@@ -1,0 +1,76 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capabilities of DeepSpeed, built on JAX/XLA/pjit/Pallas.
+
+Public API mirrors the reference (``deepspeed/__init__.py``):
+  initialize()      — build a training engine from a model + JSON config
+  init_inference()  — build an inference engine
+  comm              — functional collectives over the device mesh
+"""
+
+from .version import __version__
+from . import comm
+from .config import DeepSpeedTpuConfig
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mesh_param=None,
+               config_params=None,
+               **kwargs):
+    """Build a :class:`deepspeed_tpu.runtime.engine.DeepSpeedTpuEngine`.
+
+    Reference: ``deepspeed/__init__.py:69``. `model` is a flax module (or
+    (init_fn, apply_fn) pair); returns (engine, optimizer, dataloader,
+    lr_scheduler) like the reference.
+    """
+    from .runtime.engine import DeepSpeedTpuEngine
+
+    config = config if config is not None else config_params
+    if args is not None and config is None:
+        config = getattr(args, "deepspeed_config", None)
+
+    engine = DeepSpeedTpuEngine(model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mpu=mpu,
+                                collate_fn=collate_fn,
+                                config=config,
+                                mesh_param=mesh_param,
+                                **kwargs)
+    return_items = [engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler]
+    return tuple(return_items)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an inference engine (reference ``deepspeed/__init__.py:291``)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**{**config, **kwargs})
+    return InferenceEngine(model, config=config)
+
+
+def add_config_arguments(parser):
+    """Reference ``deepspeed/__init__.py:268`` argparse passthrough."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true")
+    group.add_argument("--deepspeed_config", default=None, type=str)
+    group.add_argument("--deepscale", default=False, action="store_true")
+    group.add_argument("--local_rank", type=int, default=-1)
+    return parser

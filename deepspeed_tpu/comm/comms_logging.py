@@ -1,0 +1,127 @@
+"""Communication logging (reference ``deepspeed/utils/comms_logging.py:67``).
+
+Records per-op name/size/latency and computes algorithmic + bus bandwidth with
+the same formulas the reference uses (``get_bw``, comms_logging.py:12-45).
+Latency on TPU is host wall-clock around a blocking dispatch, which is only
+meaningful for the eager collective API; in-trace collectives register their
+byte counts at trace time and timing comes from xprof.
+"""
+
+import math
+from ..utils.logging import logger, log_dist
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def calc_bw_log(comm_op, size, duration, n):
+    """Return (algbw, busbw) in GB/s for a collective of `size` bytes over
+    `n` participants; factors follow the reference's nccl-tests convention."""
+    duration = max(duration, 1e-9)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / max(n, 1))
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / max(n, 1))
+    elif comm_op == "all_reduce":
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / max(n, 1))
+    else:  # broadcast / send / recv / barrier / pt2pt
+        tput = size / duration
+        busbw = tput
+    tput /= 1e9
+    busbw /= 1e9
+    return tput, busbw
+
+
+class CommsLogger:
+
+    def __init__(self, config=None):
+        from ..config.feature_configs import CommsLoggerConfig
+        config = config or CommsLoggerConfig()
+        self.comms_dict = {}
+        self.verbose = config.verbose
+        self.debug = config.debug
+        self.prof_ops = config.prof_ops
+        self.prof_all = config.prof_all
+        self.enabled = config.enabled
+
+    def configure(self, config):
+        self.enabled = config.comms_config.enabled
+        self.verbose = config.comms_config.verbose
+        self.debug = config.comms_config.debug
+        self.prof_ops = config.comms_config.prof_ops
+        self.prof_all = config.comms_config.prof_all
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def start_profiling_op(self, op_name_list):
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list):
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def append(self, raw_name, record_name, latency, msg_size, n_participants=1):
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, n_participants)
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(latency)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+                self.comms_dict[record_name][msg_size][3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        if self.verbose:
+            log_str = f"comm op: {record_name} | time (ms): {latency * 1000:.2f} | msg size: {convert_size(msg_size)} | algbw (Gbps): {algbw * 8:.2f} | busbw (Gbps): {busbw * 8:.2f}"
+            log_dist(log_str, [0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from ..utils.timer import trim_mean
+        if print_log:
+            print(f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}"
+                  f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}"
+                  f"{'tput_avg (Gbps)': <20}{'busbw_avg (Gbps)': <20}")
+        for record_name in self.comms_dict.keys():
+            if print_log:
+                print(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count = vals[0]
+                total_lat = sum(vals[1])
+                avg_lat = trim_mean(vals[1], 0.1)
+                avg_algbw = trim_mean(vals[2], 0.1)
+                avg_busbw = trim_mean(vals[3], 0.1)
+                if print_log:
+                    print(f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                          f"{total_lat * 1000: <20.2f}{avg_lat * 1000: <20.2f}"
+                          f"{avg_algbw * 8: <20.2f}{avg_busbw * 8: <20.2f}")
+        return self.comms_dict
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return "%s %s" % (s, size_name[i])
+
+
+_COMMS_LOGGER = None
+
+
+def get_comms_logger() -> CommsLogger:
+    global _COMMS_LOGGER
+    if _COMMS_LOGGER is None:
+        _COMMS_LOGGER = CommsLogger()
+    return _COMMS_LOGGER

@@ -1,0 +1,167 @@
+"""Device-mesh management.
+
+This replaces the reference's ProcessGroup machinery
+(``deepspeed/utils/groups.py``, ``comm/comm.py:603 initialize_mesh_device``):
+a single global `jax.sharding.Mesh` with named axes
+
+    (pipe, data, fsdp, seq, expert, model)
+
+where every reference "group" maps to an axis (or tuple of axes):
+
+| reference group                          | mesh axis/axes          |
+|------------------------------------------|-------------------------|
+| data-parallel group (groups.py:...)      | ("data", "fsdp")        |
+| ZeRO partition group                     | "fsdp" (stage>=1)       |
+| model/tensor-parallel group (:68)        | "model"                 |
+| expert-parallel group (:117)             | "expert"                |
+| expert-data-parallel group (:188)        | data axes minus expert  |
+| sequence-parallel group (:472)           | "seq"                   |
+| pipeline stage group                     | "pipe"                  |
+| ZeRO++ hpZ secondary group (:529)        | "fsdp" innermost slice  |
+
+Axis sizes come from ``MeshConfig``; -1 fills with remaining devices.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.logging import logger
+
+MESH_AXES = ("pipe", "data", "fsdp", "seq", "expert", "model")
+
+_GLOBAL_MESH_CTX: Optional["MeshContext"] = None
+
+
+def resolve_axis_sizes(n_devices: int, sizes: Dict[str, int], order: Sequence[str] = MESH_AXES) -> Dict[str, int]:
+    """Resolve -1 entries: the first -1 axis absorbs all remaining devices."""
+    fixed = {k: v for k, v in sizes.items() if v != -1}
+    prod = int(np.prod([max(v, 1) for v in fixed.values()])) if fixed else 1
+    free = [k for k in order if sizes.get(k, 1) == -1]
+    out = {k: max(sizes.get(k, 1), 1) for k in order}
+    if free:
+        if n_devices % prod != 0:
+            raise ValueError(f"Device count {n_devices} not divisible by fixed mesh axes {fixed}")
+        rem = n_devices // prod
+        out[free[0]] = rem
+        for k in free[1:]:
+            out[k] = 1
+    total = int(np.prod(list(out.values())))
+    if total != n_devices:
+        raise ValueError(f"Mesh axes {out} (={total}) do not cover {n_devices} devices")
+    return out
+
+
+class MeshContext:
+    """Holds the global mesh and the axis-name algebra used by every layer."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    # -------- construction --------
+
+    @classmethod
+    def create(cls,
+               axis_sizes: Optional[Dict[str, int]] = None,
+               devices=None,
+               axis_order: Sequence[str] = MESH_AXES) -> "MeshContext":
+        devices = devices if devices is not None else jax.devices()
+        n = len(devices)
+        axis_sizes = dict(axis_sizes) if axis_sizes else {"data": -1}
+        if all(v != -1 for v in axis_sizes.values()):
+            # let "data" absorb leftover devices when not fully specified
+            axis_sizes.setdefault("data", -1)
+        sizes = resolve_axis_sizes(n, axis_sizes, order=axis_order)
+        shape = tuple(sizes[a] for a in axis_order)
+        dev_array = np.asarray(devices).reshape(shape)
+        mesh = Mesh(dev_array, axis_names=tuple(axis_order))
+        logger.info(f"Created mesh {dict(zip(axis_order, shape))} over {n} devices")
+        return cls(mesh)
+
+    # -------- axis algebra --------
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, axis) -> int:
+        if axis is None:
+            return self.world_size
+        if isinstance(axis, (tuple, list)):
+            return int(np.prod([self.axis_size(a) for a in axis]))
+        return self.mesh.shape[axis]
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def dp_axes(self) -> Tuple[str, ...]:
+        """Axes over which pure data parallelism happens (incl. ZeRO axis)."""
+        return tuple(a for a in ("data", "fsdp") if self.axis_size(a) > 1) or ("data", )
+
+    @property
+    def dp_size(self) -> int:
+        return self.axis_size("data") * self.axis_size("fsdp")
+
+    @property
+    def fsdp_size(self) -> int:
+        return self.axis_size("fsdp")
+
+    @property
+    def mp_size(self) -> int:
+        return self.axis_size("model")
+
+    @property
+    def sp_size(self) -> int:
+        return self.axis_size("seq")
+
+    @property
+    def ep_size(self) -> int:
+        return self.axis_size("expert")
+
+    @property
+    def pp_size(self) -> int:
+        return self.axis_size("pipe")
+
+    # -------- sharding helpers --------
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+# ---------------- global accessors ----------------
+
+
+def set_mesh_context(ctx: MeshContext):
+    global _GLOBAL_MESH_CTX
+    _GLOBAL_MESH_CTX = ctx
+
+
+def get_mesh_context() -> MeshContext:
+    global _GLOBAL_MESH_CTX
+    if _GLOBAL_MESH_CTX is None:
+        _GLOBAL_MESH_CTX = MeshContext.create()
+    return _GLOBAL_MESH_CTX
+
+
+def mesh_is_initialized() -> bool:
+    return _GLOBAL_MESH_CTX is not None
+
+
+def reset_mesh_context():
+    global _GLOBAL_MESH_CTX
+    _GLOBAL_MESH_CTX = None

@@ -1,0 +1,279 @@
+"""Top-level config tree.
+
+TPU-native analog of ``DeepSpeedConfig`` (reference ``runtime/config.py:706``):
+parses a JSON dict/file with the same keys, enforces the batch-size triangle
+``train_batch_size = micro_batch * grad_accum * dp_world_size``
+(reference ``runtime/config.py:917 _batch_assertion``), and exposes per-feature
+sub-configs.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from .config_utils import dict_raise_error_on_duplicate_keys
+from .feature_configs import (
+    ActivationCheckpointingConfig,
+    AioConfig,
+    BF16Config,
+    CheckpointConfig,
+    CommsLoggerConfig,
+    CompileConfig,
+    DataTypesConfig,
+    FlopsProfilerConfig,
+    FP16Config,
+    MeshConfig,
+    MonitorConfig,
+    ZeroConfig,
+)
+from ..utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+LION_OPTIMIZER = "lion"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+MUADAM_OPTIMIZER = "muadam"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER, ADAGRAD_OPTIMIZER,
+    ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, MUADAM_OPTIMIZER
+]
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """Print ints >= 1e3 in scientific notation when dumping configs."""
+
+    def iterencode(self, o, _one_shot=False, level=0):
+        indent = self.indent if self.indent is not None else 4
+        prefix_close = " " * level * indent
+        level += 1
+        prefix = " " * level * indent
+        if isinstance(o, bool):
+            return "true" if o else "false"
+        elif isinstance(o, float) or isinstance(o, int):
+            if o > 1e3:
+                return f"{o:e}"
+            else:
+                return f"{o}"
+        elif isinstance(o, dict):
+            x = [f'\n{prefix}"{k}": {self.iterencode(v, level=level)}' for k, v in o.items()]
+            return "{" + ", ".join(x) + f"\n{prefix_close}" + "}"
+        elif isinstance(o, list):
+            x = [f"\n{prefix}{self.iterencode(el, level=level)}" for el in o]
+            return "[" + ", ".join(x) + f"\n{prefix_close}" + "]"
+        return "".join(super().iterencode(o, _one_shot))
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedTpuConfig:
+    """The validated config tree the engine reads everywhere."""
+
+    def __init__(self, config: Any, world_size: Optional[int] = None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"DeepSpeed config file not found: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif isinstance(config, DeepSpeedTpuConfig):
+            self._param_dict = dict(config._param_dict)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path to a json file or a dict, got: {type(config)}")
+
+        self.world_size = world_size if world_size is not None else self._detect_world_size()
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    @staticmethod
+    def _detect_world_size():
+        try:
+            import jax
+            return jax.device_count()
+        except Exception:
+            return int(os.environ.get("WORLD_SIZE", 1))
+
+    # ------------------------------------------------------------------
+
+    def _initialize_params(self, pd: Dict[str, Any]):
+        self.train_batch_size = pd.get(TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = pd.get(TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = pd.get(GRADIENT_ACCUMULATION_STEPS)
+
+        self.steps_per_print = pd.get("steps_per_print", 10)
+        self.dump_state = pd.get("dump_state", False)
+        self.wall_clock_breakdown = pd.get("wall_clock_breakdown", False)
+        self.memory_breakdown = pd.get("memory_breakdown", False)
+        self.prescale_gradients = pd.get("prescale_gradients", False)
+        self.gradient_predivide_factor = pd.get("gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled = pd.get("sparse_gradients", False)
+        self.gradient_clipping = pd.get("gradient_clipping", 0.0)
+        self.communication_data_type = pd.get("communication_data_type", None)
+        self.disable_allgather = pd.get("disable_allgather", False)
+        self.zero_allow_untested_optimizer = pd.get("zero_allow_untested_optimizer", False)
+        self.zero_force_ds_cpu_optimizer = pd.get("zero_force_ds_cpu_optimizer", True)
+
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = False
+        opt = pd.get("optimizer")
+        if opt is not None:
+            self.optimizer_name = opt.get("type", "").lower()
+            self.optimizer_params = opt.get("params", {})
+            self.optimizer_legacy_fusion = opt.get("legacy_fusion", False)
+
+        self.scheduler_name = None
+        self.scheduler_params = None
+        sched = pd.get("scheduler")
+        if sched is not None:
+            self.scheduler_name = sched.get("type")
+            self.scheduler_params = sched.get("params", {})
+
+        self.zero_config = ZeroConfig(**pd.get("zero_optimization", {}))
+        self.fp16_config = FP16Config(**pd.get("fp16", {}))
+        self.bf16_config = BF16Config(**pd.get("bf16", pd.get("bfloat16", {})))
+        self.data_types_config = DataTypesConfig(**pd.get("data_types", {}))
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **pd.get("activation_checkpointing", {}))
+        self.comms_config = CommsLoggerConfig(**pd.get("comms_logger", {}))
+        self.flops_profiler_config = FlopsProfilerConfig(**pd.get("flops_profiler", {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=pd.get("tensorboard", {}),
+            wandb=pd.get("wandb", {}),
+            csv_monitor=pd.get("csv_monitor", {}),
+            comet=pd.get("comet", {}),
+        )
+        self.aio_config = AioConfig(**pd.get("aio", {}))
+        self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
+        self.compile_config = CompileConfig(**pd.get("compile", {}))
+        self.mesh_config = MeshConfig(**pd.get("mesh", {}))
+
+        self.elasticity_enabled = bool(pd.get("elasticity", {}).get("enabled", False))
+        self.elasticity_config = pd.get("elasticity", {})
+        self.autotuning_config = pd.get("autotuning", {})
+        self.compression_config = pd.get("compression_training", {})
+        self.curriculum_enabled_legacy = bool(pd.get("curriculum_learning", {}).get("enabled", False))
+        self.curriculum_params_legacy = pd.get("curriculum_learning", {})
+        self.data_efficiency_config = pd.get("data_efficiency", {})
+
+        # Pipeline parallelism settings (engine-level; reference engine.py pipeline plumbing)
+        self.pipeline_config = pd.get("pipeline", {})
+
+        # Sequence parallel (Ulysses) degree; mesh 'seq' axis wins if both given.
+        self.sequence_parallel_size = pd.get("sequence_parallel_size", self.mesh_config.seq)
+
+        self.eigenvalue_config = pd.get("eigenvalue", {})
+        self.use_data_before_expert_parallel_ = pd.get("use_data_before_expert_parallel", False)
+        self.hybrid_engine_config = pd.get("hybrid_engine", {})
+        self.nebula_config = pd.get("nebula", {})
+        self.weight_quantization_config = pd.get("weight_quantization", {})
+
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
+
+        self.graph_harvesting = pd.get("graph_harvesting", False)
+        self.seed = pd.get("seed", 42)
+
+    # ------------------------------------------------------------------
+
+    def _configure_train_batch_size(self):
+        """Resolve the batch triangle (reference ``config.py:846-915``)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = max(self.world_size, 1)
+
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp
+            micro_batch //= grad_acc
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch = micro_batch * grad_acc * dp
+        elif train_batch is not None:
+            grad_acc = 1
+            micro_batch = train_batch // dp
+        elif micro_batch is not None:
+            train_batch = micro_batch * dp
+            grad_acc = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+        self.train_batch_size = train_batch
+        self.train_micro_batch_size_per_gpu = micro_batch
+        self.gradient_accumulation_steps = grad_acc
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = max(self.world_size, 1)
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * dp, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {dp}")
+
+    def _do_sanity_check(self):
+        self._batch_assertion()
+        if self.optimizer_name is not None and self.optimizer_name not in DEEPSPEED_OPTIMIZERS:
+            # Unknown optimizers fall through to optax lookup at engine build;
+            # mirror reference behavior of allowing client optimizers.
+            logger.debug(f"Optimizer {self.optimizer_name} not a built-in; "
+                         "will resolve against optax at engine build time.")
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 modes cannot both be enabled")
+
+    # ------------------------------------------------------------------
+
+    @property
+    def fp16_enabled(self):
+        return bool(self.fp16_config.enabled)
+
+    @property
+    def bf16_enabled(self):
+        return bool(self.bf16_config.enabled)
+
+    @property
+    def loss_scale(self):
+        return self.fp16_config.loss_scale
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.fp16_config.loss_scale == 0
+
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    def print(self, name="DeepSpeedTpuConfig"):
+        logger.info("{}:".format(name))
+        logger.info(json.dumps(self._param_dict, sort_keys=True, indent=4, cls=ScientificNotationEncoder,
+                               default=str))
+
+    def dump(self):
+        return dict(self._param_dict)

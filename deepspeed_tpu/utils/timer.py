@@ -1,0 +1,251 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of the reference timers (``deepspeed/utils/timer.py``):
+``SynchronizedWallClockTimer`` (reference :44) used CUDA events; here a
+"synchronized" read calls ``jax.block_until_ready`` on a token the caller
+passes (or ``jax.effects_barrier``) before reading the host clock, since XLA
+dispatch is async. ``ThroughputTimer`` (reference :199) is host arithmetic and
+ports directly.
+"""
+
+import time
+from collections import OrderedDict
+
+from .logging import logger, log_dist
+
+try:
+    import psutil
+    _HAS_PSUTIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PSUTIL = False
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+TIME_EPSILON = 1e-6
+
+
+def _sync():
+    """Drain outstanding device work so host wall-clock brackets device time."""
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; synchronized reads drain the device queue."""
+
+    class Timer:
+
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.elapsed_ = 0.0
+            self.start_time = time.time()
+            self.records = []
+
+        def start(self):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            _sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=False):
+            assert self.started_, "timer is not started"
+            _sync()
+            elapsed = time.time() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            if record:
+                self.records.append(self.elapsed_)
+            self.started_ = False
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_ = 0.0
+            self.records = []
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if self.started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed_
+
+        def mean(self):
+            if not self.records:
+                return 0.0
+            return sum(self.records) / len(self.records)
+
+    def __init__(self):
+        self.timers = OrderedDict()
+
+    def get_timers(self):
+        return self.timers
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        if not _HAS_PSUTIL:
+            return ""
+        vm = psutil.virtual_memory()
+        return f"host mem used: {vm.used / (1024**3):.2f} GB ({vm.percent}%)"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].mean() * 1000.0 / normalizer
+                means[name] = round(elapsed_time, 2)
+        return means
+
+
+class NoopTimer:
+
+    class Timer:
+
+        def start(self):
+            ...
+
+        def reset(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0
+
+        def mean(self):
+            return 0
+
+    def __init__(self):
+        self.timer = self.Timer()
+
+    def __call__(self, name):
+        return self.timer
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        ...
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        ...
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs accounting (reference ``utils/timer.py:199``)."""
+
+    def __init__(self, config, batch_size, start_step=2, steps_per_output=None, monitor_memory=False, logging_fn=None):
+        self.config = config
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = batch_size or 1
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    @property
+    def enabled(self):
+        return getattr(self.config, "enabled", True)
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        if not self.enabled:
+            return
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.enabled or not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.steps_per_output and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6f}, "
+                        f"CurrSamplesPerSec={self._steps_to_samples(1) / (self.step_elapsed_time + TIME_EPSILON):.6f}")
+                self.step_elapsed_time = 0
+
+    def _steps_to_samples(self, steps):
+        return steps * self.batch_size
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / (avg_time_per_step + TIME_EPSILON)
+        return float("-inf")
+
+
+def trim_mean(data, trim_percent):
+    """Compute the trimmed mean of a list of numbers."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    data.sort()
+    k = int(round(n * trim_percent))
+    if len(data[k:n - k]) == 0:
+        return sum(data) / n
+    return sum(data[k:n - k]) / max(len(data[k:n - k]), 1)

@@ -1,0 +1,97 @@
+"""Config-tree tests (parity with reference ``tests/unit/runtime/test_ds_config_dict.py``)."""
+
+import json
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedTpuConfig, DeepSpeedConfigError
+
+
+def test_batch_triangle_full():
+    cfg = DeepSpeedTpuConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 1},
+        world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_infer_grad_accum():
+    cfg = DeepSpeedTpuConfig({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangle_infer_train_batch():
+    cfg = DeepSpeedTpuConfig(
+        {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3}, world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triangle_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedTpuConfig(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 1},
+            world_size=8)
+
+
+def test_batch_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedTpuConfig({}, world_size=8)
+
+
+def test_zero_config_aliases():
+    cfg = DeepSpeedTpuConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_prefetch_bucket_size": 1000,
+                "stage3_max_live_parameters": 12345,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            },
+        },
+        world_size=8)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.prefetch_bucket_size == 1000
+    assert cfg.zero_config.max_live_parameters == 12345
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_optimization_stage == 3
+    assert cfg.zero_enabled
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedTpuConfig(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_optimizer_scheduler_parsing():
+    cfg = DeepSpeedTpuConfig(
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "betas": [0.9, 0.999]}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+        },
+        world_size=8)
+    assert cfg.optimizer_name == "adamw"
+    assert cfg.optimizer_params["lr"] == 1e-3
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "bf16": {"enabled": True}}))
+    cfg = DeepSpeedTpuConfig(str(p), world_size=8)
+    assert cfg.bf16_enabled and not cfg.fp16_enabled
+
+
+def test_duplicate_keys_raise(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedTpuConfig(str(p), world_size=8)
+
+
+def test_mesh_config():
+    cfg = DeepSpeedTpuConfig({"train_batch_size": 8, "mesh": {"fsdp": 4, "model": 2, "data": 1}},
+                             world_size=8)
+    assert cfg.mesh_config.fsdp == 4
+    assert cfg.mesh_config.model == 2
