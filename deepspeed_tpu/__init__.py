@@ -55,7 +55,7 @@ def initialize(args=None,
     return tuple(return_items)
 
 
-def init_inference(model=None, config=None, **kwargs):
+def init_inference(model=None, config=None, params=None, **kwargs):
     """Build an inference engine (reference ``deepspeed/__init__.py:291``)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
@@ -63,7 +63,7 @@ def init_inference(model=None, config=None, **kwargs):
         config = {}
     if isinstance(config, dict):
         config = DeepSpeedInferenceConfig(**{**config, **kwargs})
-    return InferenceEngine(model, config=config)
+    return InferenceEngine(model, config=config, params=params)
 
 
 def add_config_arguments(parser):

@@ -1,0 +1,88 @@
+"""Checkpoint engines.
+
+Rebuild of reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``
+(the create/save/load/commit ABC) with an orbax-backed implementation:
+sharded arrays are written/restored natively (each host writes its shards),
+which subsumes the reference's per-DP-rank ZeRO shard files
+(``engine.py:3528 _save_zero_checkpoint``) — orbax metadata records the
+sharding, and restore-with-different-topology covers elastic resume.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class CheckpointEngine:
+    """ABC (reference checkpoint_engine.py:9)."""
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        ...
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        raise NotImplementedError
+
+    def makedirs(self, path, exist_ok=False):
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Sharded async-capable checkpointing via orbax.
+
+    The reference's torch engine writes one file per rank; here a single
+    logical checkpoint directory holds OCDBT-sharded arrays + a JSON sidecar
+    for host state (step counters, scheduler, rng, client state).
+    """
+
+    HOST_STATE_FILE = "ds_host_state.json"
+
+    def __init__(self, config_params=None, use_async: bool = False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def create(self, tag):
+        logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is about to be saved!")
+
+    def save(self, state_dict: Dict[str, Any], path: str, host_state: Optional[Dict] = None):
+        path = os.path.abspath(path)
+        self._ckptr.save(path, state_dict, force=True)
+        self._ckptr.wait_until_finished()
+        if host_state is not None:
+            with open(os.path.join(path, self.HOST_STATE_FILE), "w") as f:
+                json.dump(host_state, f)
+        return path
+
+    def load(self, path: str, map_location=None, target=None):
+        """Restore; `target` is an abstract pytree (jax.ShapeDtypeStruct with
+        shardings) directing placement — omit to restore as numpy."""
+        path = os.path.abspath(path)
+        if target is not None:
+            restored = self._ckptr.restore(path, target)
+        else:
+            restored = self._ckptr.restore(path)
+        host_state = None
+        hs_path = os.path.join(path, self.HOST_STATE_FILE)
+        if os.path.exists(hs_path):
+            with open(hs_path) as f:
+                host_state = json.load(f)
+        return restored, host_state
+
+    def commit(self, tag):
+        logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is ready now!")
+        return True

@@ -34,14 +34,13 @@ LAMB_OPTIMIZER = "lamb"
 LION_OPTIMIZER = "lion"
 SGD_OPTIMIZER = "sgd"
 ADAGRAD_OPTIMIZER = "adagrad"
-MUADAM_OPTIMIZER = "muadam"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
 ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
 ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
 
 DEEPSPEED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER, ADAGRAD_OPTIMIZER,
-    ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, MUADAM_OPTIMIZER
+    ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER
 ]
 
 TRAIN_BATCH_SIZE = "train_batch_size"

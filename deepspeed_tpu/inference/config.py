@@ -1,0 +1,52 @@
+"""Inference config (reference ``deepspeed/inference/config.py``).
+
+Same key surface (dtype, tensor_parallel/tp_size, max_out_tokens,
+replace_with_kernel_inject, ...); kernel-injection flags are accepted for
+API parity — on TPU "injection" is jit + Pallas kernels + sharding rules,
+applied automatically.
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..config.config_utils import ConfigModel
+
+
+class DeepSpeedTPConfig(ConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(ConfigModel):
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: Any = 1
+    type: str = "standard"
+
+
+class QuantizationConfig(ConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    kernel_inject: bool = Field(False, alias="replace_with_kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field({}, alias="tp")
+    enable_cuda_graph: bool = False  # parity no-op: XLA always compiles
+    zero: Dict[str, Any] = {}
+    triangular_masking: bool = Field(True, alias="triangular_masking")
+    moe: DeepSpeedMoEConfig = {}
+    quant: QuantizationConfig = {}
+    max_out_tokens: int = Field(1024, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_out_tokens")
+    max_tokens: int = 1024
+    checkpoint: Optional[Any] = None
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = None
+    return_tuple: bool = True
+    set_empty_params: bool = False

@@ -1,0 +1,109 @@
+"""v1 inference engine.
+
+Rebuild of reference ``deepspeed/inference/engine.py:41 InferenceEngine``:
+wraps a model for serving — dtype cast, TP sharding over the ``model`` mesh
+axis, compiled forward, and a ``generate`` loop. The reference's CUDA-graph
+capture (:527) is subsumed by jit; kernel injection (:411) by XLA fusion +
+Pallas kernels; TP groups (:257) by the mesh.
+
+The ragged continuous-batching engine (FastGen, reference inference/v2) lives
+in ``deepspeed_tpu/inference/v2``.
+"""
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.mesh import get_mesh_context, mesh_is_initialized
+from .. import comm as dist
+from ..utils.logging import logger
+from .config import DeepSpeedInferenceConfig
+
+try:
+    import flax.linen as nn
+    _HAS_FLAX = True
+except ImportError:  # pragma: no cover
+    _HAS_FLAX = False
+
+_DTYPES = {"float32": jnp.float32, "fp32": jnp.float32, "float16": jnp.float16,
+           "fp16": jnp.float16, "half": jnp.float16, "bfloat16": jnp.bfloat16,
+           "bf16": jnp.bfloat16, "int8": jnp.int8}
+
+
+class InferenceEngine:
+
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None, params=None):
+        self._config = config or DeepSpeedInferenceConfig()
+        self.module = model
+        self.dtype = _DTYPES.get(str(self._config.dtype).replace("torch.", ""), jnp.bfloat16)
+
+        if not mesh_is_initialized():
+            tp = self._config.tensor_parallel.tp_size
+            dist.init_distributed(mesh_axes={"model": tp, "data": -1} if tp > 1 else None)
+        self.mesh_ctx = get_mesh_context()
+
+        if _HAS_FLAX and isinstance(model, nn.Module):
+            self._apply = lambda p, *a, **k: model.apply({"params": p}, *a, **k)
+        elif callable(model):
+            self._apply = model
+        else:
+            raise TypeError(f"model must be a flax Module or apply callable, got {type(model)}")
+
+        self.params = None
+        if params is not None:
+            self.set_params(params)
+
+        self._fwd = jax.jit(lambda p, a, k: self._apply(p, *a, **k))
+
+    def set_params(self, params):
+        """Cast + (TP-)shard weights. With tp_size>1 the AutoTP analog in
+        parallel/tp.py provides the sharding rules."""
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        if self.mesh_ctx.mp_size > 1:
+            from ..parallel.tp import shard_params_for_tp
+            params = shard_params_for_tp(params, self.mesh_ctx)
+        else:
+            params = jax.device_put(params, self.mesh_ctx.replicated())
+        self.params = params
+        return self
+
+    def forward(self, *args, **kwargs):
+        """Compiled forward (reference :587)."""
+        assert self.params is not None, "call set_params(params) before forward"
+        return self._fwd(self.params, args, kwargs)
+
+    __call__ = forward
+
+    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, rng: Optional[jax.Array] = None):
+        """Greedy/temperature decode. This v1 path recomputes the prefix each
+        token (no KV cache) — correct but O(n^2); the v2 ragged engine holds
+        the paged KV cache (reference inference/v2)."""
+        assert self.params is not None
+        ids = jnp.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        finished = jnp.zeros((ids.shape[0], ), dtype=bool)
+        for _ in range(max_new_tokens):
+            logits = self._fwd(self.params, (ids, ), {})
+            next_logits = logits[:, -1, :]
+            if temperature and temperature > 0:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(next_logits, axis=-1)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        return ids
+
+    def profile_model_time(self, use_cuda_events=True):
+        logger.warning("profile_model_time: use jax.profiler traces on TPU")

@@ -1,0 +1,2 @@
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, cross_entropy_loss, init_llama,
+                    unbox_params, logical_axis_tree)

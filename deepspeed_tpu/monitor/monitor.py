@@ -1,0 +1,102 @@
+"""Monitoring fan-out (reference ``deepspeed/monitor/monitor.py:30``
+MonitorMaster → TensorBoard/W&B/Comet/CSV writers). Writers degrade
+gracefully when their backend package is absent."""
+
+import os
+import csv as _csv
+from typing import List, Tuple
+
+from ..utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            log_dir = os.path.join(config.output_path or ".", config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:  # tensorboard optional
+            logger.warning(f"TensorBoard monitor disabled: {e}")
+        self.enabled = self.summary_writer is not None
+
+    def write_events(self, event_list, flush=True):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        try:
+            import wandb
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb monitor disabled: {e}")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = True
+        self.output_path = config.output_path or "."
+        self.job_name = config.job_name
+        self.log_dir = os.path.join(self.output_path, self.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.filenames = {}
+
+    def write_events(self, event_list):
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            fn = os.path.join(self.log_dir, f"{safe}.csv")
+            new = not os.path.exists(fn)
+            with open(fn, "a", newline="") as f:
+                w = _csv.writer(f)
+                if new:
+                    w.writerow(["step", safe])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled writers (reference monitor.py:30)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.monitors = []
+        if monitor_config.tensorboard.enabled:
+            self.monitors.append(TensorBoardMonitor(monitor_config.tensorboard))
+        if monitor_config.wandb.enabled:
+            self.monitors.append(WandbMonitor(monitor_config.wandb))
+        if monitor_config.csv_monitor.enabled:
+            self.monitors.append(csvMonitor(monitor_config.csv_monitor))
+        self.enabled = len(self.monitors) > 0
+
+    def write_events(self, event_list):
+        for m in self.monitors:
+            m.write_events(event_list)

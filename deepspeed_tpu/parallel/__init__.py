@@ -1,0 +1,1 @@
+from .tp import tp_shardings, shard_params_for_tp, spec_from_logical, heuristic_spec, LOGICAL_RULES

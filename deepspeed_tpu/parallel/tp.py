@@ -1,0 +1,83 @@
+"""Tensor parallelism as sharding rules — the AutoTP analog.
+
+Reference: ``module_inject/auto_tp.py:189`` parses the module graph and
+row/col-shards linear weights, inserting explicit all-reduces
+(``all_reduce_linears``). On TPU the same policy is expressed as *parameter
+shardings over the ``model`` mesh axis*; XLA's SPMD partitioner propagates
+activation shardings and inserts the psum the reference codes by hand.
+
+Two sources of rules:
+1. logical-axis metadata (flax ``nn.with_partitioning``) on model params —
+   mapped via LOGICAL_RULES (the t5x-style rule table);
+2. name heuristics for unannotated pytrees (the AutoTP fallback): column-
+   parallel for q/k/v/gate/up/in-projections, row-parallel for o/down/out.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import MeshContext
+from ..utils.logging import logger
+
+# logical axis name -> mesh axis (None = replicate); the t5x-style rule table
+LOGICAL_RULES: List[Tuple[str, Optional[Any]]] = [
+    ("embed", None),
+    ("heads", "model"),
+    ("kv", "model"),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("layers", None),
+    ("expert", "expert"),
+]
+
+
+def spec_from_logical(names: Sequence[Optional[str]], rules=None) -> P:
+    rules = dict(rules or LOGICAL_RULES)
+    return P(*(rules.get(n) for n in names))
+
+
+# AutoTP-style name heuristics (reference auto_tp.py partition policy)
+_COL_PARALLEL = re.compile(r"(q_proj|k_proj|v_proj|gate_proj|up_proj|wi|fc1|c_fc|query|key|value)")
+_ROW_PARALLEL = re.compile(r"(o_proj|down_proj|wo|fc2|c_proj|dense_4h_to_h|out_proj)")
+
+
+def heuristic_spec(path: str, shape: Sequence[int], mp_size: int) -> P:
+    """Column-parallel: shard output dim; row-parallel: shard input dim.
+    Kernels are [in, out] in flax Dense."""
+    if len(shape) < 2:
+        return P()
+    if _COL_PARALLEL.search(path) and shape[-1] % mp_size == 0:
+        return P(*([None] * (len(shape) - 1) + ["model"]))
+    if _ROW_PARALLEL.search(path) and shape[-2] % mp_size == 0:
+        return P(*([None] * (len(shape) - 2) + ["model", None]))
+    return P()
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def tp_shardings(params: Any, ctx: MeshContext, logical_axes: Any = None,
+                 rules=None) -> Any:
+    """NamedSharding pytree for TP over the 'model' axis."""
+    mp = ctx.mp_size
+
+    if logical_axes is not None:
+        return jax.tree_util.tree_map(
+            lambda names: NamedSharding(ctx.mesh, spec_from_logical(names, rules))
+            if names else NamedSharding(ctx.mesh, P()), logical_axes,
+            is_leaf=lambda x: x is None or isinstance(x, tuple))
+
+    def _one(path, leaf):
+        return NamedSharding(ctx.mesh, heuristic_spec(_path_str(path), leaf.shape, mp))
+
+    return jax.tree_util.tree_map_with_path(_one, params)
+
+
+def shard_params_for_tp(params: Any, ctx: MeshContext, logical_axes: Any = None) -> Any:
+    """Place params with TP shardings (inference path entry point)."""
+    shardings = tp_shardings(params, ctx, logical_axes)
+    return jax.device_put(params, shardings)

@@ -1,0 +1,4 @@
+from .engine import DeepSpeedTpuEngine
+from .lr_schedules import (LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR,
+                           get_lr_schedule)
+from .zero_sharding import ZeroShardingPlan

@@ -1,0 +1,80 @@
+"""Data loaders (reference ``runtime/dataloader.py``: DeepSpeedDataLoader :41,
+RepeatingLoader :17) — torch-free: datasets are sequences/iterables of numpy
+or jax arrays; collation stacks to numpy (host) and the engine shards to
+device via the batch sharding plan."""
+
+import math
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+
+    def __init__(self, loader):
+        """Wraps an iterator to restart on StopIteration (reference :17)."""
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def default_collate(items):
+    """Stack a list of samples; supports tuples/dicts/arrays."""
+    first = items[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate([it[i] for it in items]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate([it[k] for it in items]) for k in first}
+    return np.stack([np.asarray(it) for it in items])
+
+
+class DeepSpeedDataLoader:
+    """Batched loader over a map-style dataset (reference :41). Distributed
+    sampling note: under SPMD single-controller the *global* batch is formed
+    on host and sharded by the engine, so there is no per-rank sampler — the
+    loader yields micro-batches of the global micro batch size * dp."""
+
+    def __init__(self,
+                 dataset: Sequence,
+                 batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset) / self.batch_size
+        return math.floor(n) if self.drop_last else math.ceil(n)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        nb = len(self)
+        for b in range(nb):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in sel])

@@ -1,0 +1,119 @@
+"""Loss scaling for fp16 training.
+
+Rebuild of reference ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler :67,
+DynamicLossScaler :91) as a jit-compatible pytree state + pure update rule, so
+the overflow check / scale adjustment lives inside the compiled train step
+(the reference does this host-side between CUDA kernels; on TPU a host round
+trip per step would stall the pipeline).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss-scale state; all fields device scalars."""
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iter: jnp.ndarray  # i32 scalar
+
+
+def make_static_state(scale: float) -> LossScaleState:
+    return LossScaleState(cur_scale=jnp.float32(scale),
+                          cur_hysteresis=jnp.int32(1),
+                          last_overflow_iter=jnp.int32(-1),
+                          iter=jnp.int32(0))
+
+
+def make_dynamic_state(init_scale_power: int = 16, delayed_shift: int = 2) -> LossScaleState:
+    return LossScaleState(cur_scale=jnp.float32(2.0**init_scale_power),
+                          cur_hysteresis=jnp.int32(delayed_shift),
+                          last_overflow_iter=jnp.int32(-1),
+                          iter=jnp.int32(0))
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True if any grad entry is non-finite (reference CheckOverflow)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = flags[0] if flags else jnp.bool_(False)
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_scale(state: LossScaleState,
+                 overflow: jnp.ndarray,
+                 scale_factor: float = 2.0,
+                 scale_window: int = 1000,
+                 min_scale: float = 1.0,
+                 max_scale: float = 2.0**32,
+                 delayed_shift: int = 2,
+                 consecutive_hysteresis: bool = False) -> LossScaleState:
+    """Pure DynamicLossScaler.update_scale (reference loss_scaler.py:137)."""
+    # overflow path: burn hysteresis first, then halve the scale
+    use_hyst = jnp.logical_and(overflow, state.cur_hysteresis > 1)
+    scale_on_overflow = jnp.where(use_hyst, state.cur_scale,
+                                  jnp.maximum(state.cur_scale / scale_factor, min_scale))
+    hyst_on_overflow = jnp.where(use_hyst, state.cur_hysteresis - 1, state.cur_hysteresis)
+
+    # growth path: double every scale_window clean iters
+    # grow when (cur_iter - last_overflow_iter) % window == 0, cur_iter
+    # 0-based and incremented after the check (reference loss_scaler.py:199):
+    # with last=-1 the first growth lands on iter 999 for window=1000
+    clean_run = (state.iter - state.last_overflow_iter) % scale_window == 0
+    scale_on_ok = jnp.where(clean_run, jnp.minimum(state.cur_scale * scale_factor, max_scale),
+                            state.cur_scale)
+    hyst_on_ok = (jnp.int32(delayed_shift) if consecutive_hysteresis else state.cur_hysteresis)
+
+    return LossScaleState(
+        cur_scale=jnp.where(overflow, scale_on_overflow, scale_on_ok),
+        cur_hysteresis=jnp.where(overflow, hyst_on_overflow, hyst_on_ok),
+        last_overflow_iter=jnp.where(overflow, state.iter, state.last_overflow_iter),
+        iter=state.iter + 1,
+    )
+
+
+class LossScalerConfig(NamedTuple):
+    """Static knobs resolved from FP16Config."""
+    dynamic: bool
+    init_scale_power: int
+    scale_window: int
+    hysteresis: int
+    consecutive_hysteresis: bool
+    min_scale: float
+    static_scale: float
+
+    @classmethod
+    def from_fp16_config(cls, c):
+        return cls(dynamic=(c.loss_scale == 0),
+                   init_scale_power=c.initial_scale_power,
+                   scale_window=c.loss_scale_window,
+                   hysteresis=c.hysteresis,
+                   consecutive_hysteresis=c.consecutive_hysteresis,
+                   min_scale=c.min_loss_scale,
+                   static_scale=c.loss_scale if c.loss_scale else 1.0)
+
+    def initial_state(self) -> LossScaleState:
+        if self.dynamic:
+            return make_dynamic_state(self.init_scale_power, self.hysteresis)
+        return make_static_state(self.static_scale)
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        if not self.dynamic:
+            return state._replace(iter=state.iter + 1)
+        return update_scale(state,
+                            overflow,
+                            scale_window=self.scale_window,
+                            min_scale=self.min_scale,
+                            delayed_shift=self.hysteresis,
+                            consecutive_hysteresis=self.consecutive_hysteresis)

@@ -1,0 +1,263 @@
+"""LR schedules.
+
+Rebuild of reference ``deepspeed/runtime/lr_schedules.py`` (LRRangeTest :267,
+OneCycle :370, WarmupLR :634, WarmupDecayLR :723, WarmupCosineLR :774) with the
+same schedule names and JSON param keys. Each schedule is a host-side object
+with the reference's ``step()/get_lr()/state_dict()`` API **and** a pure
+``lr_at(step)`` usable inside a jitted train step (all math is jnp-safe).
+"""
+
+import math
+from typing import Optional
+
+from ..utils.logging import logger
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+class _LRScheduleBase:
+    """Host-side schedule with reference API; subclasses define _lr(step)."""
+
+    def __init__(self, optimizer=None, last_batch_iteration: int = -1):
+        self.optimizer = optimizer
+        self.last_batch_iteration = last_batch_iteration
+
+    def _lr(self, step: int) -> float:
+        raise NotImplementedError
+
+    def lr_at(self, step):
+        """Pure function of step (jnp-friendly) for use inside jit."""
+        return self._lr(step)
+
+    def get_lr(self):
+        if self.last_batch_iteration < 0:
+            logger.warning("Attempting to get learning rate from scheduler before it has started")
+            return [0.0]
+        return [self._lr(self.last_batch_iteration)]
+
+    def get_last_lr(self):
+        assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
+        return self._last_lr
+
+    def step(self, last_batch_iteration: Optional[int] = None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        self._last_lr = [self._lr(self.last_batch_iteration)]
+        if self.optimizer is not None and hasattr(self.optimizer, "set_lr"):
+            self.optimizer.set_lr(self._last_lr[0])
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class LRRangeTest(_LRScheduleBase):
+    """LR range test (reference :267): linearly/staircase-increasing LR."""
+
+    def __init__(self,
+                 optimizer=None,
+                 lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        if lr_range_test_min_lr <= 0:
+            raise ValueError(f"LR range test minimum lr={lr_range_test_min_lr}, must be > 0")
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def _lr(self, step):
+        import jax.numpy as jnp
+        count = step / self.step_size
+        if self.staircase:
+            count = jnp.floor(count) if not isinstance(count, float) else math.floor(count)
+        return self.min_lr * (1 + count * self.step_rate)
+
+
+class OneCycle(_LRScheduleBase):
+    """1-cycle policy (reference :370): up phase, down phase, then decay."""
+
+    def __init__(self,
+                 optimizer=None,
+                 cycle_min_lr: float = 1e-4,
+                 cycle_max_lr: float = 1e-3,
+                 decay_lr_rate: float = 0.0,
+                 cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0,
+                 cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0,
+                 cycle_momentum: bool = True,
+                 cycle_min_mom: float = 0.8,
+                 cycle_max_mom: float = 0.9,
+                 decay_mom_rate: float = 0.0,
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_step_size = cycle_first_step_size
+        self.second_step_size = cycle_second_step_size or cycle_first_step_size
+        self.decay_step_size = decay_step_size
+        self.total_cycle_size = self.first_step_size + self.second_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+
+    def _lr(self, step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, dtype=jnp.float32)
+        in_up = step < self.first_step_size
+        in_cycle = step < self.total_cycle_size
+        up_frac = jnp.clip(step / max(self.first_step_size, 1), 0.0, 1.0)
+        down_frac = jnp.clip((step - self.first_step_size) / max(self.second_step_size, 1), 0.0, 1.0)
+        lr_up = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * up_frac
+        lr_down = self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * down_frac
+        # decay phase after the cycle
+        decay_steps = jnp.maximum(step - self.total_cycle_size, 0.0)
+        if self.decay_step_size > 0:
+            decay_count = jnp.floor(decay_steps / self.decay_step_size)
+        else:
+            decay_count = decay_steps
+        lr_decay = self.cycle_min_lr / (1.0 + decay_count * self.decay_lr_rate)
+        return jnp.where(in_up, lr_up, jnp.where(in_cycle, lr_down, lr_decay))
+
+    def get_mom(self):
+        if not self.cycle_momentum:
+            return None
+        step = max(self.last_batch_iteration, 0)
+        if step < self.first_step_size:
+            frac = step / max(self.first_step_size, 1)
+            return self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * frac
+        elif step < self.total_cycle_size:
+            frac = (step - self.first_step_size) / max(self.second_step_size, 1)
+            return self.cycle_min_mom + (self.cycle_max_mom - self.cycle_min_mom) * frac
+        return self.cycle_max_mom
+
+
+class WarmupLR(_LRScheduleBase):
+    """Warmup then hold (reference :634). warmup_type: log|linear."""
+
+    def __init__(self,
+                 optimizer=None,
+                 warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000,
+                 warmup_type: str = "log",
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        if warmup_type not in ("log", "linear"):
+            logger.warning(f"Using unknown warmup_type: {warmup_type}. The increasing function "
+                           "is set to default (log)")
+            warmup_type = "log"
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+    def _gamma(self, step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, dtype=jnp.float32)
+        if self.warmup_type == "log":
+            g = self.inverse_log_warm_up * jnp.log(jnp.maximum(step, 1.0))
+        else:
+            g = step / self.warmup_num_steps
+        return jnp.clip(g, 0.0, 1.0)
+
+    def _lr(self, step):
+        g = self._gamma(step)
+        return self.min_lr + (self.max_lr - self.min_lr) * g
+
+
+class WarmupDecayLR(WarmupLR):
+    """Warmup then linear decay to zero over total_num_steps (reference :723)."""
+
+    def __init__(self,
+                 optimizer=None,
+                 total_num_steps: int = 10000,
+                 warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000,
+                 warmup_type: str = "log",
+                 last_batch_iteration: int = -1):
+        self.total_num_steps = total_num_steps
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type,
+                         last_batch_iteration)
+        if self.total_num_steps < self.warmup_num_steps:
+            logger.warning("total_num_steps {} is less than warmup_num_steps {}".format(
+                total_num_steps, warmup_num_steps))
+
+    def _gamma(self, step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = super()._gamma(step)
+        decay = jnp.maximum(
+            0.0, (self.total_num_steps - step) /
+            max(self.total_num_steps - self.warmup_num_steps, 1))
+        return jnp.where(step < self.warmup_num_steps, warm, decay)
+
+
+class WarmupCosineLR(_LRScheduleBase):
+    """Warmup then cosine decay (reference :774); ratios of the optimizer lr."""
+
+    def __init__(self,
+                 optimizer=None,
+                 total_num_steps: int = 10000,
+                 warmup_min_ratio: float = 0.0,
+                 warmup_num_steps: int = 1000,
+                 cos_min_ratio: float = 0.0001,
+                 last_batch_iteration: int = -1,
+                 base_lr: float = 1.0):
+        super().__init__(optimizer, last_batch_iteration)
+        self.total_num_steps = total_num_steps
+        self.warmup_min_ratio = warmup_min_ratio
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        self.cos_min_ratio = cos_min_ratio
+        self.base_lr = base_lr
+        if self.total_num_steps < self.warmup_num_steps:
+            logger.warning("total_num_steps {} is less than warmup_num_steps {}".format(
+                total_num_steps, warmup_num_steps))
+
+    def _lr(self, step):
+        import jax.numpy as jnp
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm_ratio = self.warmup_min_ratio + (1.0 - self.warmup_min_ratio) * jnp.clip(
+            step / self.warmup_num_steps, 0.0, 1.0)
+        frac = jnp.clip((step - self.warmup_num_steps) /
+                        max(self.total_num_steps - self.warmup_num_steps, 1), 0.0, 1.0)
+        cos_ratio = self.cos_min_ratio + (1.0 - self.cos_min_ratio) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        ratio = jnp.where(step < self.warmup_num_steps, warm_ratio, cos_ratio)
+        return self.base_lr * ratio
+
+
+SCHEDULE_CLASSES = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+    WARMUP_COSINE_LR: WarmupCosineLR,
+}
+
+
+def get_lr_schedule(name: str, params: dict, optimizer=None, base_lr: Optional[float] = None):
+    if name not in SCHEDULE_CLASSES:
+        raise ValueError(f"Unknown LR schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    kwargs = dict(params)
+    if name == WARMUP_COSINE_LR and base_lr is not None:
+        kwargs.setdefault("base_lr", base_lr)
+    return SCHEDULE_CLASSES[name](optimizer=optimizer, **kwargs)

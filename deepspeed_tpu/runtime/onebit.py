@@ -1,0 +1,168 @@
+"""1-bit / 0/1 Adam and 1-bit LAMB optimizers.
+
+Rebuild of reference ``runtime/fp16/onebit/{adam,zoadam,lamb}.py``: after a
+full-precision warmup phase, the momentum is communicated in sign+scale form
+with an error-feedback buffer (error compensation), and (for 1-bit Adam) the
+variance term is frozen at its warmup value.
+
+TPU note: the reference pairs this math with custom NCCL/MPI compressed
+collectives (``runtime/comm/nccl.py compressed_allreduce``). Under SPMD/XLA
+the gradient all-reduce is emitted by the compiler, so the compression here is
+expressed as the *numerics* (sign+scale with error feedback applied to the
+momentum update); the wire-compression analog over ICI is provided by the
+quantized-collective kernels in ``ops/pallas/quant.py`` + shard_map reductions
+(ZeRO++ qgZ path), which share this module's sign/scale math.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OneBitAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any  # momentum (exchanged compressed after warmup)
+    nu: Any  # variance (frozen after warmup for 1-bit Adam)
+    error: Any  # error-feedback buffer
+
+
+def _sign_compress(x, error):
+    """Error-compensated 1-bit compression: sign + per-tensor L1 scale.
+    Returns (compressed, new_error); reference compressed_allreduce
+    (runtime/comm/nccl.py:16) packs the sign bits for the wire."""
+    corrected = x + error
+    scale = jnp.mean(jnp.abs(corrected))
+    compressed = jnp.sign(corrected) * scale
+    new_error = corrected - compressed
+    return compressed, new_error
+
+
+def scale_by_onebit_adam(b1: float = 0.9,
+                         b2: float = 0.999,
+                         eps: float = 1e-8,
+                         freeze_step: int = 100000,
+                         var_freeze: bool = True) -> optax.GradientTransformation:
+    """1-bit Adam (reference onebit/adam.py:14). Before `freeze_step`: exact
+    Adam. After: variance frozen, momentum sign-compressed w/ error feedback."""
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return OneBitAdamState(count=jnp.zeros([], jnp.int32),
+                               mu=jax.tree_util.tree_map(jnp.zeros_like, params),
+                               nu=jax.tree_util.tree_map(jnp.zeros_like, params),
+                               error=zeros)
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        in_warmup = count <= freeze_step
+
+        # warmup variance update; frozen afterwards
+        nu_warm = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * (g * g), state.nu, updates)
+        nu = jax.tree_util.tree_map(lambda w, f: jnp.where(in_warmup, w, f), nu_warm, state.nu) \
+            if var_freeze else nu_warm
+
+        # compressed momentum (post-warmup)
+        comp_and_err = jax.tree_util.tree_map(_sign_compress, mu, state.error)
+        mu_comp = jax.tree_util.tree_map(lambda ce: ce[0], comp_and_err,
+                                         is_leaf=lambda x: isinstance(x, tuple))
+        err_new = jax.tree_util.tree_map(lambda ce: ce[1], comp_and_err,
+                                         is_leaf=lambda x: isinstance(x, tuple))
+        mu_used = jax.tree_util.tree_map(lambda w, c: jnp.where(in_warmup, w, c), mu, mu_comp)
+        error = jax.tree_util.tree_map(lambda e_old, e_new: jnp.where(in_warmup, e_old, e_new),
+                                       state.error, err_new)
+
+        c = count.astype(jnp.float32)
+        bc1 = 1 - b1**c
+        bc2 = 1 - b2**c
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu_used, nu)
+        return new_updates, OneBitAdamState(count=count, mu=mu_used, nu=nu, error=error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def scale_by_zero_one_adam(b1: float = 0.9,
+                           b2: float = 0.999,
+                           eps: float = 1e-8,
+                           var_freeze_step: int = 100000,
+                           var_update_scaler: int = 16,
+                           local_step_scaler: int = 32678,
+                           local_step_clipper: int = 16) -> optax.GradientTransformation:
+    """0/1 Adam (reference onebit/zoadam.py:14): like 1-bit Adam but with
+    interval-scheduled variance updates instead of a hard freeze."""
+
+    def init_fn(params):
+        return OneBitAdamState(count=jnp.zeros([], jnp.int32),
+                               mu=jax.tree_util.tree_map(jnp.zeros_like, params),
+                               nu=jax.tree_util.tree_map(jnp.zeros_like, params),
+                               error=jax.tree_util.tree_map(jnp.zeros_like, params))
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        # variance updated every var_update_scaler steps (0/1 Adam policy)
+        do_var = (count % var_update_scaler == 0) | (count <= var_freeze_step)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(do_var, b2 * v + (1 - b2) * (g * g), v), state.nu, updates)
+        c = count.astype(jnp.float32)
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v: (m / (1 - b1**c)) / (jnp.sqrt(v / (1 - b2**c)) + eps), mu, nu)
+        return new_updates, OneBitAdamState(count=count, mu=mu, nu=nu, error=state.error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def scale_by_onebit_lamb(b1: float = 0.9,
+                         b2: float = 0.999,
+                         eps: float = 1e-8,
+                         freeze_step: int = 100000,
+                         max_coeff: float = 10.0,
+                         min_coeff: float = 0.01) -> optax.GradientTransformation:
+    """1-bit LAMB (reference onebit/lamb.py:15): 1-bit Adam core + layerwise
+    trust ratio clamped to [min_coeff, max_coeff]."""
+    core = scale_by_onebit_adam(b1=b1, b2=b2, eps=eps, freeze_step=freeze_step)
+
+    def init_fn(params):
+        return core.init(params)
+
+    def update_fn(updates, state, params=None):
+        upd, state = core.update(updates, state, params)
+
+        def trust(u, p):
+            pn = jnp.linalg.norm(p.astype(jnp.float32))
+            un = jnp.linalg.norm(u.astype(jnp.float32))
+            ratio = jnp.where(un > 0, pn / jnp.maximum(un, 1e-12), 1.0)
+            ratio = jnp.clip(jnp.where(pn > 0, ratio, 1.0), min_coeff, max_coeff)
+            return u * ratio
+
+        upd = jax.tree_util.tree_map(trust, upd, params)
+        return upd, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_onebit_optimizer(name: str, params: Dict[str, Any], learning_rate) -> optax.GradientTransformation:
+    betas = params.get("betas", (0.9, 0.999))
+    eps = float(params.get("eps", 1e-8))
+    weight_decay = float(params.get("weight_decay", 0.0))
+    freeze_step = int(params.get("freeze_step", 100000))
+    if name == "onebitadam":
+        core = scale_by_onebit_adam(b1=betas[0], b2=betas[1], eps=eps, freeze_step=freeze_step)
+    elif name == "zerooneadam":
+        core = scale_by_zero_one_adam(b1=betas[0], b2=betas[1], eps=eps,
+                                      var_freeze_step=int(params.get("var_freeze_step", freeze_step)),
+                                      var_update_scaler=int(params.get("var_update_scaler", 16)))
+    elif name == "onebitlamb":
+        core = scale_by_onebit_lamb(b1=betas[0], b2=betas[1], eps=eps, freeze_step=freeze_step,
+                                    max_coeff=float(params.get("max_coeff", 10.0)),
+                                    min_coeff=float(params.get("min_coeff", 0.01)))
+    else:
+        raise ValueError(name)
+    return optax.chain(
+        core,
+        optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
+        optax.scale_by_learning_rate(learning_rate),
+    )

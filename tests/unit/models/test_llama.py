@@ -1,0 +1,83 @@
+"""Llama model tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM, init_llama, cross_entropy_loss
+
+
+def test_forward_shapes():
+    cfg = LlamaConfig.tiny()
+    model, params = init_llama(cfg)
+    ids = jnp.ones((2, 16), dtype=jnp.int32)
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_contract():
+    cfg = LlamaConfig.tiny()
+    model, params = init_llama(cfg)
+    ids = jnp.ones((2, 16), dtype=jnp.int32)
+    loss = model.apply({"params": params}, ids, labels=ids)
+    assert loss.shape == ()
+    assert np.isfinite(float(loss))
+    # fresh init loss ≈ ln(vocab) (lecun-init logits add ~1 nat of variance)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 2.0
+
+
+def test_ignore_index_masking():
+    logits = jnp.zeros((1, 4, 8))
+    labels = jnp.array([[1, 2, -100, 3]])
+    loss = cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+def test_scan_vs_loop_equivalence():
+    """scan_layers is a compile-time layout choice, not a numerics change."""
+    cfg_loop = LlamaConfig.tiny(scan_layers=False)
+    model_l, params_l = init_llama(cfg_loop)
+    cfg_scan = LlamaConfig.tiny(scan_layers=True)
+    model_s, params_s = init_llama(cfg_scan)
+    # stack the loop params into scan layout and compare forward
+    ids = jnp.ones((1, 8), dtype=jnp.int32)
+    import jax.tree_util as jtu
+    stacked = jtu.tree_map(lambda *xs: jnp.stack(xs),
+                           params_l["model"]["layers_0"], params_l["model"]["layers_1"])
+    params_s2 = {"model": {**{k: v for k, v in params_l["model"].items()
+                              if not k.startswith("layers_")},
+                           "layers": {"layer": stacked}}}
+    out_l = model_l.apply({"params": params_l}, ids)
+    out_s = model_s.apply({"params": params_s2}, ids)
+    np.testing.assert_allclose(np.asarray(out_l), np.asarray(out_s), rtol=2e-2, atol=2e-2)
+
+
+def test_gqa_heads():
+    cfg = LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=1)
+    model, params = init_llama(cfg)
+    k = params["model"]["layers_0"]["self_attn"]["k_proj"]["kernel"]
+    assert k.shape[-1] == cfg.head_dim_ * 1
+
+
+@pytest.mark.world_size(8)
+def test_llama_trains_with_engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model, params = init_llama(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+                "mesh": {"fsdp": 8}})
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(8):
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, 16)), dtype=jnp.int32)
+        loss = engine.forward(ids, labels=ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,72 @@
+"""LR schedule tests (parity: reference ``tests/unit/runtime/test_lr_schedulers.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR,
+                                                WarmupCosineLR, get_lr_schedule)
+
+
+def test_warmup_lr():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    assert float(s.lr_at(0)) == 0.0
+    assert float(s.lr_at(5)) == pytest.approx(0.05)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(100)) == pytest.approx(0.1)  # hold
+
+
+def test_warmup_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100, warmup_type="log")
+    assert float(s.lr_at(1)) == pytest.approx(0.0)
+    assert float(s.lr_at(100)) == pytest.approx(0.1, rel=1e-3)
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10,
+                      warmup_type="linear")
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(55)) == pytest.approx(0.05)
+    assert float(s.lr_at(100)) == pytest.approx(0.0)
+    assert float(s.lr_at(200)) == pytest.approx(0.0)  # clamped
+
+
+def test_warmup_cosine():
+    s = WarmupCosineLR(total_num_steps=100, warmup_num_steps=10, warmup_min_ratio=0.0,
+                       cos_min_ratio=0.1, base_lr=1.0)
+    assert float(s.lr_at(10)) == pytest.approx(1.0, rel=1e-2)
+    assert float(s.lr_at(100)) == pytest.approx(0.1, rel=1e-2)
+    mid = float(s.lr_at(55))
+    assert 0.1 < mid < 1.0
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(20)) == pytest.approx(0.01, rel=1e-2)
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.02)
+
+
+def test_step_api():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    for _ in range(5):
+        s.step()
+    assert s.last_batch_iteration == 4
+    assert s.get_last_lr()[0] == pytest.approx(float(s.lr_at(4)))
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == 4
+
+
+def test_factory():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})

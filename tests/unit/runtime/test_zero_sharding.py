@@ -1,0 +1,69 @@
+"""ZeRO sharding-plan tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import MeshContext, set_mesh_context
+from deepspeed_tpu.runtime.zero_sharding import (ZeroShardingPlan, choose_partition_dim, leaf_spec,
+                                                 zero_axes_for)
+
+
+@pytest.fixture
+def ctx8():
+    ctx = MeshContext.create(axis_sizes={"data": 2, "fsdp": 4})
+    set_mesh_context(ctx)
+    return ctx
+
+
+def test_choose_partition_dim():
+    assert choose_partition_dim((16, 8), 4) == 0
+    assert choose_partition_dim((6, 8), 4) == 1
+    assert choose_partition_dim((3, 5), 4) is None
+    assert choose_partition_dim((12, 16), 4) == 1  # largest divisible dim
+    assert choose_partition_dim((), 4) is None
+    assert choose_partition_dim((16,), 4, min_size=100) is None  # persistence threshold
+
+
+def test_zero_axes(ctx8):
+    assert zero_axes_for(ctx8) == ("fsdp",)
+    ctx2 = MeshContext.create(axis_sizes={"data": 8, "fsdp": 1})
+    assert zero_axes_for(ctx2) == ("data",)
+
+
+@pytest.mark.world_size(8)
+def test_stage3_param_sharding(ctx8):
+    plan = ZeroShardingPlan(ctx8, stage=3)
+    params = {"w": jnp.ones((16, 8)), "b": jnp.ones((5,))}
+    sh = plan.param_shardings(params)
+    assert sh["w"].spec == P("fsdp", None)
+    assert sh["b"].spec == P()  # 5 not divisible → replicated
+
+
+@pytest.mark.world_size(8)
+def test_stage_levels(ctx8):
+    params = {"w": jnp.ones((16, 8))}
+    for stage, (p_sharded, g_sharded, o_sharded) in {
+            0: (False, False, False),
+            1: (False, False, True),
+            2: (False, True, True),
+            3: (True, True, True),
+    }.items():
+        plan = ZeroShardingPlan(ctx8, stage=stage)
+        psh = plan.param_shardings(params)["w"].spec
+        gsh = plan.grad_shardings(params)["w"].spec
+        osh = plan.opt_state_shardings(params)["w"].spec
+        assert (psh != P()) == p_sharded, (stage, psh)
+        assert (gsh != P()) == g_sharded, (stage, gsh)
+        assert (osh != P()) == o_sharded, (stage, osh)
+
+
+@pytest.mark.world_size(8)
+def test_batch_sharding(ctx8):
+    plan = ZeroShardingPlan(ctx8, stage=0)
+    batch = (jnp.ones((16, 4)), jnp.ones((3, 4)))
+    sh = plan.batch_sharding(batch)
+    assert sh[0].spec == P(("data", "fsdp"))
+    assert sh[1].spec == P()  # 3 not divisible by 8

@@ -1,0 +1,41 @@
+"""Test model fixtures — analog of reference ``tests/unit/simple_model.py``."""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """MLP returning its own loss (the engine's loss contract)."""
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y):
+        for _ in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.hidden_dim)(x)
+        return jnp.mean((x - y)**2)
+
+
+def simple_model_and_params(hidden_dim=16, nlayers=2, seed=0):
+    model = SimpleModel(hidden_dim=hidden_dim, nlayers=nlayers)
+    x = jnp.ones((2, hidden_dim))
+    y = jnp.ones((2, hidden_dim))
+    params = model.init(jax.random.PRNGKey(seed), x, y)["params"]
+    return model, params
+
+
+def random_dataset(total_samples, hidden_dim, seed=123):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    ys = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def random_dataloader(model_hidden, total_samples=64, batch_size=8):
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    ds = random_dataset(total_samples, model_hidden)
+    return DeepSpeedDataLoader(ds, batch_size=batch_size)
