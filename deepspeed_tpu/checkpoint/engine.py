@@ -48,7 +48,8 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     for host state (step counters, scheduler, rng, client state).
     """
 
-    HOST_STATE_FILE = "ds_host_state.json"
+    HOST_STATE_FILE = "ds_host_state.pkl"
+    LEGACY_HOST_STATE_FILE = "ds_host_state.json"
 
     def __init__(self, config_params=None, use_async: bool = False):
         super().__init__(config_params)
@@ -64,8 +65,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self._ckptr.save(path, state_dict, force=True)
         self._ckptr.wait_until_finished()
         if host_state is not None:
-            with open(os.path.join(path, self.HOST_STATE_FILE), "w") as f:
-                json.dump(host_state, f)
+            # pickle, not JSON: the reference torch.save()s arbitrary client
+            # state (engine.py:3109) — numpy rng states etc. must round-trip
+            import pickle
+            with open(os.path.join(path, self.HOST_STATE_FILE), "wb") as f:
+                pickle.dump(host_state, f)
         return path
 
     def load(self, path: str, map_location=None, target=None):
@@ -78,8 +82,13 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             restored = self._ckptr.restore(path)
         host_state = None
         hs_path = os.path.join(path, self.HOST_STATE_FILE)
+        legacy = os.path.join(path, self.LEGACY_HOST_STATE_FILE)
         if os.path.exists(hs_path):
-            with open(hs_path) as f:
+            import pickle
+            with open(hs_path, "rb") as f:
+                host_state = pickle.load(f)
+        elif os.path.exists(legacy):
+            with open(legacy) as f:
                 host_state = json.load(f)
         return restored, host_state
 

@@ -98,6 +98,19 @@ class DeepSpeedTpuConfig:
         self._configure_train_batch_size()
         self._do_sanity_check()
 
+    def reresolve(self, world_size: int):
+        """Re-run batch-triangle resolution for a corrected dp world size
+        (the engine learns the true dp = data*fsdp only after the mesh is
+        built; see engine.py)."""
+        if world_size == self.world_size:
+            return
+        self.world_size = world_size
+        self.train_batch_size = self._param_dict.get(TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = self._param_dict.get(TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = self._param_dict.get(GRADIENT_ACCUMULATION_STEPS)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
     @staticmethod
     def _detect_world_size():
         try:

@@ -56,6 +56,7 @@ class InferenceEngine:
             self.set_params(params)
 
         self._fwd = jax.jit(lambda p, a, k: self._apply(p, *a, **k))
+        self._decode_step = jax.jit(self._decode_step_impl)
 
     def set_params(self, params):
         """Cast + (TP-)shard weights. With tp_size>1 the AutoTP analog in
@@ -77,33 +78,47 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _decode_step_impl(self, params, buf, cur, rng, finished, temperature, eos):
+        """One decode step over a FIXED-length buffer: the jit signature never
+        changes across tokens (a growing ids array would recompile the model
+        per token). Causal attention makes the garbage beyond `cur` inert."""
+        logits = self._apply(params, buf)
+        next_logits = logits[:, cur - 1, :]
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(sub, next_logits / jnp.maximum(temperature, 1e-6), axis=-1)
+        greedy = jnp.argmax(next_logits, axis=-1)
+        nxt = jnp.where(temperature > 0, sampled, greedy)
+        nxt = jnp.where(finished, eos, nxt)
+        finished = finished | (nxt == eos)
+        buf = buf.at[:, cur].set(nxt.astype(buf.dtype))
+        return buf, cur + 1, rng, finished
+
     def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, rng: Optional[jax.Array] = None):
-        """Greedy/temperature decode. This v1 path recomputes the prefix each
-        token (no KV cache) — correct but O(n^2); the v2 ragged engine holds
-        the paged KV cache (reference inference/v2)."""
+        """Greedy/temperature decode over a fixed-size buffer (one compile).
+        This v1 path recomputes the prefix each token (no KV cache) — correct
+        but O(n^2) FLOPs; the v2 ragged engine holds the paged KV cache
+        (reference inference/v2)."""
         assert self.params is not None
         ids = jnp.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
+        b, s0 = ids.shape
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        finished = jnp.zeros((ids.shape[0], ), dtype=bool)
-        for _ in range(max_new_tokens):
-            logits = self._fwd(self.params, (ids, ), {})
-            next_logits = logits[:, -1, :]
-            if temperature and temperature > 0:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(next_logits, axis=-1)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        buf = jnp.pad(ids, ((0, 0), (0, max_new_tokens)))
+        cur = jnp.int32(s0)
+        finished = jnp.zeros((b, ), dtype=bool)
+        temp = jnp.float32(temperature)
+        # eos=-1 sentinel never matches a real token -> no early finish
+        eos = jnp.int32(eos_token_id if eos_token_id is not None else -1)
+        for i in range(max_new_tokens):
+            buf, cur, rng, finished = self._decode_step(self.params, buf, cur, rng, finished,
+                                                        temp, eos)
+            # host sync for early exit only when an eos is in play
             if eos_token_id is not None and bool(finished.all()):
-                break
-        return ids
+                return buf[:, :s0 + i + 1]
+        return buf
 
     def profile_model_time(self, use_cuda_events=True):
         logger.warning("profile_model_time: use jax.profiler traces on TPU")

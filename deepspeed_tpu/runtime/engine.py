@@ -92,12 +92,14 @@ class DeepSpeedTpuEngine:
             return get_mesh_context().dp_size
         mesh_cfg = dict(raw.get("mesh", {})) if isinstance(raw, dict) else {}
         mesh_cfg.pop("axis_order", None)
+        # partial specs (e.g. {"model": 2}) leave "data" to absorb leftovers,
+        # mirroring MeshContext.create
+        if mesh_cfg and all(v != -1 for v in mesh_cfg.values()) and "data" not in mesh_cfg:
+            mesh_cfg["data"] = -1
         try:
             sizes = resolve_axis_sizes(jax.device_count(), mesh_cfg or {"data": -1})
         except ValueError:
             return jax.device_count()
-        if all(v != -1 for v in mesh_cfg.values()) and "data" not in mesh_cfg:
-            sizes = resolve_axis_sizes(jax.device_count(), {**mesh_cfg, "data": -1})
         return sizes.get("data", 1) * sizes.get("fsdp", 1)
 
     def __init__(self,
@@ -142,17 +144,8 @@ class DeepSpeedTpuEngine:
             dist.init_distributed(mesh_axes=axes)
         self.mesh_ctx = get_mesh_context()
         self.dp_world_size = self.mesh_ctx.dp_size
-        if self._config.world_size != self.dp_world_size:
-            # pre-initialized mesh differs from config's guess: re-resolve
-            self._config.world_size = self.dp_world_size
-            self._config.train_batch_size = None if self._config._param_dict.get(
-                "train_batch_size") is None else self._config._param_dict["train_batch_size"]
-            self._config.train_micro_batch_size_per_gpu = self._config._param_dict.get(
-                "train_micro_batch_size_per_gpu")
-            self._config.gradient_accumulation_steps = self._config._param_dict.get(
-                "gradient_accumulation_steps")
-            self._config._configure_train_batch_size()
-            self._config._batch_assertion()
+        # pre-initialized mesh may differ from the config's pre-mesh guess
+        self._config.reresolve(self.dp_world_size)
 
         # ---- precision policy ----
         if self._config.bf16_enabled:
@@ -346,10 +339,18 @@ class DeepSpeedTpuEngine:
 
     def forward(self, *args, **kwargs):
         """Compute loss AND cache gradients (see module docstring)."""
+        if self._pending is not None:
+            # forward() accumulates grads at forward time (module docstring);
+            # a second forward without backward() would silently contaminate
+            # the accumulation buffer — the reference's forward is pure, so
+            # ported eval loops must use eval_batch()/module_forward()
+            raise RuntimeError(
+                "forward() called twice without backward(); for inference/eval "
+                "use eval_batch() or module_forward() (grad-free compiled path)")
         self.timers(FORWARD_MICRO_TIMER).start()
         scale = self.scale_state.cur_scale if self._use_loss_scaling else self._one
-        batch = self.zero_plan.batch_sharding(args)
-        args = jax.device_put(args, batch)
+        args = jax.device_put(args, self.zero_plan.batch_sharding(args))
+        kwargs = jax.device_put(kwargs, self.zero_plan.batch_sharding(kwargs))
         loss, new_acc = self._fwd_bwd(self.params, self.grad_acc, scale, args, kwargs)
         # grad_acc was donated; keep the new buffer, commit on backward()
         self.grad_acc = new_acc

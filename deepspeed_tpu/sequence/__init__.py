@@ -1,0 +1,18 @@
+"""Sequence parallelism (DeepSpeed-Ulysses) + ring attention.
+
+TPU-native rebuild of reference ``deepspeed/sequence/`` plus the ring-attention
+context-parallel extension the reference lacks (SURVEY.md §2.4: flagged as the
+TPU CP analog).
+"""
+
+from .layer import DistributedAttention, seq_all_to_all, ulysses_spmd
+from .ring import ring_attention
+from .cross_entropy import vocab_sequence_parallel_cross_entropy
+
+__all__ = [
+    "DistributedAttention",
+    "seq_all_to_all",
+    "ulysses_spmd",
+    "ring_attention",
+    "vocab_sequence_parallel_cross_entropy",
+]

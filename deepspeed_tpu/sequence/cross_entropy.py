@@ -1,0 +1,31 @@
+"""Sequence-parallel cross entropy.
+
+Rebuild of reference ``deepspeed/sequence/cross_entropy.py:11
+vocab_sequence_parallel_cross_entropy``: each sequence-parallel rank computes
+cross-entropy for its local sequence shard, then the per-token losses are
+all-gathered over the ``seq`` axis so every rank sees the full [S, B] loss.
+
+The reference needs a hand-written autograd.Function (the gather is done on
+the loss, and the backward re-slices grad_output per rank); under JAX the
+gather is differentiable, so plain autodiff produces the same sliced gradient.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _log_softmax(x):
+    m = lax.stop_gradient(x.max(axis=-1, keepdims=True))
+    shifted = x - m
+    return shifted - jnp.log(jnp.exp(shifted).sum(axis=-1, keepdims=True))
+
+
+def vocab_sequence_parallel_cross_entropy(logits, target, axis_name: str = "seq"):
+    """Per-token NLL over the sequence-parallel group (inside shard_map).
+
+    logits: [S/P, B, V] local shard; target: [S/P, B].
+    Returns [S, B] per-token loss, identical on every rank.
+    """
+    logp = _log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, target[..., None], axis=-1)[..., 0]
+    return lax.all_gather(loss, axis_name, axis=0, tiled=True)

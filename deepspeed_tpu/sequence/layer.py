@@ -1,0 +1,103 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Rebuild of reference ``deepspeed/sequence/layer.py`` (``_SeqAllToAll :90``,
+``single_all_to_all :41``, ``DistributedAttention :145``): shard the sequence
+dim across the ``seq`` mesh axis; before attention, all-to-all swaps the
+sharding from [b, s/P, h, d] to [b, s, h/P, d] so each device holds full
+sequences for a head subset; after attention the inverse all-to-all restores
+sequence sharding.
+
+Two implementations, matching the two JAX programming styles:
+
+1. `seq_all_to_all` / `DistributedAttention` — explicit ``lax.all_to_all``
+   for use inside ``shard_map`` (per-shard view). This is the direct analog of
+   the reference's torch `dist.all_to_all_single` path; on TPU the all-to-all
+   rides ICI.
+2. `ulysses_spmd` — GSPMD style for use under plain ``jit``: resharding via
+   ``with_sharding_constraint`` makes XLA insert the same all-to-alls, with
+   the compiler free to overlap them with the qkv projections.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import get_mesh_context
+
+
+def seq_all_to_all(x, axis_name: str = "seq", scatter_idx: int = 2, gather_idx: int = 1):
+    """All-to-all swapping shard dim, per-shard view (inside shard_map).
+
+    Reference ``sequence/layer.py:41 single_all_to_all``. `scatter_idx` is the
+    dim to split across the group (becomes 1/P per device), `gather_idx` the
+    dim to concatenate (becomes full). For [b, s/P, h, d] inputs,
+    (scatter=2, gather=1) yields [b, s, h/P, d].
+
+    The reference asserts heads % P == 0 (layer.py:53); we do the same at
+    trace time.
+    """
+    p = lax.psum(1, axis_name)
+    if x.shape[scatter_idx] % p != 0:
+        raise ValueError(
+            f"dim {scatter_idx} of shape {x.shape} not divisible by sequence-parallel size {p}")
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Ulysses attention wrapper (reference ``sequence/layer.py:145``).
+
+    Wraps any local attention fn `(q, k, v, *args, **kwargs) -> out` whose
+    tensors are [b, s, h, d] per-device views. Must be called inside a
+    ``shard_map`` (or ``jit``+manual axes) context where `sequence_axis` is a
+    bound mesh axis name.
+    """
+
+    def __init__(self,
+                 local_attention: Callable,
+                 sequence_axis: str = "seq",
+                 scatter_idx: int = 2,
+                 gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis = sequence_axis
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        # [b, s/P, h, d] -> [b, s, h/P, d]
+        q = seq_all_to_all(query, self.axis, self.scatter_idx, self.gather_idx)
+        k = seq_all_to_all(key, self.axis, self.scatter_idx, self.gather_idx)
+        v = seq_all_to_all(value, self.axis, self.scatter_idx, self.gather_idx)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # [b, s, h/P, d] -> [b, s/P, h, d]
+        return seq_all_to_all(out, self.axis, self.gather_idx, self.scatter_idx)
+
+
+def ulysses_spmd(local_attention: Callable,
+                 query,
+                 key,
+                 value,
+                 *args,
+                 sequence_axis: str = "seq",
+                 mesh_ctx=None,
+                 **kwargs):
+    """GSPMD Ulysses: express the seq<->head reshard as sharding constraints.
+
+    Under ``jit`` over the global mesh, annotating [b, s@seq, h, d] ->
+    [b, s, h@seq, d] makes XLA emit the identical ICI all-to-all the explicit
+    path does, but leaves scheduling/overlap to the compiler — the idiomatic
+    pjit formulation of reference ``DistributedAttention.forward :181``.
+    """
+    ctx = mesh_ctx or get_mesh_context()
+    if ctx.axis_size(sequence_axis) == 1:
+        return local_attention(query, key, value, *args, **kwargs)
+    csr = jax.lax.with_sharding_constraint
+    head_spec = ctx.sharding(None, None, sequence_axis, None)
+    seq_spec = ctx.sharding(None, sequence_axis, None, None)
+    q = csr(query, head_spec)
+    k = csr(key, head_spec)
+    v = csr(value, head_spec)
+    out = local_attention(q, k, v, *args, **kwargs)
+    return csr(out, seq_spec)

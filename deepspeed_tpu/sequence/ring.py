@@ -1,0 +1,125 @@
+"""Ring attention — context parallelism over the ``seq`` mesh axis.
+
+Not present in the reference (SURVEY.md §2.4: "no ring-attention impl; Ulysses
+is the long-seq answer") — this is the TPU-native CP extension: K/V shards
+rotate around the ring of devices via ``lax.ppermute`` (ICI neighbor
+exchanges) while each device keeps its Q shard resident, with flash-style
+online-softmax accumulation so the full [s, s] score matrix never
+materializes. Communication overlaps compute: block i+1's K/V travels while
+block i's scores are on the MXU.
+
+Causal masking uses global positions, so with the default contiguous layout
+later ranks do more work than earlier ones; `zigzag` sharding (rank r holds
+chunks r and 2P-1-r) balances the causal load — pass ``layout="zigzag"`` and
+shard inputs accordingly with `zigzag_split` / `zigzag_unsplit`.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, o, m, l, scale):
+    """One online-softmax accumulation step.
+
+    q [b,sq,h,d], k/v [b,sk,h,d], bias broadcastable to [b,h,sq,sk];
+    o [b,sq,h,d] fp32 accumulator, m/l [b,h,sq] running max / normalizer.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new == NEG_INF) against exp overflow/nan
+    m_safe = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s <= NEG_INF, 0.0, p)
+    correction = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - m_safe))
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q,
+                   k,
+                   v,
+                   axis_name: str = "seq",
+                   causal: bool = False,
+                   scale: Optional[float] = None,
+                   layout: str = "contiguous"):
+    """Ring attention over per-shard views [b, s/P, h, d] (inside shard_map).
+
+    Returns the attention output for the local Q shard, exact (not
+    approximate): equals full softmax attention over the global sequence.
+    """
+    b, s_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    p = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+
+    q_pos = _global_positions(rank, s_local, p, layout)
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        kv_rank = (rank - i) % p
+        kv_pos = _global_positions(kv_rank, s_local, p, layout)
+        bias = None
+        if causal:
+            mask = kv_pos[None, :] > q_pos[:, None]  # [sq, sk]
+            bias = jnp.where(mask, NEG_INF, 0.0)[None, None]
+        o, m, l = _block_attn(q, k_cur, v_cur, bias, o, m, l, scale)
+        # rotate K/V to the next rank (the final hop restores the original
+        # shard; unconditional rotation keeps the loop body branch-free)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = lax.fori_loop(0, p, body, (o, m, l, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _global_positions(rank, s_local, p, layout):
+    """Global token positions held by `rank` for its local sequence slice."""
+    idx = jnp.arange(s_local)
+    if layout == "zigzag":
+        half = s_local // 2
+        lo = rank * half + idx[:half]
+        hi = (2 * p - 1 - rank) * half + (idx[half:] - half)
+        return jnp.concatenate([lo, hi])
+    return rank * s_local + idx
+
+
+def zigzag_split(x, n_shards: int, axis: int = 1):
+    """Reorder a global sequence so contiguous shard r holds zigzag chunks
+    (r, 2P-1-r); apply before sharding when using layout='zigzag'."""
+    chunks = jnp.split(x, 2 * n_shards, axis=axis)
+    order = []
+    for r in range(n_shards):
+        order += [chunks[r], chunks[2 * n_shards - 1 - r]]
+    return jnp.concatenate(order, axis=axis)
+
+
+def zigzag_unsplit(x, n_shards: int, axis: int = 1):
+    """Inverse of `zigzag_split`."""
+    chunks = jnp.split(x, 2 * n_shards, axis=axis)
+    out = [None] * (2 * n_shards)
+    i = 0
+    for r in range(n_shards):
+        out[r] = chunks[i]
+        out[2 * n_shards - 1 - r] = chunks[i + 1]
+        i += 2
+    return jnp.concatenate(out, axis=axis)
