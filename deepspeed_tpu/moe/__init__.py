@@ -1,0 +1,19 @@
+"""Mixture-of-Experts with expert parallelism.
+
+TPU-native rebuild of reference ``deepspeed/moe/``: GShard-style top-k gating
+with capacity + load-balancing losses (``sharded_moe.py``), expert-parallel
+dispatch over the ``expert`` mesh axis (the reference's ``_AllToAll :96`` is
+here a sharding constraint XLA lowers to an ICI all-to-all), and the `MoE`
+module wrapper (``layer.py:17``).
+"""
+
+from .sharded_moe import top1gating, top2gating, topkgating, TopKGate
+from .experts import Experts, ExpertMLP
+from .layer import MoE, MOELayer
+from .utils import is_moe_param, split_params_into_different_moe_groups_for_optimizer
+
+__all__ = [
+    "top1gating", "top2gating", "topkgating", "TopKGate",
+    "Experts", "ExpertMLP", "MoE", "MOELayer",
+    "is_moe_param", "split_params_into_different_moe_groups_for_optimizer",
+]

@@ -1,0 +1,56 @@
+"""Expert modules.
+
+Rebuild of reference ``deepspeed/moe/experts.py:13 Experts`` (a ModuleList of
+deep-copied expert modules, each fed its [c, m] slice). TPU-native: experts
+are a *stacked* parameter tree [E, ...] produced by ``nn.vmap`` — one einsum
+per layer over all local experts (the grouped-GEMM formulation the reference
+needs CUTLASS ``moe_gemm`` kernels for falls out of XLA batching), and the
+leading expert dim is what the ``expert`` mesh axis shards.
+"""
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+EXPERT_PARTITION_NAME = "expert"
+
+
+class ExpertMLP(nn.Module):
+    """A single FFN expert (what the reference users pass as `expert`)."""
+    hidden_size: int
+    intermediate_size: int
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype, name="wi")(x)
+        h = self.activation(h)
+        return nn.Dense(self.hidden_size, dtype=self.dtype, name="wo")(h)
+
+
+class Experts(nn.Module):
+    """Vectorize an expert module over the expert dim: input [E, C, M] ->
+    output [E, C, M], params stacked with leading dim E.
+
+    `expert_fn` builds one expert template; it is constructed *inside* this
+    module's scope so the stacked params nest under `experts/...` (flax binds
+    submodules to the scope active at construction time).
+    """
+    expert_fn: Callable[[], nn.Module]
+    num_experts: int
+
+    @nn.compact
+    def __call__(self, x):
+        expert = self.expert_fn()
+        vmapped = nn.vmap(
+            lambda mdl, xi: mdl(xi),
+            in_axes=0,
+            out_axes=0,
+            axis_size=self.num_experts,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: EXPERT_PARTITION_NAME},
+        )
+        return vmapped(expert, x)

@@ -1,0 +1,127 @@
+"""MoE layer + wrapper module.
+
+Rebuild of reference ``deepspeed/moe/layer.py:17 MoE`` and
+``sharded_moe.py:533 MOELayer``:
+
+    gate -> dispatch einsum("sec,sm->ecm") -> [all-to-all over EP]
+         -> experts -> [all-to-all back] -> combine einsum("sec,ecm->sm")
+
+The reference's explicit ``_AllToAll`` autograd function (:96) is replaced by
+``with_sharding_constraint``: tokens enter sharded over the data axes, the
+dispatched [E, C, M] tensor is constrained to shard E over the ``expert``
+mesh axis, and XLA lowers the resharding to the same ICI all-to-all — in both
+directions, with autodiff giving the transposed collective in backward.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import get_mesh_context, mesh_is_initialized
+from .experts import Experts, ExpertMLP
+from .sharded_moe import TopKGate
+
+
+class MOELayer(nn.Module):
+    """Core dispatch/combine (reference ``sharded_moe.py:533``).
+
+    Builds its own gate + experts children (so params nest under this
+    module's name, matching the reference's `deepspeed_moe` state-dict
+    prefix).
+    """
+    model_dim: int
+    num_experts: int
+    expert_fn: Callable[[], nn.Module]
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    top2_2nd_expert_sampling: bool = True
+    use_sharding_constraint: bool = True
+
+    @nn.compact
+    def __call__(self, x, used_token=None, train: bool = True):
+        gate = TopKGate(model_dim=self.model_dim,
+                        num_experts=self.num_experts,
+                        k=self.k,
+                        capacity_factor=self.capacity_factor,
+                        eval_capacity_factor=self.eval_capacity_factor,
+                        min_capacity=self.min_capacity,
+                        noisy_gate_policy=self.noisy_gate_policy,
+                        drop_tokens=self.drop_tokens,
+                        use_rts=self.use_rts,
+                        top2_2nd_expert_sampling=self.top2_2nd_expert_sampling,
+                        name="gate")
+        experts = Experts(expert_fn=self.expert_fn, num_experts=self.num_experts,
+                          name="experts")
+
+        orig_shape = x.shape
+        d_model = orig_shape[-1]
+        reshaped = x.reshape(-1, d_model)  # [S, M] tokens
+
+        l_aux, combine_weights, dispatch_mask, exp_counts = gate(reshaped, used_token,
+                                                                 train=train)
+
+        dispatched = jnp.einsum("sec,sm->ecm", dispatch_mask.astype(x.dtype), reshaped)
+        dispatched = self._constrain_expert(dispatched)
+        expert_out = experts(dispatched)  # [E, C, M]
+        expert_out = self._constrain_expert(expert_out)
+        combined = jnp.einsum("sec,ecm->sm", combine_weights.astype(x.dtype), expert_out)
+        return combined.reshape(orig_shape), l_aux, exp_counts
+
+    def _constrain_expert(self, t):
+        if not self.use_sharding_constraint or not mesh_is_initialized():
+            return t
+        ctx = get_mesh_context()
+        if ctx.axis_size("expert") <= 1:
+            return t
+        return jax.lax.with_sharding_constraint(t, ctx.sharding("expert", None, None))
+
+
+class MoE(nn.Module):
+    """User-facing wrapper (reference ``moe/layer.py:17``): returns
+    (output, l_aux, exp_counts). `expert` defaults to an FFN sized by
+    `hidden_size`/`intermediate_size` when not given; pass `expert_fn` for a
+    custom expert architecture (a factory, so each instantiation lands in the
+    experts scope)."""
+    hidden_size: int
+    num_experts: int = 1
+    ep_size: int = 1  # informational; sharding comes from the mesh
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    intermediate_size: Optional[int] = None
+    expert_fn: Optional[Callable[[], nn.Module]] = None
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    top2_2nd_expert_sampling: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, used_token=None, train: bool = True):
+        expert_fn = self.expert_fn
+        if expert_fn is None:
+            hidden, inter = self.hidden_size, self.intermediate_size or 4 * self.hidden_size
+            dtype = hidden_states.dtype
+            expert_fn = lambda: ExpertMLP(hidden_size=hidden, intermediate_size=inter,
+                                          dtype=dtype)
+        layer = MOELayer(model_dim=self.hidden_size,
+                         num_experts=self.num_experts,
+                         expert_fn=expert_fn,
+                         k=self.k,
+                         capacity_factor=self.capacity_factor,
+                         eval_capacity_factor=self.eval_capacity_factor,
+                         min_capacity=self.min_capacity,
+                         noisy_gate_policy=self.noisy_gate_policy,
+                         drop_tokens=self.drop_tokens,
+                         use_rts=self.use_rts,
+                         top2_2nd_expert_sampling=self.top2_2nd_expert_sampling,
+                         name="deepspeed_moe")
+        return layer(hidden_states, used_token, train=train)

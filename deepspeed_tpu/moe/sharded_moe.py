@@ -1,0 +1,254 @@
+"""GShard gating math (top-1 / top-2 / top-k) with capacity and aux losses.
+
+Rebuild of reference ``deepspeed/moe/sharded_moe.py`` (``top1gating :183``,
+``top2gating :290``, ``topkgating :374``, ``_capacity :161``) with the same
+return contract:
+
+    (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C], exp_counts [E])
+
+XLA-native differences:
+- capacity is a *static* Python int (shapes are known at trace time); the
+  reference's ``drop_tokens=False`` path (dynamic capacity = max live count,
+  all-reduced over EP) is realized by padding capacity to S — no token is
+  ever dropped, at the cost of a full-size buffer, which is the only
+  static-shape-true version of "never drop".
+- randomness (RSample noisy gating, Random Token Selection) takes an explicit
+  `rng` key instead of global generator state.
+"""
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Reference ``sharded_moe.py:161``: ceil(S/E * cf), floored at
+    min_capacity — static ints under jit."""
+    capacity = math.ceil((num_tokens / num_experts) * capacity_factor)
+    return max(capacity, min_capacity)
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def _gumbel(rng, shape):
+    return jax.random.gumbel(rng, shape, jnp.float32)
+
+
+def top1gating(logits: Array,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               used_token: Optional[Array] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[Array] = None) -> Tuple[Array, Array, Array, Array]:
+    """Top-1 gating (reference ``sharded_moe.py:183``). logits: [S, E]."""
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noisy_gate_policy == "RSample":
+        assert rng is not None, "RSample noisy gating needs an rng key"
+        rng, sub = jax.random.split(rng)
+        logits_w_noise = logits + _gumbel(sub, logits.shape)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    capacity = _capacity(S, E, capacity_factor, min_capacity) if drop_tokens else S
+
+    indices1_s = jnp.argmax(logits_w_noise if noisy_gate_policy == "RSample" else gates, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+    if used_token is not None:
+        mask1 = used_token[:, None] * mask1
+
+    exp_counts = jax.lax.stop_gradient(mask1.sum(axis=0))
+
+    # load-balancing loss (GShard eq. 4): E * sum_e mean(gate_e) * mean(assigned_e)
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = jnp.sum(me * jax.lax.stop_gradient(ce)) * E
+
+    # Random Token Selection (reference :236): prioritize random tokens,
+    # not sequence order, when over capacity
+    if use_rts:
+        assert rng is not None, "use_rts needs an rng key (or pass use_rts=False)"
+        rng, sub = jax.random.split(rng)
+        mask1_rand = mask1 * jax.random.uniform(sub, mask1.shape)
+    else:
+        mask1_rand = mask1
+
+    assert S >= min_capacity, (
+        "No. of tokens (batch-size) should be greater than min_capacity. "
+        "Either set min_capacity to 0 or increase your batch size.")
+
+    if capacity < S:
+        # keep only the top-capacity tokens per expert column
+        _, top_idx = jax.lax.top_k(mask1_rand.T, capacity)  # [E, C]
+        keep = jnp.zeros((E, S), jnp.float32).at[jnp.arange(E)[:, None], top_idx].set(1.0).T
+        mask1 = mask1 * keep
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+
+    gates = gates * mask1
+    locations1_sc = _one_hot(locations1_s, capacity)
+    combine_weights = jnp.einsum("se,sc->sec", gates, locations1_sc)
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits: Array,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               drop_tokens: bool = True,
+               top2_2nd_expert_sampling: bool = True,
+               rng: Optional[Array] = None) -> Tuple[Array, Array, Array, Array]:
+    """Top-2 gating (reference ``sharded_moe.py:290``). logits: [S, E]."""
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    indices1_s = jnp.argmax(gates, axis=1)
+    mask1 = _one_hot(indices1_s, E)
+
+    if top2_2nd_expert_sampling:
+        assert rng is not None, "top2 2nd-expert sampling needs an rng key"
+        rng, sub = jax.random.split(rng)
+        logits = logits + _gumbel(sub, logits.shape)
+
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits)
+    indices2_s = jnp.argmax(logits_except1, axis=1)
+    mask2 = _one_hot(indices2_s, E)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1
+    locations2 = locations2 + mask1.sum(axis=0, keepdims=True)
+
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = jnp.mean(me * jax.lax.stop_gradient(ce)) * E * E
+
+    exp_counts = jax.lax.stop_gradient((mask1 + mask2).sum(axis=0))
+
+    if drop_tokens:
+        capacity = _capacity(S, E, capacity_factor * 2, min_capacity)
+        mask1 = mask1 * (locations1 < capacity)
+        mask2 = mask2 * (locations2 < capacity)
+    else:
+        capacity = 2 * S  # static "never drop": both assignments always fit
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1).astype(jnp.int32)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1).astype(jnp.int32)
+
+    gates1_s = jnp.einsum("se,se->s", gates, mask1)
+    gates2_s = jnp.einsum("se,se->s", gates, mask2)
+    denom_s = jnp.clip(gates1_s + gates2_s, jnp.finfo(gates.dtype).eps, None)
+    gates1_s = gates1_s / denom_s
+    gates2_s = gates2_s / denom_s
+
+    gates1 = gates1_s[:, None] * mask1
+    gates2 = gates2_s[:, None] * mask2
+    locations1_sc = _one_hot(locations1_s, capacity)
+    locations2_sc = _one_hot(locations2_s, capacity)
+    combine_weights = (jnp.einsum("se,sc->sec", gates1, locations1_sc) +
+                       jnp.einsum("se,sc->sec", gates2, locations2_sc))
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def topkgating(logits: Array,
+               k: int,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               drop_tokens: bool = True,
+               drop_policy: str = "probs") -> Tuple[Array, Array, Array, Array]:
+    """Top-k gating (reference ``sharded_moe.py:374``). logits: [S, E]."""
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    top_gate, top_idx = jax.lax.top_k(logits, k)  # [S, k]
+    gates = jax.nn.softmax(logits, axis=1)
+
+    mask = jnp.zeros((S, E), jnp.float32).at[jnp.arange(S)[:, None], top_idx].set(1.0)
+    topk_masked_gates = jnp.zeros((S, E), jnp.float32).at[jnp.arange(S)[:, None],
+                                                          top_idx].set(top_gate)
+
+    exp_counts = jax.lax.stop_gradient(mask.sum(axis=0))
+
+    me = gates.mean(axis=0)
+    ce = mask.mean(axis=0)
+    l_aux = jnp.mean(me * jax.lax.stop_gradient(ce)) * E * E / k
+
+    if drop_tokens:
+        capacity = _capacity(S, E, capacity_factor * k, min_capacity)
+        if drop_policy == "probs":
+            # keep the capacity highest-prob tokens per expert
+            _, cap_idx = jax.lax.top_k(topk_masked_gates.T, min(capacity, S))  # [E, C]
+            keep = jnp.zeros((E, S), jnp.float32).at[jnp.arange(E)[:, None], cap_idx].set(1.0).T
+            mask = mask * keep
+            locations = jnp.cumsum(mask, axis=0) - 1
+        elif drop_policy == "position":
+            locations = jnp.cumsum(mask, axis=0) - 1
+            mask = mask * (locations < capacity)
+        else:
+            raise ValueError(f"Invalid drop_policy: {drop_policy}")
+    else:
+        capacity = S
+        locations = jnp.cumsum(mask, axis=0) - 1
+
+    gates_masked = gates * mask
+    gates_s = gates_masked.sum(axis=-1, keepdims=True)
+    denom_s = jnp.clip(gates_s, jnp.finfo(gates_masked.dtype).eps, None)
+    gates_masked = gates_masked / denom_s
+
+    locations_sc = _one_hot((locations * mask).astype(jnp.int32), capacity)
+    combine_weights = jnp.einsum("se,sec->sec", gates_masked, locations_sc)
+    # a token not assigned to expert e has mask[s,e]=0 -> gates_masked 0 -> no
+    # contribution, but one_hot(0) would alias capacity slot 0; mask it out
+    combine_weights = combine_weights * mask[..., None]
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+try:
+    import flax.linen as nn
+
+    class TopKGate(nn.Module):
+        """Gate module (reference ``sharded_moe.py:449 TopKGate``): a linear
+        router over fp32 + one of the gating functions above."""
+        model_dim: int
+        num_experts: int
+        k: int = 1
+        capacity_factor: float = 1.0
+        eval_capacity_factor: float = 1.0
+        min_capacity: int = 4
+        noisy_gate_policy: Optional[str] = None
+        drop_tokens: bool = True
+        use_rts: bool = True
+        top2_2nd_expert_sampling: bool = True
+
+        @nn.compact
+        def __call__(self, x, used_token=None, train: bool = True):
+            # router in fp32 always (reference TopKGate.forward casts to float)
+            wg = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="wg")
+            logits = wg(x.astype(jnp.float32))
+            cf = self.capacity_factor if train else self.eval_capacity_factor
+            needs_rng = (self.noisy_gate_policy == "RSample" and train) or \
+                (self.k == 1 and self.use_rts) or (self.k == 2 and self.top2_2nd_expert_sampling)
+            rng = self.make_rng("gating") if needs_rng and self.has_rng("gating") else None
+            if self.k == 1:
+                return top1gating(logits, cf, self.min_capacity, used_token,
+                                  self.noisy_gate_policy if train else None,
+                                  self.drop_tokens, self.use_rts and rng is not None, rng=rng)
+            elif self.k == 2:
+                return top2gating(logits, cf, self.min_capacity, self.drop_tokens,
+                                  self.top2_2nd_expert_sampling and rng is not None, rng=rng)
+            return topkgating(logits, self.k, cf, self.min_capacity, self.drop_tokens)
+
+except ImportError:  # pragma: no cover
+    TopKGate = None

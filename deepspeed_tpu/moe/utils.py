@@ -1,0 +1,52 @@
+"""MoE parameter utilities.
+
+Rebuild of reference ``deepspeed/moe/utils.py``: identify expert parameters
+(``is_moe_param :27``) and split optimizer param groups so expert params get
+their own group with expert-parallel reduction semantics
+(``split_params_into_different_moe_groups_for_optimizer :72``).
+
+Here params are pytrees, not nn.Parameters with attributes: an "MoE param" is
+any leaf whose tree path contains an expert-stack marker (`experts` /
+`deepspeed_moe` / `expert`). The engine uses the mask to (a) shard expert
+leaves over the ``expert`` axis first and (b) skip the data-parallel grad
+average over the expert axis for them.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+MOE_PATH_MARKERS = ("experts", "deepspeed_moe", "expert")
+
+
+def _path_names(path) -> List[str]:
+    names = []
+    for k in path:
+        if hasattr(k, "key"):
+            names.append(str(k.key))
+        elif hasattr(k, "idx"):
+            names.append(str(k.idx))
+        else:
+            names.append(str(k))
+    return names
+
+
+def is_moe_param_path(path) -> bool:
+    return any(m in _path_names(path) for m in MOE_PATH_MARKERS)
+
+
+def is_moe_param(tree: Any) -> Any:
+    """Boolean mask pytree: True for expert leaves (reference utils.py:27)."""
+    return jax.tree_util.tree_map_with_path(lambda p, _: is_moe_param_path(p), tree)
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        param_groups: Any) -> Tuple[Any, Any]:
+    """Split a params pytree into (non_moe, moe) subtrees, with None in the
+    complementary positions (reference utils.py:72 returns separate optimizer
+    groups; optax analog: use these masks with optax.masked)."""
+    non_moe = jax.tree_util.tree_map_with_path(
+        lambda p, x: None if is_moe_param_path(p) else x, param_groups)
+    moe = jax.tree_util.tree_map_with_path(
+        lambda p, x: x if is_moe_param_path(p) else None, param_groups)
+    return non_moe, moe

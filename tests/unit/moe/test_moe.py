@@ -1,0 +1,146 @@
+"""MoE tests (parity with reference ``tests/unit/moe/test_moe.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import MeshContext, set_mesh_context
+from deepspeed_tpu.moe import (MoE, TopKGate, is_moe_param, top1gating, top2gating, topkgating,
+                               split_params_into_different_moe_groups_for_optimizer)
+
+
+def test_top1gating_shapes_and_conservation():
+    rng = jax.random.PRNGKey(0)
+    S, E = 64, 8
+    logits = jax.random.normal(rng, (S, E))
+    l_aux, cw, dm, counts = top1gating(logits, capacity_factor=1.0, min_capacity=4,
+                                       use_rts=False)
+    C = cw.shape[-1]
+    assert cw.shape == (S, E, C) and dm.shape == (S, E, C)
+    assert counts.shape == (E, )
+    # each token goes to at most one (expert, slot); weights in [0, 1]
+    per_token = np.asarray(cw.sum(axis=(1, 2)))
+    assert (per_token <= 1.0 + 1e-5).all()
+    # capacity = ceil(S/E * cf) = 8
+    assert C == 8
+    assert float(l_aux) > 0
+
+
+def test_top1gating_respects_capacity():
+    logits = jnp.zeros((32, 4)).at[:, 0].set(10.0)  # everyone wants expert 0
+    _, cw, _, counts = top1gating(logits, capacity_factor=1.0, min_capacity=1,
+                                  use_rts=False)
+    C = cw.shape[-1]
+    kept = np.asarray(cw.sum(axis=(0, 2)))  # tokens kept per expert
+    assert kept[0] <= C  # over-capacity tokens dropped
+    assert np.asarray(counts)[0] == 32  # raw demand recorded pre-drop
+
+
+def test_top1gating_no_drop():
+    logits = jnp.zeros((16, 4)).at[:, 1].set(5.0)
+    _, cw, _, _ = top1gating(logits, capacity_factor=1.0, min_capacity=1,
+                             drop_tokens=False, use_rts=False)
+    # never-drop: every token dispatched exactly once
+    np.testing.assert_allclose(np.asarray(cw.sum(axis=(1, 2))) > 0, True)
+
+
+def test_top2gating_two_experts_per_token():
+    rng = jax.random.PRNGKey(1)
+    S, E = 64, 8
+    logits = jax.random.normal(rng, (S, E))
+    l_aux, cw, dm, counts = top2gating(logits, capacity_factor=2.0, min_capacity=2,
+                                       top2_2nd_expert_sampling=False)
+    active_experts = (np.asarray(cw.sum(axis=2)) > 0).sum(axis=1)
+    assert (active_experts <= 2).all()
+    # combine weights for kept tokens sum to ~1 (normalized over the pair)
+    sums = np.asarray(cw.sum(axis=(1, 2)))
+    kept = sums > 0
+    np.testing.assert_allclose(sums[kept][active_experts[kept] == 2], 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("drop_policy", ["probs", "position"])
+def test_topkgating(drop_policy):
+    rng = jax.random.PRNGKey(2)
+    S, E, k = 64, 8, 4
+    logits = jax.random.normal(rng, (S, E))
+    l_aux, cw, dm, counts = topkgating(logits, k=k, capacity_factor=1.0, min_capacity=2,
+                                       drop_policy=drop_policy)
+    active = (np.asarray(cw.sum(axis=2)) > 0).sum(axis=1)
+    assert (active <= k).all()
+    assert float(l_aux) > 0
+
+
+def test_gating_jits():
+    logits = jax.random.normal(jax.random.PRNGKey(3), (32, 4))
+    f = jax.jit(lambda lg: topkgating(lg, k=2, capacity_factor=1.0, min_capacity=2))
+    l_aux, cw, dm, counts = f(logits)
+    assert cw.shape[0] == 32
+
+
+def test_moe_module_forward():
+    model = MoE(hidden_size=16, num_experts=4, k=2, capacity_factor=2.0,
+                min_capacity=2, intermediate_size=32, top2_2nd_expert_sampling=False)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 16))
+    params = model.init({"params": jax.random.PRNGKey(0)}, x)
+    out, l_aux, counts = model.apply(params, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(l_aux))
+    # expert params are stacked [E, ...]
+    flat = jax.tree_util.tree_leaves(params["params"]["deepspeed_moe"]["experts"])
+    assert all(leaf.shape[0] == 4 for leaf in flat)
+
+
+def test_moe_grads_flow_to_experts_and_gate():
+    model = MoE(hidden_size=8, num_experts=4, k=1, capacity_factor=2.0,
+                min_capacity=2, intermediate_size=16, use_rts=False)
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 4, 8))
+    params = model.init({"params": jax.random.PRNGKey(0)}, x)
+
+    def loss(p):
+        out, l_aux, _ = model.apply(p, x)
+        return (out ** 2).mean() + 0.01 * l_aux
+
+    g = jax.grad(loss)(params)
+    gnorm = jax.tree_util.tree_map(lambda t: float(jnp.abs(t).sum()), g)
+    leaves = jax.tree_util.tree_leaves(gnorm)
+    assert sum(leaves) > 0
+    # gate receives gradient through l_aux + routing weights
+    wg = g["params"]["deepspeed_moe"]["gate"]["wg"]["kernel"]
+    assert float(jnp.abs(wg).sum()) > 0
+
+
+@pytest.mark.world_size(8)
+def test_moe_expert_parallel_sharded():
+    ctx = MeshContext.create(axis_sizes={"expert": 4, "data": 2})
+    set_mesh_context(ctx)
+    model = MoE(hidden_size=16, num_experts=4, k=1, capacity_factor=2.0,
+                min_capacity=2, intermediate_size=32, use_rts=False)
+    x = jax.random.normal(jax.random.PRNGKey(6), (4, 8, 16))
+    params = model.init({"params": jax.random.PRNGKey(0)}, x)
+    # shard expert stacks over the expert axis; tokens over data
+    shardings = jax.tree_util.tree_map(
+        lambda leaf: ctx.sharding("expert") if leaf.ndim >= 1 and leaf.shape[0] == 4
+        else ctx.replicated(), params)
+    params = jax.device_put(params, shardings)
+    x = jax.device_put(x, ctx.sharding("data"))
+
+    @jax.jit
+    def fwd(p, x):
+        return model.apply(p, x)
+
+    out, l_aux, counts = fwd(params, x)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_param_utils():
+    model = MoE(hidden_size=8, num_experts=2, k=1, intermediate_size=16, use_rts=False)
+    x = jnp.ones((2, 4, 8))
+    params = model.init({"params": jax.random.PRNGKey(0)}, x)
+    mask = is_moe_param(params)
+    leaves = jax.tree_util.tree_leaves(mask)
+    assert any(leaves) and not all(leaves) or all(leaves)  # gate+experts both under deepspeed_moe
+    non_moe, moe = split_params_into_different_moe_groups_for_optimizer(params)
+    moe_leaves = [l for l in jax.tree_util.tree_leaves(moe) if l is not None]
+    assert len(moe_leaves) > 0
