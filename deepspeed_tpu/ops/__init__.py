@@ -1,0 +1,21 @@
+"""TPU-native op layer.
+
+Rebuild of reference ``deepspeed/ops`` + ``op_builder/``: instead of JIT-built
+CUDA extensions, each op is a pure function that dispatches to a Pallas TPU
+kernel when running on TPU and to an equivalent XLA (jnp) implementation
+elsewhere (CPU tests, interpret mode). The registry mirrors ``op_builder``'s
+compatibility reporting (``ds_report``).
+"""
+
+from .registry import OpRegistry, compatible_ops, op_report, registry
+from .attention import flash_attention
+from .normalization import rms_norm, layer_norm
+from .rope import apply_rotary_pos_emb
+from .quantizer import quantize_int8_blockwise, dequantize_int8_blockwise
+from .fused_optimizer import fused_adam_step
+
+__all__ = [
+    "OpRegistry", "registry", "compatible_ops", "op_report",
+    "flash_attention", "rms_norm", "layer_norm", "apply_rotary_pos_emb",
+    "quantize_int8_blockwise", "dequantize_int8_blockwise", "fused_adam_step",
+]

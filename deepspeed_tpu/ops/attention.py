@@ -1,0 +1,173 @@
+"""Flash attention — Pallas TPU kernel with XLA fallback.
+
+TPU-native replacement for the reference's fused attention kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, flash paths in
+``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash``): blocked
+online-softmax attention that never materializes the [S, S] score matrix.
+
+Grid layout: (batch*heads, q_blocks, kv_blocks) with the kv dim innermost —
+accumulators (o, m, l) live in VMEM scratch that persists across the kv
+iterations of one q block; output is finalized on the last kv step. Causal
+masking prunes fully-masked kv blocks via `pl.when`.
+
+Backward: `jax.custom_vjp` whose bwd recomputes attention with the XLA path
+(flash-style remat — the standard memory/FLOPs trade); a dedicated Pallas
+bwd kernel is a later optimization.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+from .registry import registry, use_pallas
+
+NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, scale, causal):
+    """Reference implementation, [B, S, H, D]; XLA fuses this reasonably."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        n, m = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *, scale, causal,
+                  block_q, block_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        m_prev, l_prev = m_s[:, 0], l_s[:, 0]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        m_safe = jnp.where(m_cur <= NEG_INF, 0.0, m_cur)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        corr = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - m_safe))
+        l_cur = l_prev * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr[:, None] + pv
+        m_s[:, 0] = m_cur
+        l_s[:, 0] = l_cur
+
+    if causal:
+        # skip kv blocks entirely above the diagonal
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_s[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    assert Sq % block_q == 0 and Sk % block_k == 0, (
+        f"seq lens ({Sq},{Sk}) must be divisible by blocks ({block_q},{block_k})")
+    num_q, num_kv = Sq // block_q, Sk // block_k
+
+    # [B, S, H, D] -> [B*H, S, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, num_kv=num_kv)
+    scratch = [
+        pltpu.VMEM((block_q, D), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, scale, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(q,
+                    k,
+                    v,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    force_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Blocked attention over [B, S, H, D] tensors.
+
+    Dispatches to the Pallas kernel on TPU (or with interpret=True anywhere);
+    falls back to the fused XLA softmax-attention path otherwise.
+    """
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    if use_pallas(force_pallas) or interpret:
+        return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _xla_attention(q, k, v, scale, causal)
+
+
+registry.register("flash_attention", "pallas" if _HAS_PLTPU else "xla", True)

@@ -1,0 +1,81 @@
+"""Op registry + compatibility report.
+
+Analog of reference ``op_builder/builder.py`` (``OpBuilder.is_compatible``,
+``ds_report`` CLI): ops register themselves with a name, the backend they
+use on this platform ("pallas" | "xla"), and whether the fast path is
+available. There is no JIT compilation of extensions — Pallas kernels compile
+through XLA at trace time — so "installed" vs "compatible" collapses to one
+availability probe.
+"""
+
+import functools
+from typing import Callable, Dict, NamedTuple, Optional
+
+import jax
+
+
+class OpInfo(NamedTuple):
+    name: str
+    backend: str  # "pallas" or "xla"
+    compatible: bool
+    reason: str
+
+
+class OpRegistry:
+
+    def __init__(self):
+        self._ops: Dict[str, OpInfo] = {}
+
+    def register(self, name: str, backend: str, compatible: bool, reason: str = ""):
+        self._ops[name] = OpInfo(name, backend, compatible, reason)
+
+    def report(self) -> Dict[str, OpInfo]:
+        return dict(self._ops)
+
+    def __contains__(self, name):
+        return name in self._ops
+
+
+registry = OpRegistry()
+
+
+@functools.cache
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu" or any(
+            d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+@functools.cache
+def pallas_available() -> bool:
+    """Pallas TPU kernels need a TPU backend; interpret mode covers tests."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def use_pallas(force: Optional[bool] = None) -> bool:
+    """Fast-path decision: pallas on real TPU; XLA elsewhere unless forced
+    (tests force interpret mode)."""
+    if force is not None:
+        return force
+    return on_tpu() and pallas_available()
+
+
+def compatible_ops():
+    return [o.name for o in registry.report().values() if o.compatible]
+
+
+def op_report() -> str:
+    """ds_report-style compatibility matrix (reference bin/ds_report)."""
+    lines = ["-" * 60, "deepspeed_tpu op compatibility report",
+             f"backend: {jax.default_backend()}", "-" * 60,
+             f"{'op':<30}{'impl':<10}{'compatible'}"]
+    for info in registry.report().values():
+        lines.append(f"{info.name:<30}{info.backend:<10}{info.compatible}"
+                     + (f"  [{info.reason}]" if info.reason else ""))
+    return "\n".join(lines)

@@ -1,0 +1,40 @@
+"""Rotary position embedding.
+
+TPU-native equivalent of reference ``csrc/transformer/inference/csrc/
+apply_rotary_pos_emb.cu`` and the v2 ``linear_blocked_kv_rotary`` fusion.
+RoPE is pure elementwise (VPU work); XLA fuses it into the surrounding
+matmuls, so the default path is jnp — the function exists as the op-layer
+seam (and for parity with the reference op surface).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .registry import registry
+
+
+def precompute_rope_freqs(head_dim: int, max_len: int, theta: float = 10000.0,
+                          dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary_pos_emb(x, cos, sin, positions: Optional[jnp.ndarray] = None):
+    """x: [B, S, H, D]; cos/sin: [max_len, D/2]; positions: [B, S] or [S]."""
+    if positions is None:
+        positions = jnp.arange(x.shape[1])
+    c = cos[positions]  # [., S, D/2]
+    s = sin[positions]
+    if c.ndim == 2:
+        c = c[None]
+        s = s[None]
+    c = c[:, :, None, :]
+    s = s[:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
+
+
+registry.register("rotary_pos_emb", "xla", True, "elementwise; XLA-fused")
