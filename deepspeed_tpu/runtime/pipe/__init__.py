@@ -1,0 +1,29 @@
+"""Pipeline parallelism.
+
+TPU-native rebuild of reference ``deepspeed/runtime/pipe/``:
+- ``LayerSpec``/``TiedLayerSpec``/``PipelineModule`` (module.py) — layer-list
+  model description + stage partitioning
+- instruction schedules (schedule.py) — TrainSchedule/InferenceSchedule
+  (ported semantics; on TPU they describe, rather than drive, execution)
+- the SPMD executor (spmd.py) — scan-over-ticks + ppermute over the ``pipe``
+  mesh axis; reverse-mode autodiff of the scan IS the backward schedule
+- ``PipelineEngine`` (engine.py) — train_batch/eval_batch over the executor
+"""
+
+from .module import LayerSpec, TiedLayerSpec, PipelineModule
+from .schedule import (TrainSchedule, InferenceSchedule, DataParallelSchedule,
+                       ForwardPass, BackwardPass, SendActivation, RecvActivation,
+                       SendGrad, RecvGrad, LoadMicroBatch, ReduceGrads, ReduceTiedGrads,
+                       OptimizerStep, PipeInstruction)
+from .spmd import spmd_pipeline
+from .engine import PipelineEngine, PipeZeroPlan, make_pipeline_apply
+from .topology import PipeDataParallelTopology, PipeModelDataParallelTopology, ProcessTopology
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule", "spmd_pipeline",
+    "PipelineEngine", "PipeZeroPlan", "make_pipeline_apply",
+    "TrainSchedule", "InferenceSchedule", "DataParallelSchedule", "PipeInstruction",
+    "ForwardPass", "BackwardPass", "SendActivation", "RecvActivation", "SendGrad",
+    "RecvGrad", "LoadMicroBatch", "ReduceGrads", "ReduceTiedGrads", "OptimizerStep",
+    "ProcessTopology", "PipeDataParallelTopology", "PipeModelDataParallelTopology",
+]

@@ -1,0 +1,192 @@
+"""PipelineModule: layer-list model description + stage partitioning.
+
+Rebuild of reference ``runtime/pipe/module.py`` (``LayerSpec :30``,
+``TiedLayerSpec :77``, ``PipelineModule :86``, ``_partition_layers :391``):
+the model is a flat list of layer builders; stages own contiguous slices
+chosen by ``partition_method``:
+
+- "uniform": equal layer counts
+- "parameters": balance per-layer parameter counts
+- "type:regex": balance layers whose class name matches the regex
+
+TPU-native notes: layers build flax modules (or plain callables); `init`
+returns per-layer param trees. For the SPMD fast path the homogeneous body
+is *stacked* into [L, ...] leaves (`stack_params`) so stages hold [L/S, ...]
+slices sharded over the ``pipe`` axis — one program, S stage shards. Tied
+layers (word embedding reused at the head, reference ``module.py:444`` tied
+allreduce) are realized by passing the same param subtree to both call
+sites; the psum of the two gradient contributions is emitted by XLA.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference module.py:30)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self, log=False):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with every other layer of the same key
+    (reference module.py:77)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def _count_params(tree) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(tree)))
+
+
+def partition_balanced(weights: Sequence[int], num_parts: int) -> List[int]:
+    """Bounds [p0..p_num_parts] minimizing the max part weight over contiguous
+    partitions (reference ds_utils.partition_balanced; DP over prefix sums)."""
+    n = len(weights)
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+    # binary search the optimal bottleneck, then greedy assignment
+    lo, hi = max(weights) if weights else 0, int(prefix[-1])
+
+    def parts_for(cap):
+        bounds, start = [0], 0
+        for _ in range(num_parts):
+            end = start
+            while end < n and prefix[end + 1] - prefix[start] <= cap:
+                end += 1
+            bounds.append(end)
+            start = end
+        return bounds
+
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if parts_for(mid)[-1] >= n:
+            hi = mid
+        else:
+            lo = mid + 1
+    bounds = parts_for(lo)
+    bounds[-1] = n
+    # monotone fix for degenerate trailing parts
+    for i in range(1, len(bounds)):
+        bounds[i] = max(bounds[i], bounds[i - 1])
+    return bounds
+
+
+class PipelineModule:
+    """Layer-list pipeline model (reference module.py:86).
+
+    Not an nn.Module: it owns a list of built layers (flax modules or
+    callables taking (params, x) / (x,)) plus partitioning metadata. The
+    engine chooses the execution strategy; `__call__`-style sequential apply
+    is provided for correctness checks and the non-pipelined fallback.
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 seed_layers: bool = False,
+                 activation_checkpoint_interval: int = 0):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.tied_keys: Dict[str, List[int]] = {}
+
+        self.layers = []
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_keys.setdefault(spec.key, []).append(i)
+                self.layers.append(spec.build())
+            elif isinstance(spec, LayerSpec):
+                self.layers.append(spec.build())
+            else:
+                self.layers.append(spec)  # already-built module/callable
+        self._params = None
+        self.parts = None
+
+    # -------- init --------
+
+    def init(self, rng, x):
+        """Initialize per-layer params by threading a sample activation
+        through the stack. Returns list of param trees (None for paramless
+        layers); tied layers share one tree (first occurrence owns it)."""
+        params = []
+        tied_owner: Dict[str, Any] = {}
+        for i, (spec, layer) in enumerate(zip(self.layer_specs, self.layers)):
+            rng, sub = jax.random.split(rng)
+            if hasattr(layer, "init"):  # flax module
+                key = spec.key if isinstance(spec, TiedLayerSpec) else None
+                if key is not None and key in tied_owner:
+                    p = tied_owner[key]
+                else:
+                    p = layer.init({"params": sub}, x)
+                    if key is not None:
+                        tied_owner[key] = p
+                params.append(p)
+                x = layer.apply(p, x)
+            else:
+                params.append(None)
+                x = layer(x)
+        self._params = params
+        return params
+
+    # -------- partitioning (reference _partition_layers :391) --------
+
+    def partition_layers(self, num_stages: Optional[int] = None) -> List[int]:
+        num_stages = num_stages or self.num_stages
+        n = len(self.layers)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            weights = [1] * n
+        elif method == "parameters":
+            assert self._params is not None, "call init() before parameters partitioning"
+            weights = [max(_count_params(p), 1) if p is not None else 1 for p in self._params]
+        elif method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [1 if re.search(pat, type(l).__name__, re.IGNORECASE) else 0
+                       for l in self.layers]
+            if sum(weights) == 0:
+                weights = [1] * n
+        else:
+            raise NotImplementedError(f"Partitioning method {method} not implemented")
+        self.parts = partition_balanced(weights, num_stages)
+        return self.parts
+
+    def stage_layers(self, stage_id: int) -> List:
+        assert self.parts is not None, "call partition_layers() first"
+        return self.layers[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    # -------- sequential apply (correctness / fallback path) --------
+
+    def apply(self, params_list, x, *loss_args):
+        for layer, p in zip(self.layers, params_list):
+            x = layer.apply(p, x) if p is not None else layer(x)
+        if self.loss_fn is not None and loss_args:
+            return self.loss_fn(x, *loss_args)
+        return x
+
+    # -------- SPMD stacking (homogeneous body) --------
+
+    @staticmethod
+    def stack_params(params_list):
+        """Stack identical-structure per-layer trees into [L, ...] leaves —
+        the layout the pipe axis shards (and lax.scan consumes)."""
+        return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *params_list)

@@ -1,0 +1,98 @@
+"""Process topology — axis bookkeeping.
+
+Rebuild of reference ``runtime/pipe/topology.py`` (``ProcessTopology :12``,
+``PipeDataParallelTopology :244``): maps linear ranks <-> named axis
+coordinates. On TPU the device mesh already IS this object; these classes
+keep the reference API for code that reasons about coordinates (layer
+partitioning, checkpoint naming, grid tests).
+"""
+
+import itertools
+from collections import namedtuple
+from typing import Dict, List, Sequence
+
+
+class ProcessTopology:
+    """Cartesian product of named axes; rank = row-major coordinate index."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        key = self.ProcessCoord(**coord_kwargs)
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", ), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis) -> List[List[int]]:
+        """Lists of ranks that vary only along `axis` (the reference's
+        process-group construction; on TPU: mesh-axis subsets)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in itertools.product(*ranges):
+            other = dict(zip(other_axes, combo))
+            ranks = [self.get_rank(**{axis: i, **other}) for i in range(self.get_dim(axis))]
+            if len(ranks) > 1:
+                lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return [rank for coord, rank in self.mapping.items() if _match(coord)]
+
+    def get_axis_list(self, axis, idx) -> List[int]:
+        return [rank for coord, rank in self.mapping.items() if getattr(coord, axis) == idx]
+
+    @property
+    def world_size(self):
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe x data (reference :244)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe x data x model (reference :251)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
