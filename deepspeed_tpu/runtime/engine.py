@@ -139,6 +139,12 @@ class DeepSpeedTpuEngine:
         if not mesh_is_initialized():
             mc = self._config.mesh_config
             axes = {a: getattr(mc, a) for a in mc.axis_order}
+            hpz = self._config.zero_config.zero_hpz_partition_size
+            if hpz > 1 and axes.get("fsdp", 1) == 1:
+                # hpZ (ZeRO++ secondary partition): shard params over the
+                # innermost ICI-local axis only; replicate across nodes
+                from .zeropp import hpz_mesh_axes
+                axes.update(hpz_mesh_axes(jax.device_count(), hpz))
             if mesh_param is not None:  # reference mesh_param=(dp, sp)
                 axes = {"data": mesh_param[0], "seq": mesh_param[1]}
             dist.init_distributed(mesh_axes=axes)
@@ -274,7 +280,18 @@ class DeepSpeedTpuEngine:
         tx = self.base_tx
         scaler_cfg = self.scaler_cfg
 
+        # ZeRO++ qwZ/qgZ: explicit int8-wire param gather (fwd) and gradient
+        # reduce-scatter (bwd) instead of XLA's implicit bf16 resharding
+        zc = self._config.zero_config
+        qwz_gather = None
+        if zc.zero_quantized_weights and self.zero_plan.stage >= 3 and self.zero_plan.zero_axes:
+            from .zeropp import make_qwz_param_gather
+            qwz_gather = make_qwz_param_gather(self.mesh_ctx, self.param_shardings,
+                                               qgz=zc.zero_quantized_gradients)
+
         def loss_of(params, args, kwargs, scale):
+            if qwz_gather is not None:
+                params = qwz_gather(params)
             cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
             out = apply_fn(cparams, *args, **kwargs)
             loss, _ = _extract_loss(out)
