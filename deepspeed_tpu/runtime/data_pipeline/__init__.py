@@ -1,0 +1,17 @@
+"""Data efficiency pipeline.
+
+Rebuild of reference ``deepspeed/runtime/data_pipeline/``: curriculum
+learning scheduler, difficulty-based data sampling, Megatron-format indexed
+datasets, and random-LTD token dropping.
+"""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+from .data_routing import RandomLayerTokenDrop, RandomLTDScheduler
+
+__all__ = [
+    "CurriculumScheduler", "DeepSpeedDataSampler",
+    "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+    "RandomLayerTokenDrop", "RandomLTDScheduler",
+]

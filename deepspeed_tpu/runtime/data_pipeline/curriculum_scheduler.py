@@ -1,0 +1,118 @@
+"""Curriculum learning scheduler.
+
+Rebuild of reference ``runtime/data_pipeline/curriculum_scheduler.py:11
+CurriculumScheduler`` with the same JSON config keys and difficulty
+schedules: fixed_linear, fixed_root, fixed_discrete, custom.
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+from ...utils.logging import logger
+
+MIN_DIFFICULTY = "min_difficulty"
+MAX_DIFFICULTY = "max_difficulty"
+CURRENT_DIFFICULTY = "current_difficulty"
+SCHEDULE_TYPE = "schedule_type"
+SCHEDULE_CONFIG = "schedule_config"
+SCHEDULE_FIXED_LINEAR = "fixed_linear"
+SCHEDULE_FIXED_ROOT = "fixed_root"
+SCHEDULE_FIXED_DISCRETE = "fixed_discrete"
+SCHEDULE_CUSTOM = "custom"
+TOTAL_STEP = "total_curriculum_step"
+DIFFICULTY_STEP = "difficulty_step"
+ROOT_DEGREE = "root_degree"
+DIFFICULTY = "difficulty"
+MAX_STEP = "max_step"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict):
+        self.state = {}
+        for key in (MIN_DIFFICULTY, MAX_DIFFICULTY, SCHEDULE_TYPE):
+            assert key in config, f"Curriculum learning requires the config '{key}'"
+        self.state[MIN_DIFFICULTY] = config[MIN_DIFFICULTY]
+        self.state[MAX_DIFFICULTY] = config[MAX_DIFFICULTY]
+        self.state[CURRENT_DIFFICULTY] = config[MIN_DIFFICULTY]
+        self.state[SCHEDULE_TYPE] = config[SCHEDULE_TYPE]
+        self.first_step = True
+        self.custom_get_difficulty: Optional[Callable] = None
+
+        stype = config[SCHEDULE_TYPE]
+        sconf = config.get(SCHEDULE_CONFIG, {})
+        if stype == SCHEDULE_FIXED_DISCRETE:
+            assert DIFFICULTY in sconf and MAX_STEP in sconf
+            assert len(sconf[DIFFICULTY]) == len(sconf[MAX_STEP]) + 1
+            self.state[SCHEDULE_CONFIG] = sconf
+        elif stype in (SCHEDULE_FIXED_ROOT, SCHEDULE_FIXED_LINEAR):
+            assert TOTAL_STEP in sconf and DIFFICULTY_STEP in sconf
+            if stype == SCHEDULE_FIXED_ROOT:
+                assert ROOT_DEGREE in sconf
+            if sconf[DIFFICULTY_STEP] % 8 != 0:
+                logger.warning(
+                    "difficulty_step not a multiple of 8; disregard if your metric "
+                    "is unrelated to seqlen padding efficiency.")
+            self.state[SCHEDULE_CONFIG] = sconf
+        elif stype == SCHEDULE_CUSTOM:
+            pass
+        else:
+            raise RuntimeError(f"Unsupported curriculum schedule type {stype}")
+
+    # -------- queries --------
+
+    def get_current_difficulty(self):
+        return self.state[CURRENT_DIFFICULTY]
+
+    def set_current_difficulty(self, difficulty):
+        self.state[CURRENT_DIFFICULTY] = difficulty
+
+    def set_custom_get_difficulty(self, schedule_function: Callable):
+        self.custom_get_difficulty = schedule_function
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    # -------- schedules (reference :131-180) --------
+
+    def __fixed_discrete_get_difficulty(self, global_steps):
+        s = self.state[SCHEDULE_CONFIG]
+        if global_steps > s[MAX_STEP][-1]:
+            return s[DIFFICULTY][-1]
+        for i, step in enumerate(s[MAX_STEP]):
+            if global_steps <= step:
+                return s[DIFFICULTY][i]
+        return s[DIFFICULTY][-1]
+
+    def __fixed_root_get_difficulty(self, global_steps, root_degree=None):
+        s = self.state[SCHEDULE_CONFIG]
+        if root_degree is None:
+            root_degree = s[ROOT_DEGREE]
+        frac = (float(global_steps) / s[TOTAL_STEP]) ** (1.0 / root_degree)
+        next_difficulty = math.floor(
+            frac * (self.state[MAX_DIFFICULTY] - self.state[MIN_DIFFICULTY])
+            + self.state[MIN_DIFFICULTY])
+        next_difficulty -= next_difficulty % s[DIFFICULTY_STEP]
+        return min(next_difficulty, self.state[MAX_DIFFICULTY])
+
+    def get_difficulty(self, global_steps):
+        stype = self.state[SCHEDULE_TYPE]
+        if stype == SCHEDULE_FIXED_DISCRETE:
+            return self.__fixed_discrete_get_difficulty(global_steps)
+        if stype == SCHEDULE_FIXED_LINEAR:
+            return self.__fixed_root_get_difficulty(global_steps, 1)
+        if stype == SCHEDULE_FIXED_ROOT:
+            return self.__fixed_root_get_difficulty(global_steps)
+        if stype == SCHEDULE_CUSTOM:
+            assert self.custom_get_difficulty is not None, \
+                "custom schedule requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"Unsupported curriculum schedule type {stype}")
+
+    def update_difficulty(self, global_steps):
+        if self.state[CURRENT_DIFFICULTY] < self.state[MAX_DIFFICULTY]:
+            self.state[CURRENT_DIFFICULTY] = self.get_difficulty(global_steps)
+        return self.state[CURRENT_DIFFICULTY]

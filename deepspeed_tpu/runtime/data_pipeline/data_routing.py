@@ -1,0 +1,80 @@
+"""Random layer token dropping (random-LTD).
+
+Rebuild of reference ``runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + its scheduler: wrap a transformer layer so only a
+random subset of tokens passes through it (the rest bypass), with the kept
+count annealed up to full length over training. The reference's CUDA
+``token_sort``/``gather_scatter`` kernels are jnp argsort/take_along_axis —
+XLA-native on TPU.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_select(rng, hidden, keep: int):
+    """Pick `keep` random token indices per batch row; returns (sorted idx
+    [B, keep], gathered hidden [B, keep, D])."""
+    B, S = hidden.shape[0], hidden.shape[1]
+    scores = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(scores, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)  # keep relative order (reference token_sort)
+    gathered = jnp.take_along_axis(hidden, idx[..., None], axis=1)
+    return idx, gathered
+
+
+def random_ltd_scatter(hidden, processed, idx):
+    """Scatter processed tokens back into the full sequence (bypass rest)."""
+    return hidden.at[jnp.arange(hidden.shape[0])[:, None], idx].set(processed)
+
+
+class RandomLayerTokenDrop:
+    """Functional wrapper: layer_fn(params, x[, ...]) -> x applied to a random
+    token subset of annealed size."""
+
+    def __init__(self, layer_fn: Callable):
+        self.layer_fn = layer_fn
+
+    def __call__(self, params, hidden, keep: int, rng, *args, **kwargs):
+        S = hidden.shape[1]
+        if keep >= S:
+            return self.layer_fn(params, hidden, *args, **kwargs)
+        idx, sub = random_ltd_select(rng, hidden, keep)
+        out = self.layer_fn(params, sub, *args, **kwargs)
+        return random_ltd_scatter(hidden, out, idx)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference ``scheduler.py RandomLTDScheduler``):
+    linear anneal from `start_value` to `max_value` (full seqlen) over
+    `total_layer_tokens` steps in increments of `step_size`."""
+
+    def __init__(self, config: Dict):
+        ltd = config.get("random_ltd", config)
+        sched = ltd.get("random_ltd_schedule", ltd)
+        self.start_value = sched.get("start_value", ltd.get("random_ltd_layer_num", 128))
+        self.max_value = sched.get("max_value", 2048)
+        self.step_size = sched.get("step_size", 16)
+        self.schedule_steps = sched.get("schedule_steps", sched.get("total_layer_tokens", 1000))
+        self.current_value = self.start_value
+        self.global_step = 0
+
+    def get_current_seq(self):
+        return self.current_value
+
+    def update_seq(self, global_step: int):
+        self.global_step = global_step
+        frac = min(global_step / max(self.schedule_steps, 1), 1.0)
+        val = int(self.start_value + frac * (self.max_value - self.start_value))
+        val -= val % self.step_size
+        self.current_value = min(max(val, self.start_value), self.max_value)
+        return self.current_value
+
+    def state_dict(self):
+        return {"current_value": self.current_value, "global_step": self.global_step}
+
+    def load_state_dict(self, sd):
+        self.current_value = sd["current_value"]
+        self.global_step = sd["global_step"]

@@ -1,0 +1,101 @@
+"""Curriculum-aware distributed data sampler.
+
+Rebuild of reference ``runtime/data_pipeline/data_sampling/data_sampler.py:36
+DeepSpeedDataSampler``: deterministic epoch shuffling + per-dp-rank batch
+index slices, with optional curriculum filtering — at each step, only samples
+whose difficulty metric is <= the scheduler's current difficulty are
+eligible. Difficulty metrics are plain arrays here (the reference reads them
+from indexed metric files; `metric_values` accepts either an array or an
+MMapIndexedDataset).
+"""
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self,
+                 total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_rank: int = 0,
+                 data_parallel_size: int = 1,
+                 gradient_accumulation_steps: int = 1,
+                 curriculum_scheduler: Optional[CurriculumScheduler] = None,
+                 metric_values: Optional[Sequence] = None,
+                 drop_last: bool = True,
+                 shuffle: bool = True,
+                 seed: int = 1234):
+        self.total_samples = total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.global_batch_size = micro_batch_size * data_parallel_size * gradient_accumulation_steps
+        self.curriculum = curriculum_scheduler
+        self.metric_values = None if metric_values is None else np.asarray(metric_values)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.consumed_samples = 0
+        if self.curriculum is not None:
+            assert self.metric_values is not None, \
+                "curriculum sampling needs per-sample difficulty metrics"
+
+    def __len__(self):
+        n = self.total_samples
+        if self.drop_last:
+            return n // self.global_batch_size
+        return (n + self.global_batch_size - 1) // self.global_batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed_samples": self.consumed_samples,
+                "curriculum": None if self.curriculum is None else self.curriculum.get_state()}
+
+    def load_state_dict(self, sd):
+        self.epoch = sd["epoch"]
+        self.consumed_samples = sd["consumed_samples"]
+        if self.curriculum is not None and sd.get("curriculum"):
+            self.curriculum.set_state(sd["curriculum"])
+
+    def _epoch_order(self) -> np.ndarray:
+        order = np.arange(self.total_samples)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yields [micro_batch_size] index arrays for THIS dp rank."""
+        order = self._epoch_order()
+        step = self.consumed_samples // self.global_batch_size
+        pos = 0
+        while pos + self.global_batch_size <= len(order) or (
+                not self.drop_last and pos < len(order)):
+            if self.curriculum is not None:
+                difficulty = self.curriculum.update_difficulty(step + 1)
+                eligible = order[self.metric_values[order] <= difficulty]
+                if len(eligible) < self.global_batch_size:
+                    eligible = order  # degenerate config: fall back to all
+                batch = eligible[pos % max(len(eligible) - self.global_batch_size, 1):]
+                batch = batch[:self.global_batch_size]
+            else:
+                batch = order[pos:pos + self.global_batch_size]
+            if len(batch) < self.global_batch_size and self.drop_last:
+                break
+            # slice this rank's micro-batches (contiguous per-rank layout)
+            for g in range(self.gas):
+                lo = g * self.micro_batch_size * self.dp_size + self.dp_rank * self.micro_batch_size
+                mb = batch[lo:lo + self.micro_batch_size]
+                if len(mb):
+                    yield np.asarray(mb)
+            pos += self.global_batch_size
+            self.consumed_samples += self.global_batch_size
+            step += 1

@@ -1,0 +1,130 @@
+"""Memory-mapped indexed dataset (Megatron .bin/.idx format).
+
+Rebuild of reference ``runtime/data_pipeline/data_sampling/indexed_dataset.py:369
+MMapIndexedDataset`` — same on-disk layout (magic ``MMIDIDX``, version, dtype
+code, counts, sizes, pointers; raw sample data in the .bin) so datasets
+preprocessed for Megatron/DeepSpeed load unchanged. Reads are zero-copy numpy
+memmap views; the host dataloader hands them to ``jax.device_put``.
+"""
+
+import os
+import struct
+from functools import lru_cache
+from typing import List, Sequence, Union
+
+import numpy as np
+
+_INDEX_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+    5: np.int64, 6: np.float64, 7: np.float32, 8: np.uint16,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDataset:
+
+    class Index:
+
+        def __init__(self, path):
+            with open(path, "rb") as f:
+                magic = f.read(9)
+                assert magic == _INDEX_MAGIC, (
+                    f"Index file {path} has bad magic — not an MMapIndexedDataset index")
+                (version, ) = struct.unpack("<Q", f.read(8))
+                assert version == _VERSION
+                (dtype_code, ) = struct.unpack("<B", f.read(1))
+                self.dtype = _DTYPES[dtype_code]
+                (self._len, ) = struct.unpack("<Q", f.read(8))
+                (self._doc_count, ) = struct.unpack("<Q", f.read(8))
+                offset = f.tell()
+            buf = np.memmap(path, mode="r", order="C")
+            self.sizes = np.frombuffer(buf, dtype=np.int32, count=self._len, offset=offset)
+            ptr_off = offset + self.sizes.nbytes
+            self.pointers = np.frombuffer(buf, dtype=np.int64, count=self._len, offset=ptr_off)
+            doc_off = ptr_off + self.pointers.nbytes
+            self.doc_idx = np.frombuffer(buf, dtype=np.int64, count=self._doc_count,
+                                         offset=doc_off)
+
+        def __len__(self):
+            return self._len
+
+    def __init__(self, path_prefix: str, skip_warmup: bool = True):
+        self._path = path_prefix
+        self._index = self.Index(index_file_path(path_prefix))
+        self._bin = np.memmap(data_file_path(path_prefix), mode="r", order="C")
+
+    def __len__(self):
+        return len(self._index)
+
+    @property
+    def sizes(self):
+        return self._index.sizes
+
+    @property
+    def doc_idx(self):
+        return self._index.doc_idx
+
+    def __getitem__(self, idx: Union[int, slice]):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        ptr = self._index.pointers[idx]
+        size = self._index.sizes[idx]
+        return np.frombuffer(self._bin, dtype=self._index.dtype, count=size, offset=ptr)
+
+    def get(self, idx, offset=0, length=None):
+        ptr = self._index.pointers[idx] + offset * np.dtype(self._index.dtype).itemsize
+        size = self._index.sizes[idx] - offset
+        if length is not None:
+            size = min(size, length)
+        return np.frombuffer(self._bin, dtype=self._index.dtype, count=size, offset=ptr)
+
+    @staticmethod
+    def exists(path_prefix):
+        return (os.path.exists(index_file_path(path_prefix))
+                and os.path.exists(data_file_path(path_prefix)))
+
+
+class MMapIndexedDatasetBuilder:
+    """Writer (reference ``indexed_dataset.py MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_file_prefix: str, dtype=np.int32):
+        self._prefix = out_file_prefix
+        self._dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(out_file_prefix), "wb")
+        self._sizes: List[int] = []
+        self._pointers: List[int] = []
+        self._doc_idx: List[int] = [0]
+        self._offset = 0
+
+    def add_item(self, tensor: Sequence):
+        arr = np.asarray(tensor, dtype=self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._pointers.append(self._offset)
+        self._sizes.append(arr.size)
+        self._offset += arr.nbytes
+
+    def end_document(self):
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self):
+        self._bin.close()
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_INDEX_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(np.asarray(self._sizes, dtype=np.int32).tobytes(order="C"))
+            f.write(np.asarray(self._pointers, dtype=np.int64).tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, dtype=np.int64).tobytes(order="C"))

@@ -1,0 +1,148 @@
+"""Data pipeline tests (parity with reference
+``tests/unit/runtime/test_data_efficiency.py`` + indexed dataset tests)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler, DeepSpeedDataSampler,
+                                                 MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                                                 RandomLayerTokenDrop, RandomLTDScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (random_ltd_scatter,
+                                                              random_ltd_select)
+
+
+def test_curriculum_fixed_linear():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+    })
+    assert sched.get_difficulty(0) == 8
+    assert sched.get_difficulty(100) == 64
+    mid = sched.get_difficulty(50)
+    assert 8 <= mid <= 64 and mid % 8 == 0
+    # monotone
+    vals = [sched.update_difficulty(s) for s in range(0, 110, 10)]
+    assert vals == sorted(vals)
+
+
+def test_curriculum_fixed_root():
+    sched = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64, "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8,
+                            "root_degree": 2},
+    })
+    lin = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+    })
+    # sqrt schedule ramps faster early
+    assert sched.get_difficulty(25) >= lin.get_difficulty(25)
+    assert sched.get_difficulty(100) == 64
+
+
+def test_curriculum_fixed_discrete():
+    sched = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 3, "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]},
+    })
+    assert sched.get_difficulty(3) == 1
+    assert sched.get_difficulty(7) == 2
+    assert sched.get_difficulty(100) == 3
+
+
+def test_curriculum_custom():
+    sched = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 10, "schedule_type": "custom",
+    })
+    sched.set_custom_get_difficulty(lambda step: min(1 + step, 10))
+    assert sched.get_difficulty(3) == 4
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ds")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    samples = [np.arange(n, dtype=np.int32) for n in (5, 17, 3, 256)]
+    for s in samples[:2]:
+        builder.add_item(s)
+    builder.end_document()
+    for s in samples[2:]:
+        builder.add_item(s)
+    builder.end_document()
+    builder.finalize()
+
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for got, want in zip(ds[0:4], samples):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.sizes, [5, 17, 3, 256])
+    np.testing.assert_array_equal(ds.doc_idx, [0, 2, 4])
+    # partial reads
+    np.testing.assert_array_equal(ds.get(3, offset=10, length=5), np.arange(10, 15))
+
+
+def test_data_sampler_partitions_ranks():
+    n, mbs, dp = 64, 4, 2
+    seen = {r: [] for r in range(dp)}
+    for r in range(dp):
+        sampler = DeepSpeedDataSampler(total_samples=n, micro_batch_size=mbs,
+                                       data_parallel_rank=r, data_parallel_size=dp,
+                                       shuffle=True, seed=7)
+        for mb in sampler:
+            assert len(mb) == mbs
+            seen[r].extend(mb.tolist())
+    # disjoint + complete coverage
+    assert not (set(seen[0]) & set(seen[1]))
+    assert set(seen[0]) | set(seen[1]) == set(range(n))
+
+
+def test_data_sampler_curriculum_filters():
+    n = 128
+    metrics = np.arange(n)  # difficulty = index
+    sched = CurriculumScheduler({
+        "min_difficulty": 16, "max_difficulty": n, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+    })
+    sampler = DeepSpeedDataSampler(total_samples=n, micro_batch_size=8,
+                                   curriculum_scheduler=sched, metric_values=metrics,
+                                   shuffle=False, seed=0)
+    first = next(iter(sampler))
+    # first batch drawn while difficulty is low -> only easy samples
+    assert first.max() <= 48
+
+
+def test_random_ltd_select_scatter():
+    rng = jax.random.PRNGKey(0)
+    h = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx, sub = random_ltd_select(rng, h, keep=4)
+    assert sub.shape == (2, 4, 4)
+    assert (np.diff(np.asarray(idx), axis=1) > 0).all()  # sorted order kept
+    out = random_ltd_scatter(h, sub * 0, idx)
+    # dropped tokens untouched, kept tokens zeroed
+    kept_mask = np.zeros((2, 8), bool)
+    for b in range(2):
+        kept_mask[b, np.asarray(idx)[b]] = True
+    np.testing.assert_array_equal(np.asarray(out)[~kept_mask], np.asarray(h)[~kept_mask])
+    assert (np.asarray(out)[kept_mask] == 0).all()
+
+
+def test_random_ltd_layer_and_scheduler():
+    def layer_fn(params, x):
+        return x * params
+
+    wrapped = RandomLayerTokenDrop(layer_fn)
+    h = jnp.ones((2, 16, 4))
+    out = wrapped(2.0, h, keep=8, rng=jax.random.PRNGKey(1))
+    assert float(out.sum()) == 2 * 16 * 4 + 2 * 8 * 4  # half doubled
+    full = wrapped(2.0, h, keep=16, rng=jax.random.PRNGKey(1))
+    assert float(full.sum()) == 2 * 2 * 16 * 4
+
+    sched = RandomLTDScheduler({"random_ltd_schedule": {
+        "start_value": 128, "max_value": 512, "step_size": 16, "schedule_steps": 100}})
+    assert sched.update_seq(0) == 128
+    assert sched.update_seq(100) == 512
+    assert sched.update_seq(50) % 16 == 0
