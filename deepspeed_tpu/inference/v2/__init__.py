@@ -1,0 +1,10 @@
+"""Ragged continuous-batching inference engine (FastGen-class).
+
+Rebuild of reference ``deepspeed/inference/v2`` for TPU: paged KV cache with
+dense int32 block tables, bucketed compile cache instead of dynamic shapes,
+Dynamic SplitFuse scheduling semantics (``can_schedule``/``query``).
+"""
+
+from .config_v2 import RaggedInferenceEngineConfig, DSStateManagerConfig, KVCacheConfig
+from .scheduling_utils import SchedulingResult, SchedulingError
+from .engine_v2 import InferenceEngineV2, build_llama_engine

@@ -1,0 +1,72 @@
+"""Inference-v2 configuration tree.
+
+Reference: ``deepspeed/inference/v2/config_v2.py`` (RaggedInferenceEngineConfig)
+and ``inference/v2/ragged/manager_configs.py`` (DSStateManagerConfig,
+KVCacheConfig). Same knobs, pydantic-validated, TPU notes where semantics
+shift (static shapes → bucketing).
+"""
+
+from typing import Optional, Tuple
+
+from pydantic import Field, model_validator
+
+from ...config.config_utils import ConfigModel
+
+
+class KVCacheConfig(ConfigModel):
+    """Per-token cache geometry (reference manager_configs.py:28)."""
+    type: str = "dense"
+    block_size: int = 128
+    num_allocation_groups: int = 1
+    # (num_layers, num_kv_heads, head_size) per token
+    cache_shape: Tuple[int, int, int] = (1, 1, 64)
+    cache_dtype: str = "bfloat16"
+    max_blocks_per_allocation_group: int = 64
+
+
+class DSStateManagerConfig(ConfigModel):
+    """Reference manager_configs.py:DSStateManagerConfig."""
+    max_tracked_sequences: int = 2048
+    """Max sequences the state manager tracks (KV + metadata slots)."""
+
+    max_ragged_batch_size: int = 768
+    """Max total tokens in one ragged forward (Dynamic SplitFuse budget)."""
+
+    max_ragged_sequence_count: int = 512
+    """Max distinct sequences composable into one ragged batch."""
+
+    max_context: int = 8192
+    """Max per-sequence length (history + new)."""
+
+    memory_config_mode: str = "reserve"
+    memory_config_size: float = 0.85
+    """'reserve': fraction of free HBM for KV blocks; 'allocate': block count."""
+
+    offload: bool = False
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.max_ragged_sequence_count > self.max_tracked_sequences:
+            raise ValueError("max_ragged_sequence_count cannot exceed max_tracked_sequences")
+        if self.max_ragged_sequence_count > self.max_ragged_batch_size:
+            raise ValueError("max_ragged_sequence_count cannot exceed max_ragged_batch_size")
+        return self
+
+
+class QuantizationConfig(ConfigModel):
+    quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
+
+
+class TensorParallelConfig(ConfigModel):
+    tp_size: int = 1
+
+
+class RaggedInferenceEngineConfig(ConfigModel):
+    """Reference config_v2.py:RaggedInferenceEngineConfig."""
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+    state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig)
+    quantization: QuantizationConfig = Field(default_factory=QuantizationConfig)
+
+    # TPU-specific: number of KV blocks to allocate (overrides memory_config
+    # sizing when set — tests and CPU runs need deterministic small caches).
+    num_kv_blocks: Optional[int] = None

@@ -1,0 +1,158 @@
+"""InferenceEngineV2 — ragged continuous-batching serving engine.
+
+Reference: ``deepspeed/inference/v2/engine_v2.py:30 InferenceEngineV2``.
+Same contract: ``put(uids, tokens)`` runs one ragged forward returning one
+logits row per sequence; ``query``/``can_schedule`` expose the Dynamic
+SplitFuse feasibility math to the scheduler (MII-equivalent); ``flush``
+drops a sequence's KV. TPU-side, a forward is one jitted program per shape
+bucket (see ragged_wrapper) and the KV cache is donated functional state.
+"""
+
+import os
+import pickle
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from ...models.llama import LlamaConfig, init_llama
+from .config_v2 import RaggedInferenceEngineConfig
+from .model import RaggedLlamaModel
+from .ragged.ragged_manager import DSStateManager
+from .ragged.ragged_wrapper import RaggedBatchWrapper
+from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
+from .scheduling_utils import SchedulingError, SchedulingResult
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model: RaggedLlamaModel, engine_config: RaggedInferenceEngineConfig):
+        self._config = engine_config
+        self._model = model
+
+        kv_config = model.kv_cache_config()
+        self._batch = RaggedBatchWrapper(engine_config.state_manager,
+                                         block_size=kv_config.block_size)
+        self._state_manager = DSStateManager(engine_config.state_manager, kv_config,
+                                             num_blocks=engine_config.num_kv_blocks)
+        self._model.set_state_manager(self._state_manager)
+
+    # ---- properties (reference engine_v2.py:47-66) ----
+
+    @property
+    def free_blocks(self) -> int:
+        return self._state_manager.free_blocks
+
+    @property
+    def n_kv_cache_groups(self) -> int:
+        return 1
+
+    def model(self) -> RaggedLlamaModel:
+        return self._model
+
+    # ---- serving (reference :107 put) ----
+
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True):
+        """One ragged forward; returns logits [n_seqs_padded, vocab] — row i is
+        the next-token distribution for batch_uids[i]."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, dtype=np.int32).reshape(-1) for t in batch_tokens]
+
+        if do_checks:
+            token_lens = [t.size for t in batch_tokens]
+            schedule_check = self.can_schedule(batch_uids, token_lens)
+            if schedule_check != SchedulingResult.Success:
+                raise SchedulingError(schedule_check)
+
+        self._batch.clear()
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            host_seq_desc = self._state_manager.get_or_create_sequence(uid)
+            self._model.maybe_allocate_kv(host_seq_desc, tokens.size)
+            host_seq_desc.pre_forward(tokens.size)
+            self._batch.insert_sequence(host_seq_desc, tokens, do_checks=do_checks)
+
+        batch = self._batch.finalize(
+            total_slots=self._state_manager.kv_cache.num_blocks *
+            self._state_manager.kv_cache.block_size)
+        logits = self._model.forward(batch)
+
+        for uid in batch_uids:
+            seq = self._state_manager.get_sequence(uid)
+            seq.post_forward()
+            self._model.maybe_free_kv(seq)
+        return logits
+
+    # ---- scheduling feasibility (reference :158 query / :184 can_schedule) ----
+
+    def query(self, uid: int, max_request_tokens: int, max_request_blocks: int) -> Tuple[int, int]:
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            if self._state_manager.n_tracked_sequences >= \
+                    self._config.state_manager.max_tracked_sequences:
+                return (0, 0)
+            seq_desc = PlaceholderSequenceDescriptor()
+        return self._model.get_kv_requirements(seq_desc, max_request_tokens, max_request_blocks)
+
+    def can_schedule(self, uids: Iterable[int], lengths: Iterable[int]) -> SchedulingResult:
+        uids, lengths = list(uids), list(lengths)
+        cur_seqs = self._state_manager.n_tracked_sequences
+        free_blocks = self._state_manager.free_blocks
+        batch_len = 0
+
+        if len(uids) > self._config.state_manager.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+
+        for uid, length in zip(uids, lengths):
+            seq_desc = self._state_manager.get_sequence(uid)
+            if seq_desc is None:
+                cur_seqs += 1
+                seq_desc = PlaceholderSequenceDescriptor()
+            if seq_desc.seen_tokens + length > self._config.state_manager.max_context:
+                return SchedulingResult.SequenceTokenLimitExceeded
+            sched_len, sched_blocks = self._model.get_kv_requirements(seq_desc, length, free_blocks)
+            if sched_len != length:
+                return SchedulingResult.KVCacheLimitExceeded
+            batch_len += length
+            free_blocks -= sched_blocks
+
+        if cur_seqs > self._config.state_manager.max_tracked_sequences:
+            return SchedulingResult.EngineSequenceLimitExceeded
+        if batch_len > self._config.state_manager.max_ragged_batch_size:
+            return SchedulingResult.BatchTokenLimitExceeded
+        return SchedulingResult.Success
+
+    def get_remaining_block_capacity(self, uid: int) -> int:
+        seq_desc = self._state_manager.get_sequence(uid)
+        if seq_desc is None:
+            return 0
+        return self._model.get_remaining_block_capacity(seq_desc)
+
+    def flush(self, uid: int) -> None:
+        self._state_manager.flush_sequence(uid)
+
+    def serialize(self, save_path: str) -> None:
+        """Flat param snapshot (reference :251 → flat_model_helpers)."""
+        os.makedirs(save_path, exist_ok=True)
+        flat, treedef = jax.tree_util.tree_flatten(self._model.params)
+        np.savez(os.path.join(save_path, "params.npz"),
+                 **{str(i): np.asarray(x) for i, x in enumerate(flat)})
+        with open(os.path.join(save_path, "metadata.pkl"), "wb") as f:
+            pickle.dump({"treedef": treedef, "config": self._model.config}, f)
+
+
+def build_llama_engine(config: Optional[LlamaConfig] = None,
+                       params=None,
+                       engine_config: Optional[RaggedInferenceEngineConfig] = None,
+                       seed: int = 0,
+                       dtype=None,
+                       kv_block_size: int = 64) -> InferenceEngineV2:
+    """Factory (reference ``engine_factory.py build_hf_engine``): build a
+    ragged engine from a Llama config + trained params (random if None)."""
+    import jax.numpy as jnp
+    config = config or LlamaConfig.tiny()
+    engine_config = engine_config or RaggedInferenceEngineConfig()
+    if params is None:
+        _, params = init_llama(config, seed=seed)
+    model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
+                             kv_block_size=kv_block_size)
+    return InferenceEngineV2(model, engine_config)

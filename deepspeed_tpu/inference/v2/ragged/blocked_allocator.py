@@ -1,0 +1,56 @@
+"""Free-list allocator for paged KV-cache blocks.
+
+Reference: ``deepspeed/inference/v2/ragged/blocked_allocator.py:11`` — the
+same linked-list free list, but host-side numpy (no device traffic: block ids
+only ever reach the device inside the batch's dense block-table array).
+"""
+
+from typing import Iterable, Union
+
+import numpy as np
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 1:
+            raise ValueError(f"Blocked KV-cache must have at least 1 block, provided {num_blocks}")
+        self._num_blocks = num_blocks
+        self._blocks = np.arange(1, num_blocks + 1, dtype=np.int32)
+        self._head = 0
+        self._free_blocks = num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        if num_blocks > self._free_blocks:
+            raise ValueError(f"Not enough free blocks in the KV-cache to allocate {num_blocks}")
+        allocated = np.empty(num_blocks, dtype=np.int32)
+        for i in range(num_blocks):
+            allocated[i] = self._head
+            self._head = int(self._blocks[self._head])
+            self._blocks[allocated[i]] = -1  # mark used
+            self._free_blocks -= 1
+        return allocated
+
+    def free(self, blocks: Union[Iterable[int], int]) -> None:
+        if isinstance(blocks, (int, np.integer)):
+            blocks = [int(blocks)]
+        blocks = [int(b) for b in blocks]
+        seen = set()
+        for b in blocks:
+            if b < 0 or b >= self._num_blocks:
+                raise ValueError(f"Invalid block {b} provided to free")
+            if self._blocks[b] != -1 or b in seen:
+                raise ValueError(f"Block {b} is already free")
+            seen.add(b)
+        for b in blocks:
+            self._blocks[b] = self._head
+            self._head = b
+            self._free_blocks += 1
+
+    @property
+    def free_blocks(self) -> int:
+        return self._free_blocks
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks

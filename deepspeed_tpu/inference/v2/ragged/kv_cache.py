@@ -1,0 +1,57 @@
+"""Paged (blocked) KV cache on device.
+
+Reference: ``deepspeed/inference/v2/ragged/kv_cache.py`` (BlockedKVCache).
+TPU design: ONE device array per allocation group shaped
+``[num_layers, num_blocks * block_size, 2, num_kv_heads, head_dim]`` — flat
+slot addressing means the model writes new K/V with a single scatter of
+per-token flat indices (``block_table[pos // bs] * bs + pos % bs``) and reads
+history with a gather of the sequence's block table; both are dense int32
+indexed ops XLA lowers to efficient dynamic-gather/scatter on TPU.
+
+The cache is functional state: the jitted forward takes it as a donated
+argument and returns the updated array (no in-place mutation semantics to
+fight — donation makes it zero-copy on device).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config_v2 import KVCacheConfig
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
+
+
+class BlockedKVCache:
+
+    def __init__(self, config: KVCacheConfig, num_blocks: int):
+        self._config = config
+        self.num_blocks = num_blocks
+        self.block_size = config.block_size
+        n_layers, n_kv, head_dim = config.cache_shape
+        self.dtype = _DTYPES.get(config.cache_dtype, jnp.bfloat16)
+        self.shape = (n_layers, num_blocks * config.block_size, 2, n_kv, head_dim)
+        self.cache = jnp.zeros(self.shape, dtype=self.dtype)
+
+    @property
+    def per_token_bytes(self) -> int:
+        n_layers, n_kv, head_dim = self._config.cache_shape
+        return n_layers * 2 * n_kv * head_dim * jnp.dtype(self.dtype).itemsize
+
+    def update(self, new_cache: jax.Array) -> None:
+        """Install the updated cache returned by a forward (donated swap)."""
+        self.cache = new_cache
+
+    @staticmethod
+    def required_blocks(tokens: int, block_size: int) -> int:
+        return (tokens + block_size - 1) // block_size
+
+
+def estimate_kv_blocks(config: KVCacheConfig, hbm_bytes: int, fraction: float) -> int:
+    """Size the cache from an HBM budget (reference memory_config 'reserve')."""
+    n_layers, n_kv, head_dim = config.cache_shape
+    per_block = (n_layers * 2 * n_kv * head_dim *
+                 jnp.dtype(_DTYPES.get(config.cache_dtype, jnp.bfloat16)).itemsize *
+                 config.block_size)
+    return max(1, int(hbm_bytes * fraction) // per_block)

@@ -1,0 +1,85 @@
+"""Persistent state manager for ragged serving.
+
+Reference: ``deepspeed/inference/v2/ragged/ragged_manager.py:19 DSStateManager``
+— tracks live sequences, owns the block allocator + paged KV cache.
+"""
+
+from typing import Dict, Optional
+
+from ..config_v2 import DSStateManagerConfig, KVCacheConfig
+from .blocked_allocator import BlockedAllocator
+from .kv_cache import BlockedKVCache
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+class DSStateManager:
+
+    def __init__(self,
+                 config: DSStateManagerConfig,
+                 kv_config: KVCacheConfig,
+                 num_blocks: Optional[int] = None):
+        self._config = config
+        self._kv_config = kv_config
+        if num_blocks is None:
+            # default sizing: enough blocks for max_tracked_sequences at one
+            # block each plus the ragged batch; real deployments size from HBM
+            # via estimate_kv_blocks
+            num_blocks = max(64, config.max_tracked_sequences)
+        self._allocator = BlockedAllocator(num_blocks)
+        self._kv_cache = BlockedKVCache(kv_config, num_blocks)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    # ---- sequence tracking (reference ragged_manager.py:96-160) ----
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def tracked_sequences(self) -> Dict[int, DSSequenceDescriptor]:
+        return self._seqs
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        seq = self._seqs.get(uid)
+        if seq is not None:
+            return seq
+        return self._create_sequence(uid)
+
+    def _create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid in self._seqs:
+            raise ValueError(f"Sequence {uid} already exists")
+        if len(self._seqs) >= self._config.max_tracked_sequences:
+            raise RuntimeError("max_tracked_sequences exceeded")
+        max_blocks = (self._config.max_context + self._kv_config.block_size - 1) \
+            // self._kv_config.block_size
+        seq = DSSequenceDescriptor(uid, max_blocks)
+        self._seqs[uid] = seq
+        return seq
+
+    def flush_sequence(self, uid: int) -> None:
+        """Free a sequence's KV blocks + tracking (reference :147)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            return
+        if seq.kv_blocks:
+            self._allocator.free(seq.kv_blocks)
+
+    # ---- KV accounting ----
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    @property
+    def kv_cache(self) -> BlockedKVCache:
+        return self._kv_cache
+
+    @property
+    def block_size(self) -> int:
+        return self._kv_config.block_size
+
+    def allocate_blocks(self, n_blocks: int):
+        return self._allocator.allocate(n_blocks)

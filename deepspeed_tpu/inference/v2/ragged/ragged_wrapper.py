@@ -1,0 +1,130 @@
+"""Ragged batch construction.
+
+Reference: ``deepspeed/inference/v2/ragged/ragged_wrapper.py``
+(RaggedBatchWrapper) — host-side assembly of the dense metadata a ragged
+forward needs. TPU twist: XLA requires static shapes, so every array is
+padded to a **bucket** (next power of two) and the jitted forward is cached
+per bucket signature — the compile-cache analog of the reference's CUDA-graph
+ambitions, with padding in place of true dynamism.
+
+Arrays shipped to device per forward:
+  tokens[T], token_seq[T], token_pos[T], token_slot[T] (flat KV write index;
+  padding points one-past-the-end so the scatter drops it), seq_start[S],
+  seq_n_new[S], seq_seen[S], block_table[S, B], last_token_idx[S].
+"""
+
+from typing import List, NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config_v2 import DSStateManagerConfig
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class RaggedBatch(NamedTuple):
+    """Device-side dense view of one ragged batch."""
+    tokens: jnp.ndarray        # int32 [T]
+    token_seq: jnp.ndarray     # int32 [T] slot in [0, S)
+    token_pos: jnp.ndarray     # int32 [T] absolute position in sequence
+    token_slot: jnp.ndarray    # int32 [T] flat KV slot (OOB for padding)
+    seq_start: jnp.ndarray     # int32 [S] first token index
+    seq_n_new: jnp.ndarray     # int32 [S] new tokens this forward (0 = pad)
+    seq_seen: jnp.ndarray      # int32 [S] history length
+    block_table: jnp.ndarray   # int32 [S, B]
+    last_token_idx: jnp.ndarray  # int32 [S] token index of final token
+
+    @property
+    def bucket_key(self):
+        return (self.tokens.shape[0], self.seq_start.shape[0], self.block_table.shape[1])
+
+
+class RaggedBatchWrapper:
+
+    def __init__(self, config: DSStateManagerConfig, block_size: int = 128):
+        self._config = config
+        self._block_size = block_size
+        self.clear()
+
+    def clear(self) -> None:
+        self._uids: List[int] = []
+        self._token_lists: List[np.ndarray] = []
+        self._seqs: List[DSSequenceDescriptor] = []
+        self._batch = None
+
+    def insert_sequence(self, seq_desc: DSSequenceDescriptor, tokens, do_checks: bool = True) -> None:
+        tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        if do_checks:
+            if len(self._seqs) + 1 > self._config.max_ragged_sequence_count:
+                raise RuntimeError("batch sequence limit exceeded")
+            if self.current_tokens + tokens.size > self._config.max_ragged_batch_size:
+                raise RuntimeError("batch token limit exceeded")
+        self._uids.append(seq_desc.uid)
+        self._token_lists.append(tokens)
+        self._seqs.append(seq_desc)
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def current_tokens(self) -> int:
+        return int(sum(t.size for t in self._token_lists))
+
+    def finalize(self, total_slots: int) -> RaggedBatch:
+        """Build the padded dense arrays. `total_slots` = num_blocks*block_size
+        of the KV cache (used as the drop target for padding writes)."""
+        bs = self._block_size
+        S = _bucket(max(1, len(self._seqs)), floor=1)
+        T = _bucket(max(1, self.current_tokens))
+        max_blocks = max((s.cur_allocated_blocks for s in self._seqs), default=1)
+        B = _bucket(max(1, max_blocks), floor=1)
+
+        tokens = np.zeros(T, dtype=np.int32)
+        token_seq = np.zeros(T, dtype=np.int32)
+        token_pos = np.zeros(T, dtype=np.int32)
+        token_slot = np.full(T, total_slots, dtype=np.int32)  # OOB → scatter drop
+        seq_start = np.zeros(S, dtype=np.int32)
+        seq_n_new = np.zeros(S, dtype=np.int32)
+        seq_seen = np.zeros(S, dtype=np.int32)
+        block_table = np.zeros((S, B), dtype=np.int32)
+        last_token_idx = np.zeros(S, dtype=np.int32)
+
+        cursor = 0
+        for i, (seq, toks) in enumerate(zip(self._seqs, self._token_lists)):
+            n = toks.size
+            seq_start[i] = cursor
+            seq_n_new[i] = n
+            seq_seen[i] = seq.seen_tokens
+            bt = seq.block_table(B)
+            block_table[i] = bt
+            tokens[cursor:cursor + n] = toks
+            token_seq[cursor:cursor + n] = i
+            pos = seq.seen_tokens + np.arange(n, dtype=np.int32)
+            token_pos[cursor:cursor + n] = pos
+            token_slot[cursor:cursor + n] = bt[pos // bs] * bs + pos % bs
+            last_token_idx[i] = cursor + n - 1
+            cursor += n
+
+        self._batch = RaggedBatch(
+            tokens=jnp.asarray(tokens), token_seq=jnp.asarray(token_seq),
+            token_pos=jnp.asarray(token_pos), token_slot=jnp.asarray(token_slot),
+            seq_start=jnp.asarray(seq_start), seq_n_new=jnp.asarray(seq_n_new),
+            seq_seen=jnp.asarray(seq_seen), block_table=jnp.asarray(block_table),
+            last_token_idx=jnp.asarray(last_token_idx))
+        return self._batch
+
+    @property
+    def batch(self) -> RaggedBatch:
+        return self._batch
+
+    @property
+    def uids(self) -> List[int]:
+        return list(self._uids)

@@ -1,0 +1,88 @@
+"""Host-side sequence metadata.
+
+Reference: ``deepspeed/inference/v2/ragged/sequence_descriptor.py``
+(DSSequenceDescriptor / PlaceholderSequenceDescriptor). The reference keeps
+per-sequence views into pinned device tensors; on TPU all metadata stays
+host-numpy and is shipped once per forward inside the RaggedBatch arrays.
+"""
+
+from typing import List
+
+import numpy as np
+
+
+class BaseSequenceDescriptor:
+
+    @property
+    def seen_tokens(self) -> int:
+        raise NotImplementedError()
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        raise NotImplementedError()
+
+
+class PlaceholderSequenceDescriptor(BaseSequenceDescriptor):
+    """Stand-in for unknown UIDs during scheduling dry runs
+    (reference sequence_descriptor.py:PlaceholderSequenceDescriptor)."""
+
+    def __init__(self, seen_tokens: int = 0, cur_allocated_blocks: int = 0):
+        self._seen_tokens = seen_tokens
+        self._cur_allocated_blocks = cur_allocated_blocks
+
+    @property
+    def seen_tokens(self) -> int:
+        return self._seen_tokens
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return self._cur_allocated_blocks
+
+
+class DSSequenceDescriptor(BaseSequenceDescriptor):
+
+    def __init__(self, uid: int, max_blocks_per_seq: int):
+        self.uid = uid
+        self._seen_tokens = 0
+        self._in_flight_tokens = 0
+        self._max_blocks = max_blocks_per_seq
+        self._blocks: List[int] = []
+
+    @property
+    def seen_tokens(self) -> int:
+        return self._seen_tokens
+
+    @property
+    def in_flight_tokens(self) -> int:
+        return self._in_flight_tokens
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self._blocks)
+
+    @property
+    def kv_blocks(self) -> List[int]:
+        return self._blocks
+
+    def block_table(self, width: int) -> np.ndarray:
+        """Dense int32 block table padded to `width` with 0 (padded entries are
+        masked out by position bounds in the attention kernel)."""
+        t = np.zeros(width, dtype=np.int32)
+        n = min(len(self._blocks), width)
+        t[:n] = self._blocks[:n]
+        return t
+
+    def extend_kv_cache(self, new_blocks) -> None:
+        blocks = [int(b) for b in np.atleast_1d(new_blocks)]
+        if len(self._blocks) + len(blocks) > self._max_blocks:
+            raise ValueError(f"Sequence {self.uid} exceeds max_blocks_per_seq={self._max_blocks}")
+        self._blocks.extend(blocks)
+
+    def pre_forward(self, num_tokens: int) -> None:
+        """Reference sequence_descriptor: record in-flight tokens."""
+        self._in_flight_tokens = num_tokens
+
+    def post_forward(self) -> None:
+        """Commit in-flight tokens to history."""
+        self._seen_tokens += self._in_flight_tokens
+        self._in_flight_tokens = 0

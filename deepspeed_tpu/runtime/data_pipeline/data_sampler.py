@@ -76,7 +76,9 @@ class DeepSpeedDataSampler:
         """Yields [micro_batch_size] index arrays for THIS dp rank."""
         order = self._epoch_order()
         step = self.consumed_samples // self.global_batch_size
-        pos = 0
+        # resume mid-epoch: skip what this epoch already consumed
+        epoch_samples = len(self) * self.global_batch_size if self.drop_last else self.total_samples
+        pos = self.consumed_samples % epoch_samples if epoch_samples else 0
         while pos + self.global_batch_size <= len(order) or (
                 not self.drop_last and pos < len(order)):
             if self.curriculum is not None:
@@ -84,8 +86,10 @@ class DeepSpeedDataSampler:
                 eligible = order[self.metric_values[order] <= difficulty]
                 if len(eligible) < self.global_batch_size:
                     eligible = order  # degenerate config: fall back to all
-                batch = eligible[pos % max(len(eligible) - self.global_batch_size, 1):]
-                batch = batch[:self.global_batch_size]
+                # deterministic draw keyed by step: full eligible-pool coverage
+                # in expectation, and resume replays the same batch
+                rng = np.random.default_rng([self.seed, self.epoch, step])
+                batch = rng.choice(eligible, self.global_batch_size, replace=False)
             else:
                 batch = order[pos:pos + self.global_batch_size]
             if len(batch) < self.global_batch_size and self.drop_last:

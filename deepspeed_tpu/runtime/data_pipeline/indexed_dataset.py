@@ -9,7 +9,6 @@ memmap views; the host dataloader hands them to ``jax.device_put``.
 
 import os
 import struct
-from functools import lru_cache
 from typing import List, Sequence, Union
 
 import numpy as np
@@ -60,6 +59,9 @@ class MMapIndexedDataset:
             return self._len
 
     def __init__(self, path_prefix: str, skip_warmup: bool = True):
+        # skip_warmup kept for reference API parity only: the reference
+        # optionally touch-reads the mmap to prime the page cache; host-side
+        # np.memmap readahead makes that unnecessary here, so it's a no-op.
         self._path = path_prefix
         self._index = self.Index(index_file_path(path_prefix))
         self._bin = np.memmap(data_file_path(path_prefix), mode="r", order="C")
