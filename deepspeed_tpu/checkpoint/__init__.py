@@ -1,1 +1,4 @@
-from .engine import CheckpointEngine, OrbaxCheckpointEngine
+from .engine import CheckpointEngine, OrbaxCheckpointEngine, AsyncCheckpointEngine
+from .universal import ds_to_universal, load_universal, load_universal_into
+from .zero_to_fp32 import (get_fp32_state_dict_from_zero_checkpoint,
+                           convert_zero_checkpoint_to_fp32_state_dict)

@@ -56,6 +56,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         import orbax.checkpoint as ocp
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
+        self._async = use_async
 
     def create(self, tag):
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is about to be saved!")
@@ -63,14 +64,22 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, state_dict: Dict[str, Any], path: str, host_state: Optional[Dict] = None):
         path = os.path.abspath(path)
         self._ckptr.save(path, state_dict, force=True)
+        if self._async:
+            # orbax materializes the dir atomically (tmp → rename) when the
+            # async write completes; host state must wait for commit()
+            self._pending_host_state = (path, host_state)
+            return path
         self._ckptr.wait_until_finished()
+        self._write_host_state(path, host_state)
+        return path
+
+    def _write_host_state(self, path, host_state):
         if host_state is not None:
             # pickle, not JSON: the reference torch.save()s arbitrary client
             # state (engine.py:3109) — numpy rng states etc. must round-trip
             import pickle
             with open(os.path.join(path, self.HOST_STATE_FILE), "wb") as f:
                 pickle.dump(host_state, f)
-        return path
 
     def load(self, path: str, map_location=None, target=None):
         """Restore; `target` is an abstract pytree (jax.ShapeDtypeStruct with
@@ -93,5 +102,21 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return restored, host_state
 
     def commit(self, tag):
+        if self._async:
+            self._ckptr.wait_until_finished()
+            pending = getattr(self, "_pending_host_state", None)
+            if pending is not None:
+                self._write_host_state(*pending)
+                self._pending_host_state = None
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is ready now!")
         return True
+
+
+class AsyncCheckpointEngine(OrbaxCheckpointEngine):
+    """Tiered/async checkpointing (reference nebula_checkpoint_engine.py):
+    ``save`` returns once the snapshot is staged (orbax async write continues
+    in the background); ``commit`` is the durability barrier. Training
+    overlaps the serialization — the Nebula value proposition, natively."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params, use_async=True)

@@ -1,0 +1,181 @@
+"""Universal checkpoint: any→any parallelism conversion.
+
+Reference: ``deepspeed/checkpoint/ds_to_universal.py`` (extract ZeRO shards →
+merge TP slices → per-parameter fp32 "universal" fragments) and
+``checkpoint/universal_checkpoint.py:22 load_hp_checkpoint_state``.
+
+TPU-side most of the reference machinery is already subsumed: orbax stores
+full *logical* arrays (sharding is metadata, not file layout), so "merge
+shards" is a no-op. What remains — and is rebuilt here — is the *layout
+contract*: a checkpoint exploded into one directory per parameter holding
+fp32 master weight + optimizer moments, loadable into ANY later topology
+(different mesh, different optimizer partitioning, even a different
+framework). That contract is what makes cross-cluster / cross-revision
+resume possible, so we keep it file-for-file.
+
+Layout (matches the reference's universal layout semantically):
+    <out>/zero/<param.path>/fp32.npy
+    <out>/zero/<param.path>/exp_avg.npy       (when Adam-family state exists)
+    <out>/zero/<param.path>/exp_avg_sq.npy
+    <out>/universal_meta.json                 {step, param list, source}
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+
+from ..utils.logging import logger
+
+_SEP = "."
+
+
+def _flatten(tree, prefix=()):
+    """Dict/list pytree → {dotted.path: leaf}."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k), )))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, prefix + (str(i), )))
+    else:
+        out[_SEP.join(prefix)] = tree
+    return out
+
+
+def _unflatten_into(flat: Dict[str, Any], target_tree):
+    """Place flat {path: array} into the structure of target_tree."""
+    flat_t = _flatten(target_tree)
+    missing = [k for k in flat_t if k not in flat]
+    if missing:
+        raise KeyError(f"universal checkpoint missing parameters: {missing[:5]}"
+                       f"{'...' if len(missing) > 5 else ''}")
+    leaves_in_order = [flat[k] for k in flat_t]
+    treedef = jax.tree_util.tree_structure(target_tree)
+    return jax.tree_util.tree_unflatten(treedef, leaves_in_order)
+
+
+def _find_adam_moments(opt_state) -> Optional[Any]:
+    """Locate the ScaleByAdamState-like entry (has mu/nu pytrees) in an optax
+    chain state. Returns (mu, nu, count) or None."""
+    def probe(node):
+        # live optax state: ScaleByAdamState namedtuple; orbax numpy restore:
+        # the same structure as nested dicts keyed by field name
+        if hasattr(node, "mu") and hasattr(node, "nu"):
+            return node.mu, node.nu, getattr(node, "count", None)
+        if isinstance(node, dict) and "mu" in node and "nu" in node:
+            return node["mu"], node["nu"], node.get("count")
+        if isinstance(node, (list, tuple)):
+            for item in node:
+                found = probe(item)
+                if found is not None:
+                    return found
+        if isinstance(node, dict):
+            for item in node.values():
+                found = probe(item)
+                if found is not None:
+                    return found
+        return None
+    return probe(opt_state)
+
+
+def ds_to_universal(ckpt_path: str, output_dir: str) -> str:
+    """Convert an engine checkpoint (orbax dir saved by save_checkpoint) to
+    the universal layout (reference ds_to_universal.py:469 main)."""
+    from .engine import OrbaxCheckpointEngine
+    eng = OrbaxCheckpointEngine()
+    state, host_state = eng.load(ckpt_path)  # numpy restore, no target
+
+    params = state["params"]
+    flat_params = _flatten(params)
+    moments = _find_adam_moments(state.get("opt_state"))
+
+    zero_dir = os.path.join(output_dir, "zero")
+    if os.path.exists(zero_dir):
+        shutil.rmtree(zero_dir)
+    os.makedirs(zero_dir)
+
+    for name, w in flat_params.items():
+        pdir = os.path.join(zero_dir, name)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"), np.asarray(w, dtype=np.float32))
+    if moments is not None:
+        mu, nu, count = moments
+        for fname, tree in (("exp_avg.npy", mu), ("exp_avg_sq.npy", nu)):
+            for name, m in _flatten(tree).items():
+                np.save(os.path.join(zero_dir, name, fname),
+                        np.asarray(m, dtype=np.float32))
+
+    meta = {
+        "step": int(host_state.get("global_steps", 0)) if host_state else 0,
+        "params": sorted(flat_params.keys()),
+        "has_optim_states": moments is not None,
+        "source": os.path.abspath(ckpt_path),
+    }
+    with open(os.path.join(output_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    logger.info(f"universal checkpoint written: {output_dir} ({len(flat_params)} params)")
+    return output_dir
+
+
+def load_universal(universal_dir: str, fname: str = "fp32.npy") -> Dict[str, np.ndarray]:
+    """Read one fragment kind for all params → {dotted.path: array}."""
+    zero_dir = os.path.join(universal_dir, "zero")
+    with open(os.path.join(universal_dir, "universal_meta.json")) as f:
+        meta = json.load(f)
+    out = {}
+    for name in meta["params"]:
+        path = os.path.join(zero_dir, name, fname)
+        if os.path.exists(path):
+            out[name] = np.load(path)
+    return out
+
+
+def load_universal_into(universal_dir: str, params_target, opt_state_target=None):
+    """Reconstruct (params, opt_state) pytrees shaped like the targets from a
+    universal dir (reference universal_checkpoint.py:22
+    load_hp_checkpoint_state — per-param fragment mapping)."""
+    with open(os.path.join(universal_dir, "universal_meta.json")) as f:
+        meta = json.load(f)
+    params = _unflatten_into(load_universal(universal_dir, "fp32.npy"), params_target)
+    opt_state = None
+    if opt_state_target is not None and meta.get("has_optim_states"):
+        moments = _find_adam_moments(opt_state_target)
+        if moments is not None:
+            mu_t, nu_t, _ = moments
+            mu = _unflatten_into(load_universal(universal_dir, "exp_avg.npy"), mu_t)
+            nu = _unflatten_into(load_universal(universal_dir, "exp_avg_sq.npy"), nu_t)
+
+            step = int(meta.get("step", 0))
+
+            def swap(node):
+                if hasattr(node, "mu") and hasattr(node, "nu"):
+                    repl = {"mu": mu, "nu": nu}
+                    if hasattr(node, "count"):  # bias-correction step counter
+                        repl["count"] = np.asarray(step, dtype=np.int32)
+                    return node._replace(**repl)
+                if isinstance(node, tuple) and not hasattr(node, "_fields"):
+                    return tuple(swap(x) for x in node)
+                if isinstance(node, list):
+                    return [swap(x) for x in node]
+                return node
+            opt_state = swap(opt_state_target)
+    return params, opt_state, meta
+
+
+def main(argv=None):
+    """CLI: python -m deepspeed_tpu.checkpoint.universal <ckpt> <out>."""
+    import argparse
+    ap = argparse.ArgumentParser(description="DeepSpeed-TPU universal checkpoint converter")
+    ap.add_argument("input_folder", help="engine checkpoint dir (a tag dir)")
+    ap.add_argument("output_folder", help="universal checkpoint output dir")
+    args = ap.parse_args(argv)
+    ds_to_universal(args.input_folder, args.output_folder)
+
+
+if __name__ == "__main__":
+    main()

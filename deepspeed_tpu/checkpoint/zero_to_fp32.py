@@ -1,0 +1,63 @@
+"""Offline fp32 state-dict reconstruction from an engine checkpoint.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` — the user-facing script that
+merges per-rank ZeRO shards into one consolidated fp32 state dict. With
+orbax, shards merge at read time, so this reduces to: restore as numpy,
+take the fp32 master params, dump a flat npz (plus the same
+``get_fp32_state_dict_from_zero_checkpoint`` programmatic API).
+"""
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .universal import _flatten
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise ValueError(f"no 'latest' file in {checkpoint_dir}; pass tag explicitly")
+    return os.path.join(checkpoint_dir, tag)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Flat {dotted.param.path: fp32 array} (reference zero_to_fp32.py
+    get_fp32_state_dict_from_zero_checkpoint)."""
+    from .engine import OrbaxCheckpointEngine
+    path = _resolve_tag(checkpoint_dir, tag)
+    state, _ = OrbaxCheckpointEngine().load(path)
+    return {k: np.asarray(v, dtype=np.float32) for k, v in _flatten(state["params"]).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Write the consolidated fp32 params as one .npz (reference writes
+    pytorch_model.bin)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    np.savez(output_file, **sd)
+    logger.info(f"saved {len(sd)} fp32 tensors to {output_file}")
+    return output_file
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="Extract fp32 weights from a checkpoint")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_file")
+    ap.add_argument("-t", "--tag", default=None)
+    args = ap.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()

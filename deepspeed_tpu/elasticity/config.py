@@ -1,0 +1,64 @@
+"""Elasticity config (reference ``deepspeed/elasticity/config.py``)."""
+
+
+class ElasticityError(Exception):
+    """Base elasticity error (reference config.py:10)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Config error (reference config.py:16)."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current world size not in the valid set (reference config.py:22)."""
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityConfig:
+    """Validated view of the ``elasticity`` config block (config.py:28).
+
+    {"enabled": true, "max_train_batch_size": 2000,
+     "micro_batch_sizes": [2,4,6], "min_gpus": 1, "max_gpus": 10000,
+     "min_time": 20, "version": 0.2, "ignore_non_elastic_batch_info": false,
+     "num_gpus_per_node": 1, "model_parallel_size": 1}
+
+    Chip-count knobs keep the reference's "gpus" key names for config-file
+    compatibility; they mean TPU chips here.
+    """
+
+    def __init__(self, param_dict: dict):
+        self.enabled = param_dict.get("enabled", False)
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError("max_train_batch_size is required when "
+                                            "elasticity is enabled")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError("micro_batch_sizes is required when "
+                                            "elasticity is enabled")
+        self.max_acceptable_batch_size = param_dict.get("max_train_batch_size", 2000)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [2, 4, 6])
+        if not isinstance(self.micro_batches, list) or not self.micro_batches:
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be a non-empty list, got {self.micro_batches}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got {self.micro_batches}")
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", 10000)
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid chip range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = float(param_dict.get("version", 0.2))
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch_size", True)
+        self.ignore_non_elastic_batch_info = param_dict.get("ignore_non_elastic_batch_info", False)
+        self.num_gpus_per_node = param_dict.get("num_gpus_per_node", 1)
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return str(self.__dict__)

@@ -1,0 +1,189 @@
+"""Elastic batch-size / chip-count math.
+
+Reference: ``deepspeed/elasticity/elasticity.py:233 compute_elastic_config``
+(+ ``_get_compatible_gpus_v01 :83`` / ``_get_compatible_gpus_v02 :126``).
+Pure arithmetic — same algorithm, reimplemented:
+
+Pick a global batch size B ≤ max_acceptable that maximizes the number of
+chip counts w for which B = micro_batch × grad_accum × w has an integer
+solution with some allowed micro-batch. Scaling the job up/down across any
+w in the valid set then never changes the *global* batch (convergence-safe
+elastic training). Candidates are built by scaling each micro-batch (and
+their LCM) by highly composite numbers — maximally divisor-rich, hence
+maximally elastic.
+
+v0.2 operates at node granularity (whole TPU hosts) with model-parallel
+awareness: valid world sizes are multiples of chips-per-node, and MP shrinks
+the effective data-parallel width per node.
+
+The reference's ``DSElasticAgent`` (torch-elastic subclass managing worker
+restarts) has no TPU analog — restart orchestration belongs to the cluster
+scheduler (GKE/xmanager); the scheduler calls ``compute_elastic_config`` to
+pick compatible slice sizes, and resume correctness comes from the
+universal checkpoint (any→any) path.
+"""
+
+import math
+from functools import reduce
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize, LATEST_ELASTICITY_VERSION)
+
+# Smallest highly composite numbers — divisor-count record holders. Enough to
+# cover global batches into the ~700k range (reference elasticity.py:21).
+_HCN = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280, 720720
+]
+
+
+def _lcm(nums: List[int]) -> int:
+    return reduce(math.lcm, nums)
+
+
+def _largest_hcn_multiple(base: int, limit: int) -> int:
+    """base × (largest HCN keeping the product ≤ limit)."""
+    if base >= limit:
+        return base
+    q = limit // base
+    best = 1
+    for h in _HCN:
+        if h > q:
+            break
+        best = h
+    return base * best
+
+
+def _candidate_batch_sizes(micro_batches: List[int], max_batch: int) -> List[int]:
+    bases = list(micro_batches) + [_lcm(micro_batches)]
+    return sorted({_largest_hcn_multiple(b, max_batch) for b in bases})
+
+
+def _valid_chip_counts(batch_size: int, micro_batches: List[int], lo: int, hi: int) -> List[int]:
+    """All chip counts w in [lo, hi] such that some micro-batch divides
+    batch_size/w evenly (i.e. gas = batch/(mb·w) is a positive integer)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        per_mb_chips = batch_size // mb
+        # every divisor of per_mb_chips is a workable world size
+        for d in range(1, int(math.isqrt(per_mb_chips)) + 1):
+            if per_mb_chips % d == 0:
+                for w in (d, per_mb_chips // d):
+                    if lo <= w <= hi:
+                        valid.add(w)
+    return sorted(valid)
+
+
+def get_compatible_chip_counts(micro_batches: List[int],
+                               max_batch: int,
+                               min_chips: int = 1,
+                               max_chips: Optional[int] = None,
+                               prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """v0.1 core (reference _get_compatible_gpus_v01): choose the candidate
+    batch with the most valid chip counts; ties break toward the larger
+    (or smaller) batch per prefer_larger."""
+    if max_chips is None:
+        max_chips = max_batch // min(micro_batches)
+    bad = [m for m in micro_batches if m > max_batch]
+    if bad:
+        raise ElasticityError(f"micro batches {bad} exceed max batch size {max_batch}")
+
+    best_batch, best_valid = min(micro_batches), []
+    for cand in _candidate_batch_sizes(micro_batches, max_batch):
+        valid = _valid_chip_counts(cand, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid) and
+            (cand > best_batch if prefer_larger else cand < best_batch))
+        if better:
+            best_batch, best_valid = cand, valid
+    return best_batch, best_valid
+
+
+def _node_level_config(cfg: ElasticityConfig, current_chips: int):
+    """v0.2 (reference _get_compatible_gpus_v02): node-granular scaling with
+    model parallelism folded out of the dp width."""
+    cpn = cfg.num_gpus_per_node
+    if cpn % cfg.model_parallel_size != 0:
+        raise ElasticityError(f"chips per node {cpn} must be divisible by "
+                              f"model_parallel_size {cfg.model_parallel_size}")
+    dp_per_node = cpn // cfg.model_parallel_size
+
+    batch, node_counts = get_compatible_chip_counts(
+        cfg.micro_batches, cfg.max_acceptable_batch_size // dp_per_node,
+        max(1, cfg.min_gpus // cpn), max(1, cfg.max_gpus // cpn),
+        prefer_larger=cfg.prefer_larger_batch_size)
+    batch *= dp_per_node
+    valid_dp = [n * dp_per_node for n in node_counts]
+
+    if current_chips and current_chips // cfg.model_parallel_size not in valid_dp:
+        # fall back: keep the current topology, take the biggest batch it fits
+        cur_dp = (current_chips // cpn) * dp_per_node
+        cands = [mb * cur_dp * (cfg.max_acceptable_batch_size // (mb * cur_dp))
+                 for mb in cfg.micro_batches if mb * cur_dp <= cfg.max_acceptable_batch_size]
+        if not cands:
+            raise ElasticityIncompatibleWorldSize(
+                f"no batch fits world size {current_chips} under "
+                f"{cfg.max_acceptable_batch_size}")
+        batch = max(cands) if cfg.prefer_larger_batch_size else min(cands)
+        valid_dp = [cur_dp]
+    return batch, valid_dp
+
+
+def _pick_micro_batch(cfg: ElasticityConfig, batch: int, dp_world: int) -> Optional[int]:
+    """Largest (or smallest) allowed micro-batch dividing the per-chip batch
+    (reference get_microbatch, elasticity.py:146)."""
+    fitting = [mb for mb in cfg.micro_batches if (batch // dp_world) % mb == 0]
+    if not fitting:
+        return None
+    return max(fitting) if cfg.prefer_larger_batch_size else min(fitting)
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    """Reference elasticity.py:202."""
+    return ds_config.get("elasticity", {}).get("enabled", False)
+
+
+def compute_elastic_config(ds_config: dict,
+                           target_deepspeed_version: str = None,
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference elasticity.py:233 — deterministic (batch, valid chip counts
+    [, micro_batch]) from the elasticity config block. Called by both the
+    cluster scheduler (to pick slice sizes) and the runtime (to derive gas)."""
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"Expected ds_config dict, got {type(ds_config)}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError("'elasticity' is missing from config json")
+    cfg = ElasticityConfig(ds_config["elasticity"])
+    if not cfg.enabled:
+        raise ElasticityConfigError("Elasticity is disabled ('enabled': false)")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {cfg.version} > supported {LATEST_ELASTICITY_VERSION}")
+    if cfg.model_parallel_size > 1 and cfg.version < 0.2:
+        raise ElasticityConfigError(
+            f"elasticity v{cfg.version} does not support model parallelism")
+
+    if cfg.version >= 0.2:
+        batch, valid = _node_level_config(cfg, world_size)
+    else:
+        batch, valid = get_compatible_chip_counts(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, cfg.min_gpus, cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size)
+
+    if world_size > 0:
+        dp = world_size // cfg.model_parallel_size
+        if dp not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} (dp={dp}) not in valid set {valid}")
+    logger.info(f"elastic config: batch={batch}, valid chip counts={valid}")
+
+    if return_microbatch:
+        dp = (world_size or valid[-1]) // cfg.model_parallel_size
+        return batch, valid, _pick_micro_batch(cfg, batch, dp)
+    return batch, valid

@@ -525,6 +525,26 @@ class DeepSpeedTpuEngine:
         self.checkpoint_engine.commit(tag)
         return True
 
+    def load_universal_checkpoint(self, universal_dir):
+        """Resume from a universal checkpoint at ANY parallelism (reference
+        bf16_optimizer.py:519 load_hp_checkpoint_state / universal_checkpoint
+        config flag): fp32 fragments are re-laid-out onto the live mesh's
+        shardings regardless of what topology wrote them."""
+        from ..checkpoint.universal import load_universal_into
+        params_host = jax.tree_util.tree_map(lambda x: np.zeros(x.shape, jnp.float32),
+                                             jax.eval_shape(lambda p: p, self.params))
+        params, opt_state, meta = load_universal_into(universal_dir, params_host,
+                                                      self.opt_state)
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32), params),
+            self.param_shardings)
+        if opt_state is not None:
+            self.opt_state = jax.device_put(opt_state, self.opt_state_shardings)
+        self.global_steps = meta.get("step", 0)
+        log_dist(f"loaded universal checkpoint {universal_dir} at step {self.global_steps}",
+                 ranks=[0])
+        return universal_dir, {}
+
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):

@@ -1,0 +1,89 @@
+"""Elasticity tests — parity targets: reference
+``tests/unit/elasticity/test_elastic.py`` (the canonical base-config cases)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, elasticity_enabled,
+                                      ElasticityConfigError, ElasticityIncompatibleWorldSize,
+                                      get_compatible_chip_counts)
+
+
+def base_config(**over):
+    el = {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+    el.update(over)
+    return {"elasticity": el}
+
+
+class TestElasticityMath:
+
+    def test_basic_10k(self):
+        batch, valid = compute_elastic_config(base_config())
+        assert batch <= 10000
+        # every valid chip count divides the batch with an allowed micro-batch
+        for w in valid:
+            assert any(batch % (mb * w) == 0 for mb in [8, 12, 16, 17])
+        assert all(32 <= w <= 1500 for w in valid)
+        assert len(valid) > 20  # highly-composite → rich valid set
+
+    def test_deterministic(self):
+        a = compute_elastic_config(base_config())
+        b = compute_elastic_config(base_config())
+        assert a == b
+
+    def test_world_size_valid(self):
+        batch, valid, micro = compute_elastic_config(base_config(), world_size=64,
+                                                     return_microbatch=True)
+        assert 64 in valid
+        assert micro in [8, 12, 16, 17]
+        assert batch % (micro * 64) == 0
+
+    def test_world_size_invalid_raises(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(base_config(micro_batch_sizes=[8, 16]), world_size=67)
+
+    def test_disabled_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_config(enabled=False))
+
+    def test_missing_block_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({})
+
+    def test_future_version_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_config(version=0.3))
+
+    def test_mp_needs_v02(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_config(model_parallel_size=4))
+
+    def test_enabled_probe(self):
+        assert elasticity_enabled(base_config())
+        assert not elasticity_enabled({})
+
+    def test_v02_node_granularity(self):
+        cfg = base_config(version=0.2, num_gpus_per_node=4, min_gpus=4, max_gpus=256)
+        batch, valid = compute_elastic_config(cfg)
+        assert all(w % 4 == 0 for w in valid)  # whole nodes only
+
+    def test_v02_model_parallel(self):
+        cfg = base_config(version=0.2, num_gpus_per_node=8, model_parallel_size=2,
+                          min_gpus=8, max_gpus=512, micro_batch_sizes=[2, 4])
+        batch, valid, micro = compute_elastic_config(cfg, world_size=16,
+                                                     return_microbatch=True)
+        # dp width = 16/2 = 8 must be valid and micro-batch consistent
+        assert 8 in valid
+        assert micro in [2, 4]
+
+    def test_chip_count_core(self):
+        batch, valid = get_compatible_chip_counts([2, 4], 100, 1, 100)
+        for w in valid:
+            assert any(batch % (mb * w) == 0 for mb in [2, 4])
