@@ -1,0 +1,219 @@
+// Async file IO library for NVMe tensor swapping (DeepNVMe equivalent).
+//
+// Reference: csrc/aio/py_lib/deepspeed_aio_thread.cpp (libaio thread pool) +
+// deepspeed_py_io_handle.cpp. TPU rebuild: the device side is XLA's job;
+// what the host needs is exactly this — a C++ thread pool draining a
+// submission queue of pread/pwrite requests against NVMe, with optional
+// O_DIRECT (page-aligned bounce buffers per worker), exposed through a C ABI
+// consumed via ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread ds_aio.cpp -o libds_aio.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdlib.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kAlign = 4096;  // O_DIRECT sector alignment
+
+struct Request {
+    long id;
+    bool is_read;
+    std::string path;
+    char* buf;
+    size_t nbytes;
+    long offset;
+};
+
+struct Completion {
+    long bytes_or_negerrno;
+};
+
+class AioHandle {
+public:
+    AioHandle(int n_threads, size_t block_size, bool use_o_direct)
+        : block_size_(align_up(block_size ? block_size : (1 << 20))),
+          o_direct_(use_o_direct),
+          next_id_(1),
+          stop_(false) {
+        for (int i = 0; i < (n_threads > 0 ? n_threads : 1); ++i) {
+            workers_.emplace_back([this] { this->worker(); });
+        }
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : workers_) t.join();
+    }
+
+    long submit(bool is_read, const char* path, void* buf, size_t nbytes, long offset) {
+        std::lock_guard<std::mutex> lk(mu_);
+        long id = next_id_++;
+        queue_.push_back(Request{id, is_read, path, static_cast<char*>(buf), nbytes, offset});
+        inflight_++;
+        cv_.notify_one();
+        return id;
+    }
+
+    // Blocks until request `id` completes; returns bytes transferred or -errno.
+    long wait(long id) {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [&] { return completed_.count(id) > 0; });
+        long r = completed_[id].bytes_or_negerrno;
+        completed_.erase(id);
+        return r;
+    }
+
+    // Drains everything submitted so far; returns 0 or first -errno seen.
+    long wait_all() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [&] { return inflight_ == 0; });
+        long rc = 0;
+        for (auto& kv : completed_) {
+            if (kv.second.bytes_or_negerrno < 0 && rc == 0) rc = kv.second.bytes_or_negerrno;
+        }
+        completed_.clear();
+        return rc;
+    }
+
+private:
+    void worker() {
+        // one aligned bounce buffer per worker for the O_DIRECT path
+        char* bounce = nullptr;
+        if (o_direct_) {
+            if (posix_memalign(reinterpret_cast<void**>(&bounce), kAlign,
+                               align_up(block_size_)) != 0) {
+                bounce = nullptr;
+            }
+        }
+        for (;;) {
+            Request req;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+                if (stop_ && queue_.empty()) break;
+                req = queue_.front();
+                queue_.pop_front();
+            }
+            long rc = execute(req, bounce);
+            {
+                std::lock_guard<std::mutex> lk(mu_);
+                completed_[req.id] = Completion{rc};
+                inflight_--;
+            }
+            done_cv_.notify_all();
+        }
+        free(bounce);
+    }
+
+    static size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+    long execute(const Request& req, char* bounce) {
+        int flags = req.is_read ? O_RDONLY : (O_WRONLY | O_CREAT);
+        // O_DIRECT needs sector-aligned offsets; block_size_ is aligned so
+        // per-chunk offsets stay aligned iff the base offset is
+        bool direct = o_direct_ && bounce != nullptr && (req.offset % kAlign) == 0;
+        if (direct) flags |= O_DIRECT;
+        int fd = open(req.path.c_str(), flags, 0644);
+        if (fd < 0 && direct) {  // filesystem may refuse O_DIRECT (e.g. tmpfs)
+            direct = false;
+            flags &= ~O_DIRECT;
+            fd = open(req.path.c_str(), flags, 0644);
+        }
+        if (fd < 0) return -errno;
+
+        size_t done = 0;
+        long rc = 0;
+        while (done < req.nbytes) {
+            size_t chunk = std::min(block_size_, req.nbytes - done);
+            ssize_t n;
+            if (req.is_read) {
+                if (direct) {
+                    // aligned read through the bounce buffer, then copy out
+                    size_t aligned = align_up(chunk);
+                    n = pread(fd, bounce, aligned, req.offset + done);
+                    if (n > 0) {
+                        size_t usable = std::min(static_cast<size_t>(n), chunk);
+                        memcpy(req.buf + done, bounce, usable);
+                        n = usable;
+                    }
+                } else {
+                    n = pread(fd, req.buf + done, chunk, req.offset + done);
+                }
+            } else {
+                if (direct && align_up(chunk) == chunk &&
+                    ((req.offset + done) % kAlign) == 0) {
+                    memcpy(bounce, req.buf + done, chunk);
+                    n = pwrite(fd, bounce, chunk, req.offset + done);
+                } else {
+                    // unaligned tail: fall back to buffered write
+                    int f2 = open(req.path.c_str(), O_WRONLY | O_CREAT, 0644);
+                    n = (f2 < 0) ? -1 : pwrite(f2, req.buf + done, chunk, req.offset + done);
+                    if (f2 >= 0) close(f2);
+                }
+            }
+            if (n < 0) {
+                rc = -errno;
+                break;
+            }
+            if (n == 0) break;  // EOF
+            done += static_cast<size_t>(n);
+        }
+        close(fd);
+        return rc < 0 ? rc : static_cast<long>(done);
+    }
+
+    size_t block_size_;
+    bool o_direct_;
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::condition_variable done_cv_;
+    std::deque<Request> queue_;
+    std::unordered_map<long, Completion> completed_;
+    long next_id_;
+    size_t inflight_ = 0;
+    bool stop_;
+    std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_handle_new(int n_threads, long block_size, int use_o_direct) {
+    return new AioHandle(n_threads, static_cast<size_t>(block_size), use_o_direct != 0);
+}
+
+void ds_aio_handle_free(void* h) { delete static_cast<AioHandle*>(h); }
+
+long ds_aio_submit_read(void* h, const char* path, void* buf, long nbytes, long offset) {
+    return static_cast<AioHandle*>(h)->submit(true, path, buf, static_cast<size_t>(nbytes), offset);
+}
+
+long ds_aio_submit_write(void* h, const char* path, void* buf, long nbytes, long offset) {
+    return static_cast<AioHandle*>(h)->submit(false, path, buf, static_cast<size_t>(nbytes),
+                                              offset);
+}
+
+long ds_aio_wait(void* h, long req_id) { return static_cast<AioHandle*>(h)->wait(req_id); }
+
+long ds_aio_wait_all(void* h) { return static_cast<AioHandle*>(h)->wait_all(); }
+
+}  // extern "C"

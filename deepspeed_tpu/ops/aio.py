@@ -1,0 +1,185 @@
+"""Async NVMe IO — ctypes binding over the C++ thread-pool library.
+
+Reference: ``op_builder/async_io.py`` (AsyncIOBuilder, jit_load) +
+``csrc/aio/py_lib``. The builder compiles ``csrc/aio/ds_aio.cpp`` with g++ at
+first use into a cached shared object (the jit_load analog —
+``op_builder/builder.py:535``), binds it via ctypes (no pybind11 in the
+image), and falls back to a pure-Python thread pool when no toolchain is
+available, mirroring the reference's compatibility-probe behavior
+(``async_io.py is_compatible``).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .registry import registry
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "aio", "ds_aio.cpp")
+_BUILD_DIR = os.environ.get("DS_TPU_BUILD_DIR",
+                            os.path.join(_REPO_ROOT, "build", "lib"))
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _jit_load() -> Optional[ctypes.CDLL]:
+    """Compile-if-stale then dlopen (reference builder.py:535 jit_load)."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so_path = os.path.join(_BUILD_DIR, "libds_aio.so")
+        try:
+            if (not os.path.exists(so_path)
+                    or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                       _SRC, "-o", so_path]
+                subprocess.run(cmd, check=True, capture_output=True)
+                logger.info(f"built {so_path}")
+            lib = ctypes.CDLL(so_path)
+            lib.ds_aio_handle_new.restype = ctypes.c_void_p
+            lib.ds_aio_handle_new.argtypes = [ctypes.c_int, ctypes.c_long, ctypes.c_int]
+            lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+            for fn in (lib.ds_aio_submit_read, lib.ds_aio_submit_write):
+                fn.restype = ctypes.c_long
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                               ctypes.c_long, ctypes.c_long]
+            lib.ds_aio_wait.restype = ctypes.c_long
+            lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.ds_aio_wait_all.restype = ctypes.c_long
+            lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+            _lib = lib
+            registry.register("aio", "native", True)
+        except (subprocess.CalledProcessError, OSError) as e:
+            logger.warning(f"ds_aio native build unavailable ({e}); using thread-pool fallback")
+            _build_failed = True
+            registry.register("aio", "fallback", True)
+        return _lib
+
+
+def aio_available() -> bool:
+    """True when the native library is usable (ds_report probe)."""
+    return _jit_load() is not None
+
+
+class AsyncIOHandle:
+    """Submission handle (reference csrc/aio/py_lib/deepspeed_py_io_handle.cpp
+    semantics: submit read/write of a host buffer, wait on completion).
+
+    Buffers must be writable C-contiguous numpy arrays; they are pinned by
+    keeping a reference until wait() — the caller must not resize them.
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 thread_count: int = 4, use_o_direct: bool = False):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        self._inflight: Dict[int, np.ndarray] = {}
+        self._lib = _jit_load()
+        if self._lib is not None:
+            self._h = self._lib.ds_aio_handle_new(thread_count, block_size,
+                                                  1 if use_o_direct else 0)
+            self._pool = None
+        else:
+            self._h = None
+            self._pool = ThreadPoolExecutor(max_workers=thread_count)
+            self._futures = {}
+            self._next_id = 1
+
+    # ---- fallback helpers ----
+
+    def _py_read(self, path, buf, offset):
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(buf.nbytes)
+        flat = buf.reshape(-1).view(np.uint8)
+        flat[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        return len(data)
+
+    def _py_write(self, path, buf, offset):
+        mode = "r+b" if os.path.exists(path) else "wb"
+        with open(path, mode) as f:
+            f.seek(offset)
+            f.write(buf.tobytes())
+        return buf.nbytes
+
+    # ---- public API ----
+
+    def submit_read(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"] and buffer.flags["WRITEABLE"]
+        if self._h is not None:
+            rid = self._lib.ds_aio_submit_read(
+                self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
+                buffer.nbytes, offset)
+        else:
+            rid = self._next_id
+            self._next_id += 1
+            self._futures[rid] = self._pool.submit(self._py_read, path, buffer, offset)
+        self._inflight[rid] = buffer
+        return rid
+
+    def submit_write(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
+        assert buffer.flags["C_CONTIGUOUS"]
+        if self._h is not None:
+            rid = self._lib.ds_aio_submit_write(
+                self._h, path.encode(), buffer.ctypes.data_as(ctypes.c_void_p),
+                buffer.nbytes, offset)
+        else:
+            rid = self._next_id
+            self._next_id += 1
+            self._futures[rid] = self._pool.submit(self._py_write, path, buffer, offset)
+        self._inflight[rid] = buffer
+        return rid
+
+    def wait(self, request_id: int) -> int:
+        """Bytes transferred; raises OSError on IO failure."""
+        if self._h is not None:
+            rc = self._lib.ds_aio_wait(self._h, request_id)
+        else:
+            rc = self._futures.pop(request_id).result()
+        self._inflight.pop(request_id, None)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+    def wait_all(self) -> None:
+        if self._h is not None:
+            rc = self._lib.ds_aio_wait_all(self._h)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc))
+        else:
+            for rid in list(self._futures):
+                self.wait(rid)
+        self._inflight.clear()
+
+    # sync conveniences (reference sync_pread/sync_pwrite)
+    def pread(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
+        return self.wait(self.submit_read(path, buffer, offset))
+
+    def pwrite(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
+        return self.wait(self.submit_write(path, buffer, offset))
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ds_aio_handle_free(self._h)
+            self._h = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

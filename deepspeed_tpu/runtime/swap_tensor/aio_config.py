@@ -1,0 +1,19 @@
+"""AIO/swap configuration (reference ``runtime/swap_tensor/aio_config.py`` +
+``constants.py`` AIO block). Same JSON keys."""
+
+from pydantic import Field
+
+from ...config.config_utils import ConfigModel
+
+
+class AioConfig(ConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 32
+    thread_count: int = 4
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False  # accepted for config parity; no GDS analog on TPU
+
+
+def get_aio_config(param_dict: dict) -> AioConfig:
+    return AioConfig(**param_dict.get("aio", {}))

@@ -1,0 +1,56 @@
+"""Generic async tensor swapper.
+
+Reference: ``runtime/swap_tensor/async_swapper.py:19 AsyncTensorSwapper`` —
+fire-and-forget swap-out of host buffers through the AIO handle, with a
+synchronization barrier. The reference cycles pinned CUDA buffers; here the
+"pinned" pool is plain page-aligned numpy (TPU host memory is the staging
+tier — device→host already happened via np.asarray / jax.device_get).
+"""
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+from ...utils.logging import logger
+from .aio_config import AioConfig
+
+
+class AsyncTensorSwapper:
+
+    def __init__(self, aio_handle: Optional[AsyncIOHandle] = None,
+                 aio_config: Optional[AioConfig] = None):
+        cfg = aio_config or AioConfig()
+        self.aio = aio_handle or AsyncIOHandle(block_size=cfg.block_size,
+                                               queue_depth=cfg.queue_depth,
+                                               thread_count=cfg.thread_count)
+        self._pending_writes: List[int] = []
+        self._pending_reads: Dict[str, Tuple[int, np.ndarray]] = {}
+        self.swapped_bytes = 0
+
+    def swap_out_tensors(self, path_tensor_pairs: List[Tuple[str, np.ndarray]]) -> None:
+        """Async write; caller must keep arrays alive until synchronize (the
+        handle holds a ref as well)."""
+        for path, arr in path_tensor_pairs:
+            arr = np.ascontiguousarray(arr)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._pending_writes.append(self.aio.submit_write(path, arr))
+            self.swapped_bytes += arr.nbytes
+
+    def swap_in_tensors(self, path_buffer_pairs: List[Tuple[str, np.ndarray]]) -> None:
+        for path, buf in path_buffer_pairs:
+            self._pending_reads[path] = (self.aio.submit_read(path, buf), buf)
+
+    def synchronize_writes(self) -> None:
+        for rid in self._pending_writes:
+            self.aio.wait(rid)
+        self._pending_writes.clear()
+
+    def synchronize_reads(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for path, (rid, buf) in self._pending_reads.items():
+            self.aio.wait(rid)
+            out[path] = buf
+        self._pending_reads.clear()
+        return out

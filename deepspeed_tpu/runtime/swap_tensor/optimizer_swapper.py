@@ -1,0 +1,62 @@
+"""Optimizer-state NVMe swapping.
+
+Reference: ``runtime/swap_tensor/partitioned_optimizer_swapper.py`` (swap
+state in around each sub-group's optimizer step) and
+``pipelined_optimizer_swapper.py`` (overlap next sub-group's read + previous
+sub-group's write with the current step — double buffering). The TPU engine
+steps sub-groups of the optimizer pytree; these classes provide the same
+swap-in → step → swap-out choreography over host numpy state.
+"""
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+from .aio_config import AioConfig
+from .partitioned_param_swapper import AsyncPartitionedParameterSwapper
+
+
+class OptimizerSwapper:
+    """Blocking variant (reference partitioned_optimizer_swapper.py)."""
+
+    def __init__(self, aio_config: Optional[AioConfig] = None,
+                 swap_folder: str = "/tmp/ds_tpu_nvme_swap_optim"):
+        self._swapper = AsyncPartitionedParameterSwapper(aio_config, swap_folder)
+
+    def swap_out_optimizer_state(self, group_name: str, state: Dict[str, np.ndarray]) -> None:
+        for key, arr in state.items():
+            self._swapper.swap_out_and_release(f"{group_name}.{key}", arr)
+        self._swapper.synchronize_writes()
+
+    def swap_in_optimizer_state(self, group_name: str, keys: List[str]) -> Dict[str, np.ndarray]:
+        names = [f"{group_name}.{k}" for k in keys]
+        self._swapper.swap_in(names)
+        return {k: self._swapper.retrieve(n) for k, n in zip(keys, names)}
+
+    def purge(self, group_name: str, keys: List[str]) -> None:
+        for k in keys:
+            self._swapper.remove(f"{group_name}.{k}")
+
+
+class PipelinedOptimizerSwapper(OptimizerSwapper):
+    """Overlapped variant (reference pipelined_optimizer_swapper.py:
+    OVERLAP_SWAP_IN/OUT): prefetch group i+1 while stepping group i; writes
+    drain in the background and only synchronize at the end."""
+
+    def step_groups(self, group_names: List[str], keys: List[str],
+                    step_fn: Callable[[str, Dict[str, np.ndarray]], Dict[str, np.ndarray]]):
+        """Run `step_fn(group, state)->new_state` over every group with
+        IO/compute overlap."""
+        if not group_names:
+            return
+        names = lambda g: [f"{g}.{k}" for k in keys]
+        self._swapper.swap_in(names(group_names[0]), async_op=True)
+        for i, group in enumerate(group_names):
+            if i + 1 < len(group_names):  # prefetch next while current steps
+                self._swapper.swap_in(names(group_names[i + 1]), async_op=True)
+            state = {k: self._swapper.retrieve(n) for k, n in zip(keys, names(group))}
+            new_state = step_fn(group, state)
+            for key, arr in new_state.items():
+                self._swapper.swap_out_and_release(f"{group}.{key}", arr)
+        self._swapper.synchronize_writes()

@@ -1,0 +1,131 @@
+"""NVMe swap + AIO tests (parity targets: reference
+``tests/unit/ops/aio/test_aio.py`` and ``tests/unit/runtime/zero`` swap paths)."""
+
+import os
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available
+from deepspeed_tpu.runtime.swap_tensor import (AioConfig, AsyncTensorSwapper,
+                                               AsyncPartitionedParameterSwapper,
+                                               OptimizerSwapper, PipelinedOptimizerSwapper)
+
+
+class TestAioHandle:
+
+    def test_native_lib_builds(self):
+        # g++ is in the image; the native path must come up
+        assert aio_available()
+
+    def test_write_read_roundtrip(self, tmp_path):
+        h = AsyncIOHandle(block_size=1 << 16, thread_count=2)
+        data = np.random.default_rng(0).normal(size=(1024, )).astype(np.float32)
+        path = str(tmp_path / "blob.bin")
+        assert h.pwrite(path, data) == data.nbytes
+        out = np.empty_like(data)
+        assert h.pread(path, out) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+        h.close()
+
+    def test_async_many_requests(self, tmp_path):
+        h = AsyncIOHandle(block_size=1 << 12, thread_count=4)
+        bufs = [np.full((2048, ), i, dtype=np.int32) for i in range(16)]
+        rids = [h.submit_write(str(tmp_path / f"f{i}.bin"), b) for i, b in enumerate(bufs)]
+        for rid in rids:
+            h.wait(rid)
+        outs = [np.empty((2048, ), dtype=np.int32) for _ in range(16)]
+        rids = [h.submit_read(str(tmp_path / f"f{i}.bin"), o) for i, o in enumerate(outs)]
+        h.wait_all()
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o, bufs[i])
+        h.close()
+
+    def test_offset_io(self, tmp_path):
+        h = AsyncIOHandle(thread_count=1)
+        path = str(tmp_path / "off.bin")
+        h.pwrite(path, np.arange(100, dtype=np.uint8))
+        out = np.empty(10, dtype=np.uint8)
+        h.pread(path, out, offset=50)
+        np.testing.assert_array_equal(out, np.arange(50, 60, dtype=np.uint8))
+        h.close()
+
+    def test_read_missing_file_raises(self, tmp_path):
+        h = AsyncIOHandle(thread_count=1)
+        with pytest.raises((OSError, FileNotFoundError)):
+            h.pread(str(tmp_path / "nope.bin"), np.empty(8, dtype=np.uint8))
+        h.close()
+
+
+class TestTensorSwapper:
+
+    def test_swap_out_in(self, tmp_path):
+        sw = AsyncTensorSwapper(aio_config=AioConfig(thread_count=2))
+        arrs = {f"t{i}": np.random.default_rng(i).normal(size=(64, 64)).astype(np.float32)
+                for i in range(4)}
+        sw.swap_out_tensors([(str(tmp_path / f"{k}.swp"), v) for k, v in arrs.items()])
+        sw.synchronize_writes()
+        bufs = {k: np.empty((64, 64), dtype=np.float32) for k in arrs}
+        sw.swap_in_tensors([(str(tmp_path / f"{k}.swp"), bufs[k]) for k in arrs])
+        sw.synchronize_reads()
+        for k in arrs:
+            np.testing.assert_array_equal(bufs[k], arrs[k])
+
+
+class TestParamSwapper:
+
+    def test_roundtrip_and_dtype(self, tmp_path):
+        sw = AsyncPartitionedParameterSwapper(swap_folder=str(tmp_path))
+        w = np.random.default_rng(1).normal(size=(128, 32)).astype(np.float32)
+        b = np.random.default_rng(2).normal(size=(32, )).astype(np.float16)
+        sw.swap_out_and_release("layer1/w", w)
+        sw.swap_out_and_release("layer1/b", b)
+        sw.synchronize_writes()
+        sw.swap_in(["layer1/w", "layer1/b"])
+        np.testing.assert_array_equal(sw.retrieve("layer1/w"), w)
+        np.testing.assert_array_equal(sw.retrieve("layer1/b"), b)
+
+    def test_write_read_hazard(self, tmp_path):
+        """swap_in immediately after swap_out must see the full write."""
+        sw = AsyncPartitionedParameterSwapper(swap_folder=str(tmp_path))
+        w = np.random.default_rng(3).normal(size=(1 << 16, )).astype(np.float32)
+        sw.swap_out_and_release("p", w)
+        sw.swap_in(["p"])  # no synchronize_writes in between
+        np.testing.assert_array_equal(sw.retrieve("p"), w)
+
+    def test_remove(self, tmp_path):
+        sw = AsyncPartitionedParameterSwapper(swap_folder=str(tmp_path))
+        sw.swap_out_and_release("x", np.zeros(16, dtype=np.float32))
+        sw.synchronize_writes()
+        assert "x" in sw.swapped_names
+        sw.remove("x")
+        assert "x" not in sw.swapped_names
+        assert not any(os.scandir(tmp_path))
+
+
+class TestOptimizerSwapper:
+
+    def test_blocking_roundtrip(self, tmp_path):
+        sw = OptimizerSwapper(swap_folder=str(tmp_path))
+        state = {"exp_avg": np.ones((32, 32), np.float32),
+                 "exp_avg_sq": np.full((32, 32), 2.0, np.float32)}
+        sw.swap_out_optimizer_state("g0", state)
+        back = sw.swap_in_optimizer_state("g0", ["exp_avg", "exp_avg_sq"])
+        np.testing.assert_array_equal(back["exp_avg"], state["exp_avg"])
+        np.testing.assert_array_equal(back["exp_avg_sq"], state["exp_avg_sq"])
+
+    def test_pipelined_step_groups(self, tmp_path):
+        sw = PipelinedOptimizerSwapper(swap_folder=str(tmp_path))
+        groups = [f"g{i}" for i in range(4)]
+        for g in groups:
+            sw.swap_out_optimizer_state(g, {"m": np.zeros(1024, np.float32)})
+        stepped = []
+
+        def step_fn(group, state):
+            stepped.append(group)
+            return {"m": state["m"] + 1.0}
+
+        sw.step_groups(groups, ["m"], step_fn)
+        assert stepped == groups
+        for g in groups:  # every group's state advanced exactly once
+            m = sw.swap_in_optimizer_state(g, ["m"])["m"]
+            np.testing.assert_array_equal(m, np.ones(1024, np.float32))
