@@ -1,0 +1,2 @@
+from .config import AutotuningConfig
+from .autotuner import Autotuner

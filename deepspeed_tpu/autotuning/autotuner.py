@@ -1,0 +1,186 @@
+"""Autotuner — searches micro-batch size × ZeRO stage × remat policy.
+
+Reference: ``deepspeed/autotuning/autotuner.py:42 Autotuner`` +
+``scheduler.py:32 ResourceManager`` + ``tuner/{grid_search,random,
+model_based}``. The reference forks whole training jobs per experiment over
+the launcher; on TPU (single-controller SPMD) each experiment is an
+in-process engine build + a few timed steps — the search logic and result
+layout carry over, the multi-node experiment scheduler collapses away.
+
+Search space (reference tune_space): ZeRO stage ∈ {0,1,2,3}, micro-batch ∈
+powers of two up to the HBM ceiling (OOM candidates are caught and marked
+infeasible, the reference's "error" exp status), remat on/off. Metric:
+latency | throughput | flops (reference autotuning config metric).
+"""
+
+import itertools
+import json
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..utils.logging import logger
+from .config import AutotuningConfig
+
+
+class _Experiment:
+
+    def __init__(self, exp_id: int, config: Dict[str, Any]):
+        self.exp_id = exp_id
+        self.config = config
+        self.status = "pending"  # pending | done | error
+        self.metric_val: Optional[float] = None
+        self.error: Optional[str] = None
+
+    def record(self) -> Dict[str, Any]:
+        return {"exp_id": self.exp_id, "config": self.config, "status": self.status,
+                "metric_val": self.metric_val, "error": self.error}
+
+
+class Autotuner:
+
+    def __init__(self, base_config: Dict[str, Any],
+                 tuning_config: Optional[AutotuningConfig] = None,
+                 model_builder: Optional[Callable] = None):
+        """model_builder() -> (model, params); each experiment builds a fresh
+        engine from base_config overridden with the candidate's knobs."""
+        self.base_config = dict(base_config)
+        self.cfg = tuning_config or AutotuningConfig(
+            **base_config.get("autotuning", {"enabled": True}))
+        self.model_builder = model_builder
+        self.exps: List[_Experiment] = []
+        self.best: Optional[_Experiment] = None
+
+    # ---- search space (reference _generate_experiments) ----
+
+    def _micro_batch_candidates(self) -> List[int]:
+        lo = max(1, self.cfg.min_train_micro_batch_size_per_gpu)
+        hi = self.cfg.max_train_micro_batch_size_per_gpu or lo * 16
+        out, mb = [], lo
+        while mb <= hi and len(out) < self.cfg.num_tuning_micro_batch_sizes:
+            out.append(mb)
+            mb *= 2
+        return out
+
+    def _zero_candidates(self) -> List[int]:
+        if self.cfg.zero_stages:
+            return list(self.cfg.zero_stages)
+        return [0, 1, 2, 3]
+
+    def experiment_space(self) -> List[Dict[str, Any]]:
+        space = []
+        for mb, stage, remat in itertools.product(
+                self._micro_batch_candidates(), self._zero_candidates(), [False, True]):
+            space.append({"train_micro_batch_size_per_gpu": mb,
+                          "zero_stage": stage, "remat": remat})
+        return space
+
+    # ---- tuner orderings (reference tuner/{grid_search,random,model_based}) ----
+
+    def _order(self, space: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        kind = self.cfg.tuner_type
+        if kind == "random":
+            rng = random.Random(0)
+            space = list(space)
+            rng.shuffle(space)
+            return space
+        if kind == "model_based":
+            # cheap surrogate: larger micro-batch and lower stage first
+            # (higher predicted throughput), refine from measurements
+            return sorted(space, key=lambda c: (-c["train_micro_batch_size_per_gpu"],
+                                                c["zero_stage"], c["remat"]))
+        return space  # gridsearch
+
+    # ---- experiment runner (reference scheduler.run_job, in-process) ----
+
+    def _run_experiment(self, exp: _Experiment, steps: int) -> None:
+        import deepspeed_tpu
+        from ..comm.mesh import reset_mesh_context
+        import jax.numpy as jnp
+        import numpy as np
+
+        cand = exp.config
+        cfg = json.loads(json.dumps(self.base_config))  # deep copy; exps must not alias
+        cfg.pop("autotuning", None)
+        mb = cand["train_micro_batch_size_per_gpu"]
+        cfg["train_micro_batch_size_per_gpu"] = mb
+        cfg.pop("train_batch_size", None)
+        cfg["gradient_accumulation_steps"] = cfg.get("gradient_accumulation_steps", 1)
+        cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
+        if cand["remat"]:
+            cfg["activation_checkpointing"] = {"remat_policy": "nothing_saveable"}
+        try:
+            reset_mesh_context()
+            model, params = self.model_builder()
+            engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                  config=cfg)
+            hidden = np.asarray(jax.tree_util.tree_leaves(params)[0]).shape[0]
+            bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+            x = jnp.ones((bs, hidden), jnp.float32)
+            y = jnp.zeros_like(x)
+            # warmup (compile), then timed steps
+            loss = engine.forward(x, y)
+            engine.backward(loss)
+            engine.step()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.forward(x, y)
+                engine.backward(loss)
+                engine.step()
+            float(loss)  # host sync closes the timing region
+            dt = (time.perf_counter() - t0) / steps
+            if self.cfg.metric == "latency":
+                exp.metric_val = -dt  # maximize
+            else:  # throughput (samples/s); flops metric folds into this rank
+                exp.metric_val = engine.train_batch_size() / dt
+            exp.status = "done"
+        except Exception as e:  # infeasible config (OOM etc.)
+            exp.status = "error"
+            exp.error = f"{type(e).__name__}: {e}"
+
+    # ---- main loop (reference autotuner.tune) ----
+
+    def tune(self, steps: int = 3) -> Optional[Dict[str, Any]]:
+        assert self.model_builder is not None, "model_builder is required to tune"
+        space = self._order(self.experiment_space())
+        space = space[:self.cfg.tuner_num_trials]
+        stagnant = 0
+        for i, cand in enumerate(space):
+            exp = _Experiment(i, cand)
+            self.exps.append(exp)
+            self._run_experiment(exp, steps)
+            if exp.status == "done" and (self.best is None
+                                         or exp.metric_val > self.best.metric_val):
+                self.best = exp
+                stagnant = 0
+            else:
+                stagnant += 1
+            logger.info(f"autotune exp {i}: {cand} -> {exp.status} "
+                        f"metric={exp.metric_val}")
+            if stagnant >= self.cfg.tuner_early_stopping:
+                logger.info("autotune early stopping")
+                break
+        self._write_results()
+        return None if self.best is None else self.best.config
+
+    def _write_results(self) -> None:
+        os.makedirs(self.cfg.results_dir, exist_ok=True)
+        with open(os.path.join(self.cfg.results_dir, "exps.json"), "w") as f:
+            json.dump([e.record() for e in self.exps], f, indent=2)
+        if self.best is not None:
+            with open(os.path.join(self.cfg.results_dir, "best.json"), "w") as f:
+                json.dump(self.best.record(), f, indent=2)
+
+    def get_best_space_records(self) -> Dict[str, Any]:
+        """Reference get_best_space_records: per-stage best."""
+        per_stage: Dict[str, Any] = {}
+        for e in self.exps:
+            if e.status != "done":
+                continue
+            key = f"z{e.config['zero_stage']}"
+            if key not in per_stage or e.metric_val > per_stage[key]["metric_val"]:
+                per_stage[key] = e.record()
+        return per_stage

@@ -1,0 +1,26 @@
+"""Autotuning config (reference ``deepspeed/autotuning/config.py``
+DeepSpeedAutotuningConfig — same JSON keys)."""
+
+from typing import List, Optional
+
+from ..config.config_utils import ConfigModel
+
+
+class AutotuningConfig(ConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    metric: str = "throughput"  # latency | throughput | flops
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    tuner_type: str = "gridsearch"  # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: Optional[int] = None
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    zero_stages: Optional[List[int]] = None  # restrict search space

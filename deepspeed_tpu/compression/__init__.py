@@ -1,0 +1,4 @@
+from .compress import init_compression, redundancy_clean, CompressionTransform
+from .basic_layer import (quantize_weight_ste, quantize_activation, prune_magnitude,
+                          prune_rows, prune_heads, prune_channels)
+from .scheduler import CompressionScheduler

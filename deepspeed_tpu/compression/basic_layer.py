@@ -1,0 +1,95 @@
+"""Compression primitives — quantization-aware training + pruning, functional.
+
+Reference: ``deepspeed/compression/basic_layer.py`` (LinearLayer_Compress &
+friends: wrapper modules that fake-quantize/mask weights in forward) and
+``utils.py`` (TopKBinarizer, SymQuantizer...). The torch design wraps
+modules; the TPU design is pure functions applied to param leaves inside the
+jitted loss — straight-through estimators (STE) via
+``x + stop_gradient(q(x) - x)`` so the compression is differentiable-through
+and fuses into the XLA step (no wrapper-module overhead).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x, qx):
+    """Straight-through: forward sees qx, gradient flows to x."""
+    return x + jax.lax.stop_gradient(qx - x)
+
+
+def quantize_weight_ste(w, bits: int = 8, symmetric: bool = True,
+                        per_channel: bool = True):
+    """Fake-quantize weights for QAT (reference SymQuantizer/AsymQuantizer in
+    compression/utils.py; LinearLayer_Compress weight path)."""
+    axis = tuple(range(w.ndim - 1)) if per_channel and w.ndim >= 2 else None
+    if symmetric:
+        qmax = 2.0**(bits - 1) - 1
+        scale = jnp.max(jnp.abs(w), axis=axis, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax) * scale
+    else:
+        qmax = 2.0**bits - 1
+        lo = jnp.min(w, axis=axis, keepdims=True)
+        hi = jnp.max(w, axis=axis, keepdims=True)
+        scale = jnp.maximum((hi - lo) / qmax, 1e-8)
+        q = (jnp.clip(jnp.round((w - lo) / scale), 0, qmax)) * scale + lo
+    return _ste(w, q)
+
+
+def quantize_activation(x, bits: int = 8, symmetric: bool = True):
+    """Activation fake-quant (reference activation_quantization; dynamic
+    range per tensor)."""
+    return quantize_weight_ste(x, bits=bits, symmetric=symmetric, per_channel=False)
+
+
+def prune_magnitude(w, ratio: float, method: str = "l1"):
+    """Unstructured sparse pruning mask by |w| (reference sparse_pruning
+    method l1/topk: keep the largest (1-ratio) fraction)."""
+    if ratio <= 0:
+        return w
+    k = int(w.size * (1.0 - ratio))
+    if k <= 0:
+        return jnp.zeros_like(w)
+    flat = jnp.abs(w).reshape(-1)
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(w) >= thresh).astype(w.dtype)
+    return _ste(w, w * mask)
+
+
+def prune_rows(w, ratio: float):
+    """Structured row pruning (reference row_pruning): zero the lowest-L1
+    output rows of a [in, out] kernel."""
+    if ratio <= 0 or w.ndim < 2:
+        return w
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))  # per output col
+    k = max(1, int(norms.size * (1.0 - ratio)))
+    thresh = jax.lax.top_k(norms, k)[0][-1]
+    mask = (norms >= thresh).astype(w.dtype)
+    return _ste(w, w * mask)
+
+
+def prune_channels(w, ratio: float):
+    """Structured input-channel pruning (reference channel_pruning)."""
+    if ratio <= 0 or w.ndim < 2:
+        return w
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))  # per input row
+    k = max(1, int(norms.size * (1.0 - ratio)))
+    thresh = jax.lax.top_k(norms, k)[0][-1]
+    mask = (norms >= thresh).astype(w.dtype).reshape((-1, ) + (1, ) * (w.ndim - 1))
+    return _ste(w, w * mask)
+
+
+def prune_heads(w, ratio: float, num_heads: int):
+    """Head pruning for attention output projections (reference head_pruning:
+    mask whole heads of a [heads*dim, out] kernel)."""
+    if ratio <= 0 or w.ndim != 2 or w.shape[0] % num_heads != 0:
+        return w
+    head_dim = w.shape[0] // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(num_heads, head_dim, -1)), axis=(1, 2))
+    k = max(1, int(num_heads * (1.0 - ratio)))
+    thresh = jax.lax.top_k(per_head, k)[0][-1]
+    mask = jnp.repeat((per_head >= thresh).astype(w.dtype), head_dim)[:, None]
+    return _ste(w, w * mask)

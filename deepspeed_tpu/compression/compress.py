@@ -1,0 +1,158 @@
+"""Config-driven compression (reference ``compression/compress.py``).
+
+``init_compression(apply_fn, params, ds_config)`` returns a wrapped apply_fn
+that fake-quantizes / masks the matching parameter leaves inside the jitted
+forward (the functional analog of the reference's module replacement with
+``LinearLayer_Compress``), plus the transform object for inspection.
+``redundancy_clean`` applies the masks/quantization permanently to a param
+tree (the reference's post-training cleanup that materializes pruning).
+
+Config schema = the reference's ``compression_training`` block:
+  {"weight_quantization": {"shared_parameters": {...}, "different_groups":
+     {"wq1": {"params": {"target_bits": 8}, "modules": ["attention.*"]}}},
+   "sparse_pruning": {...}, "row_pruning": {...}, "head_pruning": {...},
+   "channel_pruning": {...}, "activation_quantization": {...}}
+"""
+
+import fnmatch
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+from . import basic_layer as B
+
+_TECHNIQUES = ("weight_quantization", "activation_quantization", "sparse_pruning",
+               "row_pruning", "head_pruning", "channel_pruning", "layer_reduction")
+
+
+def check_deepspeed_config(config) -> dict:
+    """Reference compress.py:20."""
+    if hasattr(config, "_param_dict"):
+        config = config._param_dict
+    if not isinstance(config, dict):
+        raise ValueError("expected a ds_config dict")
+    return config.get("compression_training", {})
+
+
+class _Rule:
+
+    def __init__(self, technique: str, group: str, patterns: List[str], params: dict,
+                 offset: int = 0, offset_end: Optional[int] = None):
+        self.technique = technique
+        self.group = group
+        self.patterns = patterns
+        self.params = params
+        self.offset = offset
+        self.offset_end = offset_end
+
+    def matches(self, path: str) -> bool:
+        return any(fnmatch.fnmatch(path, pat) or fnmatch.fnmatch(path, f"*{pat}*")
+                   for pat in self.patterns)
+
+    def apply(self, w):
+        p = self.params
+        if self.technique == "weight_quantization":
+            return B.quantize_weight_ste(w, bits=p.get("target_bits", 8),
+                                         symmetric=p.get("symmetric", True))
+        if self.technique == "sparse_pruning":
+            return B.prune_magnitude(w, p.get("dense_ratio_complement",
+                                              1.0 - p.get("dense_ratio", 0.5)))
+        if self.technique == "row_pruning":
+            return B.prune_rows(w, 1.0 - p.get("dense_ratio", 0.5))
+        if self.technique == "channel_pruning":
+            return B.prune_channels(w, 1.0 - p.get("dense_ratio", 0.5))
+        if self.technique == "head_pruning":
+            return B.prune_heads(w, 1.0 - p.get("dense_ratio", 0.5),
+                                 num_heads=p.get("num_heads", 1))
+        return w
+
+
+class CompressionTransform:
+    """Collected rules; applies matching techniques to a param tree."""
+
+    def __init__(self, rules: List[_Rule]):
+        self.rules = rules
+
+    @staticmethod
+    def from_config(ds_config) -> "CompressionTransform":
+        cc = check_deepspeed_config(ds_config)
+        rules = []
+        for tech in _TECHNIQUES:
+            block = cc.get(tech)
+            if not block or tech == "layer_reduction":
+                continue
+            shared = block.get("shared_parameters", {})
+            if not shared.get("enabled", False):
+                continue
+            for group, spec in block.get("different_groups", {}).items():
+                rules.append(_Rule(
+                    tech, group,
+                    spec.get("modules", ["*"]),
+                    spec.get("params", {}),
+                    offset=shared.get("schedule_offset", 0),
+                    offset_end=shared.get("schedule_offset_end")))
+        return CompressionTransform(rules)
+
+    def active_rules(self, step: Optional[int]) -> List[_Rule]:
+        if step is None:
+            return self.rules
+        return [r for r in self.rules
+                if step >= r.offset and (r.offset_end is None or step <= r.offset_end)]
+
+    def __call__(self, params, step: Optional[int] = None):
+        rules = self.active_rules(step)
+        if not rules:
+            return params
+        flat = _flatten_with_paths(params)
+        out = {}
+        for path, leaf in flat.items():
+            for r in rules:
+                if hasattr(leaf, "ndim") and r.matches(path):
+                    leaf = r.apply(leaf)
+            out[path] = leaf
+        return _unflatten_like(out, params)
+
+
+def _flatten_with_paths(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_paths(v, f"{prefix}{k}." if prefix or True else k))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten_like(flat: Dict[str, Any], like):
+    def rebuild(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, f"{prefix}{k}.") for k, v in tree.items()}
+        return flat[prefix[:-1]]
+    return rebuild(like)
+
+
+def init_compression(apply_fn: Callable, ds_config, mpu=None,
+                     step_fn: Optional[Callable[[], int]] = None
+                     ) -> Tuple[Callable, CompressionTransform]:
+    """Reference compress.py:100 init_compression — returns
+    (compressed_apply_fn, transform). The wrapped fn fake-compresses matching
+    params on every forward (QAT); jit-safe."""
+    transform = CompressionTransform.from_config(ds_config)
+    if not transform.rules:
+        logger.warning("init_compression: no enabled compression techniques in config")
+        return apply_fn, transform
+
+    def compressed_apply(params, *args, **kwargs):
+        step = step_fn() if step_fn is not None else None
+        return apply_fn(transform(params, step), *args, **kwargs)
+
+    return compressed_apply, transform
+
+
+def redundancy_clean(params, ds_config, mpu=None):
+    """Reference compress.py:148 — materialize compression into the weights
+    (post-QAT export): returns a new param tree with masks/quant applied."""
+    transform = CompressionTransform.from_config(ds_config)
+    return jax.tree_util.tree_map(lambda x: x, transform(params, step=None))

@@ -1,0 +1,19 @@
+"""Compression schedule gating (reference ``compression/scheduler.py``:
+techniques activate at schedule_offset steps)."""
+
+from typing import Dict, List
+
+
+class CompressionScheduler:
+
+    def __init__(self, groups: List[dict]):
+        """groups: [{name, offset, offset_end}]"""
+        self.groups = groups
+
+    def active(self, step: int) -> Dict[str, bool]:
+        out = {}
+        for g in self.groups:
+            start = g.get("schedule_offset", 0)
+            end = g.get("schedule_offset_end", None)
+            out[g["name"]] = step >= start and (end is None or step <= end)
+        return out

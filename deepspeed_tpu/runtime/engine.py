@@ -134,6 +134,7 @@ class DeepSpeedTpuEngine:
         self._pending = None  # (grads, loss) from forward awaiting backward
         self._last_grad_norm = None
         self.losses = None
+        self.last_fwd_spec = None  # abstract fwd arg spec (flops profiler)
 
         # ---- mesh ----
         if not mesh_is_initialized():
@@ -216,6 +217,14 @@ class DeepSpeedTpuEngine:
                 self._config.monitor_config.csv_monitor.enabled]):
             from ..monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(self._config.monitor_config)
+
+        # flops profiler (reference engine.py flops_profiler hook)
+        self.flops_profiler = None
+        if self._config.flops_profiler_config.enabled:
+            from ..profiling.flops_profiler.profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(
+                model, ds_engine=self,
+                recompute_fwd_factor=self._config.flops_profiler_config.recompute_fwd_factor)
 
         self.checkpoint_engine = OrbaxCheckpointEngine()
         dist.configure(deepspeed_config=self._config)
@@ -372,6 +381,10 @@ class DeepSpeedTpuEngine:
         # grad_acc was donated; keep the new buffer, commit on backward()
         self.grad_acc = new_acc
         self._pending = loss
+        # abstract arg spec for the flops profiler's cost analysis
+        self.last_fwd_spec = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") else x,
+            (self.params, self.grad_acc, scale, args, kwargs))
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 

@@ -1,0 +1,130 @@
+"""Compression tests (parity target: reference
+``tests/unit/compression/test_compression.py``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (init_compression, redundancy_clean,
+                                       CompressionTransform, quantize_weight_ste,
+                                       prune_magnitude, prune_rows, prune_heads,
+                                       prune_channels, CompressionScheduler)
+
+
+class TestPrimitives:
+
+    def test_quant_ste_value_and_grad(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 16)), jnp.float32)
+        q = quantize_weight_ste(w, bits=8)
+        # 8-bit quantization error bounded by scale/2 per channel
+        scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+        assert float(jnp.max(jnp.abs(q - w))) <= float(jnp.max(scale))
+        # STE: gradient flows as identity
+        g = jax.grad(lambda x: jnp.sum(quantize_weight_ste(x, bits=8)))(w)
+        np.testing.assert_allclose(np.asarray(g), np.ones_like(g), atol=1e-6)
+
+    def test_low_bit_quantizes_coarser(self):
+        w = jnp.asarray(np.random.default_rng(1).normal(size=(64, 64)), jnp.float32)
+        e8 = float(jnp.mean(jnp.abs(quantize_weight_ste(w, 8) - w)))
+        e2 = float(jnp.mean(jnp.abs(quantize_weight_ste(w, 2) - w)))
+        assert e2 > e8
+
+    def test_prune_magnitude_ratio(self):
+        w = jnp.asarray(np.random.default_rng(2).normal(size=(32, 32)), jnp.float32)
+        p = prune_magnitude(w, ratio=0.75)
+        sparsity = float(jnp.mean(p == 0))
+        assert 0.70 <= sparsity <= 0.80
+
+    def test_prune_rows_structured(self):
+        w = jnp.asarray(np.random.default_rng(3).normal(size=(16, 8)), jnp.float32)
+        p = np.asarray(prune_rows(w, ratio=0.5))
+        zero_cols = np.all(p == 0, axis=0)
+        assert zero_cols.sum() == 4  # half the output columns zeroed
+
+    def test_prune_channels_structured(self):
+        w = jnp.asarray(np.random.default_rng(4).normal(size=(16, 8)), jnp.float32)
+        p = np.asarray(prune_channels(w, ratio=0.5))
+        zero_rows = np.all(p == 0, axis=1)
+        assert zero_rows.sum() == 8
+
+    def test_prune_heads(self):
+        w = jnp.asarray(np.random.default_rng(5).normal(size=(4 * 8, 16)), jnp.float32)
+        p = np.asarray(prune_heads(w, ratio=0.5, num_heads=4))
+        per_head_zero = [np.all(p[h * 8:(h + 1) * 8] == 0) for h in range(4)]
+        assert sum(per_head_zero) == 2
+
+
+CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8}, "modules": ["Dense_0*"]}
+            },
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["Dense_1*"]}
+            },
+        },
+    }
+}
+
+
+class TestConfigDriven:
+
+    def test_transform_matches_modules(self):
+        t = CompressionTransform.from_config(CONFIG)
+        assert len(t.rules) == 2
+        params = {"Dense_0": {"kernel": jnp.asarray(
+                      np.random.default_rng(7).normal(size=(8, 8)), jnp.float32)},
+                  "Dense_1": {"kernel": jnp.asarray(
+                      np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)},
+                  "Dense_2": {"kernel": jnp.ones((8, 8))}}
+        out = t(params, step=20)  # both rules active
+        # Dense_0 quantized (values snapped to the 8-bit grid), Dense_2 untouched
+        assert not np.array_equal(np.asarray(out["Dense_0"]["kernel"]),
+                                  np.asarray(params["Dense_0"]["kernel"]))
+        np.testing.assert_array_equal(np.asarray(out["Dense_2"]["kernel"]),
+                                      np.ones((8, 8)))
+        # Dense_1 pruned to ~50%
+        sp = float(jnp.mean(out["Dense_1"]["kernel"] == 0))
+        assert 0.4 <= sp <= 0.6
+
+    def test_schedule_offset_gates(self):
+        t = CompressionTransform.from_config(CONFIG)
+        params = {"Dense_1": {"kernel": jnp.asarray(
+            np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)}}
+        early = t(params, step=5)  # pruning not active until step 10
+        assert float(jnp.mean(early["Dense_1"]["kernel"] == 0)) == 0.0
+
+    def test_init_compression_wraps_apply(self):
+        def apply_fn(params, x):
+            return x @ params["Dense_0"]["kernel"]
+
+        wrapped, t = init_compression(apply_fn, CONFIG)
+        params = {"Dense_0": {"kernel": jnp.asarray(
+            np.random.default_rng(9).normal(size=(4, 4)), jnp.float32)}}
+        x = jnp.ones((2, 4))
+        out_q = wrapped(params, x)
+        out_raw = apply_fn(params, x)
+        assert not np.allclose(np.asarray(out_q), np.asarray(out_raw))
+        # jit-safe
+        np.testing.assert_allclose(np.asarray(jax.jit(wrapped)(params, x)),
+                                   np.asarray(out_q), rtol=1e-6)
+
+    def test_redundancy_clean(self):
+        params = {"Dense_1": {"kernel": jnp.asarray(
+            np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)}}
+        cleaned = redundancy_clean(params, CONFIG)
+        sp = float(jnp.mean(cleaned["Dense_1"]["kernel"] == 0))
+        assert 0.4 <= sp <= 0.6
+
+    def test_scheduler(self):
+        sched = CompressionScheduler([{"name": "a", "schedule_offset": 5},
+                                      {"name": "b", "schedule_offset": 0,
+                                       "schedule_offset_end": 3}])
+        assert sched.active(0) == {"a": False, "b": True}
+        assert sched.active(6) == {"a": True, "b": False}

@@ -1,0 +1,72 @@
+"""Flops profiler tests (parity target: reference
+``tests/unit/profiling/flops_profiler/test_flops_profiler.py``)."""
+
+import sys
+import os
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from simple_model import simple_model_and_params  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm.mesh import reset_mesh_context  # noqa: E402
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile  # noqa: E402
+from deepspeed_tpu.profiling.flops_profiler import profile_compiled  # noqa: E402
+
+
+def test_profile_compiled_matmul_flops():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 512), jnp.float32)
+    costs = profile_compiled(lambda x, y: x @ y, a, b)
+    # exact: 2*M*N*K flops
+    assert costs["flops"] == 2 * 128 * 256 * 512
+
+
+def test_get_model_profile():
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+    x = jnp.ones((32, 64))
+    w = jnp.ones((64, 64))
+    flops, macs, params = get_model_profile(f, (x, w), params={"w": w},
+                                            print_profile=False, as_string=False)
+    assert flops >= 2 * 32 * 64 * 64
+    assert params == 64 * 64
+
+
+def test_engine_integration():
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1}})
+    assert engine.flops_profiler is not None
+    x = jnp.ones((8, 16))
+    y = jnp.zeros((8, 16))
+    loss = engine.forward(x, y)
+    engine.backward(loss)
+    engine.step()
+    prof = engine.flops_profiler
+    prof.start_profile()
+    loss = engine.forward(x, y)
+    engine.backward(loss)
+    engine.step()
+    prof.stop_profile()
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_params() == sum(int(np.prod(p.shape))
+                                          for p in jax.tree_util.tree_leaves(params))
+    report = prof.print_model_profile(profile_step=2, batch_tokens=8, output_file=os.devnull)
+    assert "Flops Profiler" in report
+    assert prof.get_total_duration() > 0
+
+
+def test_string_helpers():
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (flops_to_string,
+                                                                 params_to_string,
+                                                                 duration_to_string)
+    assert flops_to_string(2.5e9).startswith("2.5 G")
+    assert params_to_string(1_500_000).startswith("1.5 M")
+    assert duration_to_string(0.002).endswith("ms")
