@@ -1,0 +1,81 @@
+"""Environment / op-compatibility report.
+
+Reference: ``deepspeed/env_report.py`` (the ``ds_report`` CLI): prints the
+op-builder compatibility matrix + torch/cuda versions. TPU version reports
+the jax stack, device inventory, mesh capability, and the op registry
+(pallas kernels, native AIO) status.
+"""
+
+import importlib
+import sys
+
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def _version(mod_name):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except ImportError:
+        return None
+
+
+def op_report():
+    """Op registry status lines (reference op_report: compatible/installed)."""
+    from .ops.registry import registry
+    # probe ops so their registration side effects run
+    from .ops import aio as _aio  # noqa: F401
+    _aio.aio_available()
+    for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope"):
+        try:
+            importlib.import_module(f".ops.{mod}", package=__package__)
+        except ImportError:
+            pass
+    lines = ["-" * 64, "op name " + "." * 40 + " backend  status", "-" * 64]
+    for name, info in sorted(registry.report().items()):
+        status = OKAY if info.compatible else NO
+        lines.append(f"{name} {'.' * max(1, 48 - len(name))} "
+                     f"[{info.backend}] {status}")
+    return "\n".join(lines)
+
+
+def debug_report():
+    import jax
+    lines = []
+    lines.append("-" * 64)
+    lines.append("DeepSpeed-TPU general environment info:")
+    lines.append("-" * 64)
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        v = _version(mod)
+        lines.append(f"{mod} version {'.' * max(1, 40 - len(mod))} "
+                     f"{v if v else NO}")
+    lines.append(f"python version {'.' * 34} {sys.version.split()[0]}")
+    try:
+        devs = jax.devices()
+        lines.append(f"platform {'.' * 40} {devs[0].platform}")
+        lines.append(f"device count {'.' * 36} {len(devs)}")
+        lines.append(f"process count {'.' * 35} {jax.process_count()}")
+    except Exception as e:
+        lines.append(f"jax devices {'.' * 37} {NO} ({e})")
+    return "\n".join(lines)
+
+
+def main():
+    print(op_report())
+    print(debug_report())
+    return 0
+
+
+def cli_main():
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Multi-host launcher.
+
+Reference: ``deepspeed/launcher/runner.py:399 main`` (hostfile parsing :211,
+resource filtering :266, PDSH/MPI runners in multinode_runner.py) and the
+per-node ``launch.py:133``.
+
+TPU shape of the problem: JAX is single-controller-per-host SPMD — ONE
+process per host (not per chip), rendezvoused through
+``jax.distributed.initialize(coordinator, num_processes, process_id)``. So
+the launcher reduces to: parse hostfile → assign process ids → ssh each host
+and exec the script with the rendezvous env (the reference's env-propagation
+contract: we forward DS_/JAX_/XLA_ prefixed vars + --export list). On a
+single host it just execs locally (chips are already visible to one
+process).
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_PREFIXES = ("DS_", "JAX_", "XLA_", "TPU_", "PYTHON", "PATH", "LD_LIBRARY_PATH")
+
+
+def parse_hostfile(path: str) -> "OrderedDict[str, int]":
+    """'hostname slots=N' lines → {host: slots} (reference runner.py:211)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if host in resources:
+                raise ValueError(f"host {host} appears twice in hostfile")
+            resources[host] = slots
+    if not resources:
+        raise ValueError(f"no hosts found in hostfile {path}")
+    return resources
+
+
+def filter_resources(resources: "OrderedDict[str, int]", include: str = "",
+                     exclude: str = "") -> "OrderedDict[str, int]":
+    """--include/--exclude 'host1@host2' filtering (reference :266; slot
+    selection is meaningless on TPU hosts so only whole hosts filter)."""
+    def hostset(spec):
+        return {h for h in spec.replace("@", " ").split() if h}
+    inc, exc = hostset(include), hostset(exclude)
+    out = OrderedDict()
+    for host, slots in resources.items():
+        if inc and host not in inc:
+            continue
+        if host in exc:
+            continue
+        out[host] = slots
+    if not out:
+        raise ValueError("resource filtering removed every host")
+    return out
+
+
+def _export_env(extra: List[str]) -> Dict[str, str]:
+    env = {k: v for k, v in os.environ.items() if k.startswith(EXPORT_PREFIXES)}
+    for name in extra:
+        if name in os.environ:
+            env[name] = os.environ[name]
+    return env
+
+
+def build_commands(hosts: List[str], master_addr: str, master_port: int,
+                   script: str, script_args: List[str],
+                   exports: Dict[str, str]) -> List[List[str]]:
+    """One ssh command per host with the JAX rendezvous env."""
+    cmds = []
+    for pid, host in enumerate(hosts):
+        env = dict(exports)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
+        env["JAX_NUM_PROCESSES"] = str(len(hosts))
+        env["JAX_PROCESS_ID"] = str(pid)
+        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " \
+                 f"{sys.executable} {shlex.quote(script)} " \
+                 f"{' '.join(shlex.quote(a) for a in script_args)}"
+        if pid == 0 and host in ("localhost", "127.0.0.1"):
+            cmds.append(["bash", "-c", remote])
+        else:
+            cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
+    return cmds
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu multi-host launcher (reference bin/deepspeed)")
+    parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("-i", "--include", default="")
+    parser.add_argument("-e", "--exclude", default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--export", action="append", default=[],
+                        help="extra env var names to forward")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print commands without executing")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if os.path.exists(args.hostfile):
+        resources = filter_resources(parse_hostfile(args.hostfile),
+                                     args.include, args.exclude)
+        hosts = list(resources)
+    else:
+        hosts = ["localhost"]
+    if args.num_nodes > 0:
+        hosts = hosts[:args.num_nodes]
+    master = args.master_addr or hosts[0]
+
+    if len(hosts) == 1 and not args.dry_run:
+        # single host: exec in place, no rendezvous env needed
+        os.execvpe(sys.executable, [sys.executable, args.script] + args.script_args,
+                   os.environ)
+
+    cmds = build_commands(hosts, master, args.master_port, args.script,
+                          args.script_args, _export_env(args.export))
+    if args.dry_run:
+        for c in cmds:
+            print(" ".join(shlex.quote(x) for x in c))
+        return 0
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

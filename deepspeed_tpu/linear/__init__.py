@@ -1,0 +1,3 @@
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import OptimizedLinear, LoRAOptimizedLinear
+from .quantization import QuantizedParameter

@@ -1,0 +1,21 @@
+"""Linear/LoRA configs (reference ``deepspeed/linear/config.py`` — same
+fields)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Reference linear/config.py LoRAConfig."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # shard the frozen base over 'model' axis
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference linear/config.py QuantizationConfig (FP quantization of the
+    frozen base weight; int8 blockwise here — the TPU-native cheap format)."""
+    q_bits: int = 8
+    mantissa_bits: int = 3  # accepted for parity; int8 path ignores it
+    group_size: int = 512

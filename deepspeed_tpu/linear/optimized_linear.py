@@ -1,0 +1,74 @@
+"""OptimizedLinear / LoRAOptimizedLinear.
+
+Reference: ``deepspeed/linear/optimized_linear.py:18 OptimizedLinear`` (a
+factory: plain Linear, or LoRAOptimizedLinear :76 when lora_config given —
+frozen possibly-quantized sharded base weight + trainable low-rank A·B).
+
+TPU design: flax modules. The frozen base weight is a *constant* captured in
+the module (not a trainable param) — optionally int8-quantized storage
+(dequant fuses into the matmul under jit) and sharded over the ``model``
+mesh axis by AutoTP rules; only lora_A/lora_B are flax params, so the
+optimizer state is rank-r (the entire point of LoRA).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..utils.logging import logger
+from .config import LoRAConfig, QuantizationConfig
+from .quantization import QuantizedParameter
+
+
+class LoRAOptimizedLinear(nn.Module):
+    """y = x @ W_base(frozen) + (x @ A) @ B * (alpha / sqrt(r))."""
+    output_dim: int
+    base_weight: Any  # jnp array [in, out] or QuantizedParameter
+    lora_config: LoRAConfig
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.lora_config
+        w = self.base_weight
+        if isinstance(w, QuantizedParameter):
+            w = w.dequantized()
+        w = jax.lax.stop_gradient(w.astype(self.dtype))
+        in_dim = w.shape[0]
+        # reference scales by alpha/sqrt(r) (linear/optimized_linear.py:76)
+        scaling = cfg.lora_alpha / (cfg.lora_r**0.5)
+        lora_a = self.param("lora_a", nn.initializers.lecun_normal(),
+                            (in_dim, cfg.lora_r), jnp.float32)
+        lora_b = self.param("lora_b", nn.initializers.zeros,
+                            (cfg.lora_r, self.output_dim), jnp.float32)
+        base = x @ w
+        delta = (x @ lora_a.astype(self.dtype)) @ lora_b.astype(self.dtype)
+        return base + delta * scaling
+
+
+def OptimizedLinear(input_dim: int,
+                    output_dim: int,
+                    base_weight=None,
+                    lora_config: Optional[LoRAConfig] = None,
+                    quantization_config: Optional[QuantizationConfig] = None,
+                    dtype=jnp.bfloat16,
+                    seed: int = 0):
+    """Factory (reference optimized_linear.py:18): returns a flax module —
+    plain Dense when no lora_config; LoRAOptimizedLinear otherwise. A given
+    ``base_weight`` is quantized per quantization_config."""
+    if lora_config is None and quantization_config is None:
+        return nn.Dense(output_dim, use_bias=False, dtype=dtype)
+    if base_weight is None:
+        key = jax.random.PRNGKey(seed)
+        base_weight = nn.initializers.lecun_normal()(key, (input_dim, output_dim),
+                                                     jnp.float32)
+    if quantization_config is not None:
+        base_weight = QuantizedParameter.quantize(jnp.asarray(base_weight),
+                                                  quantization_config)
+    if lora_config is None:
+        # quantized-only linear: frozen quantized weight, no adapters
+        lora_config = LoRAConfig(lora_r=1, lora_alpha=0.0)
+    return LoRAOptimizedLinear(output_dim=output_dim, base_weight=base_weight,
+                               lora_config=lora_config, dtype=dtype)

@@ -1,0 +1,50 @@
+"""Quantized parameter storage.
+
+Reference: ``deepspeed/linear/quantization.py:18 QuantizedParameter`` — a
+tensor subclass that stores FP6/FP8-quantized data and dequantizes on use.
+TPU version: a small container of (int8 values, bf16 scales) produced by the
+blockwise Pallas/XLA quantizer (``ops/quantizer.py``), dequantized inside
+jit where XLA fuses it into the consuming matmul.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantizer import dequantize_int8_blockwise, quantize_int8_blockwise
+from .config import QuantizationConfig
+
+
+class QuantizedParameter:
+
+    def __init__(self, values, scales, shape: Tuple[int, ...], block_size: int,
+                 dtype=jnp.bfloat16):
+        self.values = values
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.block_size = block_size
+        self.dtype = dtype
+
+    @staticmethod
+    def quantize(w, config: QuantizationConfig = None) -> "QuantizedParameter":
+        config = config or QuantizationConfig()
+        assert config.q_bits == 8, "int8 is the supported quantized storage"
+        values, scales = quantize_int8_blockwise(w, block_size=config.group_size)
+        return QuantizedParameter(values, scales, w.shape, config.group_size,
+                                  dtype=w.dtype)
+
+    def dequantized(self):
+        return dequantize_int8_blockwise(self.values, self.scales, self.shape,
+                                         self.block_size).astype(self.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.values.size + self.scales.size * self.scales.dtype.itemsize)
+
+
+# pytree registration so QuantizedParameter flows through jit/device_put
+jax.tree_util.register_pytree_node(
+    QuantizedParameter,
+    lambda qp: ((qp.values, qp.scales), (qp.shape, qp.block_size, qp.dtype)),
+    lambda aux, kids: QuantizedParameter(kids[0], kids[1], aux[0], aux[1], aux[2]))

@@ -1,0 +1,3 @@
+from .replace_policy import (HFCheckpointPolicy, LlamaPolicy, MistralPolicy, Qwen2Policy,
+                             Gemma2Policy, policy_for, SUPPORTED_ARCHS)
+from .replace_module import convert_hf_checkpoint, export_hf_checkpoint, replace_transformer_layer

@@ -1,0 +1,117 @@
+"""HF checkpoint conversion + injection entry points.
+
+Reference: ``module_inject/replace_module.py:183 replace_transformer_layer``
+— walks an HF torch model replacing decoder layers with fused containers and
+sharding weights. TPU equivalent: *convert once* into the native flax param
+tree (the fused "container" is the whole jitted model), then serve through
+``init_inference`` (TP via AutoTP shardings) or the v2 ragged engine.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..utils.logging import logger
+from .replace_policy import HFCheckpointPolicy, policy_for
+
+
+def _nest(flat: Dict[str, np.ndarray]) -> Dict:
+    """'a/b/c': x  →  {'a': {'b': {'c': x}}}"""
+    out: Dict = {}
+    for path, v in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _to_numpy(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor without importing torch
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def convert_hf_checkpoint(arch: str,
+                          hf_state_dict: Dict[str, Any],
+                          hf_config: Dict,
+                          dtype=jnp.bfloat16) -> Tuple[LlamaConfig, Dict]:
+    """HF state dict (torch tensors or arrays) → (LlamaConfig, flax params
+    compatible with models/llama.py + inference/v2)."""
+    policy = policy_for(arch)
+    cfg = policy.config_from_hf(hf_config)
+    flat: Dict[str, np.ndarray] = {}
+    consumed = set()
+
+    def take(hf_name: str, flax_path: str, transpose: bool):
+        if hf_name not in hf_state_dict:
+            raise KeyError(f"HF checkpoint missing '{hf_name}' (arch={arch})")
+        w = _to_numpy(hf_state_dict[hf_name])
+        if transpose:
+            w = w.T  # torch Linear [out,in] → flax kernel [in,out]
+        flat[flax_path] = w.astype(np.float32)
+        consumed.add(hf_name)
+
+    for hf_name, (flax_path, tr) in policy.global_map(cfg.tie_word_embeddings).items():
+        take(hf_name, flax_path, tr)
+    for layer in range(cfg.num_hidden_layers):
+        for hf_name, (flax_path, tr) in policy.weight_map(layer).items():
+            take(hf_name, flax_path, tr)
+
+    leftovers = [k for k in hf_state_dict if k not in consumed
+                 and not k.endswith("rotary_emb.inv_freq")]
+    bias_leftovers = [k for k in leftovers if k.endswith(".bias")]
+    if bias_leftovers and policy.supports_bias:
+        logger.warning(f"{arch}: dropping {len(bias_leftovers)} bias tensors "
+                       "(flax model is bias-free; affects logits slightly)")
+        leftovers = [k for k in leftovers if k not in bias_leftovers]
+    if leftovers:
+        logger.warning(f"unconverted HF tensors: {leftovers[:8]}"
+                       f"{'...' if len(leftovers) > 8 else ''}")
+
+    params = {"model": _nest(flat)}
+    return cfg, params
+
+
+def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[str, np.ndarray]:
+    """Inverse conversion: flax params → HF-layout state dict (numpy)."""
+    policy = policy_for(arch)
+    flat = {}
+
+    def walk(node, prefix=""):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}/")
+        else:
+            flat[prefix[:-1]] = np.asarray(node, dtype=np.float32)
+
+    walk(params.get("model", params))
+    out = {}
+    maps = dict(policy.global_map(config.tie_word_embeddings))
+    for layer in range(config.num_hidden_layers):
+        maps.update(policy.weight_map(layer))
+    for hf_name, (flax_path, transpose) in maps.items():
+        w = flat[flax_path]
+        out[hf_name] = w.T if transpose else w
+    return out
+
+
+def replace_transformer_layer(arch_or_model_type: str,
+                              hf_state_dict: Dict[str, Any],
+                              hf_config: Dict,
+                              tp_size: int = 1,
+                              dtype=jnp.bfloat16):
+    """Reference entry name kept (replace_module.py:183): converts the HF
+    checkpoint and returns a TP-sharded v1 InferenceEngine over it."""
+    import deepspeed_tpu
+    cfg, params = convert_hf_checkpoint(arch_or_model_type, hf_state_dict, hf_config,
+                                        dtype=dtype)
+    from ..models.llama import LlamaForCausalLM
+    model = LlamaForCausalLM(cfg)
+    return deepspeed_tpu.init_inference(
+        model, config={"dtype": "bfloat16" if dtype == jnp.bfloat16 else "float32",
+                       "tensor_parallel": {"tp_size": tp_size}},
+        params=params)

@@ -1,0 +1,128 @@
+"""Per-architecture injection policies.
+
+Reference: ``deepspeed/module_inject/replace_policy.py`` +
+``containers/*`` (~20 archs): each policy knows an architecture's module
+layout — which weights feed attention/MLP, which are column- vs row-parallel
+— and maps HF modules onto the fused inference containers.
+
+TPU equivalent: the "container" is the native flax Llama-family model
+(``models/llama.py``) plus its paged-KV serving twin
+(``inference/v2/model.py``); a policy here is (a) the HF→flax parameter name
+map with layout fixups (torch Linear stores [out,in]; flax kernels are
+[in,out]) and (b) the TP partition hints AutoTP consumes
+(``parallel/tp.py``).
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.llama import LlamaConfig
+
+
+class HFCheckpointPolicy:
+    """Base policy: llama-family weight map (LLaMA 2/3, Mistral, Qwen2 share
+    the module graph; reference containers/llama.py, mistral, qwen2)."""
+
+    arch: str = "llama"
+    supports_bias: bool = False
+
+    # AutoTP hints (reference policy.py container attrs)
+    col_parallel = ["q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"]
+    row_parallel = ["o_proj", "down_proj"]
+
+    def config_from_hf(self, hf_config: Dict) -> LlamaConfig:
+        """Map an HF config dict to LlamaConfig."""
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config.get("num_key_value_heads",
+                                              hf_config["num_attention_heads"]),
+            max_position_embeddings=hf_config.get("max_position_embeddings", 8192),
+            rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
+
+    def weight_map(self, layer: int) -> Dict[str, Tuple[str, bool]]:
+        """HF name -> (flax path under params['model'], transpose?)."""
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "self_attn.q_proj.weight": (f + "self_attn/q_proj/kernel", True),
+            p + "self_attn.k_proj.weight": (f + "self_attn/k_proj/kernel", True),
+            p + "self_attn.v_proj.weight": (f + "self_attn/v_proj/kernel", True),
+            p + "self_attn.o_proj.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "mlp.gate_proj.weight": (f + "mlp/gate_proj/kernel", True),
+            p + "mlp.up_proj.weight": (f + "mlp/up_proj/kernel", True),
+            p + "mlp.down_proj.weight": (f + "mlp/down_proj/kernel", True),
+            p + "input_layernorm.weight": (f + "input_layernorm/weight", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/weight",
+                                                    False),
+        }
+
+    def global_map(self, tie_embeddings: bool) -> Dict[str, Tuple[str, bool]]:
+        out = {
+            "model.embed_tokens.weight": ("embed_tokens/embedding", False),
+            "model.norm.weight": ("norm/weight", False),
+        }
+        if not tie_embeddings:
+            out["lm_head.weight"] = ("lm_head/kernel", True)
+        return out
+
+
+class LlamaPolicy(HFCheckpointPolicy):
+    arch = "llama"
+
+
+class MistralPolicy(HFCheckpointPolicy):
+    """Mistral: llama graph w/ sliding-window attn config (served dense here;
+    reference containers/mistral)."""
+    arch = "mistral"
+
+    def config_from_hf(self, hf_config):
+        cfg = super().config_from_hf(hf_config)
+        return cfg  # sliding_window handled at attention level when present
+
+
+class Qwen2Policy(HFCheckpointPolicy):
+    """Qwen2 adds attention qkv biases (reference containers/qwen2); biases
+    are folded away with a warning until the flax model grows bias support."""
+    arch = "qwen2"
+    supports_bias = True
+
+
+class Gemma2Policy(HFCheckpointPolicy):
+    """Gemma-2: llama-family graph with tied embeddings by default."""
+    arch = "gemma2"
+
+    def config_from_hf(self, hf_config):
+        cfg = super().config_from_hf(hf_config)
+        import dataclasses
+        return dataclasses.replace(cfg, tie_word_embeddings=True)
+
+
+_POLICIES = {
+    "llama": LlamaPolicy,
+    "LlamaForCausalLM": LlamaPolicy,
+    "mistral": MistralPolicy,
+    "MistralForCausalLM": MistralPolicy,
+    "qwen2": Qwen2Policy,
+    "Qwen2ForCausalLM": Qwen2Policy,
+    "gemma2": Gemma2Policy,
+    "Gemma2ForCausalLM": Gemma2Policy,
+}
+
+SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})
+
+
+def policy_for(arch_or_model_type: str) -> HFCheckpointPolicy:
+    """Reference replace_policy.py generic_policies lookup."""
+    pol = _POLICIES.get(arch_or_model_type)
+    if pol is None:
+        raise ValueError(f"no injection policy for '{arch_or_model_type}'; "
+                         f"supported: {SUPPORTED_ARCHS}")
+    return pol()

@@ -1,0 +1,61 @@
+"""Launcher tests (parity target: reference
+``tests/unit/launcher/test_ds_arguments.py`` + runner hostfile parsing)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (parse_hostfile, filter_resources,
+                                           build_commands)
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# comment\nworker-0 slots=4\nworker-1 slots=4\n\nworker-2\n")
+    res = parse_hostfile(str(hf))
+    assert list(res.items()) == [("worker-0", 4), ("worker-1", 4), ("worker-2", 1)]
+
+
+def test_parse_hostfile_duplicate_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=2\nw0 slots=4\n")
+    with pytest.raises(ValueError):
+        parse_hostfile(str(hf))
+
+
+def test_filter_resources(tmp_path):
+    from collections import OrderedDict
+    res = OrderedDict([("a", 4), ("b", 4), ("c", 4)])
+    assert list(filter_resources(res, include="a@c")) == ["a", "c"]
+    assert list(filter_resources(res, exclude="b")) == ["a", "c"]
+    with pytest.raises(ValueError):
+        filter_resources(res, include="zzz")
+
+
+def test_build_commands_rendezvous_env():
+    cmds = build_commands(["h0", "h1"], "h0", 29500, "train.py", ["--x", "1"],
+                          {"JAX_PLATFORMS": "tpu"})
+    assert len(cmds) == 2
+    # every host gets coordinator + unique process id
+    joined0, joined1 = " ".join(cmds[0]), " ".join(cmds[1])
+    assert "JAX_COORDINATOR_ADDRESS=h0:29500" in joined0
+    assert "JAX_NUM_PROCESSES=2" in joined0
+    assert "JAX_PROCESS_ID=0" in joined0
+    assert "JAX_PROCESS_ID=1" in joined1
+    assert cmds[1][0] == "ssh"
+
+
+def test_dry_run_cli(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("h0 slots=4\nh1 slots=4\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner", "--hostfile", str(hf),
+         "--dry_run", "train.py", "--lr", "0.1"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ, "PYTHONPATH": "/root/repo"})
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.splitlines() if "train.py" in l]
+    assert len(lines) == 2
+    assert "ssh" in lines[1]
