@@ -41,6 +41,11 @@ def initialize(args=None,
     if args is not None and config is None:
         config = getattr(args, "deepspeed_config", None)
 
+    # RLHF hybrid engine (reference __init__.py: DeepSpeedHybridEngine when
+    # config.hybrid_engine.enabled)
+    if isinstance(config, dict) and config.get("hybrid_engine", {}).get("enabled"):
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine as DeepSpeedTpuEngine  # noqa: F811
+
     engine = DeepSpeedTpuEngine(model=model,
                                 optimizer=optimizer,
                                 model_parameters=model_parameters,

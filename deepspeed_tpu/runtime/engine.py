@@ -146,6 +146,11 @@ class DeepSpeedTpuEngine:
                 # innermost ICI-local axis only; replicate across nodes
                 from .zeropp import hpz_mesh_axes
                 axes.update(hpz_mesh_axes(jax.device_count(), hpz))
+            mics = self._config.zero_config.mics_shard_size
+            if mics > 1 and axes.get("fsdp", 1) == 1:
+                # MiCS: ZeRO-3 within shard groups, replicate across
+                from .mics import mics_mesh_axes
+                axes.update(mics_mesh_axes(jax.device_count(), mics))
             if mesh_param is not None:  # reference mesh_param=(dp, sp)
                 axes = {"data": mesh_param[0], "seq": mesh_param[1]}
             dist.init_distributed(mesh_axes=axes)

@@ -1,0 +1,121 @@
+"""Hybrid engine — train + generate in one engine (RLHF).
+
+Reference: ``runtime/hybrid_engine.py:32 DeepSpeedHybridEngine``: during
+RLHF, actor training interleaves with rollout generation; the reference
+flips each decoder layer into its fused inference container for
+``generate()`` and back for training, sharing weights in place.
+
+TPU design: the training engine owns fp32 master params; ``generate()``
+serves rollouts through the v2 ragged paged-KV engine over a *view* of
+those same params (cast once per refresh — the analog of the reference's
+weight-sharing container flip, without module surgery: both paths are pure
+functions over the same tree). After each optimizer step the inference view
+is marked stale and recast lazily on the next generate.
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .engine import DeepSpeedTpuEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
+
+    def __init__(self, *args, llama_config=None, generate_config=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        hec = self._config.hybrid_engine_config or {}
+        self._he_dtype = jnp.bfloat16 if hec.get("fp16", True) else jnp.float32
+        self._llama_config = llama_config
+        self._gen_engine = None
+        self._gen_params_version = -1
+        self._inference_mode = False
+        self._kv_block_size = hec.get("kv_block_size", 64)
+        self._num_kv_blocks = hec.get("num_kv_blocks", 512)
+        self._max_context = hec.get("max_out_tokens", 2048)
+
+    # ---- mode flips (reference eval()/train() container swaps) ----
+
+    def eval(self):
+        self._inference_mode = True
+        return self
+
+    def train(self, mode: bool = True):
+        self._inference_mode = not mode
+        return self
+
+    def step(self, *a, **kw):
+        out = super().step(*a, **kw)
+        # params changed → inference view is stale (reference re-shards
+        # containers on the fly; we just recast lazily)
+        self._gen_params_version = -1
+        return out
+
+    # ---- generation (reference generate() :238) ----
+
+    def _refresh_generation_engine(self):
+        if self._llama_config is None:
+            raise RuntimeError("hybrid generate() needs llama_config (the flax "
+                               "LlamaConfig of the wrapped model)")
+        from ..inference.v2 import (InferenceEngineV2, RaggedInferenceEngineConfig)
+        from ..inference.v2.config_v2 import DSStateManagerConfig
+        from ..inference.v2.model import RaggedLlamaModel
+
+        if self._gen_params_version == self.global_steps and self._gen_engine is not None:
+            return
+        params = self.params
+        model = RaggedLlamaModel(self._llama_config, params, dtype=self._he_dtype,
+                                 kv_block_size=self._kv_block_size)
+        if self._gen_engine is None:
+            cfg = RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(max_context=self._max_context),
+                num_kv_blocks=self._num_kv_blocks)
+            self._gen_engine = InferenceEngineV2(model, cfg)
+        else:
+            # keep the KV cache + state manager; swap the weights (this is
+            # the in-place weight sharing the reference gets from containers)
+            model.set_state_manager(self._gen_engine._state_manager)
+            self._gen_engine._model = model
+        self._gen_params_version = self.global_steps
+
+    def generate(self, input_ids, max_new_tokens: int = 16, do_sample: bool = False,
+                 temperature: float = 1.0, seed: int = 0, eos_token_id: Optional[int] = None):
+        """Batched rollout generation with paged KV (greedy or sampled).
+        input_ids: [batch, prompt_len] (list/array; left-unpadded)."""
+        self._refresh_generation_engine()
+        eng = self._gen_engine
+        prompts = [np.asarray(row, dtype=np.int32).reshape(-1) for row in input_ids]
+        uids = list(range(len(prompts)))
+        key = jax.random.PRNGKey(seed)
+
+        out = [list(p) for p in prompts]
+        done = [False] * len(prompts)
+        logits = eng.put(uids, prompts)
+        for step in range(max_new_tokens):
+            lg = np.asarray(logits)[:len(prompts)]
+            if do_sample:
+                key, sub = jax.random.split(key)
+                nxt = np.asarray(jax.random.categorical(sub, jnp.asarray(lg) / temperature))
+            else:
+                nxt = lg.argmax(-1)
+            for i in range(len(prompts)):
+                if not done[i]:
+                    out[i].append(int(nxt[i]))
+                    if eos_token_id is not None and int(nxt[i]) == eos_token_id:
+                        done[i] = True
+            if all(done) or step == max_new_tokens - 1:
+                break
+            live = [i for i in range(len(prompts)) if not done[i]]
+            logits_live = eng.put([uids[i] for i in live], [[out[i][-1]] for i in live])
+            # scatter live rows back into a full-width logits view
+            lg_full = np.zeros((len(prompts), np.asarray(logits_live).shape[-1]),
+                               dtype=np.float32)
+            for row, i in enumerate(live):
+                lg_full[i] = np.asarray(logits_live)[row]
+            logits = lg_full
+        for uid in uids:
+            eng.flush(uid)
+        return out

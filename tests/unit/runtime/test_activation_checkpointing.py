@@ -1,0 +1,123 @@
+"""Activation checkpointing tests (parity target: reference
+``tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py``
+— checkpointed forward/backward equals non-checkpointed)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+def mlp(params, x):
+    for w in params:
+        x = jnp.tanh(x @ w)
+    return jnp.sum(x**2)
+
+
+@pytest.fixture
+def setup():
+    rng = np.random.default_rng(0)
+    params = [jnp.asarray(rng.normal(size=(16, 16)) * 0.3, jnp.float32) for _ in range(3)]
+    x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+    return params, x
+
+
+def test_checkpoint_matches_plain(setup):
+    params, x = setup
+    ckpt.configure(partition_activations=False, checkpoint_in_cpu=False)
+    ref, ref_g = jax.value_and_grad(mlp)(params, x)
+    out = ckpt.checkpoint(mlp, params, x)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+    g = jax.grad(lambda p: ckpt.checkpoint(mlp, p, x))(params)
+    for a, b in zip(g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_checkpoint_forces_remat(setup):
+    params, x = setup
+    ckpt.configure(partition_activations=False)
+    # the remat primitive must appear in the grad jaxpr
+    jaxpr = jax.make_jaxpr(jax.grad(lambda p: ckpt.checkpoint(mlp, p, x)))(params)
+    assert "remat" in str(jaxpr) or "checkpoint" in str(jaxpr)
+
+
+def test_named_policy(setup):
+    params, x = setup
+    ckpt.configure(partition_activations=False)
+    ckpt._CONFIG["policy"] = "dots_saveable"
+    try:
+        out = ckpt.checkpoint(mlp, params, x)
+        ref = mlp(params, x)
+        np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+    finally:
+        ckpt._CONFIG["policy"] = None
+
+
+def test_unknown_policy_raises(setup):
+    params, x = setup
+    ckpt._CONFIG["policy"] = "not_a_policy"
+    try:
+        with pytest.raises(ValueError):
+            ckpt.checkpoint(mlp, params, x)
+    finally:
+        ckpt._CONFIG["policy"] = None
+
+
+def test_partition_activations_under_mesh(setup):
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    params, x = setup
+    reset_mesh_context()
+    dist.init_distributed(mesh_axes={"model": 4, "data": 2})
+    ckpt.configure(partition_activations=True)
+    try:
+        out = ckpt.checkpoint(mlp, params, x)
+        # sharded reductions reorder float adds: tolerance reflects that
+        np.testing.assert_allclose(float(out), float(mlp(params, x)), rtol=1e-4)
+        g = jax.grad(lambda p: ckpt.checkpoint(mlp, p, x))(params)
+        ref_g = jax.grad(mlp)(params, x)
+        for a, b in zip(g, ref_g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5)
+    finally:
+        ckpt.configure(partition_activations=False)
+        reset_mesh_context()
+
+
+class TestRNGTracker:
+
+    def test_add_fork_deterministic(self):
+        t = ckpt.RNGStatesTracker()
+        t.add("stream", 123)
+        k1 = t.fork("stream")
+        k2 = t.fork("stream")
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+        # same seed → same sequence
+        t2 = ckpt.RNGStatesTracker()
+        t2.add("stream", 123)
+        np.testing.assert_array_equal(np.asarray(t2.fork("stream")), np.asarray(k1))
+
+    def test_duplicate_add_raises(self):
+        t = ckpt.RNGStatesTracker()
+        t.add("s", 1)
+        with pytest.raises(Exception):
+            t.add("s", 2)
+
+    def test_missing_fork_raises(self):
+        with pytest.raises(Exception):
+            ckpt.RNGStatesTracker().fork("nope")
+
+    def test_model_parallel_seed_distinct_per_rank(self):
+        from jax.sharding import Mesh
+        import jax.numpy as jnp
+        base, mp_key = ckpt.model_parallel_rng_seed(7)
+        devs = np.array(jax.devices()[:4]).reshape(4)
+        with Mesh(devs, ("model", )):
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            keys = shard_map(lambda: mp_key().reshape(1, 2),
+                             mesh=Mesh(devs, ("model", )), in_specs=(),
+                             out_specs=P("model"))()
+        keys = np.asarray(keys)
+        assert len({tuple(k) for k in keys}) == 4  # all ranks distinct

@@ -1,0 +1,93 @@
+"""Hybrid engine tests (parity target: reference ``tests/unit/hybrid_engine``
+— train/generate interleaving with weight sharing)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.models.llama import LlamaConfig, init_llama
+
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture
+def engine():
+    reset_mesh_context()
+    model, params = init_llama(CFG, seed=0)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "hybrid_engine": {"enabled": True, "fp16": False,
+                                  "kv_block_size": 16, "num_kv_blocks": 64,
+                                  "max_out_tokens": 128},
+                "steps_per_print": 1000},
+        llama_config=CFG)
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, CFG.vocab_size, size=(8, 16)).astype(np.int32)
+    return jnp.asarray(ids), jnp.asarray(ids)
+
+
+def test_is_hybrid_engine(engine):
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+
+def test_generate_greedy(engine):
+    out = engine.generate([[1, 5, 9], [2, 4, 6, 8]], max_new_tokens=5)
+    assert len(out) == 2
+    assert len(out[0]) == 3 + 5 and len(out[1]) == 4 + 5
+    assert all(0 <= t < CFG.vocab_size for seq in out for t in seq)
+
+
+def test_generate_matches_training_model(engine):
+    """Greedy first token must equal argmax of the training model's logits —
+    the weight-sharing guarantee."""
+    prompt = [1, 5, 9, 42]
+    out = engine.generate([prompt], max_new_tokens=1)
+    logits = engine.module.apply({"params": jax.tree_util.tree_map(np.asarray, engine.params)},
+                                 jnp.asarray([prompt]))
+    expected = int(np.asarray(logits)[0, -1].argmax())
+    assert out[0][-1] == expected
+
+
+def test_train_then_generate_uses_fresh_weights(engine):
+    ids, labels = _batch()
+    out_before = engine.generate([[1, 2, 3, 4]], max_new_tokens=3)
+    for _ in range(3):
+        loss = engine.forward(ids, labels)
+        engine.backward(loss)
+        engine.step()
+    out_after = engine.generate([[1, 2, 3, 4]], max_new_tokens=3)
+    # weights moved; the engine must not serve the stale view (tokens may
+    # coincide, so check the version bump rather than token inequality)
+    assert engine._gen_params_version == engine.global_steps
+    assert len(out_after[0]) == 7
+    # and generation still matches the CURRENT training weights
+    logits = engine.module.apply({"params": jax.tree_util.tree_map(np.asarray, engine.params)},
+                                 jnp.asarray([[1, 2, 3, 4]]))
+    assert out_after[0][4] == int(np.asarray(logits)[0, -1].argmax())
+
+
+def test_eos_stopping(engine):
+    prompt = [1, 5, 9]
+    full = engine.generate([prompt], max_new_tokens=8)
+    eos = full[0][3]  # first generated token
+    out = engine.generate([prompt], max_new_tokens=8, eos_token_id=eos)
+    assert len(out[0]) == 4  # stopped right after eos
+
+
+def test_sampled_generation_deterministic_by_seed(engine):
+    a = engine.generate([[1, 2, 3]], max_new_tokens=4, do_sample=True, seed=11)
+    b = engine.generate([[1, 2, 3]], max_new_tokens=4, do_sample=True, seed=11)
+    c = engine.generate([[1, 2, 3]], max_new_tokens=4, do_sample=True, seed=12)
+    assert a == b
+    assert isinstance(c[0], list)
