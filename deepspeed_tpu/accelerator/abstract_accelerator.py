@@ -1,0 +1,107 @@
+"""Accelerator abstraction.
+
+Reference: ``accelerator/abstract_accelerator.py:10 DeepSpeedAccelerator``
+— an ~80-method ABC because torch exposes device state imperatively
+(streams, events, RNG, allocator). Under XLA most of that surface is owned
+by the compiler, so the TPU ABC keeps the *decision points* that still
+exist: device identity/counts, memory stats, dtype support, RNG seeding,
+synchronization, host ("pinned") staging buffers, the communication-backend
+name, and op lookup. Stream/event methods exist as no-op shims for ported
+callers (XLA orders work by data dependence + donation; there is nothing to
+schedule by hand)."""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- device ----
+    @abc.abstractmethod
+    def device_name(self, device_index=None): ...
+
+    @abc.abstractmethod
+    def device_count(self): ...
+
+    @abc.abstractmethod
+    def current_device(self): ...
+
+    @abc.abstractmethod
+    def current_device_name(self): ...
+
+    def set_device(self, device_index):  # processes own all local chips
+        return None
+
+    @abc.abstractmethod
+    def is_available(self): ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None): ...
+
+    # ---- RNG ----
+    @abc.abstractmethod
+    def manual_seed(self, seed): ...
+
+    @abc.abstractmethod
+    def initial_seed(self): ...
+
+    # ---- memory ----
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None): ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None): ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None): ...
+
+    def memory_stats(self, device_index=None):
+        return {}
+
+    def empty_cache(self):
+        return None
+
+    # ---- dtype support ----
+    @abc.abstractmethod
+    def is_bf16_supported(self): ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self): ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self): ...
+
+    # ---- comm ----
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # ---- host staging ("pinned") memory ----
+    @abc.abstractmethod
+    def pin_memory(self, tensor, align_bytes=1): ...
+
+    @abc.abstractmethod
+    def is_pinned(self, tensor): ...
+
+    # ---- ops ----
+    @abc.abstractmethod
+    def create_op_builder(self, op_name): ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name): ...
+
+    # ---- stream/event shims (XLA owns scheduling) ----
+    def stream(self, stream):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def current_stream(self, device_index=None):
+        return None
+
+    def default_stream(self, device_index=None):
+        return None
+
+    def create_event(self, **kwargs):
+        return None

@@ -1,0 +1,44 @@
+"""Accelerator singleton.
+
+Reference: ``accelerator/real_accelerator.py:51 get_accelerator`` /
+``:207 set_accelerator`` — env override (``DS_ACCELERATOR``) then probe.
+On this stack "tpu" covers the XLA device whatever the backend reports
+(tpu/cpu/gpu); a CPU-flavored instance exists only so tests can assert the
+env-override path."""
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .tpu_accelerator import TPU_Accelerator
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+class CPU_Accelerator(TPU_Accelerator):
+    """XLA-on-CPU flavor (DS_ACCELERATOR=cpu); same mechanics via jax."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"  # reference cpu default name
+
+    def device_name(self, device_index=None):
+        return "cpu" if device_index is None else f"cpu:{device_index}"
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        name = os.environ.get("DS_ACCELERATOR", "tpu").lower()
+        _ACCELERATOR = CPU_Accelerator() if name == "cpu" else TPU_Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator().is_available()

@@ -1,0 +1,47 @@
+"""Progressive Layer Drop.
+
+Reference: ``runtime/progressive_layer_drop.py:10 ProgressiveLayerDrop`` —
+keep-probability schedule theta(t) = (1-theta)·exp(-gamma·t) + theta; layer
+i of L keeps with prob 1 - (i/L)(1-theta(t)). The schedule object is host
+state; the drop itself is a functional helper usable inside jit (bernoulli
+mask scaling the residual branch, identity at eval)."""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+
+def layer_drop_keep_prob(theta: float, layer_idx: int, num_layers: int) -> float:
+    """Per-layer keep probability (deeper layers drop more)."""
+    return 1.0 - (layer_idx / max(1, num_layers)) * (1.0 - theta)
+
+
+def apply_layer_drop(residual_out, x, keep_prob, rng_key, deterministic: bool = False):
+    """Stochastic-depth residual: x + m/p · f(x) with m~Bern(p) (train), or
+    x + f(x) (eval) — inverted scaling keeps expectation fixed."""
+    if deterministic:
+        return x + residual_out
+    keep = jax.random.bernoulli(rng_key, keep_prob)
+    scale = jnp.where(keep, 1.0 / keep_prob, 0.0).astype(residual_out.dtype)
+    return x + residual_out * scale

@@ -1,0 +1,49 @@
+"""Sparse tensor for embedding-style sparse gradients.
+
+Reference: ``runtime/sparse_tensor.py SparseTensor`` — wraps torch sparse
+grads so the engine's sparse allreduce (engine.py:2518) can gather
+index/value pairs. TPU version: a COO (indices, values, dense_shape) pytree;
+the sparse allreduce analog is an all_gather of indices+values followed by
+a segment-sum on device."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+
+    def __init__(self, indices, values, dense_shape: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)  # [nnz]
+        self.values = jnp.asarray(values)                     # [nnz, ...]
+        self.dense_shape = tuple(dense_shape)
+
+    @staticmethod
+    def from_dense(x, rows_nonzero=None) -> "SparseTensor":
+        """Row-sparse view (embedding grads are row-sparse)."""
+        if rows_nonzero is None:
+            rows_nonzero = jnp.nonzero(jnp.any(x != 0, axis=tuple(range(1, x.ndim))))[0]
+        return SparseTensor(rows_nonzero, x[rows_nonzero], x.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, dtype=self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> int:
+        return int(self.indices.size + self.values.size)
+
+    @property
+    def dense_size(self) -> int:
+        import numpy as np
+        return int(np.prod(self.dense_shape))
+
+    def __repr__(self):
+        return (f"SparseTensor(nnz={int(self.indices.size)}, "
+                f"dense_shape={self.dense_shape})")
+
+
+jax.tree_util.register_pytree_node(
+    SparseTensor,
+    lambda st: ((st.indices, st.values), st.dense_shape),
+    lambda shape, kids: SparseTensor(kids[0], kids[1], shape))

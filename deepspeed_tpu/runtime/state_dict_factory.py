@@ -1,0 +1,89 @@
+"""MP checkpoint merge/split at load time.
+
+Reference: ``runtime/state_dict_factory.py`` (SDLoaderFactory /
+MegatronSDLoader): load a checkpoint saved at TP degree N into a job running
+TP degree M by merging or splitting the parallel dimension of each
+column/row-parallel weight.
+
+TPU note: checkpoints written by THIS framework never need it — orbax stores
+full logical arrays. This exists for *imported* shard sets (Megatron-style
+per-rank files converted to numpy trees).
+"""
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def merge_parallel_dim(shards: Sequence[np.ndarray], axis: int) -> np.ndarray:
+    """Concatenate per-rank shards back to the full weight (ckpt_mp > run_mp
+    path of reference merge_state_dict)."""
+    return np.concatenate(list(shards), axis=axis)
+
+
+def split_parallel_dim(full: np.ndarray, num_shards: int, axis: int) -> List[np.ndarray]:
+    """Split a full weight for a larger TP degree (reference split_state_dict)."""
+    if full.shape[axis] % num_shards:
+        raise ValueError(f"dim {axis} of {full.shape} not divisible by {num_shards}")
+    return list(np.split(full, num_shards, axis=axis))
+
+
+class SDLoaderFactory:
+
+    @staticmethod
+    def get_sd_loader_json(json_or_dict, checkpoint_engine=None):
+        raise NotImplementedError("provide shard trees to SDLoader.merge/split directly")
+
+    @staticmethod
+    def get_sd_loader(ckpt_list, sd_type="Megatron", checkpoint_engine=None, version=None):
+        return SDLoader(ckpt_list)
+
+
+class SDLoader:
+    """Merge/split a list of per-TP-rank param trees (flat dicts
+    {name: array}) onto a target TP degree, with reference semantics:
+    column-parallel weights concatenate on the output dim, row-parallel on
+    the input dim, embeddings on the vocab dim."""
+
+    def __init__(self, shard_dicts: Sequence[Dict[str, np.ndarray]]):
+        self.shards = list(shard_dicts)
+
+    @staticmethod
+    def _axis_for(name: str, ndim: int) -> int:
+        from ..parallel.tp import _COL_PARALLEL, _ROW_PARALLEL
+        if _COL_PARALLEL.search(name):
+            return ndim - 1  # flax kernels [in, out]: output dim
+        if _ROW_PARALLEL.search(name):
+            return max(0, ndim - 2)  # input dim
+        if "embed" in name or "vocab" in name:
+            return 0
+        return -1  # replicated
+
+    def merge(self) -> Dict[str, np.ndarray]:
+        if len(self.shards) == 1:
+            return dict(self.shards[0])
+        out = {}
+        for name, w0 in self.shards[0].items():
+            axis = self._axis_for(name, w0.ndim)
+            parts = [sd[name] for sd in self.shards]
+            if axis < 0:
+                out[name] = w0  # replicated: any rank's copy
+            else:
+                out[name] = merge_parallel_dim(parts, axis)
+        return out
+
+    def split(self, num_shards: int) -> List[Dict[str, np.ndarray]]:
+        assert len(self.shards) == 1, "split() expects one merged tree"
+        full = self.shards[0]
+        outs = [dict() for _ in range(num_shards)]
+        for name, w in full.items():
+            axis = self._axis_for(name, w.ndim)
+            if axis < 0:
+                for o in outs:
+                    o[name] = w
+            else:
+                for o, part in zip(outs, split_parallel_dim(w, num_shards, axis)):
+                    o[name] = part
+        return outs

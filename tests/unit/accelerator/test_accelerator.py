@@ -1,0 +1,78 @@
+"""Accelerator abstraction tests (parity target: reference
+``tests/unit/accelerator/test_accelerator.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator
+from deepspeed_tpu.accelerator.real_accelerator import CPU_Accelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+@pytest.fixture(autouse=True)
+def reset_singleton():
+    yield
+    set_accelerator(None)  # type: ignore[arg-type]
+    import deepspeed_tpu.accelerator.real_accelerator as ra
+    ra._ACCELERATOR = None
+
+
+def test_singleton_and_override():
+    a = get_accelerator()
+    assert a is get_accelerator()
+    cpu = CPU_Accelerator()
+    set_accelerator(cpu)
+    assert get_accelerator() is cpu
+    assert cpu.communication_backend_name() == "gloo"
+
+
+def test_device_surface():
+    a = TPU_Accelerator()
+    assert a.is_available()
+    assert a.device_count() >= 1
+    assert a.device_name(2) in ("tpu:2", )
+    assert isinstance(a.current_device_name(), str)
+    a.synchronize()  # must not raise
+
+
+def test_dtype_support():
+    a = TPU_Accelerator()
+    assert a.is_bf16_supported()
+    import jax.numpy as jnp
+    assert jnp.bfloat16 in a.supported_dtypes()
+
+
+def test_rng():
+    a = TPU_Accelerator()
+    a.manual_seed(42)
+    assert a.initial_seed() == 42
+
+
+def test_pin_memory_alignment():
+    a = TPU_Accelerator()
+    x = np.random.default_rng(0).normal(size=(1000, )).astype(np.float32)
+    pinned = a.pin_memory(x, align_bytes=4096)
+    np.testing.assert_array_equal(pinned, x)
+    assert pinned.ctypes.data % 4096 == 0
+    assert a.is_pinned(pinned)
+
+
+def test_memory_stats_shape():
+    a = TPU_Accelerator()
+    assert a.memory_allocated() >= 0
+    assert isinstance(a.memory_stats(), dict)
+
+
+def test_op_builder_lookup():
+    a = TPU_Accelerator()
+    from deepspeed_tpu.ops import normalization  # noqa: F401 — registers rms_norm
+    info = a.get_op_builder("rms_norm")
+    assert info is not None and info.compatible
+    assert "rms_norm" in a.op_report()
+
+
+def test_stream_shims_are_noops():
+    a = TPU_Accelerator()
+    with a.stream(None):
+        pass
+    assert a.current_stream() is None and a.create_event() is None

@@ -1,0 +1,121 @@
+"""Sparse attention tests (parity target: reference
+``tests/unit/ops/sparse_attention/test_sparse_attention.py``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (SparseSelfAttention, sparse_attention,
+                                                DenseSparsityConfig, FixedSparsityConfig,
+                                                BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                VariableSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import layout_to_mask
+
+
+def qkv(b=2, h=4, s=64, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def dense_reference(q, k, v, mask):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    scores = jnp.where(jnp.asarray(mask)[None], scores, jnp.finfo(jnp.float32).min)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+
+class TestLayouts:
+
+    def test_dense_layout_all_ones(self):
+        lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert lay.shape == (2, 4, 4)
+        assert lay.sum() == 2 * 16
+
+    def test_fixed_window_and_global(self):
+        lay = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                                  num_global_blocks=1).make_layout(128)
+        nb = 8
+        # diagonal (own window) always on
+        for i in range(nb):
+            assert lay[0, i, i] == 1
+        # last row sees global block of window 0 (block 1)
+        assert lay[0, nb - 1, 1] == 1
+        # but not non-global distant block 0
+        assert lay[0, nb - 1, 0] == 0
+
+    def test_fixed_causal(self):
+        lay = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                                  attention="unidirectional").make_layout(128)
+        assert np.triu(lay[0], k=1).sum() == 0
+
+    def test_bigbird_components(self):
+        cfg = BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=1,
+                                    num_sliding_window_blocks=3, num_global_blocks=1)
+        lay = cfg.make_layout(128)
+        nb = 8
+        # global first block row+col
+        assert lay[0, 0].sum() == nb and lay[0, :, 0].sum() == nb
+        # window: diagonal on
+        assert all(lay[0, i, i] for i in range(nb))
+        # every row has >= window + random coverage
+        assert (lay[0].sum(-1) >= 2).all()
+
+    def test_longformer_spans(self):
+        lay = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0, 2],
+                                         global_block_end_indices=[1, 4]).make_layout(128)
+        assert lay[0, :, 0].all() and lay[0, 0].all()
+        assert lay[0, :, 2:4].all() and lay[0, 2:4].all()
+
+    def test_variable_windows(self):
+        lay = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=[1, 3]).make_layout(128)
+        # first window is 1 block; next 3 blocks form one group
+        assert lay[0, 1, 1] and lay[0, 1, 3] and lay[0, 3, 1]
+
+    def test_layout_to_mask(self):
+        lay = np.zeros((1, 2, 2), dtype=np.int64)
+        lay[0, 0, 0] = 1
+        m = layout_to_mask(lay, 4)
+        assert m.shape == (1, 8, 8)
+        assert m[0, :4, :4].all() and not m[0, 4:, :].any()
+
+
+class TestSparseAttention:
+
+    def test_dense_config_matches_full_attention(self):
+        q, k, v = qkv()
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=4, block=16))
+        out = attn(q, k, v)
+        ref = dense_reference(q, k, v, np.ones((4, 64, 64), bool))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_sparse_matches_masked_dense(self):
+        q, k, v = qkv()
+        cfg = BigBirdSparsityConfig(num_heads=4, block=16, num_sliding_window_blocks=3)
+        attn = SparseSelfAttention(cfg)
+        out = attn(q, k, v)
+        mask = layout_to_mask(attn.get_layout(64), 16)
+        ref = dense_reference(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_key_padding_mask(self):
+        q, k, v = qkv()
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=4, block=16))
+        kpm = jnp.asarray(np.r_[np.ones(48), np.zeros(16)], jnp.bool_)[None].repeat(2, 0)
+        out = attn(q, k, v, key_padding_mask=kpm)
+        # masked keys must not affect output: zero their values, same result
+        v2 = v.at[:, :, 48:, :].set(999.0)
+        out2 = attn(q, k, v2, key_padding_mask=kpm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5)
+
+    def test_jit_compatible(self):
+        q, k, v = qkv(s=32)
+        cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=1)
+        lay = cfg.make_layout(32)
+        f = jax.jit(lambda q, k, v: sparse_attention(q, k, v, lay, 16))
+        out = f(q, k, v)
+        assert out.shape == q.shape

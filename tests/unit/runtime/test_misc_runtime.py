@@ -1,0 +1,124 @@
+"""Misc-runtime tests: eigenvalue, PLD, state-dict factory, weight
+quantizer, sparse tensor (reference: scattered tests under
+tests/unit/runtime)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          layer_drop_keep_prob,
+                                                          apply_layer_drop)
+from deepspeed_tpu.runtime.state_dict_factory import (SDLoader, merge_parallel_dim,
+                                                      split_parallel_dim)
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+
+class TestEigenvalue:
+
+    def test_quadratic_exact(self):
+        """loss = 0.5 xᵀAx has Hessian A; power iteration finds max |eig|."""
+        A = np.diag([5.0, 2.0, 1.0]).astype(np.float32)
+
+        def loss(p):
+            return 0.5 * p["x"] @ jnp.asarray(A) @ p["x"]
+
+        ev = Eigenvalue(max_iter=200, tol=1e-5)
+        lam = ev.compute_eigenvalue(loss, {"x": jnp.ones(3, jnp.float32)})
+        assert abs(lam - 5.0) < 1e-2
+
+    def test_pytree_params(self):
+        def loss(p):
+            return jnp.sum(p["a"]**2) + 3.0 * jnp.sum(p["b"]**2)
+        lam = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+            loss, {"a": jnp.ones((4, )), "b": jnp.ones((2, 2))})
+        assert abs(lam - 6.0) < 5e-2  # Hessian diag: 2 and 6
+
+
+class TestPLD:
+
+    def test_theta_schedule_monotone(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        t0 = pld.update_state(0)
+        t100 = pld.update_state(100)
+        t1e4 = pld.update_state(10000)
+        assert t0 == pytest.approx(1.0)
+        assert t0 > t100 > t1e4
+        assert t1e4 == pytest.approx(0.5, abs=1e-3)
+        assert pld.get_state()["pld_theta"] == t1e4
+
+    def test_keep_prob_depth_scaling(self):
+        assert layer_drop_keep_prob(0.5, 0, 12) == pytest.approx(1.0)
+        assert layer_drop_keep_prob(0.5, 12, 12) == pytest.approx(0.5)
+
+    def test_apply_layer_drop(self):
+        x = jnp.ones((2, 4))
+        f = jnp.full((2, 4), 0.5)
+        out_eval = apply_layer_drop(f, x, 0.9, jax.random.PRNGKey(0), deterministic=True)
+        np.testing.assert_allclose(np.asarray(out_eval), 1.5)
+        # expectation preserved over many keys
+        outs = [np.asarray(apply_layer_drop(f, x, 0.7, jax.random.PRNGKey(i)))
+                for i in range(300)]
+        np.testing.assert_allclose(np.mean(outs), 1.5, atol=0.05)
+
+
+class TestSDLoader:
+
+    def test_merge_split_roundtrip(self):
+        full = {
+            "layers_0/self_attn/q_proj/kernel": np.arange(32, dtype=np.float32).reshape(4, 8),
+            "layers_0/self_attn/o_proj/kernel": np.arange(32, dtype=np.float32).reshape(8, 4),
+            "embed_tokens/embedding": np.arange(40, dtype=np.float32).reshape(10, 4),
+            "norm/weight": np.ones(4, np.float32),
+        }
+        shards = SDLoader([full]).split(2)
+        assert shards[0]["layers_0/self_attn/q_proj/kernel"].shape == (4, 4)  # col: out dim
+        assert shards[0]["layers_0/self_attn/o_proj/kernel"].shape == (4, 4)  # row: in dim
+        assert shards[0]["embed_tokens/embedding"].shape == (5, 4)            # vocab dim
+        assert shards[0]["norm/weight"].shape == (4, )                        # replicated
+        merged = SDLoader(shards).merge()
+        for k in full:
+            np.testing.assert_array_equal(merged[k], full[k])
+
+    def test_split_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            split_parallel_dim(np.ones((4, 6)), 4, axis=1)
+
+
+class TestWeightQuantizer:
+
+    def test_model_quantize_roundtrip_error(self):
+        rng = np.random.default_rng(0)
+        params = {"mlp": {"kernel": jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)},
+                  "norm": {"weight": jnp.ones(64, jnp.float32)}}
+        wq = WeightQuantization()
+        out = wq.model_quantize(params, bits=8, groups=4)
+        # 2D weights quantized (small error), 1D untouched
+        err = np.mean(np.abs(np.asarray(out["mlp"]["kernel"]) -
+                             np.asarray(params["mlp"]["kernel"])))
+        assert 0 < err < 0.02
+        np.testing.assert_array_equal(np.asarray(out["norm"]["weight"]), 1.0)
+
+
+class TestSparseTensor:
+
+    def test_from_dense_roundtrip(self):
+        x = np.zeros((10, 4), np.float32)
+        x[2] = 1.0
+        x[7] = 2.0
+        st = SparseTensor.from_dense(jnp.asarray(x))
+        assert int(st.indices.size) == 2
+        np.testing.assert_array_equal(np.asarray(st.to_dense()), x)
+        assert st.sparse_size() < st.dense_size
+
+    def test_duplicate_indices_accumulate(self):
+        st = SparseTensor([1, 1], [[1.0, 1.0], [2.0, 2.0]], (3, 2))
+        np.testing.assert_array_equal(np.asarray(st.to_dense())[1], [3.0, 3.0])
+
+    def test_pytree(self):
+        st = SparseTensor([0], [[1.0]], (2, 1))
+        st2 = jax.tree_util.tree_map(lambda x: x * 2, st)
+        np.testing.assert_array_equal(np.asarray(st2.values), [[2.0]])
