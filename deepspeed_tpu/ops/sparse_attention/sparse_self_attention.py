@@ -26,21 +26,30 @@ def layout_to_mask(layout: np.ndarray, block: int) -> np.ndarray:
 
 def sparse_attention(q, k, v, layout: np.ndarray, block: int,
                      key_padding_mask: Optional[jnp.ndarray] = None,
-                     scale: Optional[float] = None):
+                     scale: Optional[float] = None,
+                     key_padding_mask_mode: str = "mul"):
     """Masked attention under a block-sparse layout.
-    q,k,v: [batch, heads, seq, head_dim]; layout: [heads, nb, nb]."""
+    q,k,v: [batch, heads, seq, head_dim]; layout: [heads, nb, nb].
+    key_padding_mask [b, s]: mode 'mul' = keep-mask (True/1 = attend);
+    mode 'add' = additive float mask (0 = keep, large-negative = drop) —
+    the reference's two conventions (sparse_self_attention.py:12)."""
     b, h, s, d = q.shape
-    scale = scale or (1.0 / float(np.sqrt(d)))
-    mask = jnp.asarray(layout_to_mask(layout, block))[None]  # [1, h, s, s]
+    scale = scale if scale is not None else (1.0 / float(np.sqrt(d)))
+    visible = jnp.asarray(layout_to_mask(layout, block))[None]  # [1, h, s, s]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     neg = jnp.finfo(jnp.float32).min
-    scores = jnp.where(mask, scores, neg)
-    if key_padding_mask is not None:  # [b, s] True = keep
-        scores = jnp.where(key_padding_mask[:, None, None, :].astype(bool), scores, neg)
+    if key_padding_mask is not None:
+        kpm = key_padding_mask[:, None, None, :]
+        if key_padding_mask_mode == "add" and kpm.dtype != jnp.bool_:
+            scores = scores + kpm.astype(jnp.float32)
+            visible = visible & (kpm > -1.0)  # large-negative = masked out
+        else:  # keep-mask (bool is always keep-style, whatever the mode)
+            visible = visible & kpm.astype(bool)
+    scores = jnp.where(visible, scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
-    # rows with no visible key (fully masked) produce uniform probs; zero them
-    any_visible = mask.any(-1, keepdims=True)
+    # rows with no visible key at all would softmax to uniform; zero them
+    any_visible = visible.any(-1, keepdims=True)
     probs = jnp.where(any_visible, probs, 0.0).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
@@ -51,6 +60,8 @@ class SparseSelfAttention:
     def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
                  key_padding_mask_mode: str = "add", attn_mask_mode: str = "mul"):
         self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
         self._layout_cache = {}
 
     def get_layout(self, seq_len: int) -> np.ndarray:
@@ -62,7 +73,8 @@ class SparseSelfAttention:
         s = query.shape[2]
         layout = self.get_layout(s)
         return sparse_attention(query, key, value, layout,
-                                self.sparsity_config.block, key_padding_mask)
+                                self.sparsity_config.block, key_padding_mask,
+                                key_padding_mask_mode=self.key_padding_mask_mode)
 
 
 registry.register("sparse_attention", "xla", True,

@@ -205,8 +205,12 @@ class VariableSparsityConfig(SparsityConfig):
             layout[:, s:e, :] = 1
             layout[:, :, s:e] = 1
         if self.num_random_blocks:
-            rng = np.random.default_rng(self.seed)
+            shared = np.random.default_rng(self.seed)
             for h in range(self.num_heads):
+                # identical layout per head unless different_layout_per_head
+                # (same contract as BigBirdSparsityConfig)
+                rng = shared if self.different_layout_per_head \
+                    else np.random.default_rng(self.seed)
                 for i in range(nb):
                     cols = rng.choice(nb, size=min(self.num_random_blocks, nb),
                                       replace=False)

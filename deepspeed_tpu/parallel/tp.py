@@ -56,7 +56,8 @@ def heuristic_spec(path: str, shape: Sequence[int], mp_size: int) -> P:
     return P()
 
 
-def _path_str(path) -> str:
+def path_str(path) -> str:
+    """Public: jax key-path -> 'a/b/c' (shared by AutoTP + weight quantizer)."""
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
@@ -72,7 +73,7 @@ def tp_shardings(params: Any, ctx: MeshContext, logical_axes: Any = None,
             is_leaf=lambda x: x is None or isinstance(x, tuple))
 
     def _one(path, leaf):
-        return NamedSharding(ctx.mesh, heuristic_spec(_path_str(path), leaf.shape, mp))
+        return NamedSharding(ctx.mesh, heuristic_spec(path_str(path), leaf.shape, mp))
 
     return jax.tree_util.tree_map_with_path(_one, params)
 

@@ -43,7 +43,24 @@ class SparseTensor:
                 f"dense_shape={self.dense_shape})")
 
 
+class _StaticIndices:
+    """Hashable wrapper so indices live in pytree aux data — numeric
+    tree_maps (loss scaling, clipping, dtype casts) must only touch values;
+    mapping over indices would silently move entries to wrong rows."""
+
+    def __init__(self, arr):
+        import numpy as np
+        self.arr = np.asarray(arr, dtype=np.int32)
+        self._key = self.arr.tobytes()
+
+    def __eq__(self, other):
+        return isinstance(other, _StaticIndices) and self._key == other._key
+
+    def __hash__(self):
+        return hash(self._key)
+
+
 jax.tree_util.register_pytree_node(
     SparseTensor,
-    lambda st: ((st.indices, st.values), st.dense_shape),
-    lambda shape, kids: SparseTensor(kids[0], kids[1], shape))
+    lambda st: ((st.values, ), (_StaticIndices(st.indices), st.dense_shape)),
+    lambda aux, kids: SparseTensor(aux[0].arr, kids[0], aux[1]))

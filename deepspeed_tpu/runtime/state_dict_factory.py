@@ -53,6 +53,8 @@ class SDLoader:
     @staticmethod
     def _axis_for(name: str, ndim: int) -> int:
         from ..parallel.tp import _COL_PARALLEL, _ROW_PARALLEL
+        if ndim < 2:
+            return -1  # biases/norm scales replicate (matches tp.heuristic_spec)
         if _COL_PARALLEL.search(name):
             return ndim - 1  # flax kernels [in, out]: output dim
         if _ROW_PARALLEL.search(name):

@@ -32,8 +32,10 @@ class WeightQuantization:
         """Fake-quantize every matching weight in a tree (round-trip through
         int8) — the deployable-accuracy check MoQ runs during training."""
 
+        from ..parallel.tp import path_str
+
         def one(path, w):
-            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            name = path_str(path)
             if not hasattr(w, "ndim") or w.ndim < 2:
                 return w
             if predicate is not None and not predicate(name):

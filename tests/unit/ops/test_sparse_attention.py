@@ -112,6 +112,36 @@ class TestSparseAttention:
         out2 = attn(q, k, v2, key_padding_mask=kpm)
         np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5)
 
+    def test_fully_padded_visible_set_outputs_zero(self):
+        """Rows whose entire visible block set is padded must output 0, not
+        a uniform average over every (masked) key."""
+        q, k, v = qkv(b=1, s=32)
+        cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=1)
+        lay = cfg.make_layout(32)
+        # query block 0 sees only keys 0..15; pad them ALL out
+        kpm = jnp.asarray(np.r_[np.zeros(16), np.ones(16)], jnp.bool_)[None]
+        out = sparse_attention(q, k, v, lay, 16, key_padding_mask=kpm)
+        np.testing.assert_allclose(np.asarray(out)[0, :, :16, :], 0.0, atol=1e-6)
+
+    def test_additive_key_padding_mask(self):
+        q, k, v = qkv()
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=4, block=16),
+                                   key_padding_mask_mode="add")
+        add_mask = jnp.asarray(np.r_[np.zeros(48), np.full(16, -1e9)],
+                               jnp.float32)[None].repeat(2, 0)
+        keep_mask = jnp.asarray(np.r_[np.ones(48), np.zeros(16)], jnp.bool_)[None].repeat(2, 0)
+        out_add = attn(q, k, v, key_padding_mask=add_mask)
+        out_mul = SparseSelfAttention(DenseSparsityConfig(num_heads=4, block=16),
+                                      key_padding_mask_mode="mul")(
+            q, k, v, key_padding_mask=keep_mask)
+        np.testing.assert_allclose(np.asarray(out_add), np.asarray(out_mul),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_variable_random_identical_per_head(self):
+        lay = VariableSparsityConfig(num_heads=3, block=16, num_random_blocks=2,
+                                     different_layout_per_head=False).make_layout(128)
+        assert np.array_equal(lay[0], lay[1]) and np.array_equal(lay[1], lay[2])
+
     def test_jit_compatible(self):
         q, k, v = qkv(s=32)
         cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=1)

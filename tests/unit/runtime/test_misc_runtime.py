@@ -83,6 +83,15 @@ class TestSDLoader:
         for k in full:
             np.testing.assert_array_equal(merged[k], full[k])
 
+    def test_biases_replicate(self):
+        # 1-D row-parallel-named tensors must replicate, not shard
+        full = {"layers_0/self_attn/o_proj/bias": np.ones(4, np.float32)}
+        shards = SDLoader([full]).split(2)
+        assert shards[0]["layers_0/self_attn/o_proj/bias"].shape == (4, )
+        merged = SDLoader(shards).merge()
+        np.testing.assert_array_equal(merged["layers_0/self_attn/o_proj/bias"],
+                                      np.ones(4))
+
     def test_split_indivisible_raises(self):
         with pytest.raises(ValueError):
             split_parallel_dim(np.ones((4, 6)), 4, axis=1)
@@ -118,7 +127,9 @@ class TestSparseTensor:
         st = SparseTensor([1, 1], [[1.0, 1.0], [2.0, 2.0]], (3, 2))
         np.testing.assert_array_equal(np.asarray(st.to_dense())[1], [3.0, 3.0])
 
-    def test_pytree(self):
-        st = SparseTensor([0], [[1.0]], (2, 1))
+    def test_pytree_map_leaves_indices_alone(self):
+        st = SparseTensor([1], [[1.0]], (3, 1))
         st2 = jax.tree_util.tree_map(lambda x: x * 2, st)
         np.testing.assert_array_equal(np.asarray(st2.values), [[2.0]])
+        # indices are static aux data: numeric maps must NOT scale them
+        np.testing.assert_array_equal(np.asarray(st2.indices), [1])
