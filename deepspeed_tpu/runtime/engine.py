@@ -202,6 +202,12 @@ class DeepSpeedTpuEngine:
         self.zero_plan = ZeroShardingPlan(self.mesh_ctx, zc.stage,
                                           param_persistence_threshold=zc.param_persistence_threshold)
 
+        # ZeRO-Offload: optimizer states on host DRAM or NVMe (reference
+        # stage_1_and_2.py cpu-offload path + cpu_adam); frees HBM of the
+        # fp32 master + moments at the cost of a device<->host stream per step
+        self._offload_device = zc.offload_optimizer_device  # none | cpu | nvme
+        self._host_optimizer = None
+
         # ---- state init ----
         if model_parameters is None and _HAS_FLAX and isinstance(model, nn.Module):
             raise ValueError("model_parameters (the flax params pytree) is required")
@@ -266,10 +272,16 @@ class DeepSpeedTpuEngine:
                            out_shardings=self.grad_shardings)
         self.grad_acc = zeros_fn(self.params)
 
-        opt_state_shape = jax.eval_shape(self.base_tx.init, self.params)
-        self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
-        self.opt_state = jax.jit(self.base_tx.init,
-                                 out_shardings=self.opt_state_shardings)(self.params)
+        if self._offload_device in ("cpu", "nvme"):
+            # no device opt state at all — that's the HBM saving
+            self.opt_state = None
+            self.opt_state_shardings = None
+            self._build_host_optimizer(params)
+        else:
+            opt_state_shape = jax.eval_shape(self.base_tx.init, self.params)
+            self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
+            self.opt_state = jax.jit(self.base_tx.init,
+                                     out_shardings=self.opt_state_shardings)(self.params)
 
         # Pin every piece of loop-carried state to an explicit NamedSharding —
         # a leaf whose sharding differs between iterations (eager-created
@@ -280,6 +292,36 @@ class DeepSpeedTpuEngine:
         self.scale_state_shardings = jax.tree_util.tree_map(lambda _: repl,
                                                             tuple(self.scale_state))
         self._one = jax.device_put(jnp.float32(1.0), repl)
+
+    def _build_host_optimizer(self, params):
+        """ZeRO-Offload host optimizer (numpy Adam ≙ cpu_adam; NVMe moments
+        via the pipelined swapper when device=nvme)."""
+        import numpy as _np
+        from .host_offload import HostAdamOptimizer, flatten_tree
+        op = dict(self._config.optimizer_params or {})
+        name = (self._config.optimizer_name or "adamw").lower()
+        if name not in ("adam", "adamw"):
+            raise ValueError(f"optimizer offload supports adam/adamw, got {name}")
+        swapper = None
+        if self._offload_device == "nvme":
+            from .swap_tensor import PipelinedOptimizerSwapper, AioConfig
+            oc = self._config.zero_config.offload_optimizer
+            nvme_path = str(getattr(oc, "nvme_path", None) or "/tmp/ds_tpu_offload")
+            swapper = PipelinedOptimizerSwapper(
+                AioConfig(**(self._config._param_dict.get("aio", {}))),
+                swap_folder=nvme_path)
+        host_params = {k: _np.asarray(v, _np.float32)
+                       for k, v in flatten_tree(jax.tree_util.tree_map(
+                           _np.asarray, params)).items()}
+        self._host_optimizer = HostAdamOptimizer(
+            host_params,
+            lr=float(op.get("lr", 1e-3)),
+            betas=tuple(op.get("betas", (0.9, 0.999))),
+            eps=float(op.get("eps", 1e-8)),
+            weight_decay=float(op.get("weight_decay", 0.0)),
+            adamw_mode=(name == "adamw"),
+            nvme_swapper=swapper,
+            lr_fn=(lambda t: self.get_lr()[0]) if self.lr_scheduler is not None else None)
 
     # ------------------------------------------------------------------
     # compiled fns
@@ -357,12 +399,47 @@ class DeepSpeedTpuEngine:
         from .loss_scaler import LossScaleState
         scale_out = LossScaleState(*self.scale_state_shardings)
         repl = self.mesh_ctx.replicated()
+        if self._host_optimizer is not None:
+            # ZeRO-Offload: the optimizer step happens on host; no device
+            # apply program exists (its state would defeat the offload)
+            self._apply_step = None
+            self._train_step_fused = None
+            return
         self._apply_step = jax.jit(
             apply_step,
             donate_argnums=(0, 1, 2),
             out_shardings=(self.param_shardings, self.opt_state_shardings, self.grad_shardings,
                            scale_out, repl, repl),
         )
+
+        # gas=1 fast path: fwd+bwd+optimizer fused into ONE XLA program — no
+        # grad-accumulation buffer materialized in HBM and one dispatch per
+        # step instead of two (the reference necessarily splits these across
+        # host-driven kernel launches; under XLA the fusion is free win)
+        def train_step(params, opt_state, scale_state, args, kwargs):
+            scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
+            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params, args, kwargs, scale)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / scale, grads)
+            overflow = has_overflow(grads) if use_scaling else jnp.bool_(False)
+            gnorm = optax.global_norm(grads)
+            if clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if use_scaling:
+                new_params = _tree_where(overflow, params, new_params)
+                new_opt = _tree_where(overflow, opt_state, new_opt)
+            new_scale_state = scaler_cfg.update(scale_state, overflow)
+            return loss, new_params, new_opt, new_scale_state, overflow, gnorm
+
+        self._train_step_fused = jax.jit(
+            train_step,
+            donate_argnums=(0, 1),
+            out_shardings=(None, self.param_shardings, self.opt_state_shardings,
+                           scale_out, repl, repl),
+        ) if gas == 1 else None
 
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
@@ -414,8 +491,12 @@ class DeepSpeedTpuEngine:
         self.timers(STEP_MICRO_TIMER).start()
         if self.is_gradient_accumulation_boundary() and self.micro_steps > 0:
             self.tput_timer.start()
-            (self.params, self.opt_state, self.grad_acc, self.scale_state, overflow,
-             gnorm) = self._apply_step(self.params, self.grad_acc, self.opt_state, self.scale_state)
+            if self._host_optimizer is not None:
+                overflow, gnorm = self._host_offload_step()
+            else:
+                (self.params, self.opt_state, self.grad_acc, self.scale_state, overflow,
+                 gnorm) = self._apply_step(self.params, self.grad_acc, self.opt_state,
+                                           self.scale_state)
             self._last_grad_norm = gnorm
             if self._use_loss_scaling:
                 # host sync only for logging cadence; cheap scalar
@@ -440,6 +521,34 @@ class DeepSpeedTpuEngine:
                     ranks=[0])
         self.timers(STEP_MICRO_TIMER).stop()
 
+    def _host_offload_step(self):
+        """Device→host grads, numpy Adam, host→device params (ZeRO-Offload
+        step; reference stage_1_and_2.py cpu-offload + cpu_adam)."""
+        from .host_offload import flatten_tree, unflatten_like
+        scale = float(self.scale_state.cur_scale) if self._use_loss_scaling else 1.0
+        grads = {k: np.asarray(v, dtype=np.float32) / scale
+                 for k, v in flatten_tree(jax.tree_util.tree_map(
+                     np.asarray, self.grad_acc)).items()}
+        overflow = any(not np.all(np.isfinite(g)) for g in grads.values())
+        gnorm = float(np.sqrt(sum(float(np.sum(g.astype(np.float64)**2))
+                                  for g in grads.values())))
+        if not overflow:
+            clip = float(self._config.gradient_clipping or 0.0)
+            if clip > 0:
+                factor = min(1.0, clip / (gnorm + 1e-6))
+                for g in grads.values():
+                    g *= factor
+            master = self._host_optimizer.step(grads)
+            self.params = jax.device_put(
+                unflatten_like({k: jnp.asarray(v) for k, v in master.items()},
+                               self.params), self.param_shardings)
+        if self._use_loss_scaling:
+            self.scale_state = self.scaler_cfg.update(self.scale_state, jnp.bool_(overflow))
+        self.grad_acc = jax.tree_util.tree_map(
+            lambda g: jax.device_put(jnp.zeros(g.shape, g.dtype), g.sharding),
+            self.grad_acc)
+        return overflow, gnorm
+
     def _advance_schedule(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
@@ -447,6 +556,11 @@ class DeepSpeedTpuEngine:
     def train_batch(self, data_iter=None):
         """Pipeline-engine-style full batch step (reference pipe/engine.py:337):
         runs gradient_accumulation_steps micro-batches + the optimizer step."""
+        if self._train_step_fused is not None:
+            batch = next(data_iter)
+            if not isinstance(batch, tuple):
+                batch = (batch, )
+            return float(self.fused_train_step(*batch))
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(data_iter)
@@ -458,6 +572,32 @@ class DeepSpeedTpuEngine:
             losses.append(loss)  # device scalars; convert after the loop so
             # micro-steps pipeline instead of syncing the host every iteration
         return float(sum(float(l) for l in losses)) / self.gradient_accumulation_steps()
+
+    def fused_train_step(self, *args, **kwargs):
+        """One-program fwd+bwd+step (gas=1 only). Same semantics as
+        forward();backward();step() with one dispatch and no grad buffer."""
+        assert self._train_step_fused is not None, \
+            "fused_train_step requires gradient_accumulation_steps == 1"
+        self.tput_timer.start()
+        args = jax.device_put(args, self.zero_plan.batch_sharding(args))
+        kwargs = jax.device_put(kwargs, self.zero_plan.batch_sharding(kwargs))
+        (loss, self.params, self.opt_state, self.scale_state, overflow,
+         gnorm) = self._train_step_fused(self.params, self.opt_state, self.scale_state,
+                                         args, kwargs)
+        self._last_grad_norm = gnorm
+        self.losses = loss
+        self.micro_steps += 1
+        if self._use_loss_scaling and bool(overflow):
+            self.skipped_steps += 1
+        else:
+            self._advance_schedule()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(global_step=True)
+        if self.monitor is not None:
+            self.monitor.write_events([("Train/Samples/train_loss", float(loss),
+                                        self.global_samples)])
+        return loss
 
     def eval_batch(self, *args, **kwargs):
         """Forward-only compiled path for evaluation."""
@@ -508,12 +648,14 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
 
     def _state_dict(self):
-        return {
+        sd = {
             "params": self.params,
-            "opt_state": self.opt_state,
             "grad_acc": self.grad_acc,
             "scale_state": tuple(self.scale_state),
         }
+        if self.opt_state is not None:
+            sd["opt_state"] = self.opt_state
+        return sd
 
     def _host_state(self, client_state):
         sd = {
@@ -528,6 +670,8 @@ class DeepSpeedTpuEngine:
         }
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
             sd["lr_scheduler"] = self.lr_scheduler.state_dict()
+        if self._host_optimizer is not None:
+            sd["host_optimizer"] = self._host_optimizer.state_dict()
         return sd
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
@@ -583,10 +727,14 @@ class DeepSpeedTpuEngine:
         restored, host_state = self.checkpoint_engine.load(path, target=target)
         self.params = restored["params"]
         if load_optimizer_states and not load_module_only:
-            self.opt_state = restored["opt_state"]
+            if "opt_state" in restored:
+                self.opt_state = restored["opt_state"]
             self.grad_acc = restored["grad_acc"]
             from .loss_scaler import LossScaleState
             self.scale_state = LossScaleState(*restored["scale_state"])
+            if self._host_optimizer is not None and host_state \
+                    and "host_optimizer" in host_state:
+                self._host_optimizer.load_state_dict(host_state["host_optimizer"])
         client_state = {}
         if host_state:
             self.global_steps = host_state.get("global_steps", 0)

@@ -1,0 +1,132 @@
+"""ZeRO-Offload: optimizer stepping on the host CPU (optionally NVMe-backed).
+
+Reference: ``csrc/adam/cpu_adam_impl.cpp`` (AVX-vectorized host Adam) +
+``runtime/zero/stage_1_and_2.py`` cpu-offload grad path +
+``runtime/swap_tensor/partitioned_optimizer_swapper.py``. The point of
+ZeRO-Offload: fp32 master weights + Adam moments live in host DRAM (or
+NVMe), freeing HBM for params/activations; gradients stream device→host
+each boundary, the host does the optimizer math, updated weights stream
+back.
+
+TPU build: the host step is vectorized numpy (BLAS/SIMD under the hood —
+the same machine resources the reference's hand-written AVX loop uses).
+With ``device: nvme`` the moments round-trip through the C++ AIO swapper
+between steps, double-buffered per parameter group
+(``PipelinedOptimizerSwapper``).
+
+The math matches optax exactly (adam/adamw bias correction, decoupled
+weight decay) so host-offloaded runs are numerically interchangeable with
+on-device runs — verified by tests.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from ..utils.logging import logger
+
+
+class HostAdamOptimizer:
+    """fp32 master weights + moments on host; step() in numpy.
+
+    adam:  torch-style L2 (decay folded into the gradient).
+    adamw: decoupled decay (update includes wd·p scaled by lr) — optax.adamw.
+    """
+
+    def __init__(self, params_host: Dict[str, np.ndarray], lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 nvme_swapper=None, lr_fn=None):
+        self.master = {k: np.asarray(v, dtype=np.float32).copy()
+                       for k, v in params_host.items()}
+        self.lr = lr
+        self.lr_fn = lr_fn
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.wd = weight_decay
+        self.adamw_mode = adamw_mode
+        self.t = 0
+        self._swapper = nvme_swapper
+        if nvme_swapper is None:
+            self.m = {k: np.zeros_like(v) for k, v in self.master.items()}
+            self.v = {k: np.zeros_like(v) for k, v in self.master.items()}
+        else:  # moments live on NVMe between steps
+            self.m = self.v = None
+            for k, w in self.master.items():
+                nvme_swapper.swap_out_optimizer_state(
+                    k, {"exp_avg": np.zeros_like(w), "exp_avg_sq": np.zeros_like(w)})
+
+    def _cur_lr(self) -> float:
+        return float(self.lr_fn(self.t)) if self.lr_fn is not None else self.lr
+
+    def _step_one(self, name: str, g: np.ndarray, m: np.ndarray, v: np.ndarray):
+        p = self.master[name]
+        if self.wd and not self.adamw_mode:
+            g = g + self.wd * p  # L2 into the gradient (torch Adam)
+        m *= self.b1
+        m += (1 - self.b1) * g
+        v *= self.b2
+        v += (1 - self.b2) * g * g
+        mhat = m / (1 - self.b1**self.t)
+        vhat = v / (1 - self.b2**self.t)
+        update = mhat / (np.sqrt(vhat) + self.eps)
+        if self.wd and self.adamw_mode:
+            update = update + self.wd * p
+        p -= self._cur_lr() * update
+        return m, v
+
+    def step(self, grads_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """One optimizer step over all params; returns the updated master."""
+        self.t += 1
+        if self._swapper is None:
+            for k, g in grads_host.items():
+                self._step_one(k, np.asarray(g, np.float32), self.m[k], self.v[k])
+        else:
+            names = list(grads_host.keys())
+            # pipelined: prefetch next group's moments while stepping current
+            self._swapper._swapper.swap_in([f"{names[0]}.exp_avg", f"{names[0]}.exp_avg_sq"],
+                                           async_op=True)
+            for i, k in enumerate(names):
+                if i + 1 < len(names):
+                    nxt = names[i + 1]
+                    self._swapper._swapper.swap_in([f"{nxt}.exp_avg", f"{nxt}.exp_avg_sq"],
+                                                   async_op=True)
+                state = {kk: self._swapper._swapper.retrieve(f"{k}.{kk}")
+                         for kk in ("exp_avg", "exp_avg_sq")}
+                m, v = self._step_one(k, np.asarray(grads_host[k], np.float32),
+                                      state["exp_avg"], state["exp_avg_sq"])
+                for kk, arr in (("exp_avg", m), ("exp_avg_sq", v)):
+                    self._swapper._swapper.swap_out_and_release(f"{k}.{kk}", arr)
+            self._swapper._swapper.synchronize_writes()
+        return self.master
+
+    def state_dict(self) -> dict:
+        sd = {"t": self.t, "master": self.master}
+        if self._swapper is None:
+            sd["m"], sd["v"] = self.m, self.v
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.t = sd["t"]
+        self.master = {k: np.asarray(v, np.float32) for k, v in sd["master"].items()}
+        if self._swapper is None and "m" in sd:
+            self.m, self.v = sd["m"], sd["v"]
+
+
+def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flatten_tree(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def unflatten_like(flat: Dict[str, np.ndarray], like):
+    def rebuild(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: rebuild(v, f"{prefix}{k}.") for k, v in node.items()}
+        return flat[prefix[:-1]]
+    return rebuild(like)
