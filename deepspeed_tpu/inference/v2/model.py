@@ -149,9 +149,16 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, b
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
         h = rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        q = (h @ lp["self_attn"]["q_proj"]["kernel"]).reshape(T, nq, hd)
-        k = (h @ lp["self_attn"]["k_proj"]["kernel"]).reshape(T, nkv, hd)
-        v = (h @ lp["self_attn"]["v_proj"]["kernel"]).reshape(T, nkv, hd)
+
+        def proj(name, heads):
+            y = h @ lp["self_attn"][name]["kernel"]
+            if "bias" in lp["self_attn"][name]:  # qwen2-style qkv bias
+                y = y + lp["self_attn"][name]["bias"]
+            return y.reshape(T, heads, hd)
+
+        q = proj("q_proj", nq)
+        k = proj("k_proj", nkv)
+        v = proj("v_proj", nkv)
         q = _rope_tok(q, cos, sin, batch.token_pos)
         k = _rope_tok(k, cos, sin, batch.token_pos)
 

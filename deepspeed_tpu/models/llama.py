@@ -42,6 +42,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
+    attention_bias: bool = False  # qwen2-style qkv biases
     dtype: Any = jnp.bfloat16
     scan_layers: bool = False
     remat: bool = False
@@ -101,9 +102,10 @@ class RMSNorm(nn.Module):
         return (out * scale).astype(self.dtype)
 
 
-def _dense(features, name, axes, dtype):
-    return nn.Dense(features, use_bias=False, dtype=dtype, name=name,
-                    kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), axes))
+def _dense(features, name, axes, dtype, use_bias=False):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name,
+                    kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), axes),
+                    bias_init=nn.with_partitioning(nn.initializers.zeros, (axes[-1], )))
 
 
 class LlamaAttention(nn.Module):
@@ -116,9 +118,9 @@ class LlamaAttention(nn.Module):
         hd = cfg.head_dim_
         nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
 
-        q = _dense(nq * hd, "q_proj", (EMBED, HEADS), cfg.dtype)(x)
-        k = _dense(nkv * hd, "k_proj", (EMBED, KV), cfg.dtype)(x)
-        v = _dense(nkv * hd, "v_proj", (EMBED, KV), cfg.dtype)(x)
+        q = _dense(nq * hd, "q_proj", (EMBED, HEADS), cfg.dtype, cfg.attention_bias)(x)
+        k = _dense(nkv * hd, "k_proj", (EMBED, KV), cfg.dtype, cfg.attention_bias)(x)
+        v = _dense(nkv * hd, "v_proj", (EMBED, KV), cfg.dtype, cfg.attention_bias)(x)
 
         q = q.reshape(b, s, nq, hd)
         k = k.reshape(b, s, nkv, hd)

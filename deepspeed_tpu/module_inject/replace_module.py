@@ -58,16 +58,12 @@ def convert_hf_checkpoint(arch: str,
     for hf_name, (flax_path, tr) in policy.global_map(cfg.tie_word_embeddings).items():
         take(hf_name, flax_path, tr)
     for layer in range(cfg.num_hidden_layers):
-        for hf_name, (flax_path, tr) in policy.weight_map(layer).items():
+        for hf_name, (flax_path, tr) in policy.weight_map(
+                layer, attention_bias=cfg.attention_bias).items():
             take(hf_name, flax_path, tr)
 
     leftovers = [k for k in hf_state_dict if k not in consumed
                  and not k.endswith("rotary_emb.inv_freq")]
-    bias_leftovers = [k for k in leftovers if k.endswith(".bias")]
-    if bias_leftovers and policy.supports_bias:
-        logger.warning(f"{arch}: dropping {len(bias_leftovers)} bias tensors "
-                       "(flax model is bias-free; affects logits slightly)")
-        leftovers = [k for k in leftovers if k not in bias_leftovers]
     if leftovers:
         logger.warning(f"unconverted HF tensors: {leftovers[:8]}"
                        f"{'...' if len(leftovers) > 8 else ''}")
@@ -92,7 +88,7 @@ def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[s
     out = {}
     maps = dict(policy.global_map(config.tie_word_embeddings))
     for layer in range(config.num_hidden_layers):
-        maps.update(policy.weight_map(layer))
+        maps.update(policy.weight_map(layer, attention_bias=config.attention_bias))
     for hf_name, (flax_path, transpose) in maps.items():
         w = flat[flax_path]
         out[hf_name] = w.T if transpose else w

@@ -47,11 +47,16 @@ class HFCheckpointPolicy:
             tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
         )
 
-    def weight_map(self, layer: int) -> Dict[str, Tuple[str, bool]]:
+    def weight_map(self, layer: int, attention_bias: bool = False
+                   ) -> Dict[str, Tuple[str, bool]]:
         """HF name -> (flax path under params['model'], transpose?)."""
         p = f"model.layers.{layer}."
         f = f"layers_{layer}/"
-        return {
+        out = {}
+        if attention_bias:  # qwen2-style qkv biases (1-D: no transpose)
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                out[p + f"self_attn.{proj}.bias"] = (f + f"self_attn/{proj}/bias", False)
+        out.update({
             p + "self_attn.q_proj.weight": (f + "self_attn/q_proj/kernel", True),
             p + "self_attn.k_proj.weight": (f + "self_attn/k_proj/kernel", True),
             p + "self_attn.v_proj.weight": (f + "self_attn/v_proj/kernel", True),
@@ -62,7 +67,8 @@ class HFCheckpointPolicy:
             p + "input_layernorm.weight": (f + "input_layernorm/weight", False),
             p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/weight",
                                                     False),
-        }
+        })
+        return out
 
     def global_map(self, tie_embeddings: bool) -> Dict[str, Tuple[str, bool]]:
         out = {
@@ -89,10 +95,14 @@ class MistralPolicy(HFCheckpointPolicy):
 
 
 class Qwen2Policy(HFCheckpointPolicy):
-    """Qwen2 adds attention qkv biases (reference containers/qwen2); biases
-    are folded away with a warning until the flax model grows bias support."""
+    """Qwen2 adds attention qkv biases (reference containers/qwen2)."""
     arch = "qwen2"
     supports_bias = True
+
+    def config_from_hf(self, hf_config):
+        cfg = super().config_from_hf(hf_config)
+        import dataclasses
+        return dataclasses.replace(cfg, attention_bias=True)
 
 
 class Gemma2Policy(HFCheckpointPolicy):

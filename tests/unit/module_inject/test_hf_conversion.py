@@ -78,6 +78,41 @@ def test_export_roundtrip(tiny_hf_llama):
                                    err_msg=name)
 
 
+def test_qwen2_bias_logits_match_hf():
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hf_model = transformers.Qwen2ForCausalLM(cfg).eval()
+    # randomize biases so the test actually exercises them
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if n.endswith("bias"):
+                p.normal_(0, 0.5)
+    ours_cfg, params = convert_hf_checkpoint("qwen2", hf_model.state_dict(),
+                                             cfg.to_dict())
+    assert ours_cfg.attention_bias
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    ours = LlamaForCausalLM(dataclasses.replace(ours_cfg, dtype=jnp.float32))
+    ids = np.array([[1, 5, 9, 42, 17, 3]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+    # and through the ragged paged-KV engine
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(dataclasses.replace(ours_cfg, dtype=jnp.float32),
+                             params=params, dtype=jnp.float32, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    logits = np.asarray(eng.put([0], [ids[0]]))[0]
+    np.testing.assert_allclose(logits, ref[0, -1], rtol=2e-3, atol=2e-3)
+
+
 def test_missing_weight_raises(tiny_hf_llama):
     hf_model, hf_cfg = tiny_hf_llama
     sd = dict(hf_model.state_dict())
