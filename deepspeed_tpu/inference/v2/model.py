@@ -183,8 +183,22 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, b
         x = x + ctx_tok @ lp["self_attn"]["o_proj"]["kernel"]
 
         h2 = rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(h2 @ lp["mlp"]["gate_proj"]["kernel"])
-        x = x + ((gate * (h2 @ lp["mlp"]["up_proj"]["kernel"])) @ lp["mlp"]["down_proj"]["kernel"])
+        if cfg.num_local_experts > 0:  # Mixtral MoE block (matches models/llama.py)
+            moe = lp["block_sparse_moe"]
+            logits = h2.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1)
+            w, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+            w = (w / jnp.sum(w, -1, keepdims=True)).astype(x.dtype)
+            cw = jnp.sum(w[..., None] *
+                         jax.nn.one_hot(idx, cfg.num_local_experts, dtype=x.dtype), axis=-2)
+            act = jax.nn.silu(jnp.einsum("th,ehf->tef", h2, moe["w1"])) * \
+                jnp.einsum("th,ehf->tef", h2, moe["w3"])
+            y = jnp.einsum("tef,efh->teh", act, moe["w2"])
+            x = x + jnp.einsum("te,teh->th", cw, y)
+        else:
+            gate = jax.nn.silu(h2 @ lp["mlp"]["gate_proj"]["kernel"])
+            x = x + ((gate * (h2 @ lp["mlp"]["up_proj"]["kernel"]))
+                     @ lp["mlp"]["down_proj"]["kernel"])
 
     x = rms_norm(x, p["norm"]["weight"], cfg.rms_norm_eps)
     final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]

@@ -43,6 +43,8 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2-style qkv biases
+    num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
+    num_experts_per_tok: int = 2
     dtype: Any = jnp.bfloat16
     scan_layers: bool = False
     remat: bool = False
@@ -150,6 +152,40 @@ class LlamaMLP(nn.Module):
         return _dense(cfg.hidden_size, "down_proj", (HIDDEN, EMBED), cfg.dtype)(nn.silu(gate) * up)
 
 
+class LlamaMoEBlock(nn.Module):
+    """Mixtral-style sparse MoE MLP (reference moe/sharded_moe.py gating +
+    module_inject/containers mixtral): softmax router over E experts, top-k
+    renormalized combine. Compute is dense-over-experts with a one-hot
+    combine — capacity-free and exactly matches the reference's token-choice
+    semantics; the megablocks-style grouped matmul is the perf upgrade slot.
+    Expert weights carry the 'expert' logical axis so EP sharding is a mesh
+    rule like everything else."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        E, k = cfg.num_local_experts, cfg.num_experts_per_tok
+        H, F = cfg.hidden_size, cfg.intermediate_size
+        logits = _dense(E, "gate", (EMBED, "expert"), jnp.float32)(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        w, idx = jax.lax.top_k(probs, k)
+        w = (w / jnp.sum(w, -1, keepdims=True)).astype(cfg.dtype)  # renormalize top-k
+        cw = jnp.sum(w[..., None] * jax.nn.one_hot(idx, E, dtype=cfg.dtype), axis=-2)
+
+        init = nn.with_partitioning(nn.initializers.lecun_normal(), ("expert", EMBED, HIDDEN))
+        w1 = self.param("w1", init, (E, H, F), jnp.float32).astype(cfg.dtype)
+        w3 = self.param("w3", init, (E, H, F), jnp.float32).astype(cfg.dtype)
+        w2 = self.param("w2",
+                        nn.with_partitioning(nn.initializers.lecun_normal(),
+                                             ("expert", HIDDEN, EMBED)),
+                        (E, F, H), jnp.float32).astype(cfg.dtype)
+        act = nn.silu(jnp.einsum("...h,ehf->...ef", x, w1)) * \
+            jnp.einsum("...h,ehf->...ef", x, w3)
+        y = jnp.einsum("...ef,efh->...eh", act, w2)
+        return jnp.einsum("...e,...eh->...h", cw, y)
+
+
 class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
 
@@ -159,8 +195,11 @@ class LlamaDecoderLayer(nn.Module):
         h = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), cos, sin, positions,
             attn_mask)
-        h = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h))
+        normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
+        if cfg.num_local_experts > 0:
+            h = h + LlamaMoEBlock(cfg, name="block_sparse_moe")(normed)
+        else:
+            h = h + LlamaMLP(cfg, name="mlp")(normed)
         return h
 
 

@@ -61,6 +61,14 @@ def convert_hf_checkpoint(arch: str,
         for hf_name, (flax_path, tr) in policy.weight_map(
                 layer, attention_bias=cfg.attention_bias).items():
             take(hf_name, flax_path, tr)
+        if hasattr(policy, "moe_map") and cfg.num_local_experts > 0:
+            gate, experts = policy.moe_map(layer, cfg.num_local_experts)
+            for hf_name, (flax_path, tr) in gate.items():
+                take(hf_name, flax_path, tr)
+            for flax_path, hf_names in experts.items():
+                stacked = np.stack([_to_numpy(hf_state_dict[n]).T for n in hf_names])
+                flat[flax_path] = stacked.astype(np.float32)  # [E, in, out]
+                consumed.update(hf_names)
 
     leftovers = [k for k in hf_state_dict if k not in consumed
                  and not k.endswith("rotary_emb.inv_freq")]
@@ -89,6 +97,13 @@ def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[s
     maps = dict(policy.global_map(config.tie_word_embeddings))
     for layer in range(config.num_hidden_layers):
         maps.update(policy.weight_map(layer, attention_bias=config.attention_bias))
+        if hasattr(policy, "moe_map") and config.num_local_experts > 0:
+            gate, experts = policy.moe_map(layer, config.num_local_experts)
+            maps.update(gate)
+            for flax_path, hf_names in experts.items():
+                stacked = flat[flax_path]  # [E, in, out]
+                for e, hf_name in enumerate(hf_names):
+                    out[hf_name] = stacked[e].T
     for hf_name, (flax_path, transpose) in maps.items():
         w = flat[flax_path]
         out[hf_name] = w.T if transpose else w

@@ -105,6 +105,35 @@ class Qwen2Policy(HFCheckpointPolicy):
         return dataclasses.replace(cfg, attention_bias=True)
 
 
+class MixtralPolicy(HFCheckpointPolicy):
+    """Mixtral: llama attention + sparse-MoE MLP (reference
+    inference/v2/model_implementations/mixtral). Per-expert HF tensors are
+    stacked into [E, ...] arrays — the layout the grouped einsum consumes."""
+    arch = "mixtral"
+
+    def config_from_hf(self, hf_config):
+        cfg = super().config_from_hf(hf_config)
+        import dataclasses
+        return dataclasses.replace(
+            cfg, num_local_experts=hf_config.get("num_local_experts", 8),
+            num_experts_per_tok=hf_config.get("num_experts_per_tok", 2))
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        # mixtral has no dense mlp — drop those entries
+        return {k: v for k, v in out.items() if ".mlp." not in k}
+
+    def moe_map(self, layer: int, num_experts: int):
+        """HF names → (flax path, stacking) for the MoE block."""
+        p = f"model.layers.{layer}.block_sparse_moe."
+        f = f"layers_{layer}/block_sparse_moe/"
+        gate = {p + "gate.weight": (f + "gate/kernel", True)}
+        experts = {}
+        for which, tr in (("w1", True), ("w2", True), ("w3", True)):
+            experts[f + which] = [p + f"experts.{e}.{which}.weight" for e in range(num_experts)]
+        return gate, experts
+
+
 class Gemma2Policy(HFCheckpointPolicy):
     """Gemma-2: llama-family graph with tied embeddings by default."""
     arch = "gemma2"
@@ -122,6 +151,8 @@ _POLICIES = {
     "MistralForCausalLM": MistralPolicy,
     "qwen2": Qwen2Policy,
     "Qwen2ForCausalLM": Qwen2Policy,
+    "mixtral": MixtralPolicy,
+    "MixtralForCausalLM": MixtralPolicy,
     "gemma2": Gemma2Policy,
     "Gemma2ForCausalLM": Gemma2Policy,
 }
