@@ -92,3 +92,38 @@ def dequantize_int8_blockwise(values, scales, shape, block_size: int = 2048,
 
 
 registry.register("quantizer_int8", "pallas" if _HAS_PLTPU else "xla", True)
+
+
+# ---------------------------------------------------------------- FP8/FP quant
+
+def quantize_fp8(x, dtype=jnp.float8_e4m3fn, block_size: int = 2048):
+    """Blockwise-scaled FP8 quantization.
+
+    Reference ``csrc/fp_quantizer/fp_quantize.cu`` (FP6-LLM-style low-bit
+    float formats for weights). TPU-native version targets the hardware's
+    fp8 dtypes (e4m3 for weights/activations, e5m2 for gradients); blocks
+    are scaled so the absmax maps to the format's max normal, preserving
+    dynamic range the way the reference's per-group scales do. FP6 packing
+    has no TPU dtype — e4m3 is the native equivalent tier.
+
+    Returns (values: dtype, scales: f32 per block).
+    """
+    finfo_max = float(jnp.finfo(dtype).max)
+    flat = x.reshape(-1)
+    padded, _ = _pad_to_blocks(flat, block_size)
+    blocks = padded.reshape(-1, block_size).astype(jnp.float32)
+    scales = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / finfo_max
+    scales = jnp.maximum(scales, 1e-12)
+    values = (blocks / scales).astype(dtype)
+    return values, scales[:, 0]
+
+
+def dequantize_fp8(values, scales, shape, block_size: int = 2048):
+    """Inverse of quantize_fp8."""
+    blocks = values.astype(jnp.float32) * scales[:, None]
+    import numpy as _np
+    n = int(_np.prod(shape))
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+registry.register("fp_quantizer", "xla", True, "fp8 e4m3/e5m2 (fp6 has no TPU dtype)")

@@ -687,6 +687,30 @@ class DeepSpeedTpuEngine:
         self.checkpoint_engine.commit(tag)
         return True
 
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz",
+                         exclude_frozen_parameters=False):
+        """Consolidated 16-bit weight export (reference engine.py:3538
+        _zero3_consolidated_16bit_state_dict + save_16bit_model): gathers
+        the full (unsharded) bf16 weights and writes one flat archive a
+        serving stack can load without the training topology."""
+        from ..checkpoint.universal import _flatten
+        os.makedirs(save_dir, exist_ok=True)
+        # npz can't hold ml_dtypes.bfloat16 — store the bf16 bit pattern as
+        # uint16 with a dtype sidecar key (fp16 stores natively)
+        bf16 = self.compute_dtype == jnp.bfloat16
+        sd = {}
+        for k, v in _flatten(jax.tree_util.tree_map(np.asarray, self.params)).items():
+            if bf16:
+                import ml_dtypes
+                sd[k] = np.asarray(v).astype(ml_dtypes.bfloat16).view(np.uint16)
+            else:
+                sd[k] = np.asarray(v).astype(np.float16)
+        sd["__dtype__"] = np.asarray("bfloat16" if bf16 else "float16")
+        path = os.path.join(save_dir, save_filename)
+        np.savez(path, **sd)
+        log_dist(f"saved 16-bit model to {path} ({len(sd)} tensors)", ranks=[0])
+        return True
+
     def load_universal_checkpoint(self, universal_dir):
         """Resume from a universal checkpoint at ANY parallelism (reference
         bf16_optimizer.py:519 load_hp_checkpoint_state / universal_checkpoint

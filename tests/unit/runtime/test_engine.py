@@ -173,3 +173,28 @@ def test_eval_batch_no_state_change():
     assert np.isfinite(float(out))
     jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
                            engine.params, p0)
+
+
+def test_save_16bit_model(tmp_path):
+    import ml_dtypes
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(bf16={"enabled": True},
+                           zero_optimization={"stage": 3,
+                                              "stage3_param_persistence_threshold": 0}))
+    train_steps(engine, n=1)
+    assert engine.save_16bit_model(str(tmp_path), "model.npz")
+    archive = np.load(tmp_path / "model.npz")
+    assert str(archive["__dtype__"]) == "bfloat16"
+    names = [k for k in archive.files if k != "__dtype__"]
+    assert len(names) == len(jax.tree_util.tree_leaves(params))
+    # bf16 bit pattern decodes to the live weights
+    live = {}
+    from deepspeed_tpu.checkpoint.universal import _flatten
+    live = _flatten(jax.tree_util.tree_map(np.asarray, engine.params))
+    for k in names:
+        got = archive[k].view(ml_dtypes.bfloat16).astype(np.float32)
+        np.testing.assert_allclose(got, live[k], rtol=1e-2, atol=1e-2)
