@@ -128,12 +128,12 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, b
     # dense slot grid for history gather: [S, L]
     j = jnp.arange(L, dtype=jnp.int32)
     slot_grid = batch.block_table[:, j // block_size] * block_size + j % block_size
-    # per-seq query gather indices: [S, N]. N=T is the safe worst case (one
-    # sequence owning the whole batch); decode-heavy batches waste S× here —
-    # the Pallas blocked-flash decode kernel is the planned fix.
-    N = T
+    # per-seq query gather indices come host-precomputed as [S, N] where N
+    # buckets the largest burst — N=1 for pure decode, so the attention
+    # einsum is S×1×L instead of S×T×L (the decode fast path)
+    q_tok_idx = batch.q_tok_idx
+    N = q_tok_idx.shape[1]
     n_idx = jnp.arange(N, dtype=jnp.int32)
-    q_tok_idx = jnp.clip(batch.seq_start[:, None] + n_idx[None, :], 0, T - 1)  # [S, N]
     q_valid = n_idx[None, :] < batch.seq_n_new[:, None]  # [S, N]
     q_abs = batch.seq_seen[:, None] + n_idx[None, :]  # absolute positions [S, N]
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]  # slot j holds abs pos j

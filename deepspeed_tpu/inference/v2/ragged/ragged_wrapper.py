@@ -40,10 +40,14 @@ class RaggedBatch(NamedTuple):
     seq_seen: jnp.ndarray      # int32 [S] history length
     block_table: jnp.ndarray   # int32 [S, B]
     last_token_idx: jnp.ndarray  # int32 [S] token index of final token
+    q_tok_idx: jnp.ndarray     # int32 [S, N] token index of each seq's n-th
+    # new token (N buckets the max burst: 1 for pure decode — the attention
+    # einsum is S×N×L, so N decoupled from T is the decode fast path)
 
     @property
     def bucket_key(self):
-        return (self.tokens.shape[0], self.seq_start.shape[0], self.block_table.shape[1])
+        return (self.tokens.shape[0], self.seq_start.shape[0],
+                self.block_table.shape[1], self.q_tok_idx.shape[1])
 
 
 class RaggedBatchWrapper:
@@ -87,6 +91,8 @@ class RaggedBatchWrapper:
         max_blocks = max((s.cur_allocated_blocks for s in self._seqs), default=1)
         B = _bucket(max(1, max_blocks), floor=1)
 
+        N = _bucket(max((t.size for t in self._token_lists), default=1), floor=1)
+
         tokens = np.zeros(T, dtype=np.int32)
         token_seq = np.zeros(T, dtype=np.int32)
         token_pos = np.zeros(T, dtype=np.int32)
@@ -96,6 +102,7 @@ class RaggedBatchWrapper:
         seq_seen = np.zeros(S, dtype=np.int32)
         block_table = np.zeros((S, B), dtype=np.int32)
         last_token_idx = np.zeros(S, dtype=np.int32)
+        q_tok_idx = np.zeros((S, N), dtype=np.int32)
 
         cursor = 0
         for i, (seq, toks) in enumerate(zip(self._seqs, self._token_lists)):
@@ -111,6 +118,7 @@ class RaggedBatchWrapper:
             token_pos[cursor:cursor + n] = pos
             token_slot[cursor:cursor + n] = bt[pos // bs] * bs + pos % bs
             last_token_idx[i] = cursor + n - 1
+            q_tok_idx[i, :n] = cursor + np.arange(n, dtype=np.int32)
             cursor += n
 
         self._batch = RaggedBatch(
@@ -118,7 +126,7 @@ class RaggedBatchWrapper:
             token_pos=jnp.asarray(token_pos), token_slot=jnp.asarray(token_slot),
             seq_start=jnp.asarray(seq_start), seq_n_new=jnp.asarray(seq_n_new),
             seq_seen=jnp.asarray(seq_seen), block_table=jnp.asarray(block_table),
-            last_token_idx=jnp.asarray(last_token_idx))
+            last_token_idx=jnp.asarray(last_token_idx), q_tok_idx=jnp.asarray(q_tok_idx))
         return self._batch
 
     @property

@@ -127,3 +127,36 @@ def dequantize_fp8(values, scales, shape, block_size: int = 2048):
 
 
 registry.register("fp_quantizer", "xla", True, "fp8 e4m3/e5m2 (fp6 has no TPU dtype)")
+
+
+# ------------------------------------------------------- int4 (WoQ) packing
+
+def quantize_int4_blockwise(x, block_size: int = 2048):
+    """Weight-only INT4: symmetric per-block quant to [-7, 7], two nibbles
+    packed per int8 byte (reference ``inference/quantization`` WoQ int4 and
+    ``quantize_intX.cu``). Returns (packed int8 [N/2], scales f32)."""
+    flat = x.reshape(-1)
+    padded, _ = _pad_to_blocks(flat, block_size)
+    blocks = padded.reshape(-1, block_size).astype(jnp.float32)
+    scales = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1, keepdims=True), 1e-12) / 7.0
+    q = jnp.clip(jnp.round(blocks / scales), -7, 7).astype(jnp.int8)  # [-7,7]
+    q = q.reshape(-1)
+    # pack: low nibble = even idx, high nibble = odd idx (offset-8 unsigned)
+    u = (q + 8).astype(jnp.uint8)
+    packed = (u[0::2] | (u[1::2] << 4)).astype(jnp.int8)
+    return packed, scales[:, 0]
+
+
+def dequantize_int4_blockwise(packed, scales, shape, block_size: int = 2048):
+    """Inverse of quantize_int4_blockwise."""
+    import numpy as _np
+    u = packed.astype(jnp.uint8)
+    lo = (u & 0xF).astype(jnp.int32) - 8
+    hi = (u >> 4).astype(jnp.int32) - 8
+    q = jnp.stack([lo, hi], axis=1).reshape(-1).astype(jnp.float32)
+    blocks = q.reshape(-1, block_size) * scales[:, None]
+    n = int(_np.prod(shape))
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+registry.register("quantizer_int4", "xla", True, "weight-only int4, nibble-packed")

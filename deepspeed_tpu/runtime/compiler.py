@@ -1,0 +1,48 @@
+"""torch.compile API shim.
+
+Reference: ``runtime/compiler.py`` + ``engine.py:3665 compile()`` — opt-in
+graph compilation of the wrapped module. Under this framework everything is
+ALREADY traced and XLA-compiled (the engine jits fwd_bwd/apply as whole
+programs), so ``compile()`` is a no-op that records the request and exposes
+the same introspection flags; ``is_compiled`` reports the truth: always,
+once the first step has built its programs."""
+
+from typing import Any, Callable, Optional
+
+from ..utils.logging import logger
+
+
+def is_compile_supported() -> bool:
+    return True
+
+
+def disable(fn: Callable) -> Callable:
+    """Reference compiler.disable decorator — marks a function to stay out
+    of graph capture. JAX equivalent: the function simply isn't jitted; for
+    callers inside jit the right tool is jax.pure_callback, which this shim
+    cannot insert automatically — so it returns the fn unchanged."""
+    return fn
+
+
+class CompiledModuleWrapper:
+
+    def __init__(self, module, compile_config=None):
+        self.module = module
+        self._is_compiled = True  # XLA: compiled by construction
+
+    @property
+    def is_compiled(self) -> bool:
+        return self._is_compiled
+
+
+def attach_compile_api(engine) -> None:
+    """Give an engine the reference's compile()/is_compiled surface."""
+
+    def compile(backend: Optional[str] = None, compile_kwargs: Optional[dict] = None,
+                schedule: Any = None) -> None:
+        logger.info("compile(): engine programs are XLA-compiled by construction; "
+                    f"request recorded (backend={backend})")
+        engine._compiled = True
+
+    engine.compile = compile
+    engine.is_compiled = True

@@ -22,3 +22,27 @@ class TestFP8Quantizer:
         v, s = quantize_fp8(x, dtype=jnp.float8_e5m2, block_size=256)
         back = dequantize_fp8(v, s, x.shape, block_size=256)
         assert float(jnp.max(jnp.abs(back - x))) < 0.5
+
+
+class TestInt4Quantizer:
+
+    def test_pack_roundtrip(self):
+        import numpy as np
+        from deepspeed_tpu.ops.quantizer import (quantize_int4_blockwise,
+                                                 dequantize_int4_blockwise)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        packed, s = quantize_int4_blockwise(x, block_size=256)
+        assert packed.size == x.size // 2  # 2 nibbles per byte
+        back = dequantize_int4_blockwise(packed, s, x.shape, block_size=256)
+        rel = float(jnp.mean(jnp.abs(back - x)) / jnp.mean(jnp.abs(x)))
+        assert rel < 0.2  # 4-bit error band (absmax-scaled, block 256)
+
+    def test_exact_grid_values(self):
+        from deepspeed_tpu.ops.quantizer import (quantize_int4_blockwise,
+                                                 dequantize_int4_blockwise)
+        x = jnp.asarray([7.0, -7.0, 0.0, 3.0] * 64, jnp.float32)
+        p, s = quantize_int4_blockwise(x, block_size=256)
+        back = dequantize_int4_blockwise(p, s, x.shape, block_size=256)
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-6)
