@@ -243,13 +243,17 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 x = layer_cls(cfg, name=f"layers_{i}")(x, cos, sin, positions, attn_mask)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        # unembed in compute dtype: the [tokens, vocab] matmul is ~8% of
+        # model FLOPs and must ride the MXU's bf16 path (fp32 matmul is
+        # several× slower); MXU accumulates in fp32 regardless, and the CE
+        # loss upcasts the logits before logsumexp
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            logits = embed.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(),
                                                                (EMBED, VOCAB)),
-                              name="lm_head")(x.astype(jnp.float32))
+                              name="lm_head")(x)
         return logits
 
 
