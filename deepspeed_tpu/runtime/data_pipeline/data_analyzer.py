@@ -1,0 +1,108 @@
+"""Offline dataset difficulty analysis for curriculum learning.
+
+Reference: ``runtime/data_pipeline/data_sampling/data_analyzer.py:22
+DataAnalyzer`` — a map/reduce job computing per-sample metrics (seqlen,
+vocab rarity, ...) over the whole dataset, writing indexed metric files the
+curriculum sampler consumes. The reference shards work across
+workers×threads with file-based merge; here the map is a multiprocessing
+pool over index ranges and the reduce is in-memory numpy (a TPU-VM host
+comfortably holds billions of int32 metric values), with the same output
+artifacts: ``{metric}_sample_to_metric`` (per-sample value) and
+``{metric}_metric_to_sample`` (value → sample ids) plus percentile stats.
+"""
+
+import json
+import os
+from concurrent.futures import ProcessPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+def metric_seqlen(sample) -> int:
+    """Built-in metric (reference analyzer's seqlen example)."""
+    return int(np.asarray(sample).reshape(-1).shape[0])
+
+
+def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
+    """Built-in metric factory: mean -log frequency of a sample's tokens."""
+
+    def fn(sample) -> int:
+        ids = np.asarray(sample).reshape(-1)
+        rar = -np.log(np.maximum(vocab_freq[ids], 1e-12)).mean()
+        return int(rar * 1e3)  # fixed-point, metric files are integer-typed
+
+    return fn
+
+
+class DataAnalyzer:
+
+    def __init__(self,
+                 dataset,
+                 num_workers: int = 1,
+                 metric_names: Optional[List[str]] = None,
+                 metric_functions: Optional[List[Callable]] = None,
+                 save_path: str = "./data_analysis",
+                 metric_types: Optional[List[str]] = None,
+                 batch_size: int = 1024):
+        self.dataset = dataset
+        self.num_workers = max(1, num_workers)
+        self.metric_names = metric_names or ["seqlen"]
+        self.metric_functions = metric_functions or [metric_seqlen]
+        self.metric_types = metric_types or ["single_value_per_sample"] * len(self.metric_names)
+        self.save_path = save_path
+        self.batch_size = batch_size
+
+    # ---- map (reference run_map) ----
+
+    def _map_range(self, lo: int, hi: int) -> Dict[str, np.ndarray]:
+        out = {name: np.empty(hi - lo, dtype=np.int64) for name in self.metric_names}
+        for i in range(lo, hi):
+            sample = self.dataset[i]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                out[name][i - lo] = fn(sample)
+        return out
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        n = len(self.dataset)
+        chunks = np.linspace(0, n, self.num_workers + 1, dtype=int)
+        if self.num_workers == 1:
+            parts = [self._map_range(0, n)]
+        else:
+            with ProcessPoolExecutor(max_workers=self.num_workers) as pool:
+                parts = list(pool.map(self._map_range, chunks[:-1], chunks[1:]))
+        return {name: np.concatenate([p[name] for p in parts]) for name in self.metric_names}
+
+    # ---- reduce (reference run_reduce / merge_map_results) ----
+
+    def run_reduce(self, mapped: Dict[str, np.ndarray]) -> Dict[str, dict]:
+        os.makedirs(self.save_path, exist_ok=True)
+        results = {}
+        for name in self.metric_names:
+            vals = mapped[name]
+            np.save(os.path.join(self.save_path, f"{name}_sample_to_metric.npy"), vals)
+            order = np.argsort(vals, kind="stable")
+            np.save(os.path.join(self.save_path, f"{name}_metric_to_sample.npy"), order)
+            stats = {
+                "num_samples": int(vals.size),
+                "min": int(vals.min()), "max": int(vals.max()),
+                "mean": float(vals.mean()),
+                "percentiles": {str(p): int(np.percentile(vals, p))
+                                for p in (1, 5, 25, 50, 75, 95, 99)},
+            }
+            with open(os.path.join(self.save_path, f"{name}_stats.json"), "w") as f:
+                json.dump(stats, f, indent=2)
+            results[name] = stats
+            logger.info(f"data analysis '{name}': {stats['percentiles']}")
+        return results
+
+    def run_map_reduce(self, comm_group=None) -> Dict[str, dict]:
+        """Reference run_map_reduce — the one-call entry."""
+        return self.run_reduce(self.run_map())
+
+
+def load_metric(save_path: str, metric_name: str) -> np.ndarray:
+    """Per-sample metric values for DeepSpeedDataSampler's metric_values."""
+    return np.load(os.path.join(save_path, f"{metric_name}_sample_to_metric.npy"))

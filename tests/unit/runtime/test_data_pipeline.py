@@ -146,3 +146,39 @@ def test_random_ltd_layer_and_scheduler():
     assert sched.update_seq(0) == 128
     assert sched.update_seq(100) == 512
     assert sched.update_seq(50) % 16 == 0
+
+
+class TestDataAnalyzer:
+
+    def test_map_reduce_seqlen(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (DataAnalyzer,
+                                                                       load_metric)
+        rng = np.random.default_rng(0)
+        dataset = [np.zeros(rng.integers(4, 64), dtype=np.int32) for _ in range(200)]
+        an = DataAnalyzer(dataset, save_path=str(tmp_path))
+        stats = an.run_map_reduce()
+        assert stats["seqlen"]["num_samples"] == 200
+        vals = load_metric(str(tmp_path), "seqlen")
+        np.testing.assert_array_equal(vals, [len(s) for s in dataset])
+        order = np.load(tmp_path / "seqlen_metric_to_sample.npy")
+        assert (np.diff(vals[order]) >= 0).all()  # sorted by difficulty
+
+    def test_feeds_curriculum_sampler(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (DataAnalyzer,
+                                                                       load_metric)
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+        rng = np.random.default_rng(1)
+        dataset = [np.zeros(rng.integers(4, 64), dtype=np.int32) for _ in range(128)]
+        DataAnalyzer(dataset, save_path=str(tmp_path)).run_map_reduce()
+        metric = load_metric(str(tmp_path), "seqlen")
+        sched = CurriculumScheduler({"curriculum_type": "seqlen",
+                                     "min_difficulty": 8, "max_difficulty": 64,
+                                     "schedule_type": "fixed_linear",
+                                     "schedule_config": {"total_curriculum_step": 10,
+                                                         "difficulty_step": 1}})
+        sampler = DeepSpeedDataSampler(total_samples=128, micro_batch_size=4,
+                                       curriculum_scheduler=sched, metric_values=metric)
+        batch = next(iter(sampler))
+        # early curriculum: only short samples are eligible
+        assert all(metric[i] <= 64 for i in batch)
