@@ -7,27 +7,50 @@ Metric: training tokens/sec/chip for a ~0.4B-param Llama-class model
 reports model FLOPs utilization (MFU, 6*N*T/peak) relative to the reference's
 best published sustained utilization (54% of peak on A100,
 blogs/deepspeed-ulysses/README.md:82-83) — i.e. vs_baseline = our_MFU / 0.54.
+
+Structure (round-2 hardening): the measurement runs in a *child* process so a
+flaky TPU (axon) backend init can be retried with backoff from a supervisor
+that never crashes; after exhausting retries the supervisor falls back to
+auto platform selection, and if everything fails it still emits a parseable
+diagnostic JSON line instead of a raw traceback (round 1 shipped rc=1 and
+zero recorded perf evidence).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+ATTEMPTS = 3
+BACKOFFS = [10, 20]
+ATTEMPT_TIMEOUT = 900  # first TPU compile can take minutes on a cold relay
 
-def main():
+
+def measure():
     import jax
     import jax.numpy as jnp
     import numpy as np
     import deepspeed_tpu
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
+    platform = jax.devices()[0].platform
+
     # ~0.4B params: sized to fit one v5e chip (16 GB HBM) with Adam fp32 states
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                       num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
                       max_position_embeddings=2048, remat=True)
+    batch, seq, iters = 4, 1024, 10
+    if platform == "cpu":
+        # diagnostic-fallback sizing: same model family, tractable on host
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
+                          num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=512, remat=True)
+        batch, seq, iters = 2, 256, 3
+
     model, params = init_llama(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
 
-    batch, seq = 4, 1024
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={
@@ -56,10 +79,9 @@ def main():
     jax.block_until_ready(engine.params)
     float(jax.tree_util.tree_leaves(engine.params)[0].ravel()[0])
 
-    iters = 10
     t0 = time.time()
     for i in range(iters):
-        loss = step(i)
+        step(i)
     # barrier on the full step (params carry the optimizer update), not just
     # the forward loss — XLA dispatch is async; the host read defeats any
     # relay-side early-return on block_until_ready
@@ -70,16 +92,69 @@ def main():
     tokens_per_sec = iters * batch * seq / dt
     flops_per_token = 6 * n_params  # fwd+bwd
     achieved = tokens_per_sec * flops_per_token
-    # v5e bf16 peak ≈ 197 TFLOP/s/chip
-    peak = 197e12
-    mfu = achieved / peak
+    if platform == "cpu":
+        # a host-CPU number is a liveness diagnostic, not a TPU result —
+        # don't claim a baseline ratio for it
+        mfu_ratio = 0.0
+        unit = f"tokens/s (DIAGNOSTIC cpu fallback, {n_params/1e6:.0f}M llama)"
+    else:
+        peak = 197e12  # v5e bf16 peak ≈ 197 TFLOP/s/chip
+        mfu = achieved / peak
+        mfu_ratio = round(mfu / 0.54, 4)
+        unit = "tokens/s (0.4B llama, bf16, bs4xseq1024)"
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s (0.4B llama, bf16, bs4xseq1024)",
-        "vs_baseline": round(mfu / 0.54, 4),
+        "unit": unit,
+        "vs_baseline": mfu_ratio,
+    }), flush=True)
+
+
+def supervise():
+    last_tail = ""
+    for attempt in range(ATTEMPTS):
+        env = dict(os.environ)
+        if attempt == ATTEMPTS - 1:
+            # last resort: scrub the axon plugin entirely and run on host CPU
+            # so we record *something* rather than nothing (auto-pick would
+            # still try axon first and can hang, not just error)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                env=env, capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired as e:
+            child_out = ((e.stderr or b"") + (e.stdout or b""))
+            if isinstance(child_out, bytes):
+                child_out = child_out.decode(errors="replace")
+            last_tail = (f"attempt {attempt}: timeout after {ATTEMPT_TIMEOUT}s; "
+                         f"child output tail:\n{child_out[-2000:]}")
+            print(last_tail, file=sys.stderr)
+            continue
+        out_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+        if proc.returncode == 0 and out_lines:
+            print(out_lines[-1])
+            return 0
+        last_tail = (proc.stderr or proc.stdout or "")[-2000:]
+        print(f"attempt {attempt} rc={proc.returncode}:\n{last_tail}", file=sys.stderr)
+        if attempt < len(BACKOFFS):
+            time.sleep(BACKOFFS[attempt])
+    # every attempt failed: emit a parseable diagnostic line, exit 0 so the
+    # driver records it
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s (BENCH FAILED — see error)",
+        "vs_baseline": 0.0,
+        "error": last_tail[-500:],
     }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        measure()
+    else:
+        sys.exit(supervise())

@@ -203,6 +203,28 @@ class LlamaDecoderLayer(nn.Module):
         return h
 
 
+class LMHead(nn.Module):
+    """Unembed with bf16 MXU inputs but fp32 accumulation *and* output.
+
+    Keeps the ``lm_head/kernel`` param path (HF conversion + AutoTP policies
+    address it) while controlling the matmul output dtype, which ``nn.Dense``
+    can't (its output dtype == compute dtype).
+    """
+    features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
+            (x.shape[-1], self.features))
+        return jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
 class _ScanBody(nn.Module):
     """nn.scan adapter: scan bodies must return (carry, out)."""
     config: LlamaConfig
@@ -243,17 +265,17 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 x = layer_cls(cfg, name=f"layers_{i}")(x, cos, sin, positions, attn_mask)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
-        # unembed in compute dtype: the [tokens, vocab] matmul is ~8% of
-        # model FLOPs and must ride the MXU's bf16 path (fp32 matmul is
-        # several× slower); MXU accumulates in fp32 regardless, and the CE
-        # loss upcasts the logits before logsumexp
+        # unembed: bf16 inputs ride the MXU fast path (fp32 matmul is several×
+        # slower), but the accumulator stays fp32 and the *output* is emitted
+        # fp32 (preferred_element_type) — rounding logits to bf16 before the
+        # CE logsumexp loses precision at large vocab sizes
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x)
+            logits = jax.lax.dot_general(
+                x.astype(cfg.dtype), embed.embedding.astype(cfg.dtype),
+                (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                              kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(),
-                                                               (EMBED, VOCAB)),
-                              name="lm_head")(x)
+            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
         return logits
 
 
