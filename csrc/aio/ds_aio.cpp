@@ -14,6 +14,7 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -67,12 +68,14 @@ public:
         std::lock_guard<std::mutex> lk(mu_);
         long id = next_id_++;
         queue_.push_back(Request{id, is_read, path, static_cast<char*>(buf), nbytes, offset});
-        inflight_++;
+        inflight_ids_.insert(id);
         cv_.notify_one();
         return id;
     }
 
     // Blocks until request `id` completes; returns bytes transferred or -errno.
+    // Mixing wait(id) *after* a wait_all() that covered `id` is unsupported
+    // (wait_all consumes those completions).
     long wait(long id) {
         std::unique_lock<std::mutex> lk(mu_);
         done_cv_.wait(lk, [&] { return completed_.count(id) > 0; });
@@ -81,15 +84,26 @@ public:
         return r;
     }
 
-    // Drains everything submitted so far; returns 0 or first -errno seen.
+    // Drains everything submitted *before this call*; returns 0 or the first
+    // -errno among those requests. Completions of requests submitted after
+    // the call (or concurrently waited via wait(id)) are left untouched, so
+    // a later wait(id) on them still works.
     long wait_all() {
         std::unique_lock<std::mutex> lk(mu_);
-        done_cv_.wait(lk, [&] { return inflight_ == 0; });
+        const long watermark = next_id_;
+        done_cv_.wait(lk, [&] {
+            return inflight_ids_.empty() || *inflight_ids_.begin() >= watermark;
+        });
         long rc = 0;
-        for (auto& kv : completed_) {
-            if (kv.second.bytes_or_negerrno < 0 && rc == 0) rc = kv.second.bytes_or_negerrno;
+        for (auto it = completed_.begin(); it != completed_.end();) {
+            if (it->first < watermark) {
+                if (it->second.bytes_or_negerrno < 0 && rc == 0)
+                    rc = it->second.bytes_or_negerrno;
+                it = completed_.erase(it);
+            } else {
+                ++it;
+            }
         }
-        completed_.clear();
         return rc;
     }
 
@@ -116,7 +130,7 @@ private:
             {
                 std::lock_guard<std::mutex> lk(mu_);
                 completed_[req.id] = Completion{rc};
-                inflight_--;
+                inflight_ids_.erase(req.id);
             }
             done_cv_.notify_all();
         }
@@ -188,7 +202,7 @@ private:
     std::deque<Request> queue_;
     std::unordered_map<long, Completion> completed_;
     long next_id_;
-    size_t inflight_ = 0;
+    std::set<long> inflight_ids_;  // ordered: wait_all scans the minimum
     bool stop_;
     std::vector<std::thread> workers_;
 };

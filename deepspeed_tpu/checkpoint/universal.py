@@ -48,15 +48,30 @@ def _flatten(tree, prefix=()):
 
 
 def _unflatten_into(flat: Dict[str, Any], target_tree):
-    """Place flat {path: array} into the structure of target_tree."""
+    """Place flat {path: array} into the structure of target_tree.
+
+    Rebuilt by recursing the *target* structure keyed by path — zipping a
+    flattened-dict insertion order against ``tree_structure`` (which sorts
+    dict keys) silently scrambles leaves whenever insertion order isn't
+    sorted (e.g. ``layers_2`` vs ``layers_10``, ``norm`` vs ``lm_head``).
+    """
     flat_t = _flatten(target_tree)
     missing = [k for k in flat_t if k not in flat]
     if missing:
         raise KeyError(f"universal checkpoint missing parameters: {missing[:5]}"
                        f"{'...' if len(missing) > 5 else ''}")
-    leaves_in_order = [flat[k] for k in flat_t]
-    treedef = jax.tree_util.tree_structure(target_tree)
-    return jax.tree_util.tree_unflatten(treedef, leaves_in_order)
+
+    def build(node, prefix):
+        if isinstance(node, dict):
+            return {k: build(v, prefix + (str(k), )) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            seq = [build(v, prefix + (str(i), )) for i, v in enumerate(node)]
+            if hasattr(node, "_fields"):  # namedtuple
+                return type(node)(*seq)
+            return type(node)(seq)
+        return flat[_SEP.join(prefix)]
+
+    return build(target_tree, ())
 
 
 def _find_adam_moments(opt_state) -> Optional[Any]:

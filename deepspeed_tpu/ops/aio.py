@@ -10,6 +10,7 @@ available, mirroring the reference's compatibility-probe behavior
 """
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -37,15 +38,27 @@ def _jit_load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        so_path = os.path.join(_BUILD_DIR, "libds_aio.so")
+        # content-hash the source into the artifact name: a stale or foreign
+        # binary can never shadow the code actually in csrc/ (mtime gating is
+        # timestamp-dependent after a fresh clone)
+        with open(_SRC, "rb") as f:
+            src_hash = hashlib.sha256(f.read()).hexdigest()[:12]
+        so_path = os.path.join(_BUILD_DIR, f"libds_aio-{src_hash}.so")
         try:
-            if (not os.path.exists(so_path)
-                    or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+            if not os.path.exists(so_path):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
                        _SRC, "-o", so_path]
                 subprocess.run(cmd, check=True, capture_output=True)
                 logger.info(f"built {so_path}")
+                # purge artifacts from older source revisions
+                for name in os.listdir(_BUILD_DIR):
+                    if (name.startswith("libds_aio") and name.endswith(".so")
+                            and os.path.join(_BUILD_DIR, name) != so_path):
+                        try:
+                            os.remove(os.path.join(_BUILD_DIR, name))
+                        except OSError:
+                            pass
             lib = ctypes.CDLL(so_path)
             lib.ds_aio_handle_new.restype = ctypes.c_void_p
             lib.ds_aio_handle_new.argtypes = [ctypes.c_int, ctypes.c_long, ctypes.c_int]

@@ -42,8 +42,10 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int,
     if key_padding_mask is not None:
         kpm = key_padding_mask[:, None, None, :]
         if key_padding_mask_mode == "add" and kpm.dtype != jnp.bool_:
+            # purely additive (reference semantics): moderate biases (e.g.
+            # ALiBi-style values ≤ -1) must bias, not hard-mask — only the
+            # sparse layout decides visibility here
             scores = scores + kpm.astype(jnp.float32)
-            visible = visible & (kpm > -1.0)  # large-negative = masked out
         else:  # keep-mask (bool is always keep-style, whatever the mode)
             visible = visible & kpm.astype(bool)
     scores = jnp.where(visible, scores, neg)

@@ -109,3 +109,23 @@ class TestUniversalCheckpoint:
         restored, host = eng.load(str(tmp_path / "async_ck"))
         np.testing.assert_allclose(np.asarray(restored["a"]), np.arange(8))
         assert host["global_steps"] == 7
+
+
+def test_unflatten_into_unsorted_key_order():
+    """Regression: leaves must land by *path*, not by zipping insertion order
+    against jax's sorted-key treedef — llama-shaped trees where insertion
+    order != sorted order (layers_2 vs layers_10, norm before lm_head) used
+    to come back silently scrambled."""
+    from deepspeed_tpu.checkpoint.universal import _flatten, _unflatten_into
+
+    def leaf(tag):
+        return np.full((2,), tag, dtype=np.float32)
+
+    # insertion order deliberately unsorted: layers_2 before layers_10,
+    # norm before lm_head
+    target = {"model": {"layers_2": {"w": leaf(2)}, "layers_10": {"w": leaf(10)},
+                        "norm": {"scale": leaf(3)}, "lm_head": {"kernel": leaf(4)}}}
+    flat = _flatten(target)
+    rebuilt = _unflatten_into({k: v + 1 for k, v in flat.items()}, target)
+    for k, v in _flatten(rebuilt).items():
+        np.testing.assert_allclose(v, flat[k] + 1, err_msg=k)
