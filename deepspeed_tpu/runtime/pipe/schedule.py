@@ -1,48 +1,201 @@
-"""Pipeline instruction schedules.
+"""Pipeline schedules, derived from a declarative dependency DAG.
 
-Rebuild of reference ``runtime/pipe/schedule.py``: the same step->instruction
-generation (1F1B ``TrainSchedule :189``, ``InferenceSchedule :135``,
-instruction classes ``:327-494``). On GPU these drive the per-rank executor
-(`_exec_schedule`); under single-controller SPMD the executor is the compiled
-scan in ``spmd.py`` — these classes exist for (a) API/test parity, (b) the
-host-orchestrated debug executor, and (c) schedule introspection (the SPMD
-tick loop and TrainSchedule describe the same dependency DAG).
+Capability parity with reference ``runtime/pipe/schedule.py`` (1F1B train
+schedule, fill-drain inference schedule, instruction-name API), but the
+derivation is different by design: instead of per-rank closed-form index
+formulas, a tiny discrete-time list scheduler simulates the whole pipeline
+against an explicit dependency DAG:
+
+    F(m, s)  needs  F(m, s-1) finished one tick earlier   (activation hop)
+    B(m, s)  needs  B(m, s+1) finished one tick earlier   (gradient hop)
+                and F(m, s)                               (own forward)
+
+plus the 1F1B memory policy — a stage may start a new forward only while
+``live(s) < min(stages - s, micro_batches)`` microbatches are in flight —
+and a backward-first priority rule. 1F1B is *emergent* from those three
+declarative facts rather than hand-scheduled, the simulation gives every
+stage a shared global clock (what the SPMD tick executor in ``spmd.py``
+assumes), and peak-buffer counts are measured off the simulated timeline
+instead of asserted.
+
+The instruction vocabulary (ForwardPass/SendActivation/…) keeps the
+reference's names so training loops and tests can introspect schedules
+through the same surface.
 """
 
-from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 
-def _is_even(x):
-    return x % 2 == 0
+# ---------------------------------------------------------------------------
+# Instruction vocabulary
+# ---------------------------------------------------------------------------
 
 
-def _is_odd(x):
-    return x % 2 != 0
+@dataclass(frozen=True)
+class PipeInstruction:
+    """A single step command in a stage's instruction stream."""
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({args})"
+
+    @property
+    def name(self):
+        return type(self).__name__
 
 
-class PipeSchedule(ABC):
-    """Generates sequences of PipeInstruction per step (reference :11)."""
+@dataclass(frozen=True, repr=False)
+class OptimizerStep(PipeInstruction):
+    pass
 
-    def __init__(self, micro_batches, stages, stage_id):
+
+@dataclass(frozen=True, repr=False)
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class BufferOpInstruction(PipeInstruction):
+    buffer_id: int = 0
+
+
+@dataclass(frozen=True, repr=False)
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# DAG simulation
+# ---------------------------------------------------------------------------
+
+_FWD = "F"
+_BWD = "B"
+
+
+@dataclass
+class _Timeline:
+    """Result of simulating the pipeline: per-stage, per-tick work items."""
+    # work[s][t] = (kind, micro_batch) or None
+    work: List[List[Optional[Tuple[str, int]]]]
+    horizon: int
+    peak_live: List[int]  # per-stage max concurrently-live microbatches
+
+
+def _simulate(micro_batches: int, stages: int, with_backward: bool) -> _Timeline:
+    """Greedy list-scheduling of the work DAG on `stages` sequential executors.
+
+    Each tick, every stage runs at most one ready item. Readiness comes from
+    the DAG (cross-stage deps finish one tick before use — the transfer hop);
+    the policy is backward-first with the 1F1B live-microbatch bound.
+    """
+    done_at: Dict[Tuple[str, int, int], int] = {}  # (kind, m, s) -> tick
+    live = [0] * stages
+    peak = [0] * stages
+    # 1F1B live-microbatch bound; meaningless without backwards to drain it
+    # (forward-only output is consumed downstream immediately)
+    limit = ([max(1, min(stages - s, micro_batches)) for s in range(stages)]
+             if with_backward else [micro_batches] * stages)
+    next_fwd = [0] * stages  # microbatches enter a stage in order
+    next_bwd = [0] * stages
+    work: List[List[Optional[Tuple[str, int]]]] = [[] for _ in range(stages)]
+
+    total = micro_batches * stages * (2 if with_backward else 1)
+    finished = 0
+    t = 0
+    while finished < total:
+        picks: List[Optional[Tuple[str, int]]] = []
+        for s in range(stages):
+            pick = None
+            # backward-first: drains live microbatches, bounding memory
+            if with_backward and next_bwd[s] < micro_batches:
+                m = next_bwd[s]
+                own_fwd = done_at.get((_FWD, m, s))
+                grad_in = (done_at.get((_BWD, m, s + 1))
+                           if s + 1 < stages else own_fwd)
+                if (own_fwd is not None and own_fwd < t
+                        and grad_in is not None and grad_in < t):
+                    pick = (_BWD, m)
+            if pick is None and next_fwd[s] < micro_batches and live[s] < limit[s]:
+                m = next_fwd[s]
+                act_in = done_at.get((_FWD, m, s - 1)) if s > 0 else -1
+                if act_in is not None and act_in < t:
+                    pick = (_FWD, m)
+            picks.append(pick)
+
+        for s, pick in enumerate(picks):
+            work[s].append(pick)
+            if pick is None:
+                continue
+            kind, m = pick
+            done_at[(kind, m, s)] = t
+            finished += 1
+            if kind == _FWD:
+                next_fwd[s] += 1
+                live[s] += 1
+                peak[s] = max(peak[s], live[s])
+            else:
+                next_bwd[s] += 1
+                live[s] -= 1
+        t += 1
+        assert t <= 4 * total + stages + 4, "scheduler wedged (DAG bug)"
+
+    return _Timeline(work=work, horizon=t, peak_live=peak)
+
+
+# ---------------------------------------------------------------------------
+# Schedules
+# ---------------------------------------------------------------------------
+
+
+class PipeSchedule:
+    """Instruction streams for one stage, read off the simulated timeline."""
+
+    _with_backward = True
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
         self.micro_batches = micro_batches
         self.stages = stages
         self.stage_id = stage_id
-        self.prev_stage = stage_id - 1
-        self.next_stage = stage_id + 1
+        self._timeline = _simulate(micro_batches, stages, self._with_backward)
 
-    @abstractmethod
-    def steps(self):
-        ...
-
-    def num_pipe_buffers(self):
-        return self.micro_batches
-
-    def _valid_micro_batch(self, micro_batch_id):
-        return 0 <= micro_batch_id < self.micro_batches
-
-    def _valid_stage(self, stage_id):
-        return 0 <= stage_id < self.stages
-
+    # -- introspection ------------------------------------------------------
     @property
     def stage(self):
         return self.stage_id
@@ -63,195 +216,89 @@ class PipeSchedule(ABC):
     def is_last_stage(self):
         return self.stage_id == self.stages - 1
 
-    def _buffer_idx(self, micro_batch_id):
-        assert self._valid_micro_batch(micro_batch_id)
+    def num_pipe_buffers(self):
+        """Measured off the timeline: peak live microbatches, floor 2 (double
+        buffering for the transfer hop)."""
+        return max(2, self._timeline.peak_live[self.stage_id])
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
         return micro_batch_id % self.num_pipe_buffers()
 
+    # -- stream generation --------------------------------------------------
+    def steps(self):
+        """Yield the per-tick instruction lists for this stage.
+
+        Comm instructions are derived from the DAG edges: a cross-stage edge
+        produces a Send in the producer's next tick and a Recv in the
+        consumer's tick.
+        """
+        s = self.stage_id
+        my_work = self._timeline.work[s]
+        # sends scheduled into future ticks: tick -> [instruction]
+        pending_sends: Dict[int, List[PipeInstruction]] = {}
+
+        for t in range(self._timeline.horizon):
+            cmds: List[PipeInstruction] = list(pending_sends.pop(t, ()))
+            item = my_work[t] if t < len(my_work) else None
+            if item is not None:
+                kind, m = item
+                buf = self._buffer_idx(m)
+                if kind == _FWD:
+                    if not self.is_first_stage:
+                        cmds.append(RecvActivation(buf))
+                    if self.is_first_stage or self.is_last_stage:
+                        cmds.append(LoadMicroBatch(buf))
+                    cmds.append(ForwardPass(buf))
+                    if not self.is_last_stage:
+                        pending_sends.setdefault(t + 1, []).append(SendActivation(buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buf))
+                    cmds.append(BackwardPass(buf))
+                    if not self.is_first_stage:
+                        pending_sends.setdefault(t + 1, []).append(SendGrad(buf))
+            if self._with_backward and t == self._timeline.horizon - 1:
+                cmds.extend([ReduceTiedGrads(), ReduceGrads(), OptimizerStep()])
+            yield cmds
+
     def __iter__(self):
-        self.it = self.steps()
-        return self.it
+        return self.steps()
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: emergent from backward-first priority + the live-microbatch
+    bound (reference capability: ``runtime/pipe/schedule.py`` TrainSchedule)."""
+    _with_backward = True
 
 
 class InferenceSchedule(PipeSchedule):
-    """Forward-only fill-drain schedule (reference :135)."""
-
-    def steps(self):
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
-            cmds = []
-            micro_batch_id = step_id - self.stage_id
-
-            if _is_even(self.stage_id):
-                recv_buf = step_id % 2
-                send_buf = (step_id + 1) % 2
-            else:
-                recv_buf = (step_id + 1) % 2
-                send_buf = step_id % 2
-
-            if self.is_first_stage or self.is_last_stage:
-                if self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(recv_buf))
-
-            if _is_even(self.stage_id):
-                if self._valid_stage(self.next_stage) and self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-                if self._valid_stage(self.prev_stage) and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-            else:
-                if self._valid_stage(self.prev_stage) and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-                if self._valid_stage(self.next_stage) and self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(recv_buf))
-            yield cmds
+    """Forward-only fill-drain (reference capability: InferenceSchedule)."""
+    _with_backward = False
 
     def num_pipe_buffers(self):
         return 2
 
-
-class TrainSchedule(PipeSchedule):
-    """Synchronous 1F1B (reference :189): steady state interleaves one
-    forward with one backward; convergence matches data parallelism."""
-
-    def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-            prev_buffer = (self._buffer_idx(prev_micro_batch_id)
-                           if self._valid_micro_batch(prev_micro_batch_id) else None)
-            curr_buffer = (self._buffer_idx(micro_batch_id)
-                           if self._valid_micro_batch(micro_batch_id) else None)
-
-            cmds = []
-            if is_forward:
-                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.prev_stage):
-                    cmds.append(SendGrad(prev_buffer))
-                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(curr_buffer))
-            else:
-                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.next_stage):
-                    cmds.append(RecvGrad(curr_buffer))
-                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(prev_buffer))
-
-            if self.is_first_stage or self.is_last_stage:
-                if is_forward and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(curr_buffer))
-
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(curr_buffer) if is_forward else BackwardPass(curr_buffer))
-
-            if step_id == total_steps - 1:
-                cmds.append(ReduceTiedGrads())
-                cmds.append(ReduceGrads())
-                cmds.append(OptimizerStep())
-
-            prev_micro_batch_id = micro_batch_id
-            yield cmds
-
-    def num_pipe_buffers(self):
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
-        return max(2, buffers)
-
-    def _step_to_micro_batch(self, step_id):
-        if _is_even(step_id) and _is_even(self.stage_id):
-            return self._even_step_forward_id(step_id), True
-        if _is_odd(step_id) and _is_odd(self.stage_id):
-            return self._odd_step_forward_id(step_id), True
-        if _is_even(step_id) and _is_odd(self.stage_id):
-            return self._even_step_backward_id(step_id), False
-        if _is_odd(step_id) and _is_even(self.stage_id):
-            return self._odd_step_backward_id(step_id), False
-        raise AssertionError
-
-    def _even_step_forward_id(self, step_id):
-        return step_id // 2 - self.stage_id // 2
-
-    def _odd_step_forward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stage_id // 2
-
-    def _even_step_backward_id(self, step_id):
-        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
-
-    def _odd_step_backward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        # double-buffer: alternate so a send of batch m can overlap the
+        # compute of batch m+1
+        return micro_batch_id % 2
 
 
 class DataParallelSchedule(PipeSchedule):
-    """Degenerate single-stage schedule (reference :301)."""
+    """Degenerate single-stage schedule: every microbatch is F then B on the
+    one stage, optimizer at the end."""
+
+    def __init__(self, micro_batches: int, stages: int = 1, stage_id: int = 0):
+        # stages/stage_id preserved for introspection; steps() below is the
+        # single-stage degenerate stream regardless
+        super().__init__(micro_batches, stages, stage_id)
 
     def steps(self):
-        for step_id in range(self.micro_batches):
+        for m in range(self.micro_batches):
             cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
-            if step_id == self.micro_batches - 1:
+            if m == self.micro_batches - 1:
                 cmds.extend([ReduceGrads(), OptimizerStep()])
             yield cmds
 
     def num_pipe_buffers(self):
         return 1
-
-
-class PipeInstruction:
-    """Base instruction (reference :327)."""
-
-    def __init__(self, **kwargs):
-        self.name = self.__class__.__name__
-        self.kwargs = kwargs
-        for k, v in kwargs.items():
-            setattr(self, k, v)
-
-    def __repr__(self):
-        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
-        return f"{self.name}({args})"
-
-    def __eq__(self, other):
-        return type(self) is type(other) and self.kwargs == other.kwargs
-
-
-class OptimizerStep(PipeInstruction):
-    pass
-
-
-class ReduceGrads(PipeInstruction):
-    pass
-
-
-class ReduceTiedGrads(PipeInstruction):
-    pass
-
-
-class BufferOpInstruction(PipeInstruction):
-
-    def __init__(self, buffer_id, **kwargs):
-        super().__init__(buffer_id=buffer_id, **kwargs)
-
-
-class LoadMicroBatch(BufferOpInstruction):
-    pass
-
-
-class ForwardPass(BufferOpInstruction):
-    pass
-
-
-class BackwardPass(BufferOpInstruction):
-    pass
-
-
-class SendActivation(BufferOpInstruction):
-    pass
-
-
-class RecvActivation(BufferOpInstruction):
-    pass
-
-
-class SendGrad(BufferOpInstruction):
-    pass
-
-
-class RecvGrad(BufferOpInstruction):
-    pass
