@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from .config_v2 import KVCacheConfig
 from ...models.llama import LlamaConfig, precompute_rope
 from ...ops.normalization import rms_norm
+from ...ops.paged_attention import paged_attention
 from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 
@@ -41,10 +42,19 @@ def _rope_tok(x, cos, sin, positions):
 class RaggedLlamaModel:
     """Paged-KV decode/prefill model over a Llama param tree."""
 
-    def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64):
+    def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64,
+                 attn_backend: str = "auto"):
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
+        # "paged" = Pallas blocked-flash decode kernel (TPU; interpret-mode on
+        # CPU), "dense" = XLA gather of the full history window, "auto" =
+        # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
+        # not a serving path)
+        if attn_backend == "auto":
+            attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
+        assert attn_backend in ("paged", "dense"), attn_backend
+        self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
         # unembed in fp32 (reference keeps logits fp32; lm_head lives under
         # "model" in the training tree)
@@ -103,7 +113,8 @@ class RaggedLlamaModel:
         fn = self._fwd_cache.get(key)
         if fn is None:
             fn = jax.jit(partial(_ragged_forward, config=self.config,
-                                 block_size=self.kv_block_size),
+                                 block_size=self.kv_block_size,
+                                 attn_backend=self.attn_backend),
                          donate_argnums=(1, ))
             self._fwd_cache[key] = fn
         logits, new_cache = fn(self.params, kv.cache, batch)
@@ -111,7 +122,8 @@ class RaggedLlamaModel:
         return logits
 
 
-def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, block_size: int):
+def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
+                    block_size: int, attn_backend: str = "dense"):
     """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
     cfg = config
     T = batch.tokens.shape[0]
@@ -119,29 +131,30 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, b
     L = B * block_size  # history window bucket
     hd, nq, nkv = cfg.head_dim_, cfg.num_attention_heads, cfg.num_key_value_heads
     g = nq // nkv
-    total_slots = cache.shape[1]
 
     p = params["model"]
     x = p["embed_tokens"]["embedding"][batch.tokens]  # [T, E]
     cos, sin = precompute_rope(hd, cfg.max_position_embeddings, cfg.rope_theta)
 
-    # dense slot grid for history gather: [S, L]
-    j = jnp.arange(L, dtype=jnp.int32)
-    slot_grid = batch.block_table[:, j // block_size] * block_size + j % block_size
     # per-seq query gather indices come host-precomputed as [S, N] where N
-    # buckets the largest burst — N=1 for pure decode, so the attention
-    # einsum is S×1×L instead of S×T×L (the decode fast path)
+    # buckets the largest burst — N=1 for pure decode, so attention work is
+    # S×N×history instead of S×T×history (the decode fast path)
     q_tok_idx = batch.q_tok_idx
     N = q_tok_idx.shape[1]
-    n_idx = jnp.arange(N, dtype=jnp.int32)
-    q_valid = n_idx[None, :] < batch.seq_n_new[:, None]  # [S, N]
-    q_abs = batch.seq_seen[:, None] + n_idx[None, :]  # absolute positions [S, N]
-    key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]  # slot j holds abs pos j
-    # causal + length mask: key j visible to query at abs pos q iff j <= q and
-    # j < seen + n_new (written region)
-    attn_mask = (key_pos <= q_abs[:, :, None]) & \
-                (key_pos < (batch.seq_seen + batch.seq_n_new)[:, None, None]) & \
-                q_valid[:, :, None]  # [S, N, L]
+    seq_lens = batch.seq_seen + batch.seq_n_new  # valid key region per seq
+
+    if attn_backend not in ("paged", "dense"):
+        raise ValueError(f"unknown attn_backend {attn_backend!r}")
+    if attn_backend == "dense":
+        # XLA fallback: gather the full bucketed history window per layer
+        j = jnp.arange(L, dtype=jnp.int32)
+        slot_grid = batch.block_table[:, j // block_size] * block_size + j % block_size
+        n_idx = jnp.arange(N, dtype=jnp.int32)
+        q_valid = n_idx[None, :] < batch.seq_n_new[:, None]  # [S, N]
+        q_abs = batch.seq_seen[:, None] + n_idx[None, :]
+        key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
+        attn_mask = (key_pos <= q_abs[:, :, None]) & \
+            (key_pos < seq_lens[:, None, None]) & q_valid[:, :, None]  # [S, N, L]
 
     # token → (seq, rel) scatter-back indices
     rel = batch.token_pos - batch.seq_seen[batch.token_seq]  # [T]
@@ -163,20 +176,30 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig, b
         k = _rope_tok(k, cos, sin, batch.token_pos)
 
         # paged write: one scatter of the new tokens' K/V into flat slots
-        kv_new = jnp.stack([k, v], axis=1).astype(cache.dtype)  # [T, 2, KV, D]
-        cache = cache.at[l, batch.token_slot].set(kv_new, mode="drop")
+        # (cache is [layer, 2, KV, slot, D]; advanced indexing puts the
+        # token axis first, matching kv_new's [T, 2, KV, D])
+        kv_new = jnp.stack([k, v], axis=1).astype(cache.dtype)
+        cache = cache.at[l, :, :, batch.token_slot, :].set(kv_new, mode="drop")
 
-        # history read: gather this layer's KV for every sequence
-        hist = cache[l][slot_grid]  # [S, L, 2, KV, D]
-        k_h = hist[:, :, 0].astype(jnp.float32)  # [S, L, KV, D]
-        v_h = hist[:, :, 1].astype(x.dtype)
+        q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd)  # grouped queries
 
-        # grouped queries: [S, N, KV, G, D]
-        q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd).astype(jnp.float32)
-        scores = jnp.einsum("snkgd,slkd->snkgl", q_s, k_h) / jnp.sqrt(hd).astype(jnp.float32)
-        scores = jnp.where(attn_mask[:, :, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        ctx = jnp.einsum("snkgl,slkd->snkgd", probs, v_h).reshape(S, N, nq * hd)
+        if attn_backend == "paged":
+            # Pallas blocked-flash: stream the block-table pages, online
+            # softmax — no history gather (ops/paged_attention.py)
+            ctx = paged_attention(
+                q_s, cache, l, batch.block_table, batch.seq_seen, seq_lens,
+                page_size=block_size,
+                interpret=jax.default_backend() != "tpu")
+            ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
+        else:
+            hist = cache[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]
+            k_h = hist[:, :, 0].astype(jnp.float32)  # [S, L, KV, D]
+            v_h = hist[:, :, 1].astype(x.dtype)
+            qf = q_s.astype(jnp.float32)
+            scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) / jnp.sqrt(hd).astype(jnp.float32)
+            scores = jnp.where(attn_mask[:, :, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum("snkgl,slkd->snkgd", probs, v_h).reshape(S, N, nq * hd)
 
         # back to token-major and project out
         ctx_tok = ctx[batch.token_seq, jnp.clip(rel, 0, N - 1)]  # [T, H*D]

@@ -2,11 +2,14 @@
 
 Reference: ``deepspeed/inference/v2/ragged/kv_cache.py`` (BlockedKVCache).
 TPU design: ONE device array per allocation group shaped
-``[num_layers, num_blocks * block_size, 2, num_kv_heads, head_dim]`` — flat
+``[num_layers, 2, num_kv_heads, num_blocks * block_size, head_dim]`` — flat
 slot addressing means the model writes new K/V with a single scatter of
-per-token flat indices (``block_table[pos // bs] * bs + pos % bs``) and reads
-history with a gather of the sequence's block table; both are dense int32
-indexed ops XLA lowers to efficient dynamic-gather/scatter on TPU.
+per-token flat indices (``block_table[pos // bs] * bs + pos % bs``). The
+(layer, k/v, head)-major layout makes one KV page a contiguous
+``[block_size, head_dim]`` strip: exactly the DMA unit of the Pallas
+blocked-flash kernel (``ops/paged_attention.py``), which scalar-prefetches
+the block table and streams pages without ever materializing a gathered
+history window.
 
 The cache is functional state: the jitted forward takes it as a donated
 argument and returns the updated array (no in-place mutation semantics to
@@ -31,7 +34,7 @@ class BlockedKVCache:
         self.block_size = config.block_size
         n_layers, n_kv, head_dim = config.cache_shape
         self.dtype = _DTYPES.get(config.cache_dtype, jnp.bfloat16)
-        self.shape = (n_layers, num_blocks * config.block_size, 2, n_kv, head_dim)
+        self.shape = (n_layers, 2, n_kv, num_blocks * config.block_size, head_dim)
         self.cache = jnp.zeros(self.shape, dtype=self.dtype)
 
     @property

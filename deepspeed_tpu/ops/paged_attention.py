@@ -1,0 +1,177 @@
+"""Pallas paged-attention (blocked flash decode) for the ragged engine.
+
+Reference capability: ``deepspeed/inference/v2/kernels/ragged_ops/
+blocked_flash/`` (attention_atom.h — per-atom block-table flash over a paged
+KV cache). TPU design, rather than a port of the CUDA atom machinery:
+
+- Grid ``(seqs, kv_heads, pages)``: the page loop is innermost so an online
+  softmax (running max / sum / accumulator in VMEM scratch) streams the
+  sequence's history one KV page at a time — no [S, L, ...] gather is ever
+  materialized (the round-1 dense path gathered the full history window per
+  layer).
+- The *block table is scalar-prefetched*: the BlockSpec index map reads
+  ``block_table[s, page]`` to DMA exactly the pages the sequence owns,
+  straight from the full cache in HBM — the layer index is prefetched too,
+  so the cache is never sliced per layer (which would copy).
+- Pages past a sequence's length clamp to the previous page id: Pallas skips
+  the re-fetch of an identical block, so short sequences don't pay the
+  bucketed page count in bandwidth.
+- GQA is native: queries arrive grouped ``[S, N, KV, G, D]`` and each grid
+  step contracts the ``N*G`` query rows of one KV head against the page —
+  KV is never expanded to Q heads.
+
+Cache layout: ``[layers, 2(k/v), kv_heads, num_slots, head_dim]`` with
+``num_slots = num_pages * page_size`` — one (layer, plane, head, page) block
+is a contiguous ``[page_size, head_dim]`` strip, the unit of DMA.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
+                       q_ref, kv_ref, o_ref,                   # blocks
+                       m_scr, l_scr, acc_scr,                  # scratch
+                       *, page_size: int, groups: int, scale: float):
+    s = pl.program_id(0)
+    b = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    hist_len = lens_ref[s]   # seen + new: valid key region
+    seen = seen_ref[s]
+
+    @pl.when(b * page_size < hist_len)
+    def _accumulate():
+        # q: [1, N, 1, G, D] -> [N*G, D]; kv: [1, 2, 1, page, D]
+        q = q_ref[...].astype(jnp.float32)
+        ng, d = q.shape[1] * q.shape[3], q.shape[4]
+        q = q.reshape(ng, d)
+        k = kv_ref[0, 0, 0].astype(jnp.float32)  # [page, D]
+        v = kv_ref[0, 1, 0].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, (((1, ), (1, )), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [NG, page]
+
+        # causal + length mask in absolute positions: page b covers
+        # [b*page, (b+1)*page); query row r belongs to new-token n = r // G
+        # at absolute position seen + n
+        key_pos = b * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        q_abs = seen + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // groups
+        mask = (key_pos <= q_abs) & (key_pos < hist_len)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        masked = jnp.where(mask, scores, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(masked, axis=-1, keepdims=True))
+        # keep the running max finite so exp() below never sees inf-inf
+        m_new = jnp.maximum(m_new, NEG_INF)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(masked - m_new), 0.0)  # [NG, page]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(b == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        out = jnp.where(l > 0, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
+        n, g, d = o_ref.shape[1], o_ref.shape[3], o_ref.shape[4]
+        o_ref[...] = out.reshape(1, n, 1, g, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
+                    *, page_size: int, interpret: bool = False):
+    """Blocked-flash attention over a paged KV cache.
+
+    Args:
+      q: ``[S, N, KV, G, D]`` grouped queries (N new tokens per sequence).
+      cache: ``[L, 2, KV, num_slots, D]`` full paged cache (never sliced).
+      layer: scalar int — which layer's pages to read.
+      block_table: ``[S, B]`` int32 page ids per sequence.
+      seq_seen: ``[S]`` history length before this step.
+      seq_lens: ``[S]`` seen + n_new (valid key region).
+    Returns:
+      ``[S, N, KV, G, D]`` in q.dtype.
+    """
+    S, N, KV, G, D = q.shape
+    B = block_table.shape[1]
+    scale = 1.0 / (D ** 0.5)
+
+    def q_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
+        return (s, 0, k, 0, 0)
+
+    def kv_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
+        # clamp trailing pages to the last needed page: identical consecutive
+        # block indices skip the DMA re-fetch
+        needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
+        page = bt_r[s, jax.lax.min(b, needed - 1)]
+        return (layer_r[0], 0, k, page, 0)
+
+    def o_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
+        return (s, 0, k, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(S, KV, B),
+        in_specs=[
+            pl.BlockSpec((1, N, 1, G, D), q_map),
+            pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, N, 1, G, D), o_map),
+        scratch_shapes=[
+            pltpu.VMEM((N * G, 128), jnp.float32),  # running max (replicated)
+            pltpu.VMEM((N * G, 128), jnp.float32),  # running sum
+            pltpu.VMEM((N * G, D), jnp.float32),    # accumulator
+        ],
+    )
+
+    kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
+                               groups=G, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, N, KV, G, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray([layer], jnp.int32), block_table.astype(jnp.int32),
+      seq_seen.astype(jnp.int32), seq_lens.astype(jnp.int32), q, cache)
+
+
+def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
+                              *, page_size: int):
+    """Dense-gather XLA reference (the round-1 path) for numerics tests."""
+    S, N, KV, G, D = q.shape
+    B = block_table.shape[1]
+    L = B * page_size
+    j = jnp.arange(L, dtype=jnp.int32)
+    slot_grid = block_table[:, j // page_size] * page_size + j % page_size
+    hist = cache[layer][:, :, slot_grid, :]           # [2, KV, S, L, D]
+    k_h = jnp.moveaxis(hist[0], 1, 0).astype(jnp.float32)  # [S, KV, L, D]
+    v_h = jnp.moveaxis(hist[1], 1, 0).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) / (D ** 0.5)
+    key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
+    q_abs = seq_seen[:, None] + jnp.arange(N, dtype=jnp.int32)[None, :]
+    mask = (key_pos <= q_abs[:, :, None]) & (key_pos < seq_lens[:, None, None])
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    any_visible = mask.any(-1)[:, :, None, None, None]
+    out = jnp.einsum("snkgl,skld->snkgd", probs, v_h)
+    return jnp.where(any_visible, out, 0.0).astype(q.dtype)
