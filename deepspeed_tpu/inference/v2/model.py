@@ -27,6 +27,7 @@ from .config_v2 import KVCacheConfig
 from ...models.llama import LlamaConfig, precompute_rope
 from ...ops.normalization import rms_norm
 from ...ops.paged_attention import paged_attention
+from ...ops.grouped_matmul import moe_grouped_mlp
 from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 
@@ -212,12 +213,8 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             probs = jax.nn.softmax(logits, axis=-1)
             w, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
             w = (w / jnp.sum(w, -1, keepdims=True)).astype(x.dtype)
-            cw = jnp.sum(w[..., None] *
-                         jax.nn.one_hot(idx, cfg.num_local_experts, dtype=x.dtype), axis=-2)
-            act = jax.nn.silu(jnp.einsum("th,ehf->tef", h2, moe["w1"])) * \
-                jnp.einsum("th,ehf->tef", h2, moe["w3"])
-            y = jnp.einsum("tef,efh->teh", act, moe["w2"])
-            x = x + jnp.einsum("te,teh->th", cw, y)
+            # grouped GEMM: FLOPs ∝ top-k, not E (ops/grouped_matmul.py)
+            x = x + moe_grouped_mlp(h2, moe["w1"], moe["w3"], moe["w2"], idx, w)
         else:
             gate = jax.nn.silu(h2 @ lp["mlp"]["gate_proj"]["kernel"])
             x = x + ((gate * (h2 @ lp["mlp"]["up_proj"]["kernel"]))

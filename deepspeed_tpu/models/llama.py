@@ -45,6 +45,8 @@ class LlamaConfig:
     attention_bias: bool = False  # qwen2-style qkv biases
     num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
     num_experts_per_tok: int = 2
+    moe_grouped: bool = True      # grouped GEMM (FLOPs ∝ top-k) vs dense-over-experts
+    attn_impl: str = "auto"       # "auto" | "flash" (Pallas) | "xla"
     dtype: Any = jnp.bfloat16
     scan_layers: bool = False
     remat: bool = False
@@ -130,13 +132,35 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        # GQA handled natively by dot_product_attention (no materialized
-        # K/V head repeat — 4x K/V bandwidth saving at 8B scale)
-        mask = None
-        if attn_mask is not None:
-            # [b, s] key padding mask -> [b, 1, 1, s]
-            mask = attn_mask[:, None, None, :].astype(bool)
-        attn = jax.nn.dot_product_attention(q, k, v, mask=mask, is_causal=True)
+        # GQA handled natively by both paths (no materialized K/V head
+        # repeat — 4x K/V bandwidth saving at 8B scale). The Pallas flash
+        # kernel (fwd AND bwd, ops/attention.py) runs on TPU when the shape
+        # tiles cleanly and there's no padding mask; XLA's fused
+        # dot_product_attention otherwise.
+        from ..ops.attention import flash_attention
+
+        def _attn_unsharded():
+            # a pallas_call doesn't auto-partition under GSPMD: only take the
+            # kernel path when the head/sequence mesh axes are trivial
+            from ..comm.mesh import mesh_is_initialized, get_mesh_context
+            if not mesh_is_initialized():
+                return True
+            shape = dict(get_mesh_context().mesh.shape)
+            return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
+
+        use_flash = (cfg.attn_impl != "xla" and attn_mask is None
+                     and (s <= 128 or s % 128 == 0)
+                     and (cfg.attn_impl == "flash"
+                          or (jax.default_backend() == "tpu" and _attn_unsharded())))
+        if use_flash:
+            attn = flash_attention(q, k, v, causal=True,
+                                   interpret=jax.default_backend() != "tpu")
+        else:
+            mask = None
+            if attn_mask is not None:
+                # [b, s] key padding mask -> [b, 1, 1, s]
+                mask = attn_mask[:, None, None, :].astype(bool)
+            attn = jax.nn.dot_product_attention(q, k, v, mask=mask, is_causal=True)
         out = attn.reshape(b, s, nq * hd)
         return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype)(out)
 
@@ -155,15 +179,19 @@ class LlamaMLP(nn.Module):
 class LlamaMoEBlock(nn.Module):
     """Mixtral-style sparse MoE MLP (reference moe/sharded_moe.py gating +
     module_inject/containers mixtral): softmax router over E experts, top-k
-    renormalized combine. Compute is dense-over-experts with a one-hot
-    combine — capacity-free and exactly matches the reference's token-choice
-    semantics; the megablocks-style grouped matmul is the perf upgrade slot.
-    Expert weights carry the 'expert' logical axis so EP sharding is a mesh
-    rule like everything else."""
+    renormalized combine. Compute is a megablocks-style grouped GEMM
+    (``ops/grouped_matmul.py``: sort-by-expert → ragged_dot → weighted
+    scatter combine) so per-token FLOPs ∝ top-k, matching the reference's
+    CUTLASS moe_gemm capability; ``moe_grouped=False`` keeps the
+    dense-over-experts oracle (also the better layout when the 'expert'
+    logical axis is sharded over a real mesh axis — EP uses moe/layer.py's
+    all-to-all dispatch instead). Expert weights carry the 'expert' logical
+    axis so EP sharding is a mesh rule like everything else."""
     config: LlamaConfig
 
     @nn.compact
     def __call__(self, x):
+        from ..ops.grouped_matmul import moe_grouped_mlp, moe_dense_mlp
         cfg = self.config
         E, k = cfg.num_local_experts, cfg.num_experts_per_tok
         H, F = cfg.hidden_size, cfg.intermediate_size
@@ -171,7 +199,6 @@ class LlamaMoEBlock(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)
         w, idx = jax.lax.top_k(probs, k)
         w = (w / jnp.sum(w, -1, keepdims=True)).astype(cfg.dtype)  # renormalize top-k
-        cw = jnp.sum(w[..., None] * jax.nn.one_hot(idx, E, dtype=cfg.dtype), axis=-2)
 
         init = nn.with_partitioning(nn.initializers.lecun_normal(), ("expert", EMBED, HIDDEN))
         w1 = self.param("w1", init, (E, H, F), jnp.float32).astype(cfg.dtype)
@@ -180,10 +207,12 @@ class LlamaMoEBlock(nn.Module):
                         nn.with_partitioning(nn.initializers.lecun_normal(),
                                              ("expert", HIDDEN, EMBED)),
                         (E, F, H), jnp.float32).astype(cfg.dtype)
-        act = nn.silu(jnp.einsum("...h,ehf->...ef", x, w1)) * \
-            jnp.einsum("...h,ehf->...ef", x, w3)
-        y = jnp.einsum("...ef,efh->...eh", act, w2)
-        return jnp.einsum("...e,...eh->...h", cw, y)
+
+        lead = x.shape[:-1]
+        xt = x.reshape(-1, H)
+        fn = moe_grouped_mlp if cfg.moe_grouped else moe_dense_mlp
+        out = fn(xt, w1, w3, w2, idx.reshape(-1, k), w.reshape(-1, k))
+        return out.reshape(*lead, H)
 
 
 class LlamaDecoderLayer(nn.Module):

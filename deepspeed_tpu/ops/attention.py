@@ -1,18 +1,24 @@
-"""Flash attention — Pallas TPU kernel with XLA fallback.
+"""Flash attention — Pallas TPU kernels (fwd AND bwd) with XLA fallback.
 
 TPU-native replacement for the reference's fused attention kernels
-(``csrc/transformer/inference/csrc/softmax.cu``, flash paths in
+(``csrc/transformer/inference/csrc/softmax.cu``, the fused training-kernel
+attention in ``csrc/transformer/`` and the blocked flash paths in
 ``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash``): blocked
-online-softmax attention that never materializes the [S, S] score matrix.
+online-softmax attention that never materializes the [S, S] score matrix —
+in either direction.
 
-Grid layout: (batch*heads, q_blocks, kv_blocks) with the kv dim innermost —
-accumulators (o, m, l) live in VMEM scratch that persists across the kv
-iterations of one q block; output is finalized on the last kv step. Causal
-masking prunes fully-masked kv blocks via `pl.when`.
+Layout: GQA is native. Queries arrive ``[B, S, H, D]`` and K/V
+``[B, S, KV, D]`` with ``H = KV * G``; tensors are regrouped to
+``[B*KV, G, S, D]`` so one grid step contracts the ``G * block_q`` query
+rows of a KV group against one K/V block — K/V are never expanded to query
+heads (G× HBM saving), and the folded G dimension *fattens* the MXU matmul.
 
-Backward: `jax.custom_vjp` whose bwd recomputes attention with the XLA path
-(flash-style remat — the standard memory/FLOPs trade); a dedicated Pallas
-bwd kernel is a later optimization.
+Forward (grid ``(B*KV, q_blocks, kv_blocks)``, kv innermost): accumulators
+(o, m, l) persist in VMEM scratch across the kv sweep; the log-sum-exp is
+written out as a residual. Backward is the standard two-pass recompute:
+a dq kernel sweeps kv blocks per q block, a dk/dv kernel sweeps q blocks
+per kv block; both rebuild p from the saved LSE (no second online softmax)
+and skip fully-masked blocks under causal.
 """
 
 import functools
@@ -32,21 +38,39 @@ except ImportError:  # pragma: no cover
 from .registry import registry, use_pallas
 
 NEG_INF = -1e30
+LSE_MASKED = 1e30  # rows that saw no key: exp(s - LSE_MASKED) == 0
 
 
 def _xla_attention(q, k, v, scale, causal):
-    """Reference implementation, [B, S, H, D]; XLA fuses this reasonably."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    """Reference implementation; q [B, S, H, D], k/v [B, S, KV, D] (GQA ok)."""
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, Sq, KV, G, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
     if causal:
         n, m = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v)
+    return out.reshape(B, Sq, H, D)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *, scale, causal,
-                  block_q, block_k, num_kv):
+def _row_pos(shape, block_q, offset):
+    """Absolute q position of each row in a [G*BQ, BK] score tile (rows are
+    g-major: row = g * BQ + pos)."""
+    r = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    return offset + r % block_q
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
+                *, scale, causal, block_q, block_k, num_kv):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -57,13 +81,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *, scale, causal,
         l_s[:] = jnp.zeros_like(l_s)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+        g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)  # [BK, D]
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(k_pos > q_pos, NEG_INF, s)
         m_prev, l_prev = m_s[:, 0], l_s[:, 0]
@@ -80,7 +105,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *, scale, causal,
         l_s[:, 0] = l_cur
 
     if causal:
-        # skip kv blocks entirely above the diagonal
         @pl.when(ki * block_k <= qi * block_q + block_q - 1)
         def _():
             _compute()
@@ -89,62 +113,249 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s, *, scale, causal,
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
+        g, bq = o_ref.shape[1], o_ref.shape[2]
         l = l_s[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l[:, None]).reshape(g, bq, -1).astype(o_ref.dtype)
+        m_safe = jnp.where(m_s[:, 0] <= NEG_INF, 0.0, m_s[:, 0])
+        lse = jnp.where(l == 0.0, LSE_MASKED, m_safe + jnp.log(safe_l))
+        lse_ref[0] = lse.reshape(g, bq)
+
+
+def _regroup(q, k, v):
+    """[B,S,H,D]/[B,S,KV,D] -> qg [B*KV, G, Sq, D], kt/vt [B*KV, Sk, D]."""
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = (q.reshape(B, Sq, KV, G, D).transpose(0, 2, 3, 1, 4)
+          .reshape(B * KV, G, Sq, D))
+    kt = k.transpose(0, 2, 1, 3).reshape(B * KV, k.shape[1], D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * KV, v.shape[1], D)
+    return qg, kt, vt
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    Sk, KV = k.shape[1], k.shape[2]
+    assert H % KV == 0, (H, KV)
+    G = H // KV
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
     assert Sq % block_q == 0 and Sk % block_k == 0, (
         f"seq lens ({Sq},{Sk}) must be divisible by blocks ({block_q},{block_k})")
     num_q, num_kv = Sq // block_q, Sk // block_k
 
-    # [B, S, H, D] -> [B*H, S, D]
-    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
-
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+    qg, kt, vt = _regroup(q, k, v)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, num_kv=num_kv)
-    scratch = [
-        pltpu.VMEM((block_q, D), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-    ]
-
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, num_q, num_kv),
+        grid=(B * KV, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-        scratch_shapes=scratch,
+        out_specs=[
+            pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * KV, G, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * KV, G, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G * block_q, D), jnp.float32),
+            pltpu.VMEM((G * block_q, 1), jnp.float32),
+            pltpu.VMEM((G * block_q, 1), jnp.float32),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    )(qg, kt, vt)
+    o = (out.reshape(B, KV, G, Sq, D).transpose(0, 3, 1, 2, 4)
+         .reshape(B, Sq, H, D))
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+               *, scale, causal, block_q, block_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        lse = lse_ref[0].reshape(g * bq)
+        delta = delta_ref[0].reshape(g * bq)
+
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = _row_pos(s.shape, block_q, qi * block_q)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        g, bq = dq_ref.shape[1], dq_ref.shape[2]
+        dq_ref[0] = dq_acc[:].reshape(g, bq, -1).astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc,
+                 *, scale, causal, block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        lse = lse_ref[0].reshape(g * bq)
+        delta = delta_ref[0].reshape(g * bq)
+
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = _row_pos(s.shape, block_q, qi * block_q)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        # dv += pᵀ @ do ; dk += dsᵀ @ q — over the folded G*BQ rows, which
+        # also sums the G query heads sharing this KV head (GQA reduce)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        # a q block contributes iff its last row can see this kv block
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    B, Sq, H, D = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    num_q, num_kv = Sq // block_q, Sk // block_k
+
+    qg, kt, vt = _regroup(q, k, v)
+    dog, _, _ = _regroup(g_out, k, v)
+    og, _, _ = _regroup(o, k, v)
+    delta = jnp.sum(dog.astype(jnp.float32) * og.astype(jnp.float32), axis=-1)
+
+    q_spec = pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, G, block_q), lambda b, i, j: (b, 0, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv=num_kv),
+        grid=(B * KV, num_q, num_kv),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * KV, G, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((G * block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qg, kt, vt, dog, lse, delta)
+
+    # kv-major grid for dk/dv: q sweep innermost
+    q_spec2 = pl.BlockSpec((1, G, block_q, D), lambda b, j, i: (b, 0, i, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    r_spec2 = pl.BlockSpec((1, G, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(B * KV, num_kv, num_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * KV, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * KV, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qg, kt, vt, dog, lse, delta)
+
+    dq = (dq.reshape(B, KV, G, Sq, D).transpose(0, 3, 1, 2, 4)
+          .reshape(B, Sq, H, D))
+    dk = dk.reshape(B, KV, Sk, D).transpose(0, 2, 1, 3)
+    dv = dv.reshape(B, KV, Sk, D).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
 
 
 def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret), (q, k, v)
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, scale, causal), q, k, v)
-    return vjp(g)
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -159,10 +370,11 @@ def flash_attention(q,
                     block_k: int = 128,
                     force_pallas: Optional[bool] = None,
                     interpret: bool = False):
-    """Blocked attention over [B, S, H, D] tensors.
+    """Blocked attention; q [B, S, H, D], k/v [B, S, KV, D] (GQA native).
 
-    Dispatches to the Pallas kernel on TPU (or with interpret=True anywhere);
-    falls back to the fused XLA softmax-attention path otherwise.
+    Dispatches to the Pallas kernels on TPU (or with interpret=True anywhere)
+    for BOTH forward and backward; falls back to the fused XLA
+    softmax-attention path otherwise.
     """
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if use_pallas(force_pallas) or interpret:

@@ -1,0 +1,83 @@
+"""Grouped MoE matmul numerics vs the dense-over-experts oracle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.grouped_matmul import moe_grouped_mlp, moe_dense_mlp
+
+
+def _setup(rng, T=17, H=8, F=16, E=4, k=2, dtype=jnp.float32):
+    x = jnp.asarray(rng.normal(size=(T, H)) * 0.3, dtype)
+    w1 = jnp.asarray(rng.normal(size=(E, H, F)) * 0.2, dtype)
+    w3 = jnp.asarray(rng.normal(size=(E, H, F)) * 0.2, dtype)
+    w2 = jnp.asarray(rng.normal(size=(E, F, H)) * 0.2, dtype)
+    logits = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    w, idx = jax.lax.top_k(jax.nn.softmax(logits, -1), k)
+    w = (w / w.sum(-1, keepdims=True)).astype(dtype)
+    return x, w1, w3, w2, idx, w
+
+
+def test_grouped_matches_dense():
+    rng = np.random.default_rng(0)
+    x, w1, w3, w2, idx, w = _setup(rng)
+    out_g = moe_grouped_mlp(x, w1, w3, w2, idx, w)
+    out_d = moe_dense_mlp(x, w1, w3, w2, idx, w)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_matches_dense_skewed_routing():
+    """All tokens on one expert (worst-case group imbalance)."""
+    rng = np.random.default_rng(1)
+    x, w1, w3, w2, _, w = _setup(rng, T=9, k=2)
+    idx = jnp.stack([jnp.full((9,), 3, jnp.int32), jnp.zeros((9,), jnp.int32)], -1)
+    out_g = moe_grouped_mlp(x, w1, w3, w2, idx, w)
+    out_d = moe_dense_mlp(x, w1, w3, w2, idx, w)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_gradients_match_dense():
+    rng = np.random.default_rng(2)
+    x, w1, w3, w2, idx, w = _setup(rng, T=11)
+
+    def loss(fn, x, w1, w3, w2):
+        return (fn(x, w1, w3, w2, idx, w) ** 2).mean()
+
+    g_g = jax.grad(lambda *a: loss(moe_grouped_mlp, *a), argnums=(0, 1, 2, 3))(x, w1, w3, w2)
+    g_d = jax.grad(lambda *a: loss(moe_dense_mlp, *a), argnums=(0, 1, 2, 3))(x, w1, w3, w2)
+    for a, b in zip(g_g, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_grouped_lowers_to_native_ragged_dot_on_tpu():
+    """The TPU lowering must emit the native chlo.ragged_dot grouped-GEMM
+    instruction (FLOPs ∝ T*k) — NOT the dense-masked decomposition the CPU
+    backend falls back to (which would be ∝ T*E). Checked via jax.export so
+    no TPU hardware is needed."""
+    rng = np.random.default_rng(3)
+    x, w1, w3, w2, idx, w = _setup(rng, T=64, H=32, F=64, E=8, k=2)
+    exp = jax.export.export(jax.jit(moe_grouped_mlp), platforms=["tpu"])(
+        x, w1, w3, w2, idx, w)
+    txt = exp.mlir_module()
+    assert txt.count("chlo.ragged_dot") == 3, txt.count("chlo.ragged_dot")
+
+
+def test_moe_block_grouped_vs_dense_end_to_end():
+    """LlamaMoEBlock produces the same output under both compute paths."""
+    from deepspeed_tpu.models import LlamaConfig
+    from deepspeed_tpu.models.llama import LlamaMoEBlock
+    import dataclasses
+
+    cfg = LlamaConfig.tiny(num_local_experts=4, num_experts_per_tok=2,
+                           dtype=jnp.float32)
+    block = LlamaMoEBlock(cfg)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 8, cfg.hidden_size)) * 0.3,
+                    jnp.float32)
+    params = block.init(jax.random.PRNGKey(0), x)
+    out_g = block.apply(params, x)
+    cfg_d = dataclasses.replace(cfg, moe_grouped=False)
+    out_d = LlamaMoEBlock(cfg_d).apply(params, x)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_d),
+                               rtol=1e-5, atol=1e-5)
