@@ -69,6 +69,20 @@ def _as_apply_fn(model) -> Callable:
     raise TypeError(f"model must be a flax Module or callable apply_fn, got {type(model)}")
 
 
+def _split_static_kwargs(kwargs):
+    """Split kwargs into (traced, static): plain Python int/bool/str kwargs are
+    treated as *static* jit arguments (one cached compile per value). This is
+    the contract that lets schedule-driven shape knobs — random-LTD keep
+    counts, curriculum seqlens — flow through the compiled step."""
+    traced, static = {}, []
+    for k, v in kwargs.items():
+        if isinstance(v, (bool, int, str)) and not hasattr(v, "shape"):
+            static.append((k, v))
+        else:
+            traced[k] = v
+    return traced, tuple(sorted(static))
+
+
 def _extract_loss(out):
     """Contract: model returns loss, (loss, aux) or dict with 'loss'."""
     if isinstance(out, tuple):
@@ -237,6 +251,31 @@ class DeepSpeedTpuEngine:
                 model, ds_engine=self,
                 recompute_fwd_factor=self._config.flops_profiler_config.recompute_fwd_factor)
 
+        # ---- data efficiency: curriculum + random-LTD (reference
+        # engine.py:349-356 scheduler construction, :1877-1883 forward hooks) ----
+        self.curriculum_scheduler_legacy = None
+        if self._config.curriculum_enabled_legacy:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler_legacy = CurriculumScheduler(
+                self._config.curriculum_params_legacy)
+        self.random_ltd_scheduler = None
+        routing = (self._config.data_efficiency_config or {}).get("data_routing", {})
+        if routing.get("enabled") and routing.get("random_ltd", {}).get("enabled", False):
+            from .data_pipeline.data_routing import RandomLTDScheduler
+            self.random_ltd_scheduler = RandomLTDScheduler(routing)
+        # inject the LTD keep-count into models that declare the kwarg (the
+        # reference mutates wrapped layers in place; functional models take it
+        # as an argument instead — each annealing level is one cached compile)
+        self._ltd_kwarg = False
+        if self.random_ltd_scheduler is not None:
+            import inspect
+            try:
+                sig = inspect.signature(model.__call__ if _HAS_FLAX
+                                        and isinstance(model, nn.Module) else model)
+                self._ltd_kwarg = "random_ltd_keep" in sig.parameters
+            except (TypeError, ValueError):
+                pass
+
         self.checkpoint_engine = OrbaxCheckpointEngine()
         dist.configure(deepspeed_config=self._config)
 
@@ -345,11 +384,11 @@ class DeepSpeedTpuEngine:
             qwz_gather = make_qwz_param_gather(self.mesh_ctx, self.param_shardings,
                                                qgz=zc.zero_quantized_gradients)
 
-        def loss_of(params, args, kwargs, scale):
+        def loss_of(params, args, kwargs, static_kv, scale):
             if qwz_gather is not None:
                 params = qwz_gather(params)
             cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
-            out = apply_fn(cparams, *args, **kwargs)
+            out = apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))
             loss, _ = _extract_loss(out)
             # scale_loss_by_gas (engine.py:1816) + fp16 loss scaling
             scaled = loss.astype(jnp.float32) / gas
@@ -357,23 +396,24 @@ class DeepSpeedTpuEngine:
                 scaled = scaled * scale
             return scaled, loss
 
-        def fwd_bwd(params, acc, scale, args, kwargs):
+        def fwd_bwd(params, acc, scale, args, kwargs, static_kv):
             (scaled, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                params, args, kwargs, scale)
+                params, args, kwargs, static_kv, scale)
             new_acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, grads)
             return loss, new_acc
 
         self._fwd_bwd = jax.jit(
             fwd_bwd,
             donate_argnums=(1, ),
+            static_argnums=(5, ),
             out_shardings=(None, self.grad_shardings),
         )
 
-        def fwd_only(params, args, kwargs):
+        def fwd_only(params, args, kwargs, static_kv):
             cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
-            return apply_fn(cparams, *args, **kwargs)
+            return apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))
 
-        self._fwd_only = jax.jit(fwd_only)
+        self._fwd_only = jax.jit(fwd_only, static_argnums=(3, ))
 
         def apply_step(params, acc, opt_state, scale_state):
             scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
@@ -416,10 +456,10 @@ class DeepSpeedTpuEngine:
         # grad-accumulation buffer materialized in HBM and one dispatch per
         # step instead of two (the reference necessarily splits these across
         # host-driven kernel launches; under XLA the fusion is free win)
-        def train_step(params, opt_state, scale_state, args, kwargs):
+        def train_step(params, opt_state, scale_state, args, kwargs, static_kv):
             scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
             (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                params, args, kwargs, scale)
+                params, args, kwargs, static_kv, scale)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / scale, grads)
             overflow = has_overflow(grads) if use_scaling else jnp.bool_(False)
             gnorm = optax.global_norm(grads)
@@ -437,6 +477,7 @@ class DeepSpeedTpuEngine:
         self._train_step_fused = jax.jit(
             train_step,
             donate_argnums=(0, 1),
+            static_argnums=(5, ),
             out_shardings=(None, self.param_shardings, self.opt_state_shardings,
                            scale_out, repl, repl),
         ) if gas == 1 else None
@@ -444,6 +485,41 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
     # ------------------------------------------------------------------
+
+    def _apply_data_efficiency(self, args, kwargs):
+        """Per-micro-batch data-efficiency hooks (reference engine.py:1877-1883):
+        advance the curriculum and truncate the batch to the current seqlen
+        difficulty; advance random-LTD and inject its keep-count. Seqlen
+        truncation changes array shapes, so each difficulty level compiles
+        once — ``difficulty_step`` bounds the number of distinct programs."""
+        if self.curriculum_scheduler_legacy is not None:
+            self.curriculum_scheduler_legacy.update_difficulty(self.global_steps + 1)
+            if self._config.curriculum_params_legacy.get("curriculum_type") == "seqlen":
+                L = int(self.curriculum_scheduler_legacy.get_current_difficulty())
+                # the canonical sequence length is axis 1 of the first array
+                # arg (input ids); ONLY axes of that exact length are
+                # truncated, so (B, F) feature arrays and unrelated dims pass
+                # through; (B, S, S) masks get both seq axes cut
+                leaves = [x for x in jax.tree_util.tree_leaves(args)
+                          if hasattr(x, "ndim") and x.ndim >= 2]
+                S = leaves[0].shape[1] if leaves else None
+
+                def trunc(x):
+                    if S is None or L >= S or not hasattr(x, "ndim"):
+                        return x
+                    for axis in (1, 2):
+                        if x.ndim > axis and x.shape[axis] == S:
+                            x = jax.lax.slice_in_dim(x, 0, L, axis=axis)
+                    return x
+
+                args = jax.tree_util.tree_map(trunc, args)
+                kwargs = jax.tree_util.tree_map(trunc, kwargs)
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
+            if self._ltd_kwarg:
+                kwargs = dict(kwargs)
+                kwargs["random_ltd_keep"] = int(self.random_ltd_scheduler.get_current_seq())
+        return args, kwargs
 
     def forward(self, *args, **kwargs):
         """Compute loss AND cache gradients (see module docstring)."""
@@ -457,16 +533,19 @@ class DeepSpeedTpuEngine:
                 "use eval_batch() or module_forward() (grad-free compiled path)")
         self.timers(FORWARD_MICRO_TIMER).start()
         scale = self.scale_state.cur_scale if self._use_loss_scaling else self._one
+        args, kwargs = self._apply_data_efficiency(args, kwargs)
+        kwargs, static_kv = _split_static_kwargs(kwargs)
         args = jax.device_put(args, self.zero_plan.batch_sharding(args))
         kwargs = jax.device_put(kwargs, self.zero_plan.batch_sharding(kwargs))
-        loss, new_acc = self._fwd_bwd(self.params, self.grad_acc, scale, args, kwargs)
+        loss, new_acc = self._fwd_bwd(self.params, self.grad_acc, scale, args, kwargs,
+                                      static_kv)
         # grad_acc was donated; keep the new buffer, commit on backward()
         self.grad_acc = new_acc
         self._pending = loss
         # abstract arg spec for the flops profiler's cost analysis
         self.last_fwd_spec = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") else x,
-            (self.params, self.grad_acc, scale, args, kwargs))
+            (self.params, self.grad_acc, scale, args, kwargs, static_kv))
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
@@ -579,11 +658,13 @@ class DeepSpeedTpuEngine:
         assert self._train_step_fused is not None, \
             "fused_train_step requires gradient_accumulation_steps == 1"
         self.tput_timer.start()
+        args, kwargs = self._apply_data_efficiency(args, kwargs)
+        kwargs, static_kv = _split_static_kwargs(kwargs)
         args = jax.device_put(args, self.zero_plan.batch_sharding(args))
         kwargs = jax.device_put(kwargs, self.zero_plan.batch_sharding(kwargs))
         (loss, self.params, self.opt_state, self.scale_state, overflow,
          gnorm) = self._train_step_fused(self.params, self.opt_state, self.scale_state,
-                                         args, kwargs)
+                                         args, kwargs, static_kv)
         self._last_grad_norm = gnorm
         self.losses = loss
         self.micro_steps += 1
@@ -600,11 +681,18 @@ class DeepSpeedTpuEngine:
         return loss
 
     def eval_batch(self, *args, **kwargs):
-        """Forward-only compiled path for evaluation."""
-        return self._fwd_only(self.params, args, kwargs)
+        """Forward-only compiled path for evaluation.
+
+        Plain Python int/bool/str kwargs are STATIC jit arguments (flax-style
+        ``deterministic`` flags, LTD keep-counts): each distinct value compiles
+        once. Pass per-step varying numbers as arrays, not Python scalars.
+        """
+        kwargs, static_kv = _split_static_kwargs(kwargs)
+        return self._fwd_only(self.params, args, kwargs, static_kv)
 
     def module_forward(self, *args, **kwargs):
-        return self._fwd_only(self.params, args, kwargs)
+        kwargs, static_kv = _split_static_kwargs(kwargs)
+        return self._fwd_only(self.params, args, kwargs, static_kv)
 
     # ------------------------------------------------------------------
     # info API (reference engine.py assorted getters)
@@ -643,6 +731,15 @@ class DeepSpeedTpuEngine:
     def get_sequence_parallel_group(self):
         return "seq"
 
+    def random_ltd_enabled(self):
+        return self.random_ltd_scheduler is not None
+
+    def curriculum_enabled_legacy(self):
+        return self.curriculum_scheduler_legacy is not None
+
+    def curriculum_params_legacy(self):
+        return self._config.curriculum_params_legacy
+
     # ------------------------------------------------------------------
     # checkpoint (reference engine.py:3109 save / :2763 load)
     # ------------------------------------------------------------------
@@ -672,6 +769,12 @@ class DeepSpeedTpuEngine:
             sd["lr_scheduler"] = self.lr_scheduler.state_dict()
         if self._host_optimizer is not None:
             sd["host_optimizer"] = self._host_optimizer.state_dict()
+        # data-efficiency schedulers (reference engine.py:3300 saves
+        # random_ltd + sampler/curriculum state in the checkpoint)
+        if self.random_ltd_scheduler is not None:
+            sd["random_ltd"] = self.random_ltd_scheduler.state_dict()
+        if self.curriculum_scheduler_legacy is not None:
+            sd["curriculum_state"] = dict(self.curriculum_scheduler_legacy.get_state())
         return sd
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
@@ -769,4 +872,9 @@ class DeepSpeedTpuEngine:
             if (load_lr_scheduler_states and self.lr_scheduler is not None
                     and "lr_scheduler" in host_state):
                 self.lr_scheduler.load_state_dict(host_state["lr_scheduler"])
+            if self.random_ltd_scheduler is not None and "random_ltd" in host_state:
+                self.random_ltd_scheduler.load_state_dict(host_state["random_ltd"])
+            if (self.curriculum_scheduler_legacy is not None
+                    and "curriculum_state" in host_state):
+                self.curriculum_scheduler_legacy.set_state(host_state["curriculum_state"])
         return path, client_state
