@@ -46,6 +46,27 @@ def initialize(args=None,
     if isinstance(config, dict) and config.get("hybrid_engine", {}).get("enabled"):
         from .runtime.hybrid_engine import DeepSpeedHybridEngine as DeepSpeedTpuEngine  # noqa: F811
 
+    # ZeRO-3 parameter offload (ZeRO-Infinity): the streaming layer-list
+    # executor (reference stage3.py:614 _configure_tensor_swapping path)
+    if isinstance(config, dict) and str(
+            config.get("zero_optimization", {}).get("offload_param", {})
+            .get("device", "none")) != "none":
+        from .config import DeepSpeedTpuConfig as _Cfg
+        from .runtime.zero_infinity import ZeroInfinityEngine
+        if not isinstance(model, (list, tuple)):
+            raise ValueError(
+                "zero_optimization.offload_param requires the model as a layer "
+                "list (the PipelineModule/LayerSpec contract): params stream "
+                "host->HBM per layer, which needs explicit layer boundaries")
+        if "loss_fn" not in kwargs:
+            raise ValueError("offload_param training requires loss_fn=... "
+                             "(maps the last layer's output + batch tail to a scalar)")
+        engine = ZeroInfinityEngine(
+            layers=model, layer_params=model_parameters,
+            loss_fn=kwargs.pop("loss_fn"),
+            config=_Cfg(config) if not isinstance(config, _Cfg) else config)
+        return engine, engine.optimizer, None, None
+
     engine = DeepSpeedTpuEngine(model=model,
                                 optimizer=optimizer,
                                 model_parameters=model_parameters,

@@ -218,9 +218,15 @@ class DeepSpeedTpuEngine:
 
         # ZeRO-Offload: optimizer states on host DRAM or NVMe (reference
         # stage_1_and_2.py cpu-offload path + cpu_adam); frees HBM of the
-        # fp32 master + moments at the cost of a device<->host stream per step
+        # fp32 master + moments at the cost of a device<->host stream per step.
+        # ratio < 1.0 = Offload++ Twin-Flow (reference stage3.py:849): the
+        # first `ratio` fraction of elements step on host, the rest on device.
         self._offload_device = zc.offload_optimizer_device  # none | cpu | nvme
         self._host_optimizer = None
+        self._offload_ratio = (float(zc.offload_optimizer.ratio)
+                               if zc.offload_optimizer else 1.0)
+        self._host_param_names = set()
+        self._device_tx = None
 
         # ---- state init ----
         if model_parameters is None and _HAS_FLAX and isinstance(model, nn.Module):
@@ -311,11 +317,40 @@ class DeepSpeedTpuEngine:
                            out_shardings=self.grad_shardings)
         self.grad_acc = zeros_fn(self.params)
 
-        if self._offload_device in ("cpu", "nvme"):
+        if self._offload_device in ("cpu", "nvme") and self._offload_ratio >= 1.0:
             # no device opt state at all — that's the HBM saving
             self.opt_state = None
             self.opt_state_shardings = None
             self._build_host_optimizer(params)
+        elif self._offload_device in ("cpu", "nvme"):
+            # Twin-Flow partial offload: split leaves at the `ratio` element
+            # boundary (leaf-greedy ≙ reference sub-group split). Host subset:
+            # numpy Adam; device subset: the fused optax path. set_to_zero on
+            # the host subset keeps those params untouched by the device
+            # program — the host step merges its masters back afterwards.
+            from .host_offload import flatten_tree, unflatten_like
+            # sizes come from array metadata — no device->host transfer here
+            flat = flatten_tree(params)
+            total = sum(v.size for v in flat.values())
+            budget = self._offload_ratio * total
+            cum, labels = 0, {}
+            for k, v in flat.items():
+                if cum < budget:
+                    labels[k] = "host"
+                    self._host_param_names.add(k)
+                    cum += v.size
+                else:
+                    labels[k] = "device"
+            label_tree = unflatten_like(labels, params)
+            self._device_tx = optax.multi_transform(
+                {"device": self.base_tx, "host": optax.set_to_zero()}, label_tree)
+            opt_state_shape = jax.eval_shape(self._device_tx.init, self.params)
+            self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
+            self.opt_state = jax.jit(self._device_tx.init,
+                                     out_shardings=self.opt_state_shardings)(self.params)
+            self._build_host_optimizer(params, subset=self._host_param_names)
+            log_dist(f"Twin-Flow partial offload: {cum}/{total} elements "
+                     f"({cum/total:.2f}) on host, rest on device", ranks=[0])
         else:
             opt_state_shape = jax.eval_shape(self.base_tx.init, self.params)
             self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
@@ -332,9 +367,10 @@ class DeepSpeedTpuEngine:
                                                             tuple(self.scale_state))
         self._one = jax.device_put(jnp.float32(1.0), repl)
 
-    def _build_host_optimizer(self, params):
+    def _build_host_optimizer(self, params, subset=None):
         """ZeRO-Offload host optimizer (numpy Adam ≙ cpu_adam; NVMe moments
-        via the pipelined swapper when device=nvme)."""
+        via the pipelined swapper when device=nvme). `subset` restricts it to
+        the Twin-Flow host partition."""
         import numpy as _np
         from .host_offload import HostAdamOptimizer, flatten_tree
         op = dict(self._config.optimizer_params or {})
@@ -349,9 +385,11 @@ class DeepSpeedTpuEngine:
             swapper = PipelinedOptimizerSwapper(
                 AioConfig(**(self._config._param_dict.get("aio", {}))),
                 swap_folder=nvme_path)
+        # flatten first, copy only the leaves this optimizer owns (with a
+        # Twin-Flow subset, the device partition never crosses the PCIe)
         host_params = {k: _np.asarray(v, _np.float32)
-                       for k, v in flatten_tree(jax.tree_util.tree_map(
-                           _np.asarray, params)).items()}
+                       for k, v in flatten_tree(params).items()
+                       if subset is None or k in subset}
         self._host_optimizer = HostAdamOptimizer(
             host_params,
             lr=float(op.get("lr", 1e-3)),
@@ -372,7 +410,7 @@ class DeepSpeedTpuEngine:
         apply_fn = self.apply_fn
         use_scaling = self._use_loss_scaling
         clip = float(self._config.gradient_clipping or 0.0)
-        tx = self.base_tx
+        tx = self._device_tx if self._device_tx is not None else self.base_tx
         scaler_cfg = self.scaler_cfg
 
         # ZeRO++ qwZ/qgZ: explicit int8-wire param gather (fwd) and gradient
@@ -439,8 +477,8 @@ class DeepSpeedTpuEngine:
         from .loss_scaler import LossScaleState
         scale_out = LossScaleState(*self.scale_state_shardings)
         repl = self.mesh_ctx.replicated()
-        if self._host_optimizer is not None:
-            # ZeRO-Offload: the optimizer step happens on host; no device
+        if self._host_optimizer is not None and self._device_tx is None:
+            # full ZeRO-Offload: the optimizer step happens on host; no device
             # apply program exists (its state would defeat the offload)
             self._apply_step = None
             self._train_step_fused = None
@@ -480,7 +518,9 @@ class DeepSpeedTpuEngine:
             static_argnums=(5, ),
             out_shardings=(None, self.param_shardings, self.opt_state_shardings,
                            scale_out, repl, repl),
-        ) if gas == 1 else None
+        ) if gas == 1 and self._device_tx is None else None
+        # (Twin-Flow needs the materialized grad buffer to snapshot the host
+        # subset, so the one-program fused path is off under partial offload)
 
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
@@ -570,7 +610,9 @@ class DeepSpeedTpuEngine:
         self.timers(STEP_MICRO_TIMER).start()
         if self.is_gradient_accumulation_boundary() and self.micro_steps > 0:
             self.tput_timer.start()
-            if self._host_optimizer is not None:
+            if self._host_optimizer is not None and self._device_tx is not None:
+                overflow, gnorm = self._partial_offload_step()
+            elif self._host_optimizer is not None:
                 overflow, gnorm = self._host_offload_step()
             else:
                 (self.params, self.opt_state, self.grad_acc, self.scale_state, overflow,
@@ -627,6 +669,43 @@ class DeepSpeedTpuEngine:
             lambda g: jax.device_put(jnp.zeros(g.shape, g.dtype), g.sharding),
             self.grad_acc)
         return overflow, gnorm
+
+    def _partial_offload_step(self):
+        """Twin-Flow (Offload++) step: snapshot the host-subset grads, kick the
+        device-subset program (async XLA dispatch), then run host Adam WHILE
+        the device program executes — the overlap the reference gets from CUDA
+        streams (blogs/deepspeed-offloadpp/README.md:10) falls out of XLA's
+        async dispatch. Finally merge host masters back into the param tree."""
+        from .host_offload import flatten_tree, unflatten_like
+        scale = float(self.scale_state.cur_scale) if self._use_loss_scaling else 1.0
+        flat_g = flatten_tree(self.grad_acc)
+        host_grads = {k: np.asarray(flat_g[k], np.float32) / scale
+                      for k in self._host_param_names}
+        # device subset steps in its compiled program (donates grad_acc/opt);
+        # host params pass through it unchanged (set_to_zero)
+        (params, self.opt_state, self.grad_acc, self.scale_state, overflow,
+         gnorm) = self._apply_step(self.params, self.grad_acc, self.opt_state,
+                                   self.scale_state)
+        clip = float(self._config.gradient_clipping or 0.0)
+        overflow_b = False
+        if self._use_loss_scaling or clip > 0:
+            # clip/overflow need the program's global-grad results — this
+            # host sync serializes device_step then host_step. Without them
+            # the host Adam overlaps the still-executing device program.
+            overflow_b = bool(overflow) if self._use_loss_scaling else False
+            if not overflow_b and clip > 0:
+                factor = min(1.0, clip / (float(gnorm) + 1e-6))
+                for g in host_grads.values():
+                    g *= factor
+        if not overflow_b:
+            master = self._host_optimizer.step(host_grads)
+            flat_p = flatten_tree(params)
+            flat_s = flatten_tree(self.param_shardings)
+            for k in self._host_param_names:
+                flat_p[k] = jax.device_put(jnp.asarray(master[k]), flat_s[k])
+            params = unflatten_like(flat_p, params)
+        self.params = params
+        return overflow_b, gnorm
 
     def _advance_schedule(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):

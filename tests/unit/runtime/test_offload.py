@@ -70,6 +70,60 @@ def test_offload_with_clipping():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-6)
 
 
+class TestTwinFlowPartialOffload:
+    """Offload++ ratio split (reference stage3.py:849 subgroup_to_device +
+    blogs/deepspeed-offloadpp): part of the optimizer steps on host, the rest
+    in the on-device fused program — both paths must run and together must
+    match the all-device optimizer numerically."""
+
+    def _engine(self, ratio, **over):
+        return make_engine(None,
+                           zero_optimization={"stage": 3,
+                                              "offload_optimizer": {"device": "cpu",
+                                                                    "ratio": ratio}},
+                           **over)
+
+    def test_ratio_splits_both_paths(self):
+        e = self._engine(0.3)
+        # both optimizer paths exist
+        assert e._host_optimizer is not None, "host path missing"
+        assert e.opt_state is not None, "device path missing"
+        total = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(e.params))
+        host = sum(v.size for v in e._host_optimizer.master.values())
+        assert 0 < host < total
+        # leaf-greedy split overshoots by at most one leaf
+        assert host >= 0.3 * total
+        # device opt state only covers the device subset (host subset is
+        # masked out of the inner adam state)
+        import optax
+        inner = [s for s in jax.tree_util.tree_leaves(
+            e.opt_state, is_leaf=lambda x: isinstance(x, optax.MaskedNode))]
+        assert any(isinstance(s, optax.MaskedNode) for s in inner)
+
+    @pytest.mark.parametrize("ratio", [0.3, 0.7])
+    def test_partial_matches_device(self, ratio):
+        ref = train(make_engine(None, zero_optimization={"stage": 3}))
+        got = train(self._engine(ratio))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-6)
+
+    def test_partial_with_clipping_matches_device(self):
+        ref = train(make_engine(None, zero_optimization={"stage": 3},
+                                gradient_clipping=1e-3))
+        got = train(self._engine(0.5, gradient_clipping=1e-3))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-6)
+
+    def test_partial_checkpoint_resume(self, tmp_path):
+        e1 = self._engine(0.4)
+        train(e1, 3, seed=1)
+        e1.save_checkpoint(tmp_path / "ck", tag="t")
+        ref = train(e1, 2, seed=2)
+        e2 = self._engine(0.4)
+        e2.load_checkpoint(str(tmp_path / "ck"), tag="t")
+        got = train(e2, 2, seed=2)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
 def test_offload_checkpoint_resume(tmp_path):
     e1 = make_engine({"device": "cpu"})
     train(e1, 3, seed=1)
