@@ -47,11 +47,18 @@ def initialize(args=None,
         from .runtime.hybrid_engine import DeepSpeedHybridEngine as DeepSpeedTpuEngine  # noqa: F811
 
     # ZeRO-3 parameter offload (ZeRO-Infinity): the streaming layer-list
-    # executor (reference stage3.py:614 _configure_tensor_swapping path)
-    if isinstance(config, dict) and str(
-            config.get("zero_optimization", {}).get("offload_param", {})
-            .get("device", "none")) != "none":
-        from .config import DeepSpeedTpuConfig as _Cfg
+    # executor (reference stage3.py:614 _configure_tensor_swapping path).
+    # Normalize the config (dict | json path | DeepSpeedTpuConfig) before
+    # gating so every spelling routes the same way; JSON nulls stay inert.
+    from .config import DeepSpeedTpuConfig as _Cfg
+    if isinstance(config, str):
+        import json as _json
+        with open(config) as _f:
+            config = _json.load(_f)
+    _pd = config._param_dict if isinstance(config, _Cfg) else (
+        config if isinstance(config, dict) else {})
+    _op = ((_pd.get("zero_optimization") or {}).get("offload_param") or {})
+    if str(_op.get("device", "none")) != "none":
         from .runtime.zero_infinity import ZeroInfinityEngine
         if not isinstance(model, (list, tuple)):
             raise ValueError(

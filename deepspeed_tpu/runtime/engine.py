@@ -643,26 +643,49 @@ class DeepSpeedTpuEngine:
         self.timers(STEP_MICRO_TIMER).stop()
 
     def _host_offload_step(self):
-        """Device→host grads, numpy Adam, host→device params (ZeRO-Offload
-        step; reference stage_1_and_2.py cpu-offload + cpu_adam)."""
+        """ZeRO-Offload step, pipelined (reference stage_1_and_2.py cpu-offload
+        + cpu_adam + pipelined_optimizer_swapper.py overlap):
+
+        1. kick async device→host copies for EVERY grad leaf up front — the
+           per-leaf readbacks below then wait only for their own leaf while
+           the rest stream in the background;
+        2. one pass over leaves computes the global norm/overflow as
+           transfers complete;
+        3. the Adam pass updates one leaf at a time and immediately kicks its
+           async host→device upload — uploads overlap the remaining leaves'
+           host math (double buffering without CUDA streams)."""
         from .host_offload import flatten_tree, unflatten_like
         scale = float(self.scale_state.cur_scale) if self._use_loss_scaling else 1.0
-        grads = {k: np.asarray(v, dtype=np.float32) / scale
-                 for k, v in flatten_tree(jax.tree_util.tree_map(
-                     np.asarray, self.grad_acc)).items()}
-        overflow = any(not np.all(np.isfinite(g)) for g in grads.values())
-        gnorm = float(np.sqrt(sum(float(np.sum(g.astype(np.float64)**2))
-                                  for g in grads.values())))
+        flat_g = flatten_tree(self.grad_acc)
+        for v in flat_g.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        grads, sq, overflow = {}, 0.0, False
+        for k, v in flat_g.items():
+            g = np.asarray(v, dtype=np.float32)
+            if scale != 1.0:
+                g = g / scale
+            grads[k] = g
+            s = float(np.sum(g.astype(np.float64)**2))
+            if not np.isfinite(s):
+                overflow = True
+            sq += s
+        gnorm = float(np.sqrt(sq)) if np.isfinite(sq) else float("inf")
         if not overflow:
             clip = float(self._config.gradient_clipping or 0.0)
-            if clip > 0:
-                factor = min(1.0, clip / (gnorm + 1e-6))
-                for g in grads.values():
-                    g *= factor
-            master = self._host_optimizer.step(grads)
-            self.params = jax.device_put(
-                unflatten_like({k: jnp.asarray(v) for k, v in master.items()},
-                               self.params), self.param_shardings)
+            factor = min(1.0, clip / (gnorm + 1e-6)) if clip > 0 else 1.0
+            flat_s = flatten_tree(self.param_shardings)
+            names = list(grads.keys())
+            self._host_optimizer.step_begin()
+            new_flat = {}
+            for i, k in enumerate(names):
+                g = grads[k] * factor if factor != 1.0 else grads[k]
+                p_new = self._host_optimizer.step_param(
+                    k, g, prefetch=names[i + 1] if i + 1 < len(names) else None)
+                # async dispatch: this upload flies while the next leaf steps
+                new_flat[k] = jax.device_put(jnp.asarray(p_new), flat_s[k])
+            self._host_optimizer.step_end()
+            self.params = unflatten_like(new_flat, self.params)
         if self._use_loss_scaling:
             self.scale_state = self.scaler_cfg.update(self.scale_state, jnp.bool_(overflow))
         self.grad_acc = jax.tree_util.tree_map(

@@ -37,9 +37,7 @@ class HostAdamOptimizer:
     def __init__(self, params_host: Dict[str, np.ndarray], lr: float = 1e-3,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
-                 nvme_swapper=None, lr_fn=None):
-        self.master = {k: np.asarray(v, dtype=np.float32).copy()
-                       for k, v in params_host.items()}
+                 nvme_swapper=None, lr_fn=None, master_swapper=None):
         self.lr = lr
         self.lr_fn = lr_fn
         self.b1, self.b2 = betas
@@ -48,20 +46,48 @@ class HostAdamOptimizer:
         self.adamw_mode = adamw_mode
         self.t = 0
         self._swapper = nvme_swapper
+        self._master_swapper = master_swapper
+        if master_swapper is None:
+            self.master = {k: np.asarray(v, dtype=np.float32).copy()
+                           for k, v in params_host.items()}
+        else:
+            # fp32 master lives ON NVMe (ZeRO-Infinity params_in_nvme): DRAM
+            # holds one leaf at a time during step/serve
+            self.master = {}
+            self._master_keys = list(params_host.keys())
+            for k, v in params_host.items():
+                master_swapper.swap_out_and_release(k, np.asarray(v, np.float32))
+            master_swapper.synchronize_writes()
         if nvme_swapper is None:
-            self.m = {k: np.zeros_like(v) for k, v in self.master.items()}
-            self.v = {k: np.zeros_like(v) for k, v in self.master.items()}
+            self.m = {k: np.zeros_like(np.asarray(v)) for k, v in params_host.items()}
+            self.v = {k: np.zeros_like(np.asarray(v)) for k, v in params_host.items()}
         else:  # moments live on NVMe between steps
             self.m = self.v = None
-            for k, w in self.master.items():
+            for k, w in params_host.items():
                 nvme_swapper.swap_out_optimizer_state(
-                    k, {"exp_avg": np.zeros_like(w), "exp_avg_sq": np.zeros_like(w)})
+                    k, {"exp_avg": np.zeros_like(np.asarray(w)),
+                        "exp_avg_sq": np.zeros_like(np.asarray(w))})
+
+    @property
+    def param_names(self):
+        return (self._master_keys if self._master_swapper is not None
+                else list(self.master.keys()))
+
+    def read_master(self, name: str) -> np.ndarray:
+        """Fetch one master leaf (from DRAM, or NVMe in master-swapper mode)."""
+        if self._master_swapper is None:
+            return self.master[name]
+        self._master_swapper.swap_in([name], async_op=False)
+        return self._master_swapper.retrieve(name)
+
+    def prefetch_master(self, names) -> None:
+        if self._master_swapper is not None:
+            self._master_swapper.swap_in(list(names), async_op=True)
 
     def _cur_lr(self) -> float:
         return float(self.lr_fn(self.t)) if self.lr_fn is not None else self.lr
 
-    def _step_one(self, name: str, g: np.ndarray, m: np.ndarray, v: np.ndarray):
-        p = self.master[name]
+    def _step_one(self, p: np.ndarray, g: np.ndarray, m: np.ndarray, v: np.ndarray):
         if self.wd and not self.adamw_mode:
             g = g + self.wd * p  # L2 into the gradient (torch Adam)
         m *= self.b1
@@ -76,42 +102,92 @@ class HostAdamOptimizer:
         p -= self._cur_lr() * update
         return m, v
 
-    def step(self, grads_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """One optimizer step over all params; returns the updated master."""
+    # -- streaming per-param API: lets the engine interleave host math with
+    # device<->host transfers (reference pipelined_optimizer_swapper.py) --
+
+    def step_begin(self):
         self.t += 1
+
+    def step_param(self, name: str, g: np.ndarray,
+                   prefetch: Optional[str] = None) -> np.ndarray:
+        """Step ONE param; returns its updated master. `prefetch` kicks the
+        async NVMe read of the next param's moments/master (double buffering)."""
+        g = np.asarray(g, np.float32)
+        if prefetch is not None:
+            self.prefetch_master([prefetch])
+        p = self.read_master(name)
         if self._swapper is None:
-            for k, g in grads_host.items():
-                self._step_one(k, np.asarray(g, np.float32), self.m[k], self.v[k])
+            self._step_one(p, g, self.m[name], self.v[name])
         else:
-            names = list(grads_host.keys())
-            # pipelined: prefetch next group's moments while stepping current
-            self._swapper._swapper.swap_in([f"{names[0]}.exp_avg", f"{names[0]}.exp_avg_sq"],
-                                           async_op=True)
-            for i, k in enumerate(names):
-                if i + 1 < len(names):
-                    nxt = names[i + 1]
-                    self._swapper._swapper.swap_in([f"{nxt}.exp_avg", f"{nxt}.exp_avg_sq"],
-                                                   async_op=True)
-                state = {kk: self._swapper._swapper.retrieve(f"{k}.{kk}")
-                         for kk in ("exp_avg", "exp_avg_sq")}
-                m, v = self._step_one(k, np.asarray(grads_host[k], np.float32),
-                                      state["exp_avg"], state["exp_avg_sq"])
-                for kk, arr in (("exp_avg", m), ("exp_avg_sq", v)):
-                    self._swapper._swapper.swap_out_and_release(f"{k}.{kk}", arr)
+            sw = self._swapper._swapper
+            sw.swap_in([f"{name}.exp_avg", f"{name}.exp_avg_sq"], async_op=True)
+            if prefetch is not None:
+                sw.swap_in([f"{prefetch}.exp_avg", f"{prefetch}.exp_avg_sq"],
+                           async_op=True)
+            m = sw.retrieve(f"{name}.exp_avg")
+            v = sw.retrieve(f"{name}.exp_avg_sq")
+            m, v = self._step_one(p, g, m, v)
+            sw.swap_out_and_release(f"{name}.exp_avg", m)
+            sw.swap_out_and_release(f"{name}.exp_avg_sq", v)
+        if self._master_swapper is not None:
+            self._master_swapper.swap_out_and_release(name, p)
+        return p
+
+    def step_end(self):
+        if self._swapper is not None:
             self._swapper._swapper.synchronize_writes()
-        return self.master
+        if self._master_swapper is not None:
+            self._master_swapper.synchronize_writes()
+
+    def step(self, grads_host: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """One optimizer step over all params; returns the updated masters
+        (DRAM mode: the live dict; NVMe-master mode: a transient copy)."""
+        self.step_begin()
+        names = list(grads_host.keys())
+        out = {}
+        for i, k in enumerate(names):
+            out[k] = self.step_param(
+                k, grads_host[k],
+                prefetch=names[i + 1] if i + 1 < len(names) else None)
+        self.step_end()
+        return self.master if self._master_swapper is None else out
 
     def state_dict(self) -> dict:
-        sd = {"t": self.t, "master": self.master}
+        """Full optimizer state, NVMe-resident pieces included (a checkpoint
+        that silently dropped the moments would 'resume' with reset Adam)."""
+        sd = {"t": self.t}
+        sd["master"] = ({k: self.read_master(k) for k in self.param_names}
+                        if self._master_swapper is not None else self.master)
         if self._swapper is None:
             sd["m"], sd["v"] = self.m, self.v
+        else:
+            sw = self._swapper._swapper
+            m, v = {}, {}
+            for k in self.param_names:
+                sw.swap_in([f"{k}.exp_avg", f"{k}.exp_avg_sq"], async_op=False)
+                m[k] = sw.retrieve(f"{k}.exp_avg")
+                v[k] = sw.retrieve(f"{k}.exp_avg_sq")
+            sd["m"], sd["v"] = m, v
         return sd
 
     def load_state_dict(self, sd: dict) -> None:
         self.t = sd["t"]
-        self.master = {k: np.asarray(v, np.float32) for k, v in sd["master"].items()}
-        if self._swapper is None and "m" in sd:
-            self.m, self.v = sd["m"], sd["v"]
+        masters = {k: np.asarray(v, np.float32) for k, v in sd["master"].items()}
+        if self._master_swapper is None:
+            self.master = masters
+        else:
+            self._master_keys = list(masters.keys())
+            for k, v in masters.items():
+                self._master_swapper.swap_out_and_release(k, v)
+            self._master_swapper.synchronize_writes()
+        if self._swapper is None:
+            if "m" in sd:
+                self.m, self.v = sd["m"], sd["v"]
+        elif "m" in sd:
+            for k in sd["m"]:
+                self._swapper.swap_out_optimizer_state(
+                    k, {"exp_avg": np.asarray(sd["m"][k], np.float32),
+                        "exp_avg_sq": np.asarray(sd["v"][k], np.float32)})
 
 
 def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:

@@ -78,7 +78,7 @@ class ZeroInfinityEngine:
     """
 
     def __init__(self, layers: Sequence, layer_params: Sequence, loss_fn: Callable,
-                 config, compute_dtype=jnp.bfloat16):
+                 config):
         self._config = config
         zc = config.zero_config
         oc = zc.offload_param
@@ -130,6 +130,16 @@ class ZeroInfinityEngine:
                 AioConfig(**(config._param_dict.get("aio", {}))),
                 swap_folder=str(getattr(zc.offload_optimizer, "nvme_path", None)
                                 or "/tmp/ds_tpu_offload"))
+        # offload_param.device=nvme: the fp32 master itself lives on NVMe (the
+        # swapper IS the master store — DRAM holds one leaf at a time); cpu:
+        # master in DRAM, no NVMe traffic
+        self._param_swapper = None
+        if str(oc.device) == "nvme":
+            from .swap_tensor import AsyncPartitionedParameterSwapper, AioConfig
+            self._param_swapper = AsyncPartitionedParameterSwapper(
+                AioConfig(**(config._param_dict.get("aio", {}))),
+                swap_folder=str(oc.nvme_path or "/tmp/ds_tpu_param_swap"))
+        self._total_elements = sum(v.size for v in host_master.values())
         self._host_optimizer = HostAdamOptimizer(
             host_master,
             lr=float(op.get("lr", 1e-3)),
@@ -138,18 +148,9 @@ class ZeroInfinityEngine:
             weight_decay=float(op.get("weight_decay", 0.0)),
             adamw_mode=(name == "adamw"),
             nvme_swapper=opt_swapper,
-            lr_fn=lr_fn)
-
-        # NVMe persistence of the compute copies (offload_param.device=nvme)
-        self._param_swapper = None
-        if str(oc.device) == "nvme":
-            from .swap_tensor import AsyncPartitionedParameterSwapper, AioConfig
-            self._param_swapper = AsyncPartitionedParameterSwapper(
-                AioConfig(**(config._param_dict.get("aio", {}))),
-                swap_folder=str(oc.nvme_path or "/tmp/ds_tpu_param_swap"))
-            for k, v in self._host_optimizer.master.items():
-                self._param_swapper.swap_out_and_release(k, v)
-            self._param_swapper.synchronize_writes()
+            lr_fn=lr_fn,
+            master_swapper=self._param_swapper)
+        del host_master  # NVMe mode: the swapper owns the bytes now
 
         # per-layer compiled programs (cached by layer index; identical-shape
         # layers share XLA's compile cache by jaxpr hash anyway)
@@ -170,8 +171,7 @@ class ZeroInfinityEngine:
         self._live_param_bytes = 0
         self.peak_param_bytes = 0       # observability: the realized HBM ceiling
         itemsize = jnp.dtype(self.compute_dtype).itemsize
-        self.total_param_bytes = sum(v.size * itemsize
-                                     for v in self._host_optimizer.master.values())
+        self.total_param_bytes = self._total_elements * itemsize
 
         # grad accumulation on HOST (stage-2-style: never resident on device
         # beyond one layer)
@@ -182,7 +182,7 @@ class ZeroInfinityEngine:
         self._pending_loss = None
         log_dist(
             f"ZeroInfinityEngine: {self.n_layers} layers, "
-            f"{sum(v.size for v in self._host_optimizer.master.values())/1e6:.1f}M params "
+            f"{self._total_elements/1e6:.1f}M params "
             f"offloaded to {oc.device}, prefetch={self.prefetch}", ranks=[0])
 
     # ------------------------------------------------------------------
@@ -331,11 +331,9 @@ class ZeroInfinityEngine:
             factor = min(1.0, clip / (gnorm + 1e-6))
             for g in grads.values():
                 g *= factor
-        master = self._host_optimizer.step(grads)
-        if self._param_swapper is not None:
-            for k, v in master.items():
-                self._param_swapper.swap_out_and_release(k, v)
-            self._param_swapper.synchronize_writes()
+        # step_param writes NVMe-resident masters back through the swapper
+        # itself; nothing extra to persist here
+        self._host_optimizer.step(grads)
         self._host_grad_acc = {}
         self.global_steps += 1
 
@@ -406,11 +404,9 @@ class ZeroInfinityEngine:
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "zero_infinity.pkl"), "rb") as f:
             sd = pickle.load(f)
+        # load_state_dict re-seeds the NVMe master store through the
+        # master_swapper when params live on disk
         self._host_optimizer.load_state_dict(sd["host_optimizer"])
         self.global_steps = sd["global_steps"]
         self.micro_steps = sd["micro_steps"]
-        if self._param_swapper is not None:
-            for k, v in self._host_optimizer.master.items():
-                self._param_swapper.swap_out_and_release(k, v)
-            self._param_swapper.synchronize_writes()
         return path, sd.get("client_state", {})

@@ -116,8 +116,23 @@ def test_nvme_param_offload(tmp_path):
     e = make_infinity_engine(device="nvme", tmp=tmp_path)
     got = train(e)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-6)
-    # param bytes actually live on disk
+    # param bytes actually live on disk, and NOT duplicated in DRAM
     assert any(f.endswith(".swp") for f in os.listdir(tmp_path))
+    assert e._host_optimizer.master == {}, "NVMe mode must not keep a DRAM master"
+
+
+def test_nvme_checkpoint_preserves_moments(tmp_path):
+    """Resume from an NVMe-master checkpoint must restore Adam moments —
+    a resume with silently-reset moments diverges from the live run."""
+    ck = tmp_path / "ck"
+    e1 = make_infinity_engine(device="nvme", tmp=tmp_path / "swap1")
+    train(e1, 3, seed=1)
+    e1.save_checkpoint(str(ck), tag="t")
+    ref = train(e1, 2, seed=2)
+    e2 = make_infinity_engine(device="nvme", tmp=tmp_path / "swap2")
+    e2.load_checkpoint(str(ck), tag="t")
+    got = train(e2, 2, seed=2)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
 
 
 def test_gradient_accumulation():
