@@ -41,15 +41,9 @@ def initialize(args=None,
     if args is not None and config is None:
         config = getattr(args, "deepspeed_config", None)
 
-    # RLHF hybrid engine (reference __init__.py: DeepSpeedHybridEngine when
-    # config.hybrid_engine.enabled)
-    if isinstance(config, dict) and config.get("hybrid_engine", {}).get("enabled"):
-        from .runtime.hybrid_engine import DeepSpeedHybridEngine as DeepSpeedTpuEngine  # noqa: F811
-
-    # ZeRO-3 parameter offload (ZeRO-Infinity): the streaming layer-list
-    # executor (reference stage3.py:614 _configure_tensor_swapping path).
-    # Normalize the config (dict | json path | DeepSpeedTpuConfig) before
-    # gating so every spelling routes the same way; JSON nulls stay inert.
+    # Normalize the config (dict | json path | DeepSpeedTpuConfig) before ANY
+    # engine-selection gate so every spelling routes the same way; JSON nulls
+    # stay inert.
     from .config import DeepSpeedTpuConfig as _Cfg
     if isinstance(config, str):
         import json as _json
@@ -57,6 +51,14 @@ def initialize(args=None,
             config = _json.load(_f)
     _pd = config._param_dict if isinstance(config, _Cfg) else (
         config if isinstance(config, dict) else {})
+
+    # RLHF hybrid engine (reference __init__.py: DeepSpeedHybridEngine when
+    # config.hybrid_engine.enabled)
+    if (_pd.get("hybrid_engine") or {}).get("enabled"):
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine as DeepSpeedTpuEngine  # noqa: F811
+
+    # ZeRO-3 parameter offload (ZeRO-Infinity): the streaming layer-list
+    # executor (reference stage3.py:614 _configure_tensor_swapping path)
     _op = ((_pd.get("zero_optimization") or {}).get("offload_param") or {})
     if str(_op.get("device", "none")) != "none":
         from .runtime.zero_infinity import ZeroInfinityEngine

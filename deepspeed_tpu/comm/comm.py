@@ -122,9 +122,20 @@ def init_distributed(dist_backend: str = "xla",
     `mpi_discovery` comm.py:688), after which `jax.devices()` is global.
     """
     global _INITIALIZED
-    coord = os.environ.get("COORDINATOR_ADDRESS")
-    nproc = int(os.environ.get("NUM_PROCESSES", os.environ.get("OMPI_COMM_WORLD_SIZE", "1")))
-    pid = int(os.environ.get("PROCESS_ID", os.environ.get("OMPI_COMM_WORLD_RANK", "0")))
+
+    def _env(*names, default=None):
+        for n in names:
+            if os.environ.get(n) not in (None, ""):
+                return os.environ[n]
+        return default
+
+    # the launcher exports the JAX_-prefixed spellings (launcher/runner.py
+    # build_commands); bare + OpenMPI spellings cover manual/mpirun launches
+    coord = _env("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    nproc = int(_env("JAX_NUM_PROCESSES", "NUM_PROCESSES",
+                     "OMPI_COMM_WORLD_SIZE", default="1"))
+    pid = int(_env("JAX_PROCESS_ID", "PROCESS_ID",
+                   "OMPI_COMM_WORLD_RANK", default="0"))
     # NOTE: decide from env only — touching jax.process_count() here would
     # initialize the XLA backend and make jax.distributed.initialize raise
     # ("must be called before any JAX computations").

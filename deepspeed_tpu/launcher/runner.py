@@ -91,7 +91,10 @@ def build_commands(hosts: List[str], master_addr: str, master_port: int,
         remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " \
                  f"{sys.executable} {shlex.quote(script)} " \
                  f"{' '.join(shlex.quote(a) for a in script_args)}"
-        if pid == 0 and host in ("localhost", "127.0.0.1"):
+        if host in ("localhost", "127.0.0.1"):
+            # local processes exec directly, no ssh (also lets tests drive a
+            # real 2-process rendezvous by calling build_commands with
+            # repeated localhost entries)
             cmds.append(["bash", "-c", remote])
         else:
             cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])

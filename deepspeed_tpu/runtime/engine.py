@@ -683,7 +683,8 @@ class DeepSpeedTpuEngine:
                 p_new = self._host_optimizer.step_param(
                     k, g, prefetch=names[i + 1] if i + 1 < len(names) else None)
                 # async dispatch: this upload flies while the next leaf steps
-                new_flat[k] = jax.device_put(jnp.asarray(p_new), flat_s[k])
+                # (numpy straight to the target sharding — one transfer)
+                new_flat[k] = jax.device_put(p_new, flat_s[k])
             self._host_optimizer.step_end()
             self.params = unflatten_like(new_flat, self.params)
         if self._use_loss_scaling:
@@ -725,7 +726,7 @@ class DeepSpeedTpuEngine:
             flat_p = flatten_tree(params)
             flat_s = flatten_tree(self.param_shardings)
             for k in self._host_param_names:
-                flat_p[k] = jax.device_put(jnp.asarray(master[k]), flat_s[k])
+                flat_p[k] = jax.device_put(master[k], flat_s[k])
             params = unflatten_like(flat_p, params)
         self.params = params
         return overflow_b, gnorm

@@ -170,6 +170,57 @@ class HostAdamOptimizer:
             sd["m"], sd["v"] = m, v
         return sd
 
+    # -- leaf-streamed checkpoint files: state_dict() materializes the whole
+    # master+moments in DRAM, which NVMe-offloaded models may not fit; these
+    # write/read ONE leaf at a time --
+
+    @staticmethod
+    def _safe(name: str) -> str:
+        return name.replace("/", "__")
+
+    def save_state_files(self, path: str) -> None:
+        import json
+        import os
+        os.makedirs(path, exist_ok=True)
+        names = self.param_names
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"t": self.t, "names": names}, f)
+        for k in names:
+            base = os.path.join(path, self._safe(k))
+            np.save(base + ".master.npy", self.read_master(k))
+            if self._swapper is None:
+                m, v = self.m[k], self.v[k]
+            else:
+                sw = self._swapper._swapper
+                sw.swap_in([f"{k}.exp_avg", f"{k}.exp_avg_sq"], async_op=False)
+                m = sw.retrieve(f"{k}.exp_avg")
+                v = sw.retrieve(f"{k}.exp_avg_sq")
+            np.save(base + ".m.npy", m)
+            np.save(base + ".v.npy", v)
+
+    def load_state_files(self, path: str) -> None:
+        import json
+        import os
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        self.t = meta["t"]
+        for k in meta["names"]:
+            base = os.path.join(path, self._safe(k))
+            master = np.load(base + ".master.npy")
+            if self._master_swapper is None:
+                self.master[k] = master
+            else:
+                self._master_swapper.swap_out_and_release(k, master)
+            m, v = np.load(base + ".m.npy"), np.load(base + ".v.npy")
+            if self._swapper is None:
+                self.m[k], self.v[k] = m, v
+            else:
+                self._swapper.swap_out_optimizer_state(
+                    k, {"exp_avg": m, "exp_avg_sq": v})
+        if self._master_swapper is not None:
+            self._master_keys = list(meta["names"])
+            self._master_swapper.synchronize_writes()
+
     def load_state_dict(self, sd: dict) -> None:
         self.t = sd["t"]
         masters = {k: np.asarray(v, np.float32) for k, v in sd["master"].items()}

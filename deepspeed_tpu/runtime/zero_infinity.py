@@ -386,9 +386,12 @@ class ZeroInfinityEngine:
         if jax.process_index() == 0:  # host state is process-replicated
             path = os.path.join(save_dir, str(tag))
             os.makedirs(path, exist_ok=True)
+            # leaf-streamed: one file per master/moment leaf, so checkpointing
+            # never needs more DRAM than one leaf (the models this engine
+            # exists for don't fit a whole-state pickle in host RAM)
+            self._host_optimizer.save_state_files(os.path.join(path, "host_optimizer"))
             with open(os.path.join(path, "zero_infinity.pkl"), "wb") as f:
-                pickle.dump({"host_optimizer": self._host_optimizer.state_dict(),
-                             "global_steps": self.global_steps,
+                pickle.dump({"global_steps": self.global_steps,
                              "micro_steps": self.micro_steps,
                              "client_state": client_state or {}}, f)
             with open(os.path.join(save_dir, "latest"), "w") as f:
@@ -404,9 +407,9 @@ class ZeroInfinityEngine:
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "zero_infinity.pkl"), "rb") as f:
             sd = pickle.load(f)
-        # load_state_dict re-seeds the NVMe master store through the
-        # master_swapper when params live on disk
-        self._host_optimizer.load_state_dict(sd["host_optimizer"])
+        # re-seeds the NVMe master store through the master_swapper when
+        # params live on disk; DRAM mode fills the master dict leaf by leaf
+        self._host_optimizer.load_state_files(os.path.join(path, "host_optimizer"))
         self.global_steps = sd["global_steps"]
         self.micro_steps = sd["micro_steps"]
         return path, sd.get("client_state", {})
