@@ -176,7 +176,10 @@ class HostAdamOptimizer:
 
     @staticmethod
     def _safe(name: str) -> str:
-        return name.replace("/", "__")
+        # percent-encode: injective, so distinct param names can never
+        # collide onto one checkpoint file
+        from urllib.parse import quote
+        return quote(name, safe="")
 
     def save_state_files(self, path: str) -> None:
         import json

@@ -23,7 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...comm.mesh import MeshContext
 from ..zero_sharding import ZeroShardingPlan, leaf_spec
-from .spmd import spmd_pipeline
+from .spmd import spmd_pipeline_1f1b, spmd_pipeline_eval
 
 try:
     from jax import shard_map as _shard_map
@@ -79,6 +79,13 @@ class PipeZeroPlan(ZeroShardingPlan):
         return jax.tree_util.tree_map_with_path(_one, tree, base)
 
 
+def _zero_cotangent(x):
+    """Cotangent for a non-differentiated input: float0 for int dtypes."""
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.zeros_like(x)
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
 def make_pipeline_apply(embed_apply: Callable,
                         layer_apply: Callable,
                         head_apply: Callable,
@@ -92,6 +99,16 @@ def make_pipeline_apply(embed_apply: Callable,
     - layer_apply(layer_params, x) -> x   (one body layer)
     - head_apply(head_params, x, *batch_targets) -> scalar loss
     The batch is split as inputs = batch[:-1], targets = batch[-1:].
+
+    Training lowers to the interleaved 1F1B executor (embed inside stage 0,
+    head inside the last stage — O(S·mb) activation memory); the loss's VJP
+    returns the gradients the executor accumulated in-scan. Forward-only
+    calls (eval) use the cheap InferenceSchedule executor.
+
+    Loss semantics under pipe>1: the MEAN of per-microbatch head losses
+    (reference pipe/engine.py:582 _aggregate_total_loss averages micro
+    losses the same way). A head that masks tokens non-uniformly across
+    microbatches yields mean-of-means, not a global token mean.
     """
     pipe = mesh_ctx.axis_size("pipe")
     mesh = mesh_ctx.mesh
@@ -106,24 +123,79 @@ def make_pipeline_apply(embed_apply: Callable,
         out, _ = jax.lax.scan(one_layer, x, stage_params)
         return out
 
+    # executor adapters: inputs/targets travel as tuples of microbatched arrays
+    def ingest_fn(embed_params, in_mb):
+        return embed_apply(embed_params, *in_mb)
+
+    def head_fn(head_params, y, tgt_mb):
+        return head_apply(head_params, y, *tgt_mb)
+
+    body_specs = P("pipe")
+
+    def run_train(body, embed, head, in_mbs, tgt_mbs):
+        f = _smap(
+            lambda b, e, hd, i, tg: spmd_pipeline_1f1b(
+                stage_fn, ingest_fn, head_fn, b, e, hd, i, tg, axis_name="pipe"),
+            mesh, (body_specs, P(), P(), P(), P()),
+            (P(), body_specs, P(), P()))
+        return f(body, embed, head, in_mbs, tgt_mbs)
+
+    def run_eval(body, embed, head, in_mbs, tgt_mbs):
+        f = _smap(
+            lambda b, e, hd, i, tg: spmd_pipeline_eval(
+                stage_fn, ingest_fn, head_fn, b, e, hd, i, tg, axis_name="pipe"),
+            mesh, (body_specs, P(), P(), P(), P()), P())
+        return f(body, embed, head, in_mbs, tgt_mbs)
+
+    @jax.custom_vjp
+    def pipelined(body, embed, head, in_mbs, tgt_mbs):
+        return run_eval(body, embed, head, in_mbs, tgt_mbs)
+
+    def pipelined_fwd(body, embed, head, in_mbs, tgt_mbs):
+        loss, db, de, dh = run_train(body, embed, head, in_mbs, tgt_mbs)
+        cast = lambda g, p: jax.tree_util.tree_map(  # noqa: E731
+            lambda gg, pp: gg.astype(pp.dtype), g, p)
+        return loss, (cast(db, body), cast(de, embed), cast(dh, head),
+                      in_mbs, tgt_mbs)
+
+    def pipelined_bwd(res, g):
+        db, de, dh, in_mbs, tgt_mbs = res
+        sc = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x * g.astype(x.dtype), tree)
+        z = lambda tree: jax.tree_util.tree_map(_zero_cotangent, tree)  # noqa: E731
+        return sc(db), sc(de), sc(dh), z(in_mbs), z(tgt_mbs)
+
+    pipelined.defvjp(pipelined_fwd, pipelined_bwd)
+
+    def _microbatch(tree, M):
+        def one(x):
+            B = x.shape[0]
+            assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+            return x.reshape(M, B // M, *x.shape[1:])
+        return jax.tree_util.tree_map(one, tree)
+
     def apply_fn(params, *batch):
         inputs, targets = batch[:-1], batch[-1:]
-        h = embed_apply(params["embed"], *inputs)  # [B, s, d]
-        B = h.shape[0]
         M = num_microbatches
-        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
-        mbs = h.reshape(M, B // M, *h.shape[1:])
-
         if pipe > 1:
-            body_specs = jax.tree_util.tree_map(lambda _: P("pipe"), params["body"])
-            run = _smap(
-                lambda bp, xs: spmd_pipeline(stage_fn, bp, xs, axis_name="pipe"),
-                mesh, (body_specs, P()), P())
-            out = run(params["body"], mbs)
-        else:
-            out = jax.vmap(lambda x: stage_fn(params["body"], x))(mbs)
-
-        out = out.reshape(B, *out.shape[2:])
+            in_mbs = _microbatch(tuple(inputs), M)
+            tgt_mbs = _microbatch(tuple(targets), M)
+            # ZeRO-3 x PP: gather params over the ZeRO axis ONCE per step,
+            # OUTSIDE the pipeline scan (gather-for-compute, shard-at-rest —
+            # stage3 semantics). Collectives inside the scan's per-tick cond
+            # branches would also deadlock the CPU runtime's rendezvous.
+            body = jax.lax.with_sharding_constraint(
+                params["body"], NamedSharding(mesh, P("pipe")))
+            embed = jax.lax.with_sharding_constraint(
+                params["embed"], NamedSharding(mesh, P()))
+            head = jax.lax.with_sharding_constraint(
+                params["head"], NamedSharding(mesh, P()))
+            return pipelined(body, embed, head, in_mbs, tgt_mbs)
+        # pipe=1: plain sequential execution (no pipeline region)
+        h = embed_apply(params["embed"], *inputs)
+        mbs = _microbatch(h, M)
+        out = jax.vmap(lambda x: stage_fn(params["body"], x))(mbs)
+        out = out.reshape(h.shape[0], *out.shape[2:])
         return head_apply(params["head"], out, *targets)
 
     return apply_fn

@@ -1,26 +1,40 @@
-"""SPMD pipeline executor: scan over ticks + ppermute over the pipe axis.
+"""SPMD pipeline executors: scan over ticks + ppermute over the pipe axis.
 
-The TPU-native realization of the reference's 1F1B executor
+The TPU-native realization of the reference's executors
 (``runtime/pipe/engine.py:1406 _exec_schedule`` dispatching p2p send/recv):
-under single-controller SPMD every stage runs the same program, so the
-schedule becomes a ``lax.scan`` over ticks where each tick
+under single-controller SPMD every stage runs the same program, so a
+schedule becomes a ``lax.scan`` over ticks with ``lax.ppermute`` as the
+neighbor exchange (the p2p of ``pipe/p2p.py``).
 
-    1. stage 0 ingests microbatch t,
-    2. every stage applies its layer block to its current buffer,
-    3. ``lax.ppermute`` shifts activations one stage down the ring (ICI
-       neighbor exchange — the p2p of ``pipe/p2p.py``),
-    4. the last stage banks its result for microbatch t-(S-1).
+Two executors:
 
-Reverse-mode autodiff of the scan + ppermute yields exactly the backward
-pipeline (grads ppermute upstream), so BackwardPass/SendGrad/RecvGrad need no
-hand-written executor. Ramp-up/down bubbles compute garbage that is masked at
-collection — the same bubble cost as GPipe/1F1B (fraction (S-1)/(M+S-1)).
+- :func:`spmd_pipeline` — forward pipeline; reverse-mode autodiff of the
+  scan yields the backward pipeline, but only after ALL forward ticks — so
+  its live-activation set is O(M) microbatches (GPipe memory;
+  reference ``pipe/schedule.py:135 InferenceSchedule`` semantics). Kept for
+  inference/eval and as the autodiff oracle.
+
+- :func:`spmd_pipeline_1f1b` — the 1F1B TRAIN schedule (reference
+  ``pipe/schedule.py:189 TrainSchedule``): forward and backward interleave
+  in ONE scan. Stage ``s`` runs F(m) at tick ``s + 2m`` and B(m) at tick
+  ``2S-1-s + 2m`` — F/B strictly alternate per stage (the steady-state
+  one-forward-one-backward cadence, cf. TrainSchedule's alternating
+  instruction pairs), backward for a microbatch starts as soon as its
+  forward reaches the last stage, and each stage keeps only its in-flight
+  window: a depth-``S`` stash of stage INPUTS (recomputed through
+  ``jax.vjp`` at B — activation remat). Live activation memory is O(S·mb),
+  INDEPENDENT of the microbatch count M — 1F1B's defining property
+  (reference ``pipe/schedule.py:217 num_pipe_buffers``). The loss head runs
+  inside the last stage and ingest/embed inside stage 0, so no [M, ...]
+  activation buffer exists anywhere; per-stage parameter gradients
+  accumulate across microbatches inside the scan.
 """
 
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -71,3 +85,194 @@ def spmd_pipeline(stage_fn: Callable,
     # broadcast final activations from the last stage to all stages
     mask = (sid == S - 1).astype(outputs.dtype)
     return lax.psum(outputs * mask, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B interleaved train executor
+# ---------------------------------------------------------------------------
+
+
+def _tree_take(tree, i):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def _tree_zeros_f32(tree):
+    return jax.tree_util.tree_map(lambda x: jnp.zeros(jnp.shape(x), jnp.float32), tree)
+
+
+def _tree_add_masked(acc, delta, mask):
+    return jax.tree_util.tree_map(
+        lambda a, d: a + jnp.where(mask, d.astype(a.dtype), 0), acc, delta)
+
+
+def spmd_pipeline_1f1b(stage_fn: Callable,
+                       ingest_fn: Callable,
+                       head_fn: Callable,
+                       body_params,
+                       embed_params,
+                       head_params,
+                       in_mbs,
+                       tgt_mbs,
+                       axis_name: str = "pipe"):
+    """One fused 1F1B train pass over the ``axis_name`` pipeline axis.
+
+    Must run inside shard_map with ``axis_name`` manual. Per tick each stage
+    executes EITHER one forward or one backward micro-step (lax.cond on the
+    tick parity — never both), exchanging activations downstream and
+    gradients upstream via two ppermutes.
+
+    Args:
+      stage_fn(body_params, x) -> y: this stage's layer block.
+      ingest_fn(embed_params, in_mb) -> activations: runs ONLY on stage 0
+        (embedding); in_mb is one microbatch of raw inputs (a pytree).
+      head_fn(head_params, y, tgt_mb) -> scalar microbatch loss: runs ONLY
+        on the last stage.
+      in_mbs / tgt_mbs: [M, mb, ...] pytrees of raw inputs / targets.
+
+    Returns (mean_loss, dbody, dembed, dhead): loss and UNSCALED parameter
+    gradients (cotangent 1/M per microbatch — i.e. grads of the mean loss).
+    dbody is this stage's shard; dembed/dhead are psum-broadcast.
+    """
+    S = lax.psum(1, axis_name)
+    sid = lax.axis_index(axis_name)
+    M = jax.tree_util.tree_leaves(in_mbs)[0].shape[0]
+
+    act = jax.eval_shape(ingest_fn, embed_params, _tree_take(in_mbs, 0))
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+    K = S  # stash depth: in-flight microbatches per stage <= S - sid <= S
+
+    def fwd_full(body_p, embed_p, in_mb, h_in):
+        """Stage 0 embeds raw inputs; later stages take the incoming
+        activation. One function so jax.vjp covers embed grads too. The
+        lax.cond keeps the embedding gather (and its dense [V, d] scatter in
+        the vjp) off every stage but 0."""
+        x = lax.cond(sid == 0,
+                     lambda _: ingest_fn(embed_p, in_mb).astype(h_in.dtype),
+                     lambda _: h_in, None)
+        return stage_fn(body_p, x)
+
+    carry0 = dict(
+        fwd=zeros_act,                    # activation arriving from upstream
+        bwd=zeros_act,                    # gradient arriving from downstream
+        dy_pend=zeros_act,                # last stage: head grad awaiting its B tick
+        stash_h=jnp.zeros((K, *act.shape), act.dtype),
+        stash_in=jax.tree_util.tree_map(
+            lambda x: jnp.zeros((K, *x.shape[1:]), x.dtype), in_mbs),
+        loss=jnp.float32(0.0),
+        dbody=_tree_zeros_f32(body_params),
+        dembed=_tree_zeros_f32(embed_params),
+        dhead=_tree_zeros_f32(head_params),
+    )
+
+    def tick(c, t):
+        is_f = ((t - sid) % 2) == 0
+        mf = (t - sid) // 2                       # F(mf) at tick sid + 2*mf
+        mb_ = (t - (2 * S - 1 - sid)) // 2        # B(mb_) at tick 2S-1-sid + 2*mb_
+        mf_c = jnp.clip(mf, 0, M - 1)
+        mb_c = jnp.clip(mb_, 0, M - 1)
+        f_valid = is_f & (mf >= 0) & (mf < M)
+        b_valid = (~is_f) & (mb_ >= 0) & (mb_ < M)
+
+        def f_branch(c):
+            in_mb = _tree_take(in_mbs, mf_c)
+            y = fwd_full(body_params, embed_params, in_mb, c["fwd"])
+            is_last = sid == S - 1
+
+            def with_head(_):
+                loss_m, vjp_h = jax.vjp(
+                    lambda hp, yy: head_fn(hp, yy, _tree_take(tgt_mbs, mf_c))
+                    .astype(jnp.float32), head_params, y)
+                dh_m, dy = vjp_h(jnp.float32(1.0 / M))
+                return loss_m, dh_m, dy.astype(zeros_act.dtype)
+
+            def no_head(_):
+                return (jnp.float32(0.0),
+                        jax.tree_util.tree_map(jnp.zeros_like, head_params),
+                        zeros_act)
+
+            loss_m, dh_m, dy = lax.cond(is_last, with_head, no_head, None)
+            commit = f_valid & is_last
+            nc = dict(c)
+            nc["loss"] = c["loss"] + jnp.where(commit, loss_m / M, 0.0)
+            nc["dhead"] = _tree_add_masked(c["dhead"], dh_m, commit)
+            nc["dy_pend"] = jnp.where(commit, dy, c["dy_pend"])
+            slot = mf_c % K
+
+            def set_stash(st, val):
+                return st.at[slot].set(jnp.where(f_valid, val, st[slot]))
+
+            nc["stash_h"] = set_stash(c["stash_h"], c["fwd"])
+            nc["stash_in"] = jax.tree_util.tree_map(set_stash, c["stash_in"], in_mb)
+            return nc, y, zeros_act
+
+        def b_branch(c):
+            slot = mb_c % K
+            x_in = _tree_take(c["stash_in"], slot)
+            dy_in = jnp.where(sid == S - 1, c["dy_pend"], c["bwd"])
+            # recompute the stage forward from its saved INPUT (remat), take
+            # the vjp wrt body/embed params and the incoming activation
+            _, vjp = jax.vjp(
+                lambda bp, ep, h: fwd_full(bp, ep, x_in, h),
+                body_params, embed_params, c["stash_h"][slot])
+            db_m, de_m, dx = vjp(dy_in)
+            nc = dict(c)
+            nc["dbody"] = _tree_add_masked(c["dbody"], db_m, b_valid)
+            nc["dembed"] = _tree_add_masked(c["dembed"], de_m, b_valid & (sid == 0))
+            return nc, zeros_act, dx.astype(zeros_act.dtype)
+
+        nc, y_down, dx_up = lax.cond(is_f, f_branch, b_branch, c)
+        # collectives run unconditionally (every device must participate);
+        # receivers only read the buffer on the matching parity tick
+        nc["fwd"] = lax.ppermute(y_down, axis_name,
+                                 [(i, (i + 1) % S) for i in range(S)])
+        nc["bwd"] = lax.ppermute(dx_up, axis_name,
+                                 [(i, (i - 1) % S) for i in range(S)])
+        return nc, None
+
+    c, _ = lax.scan(tick, carry0, jnp.arange(2 * (M + S - 1)))
+    loss = lax.psum(c["loss"], axis_name)  # nonzero only on the last stage
+    dhead = jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), c["dhead"])
+    dembed = jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), c["dembed"])
+    return loss, c["dbody"], dembed, dhead
+
+
+def spmd_pipeline_eval(stage_fn: Callable,
+                       ingest_fn: Callable,
+                       head_fn: Callable,
+                       body_params,
+                       embed_params,
+                       head_params,
+                       in_mbs,
+                       tgt_mbs,
+                       axis_name: str = "pipe"):
+    """Forward-only pipeline returning the mean loss (InferenceSchedule
+    cadence: one F per stage per tick, M + S - 1 ticks, no stash)."""
+    S = lax.psum(1, axis_name)
+    sid = lax.axis_index(axis_name)
+    M = jax.tree_util.tree_leaves(in_mbs)[0].shape[0]
+    act = jax.eval_shape(ingest_fn, embed_params, _tree_take(in_mbs, 0))
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+
+    def fwd_full(in_mb, h_in):
+        h0 = ingest_fn(embed_params, in_mb).astype(h_in.dtype)
+        return stage_fn(body_params, jnp.where(sid == 0, h0, h_in))
+
+    def tick(carry, t):
+        fwd, loss = carry
+        m = t - sid
+        m_c = jnp.clip(m, 0, M - 1)
+        valid = (m >= 0) & (m < M)
+        y = fwd_full(_tree_take(in_mbs, m_c), fwd)
+        is_last = sid == S - 1
+        loss_m = lax.cond(
+            is_last,
+            lambda _: head_fn(head_params, y, _tree_take(tgt_mbs, m_c))
+            .astype(jnp.float32),
+            lambda _: jnp.float32(0.0), None)
+        loss = loss + jnp.where(valid & is_last, loss_m / M, 0.0)
+        fwd = lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        return (fwd, loss), None
+
+    (_, loss), _ = lax.scan(tick, (zeros_act, jnp.float32(0.0)),
+                            jnp.arange(M + S - 1))
+    return lax.psum(loss, axis_name)

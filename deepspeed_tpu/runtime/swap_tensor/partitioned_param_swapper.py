@@ -40,8 +40,9 @@ class AsyncPartitionedParameterSwapper:
         self._available: Dict[str, np.ndarray] = {}  # completed reads
 
     def _path(self, name: str) -> str:
-        safe = name.replace("/", "_").replace(".", "_")
-        return os.path.join(self.swap_folder, f"{safe}.swp")
+        from urllib.parse import quote
+        # injective encoding — "a/b" and "a.b" must not share a swap file
+        return os.path.join(self.swap_folder, f"{quote(name, safe='')}.swp")
 
     # ---- swap out (device -> NVMe) ----
 

@@ -409,7 +409,13 @@ class ZeroInfinityEngine:
             sd = pickle.load(f)
         # re-seeds the NVMe master store through the master_swapper when
         # params live on disk; DRAM mode fills the master dict leaf by leaf
-        self._host_optimizer.load_state_files(os.path.join(path, "host_optimizer"))
+        opt_dir = os.path.join(path, "host_optimizer")
+        if os.path.isdir(opt_dir):
+            self._host_optimizer.load_state_files(opt_dir)
+        elif "host_optimizer" in sd:  # earlier single-pickle layout
+            self._host_optimizer.load_state_dict(sd["host_optimizer"])
+        else:
+            raise FileNotFoundError(f"no host optimizer state under {path}")
         self.global_steps = sd["global_steps"]
         self.micro_steps = sd["micro_steps"]
         return path, sd.get("client_state", {})

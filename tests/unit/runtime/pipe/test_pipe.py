@@ -181,6 +181,112 @@ def test_spmd_pipeline_grads_match():
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
 
 
+# ---------------- 1F1B train executor ----------------
+
+
+def _toy_model(d=16, L=4, V=32, seed=0):
+    rng = np.random.default_rng(seed)
+    params = {
+        "embed": {"w": jnp.asarray(rng.normal(size=(V, d)), jnp.float32)},
+        "body": {"w": jnp.asarray(rng.normal(size=(L, d, d)) / np.sqrt(d), jnp.float32)},
+        "head": {"w": jnp.asarray(rng.normal(size=(d, V)) / np.sqrt(d), jnp.float32)},
+    }
+
+    def embed(p, ids):
+        return p["w"][ids]
+
+    def layer(lp, h):
+        return jnp.tanh(h @ lp["w"])
+
+    def head(p, h, labels):
+        logp = jax.nn.log_softmax(h @ p["w"])
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+    return params, embed, layer, head
+
+
+@pytest.mark.world_size(8)
+def test_1f1b_loss_and_grads_match_sequential():
+    """The interleaved 1F1B program must be numerically identical to plain
+    sequential execution — loss AND all parameter grads."""
+    from deepspeed_tpu.runtime.pipe.engine import make_pipeline_apply
+    ctx = MeshContext.create(axis_sizes={"pipe": 4})
+    set_mesh_context(ctx)
+    d, L, M, mb, seq = 16, 8, 4, 2, 8
+    params, embed, layer, head = _toy_model(d=d, L=L)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 32, size=(M * mb, seq)), jnp.int32)
+
+    apply_fn = make_pipeline_apply(embed, layer, head, ctx, M)
+
+    def ref_fn(p, ids, labels):
+        h = p["embed"]["w"][ids]
+        for l in range(L):
+            h = layer({"w": p["body"]["w"][l]}, h)
+        return head(p["head"], h, labels)
+
+    l1, g1 = jax.jit(jax.value_and_grad(apply_fn))(params, ids, ids)
+    l2, g2 = jax.value_and_grad(ref_fn)(params, ids, ids)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for (p1, a), (p2, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g1),
+            jax.tree_util.tree_leaves_with_path(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5,
+                                   err_msg=str(p1))
+
+
+@pytest.mark.world_size(8)
+def test_1f1b_activation_memory_independent_of_M():
+    """VERDICT r2 #3 'Done' criterion: compiled memory_analysis shows peak
+    activation (temp) memory independent of the microbatch count — the 1F1B
+    O(stages) window, not GPipe's O(M)."""
+    from deepspeed_tpu.runtime.pipe.engine import make_pipeline_apply
+    ctx = MeshContext.create(axis_sizes={"pipe": 4, "data": 2})
+    set_mesh_context(ctx)
+    d, L, mb, seq = 32, 8, 2, 16
+    params, embed, layer, head = _toy_model(d=d, L=L, V=64)
+
+    def temp_bytes(M):
+        af = make_pipeline_apply(embed, layer, head, ctx, M)
+        ids = jnp.ones((M * mb, seq), jnp.int32)
+        f = jax.jit(lambda p, i, l: jax.value_and_grad(af)(p, i, l))
+        stats = f.lower(params, ids, ids).compile().memory_analysis()
+        if stats is None:
+            pytest.skip("backend provides no memory_analysis")
+        return stats.temp_size_in_bytes
+
+    t4, t32 = temp_bytes(4), temp_bytes(32)
+    act_bytes_per_mb = mb * seq * d * 4  # one fp32 boundary activation
+    # 28 extra microbatches of saved activations would cost >= 28 * act bytes
+    # under GPipe-style autodiff; 1F1B's window must not grow with M beyond
+    # per-microbatch bookkeeping noise
+    assert t32 - t4 < 4 * act_bytes_per_mb, (t4, t32)
+
+
+@pytest.mark.world_size(8)
+def test_pipeline_composes_pipe_fsdp_data():
+    """3D composition: pipe x fsdp x data with ZeRO-3 body/optimizer sharding."""
+    ctx = MeshContext.create(axis_sizes={"pipe": 2, "fsdp": 2, "data": 2})
+    set_mesh_context(ctx)
+    d, L, B = 16, 4, 8
+    params, embed, layer, head = _toy_model(d=d, L=L)
+    rng = np.random.default_rng(0)
+    eng = PipelineEngine(embed, layer, head, params,
+                         config={
+                             "train_batch_size": B,
+                             "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                             "zero_optimization": {"stage": 3},
+                         },
+                         num_microbatches=4)
+    ids = jnp.asarray(rng.integers(0, 32, size=(B, 8)), jnp.int32)
+    data = iter([(ids, ids)] * 12)
+    losses = [float(eng.train_batch(data)) for _ in range(5)]
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    # the body really is sharded over pipe (and the ZeRO axis where divisible)
+    spec = eng.engine.params["body"]["w"].sharding.spec
+    assert spec[0] == "pipe"
+
+
 # ---------------- engine ----------------
 
 
