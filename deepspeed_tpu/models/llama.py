@@ -49,11 +49,38 @@ class LlamaConfig:
     attn_impl: str = "auto"       # "auto" | "flash" (Pallas) | "xla"
     dtype: Any = jnp.bfloat16
     scan_layers: bool = False
+    # ZeRO-3 live-parameter governor (runtime/zero_governor.py): scan over
+    # chunks of this many layers — one chunk's params is the hard ceiling on
+    # gathered-live elements (reference stage3_max_live_parameters). 1 =
+    # tightest ceiling; larger chunks trade memory for fewer scan steps.
+    scan_chunk_size: int = 1
     remat: bool = False
 
     @property
     def head_dim_(self):
         return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    def per_layer_elements(self) -> int:
+        """Analytic element count of one decoder layer (attention + MLP/MoE
+        + norms) — the unit of the ZeRO-3 live-parameter budget."""
+        h, hd = self.hidden_size, self.head_dim_
+        attn = h * (self.num_attention_heads * hd) * 2 \
+            + h * (self.num_key_value_heads * hd) * 2
+        if self.num_local_experts > 0:
+            mlp = 3 * h * self.intermediate_size * self.num_local_experts \
+                + h * self.num_local_experts
+        else:
+            mlp = 3 * h * self.intermediate_size
+        return attn + mlp + 2 * h
+
+    def with_live_param_budget(self, max_live_parameters: int) -> "LlamaConfig":
+        """Return a config whose layer scan chunk honors the ZeRO-3
+        ``stage3_max_live_parameters`` budget (runtime/zero_governor.py):
+        one chunk's params is the gathered-live ceiling."""
+        from ..runtime.zero_governor import chunk_size_for
+        chunk = chunk_size_for(self.num_hidden_layers, self.per_layer_elements(),
+                               max_live_parameters)
+        return dataclasses.replace(self, scan_layers=True, scan_chunk_size=chunk)
 
     # ---- presets ----
     @staticmethod
@@ -255,13 +282,20 @@ class LMHead(nn.Module):
 
 
 class _ScanBody(nn.Module):
-    """nn.scan adapter: scan bodies must return (carry, out)."""
+    """nn.scan adapter: scan bodies must return (carry, out). With
+    ``scan_chunk_size > 1`` one scan step applies a chunk of layers (the
+    ZeRO-3 live-parameter governor's chunk)."""
     config: LlamaConfig
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
-        layer_cls = nn.remat(LlamaDecoderLayer) if self.config.remat else LlamaDecoderLayer
-        return layer_cls(self.config, name="layer")(x, cos, sin, positions, attn_mask), None
+        cfg = self.config
+        layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
+        if cfg.scan_chunk_size <= 1:
+            return layer_cls(cfg, name="layer")(x, cos, sin, positions, attn_mask), None
+        for i in range(cfg.scan_chunk_size):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin, positions, attn_mask)
+        return x, None
 
 
 class LlamaModel(nn.Module):
@@ -281,12 +315,18 @@ class LlamaModel(nn.Module):
         cos, sin = precompute_rope(cfg.head_dim_, cfg.max_position_embeddings, cfg.rope_theta)
 
         if cfg.scan_layers:
-            # scan over depth: O(1) HLO in layer count (the 70B compile path)
+            # scan over depth: O(1) HLO in layer count (the 70B compile path);
+            # gathered-live params are hard-bounded to ONE scan step's chunk
+            # (the ZeRO-3 max_live_parameters governor, zero_governor.py)
+            if cfg.num_hidden_layers % cfg.scan_chunk_size != 0:
+                raise ValueError(
+                    f"num_hidden_layers={cfg.num_hidden_layers} not divisible "
+                    f"by scan_chunk_size={cfg.scan_chunk_size}")
             ScanLayer = nn.scan(_ScanBody,
                                 variable_axes={"params": 0},
                                 split_rngs={"params": True},
                                 in_axes=nn.broadcast,
-                                length=cfg.num_hidden_layers,
+                                length=cfg.num_hidden_layers // cfg.scan_chunk_size,
                                 metadata_params={nn.PARTITION_NAME: "layers"})
             x, _ = ScanLayer(cfg, name="layers")(x, cos, sin, positions, attn_mask)
         else:

