@@ -2,11 +2,11 @@
 
 Reference: ``deepspeed/ops/sparse_attention/sparse_self_attention.py:12
 SparseSelfAttention`` + the Triton ``matmul.py``/``softmax.py`` block
-kernels. TPU path: the block layout expands to a boolean mask consumed by a
-masked attention einsum — XLA's fusion makes this the right baseline on
-TPU; a Pallas splash-attention kernel (block-map-driven, skipping masked
-tiles entirely) is the performance upgrade slot and keeps this exact
-layout contract.
+kernels. TPU path: the Pallas splash kernel (``splash.py``) consumes the
+block layout as a scalar-prefetched block table and SKIPS masked tiles —
+compute ∝ active blocks, matching the Triton SDD/DSD capability; the
+masked dense einsum here is the fallback (padding masks, odd shapes) and
+the numerics oracle.
 """
 
 from typing import Optional
@@ -27,14 +27,28 @@ def layout_to_mask(layout: np.ndarray, block: int) -> np.ndarray:
 def sparse_attention(q, k, v, layout: np.ndarray, block: int,
                      key_padding_mask: Optional[jnp.ndarray] = None,
                      scale: Optional[float] = None,
-                     key_padding_mask_mode: str = "mul"):
+                     key_padding_mask_mode: str = "mul",
+                     use_kernel: Optional[bool] = None):
     """Masked attention under a block-sparse layout.
     q,k,v: [batch, heads, seq, head_dim]; layout: [heads, nb, nb].
     key_padding_mask [b, s]: mode 'mul' = keep-mask (True/1 = attend);
     mode 'add' = additive float mask (0 = keep, large-negative = drop) —
-    the reference's two conventions (sparse_self_attention.py:12)."""
+    the reference's two conventions (sparse_self_attention.py:12).
+
+    On TPU (no padding mask) this dispatches to the Pallas splash kernel
+    (splash.py), whose compute scales with ACTIVE blocks; the dense masked
+    einsum is the fallback/oracle. use_kernel forces either path."""
     b, h, s, d = q.shape
     scale = scale if scale is not None else (1.0 / float(np.sqrt(d)))
+    if use_kernel and key_padding_mask is not None:
+        raise ValueError("the splash kernel does not take key_padding_mask; "
+                         "fold padding into the layout or use the dense path")
+    if use_kernel is None:
+        use_kernel = (key_padding_mask is None and s % block == 0
+                      and jax.default_backend() == "tpu")
+    if use_kernel:
+        from .splash import splash_sparse_attention
+        return splash_sparse_attention(q, k, v, layout, block, scale=scale)
     visible = jnp.asarray(layout_to_mask(layout, block))[None]  # [1, h, s, s]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
