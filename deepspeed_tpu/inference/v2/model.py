@@ -32,12 +32,45 @@ from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 
 
-def _rope_tok(x, cos, sin, positions):
-    """Token-major rope: x [T, H, D], positions [T]."""
+def _rope_tok(x, cos, sin, positions, rotary_dim=None):
+    """Token-major rope: x [T, H, D], positions [T]; partial rotary (Phi)
+    rotates only the leading rotary_dim dims."""
+    if rotary_dim is not None and rotary_dim < x.shape[-1]:
+        xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
+        return jnp.concatenate([_rope_tok(xr, cos, sin, positions), xp],
+                               -1).astype(x.dtype)
     c = cos[positions][:, None, :]
     s = sin[positions][:, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1).astype(x.dtype)
+
+
+def _norm_tok(x, p, cfg):
+    """rmsnorm or layernorm(+bias) per the config (token-major)."""
+    if cfg.norm_type == "layernorm":
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+        return (out * p["scale"] + p["bias"]).astype(x.dtype)
+    return rms_norm(x, p["weight"], cfg.rms_norm_eps)
+
+
+def _mlp_tok(x, lp, cfg):
+    """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc."""
+    mlp = lp["mlp"]
+    if cfg.mlp_type == "swiglu":
+        gate = jax.nn.silu(x @ mlp["gate_proj"]["kernel"])
+        return (gate * (x @ mlp["up_proj"]["kernel"])) @ mlp["down_proj"]["kernel"]
+    act = (lambda y: jax.nn.gelu(y, approximate=True)) \
+        if cfg.mlp_type == "gelu_fc" else jax.nn.relu
+    h = x @ mlp["fc1"]["kernel"]
+    if "bias" in mlp["fc1"]:
+        h = h + mlp["fc1"]["bias"]
+    out = act(h) @ mlp["fc2"]["kernel"]
+    if "bias" in mlp["fc2"]:
+        out = out + mlp["fc2"]["bias"]
+    return out
 
 
 class RaggedLlamaModel:
@@ -135,7 +168,10 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     p = params["model"]
     x = p["embed_tokens"]["embedding"][batch.tokens]  # [T, E]
-    cos, sin = precompute_rope(hd, cfg.max_position_embeddings, cfg.rope_theta)
+    if cfg.pos_embedding == "learned":  # OPT (table offset by pos_offset)
+        x = x + p["embed_positions"]["embedding"][batch.token_pos + cfg.pos_offset]
+    cos, sin = precompute_rope(cfg.rotary_dim or hd, cfg.max_position_embeddings,
+                               cfg.rope_theta)
 
     # per-seq query gather indices come host-precomputed as [S, N] where N
     # buckets the largest burst — N=1 for pure decode, so attention work is
@@ -162,19 +198,20 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
-        h = rms_norm(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        h = _norm_tok(x, lp["input_layernorm"], cfg)
 
         def proj(name, heads):
             y = h @ lp["self_attn"][name]["kernel"]
-            if "bias" in lp["self_attn"][name]:  # qwen2-style qkv bias
+            if "bias" in lp["self_attn"][name]:  # qwen2/OPT/Phi biases
                 y = y + lp["self_attn"][name]["bias"]
             return y.reshape(T, heads, hd)
 
         q = proj("q_proj", nq)
         k = proj("k_proj", nkv)
         v = proj("v_proj", nkv)
-        q = _rope_tok(q, cos, sin, batch.token_pos)
-        k = _rope_tok(k, cos, sin, batch.token_pos)
+        if cfg.pos_embedding == "rope":
+            q = _rope_tok(q, cos, sin, batch.token_pos, cfg.rotary_dim)
+            k = _rope_tok(k, cos, sin, batch.token_pos, cfg.rotary_dim)
 
         # paged write: one scatter of the new tokens' K/V into flat slots
         # (cache is [layer, 2, KV, slot, D]; advanced indexing puts the
@@ -204,9 +241,16 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         # back to token-major and project out
         ctx_tok = ctx[batch.token_seq, jnp.clip(rel, 0, N - 1)]  # [T, H*D]
-        x = x + ctx_tok @ lp["self_attn"]["o_proj"]["kernel"]
+        attn_out = ctx_tok @ lp["self_attn"]["o_proj"]["kernel"]
+        if "bias" in lp["self_attn"]["o_proj"]:
+            attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
-        h2 = rms_norm(x, lp["post_attention_layernorm"]["weight"], cfg.rms_norm_eps)
+        if cfg.parallel_residual:
+            # Falcon/Phi: attention and MLP both read the SAME normed input
+            x = x + attn_out + _mlp_tok(h, lp, cfg)
+            continue
+        x = x + attn_out
+        h2 = _norm_tok(x, lp["post_attention_layernorm"], cfg)
         if cfg.num_local_experts > 0:  # Mixtral MoE block (matches models/llama.py)
             moe = lp["block_sparse_moe"]
             logits = h2.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
@@ -216,14 +260,14 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             # grouped GEMM: FLOPs ∝ top-k, not E (ops/grouped_matmul.py)
             x = x + moe_grouped_mlp(h2, moe["w1"], moe["w3"], moe["w2"], idx, w)
         else:
-            gate = jax.nn.silu(h2 @ lp["mlp"]["gate_proj"]["kernel"])
-            x = x + ((gate * (h2 @ lp["mlp"]["up_proj"]["kernel"]))
-                     @ lp["mlp"]["down_proj"]["kernel"])
+            x = x + _mlp_tok(h2, lp, cfg)
 
-    x = rms_norm(x, p["norm"]["weight"], cfg.rms_norm_eps)
+    x = _norm_tok(x, p["norm"], cfg)
     final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]
     if cfg.tie_word_embeddings:
         logits = final @ p["embed_tokens"]["embedding"].astype(jnp.float32).T
     else:
         logits = final @ p["lm_head"]["kernel"].astype(jnp.float32)
+        if "bias" in p["lm_head"]:  # Phi
+            logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
     return logits, cache

@@ -31,6 +31,12 @@ VOCAB = "vocab"
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
+    """Decoder-family config. Defaults are Llama; the variant knobs below
+    cover the reference's other injection containers (OPT/Falcon/Phi —
+    ``module_inject/containers/``, ``inference/v2/model_implementations/``):
+    learned positions + LayerNorm + ReLU fc MLP (OPT), parallel
+    attention/MLP residual + MQA (Falcon), partial rotary + fused parallel
+    block with biases (Phi)."""
     vocab_size: int = 32000
     hidden_size: int = 4096
     intermediate_size: int = 14336
@@ -43,6 +49,16 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
     attention_bias: bool = False  # qwen2-style qkv biases
+    attention_out_bias: bool = False  # OPT/Phi: bias on the output projection
+    # ---- architecture variant knobs ----
+    norm_type: str = "rmsnorm"        # "rmsnorm" | "layernorm" (scale+bias)
+    pos_embedding: str = "rope"       # "rope" | "learned" (OPT)
+    pos_offset: int = 0               # OPT stores positions at index pos+2
+    rotary_dim: Optional[int] = None  # Phi partial rotary; None = full head_dim
+    mlp_type: str = "swiglu"          # "swiglu" | "gelu_fc" | "relu_fc"
+    mlp_bias: bool = False            # fc1/fc2 biases (OPT/Phi)
+    parallel_residual: bool = False   # Falcon/Phi: x + attn(ln(x)) + mlp(ln(x))
+    lm_head_bias: bool = False        # Phi
     num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
     num_experts_per_tok: int = 2
     moe_grouped: bool = True      # grouped GEMM (FLOPs ∝ top-k) vs dense-over-experts
@@ -66,11 +82,12 @@ class LlamaConfig:
         h, hd = self.hidden_size, self.head_dim_
         attn = h * (self.num_attention_heads * hd) * 2 \
             + h * (self.num_key_value_heads * hd) * 2
+        proj = 3 if self.mlp_type == "swiglu" else 2
         if self.num_local_experts > 0:
-            mlp = 3 * h * self.intermediate_size * self.num_local_experts \
+            mlp = proj * h * self.intermediate_size * self.num_local_experts \
                 + h * self.num_local_experts
         else:
-            mlp = 3 * h * self.intermediate_size
+            mlp = proj * h * self.intermediate_size
         return attn + mlp + 2 * h
 
     def with_live_param_budget(self, max_live_parameters: int) -> "LlamaConfig":
@@ -110,10 +127,15 @@ def precompute_rope(head_dim: int, max_len: int, theta: float, dtype=jnp.float32
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
-def apply_rope(x, cos, sin, positions):
+def apply_rope(x, cos, sin, positions, rotary_dim: Optional[int] = None):
     """x: [b, s, h, d]; rotate-half formulation (reference
     csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu, rebuilt in jnp —
-    XLA fuses this into the surrounding matmuls)."""
+    XLA fuses this into the surrounding matmuls). ``rotary_dim < d`` rotates
+    only the leading slice (Phi-style partial rotary)."""
+    if rotary_dim is not None and rotary_dim < x.shape[-1]:
+        xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
+        return jnp.concatenate([apply_rope(xr, cos, sin, positions), xp],
+                               axis=-1).astype(x.dtype)
     c = cos[positions][:, :, None, :]  # [b, s, 1, d/2]
     s = sin[positions][:, :, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
@@ -139,6 +161,12 @@ def _dense(features, name, axes, dtype, use_bias=False):
                     bias_init=nn.with_partitioning(nn.initializers.zeros, (axes[-1], )))
 
 
+def _make_norm(cfg, name):
+    if cfg.norm_type == "layernorm":
+        return nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name=name)
+    return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name=name)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -156,8 +184,9 @@ class LlamaAttention(nn.Module):
         q = q.reshape(b, s, nq, hd)
         k = k.reshape(b, s, nkv, hd)
         v = v.reshape(b, s, nkv, hd)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        if cfg.pos_embedding == "rope":
+            q = apply_rope(q, cos, sin, positions, cfg.rotary_dim)
+            k = apply_rope(k, cos, sin, positions, cfg.rotary_dim)
 
         # GQA handled natively by both paths (no materialized K/V head
         # repeat — 4x K/V bandwidth saving at 8B scale). The Pallas flash
@@ -189,7 +218,8 @@ class LlamaAttention(nn.Module):
                 mask = attn_mask[:, None, None, :].astype(bool)
             attn = jax.nn.dot_product_attention(q, k, v, mask=mask, is_causal=True)
         out = attn.reshape(b, s, nq * hd)
-        return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype)(out)
+        return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype,
+                      cfg.attention_out_bias)(out)
 
 
 class LlamaMLP(nn.Module):
@@ -198,9 +228,18 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = _dense(cfg.intermediate_size, "gate_proj", (EMBED, HIDDEN), cfg.dtype)(x)
-        up = _dense(cfg.intermediate_size, "up_proj", (EMBED, HIDDEN), cfg.dtype)(x)
-        return _dense(cfg.hidden_size, "down_proj", (HIDDEN, EMBED), cfg.dtype)(nn.silu(gate) * up)
+        if cfg.mlp_type == "swiglu":
+            gate = _dense(cfg.intermediate_size, "gate_proj", (EMBED, HIDDEN), cfg.dtype)(x)
+            up = _dense(cfg.intermediate_size, "up_proj", (EMBED, HIDDEN), cfg.dtype)(x)
+            return _dense(cfg.hidden_size, "down_proj", (HIDDEN, EMBED),
+                          cfg.dtype)(nn.silu(gate) * up)
+        # fc1/fc2 form (OPT relu, Falcon/Phi gelu — HF "gelu_new" tanh approx)
+        act = {"gelu_fc": lambda y: nn.gelu(y, approximate=True),
+               "relu_fc": nn.relu}[cfg.mlp_type]
+        h = _dense(cfg.intermediate_size, "fc1", (EMBED, HIDDEN), cfg.dtype,
+                   cfg.mlp_bias)(x)
+        return _dense(cfg.hidden_size, "fc2", (HIDDEN, EMBED), cfg.dtype,
+                      cfg.mlp_bias)(act(h))
 
 
 class LlamaMoEBlock(nn.Module):
@@ -248,14 +287,18 @@ class LlamaDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), cos, sin, positions,
-            attn_mask)
-        normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
+        normed = _make_norm(cfg, "input_layernorm")(x)
+        attn_out = LlamaAttention(cfg, name="self_attn")(normed, cos, sin, positions,
+                                                         attn_mask)
+        if cfg.parallel_residual:
+            # Falcon/Phi: one shared input norm feeds BOTH branches
+            return x + attn_out + LlamaMLP(cfg, name="mlp")(normed)
+        h = x + attn_out
+        normed2 = _make_norm(cfg, "post_attention_layernorm")(h)
         if cfg.num_local_experts > 0:
-            h = h + LlamaMoEBlock(cfg, name="block_sparse_moe")(normed)
+            h = h + LlamaMoEBlock(cfg, name="block_sparse_moe")(normed2)
         else:
-            h = h + LlamaMLP(cfg, name="mlp")(normed)
+            h = h + LlamaMLP(cfg, name="mlp")(normed2)
         return h
 
 
@@ -268,6 +311,7 @@ class LMHead(nn.Module):
     """
     features: int
     dtype: Any
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -275,10 +319,15 @@ class LMHead(nn.Module):
             "kernel",
             nn.with_partitioning(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
             (x.shape[-1], self.features))
-        return jax.lax.dot_general(
+        out = jax.lax.dot_general(
             x.astype(self.dtype), kernel.astype(self.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if self.use_bias:
+            out = out + self.param(
+                "bias", nn.with_partitioning(nn.initializers.zeros, (VOCAB, )),
+                (self.features, ), jnp.float32)
+        return out
 
 
 class _ScanBody(nn.Module):
@@ -312,7 +361,16 @@ class LlamaModel(nn.Module):
                                                              (VOCAB, EMBED)),
                          name="embed_tokens")
         x = embed(input_ids)
-        cos, sin = precompute_rope(cfg.head_dim_, cfg.max_position_embeddings, cfg.rope_theta)
+        if cfg.pos_embedding == "learned":
+            # OPT-style learned positions (HF offsets the table by pos_offset)
+            pos_table = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
+                                 cfg.hidden_size, dtype=cfg.dtype,
+                                 embedding_init=nn.with_partitioning(
+                                     nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                                 name="embed_positions")
+            x = x + pos_table(positions + cfg.pos_offset)
+        cos, sin = precompute_rope(cfg.rotary_dim or cfg.head_dim_,
+                                   cfg.max_position_embeddings, cfg.rope_theta)
 
         if cfg.scan_layers:
             # scan over depth: O(1) HLO in layer count (the 70B compile path);
@@ -333,7 +391,7 @@ class LlamaModel(nn.Module):
             layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_hidden_layers):
                 x = layer_cls(cfg, name=f"layers_{i}")(x, cos, sin, positions, attn_mask)
-        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        x = _make_norm(cfg, "norm")(x)
         # unembed: bf16 inputs ride the MXU fast path (fp32 matmul is several×
         # slower), but the accumulator stays fp32 and the *output* is emitted
         # fp32 (preferred_element_type) — rounding logits to bf16 before the
@@ -344,7 +402,8 @@ class LlamaModel(nn.Module):
                 (((x.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
         else:
-            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
+            logits = LMHead(cfg.vocab_size, cfg.dtype, use_bias=cfg.lm_head_bias,
+                            name="lm_head")(x)
         return logits
 
 

@@ -1,3 +1,5 @@
 from .replace_policy import (HFCheckpointPolicy, LlamaPolicy, MistralPolicy, Qwen2Policy,
-                             Gemma2Policy, policy_for, SUPPORTED_ARCHS)
-from .replace_module import convert_hf_checkpoint, export_hf_checkpoint, replace_transformer_layer
+                             Gemma2Policy, OPTPolicy, PhiPolicy, FalconPolicy,
+                             policy_for, SUPPORTED_ARCHS)
+from .replace_module import (convert_hf_checkpoint, convert_hf_safetensors,
+                             export_hf_checkpoint, replace_transformer_layer)

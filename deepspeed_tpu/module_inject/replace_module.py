@@ -69,6 +69,16 @@ def convert_hf_checkpoint(arch: str,
                 stacked = np.stack([_to_numpy(hf_state_dict[n]).T for n in hf_names])
                 flat[flax_path] = stacked.astype(np.float32)  # [E, in, out]
                 consumed.update(hf_names)
+        if hasattr(policy, "convert_special"):
+            # fused tensors the plain name map can't express (falcon MQA qkv)
+            def get_tensor(name):
+                consumed.add(name)
+                return _to_numpy(hf_state_dict[name])
+
+            def put(path, arr):
+                flat[path] = np.asarray(arr, np.float32)
+
+            policy.convert_special(layer, cfg, get_tensor, put)
 
     leftovers = [k for k in hf_state_dict if k not in consumed
                  and not k.endswith("rotary_emb.inv_freq")]
@@ -97,6 +107,8 @@ def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[s
     maps = dict(policy.global_map(config.tie_word_embeddings))
     for layer in range(config.num_hidden_layers):
         maps.update(policy.weight_map(layer, attention_bias=config.attention_bias))
+        if hasattr(policy, "export_special"):
+            out.update(policy.export_special(layer, config, flat))
         if hasattr(policy, "moe_map") and config.num_local_experts > 0:
             gate, experts = policy.moe_map(layer, config.num_local_experts)
             maps.update(gate)
@@ -108,6 +120,102 @@ def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[s
         w = flat[flax_path]
         out[hf_name] = w.T if transpose else w
     return out
+
+
+def convert_hf_safetensors(arch: str,
+                           model_dir: str,
+                           hf_config: Optional[Dict] = None,
+                           dtype=jnp.bfloat16) -> Tuple[LlamaConfig, Dict]:
+    """Streaming conversion from a safetensors checkpoint directory.
+
+    Tensors are read ONE AT A TIME from each ``*.safetensors`` shard and cast
+    to the target dtype immediately, so peak host RAM ≈ the converted tree
+    (in `dtype`) + one tensor. The whole-dict path (:func:`convert_hf_checkpoint`)
+    holds source fp32 AND converted fp32 simultaneously — a 70B model cannot
+    do that on a host. Fused tensors a policy converts via
+    ``convert_special`` (falcon qkv) and stacked MoE experts are buffered
+    only until their conversion completes.
+    """
+    import glob
+    import json
+    import os
+    from safetensors import safe_open
+
+    if hf_config is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            hf_config = json.load(f)
+    policy = policy_for(arch)
+    cfg = policy.config_from_hf(hf_config)
+    np_dtype = jnp.dtype(dtype)
+
+    mapping: Dict[str, Tuple[str, bool]] = dict(policy.global_map(cfg.tie_word_embeddings))
+    stack_map: Dict[str, Tuple[str, int]] = {}   # hf expert tensor -> (path, e)
+    stack_shapes: Dict[str, int] = {}
+    for layer in range(cfg.num_hidden_layers):
+        mapping.update(policy.weight_map(layer, attention_bias=cfg.attention_bias))
+        if hasattr(policy, "moe_map") and cfg.num_local_experts > 0:
+            gate, experts = policy.moe_map(layer, cfg.num_local_experts)
+            mapping.update(gate)
+            for flax_path, hf_names in experts.items():
+                stack_shapes[flax_path] = len(hf_names)
+                for e, n in enumerate(hf_names):
+                    stack_map[n] = (flax_path, e)
+
+    special_names = set()
+    if hasattr(policy, "special_hf_names"):
+        for layer in range(cfg.num_hidden_layers):
+            special_names.update(policy.special_hf_names(layer))
+
+    flat: Dict[str, np.ndarray] = {}
+    extras: Dict[str, np.ndarray] = {}  # declared convert_special inputs only
+    stack_filled: Dict[str, set] = {}
+    skipped = []
+    shards = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {model_dir}")
+    for shard in shards:
+        with safe_open(shard, framework="numpy") as f:
+            for name in f.keys():
+                if name in mapping:
+                    path, tr = mapping[name]
+                    w = f.get_tensor(name)
+                    flat[path] = (w.T if tr else w).astype(np_dtype)
+                elif name in stack_map:
+                    path, e = stack_map[name]
+                    w = f.get_tensor(name).T
+                    if path not in flat:
+                        flat[path] = np.empty((stack_shapes[path], *w.shape), np_dtype)
+                    flat[path][e] = w.astype(np_dtype)
+                    stack_filled.setdefault(path, set()).add(e)
+                elif name in special_names:
+                    extras[name] = f.get_tensor(name)
+                elif not name.endswith("rotary_emb.inv_freq"):
+                    skipped.append(name)
+    if skipped:
+        logger.warning(f"unconverted checkpoint tensors: {skipped[:8]}"
+                       f"{'...' if len(skipped) > 8 else ''}")
+    if hasattr(policy, "convert_special"):
+        for layer in range(cfg.num_hidden_layers):
+            def get_tensor(name):
+                return extras.pop(name)  # freed as consumed
+
+            def put(path, arr):
+                flat[path] = np.asarray(arr).astype(np_dtype)
+
+            policy.convert_special(layer, cfg, get_tensor, put)
+    missing = [v[0] for k, v in mapping.items() if v[0] not in flat]
+    # np.empty preallocation makes a partially-filled expert stack look
+    # present — verify every expert slot was actually written
+    for path, n in stack_shapes.items():
+        if path not in flat:
+            missing.append(path)
+        elif len(stack_filled.get(path, ())) != n:
+            missing.append(f"{path} (only {len(stack_filled.get(path, ()))}/{n} "
+                           f"experts present)")
+    if missing:
+        raise KeyError(f"checkpoint under {model_dir} is missing tensors for: "
+                       f"{missing[:6]}{'...' if len(missing) > 6 else ''}")
+    return cfg, {"model": _nest(flat)}
 
 
 def replace_transformer_layer(arch_or_model_type: str,

@@ -144,6 +144,228 @@ class Gemma2Policy(HFCheckpointPolicy):
         return dataclasses.replace(cfg, tie_word_embeddings=True)
 
 
+class OPTPolicy(HFCheckpointPolicy):
+    """OPT (reference ``module_inject/containers/opt.py`` +
+    ``inference/v2/model_implementations/opt``): learned positions (table
+    offset by 2 in HF), pre-LayerNorm, ReLU fc MLP, biases everywhere,
+    tied lm_head. ``word_embed_proj_dim != hidden_size`` variants (350m's
+    project_in/out) are out of scope."""
+    arch = "opt"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("word_embed_proj_dim",
+                         hf_config["hidden_size"]) != hf_config["hidden_size"]:
+            raise ValueError("OPT variants with word_embed_proj_dim != hidden_size "
+                             "(project_in/out) are not supported")
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["ffn_dim"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config["num_attention_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 2048),
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", True),
+            attention_bias=hf_config.get("enable_bias", True),
+            attention_out_bias=hf_config.get("enable_bias", True),
+            norm_type="layernorm",
+            pos_embedding="learned",
+            pos_offset=2,
+            mlp_type="relu_fc",
+            mlp_bias=hf_config.get("enable_bias", True),
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"model.decoder.layers.{layer}."
+        f = f"layers_{layer}/"
+        out = {}
+        for hf, fx in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                       ("v_proj", "v_proj"), ("out_proj", "o_proj")):
+            out[p + f"self_attn.{hf}.weight"] = (f + f"self_attn/{fx}/kernel", True)
+            if attention_bias:  # enable_bias=False checkpoints have none
+                out[p + f"self_attn.{hf}.bias"] = (f + f"self_attn/{fx}/bias", False)
+        if attention_bias:
+            out.update({
+                p + "fc1.bias": (f + "mlp/fc1/bias", False),
+                p + "fc2.bias": (f + "mlp/fc2/bias", False),
+            })
+        out.update({
+            p + "self_attn_layer_norm.weight": (f + "input_layernorm/scale", False),
+            p + "self_attn_layer_norm.bias": (f + "input_layernorm/bias", False),
+            p + "final_layer_norm.weight": (f + "post_attention_layernorm/scale", False),
+            p + "final_layer_norm.bias": (f + "post_attention_layernorm/bias", False),
+            p + "fc1.weight": (f + "mlp/fc1/kernel", True),
+            p + "fc2.weight": (f + "mlp/fc2/kernel", True),
+        })
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "model.decoder.embed_tokens.weight": ("embed_tokens/embedding", False),
+            "model.decoder.embed_positions.weight": ("embed_positions/embedding", False),
+            "model.decoder.final_layer_norm.weight": ("norm/scale", False),
+            "model.decoder.final_layer_norm.bias": ("norm/bias", False),
+        }
+
+
+class PhiPolicy(HFCheckpointPolicy):
+    """Phi-1/2 (reference ``inference/v2/model_implementations/phi``):
+    parallel attention+MLP over ONE shared LayerNorm, partial rotary, GELU fc
+    MLP, biases everywhere including the lm_head."""
+    arch = "phi"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("qk_layernorm"):
+            raise ValueError("phi qk_layernorm=True checkpoints are not supported "
+                             "(q/k layernorm weights would be dropped)")
+        hd = hf_config["hidden_size"] // hf_config["num_attention_heads"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config.get("num_key_value_heads")
+            or hf_config["num_attention_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            rotary_dim=int(hf_config.get("partial_rotary_factor", 0.5) * hd),
+            attention_bias=True,
+            attention_out_bias=True,
+            norm_type="layernorm",
+            mlp_type="gelu_fc",
+            mlp_bias=True,
+            parallel_residual=True,
+            lm_head_bias=True,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        out = {}
+        for hf, fx in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                       ("v_proj", "v_proj"), ("dense", "o_proj")):
+            out[p + f"self_attn.{hf}.weight"] = (f + f"self_attn/{fx}/kernel", True)
+            out[p + f"self_attn.{hf}.bias"] = (f + f"self_attn/{fx}/bias", False)
+        out.update({
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "mlp.fc1.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.fc1.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.fc2.weight": (f + "mlp/fc2/kernel", True),
+            p + "mlp.fc2.bias": (f + "mlp/fc2/bias", False),
+        })
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "model.embed_tokens.weight": ("embed_tokens/embedding", False),
+            "model.final_layernorm.weight": ("norm/scale", False),
+            "model.final_layernorm.bias": ("norm/bias", False),
+            "lm_head.weight": ("lm_head/kernel", True),
+            "lm_head.bias": ("lm_head/bias", False),
+        }
+
+
+class FalconPolicy(HFCheckpointPolicy):
+    """Falcon-7B family (reference ``module_inject/containers/`` falcon +
+    ``inference/v2/model_implementations/falcon``): multi-query attention
+    (1 KV head) with a FUSED query_key_value tensor, parallel attention+MLP
+    over one LayerNorm, GELU fc MLP. The new_decoder_architecture (40B
+    grouped ln_attn/ln_mlp) variant is out of scope."""
+    arch = "falcon"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("new_decoder_architecture"):
+            raise ValueError("falcon new_decoder_architecture (40B/180B ln_attn/"
+                             "ln_mlp) is not supported; 7B-family only")
+        if hf_config.get("alibi"):
+            raise ValueError("falcon-rw alibi positions are not supported "
+                             "(this model family uses rotary)")
+        if not hf_config.get("multi_query", True):
+            raise ValueError("falcon multi_query=False uses a per-head "
+                             "interleaved fused qkv layout; not supported")
+        if hf_config.get("bias"):
+            raise ValueError("falcon bias=True checkpoints are not supported "
+                             "(bias tensors have no conversion entries)")
+        if not hf_config.get("parallel_attn", True):
+            raise ValueError("falcon parallel_attn=False (sequential residual "
+                             "with post-attention ln) is not supported")
+        h = hf_config["hidden_size"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("ffn_hidden_size", 4 * h),
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=1 if hf_config.get("multi_query", True)
+            else hf_config["num_attention_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", True),
+            attention_bias=hf_config.get("bias", False),
+            attention_out_bias=hf_config.get("bias", False),
+            norm_type="layernorm",
+            mlp_type="gelu_fc",
+            mlp_bias=hf_config.get("bias", False),
+            parallel_residual=hf_config.get("parallel_attn", True),
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"transformer.h.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "self_attention.dense.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "mlp.dense_h_to_4h.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.dense_4h_to_h.weight": (f + "mlp/fc2/kernel", True),
+        }
+
+    def special_hf_names(self, layer: int):
+        """HF tensors convert_special consumes (streaming conversion buffers
+        exactly these, nothing else)."""
+        return [f"transformer.h.{layer}.self_attention.query_key_value.weight"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        """Split the fused MQA query_key_value tensor: rows are
+        [nq*hd | hd (k) | hd (v)]."""
+        hf = f"transformer.h.{layer}.self_attention.query_key_value.weight"
+        w = get_tensor(hf)  # [(nq + 2*nkv) * hd, h]
+        hd = cfg.head_dim_
+        nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        f = f"layers_{layer}/self_attn/"
+        put(f + "q_proj/kernel", w[:nq * hd].T)
+        put(f + "k_proj/kernel", w[nq * hd:(nq + nkv) * hd].T)
+        put(f + "v_proj/kernel", w[(nq + nkv) * hd:].T)
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        f = f"layers_{layer}/self_attn/"
+        qkv = np.concatenate([flat[f + "q_proj/kernel"].T,
+                              flat[f + "k_proj/kernel"].T,
+                              flat[f + "v_proj/kernel"].T], axis=0)
+        return {f"transformer.h.{layer}.self_attention.query_key_value.weight": qkv}
+
+    def global_map(self, tie_embeddings: bool):
+        out = {
+            "transformer.word_embeddings.weight": ("embed_tokens/embedding", False),
+            "transformer.ln_f.weight": ("norm/scale", False),
+            "transformer.ln_f.bias": ("norm/bias", False),
+        }
+        if not tie_embeddings:
+            out["lm_head.weight"] = ("lm_head/kernel", True)
+        return out
+
+
 _POLICIES = {
     "llama": LlamaPolicy,
     "LlamaForCausalLM": LlamaPolicy,
@@ -155,6 +377,12 @@ _POLICIES = {
     "MixtralForCausalLM": MixtralPolicy,
     "gemma2": Gemma2Policy,
     "Gemma2ForCausalLM": Gemma2Policy,
+    "opt": OPTPolicy,
+    "OPTForCausalLM": OPTPolicy,
+    "phi": PhiPolicy,
+    "PhiForCausalLM": PhiPolicy,
+    "falcon": FalconPolicy,
+    "FalconForCausalLM": FalconPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -5,6 +5,7 @@ zoo checks, cut to the tiny-llama case)."""
 import dataclasses
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.module_inject import (convert_hf_checkpoint, export_hf_checkpoint,
@@ -183,3 +184,158 @@ def test_missing_weight_raises(tiny_hf_llama):
     sd.pop("model.layers.0.self_attn.q_proj.weight")
     with pytest.raises(KeyError):
         convert_hf_checkpoint("llama", sd, hf_cfg.to_dict())
+
+
+class TestNewArchParity:
+    """OPT / Falcon / Phi logits parity vs transformers (reference
+    module_inject/containers + inference/v2/model_implementations coverage)."""
+
+    def _compare(self, arch, hf_model, hf_cfg, atol=2e-3):
+        cfg, params = convert_hf_checkpoint(arch, hf_model.state_dict(),
+                                            hf_cfg.to_dict())
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        cfg32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = LlamaForCausalLM(cfg32)
+        ids = np.array([[1, 5, 9, 42, 17, 3, 21, 23]], dtype=np.int32)
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=atol)
+        return cfg, params
+
+    def test_opt_logits_match_hf(self):
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            do_layer_norm_before=True, activation_function="relu")
+        torch.manual_seed(1)
+        hf = transformers.OPTForCausalLM(hf_cfg).eval()
+        cfg, _ = self._compare("opt", hf, hf_cfg)
+        assert cfg.pos_embedding == "learned" and cfg.pos_offset == 2
+        assert cfg.norm_type == "layernorm" and cfg.mlp_type == "relu_fc"
+
+    def test_falcon_logits_match_hf(self):
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            bias=False, new_decoder_architecture=False, alibi=False)
+        torch.manual_seed(2)
+        hf = transformers.FalconForCausalLM(hf_cfg).eval()
+        cfg, _ = self._compare("falcon", hf, hf_cfg)
+        assert cfg.num_key_value_heads == 1  # MQA
+        assert cfg.parallel_residual
+
+    def test_phi_logits_match_hf(self):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5)
+        torch.manual_seed(3)
+        hf = transformers.PhiForCausalLM(hf_cfg).eval()
+        cfg, _ = self._compare("phi", hf, hf_cfg)
+        assert cfg.rotary_dim == 4  # half of head_dim 8
+        assert cfg.parallel_residual and cfg.lm_head_bias
+
+    @pytest.mark.parametrize("arch", ["opt", "falcon", "phi"])
+    def test_ragged_engine_serves_new_archs(self, arch):
+        """The generalized ragged model (parallel residual, layernorm, fc
+        MLP, learned/partial-rotary positions) serves each new arch: prefill
+        final-token logits through the paged-KV engine match transformers."""
+        torch.manual_seed(7)
+        if arch == "opt":
+            hf_cfg = transformers.OPTConfig(
+                vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=64,
+                do_layer_norm_before=True, activation_function="relu")
+            hf = transformers.OPTForCausalLM(hf_cfg).eval()
+        elif arch == "falcon":
+            hf_cfg = transformers.FalconConfig(
+                vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, multi_query=True, parallel_attn=True,
+                bias=False, new_decoder_architecture=False, alibi=False)
+            hf = transformers.FalconForCausalLM(hf_cfg).eval()
+        else:
+            hf_cfg = transformers.PhiConfig(
+                vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, partial_rotary_factor=0.5)
+            hf = transformers.PhiForCausalLM(hf_cfg).eval()
+        cfg, params = convert_hf_checkpoint(arch, hf.state_dict(), hf_cfg.to_dict())
+        from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                                RaggedInferenceEngineConfig)
+        from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+        eng = build_llama_engine(
+            dataclasses.replace(cfg, dtype=jnp.float32), params=params,
+            dtype=jnp.float32, kv_block_size=16,
+            engine_config=RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(max_context=64),
+                num_kv_blocks=16))
+        prompt = [1, 5, 9, 42, 17]
+        logits = np.asarray(eng.put([0], [prompt]))[0]
+        with torch.no_grad():
+            ref = hf(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+        np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+
+    def test_falcon_export_roundtrip(self):
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=64, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, multi_query=True, parallel_attn=True,
+            bias=False, new_decoder_architecture=False, alibi=False)
+        torch.manual_seed(4)
+        hf = transformers.FalconForCausalLM(hf_cfg).eval()
+        cfg, params = convert_hf_checkpoint("falcon", hf.state_dict(), hf_cfg.to_dict())
+        out = export_hf_checkpoint("falcon", cfg, params)
+        qkv = "transformer.h.0.self_attention.query_key_value.weight"
+        np.testing.assert_allclose(out[qkv], hf.state_dict()[qkv].numpy(), atol=1e-6)
+
+
+class TestStreamingSafetensors:
+
+    def test_streaming_matches_dict_conversion(self, tmp_path):
+        from safetensors.numpy import save_file
+        from deepspeed_tpu.module_inject import convert_hf_safetensors
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64)
+        torch.manual_seed(5)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        # two shards, split mid-model (the streaming path must not care)
+        keys = sorted(sd)
+        save_file({k: sd[k] for k in keys[:len(keys) // 2]}, tmp_path / "a.safetensors")
+        save_file({k: sd[k] for k in keys[len(keys) // 2:]}, tmp_path / "b.safetensors")
+        import json
+        (tmp_path / "config.json").write_text(json.dumps(hf_cfg.to_dict()))
+
+        cfg_s, params_s = convert_hf_safetensors("llama", str(tmp_path),
+                                                 dtype=jnp.float32)
+        cfg_d, params_d = convert_hf_checkpoint("llama", hf.state_dict(),
+                                                hf_cfg.to_dict())
+        assert cfg_s == cfg_d
+        for (p1, a), (p2, b) in zip(
+                jax.tree_util.tree_leaves_with_path(params_s),
+                jax.tree_util.tree_leaves_with_path(params_d)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0,
+                                       err_msg=str(p1))
+
+    def test_streaming_falcon_fused(self, tmp_path):
+        from safetensors.numpy import save_file
+        from deepspeed_tpu.module_inject import convert_hf_safetensors
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=64, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=2, multi_query=True, parallel_attn=True,
+            bias=False, new_decoder_architecture=False, alibi=False)
+        torch.manual_seed(6)
+        hf = transformers.FalconForCausalLM(hf_cfg).eval()
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        save_file(sd, tmp_path / "model.safetensors")
+        cfg_s, params_s = convert_hf_safetensors("falcon", str(tmp_path),
+                                                 hf_config=hf_cfg.to_dict(),
+                                                 dtype=jnp.float32)
+        cfg_d, params_d = convert_hf_checkpoint("falcon", hf.state_dict(),
+                                                hf_cfg.to_dict())
+        for (p1, a), (p2, b) in zip(
+                jax.tree_util.tree_leaves_with_path(params_s),
+                jax.tree_util.tree_leaves_with_path(params_d)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0,
+                                       err_msg=str(p1))
