@@ -221,7 +221,7 @@ def _eager_collective(key, make_fn, tensor, group, out_spec=None):
     axes = _norm_group(group)
     in_spec = _input_spec(tensor)
     out_spec = in_spec if out_spec is None else out_spec
-    cache_key = (key, axes, in_spec, out_spec, id(ctx.mesh))
+    cache_key = (key, axes, in_spec, out_spec, ctx.epoch)
     fn = _EAGER_JIT_CACHE.get(cache_key)
     if fn is None:
         from jax.experimental.shard_map import shard_map

@@ -1,0 +1,84 @@
+"""Error-compensated 1-bit compressed allreduce — the WIRE path.
+
+Reference: ``runtime/comm/nccl.py:16 NcclBackend.compressed_allreduce`` /
+``runtime/comm/compressed.py:13`` — the momentum exchange behind 1-bit
+Adam/LAMB/0-1 Adam packs sign bits + a per-worker scale so the wire carries
+~1/32 of the fp32 bytes.
+
+TPU shape: inside a ``shard_map`` region with the data-parallel axes manual,
+each worker packs its error-corrected tensor's SIGN BITS into uint8 (8 signs
+per byte — the arrays XLA actually moves over ICI are the packed ones),
+``lax.all_gather``s packed bits + scales, and decompresses/averages locally:
+
+    worker i:  c_i = x_i + e_i;  s_i = mean|c_i|;  wire_i = signbits(c_i)
+    result  =  mean_i( sign(wire_i) * s_i );   e_i' = c_i - sign(c_i)*s_i
+
+Wire volume per worker: N/8 bytes + 4, vs 4N for an fp32 gather — 32x, the
+reference's headline (docs/_tutorials/onebit-adam.md).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pack_signs(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [N] float → (packed uint8 [ceil(N/8)], scale scalar). The sign
+    convention: bit=1 means non-negative."""
+    n = x.shape[0]
+    pad = (-n) % 8
+    bits = (jnp.pad(x, (0, pad)) >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :]
+    packed = jnp.sum(bits * weights, axis=1).astype(jnp.uint8)
+    scale = jnp.mean(jnp.abs(x))
+    return packed, scale
+
+
+def unpack_signs(packed, n: int) -> jnp.ndarray:
+    """packed uint8 [..., ceil(N/8)] → signs ±1.0 float32 [..., N]."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[..., :, None] >> shifts) & jnp.uint8(1)
+    signs = bits.astype(jnp.float32) * 2.0 - 1.0
+    return signs.reshape(*packed.shape[:-1], -1)[..., :n]
+
+
+def compressed_allreduce_intrace(x, error, axis_names):
+    """One error-compensated compressed allreduce step (must run inside
+    shard_map with ``axis_names`` manual). x/error are flat [N] float arrays;
+    returns (averaged_result [N], new_error [N])."""
+    n = x.shape[0]
+    corrected = x + error
+    packed, scale = pack_signs(corrected)
+    # THE wire: uint8 sign bits + one fp32 scale per worker
+    all_packed = lax.all_gather(packed, axis_names)      # [W, N/8] uint8
+    all_scales = lax.all_gather(scale, axis_names)       # [W]
+    signs = unpack_signs(all_packed, n)                  # [W, N]
+    avg = jnp.mean(signs * all_scales[:, None], axis=0)
+    my_compressed = unpack_signs(packed, n) * scale
+    new_error = corrected - my_compressed
+    return avg, new_error
+
+
+def compressed_allreduce_tree(tree, error_tree, axis_names):
+    """Pytree version: each leaf raveled, exchanged, restored."""
+    def one(x, e):
+        flat, err = x.ravel(), e.ravel()
+        avg, new_err = compressed_allreduce_intrace(flat, err, axis_names)
+        return avg.reshape(x.shape).astype(x.dtype), new_err.reshape(x.shape).astype(e.dtype)
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    err_leaves = jax.tree_util.tree_leaves(error_tree)
+    out = [one(x, e) for x, e in zip(leaves, err_leaves)]
+    avg = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_err = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return avg, new_err
+
+
+def wire_bytes(n_elements: int, world: int) -> dict:
+    """Accounting: packed wire vs fp32 gather (per worker, receive side)."""
+    packed = world * ((n_elements + 7) // 8 + 4)
+    fp32 = world * n_elements * 4
+    return {"compressed_bytes": packed, "fp32_bytes": fp32,
+            "reduction": fp32 / packed}

@@ -54,11 +54,19 @@ def resolve_axis_sizes(n_devices: int, sizes: Dict[str, int], order: Sequence[st
     return out
 
 
+_MESH_EPOCH = 0
+
+
 class MeshContext:
     """Holds the global mesh and the axis-name algebra used by every layer."""
 
     def __init__(self, mesh: Mesh):
+        global _MESH_EPOCH
         self.mesh = mesh
+        # monotonic id for caches: a GC'd mesh can alias a new mesh's id(),
+        # so cache keys must use this epoch, never id(mesh)
+        _MESH_EPOCH += 1
+        self.epoch = _MESH_EPOCH
 
     # -------- construction --------
 

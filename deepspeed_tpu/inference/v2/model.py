@@ -62,8 +62,9 @@ def _mlp_tok(x, lp, cfg):
     if cfg.mlp_type == "swiglu":
         gate = jax.nn.silu(x @ mlp["gate_proj"]["kernel"])
         return (gate * (x @ mlp["up_proj"]["kernel"])) @ mlp["down_proj"]["kernel"]
-    act = (lambda y: jax.nn.gelu(y, approximate=True)) \
-        if cfg.mlp_type == "gelu_fc" else jax.nn.relu
+    act = {"gelu_fc": lambda y: jax.nn.gelu(y, approximate=False),
+           "gelu_tanh_fc": lambda y: jax.nn.gelu(y, approximate=True),
+           "relu_fc": jax.nn.relu}[cfg.mlp_type]
     h = x @ mlp["fc1"]["kernel"]
     if "bias" in mlp["fc1"]:
         h = h + mlp["fc1"]["bias"]

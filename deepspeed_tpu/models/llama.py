@@ -55,7 +55,9 @@ class LlamaConfig:
     pos_embedding: str = "rope"       # "rope" | "learned" (OPT)
     pos_offset: int = 0               # OPT stores positions at index pos+2
     rotary_dim: Optional[int] = None  # Phi partial rotary; None = full head_dim
-    mlp_type: str = "swiglu"          # "swiglu" | "gelu_fc" | "relu_fc"
+    # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
+    # "gelu_new", Phi) | "relu_fc" (OPT)
+    mlp_type: str = "swiglu"
     mlp_bias: bool = False            # fc1/fc2 biases (OPT/Phi)
     parallel_residual: bool = False   # Falcon/Phi: x + attn(ln(x)) + mlp(ln(x))
     lm_head_bias: bool = False        # Phi
@@ -233,8 +235,10 @@ class LlamaMLP(nn.Module):
             up = _dense(cfg.intermediate_size, "up_proj", (EMBED, HIDDEN), cfg.dtype)(x)
             return _dense(cfg.hidden_size, "down_proj", (HIDDEN, EMBED),
                           cfg.dtype)(nn.silu(gate) * up)
-        # fc1/fc2 form (OPT relu, Falcon/Phi gelu — HF "gelu_new" tanh approx)
-        act = {"gelu_fc": lambda y: nn.gelu(y, approximate=True),
+        # fc1/fc2 form: Falcon uses exact (erf) GELU, Phi HF "gelu_new" is
+        # the tanh approximation, OPT is ReLU
+        act = {"gelu_fc": lambda y: nn.gelu(y, approximate=False),
+               "gelu_tanh_fc": lambda y: nn.gelu(y, approximate=True),
                "relu_fc": nn.relu}[cfg.mlp_type]
         h = _dense(cfg.intermediate_size, "fc1", (EMBED, HIDDEN), cfg.dtype,
                    cfg.mlp_bias)(x)

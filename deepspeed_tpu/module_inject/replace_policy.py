@@ -159,6 +159,10 @@ class OPTPolicy(HFCheckpointPolicy):
                          hf_config["hidden_size"]) != hf_config["hidden_size"]:
             raise ValueError("OPT variants with word_embed_proj_dim != hidden_size "
                              "(project_in/out) are not supported")
+        if not hf_config.get("do_layer_norm_before", True):
+            raise ValueError("OPT do_layer_norm_before=False (post-LN, the 350m "
+                             "ordering) is not supported — the decoder here is "
+                             "pre-LN only")
         return LlamaConfig(
             vocab_size=hf_config["vocab_size"],
             hidden_size=hf_config["hidden_size"],
@@ -239,7 +243,7 @@ class PhiPolicy(HFCheckpointPolicy):
             attention_bias=True,
             attention_out_bias=True,
             norm_type="layernorm",
-            mlp_type="gelu_fc",
+            mlp_type="gelu_tanh_fc",  # HF phi hidden_act "gelu_new"
             mlp_bias=True,
             parallel_residual=True,
             lm_head_bias=True,

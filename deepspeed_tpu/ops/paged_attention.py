@@ -74,8 +74,8 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
             jnp.int32, scores.shape, 0) // groups
         mask = (key_pos <= q_abs) & (key_pos < hist_len)
 
-        m_prev = m_scr[:, :1]
-        l_prev = l_scr[:, :1]
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
         masked = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(masked, axis=-1, keepdims=True))
         # keep the running max finite so exp() below never sees inf-inf
@@ -85,12 +85,12 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
     @pl.when(b == n_pages - 1)
     def _finalize():
-        l = l_scr[:, :1]
+        l = l_scr[...]
         out = jnp.where(l > 0, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
         n, g, d = o_ref.shape[1], o_ref.shape[3], o_ref.shape[4]
         o_ref[...] = out.reshape(1, n, 1, g, d).astype(o_ref.dtype)
@@ -137,9 +137,11 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
         ],
         out_specs=pl.BlockSpec((1, N, 1, G, D), o_map),
         scratch_shapes=[
-            pltpu.VMEM((N * G, 128), jnp.float32),  # running max (replicated)
-            pltpu.VMEM((N * G, 128), jnp.float32),  # running sum
-            pltpu.VMEM((N * G, D), jnp.float32),    # accumulator
+            # logically [NG, 1]; lane padding is the compiler's business —
+            # declaring 128 lanes forced a broadcast-write every page
+            pltpu.VMEM((N * G, 1), jnp.float32),  # running max
+            pltpu.VMEM((N * G, 1), jnp.float32),  # running sum
+            pltpu.VMEM((N * G, D), jnp.float32),  # accumulator
         ],
     )
 

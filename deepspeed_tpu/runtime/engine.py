@@ -204,6 +204,7 @@ class DeepSpeedTpuEngine:
             lr_fn = self.lr_scheduler.lr_at
 
         # ---- optimizer ----
+        self._lr_fn = lr_fn
         if optimizer is not None and isinstance(optimizer, optax.GradientTransformation):
             self.base_tx, self._base_lr = optimizer, base_lr
         else:
@@ -540,6 +541,24 @@ class DeepSpeedTpuEngine:
         # (Twin-Flow needs the materialized grad buffer to snapshot the host
         # subset, so the one-program fused path is off under partial offload)
 
+        # 1-bit compressed WIRE program (reference runtime/comm/nccl.py:16):
+        # post-warmup steps exchange packed sign bits instead of fp32 grads.
+        # Opt-in via optimizer.params.comm_backend_name (the reference's knob).
+        self._wire_step = None
+        self._wire_freeze_step = 0
+        opname = (self._config.optimizer_name or "").lower()
+        op = self._config.optimizer_params or {}
+        if (opname in ("onebitadam", "onebitlamb") and op.get("comm_backend_name")
+                and self._train_step_fused is not None):
+            from .onebit_wire import build_wire_step, wire_supported
+            if wire_supported(self):
+                self._wire_step = build_wire_step(self, opname)
+                self._wire_freeze_step = int(op.get("freeze_step", 100000))
+            else:
+                logger.warning("1-bit wire program unavailable (needs gas=1, "
+                               "ZeRO stage 0, bf16/fp32, pure-DP mesh); "
+                               "falling back to compiler-emitted fp32 reduce")
+
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
     # ------------------------------------------------------------------
@@ -783,9 +802,14 @@ class DeepSpeedTpuEngine:
         kwargs, static_kv = _split_static_kwargs(kwargs)
         args = jax.device_put(args, self.zero_plan.batch_sharding(args))
         kwargs = jax.device_put(kwargs, self.zero_plan.batch_sharding(kwargs))
+        step_fn = self._train_step_fused
+        if self._wire_step is not None and self.global_steps >= self._wire_freeze_step:
+            # post-warmup: packed 1-bit momentum exchange replaces the fp32
+            # grad reduce (the reference's freeze_step phase switch)
+            step_fn = self._wire_step
         (loss, self.params, self.opt_state, self.scale_state, overflow,
-         gnorm) = self._train_step_fused(self.params, self.opt_state, self.scale_state,
-                                         args, kwargs, static_kv)
+         gnorm) = step_fn(self.params, self.opt_state, self.scale_state,
+                          args, kwargs, static_kv)
         self._last_grad_norm = gnorm
         self.losses = loss
         self.micro_steps += 1
@@ -829,11 +853,8 @@ class DeepSpeedTpuEngine:
         return self._config.gradient_accumulation_steps
 
     def get_lr(self):
-        if self.lr_scheduler is not None:
-            try:
-                return self.lr_scheduler.get_last_lr()
-            except AssertionError:
-                return [self._base_lr]
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_last_lr"):
+            return self.lr_scheduler.get_last_lr()
         return [self._base_lr]
 
     def get_global_grad_norm(self):

@@ -42,7 +42,11 @@ class _LRScheduleBase:
         return [self._lr(self.last_batch_iteration)]
 
     def get_last_lr(self):
-        assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
+        if getattr(self, "_last_lr", None) is None:
+            # before the first step(): the schedule's value at the current
+            # iteration (reference asserts here; returning the real value is
+            # strictly more useful and keeps engine.get_lr() exception-free)
+            return [self._lr(max(self.last_batch_iteration, 0))]
         return self._last_lr
 
     def step(self, last_batch_iteration: Optional[int] = None):

@@ -31,10 +31,15 @@ class OneBitAdamState(NamedTuple):
 def _sign_compress(x, error):
     """Error-compensated 1-bit compression: sign + per-tensor L1 scale.
     Returns (compressed, new_error); reference compressed_allreduce
-    (runtime/comm/nccl.py:16) packs the sign bits for the wire."""
+    (runtime/comm/nccl.py:16) packs the sign bits for the wire.
+
+    Sign convention: >= 0 maps to +1 — one bit has no zero, and the
+    reference wire packs exactly this (``sign().add_(1).bool()``). The local
+    path MUST match or it silently diverges from the wire program on
+    exactly-zero elements (dead units)."""
     corrected = x + error
     scale = jnp.mean(jnp.abs(corrected))
-    compressed = jnp.sign(corrected) * scale
+    compressed = jnp.where(corrected >= 0, scale, -scale)
     new_error = corrected - compressed
     return compressed, new_error
 
@@ -43,9 +48,15 @@ def scale_by_onebit_adam(b1: float = 0.9,
                          b2: float = 0.999,
                          eps: float = 1e-8,
                          freeze_step: int = 100000,
-                         var_freeze: bool = True) -> optax.GradientTransformation:
+                         var_freeze: bool = True,
+                         exchange_fn=None) -> optax.GradientTransformation:
     """1-bit Adam (reference onebit/adam.py:14). Before `freeze_step`: exact
-    Adam. After: variance frozen, momentum sign-compressed w/ error feedback."""
+    Adam. After: variance frozen, momentum sign-compressed w/ error feedback.
+
+    ``exchange_fn(mu_tree, error_tree) -> (avg_tree, new_error_tree)`` swaps
+    the local sign compression for a REAL wire exchange
+    (comm/compressed.py compressed_allreduce_tree inside a shard_map region);
+    used by the engine's post-warmup wire program (onebit_wire.py)."""
 
     def init_fn(params):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -65,11 +76,14 @@ def scale_by_onebit_adam(b1: float = 0.9,
             if var_freeze else nu_warm
 
         # compressed momentum (post-warmup)
-        comp_and_err = jax.tree_util.tree_map(_sign_compress, mu, state.error)
-        mu_comp = jax.tree_util.tree_map(lambda ce: ce[0], comp_and_err,
-                                         is_leaf=lambda x: isinstance(x, tuple))
-        err_new = jax.tree_util.tree_map(lambda ce: ce[1], comp_and_err,
-                                         is_leaf=lambda x: isinstance(x, tuple))
+        if exchange_fn is not None:
+            mu_comp, err_new = exchange_fn(mu, state.error)
+        else:
+            comp_and_err = jax.tree_util.tree_map(_sign_compress, mu, state.error)
+            mu_comp = jax.tree_util.tree_map(lambda ce: ce[0], comp_and_err,
+                                             is_leaf=lambda x: isinstance(x, tuple))
+            err_new = jax.tree_util.tree_map(lambda ce: ce[1], comp_and_err,
+                                             is_leaf=lambda x: isinstance(x, tuple))
         mu_used = jax.tree_util.tree_map(lambda w, c: jnp.where(in_warmup, w, c), mu, mu_comp)
         error = jax.tree_util.tree_map(lambda e_old, e_new: jnp.where(in_warmup, e_old, e_new),
                                        state.error, err_new)
@@ -129,10 +143,12 @@ def scale_by_onebit_lamb(b1: float = 0.9,
                          eps: float = 1e-8,
                          freeze_step: int = 100000,
                          max_coeff: float = 10.0,
-                         min_coeff: float = 0.01) -> optax.GradientTransformation:
+                         min_coeff: float = 0.01,
+                         exchange_fn=None) -> optax.GradientTransformation:
     """1-bit LAMB (reference onebit/lamb.py:15): 1-bit Adam core + layerwise
     trust ratio clamped to [min_coeff, max_coeff]."""
-    core = scale_by_onebit_adam(b1=b1, b2=b2, eps=eps, freeze_step=freeze_step)
+    core = scale_by_onebit_adam(b1=b1, b2=b2, eps=eps, freeze_step=freeze_step,
+                                exchange_fn=exchange_fn)
 
     def init_fn(params):
         return core.init(params)
@@ -153,21 +169,28 @@ def scale_by_onebit_lamb(b1: float = 0.9,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def build_onebit_optimizer(name: str, params: Dict[str, Any], learning_rate) -> optax.GradientTransformation:
+def build_onebit_optimizer(name: str, params: Dict[str, Any], learning_rate,
+                           exchange_fn=None) -> optax.GradientTransformation:
     betas = params.get("betas", (0.9, 0.999))
     eps = float(params.get("eps", 1e-8))
     weight_decay = float(params.get("weight_decay", 0.0))
     freeze_step = int(params.get("freeze_step", 100000))
     if name == "onebitadam":
-        core = scale_by_onebit_adam(b1=betas[0], b2=betas[1], eps=eps, freeze_step=freeze_step)
+        core = scale_by_onebit_adam(b1=betas[0], b2=betas[1], eps=eps, freeze_step=freeze_step,
+                                    exchange_fn=exchange_fn)
     elif name == "zerooneadam":
+        if exchange_fn is not None:
+            raise ValueError("0/1 Adam's interval variance updates need the raw "
+                             "gradients reduced — the compressed wire program "
+                             "supports onebitadam/onebitlamb only")
         core = scale_by_zero_one_adam(b1=betas[0], b2=betas[1], eps=eps,
                                       var_freeze_step=int(params.get("var_freeze_step", freeze_step)),
                                       var_update_scaler=int(params.get("var_update_scaler", 16)))
     elif name == "onebitlamb":
         core = scale_by_onebit_lamb(b1=betas[0], b2=betas[1], eps=eps, freeze_step=freeze_step,
                                     max_coeff=float(params.get("max_coeff", 10.0)),
-                                    min_coeff=float(params.get("min_coeff", 0.01)))
+                                    min_coeff=float(params.get("min_coeff", 0.01)),
+                                    exchange_fn=exchange_fn)
     else:
         raise ValueError(name)
     return optax.chain(
