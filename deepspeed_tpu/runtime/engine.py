@@ -549,7 +549,9 @@ class DeepSpeedTpuEngine:
         opname = (self._config.optimizer_name or "").lower()
         op = self._config.optimizer_params or {}
         if (opname in ("onebitadam", "onebitlamb") and op.get("comm_backend_name")
-                and self._train_step_fused is not None):
+                and self._train_step_fused is not None
+                and self.client_optimizer is None):  # a client tx would have a
+                # different opt-state pytree than the wire program's chain
             from .onebit_wire import build_wire_step, wire_supported
             if wire_supported(self):
                 self._wire_step = build_wire_step(self, opname)
@@ -854,7 +856,12 @@ class DeepSpeedTpuEngine:
 
     def get_lr(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_last_lr"):
-            return self.lr_scheduler.get_last_lr()
+            try:
+                return self.lr_scheduler.get_last_lr()
+            except AssertionError:
+                # external reference-style schedulers assert pre-step; our own
+                # (lr_schedules.py) return the schedule value instead
+                return [self._base_lr]
         return [self._base_lr]
 
     def get_global_grad_norm(self):

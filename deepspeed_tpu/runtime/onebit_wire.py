@@ -84,29 +84,28 @@ def build_wire_step(engine, name: str):
     ctx = engine.mesh_ctx
     mesh = ctx.mesh
     dp_axes = tuple(a for a in ("data", "fsdp") if ctx.axis_size(a) > 1)
+    ax = dp_axes if len(dp_axes) > 1 else dp_axes[0]  # lax collective axis arg
     compute_dtype = engine.compute_dtype
     apply_fn = engine.apply_fn
     gas = 1
 
-    exchange = partial(compressed_allreduce_tree,
-                       axis_names=dp_axes if len(dp_axes) > 1 else dp_axes[0])
+    exchange = partial(compressed_allreduce_tree, axis_names=ax)
     tx = build_onebit_optimizer(name, dict(engine._config.optimizer_params or {}),
                                 engine._lr_fn or engine._base_lr,
                                 exchange_fn=exchange)
 
     def local_step(params, opt_state, args, kwargs, static_kv):
         def loss_of(p):
+            from .engine import _extract_loss
             cp = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
             out = apply_fn(cp, *args, **dict(kwargs, **dict(static_kv)))
-            out = out[0] if isinstance(out, tuple) else (
-                out["loss"] if isinstance(out, dict) else out)
-            return out.astype(jnp.float32) / gas
+            loss, _ = _extract_loss(out)
+            return loss.astype(jnp.float32) / gas
 
         loss, grads = jax.value_and_grad(loss_of)(params)  # LOCAL grads
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        ax = dp_axes if len(dp_axes) > 1 else dp_axes[0]
         # diagnostic only: mean of per-worker local-grad norms (the true
         # global-grad norm would require the fp32 reduce this program avoids)
         gnorm = jax.lax.pmean(optax.global_norm(grads), ax)
@@ -114,7 +113,7 @@ def build_wire_step(engine, name: str):
         return loss, new_params, new_opt, gnorm
 
     repl = NamedSharding(mesh, P())
-    batch_spec = P(dp_axes if len(dp_axes) > 1 else dp_axes[0])
+    batch_spec = P(ax)
 
     def step(params, opt_state, scale_state, args, kwargs, static_kv):
         def region(params, opt_state, args, kwargs):
