@@ -549,17 +549,22 @@ class DeepSpeedTpuEngine:
         opname = (self._config.optimizer_name or "").lower()
         op = self._config.optimizer_params or {}
         if (opname in ("onebitadam", "onebitlamb") and op.get("comm_backend_name")
-                and self._train_step_fused is not None
-                and self.client_optimizer is None):  # a client tx would have a
-                # different opt-state pytree than the wire program's chain
-            from .onebit_wire import build_wire_step, wire_supported
-            if wire_supported(self):
-                self._wire_step = build_wire_step(self, opname)
-                self._wire_freeze_step = int(op.get("freeze_step", 100000))
+                and self._train_step_fused is not None):
+            if self.client_optimizer is not None:
+                # a client tx has a different opt-state pytree than the wire
+                # program's chain — surface the conflict, don't compress
+                logger.warning("1-bit wire program disabled: a client optimizer "
+                               "was passed to initialize(); gradients exchange "
+                               "uncompressed fp32")
             else:
-                logger.warning("1-bit wire program unavailable (needs gas=1, "
-                               "ZeRO stage 0, bf16/fp32, pure-DP mesh); "
-                               "falling back to compiler-emitted fp32 reduce")
+                from .onebit_wire import build_wire_step, wire_supported
+                if wire_supported(self):
+                    self._wire_step = build_wire_step(self, opname)
+                    self._wire_freeze_step = int(op.get("freeze_step", 100000))
+                else:
+                    logger.warning("1-bit wire program unavailable (needs gas=1, "
+                                   "ZeRO stage 0, bf16/fp32, pure-DP mesh, no "
+                                   "clipping); falling back to fp32 reduce")
 
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
@@ -855,12 +860,15 @@ class DeepSpeedTpuEngine:
         return self._config.gradient_accumulation_steps
 
     def get_lr(self):
-        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_last_lr"):
-            try:
-                return self.lr_scheduler.get_last_lr()
+        sched = self.lr_scheduler
+        if sched is not None and hasattr(sched, "get_last_lr"):
+            if getattr(sched, "_last_lr", None) is not None:
+                # stepped (ours and torch-style both set _last_lr): any
+                # exception from here is a real bug — let it surface
+                return sched.get_last_lr()
+            try:  # pre-step only: reference-style schedulers assert here
+                return sched.get_last_lr()
             except AssertionError:
-                # external reference-style schedulers assert pre-step; our own
-                # (lr_schedules.py) return the schedule value instead
                 return [self._base_lr]
         return [self._base_lr]
 

@@ -76,6 +76,7 @@ def build_wire_step(engine, name: str):
     callable with the engine's fused-step signature
     ``(params, opt_state, scale_state, args, kwargs, static_kv)``."""
     from .onebit import build_onebit_optimizer
+    from .engine import _extract_loss
 
     if not wire_supported(engine):
         raise ValueError(
@@ -96,7 +97,6 @@ def build_wire_step(engine, name: str):
 
     def local_step(params, opt_state, args, kwargs, static_kv):
         def loss_of(p):
-            from .engine import _extract_loss
             cp = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
             out = apply_fn(cp, *args, **dict(kwargs, **dict(static_kv)))
             loss, _ = _extract_loss(out)

@@ -2,3 +2,4 @@ from .aio_config import AioConfig, get_aio_config
 from .async_swapper import AsyncTensorSwapper
 from .partitioned_param_swapper import AsyncPartitionedParameterSwapper
 from .optimizer_swapper import OptimizerSwapper, PipelinedOptimizerSwapper
+from .nvme_stream import NvmeToHbmStreamer

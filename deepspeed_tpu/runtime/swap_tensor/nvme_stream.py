@@ -1,0 +1,112 @@
+"""NVMe → HBM streaming loader (the GDS analog).
+
+Reference: ``csrc/gds/py_lib/deepspeed_py_gds_handle.cpp`` moves NVMe bytes
+straight into GPU memory (9.6 GB/s read,
+``blogs/deepspeed-gds/README.md:50``). TPUs have no GPUDirect analog — the
+path is NVMe → pinned host buffer → HBM — so the bandwidth play is a
+PIPELINE: the C++ AIO thread pool (``csrc/aio/ds_aio.cpp``) reads chunk
+``i+1`` while ``jax.device_put`` streams chunk ``i``, with a ring of
+reusable host buffers. Steady-state throughput ≈ min(NVMe read BW, PCIe
+host→HBM BW) instead of their serial sum — the same double-buffering the
+reference's bounce-buffer GDS fallback uses (``deepspeed_gds_op.cpp``).
+
+``bin/ds_nvme_bench`` measures the achieved GB/s on real hardware (the
+ZeRO-Inference bar: reference blog 6 tok/s bounce vs 7 tok/s GDS came from
+exactly this path feeding weights).
+"""
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.aio import AsyncIOHandle
+from .aio_config import AioConfig
+
+DEFAULT_CHUNK = 64 << 20  # 64 MiB: big enough to saturate, small enough to ring
+
+
+class NvmeToHbmStreamer:
+    """Pipelined file → device-array reader."""
+
+    def __init__(self, aio_config: Optional[AioConfig] = None,
+                 chunk_bytes: int = DEFAULT_CHUNK, num_buffers: int = 2):
+        cfg = aio_config or AioConfig()
+        self.aio = AsyncIOHandle(block_size=cfg.block_size,
+                                 queue_depth=cfg.queue_depth,
+                                 thread_count=cfg.thread_count)
+        self.chunk_bytes = int(chunk_bytes)
+        # reusable host staging ring (≙ the reference's pinned bounce buffers)
+        self._ring = [np.empty(self.chunk_bytes, np.uint8)
+                      for _ in range(max(2, num_buffers))]
+        # XLA's CPU backend zero-copy-aliases numpy inputs — reusing the ring
+        # would corrupt "device" chunks there; TPU device_put always copies
+        # into HBM, so the ring is safe once the transfer completes
+        self._put_copies = jax.default_backend() == "cpu"
+
+    def read_to_device(self, path: str, nbytes: int, dtype, shape,
+                       sharding=None) -> jax.Array:
+        """Read `nbytes` from `path` into a device array of shape/dtype.
+
+        Chunk i's host→HBM transfer (async XLA dispatch) overlaps chunk
+        i+1's NVMe read (async AIO submit) — neither leg waits for the
+        other's tail.
+        """
+        n_chunks = max(1, (nbytes + self.chunk_bytes - 1) // self.chunk_bytes)
+        device_chunks = []
+        pending: Tuple[int, int, int] = None  # (req_id, ring_slot, size)
+        in_flight = [None] * len(self._ring)  # device chunk using each slot
+
+        def submit(i):
+            off = i * self.chunk_bytes
+            size = min(self.chunk_bytes, nbytes - off)
+            slot = i % len(self._ring)
+            if in_flight[slot] is not None:
+                # the device must be done pulling from this slot before the
+                # AIO pool overwrites it (no extra host copy that way)
+                in_flight[slot].block_until_ready()
+                in_flight[slot] = None
+            rid = self.aio.submit_read(path, self._ring[slot][:size], offset=off)
+            return (rid, slot, size)
+
+        pending = submit(0)
+        for i in range(n_chunks):
+            rid, slot, size = pending
+            self.aio.wait(rid)
+            src = self._ring[slot][:size]
+            dev = jax.device_put(src.copy() if self._put_copies else src)
+            in_flight[slot] = None if self._put_copies else dev
+            device_chunks.append(dev)
+            if i + 1 < n_chunks:
+                pending = submit(i + 1)  # next read flies during the transfer
+        flat = device_chunks[0] if len(device_chunks) == 1 else jnp.concatenate(device_chunks)
+        arr = jax.lax.bitcast_convert_type(
+            flat.reshape(-1, jnp.dtype(dtype).itemsize), dtype).reshape(shape)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        return arr
+
+    def benchmark(self, path: str, nbytes: int, iters: int = 3) -> dict:
+        """Measure pipelined NVMe→HBM GB/s for an existing file; compare
+        against the serial (read-everything-then-put) baseline."""
+        # pipelined
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            arr = self.read_to_device(path, nbytes, jnp.uint8, (nbytes, ))
+            jax.block_until_ready(arr)
+        piped = nbytes * iters / (time.perf_counter() - t0)
+        # serial baseline
+        buf = np.empty(nbytes, np.uint8)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            self.aio.pread(path, buf)
+            arr = jax.device_put(buf)
+            jax.block_until_ready(arr)
+        serial = nbytes * iters / (time.perf_counter() - t0)
+        return {"pipelined_gbps": piped / 1e9, "serial_gbps": serial / 1e9,
+                "speedup": piped / max(serial, 1e-9)}
+
+    def close(self):
+        self.aio.close()
