@@ -32,11 +32,13 @@ class NvmeToHbmStreamer:
     """Pipelined file → device-array reader."""
 
     def __init__(self, aio_config: Optional[AioConfig] = None,
-                 chunk_bytes: int = DEFAULT_CHUNK, num_buffers: int = 2):
+                 chunk_bytes: int = DEFAULT_CHUNK, num_buffers: int = 2,
+                 use_o_direct: bool = False):
         cfg = aio_config or AioConfig()
         self.aio = AsyncIOHandle(block_size=cfg.block_size,
                                  queue_depth=cfg.queue_depth,
-                                 thread_count=cfg.thread_count)
+                                 thread_count=cfg.thread_count,
+                                 use_o_direct=use_o_direct)
         self.chunk_bytes = int(chunk_bytes)
         # reusable host staging ring (≙ the reference's pinned bounce buffers)
         self._ring = [np.empty(self.chunk_bytes, np.uint8)
@@ -74,7 +76,11 @@ class NvmeToHbmStreamer:
         pending = submit(0)
         for i in range(n_chunks):
             rid, slot, size = pending
-            self.aio.wait(rid)
+            got = self.aio.wait(rid)
+            if got != size:
+                raise IOError(f"short read from {path}: chunk {i} wanted {size} "
+                              f"bytes, got {got} — a silently-truncated tensor "
+                              f"would be garbage")
             src = self._ring[slot][:size]
             dev = jax.device_put(src.copy() if self._put_copies else src)
             in_flight[slot] = None if self._put_copies else dev
@@ -87,6 +93,49 @@ class NvmeToHbmStreamer:
         if sharding is not None:
             arr = jax.device_put(arr, sharding)
         return arr
+
+    def read_to_sharded(self, path: str, dtype, shape, sharding) -> jax.Array:
+        """Read a ROW-SHARDED (dim-0) tensor straight into its shards: each
+        device's slice streams from its own byte range and lands on its
+        device — the full array never materializes on one device (the
+        ZeRO-Inference weight-feeding case; the plain path would OOM on
+        tensors bigger than a single chip's HBM). Falls back to
+        read_to_device for other sharding layouts."""
+        itemsize = jnp.dtype(dtype).itemsize
+        row_bytes = int(np.prod(shape[1:])) * itemsize if len(shape) > 1 else itemsize
+        idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+
+        def _row_contiguous(idx):
+            if len(idx) != len(shape):
+                return False
+            for ax, s in enumerate(idx[1:], start=1):
+                if (s.start or 0) != 0 or (s.stop or shape[ax]) != shape[ax]:
+                    return False
+            return True
+
+        if not all(_row_contiguous(ix) for ix in idx_map.values()):
+            nbytes = int(np.prod(shape)) * itemsize
+            return self.read_to_device(path, nbytes, dtype, shape, sharding)
+
+        shards = []
+        for dev, idx in idx_map.items():
+            s0 = idx[0]
+            start, stop = s0.start or 0, s0.stop or shape[0]
+            n = (stop - start) * row_bytes
+            host = np.empty(n, np.uint8)
+            # pipelined chunk reads into the shard's host buffer
+            off = 0
+            while off < n:
+                size = min(self.chunk_bytes, n - off)
+                got = self.aio.pread(path, host[off:off + size],
+                                     offset=start * row_bytes + off)
+                if got != size:
+                    raise IOError(f"short read from {path} at shard offset {off}")
+                off += size
+            shard_shape = (stop - start, *shape[1:])
+            shards.append(jax.device_put(
+                host.view(jnp.dtype(dtype).str).reshape(shard_shape), dev))
+        return jax.make_array_from_single_device_arrays(tuple(shape), sharding, shards)
 
     def benchmark(self, path: str, nbytes: int, iters: int = 3) -> dict:
         """Measure pipelined NVMe→HBM GB/s for an existing file; compare
