@@ -24,7 +24,9 @@ import time
 
 ATTEMPTS = 3
 BACKOFFS = [10, 20]
-ATTEMPT_TIMEOUT = 900  # first TPU compile can take minutes on a cold relay
+# first TPU compile can take minutes on a cold relay, and the OOM-fallback
+# ladder may compile up to three footprints inside ONE child attempt
+ATTEMPT_TIMEOUT = 1800
 
 
 def _measure_config(batch, seq, iters, remat):
