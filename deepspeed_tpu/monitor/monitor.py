@@ -83,6 +83,37 @@ class csvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+class CometMonitor(Monitor):
+    """Comet writer (reference monitor/comet.py); degrades gracefully when
+    comet_ml is not installed or unauthenticated."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        try:
+            import comet_ml
+            kw = {k: getattr(config, k) for k in
+                  ("project", "workspace", "api_key", "experiment_name", "mode",
+                   "online") if getattr(config, k, None) is not None}
+            exp_key = getattr(config, "experiment_key", None)
+            if exp_key:
+                self._exp = comet_ml.ExistingExperiment(previous_experiment=exp_key,
+                                                        **kw)
+            else:
+                self._exp = comet_ml.Experiment(project_name=kw.pop("project", None),
+                                                **{k: v for k, v in kw.items()
+                                                   if k != "mode"})
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"comet monitor disabled: {e}")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._exp.log_metric(name, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all enabled writers (reference monitor.py:30)."""
 
@@ -95,6 +126,8 @@ class MonitorMaster(Monitor):
             self.monitors.append(WandbMonitor(monitor_config.wandb))
         if monitor_config.csv_monitor.enabled:
             self.monitors.append(csvMonitor(monitor_config.csv_monitor))
+        if monitor_config.comet.enabled:
+            self.monitors.append(CometMonitor(monitor_config.comet))
         self.enabled = len(self.monitors) > 0
 
     def write_events(self, event_list):

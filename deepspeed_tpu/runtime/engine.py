@@ -264,7 +264,8 @@ class DeepSpeedTpuEngine:
         self.monitor = None
         if any([self._config.monitor_config.tensorboard.enabled,
                 self._config.monitor_config.wandb.enabled,
-                self._config.monitor_config.csv_monitor.enabled]):
+                self._config.monitor_config.csv_monitor.enabled,
+                self._config.monitor_config.comet.enabled]):
             from ..monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(self._config.monitor_config)
 
