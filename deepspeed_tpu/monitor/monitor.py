@@ -92,17 +92,16 @@ class CometMonitor(Monitor):
         self.enabled = False
         try:
             import comet_ml
+            # comet_ml.start() (the API the reference uses) accepts every
+            # CometConfig field directly — project/workspace/mode/online/
+            # api_key/experiment_key
             kw = {k: getattr(config, k) for k in
-                  ("project", "workspace", "api_key", "experiment_name", "mode",
-                   "online") if getattr(config, k, None) is not None}
-            exp_key = getattr(config, "experiment_key", None)
-            if exp_key:
-                self._exp = comet_ml.ExistingExperiment(previous_experiment=exp_key,
-                                                        **kw)
-            else:
-                self._exp = comet_ml.Experiment(project_name=kw.pop("project", None),
-                                                **{k: v for k, v in kw.items()
-                                                   if k != "mode"})
+                  ("project", "workspace", "api_key", "mode", "online",
+                   "experiment_key") if getattr(config, k, None) is not None}
+            self._exp = comet_ml.start(**kw)
+            name = getattr(config, "experiment_name", None)
+            if name and hasattr(self._exp, "set_name"):
+                self._exp.set_name(name)
             self.enabled = True
         except Exception as e:
             logger.warning(f"comet monitor disabled: {e}")
