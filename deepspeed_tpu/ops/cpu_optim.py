@@ -1,0 +1,113 @@
+"""ctypes binding for the C++ SIMD host optimizers.
+
+Reference: ``op_builder/cpu_adam.py`` + ``csrc/adam/cpu_adam_impl.cpp``
+(AVX Step_AVX), ``csrc/adagrad``, ``csrc/lion`` — here one translation unit
+(``csrc/cpu_optim/cpu_optim.cpp``) auto-vectorized with -O3 -march=native
+-fopenmp, built JIT with the same content-hashed artifact scheme as the AIO
+lib. Falls back to the numpy implementations in ``runtime/host_offload.py``
+when no toolchain is present.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .registry import registry
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "cpu_optim", "cpu_optim.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_lib = None
+_build_failed = False
+_lock = threading.Lock()
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _jit_load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            with open(_SRC, "rb") as f:
+                src_hash = hashlib.sha256(f.read()).hexdigest()[:12]
+            so_path = os.path.join(_BUILD_DIR, f"libds_cpu_optim-{src_hash}.so")
+            if not os.path.exists(so_path):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
+                       "-fPIC", "-std=c++17", _SRC, "-o", so_path]
+                subprocess.run(cmd, check=True, capture_output=True)
+                logger.info(f"built {so_path}")
+                for name in os.listdir(_BUILD_DIR):
+                    full = os.path.join(_BUILD_DIR, name)
+                    if (name.startswith("libds_cpu_optim") and name.endswith(".so")
+                            and full != so_path):
+                        try:
+                            os.remove(full)
+                        except OSError:
+                            pass
+            lib = ctypes.CDLL(so_path)
+            lib.ds_adam_step.argtypes = [_F32P, _F32P, _F32P, _F32P,
+                                         ctypes.c_int64, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_int, ctypes.c_int64]
+            lib.ds_adagrad_step.argtypes = [_F32P, _F32P, _F32P, ctypes.c_int64,
+                                            ctypes.c_float, ctypes.c_float]
+            lib.ds_lion_step.argtypes = [_F32P, _F32P, _F32P, ctypes.c_int64,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_float]
+            _lib = lib
+            registry.register("cpu_optim", "native", True)
+        except (subprocess.CalledProcessError, OSError) as e:
+            logger.warning(f"cpu_optim native build unavailable ({e}); "
+                           "numpy host optimizers will be used")
+            _build_failed = True
+            registry.register("cpu_optim", "fallback", True)
+        return _lib
+
+
+def cpu_optim_available() -> bool:
+    return _jit_load() is not None
+
+
+def _ptr(a: np.ndarray):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(_F32P)
+
+
+def adam_step(p, g, m, v, *, lr, b1, b2, eps, wd, adamw, step) -> bool:
+    """In-place fused AdamW step; returns False if the native lib is absent
+    (caller falls back to numpy)."""
+    lib = _jit_load()
+    if lib is None:
+        return False
+    g = np.ascontiguousarray(g, np.float32)
+    lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                     lr, b1, b2, eps, wd, int(adamw), step)
+    return True
+
+
+def adagrad_step(p, g, accum, *, lr, eps) -> bool:
+    lib = _jit_load()
+    if lib is None:
+        return False
+    g = np.ascontiguousarray(g, np.float32)
+    lib.ds_adagrad_step(_ptr(p), _ptr(g), _ptr(accum), p.size, lr, eps)
+    return True
+
+
+def lion_step(p, g, m, *, lr, b1, b2, wd) -> bool:
+    lib = _jit_load()
+    if lib is None:
+        return False
+    g = np.ascontiguousarray(g, np.float32)
+    lib.ds_lion_step(_ptr(p), _ptr(g), _ptr(m), p.size, lr, b1, b2, wd)
+    return True

@@ -395,8 +395,9 @@ class DeepSpeedTpuEngine:
         from .host_offload import HostAdamOptimizer, flatten_tree
         op = dict(self._config.optimizer_params or {})
         name = (self._config.optimizer_name or "adamw").lower()
-        if name not in ("adam", "adamw"):
-            raise ValueError(f"optimizer offload supports adam/adamw, got {name}")
+        if name not in ("adam", "adamw", "adagrad", "lion"):
+            raise ValueError(
+                f"optimizer offload supports adam/adamw/adagrad/lion, got {name}")
         swapper = None
         if self._offload_device == "nvme":
             from .swap_tensor import PipelinedOptimizerSwapper, AioConfig
@@ -410,13 +411,16 @@ class DeepSpeedTpuEngine:
         host_params = {k: _np.asarray(v, _np.float32)
                        for k, v in flatten_tree(params).items()
                        if subset is None or k in subset}
+        # hyperparameters mirror the DEVICE path (optimizers.py) exactly so
+        # offloaded runs are numerically interchangeable (adagrad has no
+        # weight decay in either path; lion shares the betas default)
         self._host_optimizer = HostAdamOptimizer(
             host_params,
             lr=float(op.get("lr", 1e-3)),
             betas=tuple(op.get("betas", (0.9, 0.999))),
             eps=float(op.get("eps", 1e-8)),
             weight_decay=float(op.get("weight_decay", 0.0)),
-            adamw_mode=(name == "adamw"),
+            mode=name,
             nvme_swapper=swapper,
             lr_fn=(lambda t: self.get_lr()[0]) if self.lr_scheduler is not None else None)
 

@@ -28,22 +28,36 @@ from ..utils.logging import logger
 
 
 class HostAdamOptimizer:
-    """fp32 master weights + moments on host; step() in numpy.
+    """fp32 master weights + optimizer state on host; step() through the C++
+    SIMD kernels (ops/cpu_optim.py ≙ reference csrc/adam/cpu_adam_impl.cpp
+    Step_AVX) with a numpy fallback.
 
-    adam:  torch-style L2 (decay folded into the gradient).
-    adamw: decoupled decay (update includes wd·p scaled by lr) — optax.adamw.
+    mode:
+      adam:    torch-style L2 (decay folded into the gradient).
+      adamw:   decoupled decay (update includes wd·p) — optax.adamw.
+      adagrad: optax.adagrad (scale_by_rss, accumulator init 0.1); state is
+               the squared-grad sum riding the exp_avg_sq slot.
+      lion:    optax.lion (sign of the b1 interpolation, decoupled decay);
+               momentum rides the exp_avg slot, no second state.
     """
+
+    _MODE_STATES = {"adam": ("m", "v"), "adamw": ("m", "v"),
+                    "adagrad": ("v", ), "lion": ("m", )}
 
     def __init__(self, params_host: Dict[str, np.ndarray], lr: float = 1e-3,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
-                 nvme_swapper=None, lr_fn=None, master_swapper=None):
+                 nvme_swapper=None, lr_fn=None, master_swapper=None,
+                 mode: Optional[str] = None,
+                 initial_accumulator_value: float = 0.1):
+        self.mode = mode or ("adamw" if adamw_mode else "adam")
+        assert self.mode in self._MODE_STATES, self.mode
         self.lr = lr
         self.lr_fn = lr_fn
         self.b1, self.b2 = betas
         self.eps = eps
         self.wd = weight_decay
-        self.adamw_mode = adamw_mode
+        self.adamw_mode = self.mode == "adamw"
         self.t = 0
         self._swapper = nvme_swapper
         self._master_swapper = master_swapper
@@ -58,15 +72,28 @@ class HostAdamOptimizer:
             for k, v in params_host.items():
                 master_swapper.swap_out_and_release(k, np.asarray(v, np.float32))
             master_swapper.synchronize_writes()
+        states = self._MODE_STATES[self.mode]
+        v_init = initial_accumulator_value if self.mode == "adagrad" else 0.0
+
+        def _zeros(v, fill):
+            z = np.zeros_like(np.asarray(v), dtype=np.float32)
+            if fill:
+                z += fill
+            return z
+
         if nvme_swapper is None:
-            self.m = {k: np.zeros_like(np.asarray(v)) for k, v in params_host.items()}
-            self.v = {k: np.zeros_like(np.asarray(v)) for k, v in params_host.items()}
+            self.m = ({k: _zeros(v, 0.0) for k, v in params_host.items()}
+                      if "m" in states else None)
+            self.v = ({k: _zeros(v, v_init) for k, v in params_host.items()}
+                      if "v" in states else None)
         else:  # moments live on NVMe between steps
+            if self.mode not in ("adam", "adamw"):
+                raise ValueError("NVMe optimizer-state offload supports "
+                                 "adam/adamw only")
             self.m = self.v = None
             for k, w in params_host.items():
                 nvme_swapper.swap_out_optimizer_state(
-                    k, {"exp_avg": np.zeros_like(np.asarray(w)),
-                        "exp_avg_sq": np.zeros_like(np.asarray(w))})
+                    k, {"exp_avg": _zeros(w, 0.0), "exp_avg_sq": _zeros(w, 0.0)})
 
     @property
     def param_names(self):
@@ -87,19 +114,46 @@ class HostAdamOptimizer:
     def _cur_lr(self) -> float:
         return float(self.lr_fn(self.t)) if self.lr_fn is not None else self.lr
 
-    def _step_one(self, p: np.ndarray, g: np.ndarray, m: np.ndarray, v: np.ndarray):
-        if self.wd and not self.adamw_mode:
-            g = g + self.wd * p  # L2 into the gradient (torch Adam)
-        m *= self.b1
-        m += (1 - self.b1) * g
-        v *= self.b2
-        v += (1 - self.b2) * g * g
-        mhat = m / (1 - self.b1**self.t)
-        vhat = v / (1 - self.b2**self.t)
-        update = mhat / (np.sqrt(vhat) + self.eps)
-        if self.wd and self.adamw_mode:
+    def _step_one(self, p: np.ndarray, g: np.ndarray, m, v):
+        """One leaf's update, in place. Dispatches to the C++ SIMD kernel
+        when the native lib built; numpy otherwise (identical numerics)."""
+        from ..ops import cpu_optim
+        lr = self._cur_lr()
+        if self.mode in ("adam", "adamw"):
+            if cpu_optim.adam_step(p, g, m, v, lr=lr, b1=self.b1, b2=self.b2,
+                                   eps=self.eps, wd=self.wd,
+                                   adamw=self.adamw_mode, step=self.t):
+                return m, v
+            if self.wd and not self.adamw_mode:
+                g = g + self.wd * p  # L2 into the gradient (torch Adam)
+            m *= self.b1
+            m += (1 - self.b1) * g
+            v *= self.b2
+            v += (1 - self.b2) * g * g
+            mhat = m / (1 - self.b1**self.t)
+            vhat = v / (1 - self.b2**self.t)
+            update = mhat / (np.sqrt(vhat) + self.eps)
+            if self.wd and self.adamw_mode:
+                update = update + self.wd * p
+            p -= lr * update
+            return m, v
+        if self.mode == "adagrad":
+            # optax.adagrad takes no weight decay; neither does this path
+            if cpu_optim.adagrad_step(p, g, v, lr=lr, eps=self.eps):
+                return m, v
+            v += g * g
+            p -= lr * g / (np.sqrt(v) + self.eps)
+            return m, v
+        # lion (optax.lion semantics)
+        if cpu_optim.lion_step(p, g, m, lr=lr, b1=self.b1, b2=self.b2, wd=self.wd):
+            return m, v
+        c = self.b1 * m + (1 - self.b1) * g
+        update = np.sign(c)
+        if self.wd:
             update = update + self.wd * p
-        p -= self._cur_lr() * update
+        p -= lr * update
+        m *= self.b2
+        m += (1 - self.b2) * g
         return m, v
 
     # -- streaming per-param API: lets the engine interleave host math with
@@ -117,7 +171,9 @@ class HostAdamOptimizer:
             self.prefetch_master([prefetch])
         p = self.read_master(name)
         if self._swapper is None:
-            self._step_one(p, g, self.m[name], self.v[name])
+            self._step_one(p, g,
+                           self.m[name] if self.m is not None else None,
+                           self.v[name] if self.v is not None else None)
         else:
             sw = self._swapper._swapper
             sw.swap_in([f"{name}.exp_avg", f"{name}.exp_avg_sq"], async_op=True)
@@ -159,7 +215,10 @@ class HostAdamOptimizer:
         sd["master"] = ({k: self.read_master(k) for k in self.param_names}
                         if self._master_swapper is not None else self.master)
         if self._swapper is None:
-            sd["m"], sd["v"] = self.m, self.v
+            if self.m is not None:
+                sd["m"] = self.m
+            if self.v is not None:
+                sd["v"] = self.v
         else:
             sw = self._swapper._swapper
             m, v = {}, {}
@@ -192,14 +251,17 @@ class HostAdamOptimizer:
             base = os.path.join(path, self._safe(k))
             np.save(base + ".master.npy", self.read_master(k))
             if self._swapper is None:
-                m, v = self.m[k], self.v[k]
+                m = self.m[k] if self.m is not None else None
+                v = self.v[k] if self.v is not None else None
             else:
                 sw = self._swapper._swapper
                 sw.swap_in([f"{k}.exp_avg", f"{k}.exp_avg_sq"], async_op=False)
                 m = sw.retrieve(f"{k}.exp_avg")
                 v = sw.retrieve(f"{k}.exp_avg_sq")
-            np.save(base + ".m.npy", m)
-            np.save(base + ".v.npy", v)
+            if m is not None:
+                np.save(base + ".m.npy", m)
+            if v is not None:
+                np.save(base + ".v.npy", v)
 
     def load_state_files(self, path: str) -> None:
         import json
@@ -207,6 +269,7 @@ class HostAdamOptimizer:
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         self.t = meta["t"]
+        needed = self._MODE_STATES[self.mode]
         for k in meta["names"]:
             base = os.path.join(path, self._safe(k))
             master = np.load(base + ".master.npy")
@@ -214,10 +277,24 @@ class HostAdamOptimizer:
                 self.master[k] = master
             else:
                 self._master_swapper.swap_out_and_release(k, master)
-            m, v = np.load(base + ".m.npy"), np.load(base + ".v.npy")
+
+            def _load_state(tag):
+                fn = base + f".{tag}.npy"
+                if not os.path.exists(fn):
+                    # missing state for this mode = a silently-reset optimizer
+                    raise FileNotFoundError(
+                        f"checkpoint is missing {fn} (mode={self.mode} needs "
+                        f"'{tag}'); refusing to resume with reset moments")
+                return np.load(fn)
+
+            m = _load_state("m") if "m" in needed else None
+            v = _load_state("v") if "v" in needed else None
             if self._swapper is None:
-                self.m[k], self.v[k] = m, v
-            else:
+                if m is not None:
+                    self.m[k] = m
+                if v is not None:
+                    self.v[k] = v
+            else:  # NVMe moments: adam/adamw only (both states present)
                 self._swapper.swap_out_optimizer_state(
                     k, {"exp_avg": m, "exp_avg_sq": v})
         if self._master_swapper is not None:
@@ -234,9 +311,16 @@ class HostAdamOptimizer:
             for k, v in masters.items():
                 self._master_swapper.swap_out_and_release(k, v)
             self._master_swapper.synchronize_writes()
+        needed = self._MODE_STATES[self.mode]
+        missing = [t for t in needed if t not in sd]
+        if missing:
+            raise KeyError(f"host optimizer state_dict missing {missing} "
+                           f"(mode={self.mode}); refusing a silent reset")
         if self._swapper is None:
             if "m" in sd:
-                self.m, self.v = sd["m"], sd["v"]
+                self.m = sd["m"]
+            if "v" in sd:
+                self.v = sd["v"]
         elif "m" in sd:
             for k in sd["m"]:
                 self._swapper.swap_out_optimizer_state(
