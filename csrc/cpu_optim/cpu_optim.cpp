@@ -48,7 +48,7 @@ void ds_adagrad_step(float* p, const float* g, float* accum, int64_t n,
         float grad = g[i];
         float a = accum[i] + grad * grad;
         accum[i] = a;
-        p[i] -= lr * grad / (std::sqrt(a) + eps);
+        p[i] -= lr * grad / std::sqrt(a + eps);
     }
 }
 

@@ -10,7 +10,6 @@ available, mirroring the reference's compatibility-probe behavior
 """
 
 import ctypes
-import hashlib
 import os
 import subprocess
 import threading
@@ -20,12 +19,11 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..utils.logging import logger
+from .jit_build import jit_build
 from .registry import registry
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "csrc", "aio", "ds_aio.cpp")
-_BUILD_DIR = os.environ.get("DS_TPU_BUILD_DIR",
-                            os.path.join(_REPO_ROOT, "build", "lib"))
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -35,30 +33,13 @@ _build_failed = False
 def _jit_load() -> Optional[ctypes.CDLL]:
     """Compile-if-stale then dlopen (reference builder.py:535 jit_load)."""
     global _lib, _build_failed
+    if _lib is not None or _build_failed:  # lock-free fast path for hot callers
+        return _lib
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        # content-hash the source into the artifact name: a stale or foreign
-        # binary can never shadow the code actually in csrc/ (mtime gating is
-        # timestamp-dependent after a fresh clone)
-        with open(_SRC, "rb") as f:
-            src_hash = hashlib.sha256(f.read()).hexdigest()[:12]
-        so_path = os.path.join(_BUILD_DIR, f"libds_aio-{src_hash}.so")
         try:
-            if not os.path.exists(so_path):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                       _SRC, "-o", so_path]
-                subprocess.run(cmd, check=True, capture_output=True)
-                logger.info(f"built {so_path}")
-                # purge artifacts from older source revisions
-                for name in os.listdir(_BUILD_DIR):
-                    if (name.startswith("libds_aio") and name.endswith(".so")
-                            and os.path.join(_BUILD_DIR, name) != so_path):
-                        try:
-                            os.remove(os.path.join(_BUILD_DIR, name))
-                        except OSError:
-                            pass
+            so_path = jit_build(_SRC, "libds_aio", ["-pthread"])
             lib = ctypes.CDLL(so_path)
             lib.ds_aio_handle_new.restype = ctypes.c_void_p
             lib.ds_aio_handle_new.argtypes = [ctypes.c_int, ctypes.c_long, ctypes.c_int]

@@ -9,7 +9,6 @@ when no toolchain is present.
 """
 
 import ctypes
-import hashlib
 import os
 import subprocess
 import threading
@@ -18,11 +17,11 @@ from typing import Optional
 import numpy as np
 
 from ..utils.logging import logger
+from .jit_build import jit_build
 from .registry import registry
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc", "cpu_optim", "cpu_optim.cpp")
-_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
 _lib = None
 _build_failed = False
 _lock = threading.Lock()
@@ -32,27 +31,13 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 def _jit_load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
+    if _lib is not None or _build_failed:  # lock-free fast path: called per leaf per step
+        return _lib
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            with open(_SRC, "rb") as f:
-                src_hash = hashlib.sha256(f.read()).hexdigest()[:12]
-            so_path = os.path.join(_BUILD_DIR, f"libds_cpu_optim-{src_hash}.so")
-            if not os.path.exists(so_path):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
-                       "-fPIC", "-std=c++17", _SRC, "-o", so_path]
-                subprocess.run(cmd, check=True, capture_output=True)
-                logger.info(f"built {so_path}")
-                for name in os.listdir(_BUILD_DIR):
-                    full = os.path.join(_BUILD_DIR, name)
-                    if (name.startswith("libds_cpu_optim") and name.endswith(".so")
-                            and full != so_path):
-                        try:
-                            os.remove(full)
-                        except OSError:
-                            pass
+            so_path = jit_build(_SRC, "libds_cpu_optim", ["-march=native", "-fopenmp"])
             lib = ctypes.CDLL(so_path)
             lib.ds_adam_step.argtypes = [_F32P, _F32P, _F32P, _F32P,
                                          ctypes.c_int64, ctypes.c_float,
@@ -79,7 +64,11 @@ def cpu_optim_available() -> bool:
 
 
 def _ptr(a: np.ndarray):
-    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    # hard error, not assert: a wrong-dtype buffer reinterpreted by the C
+    # kernel silently corrupts parameters (and -O strips asserts)
+    if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"]:
+        raise ValueError(f"expected C-contiguous float32 array, got dtype={a.dtype} "
+                         f"contiguous={a.flags['C_CONTIGUOUS']}")
     return a.ctypes.data_as(_F32P)
 
 

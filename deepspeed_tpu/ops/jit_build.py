@@ -1,0 +1,49 @@
+"""Shared JIT build scheme for the C++ host libraries.
+
+Reference: ``op_builder/builder.py:535 jit_load`` — compile-on-first-use with
+a cached artifact. Here the artifact name embeds a content hash of the source
+(mtime gating is timestamp-dependent after a fresh clone), the compile goes
+through a temp file + ``os.replace`` so an interrupted or concurrent build
+can never leave a corrupt .so at the final path, and artifacts from older
+source revisions are purged.
+"""
+
+import hashlib
+import os
+import subprocess
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+
+def jit_build(src: str, libname: str, extra_flags: Optional[List[str]] = None) -> str:
+    """Compile ``src`` into ``<srcdir>/build/<libname>-<hash>.so`` if absent;
+    returns the .so path. Raises CalledProcessError/OSError on failure."""
+    build_dir = os.environ.get("DS_TPU_BUILD_DIR",
+                               os.path.join(os.path.dirname(src), "build"))
+    with open(src, "rb") as f:
+        src_hash = hashlib.sha256(f.read()).hexdigest()[:12]
+    so_path = os.path.join(build_dir, f"{libname}-{src_hash}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               *(extra_flags or []), src, "-o", tmp_path]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp_path, so_path)  # atomic: losers overwrite with identical bits
+        finally:
+            if os.path.exists(tmp_path):
+                try:
+                    os.remove(tmp_path)
+                except OSError:
+                    pass
+        logger.info(f"built {so_path}")
+        for name in os.listdir(build_dir):
+            full = os.path.join(build_dir, name)
+            if (name.startswith(libname) and name.endswith(".so") and full != so_path):
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+    return so_path

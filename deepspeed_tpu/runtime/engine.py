@@ -413,11 +413,13 @@ class DeepSpeedTpuEngine:
                        if subset is None or k in subset}
         # hyperparameters mirror the DEVICE path (optimizers.py) exactly so
         # offloaded runs are numerically interchangeable (adagrad has no
-        # weight decay in either path; lion shares the betas default)
+        # weight decay in either path; lion's conventional b2 default is 0.99)
+        from .optimizers import ADAM_DEFAULT_BETAS, LION_DEFAULT_BETAS
+        default_betas = LION_DEFAULT_BETAS if name == "lion" else ADAM_DEFAULT_BETAS
         self._host_optimizer = HostAdamOptimizer(
             host_params,
             lr=float(op.get("lr", 1e-3)),
-            betas=tuple(op.get("betas", (0.9, 0.999))),
+            betas=tuple(op.get("betas", default_betas)),
             eps=float(op.get("eps", 1e-8)),
             weight_decay=float(op.get("weight_decay", 0.0)),
             mode=name,

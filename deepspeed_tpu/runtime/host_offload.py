@@ -142,7 +142,7 @@ class HostAdamOptimizer:
             if cpu_optim.adagrad_step(p, g, v, lr=lr, eps=self.eps):
                 return m, v
             v += g * g
-            p -= lr * g / (np.sqrt(v) + self.eps)
+            p -= lr * g / np.sqrt(v + self.eps)
             return m, v
         # lion (optax.lion semantics)
         if cpu_optim.lion_step(p, g, m, lr=lr, b1=self.b1, b2=self.b2, wd=self.wd):

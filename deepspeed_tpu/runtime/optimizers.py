@@ -20,6 +20,11 @@ from ..config.config import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER,
                              SGD_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER)
 from ..utils.logging import logger
 
+# shared by the host-offload path (engine._build_host_optimizer) so device and
+# host lion defaults can never drift
+ADAM_DEFAULT_BETAS = (0.9, 0.999)
+LION_DEFAULT_BETAS = (0.9, 0.99)
+
 
 def _pop(params: Dict[str, Any], *names, default=None):
     for n in names:
@@ -39,7 +44,9 @@ def build_optimizer(name: Optional[str],
     params = dict(params or {})
     name = (name or ADAMW_OPTIMIZER).lower()
     lr = float(_pop(params, "lr", default=1e-3))
-    betas = _pop(params, "betas", default=(0.9, 0.999))
+    # None sentinel: lion's conventional default b2 differs (0.99, optax.lion)
+    user_betas = _pop(params, "betas", default=None)
+    betas = user_betas if user_betas is not None else ADAM_DEFAULT_BETAS
     eps = float(_pop(params, "eps", default=1e-8))
     weight_decay = float(_pop(params, "weight_decay", default=0.0))
     learning_rate = lr_fn if lr_fn is not None else lr
@@ -56,7 +63,7 @@ def build_optimizer(name: Optional[str],
     elif name == LAMB_OPTIMIZER:
         tx = optax.lamb(learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
     elif name == LION_OPTIMIZER:
-        b1, b2 = (betas[0], betas[1]) if betas else (0.9, 0.99)
+        b1, b2 = user_betas if user_betas is not None else LION_DEFAULT_BETAS
         tx = optax.lion(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
     elif name == SGD_OPTIMIZER:
         momentum = float(_pop(params, "momentum", default=0.0))

@@ -167,6 +167,56 @@ def test_fused_adam_step(interp):
     np.testing.assert_allclose(np.asarray(v1), np.asarray(vn), atol=1e-6)
 
 
+@pytest.mark.parametrize("interp", [True, False])
+def test_fused_lion_step(interp):
+    import optax
+    from deepspeed_tpu.ops import fused_lion_step
+    n = 5000
+    p = jax.random.normal(jax.random.PRNGKey(11), (n, ))
+    g = jax.random.normal(jax.random.PRNGKey(12), (n, ))
+    m = 0.3 * jax.random.normal(jax.random.PRNGKey(13), (n, ))
+    p1, m1 = fused_lion_step(p, g, m, lr=1e-2, weight_decay=0.05,
+                             interpret=interp, force_pallas=interp)
+    tx = optax.lion(1e-2, b1=0.9, b2=0.99, weight_decay=0.05)
+    state = tx.init(p)
+    state = (state[0]._replace(mu=m), ) + tuple(state[1:])
+    upd, _ = tx.update(g, state, p)
+    pref = optax.apply_updates(p, upd)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(0.99 * m + 0.01 * g), atol=1e-6)
+
+
+@pytest.mark.parametrize("interp", [True, False])
+def test_fused_lamb_step_trust_ratio(interp):
+    from deepspeed_tpu.ops import fused_lamb_step
+    n1, n2 = 3000, 2096
+    n = n1 + n2
+    p = jax.random.normal(jax.random.PRNGKey(14), (n, ))
+    g = jax.random.normal(jax.random.PRNGKey(15), (n, ))
+    m = jnp.zeros((n, ))
+    v = jnp.zeros((n, ))
+    p1, m1, v1 = fused_lamb_step(p, g, m, v, lr=1e-2, step=1, weight_decay=0.01,
+                                 segments=(0, n1, n), interpret=interp,
+                                 force_pallas=interp)
+    # per-segment oracle: adam update with bias correction, trust-scaled
+    mn = 0.1 * g
+    vn = 0.001 * g * g
+    u = (mn / 0.1) / (jnp.sqrt(vn / 0.001) + 1e-6) + 0.01 * p
+    outs = []
+    for lo, hi in ((0, n1), (n1, n)):
+        ps, us = p[lo:hi], u[lo:hi]
+        trust = jnp.linalg.norm(ps) / jnp.linalg.norm(us)
+        outs.append(ps - 1e-2 * trust * us)
+    pref = jnp.concatenate(outs)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(mn), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(vn), atol=1e-6)
+    # whole-buffer trust differs from per-segment: segments must matter
+    pw, _, _ = fused_lamb_step(p, g, m, v, lr=1e-2, step=1, weight_decay=0.01,
+                               interpret=interp, force_pallas=interp)
+    assert not np.allclose(np.asarray(pw), np.asarray(p1))
+
+
 def test_op_report():
     rep = op_report()
     assert "flash_attention" in rep
