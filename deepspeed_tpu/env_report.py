@@ -33,7 +33,8 @@ def op_report():
     # probe ops so their registration side effects run
     from .ops import aio as _aio  # noqa: F401
     _aio.aio_available()
-    for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope"):
+    for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope",
+                "evoformer_attn"):
         try:
             importlib.import_module(f".ops.{mod}", package=__package__)
         except ImportError:

@@ -13,10 +13,12 @@ from .normalization import rms_norm, layer_norm
 from .rope import apply_rotary_pos_emb
 from .quantizer import quantize_int8_blockwise, dequantize_int8_blockwise
 from .fused_optimizer import fused_adam_step, fused_lamb_step, fused_lion_step
+from .evoformer_attn import DS4Sci_EvoformerAttention, evoformer_attention
 
 __all__ = [
     "OpRegistry", "registry", "compatible_ops", "op_report",
     "flash_attention", "rms_norm", "layer_norm", "apply_rotary_pos_emb",
     "quantize_int8_blockwise", "dequantize_int8_blockwise", "fused_adam_step",
-    "fused_lion_step", "fused_lamb_step",
+    "fused_lion_step", "fused_lamb_step", "evoformer_attention",
+    "DS4Sci_EvoformerAttention",
 ]

@@ -1,0 +1,46 @@
+"""Spatial (diffusion) ops — NHWC bias-add family.
+
+Reference: ``csrc/spatial/csrc/pt_binding.cpp:109-111`` exposes
+``nhwc_bias_add`` / ``nhwc_bias_add_add`` / ``nhwc_bias_add_bias_add`` as
+hand-vectorized CUDA kernels for diffusers UNet inference (the win there is
+fusing the bias broadcast into one memory pass). Under XLA these are single
+fused elementwise HLOs already — the functions exist for API parity and to
+pin the channels-last (NHWC) broadcast semantics the reference kernels
+implement (bias is per-channel, length C, added along the last axis).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import registry
+
+
+def _check_bias(x: jax.Array, bias: jax.Array) -> None:
+    if bias.ndim != 1 or bias.shape[0] != x.shape[-1]:
+        raise ValueError(f"bias must be [C={x.shape[-1]}] for NHWC input, "
+                         f"got {bias.shape}")
+
+
+def nhwc_bias_add(activation: jax.Array, bias: jax.Array) -> jax.Array:
+    """activation [N, H, W, C] (or any [..., C]) + per-channel bias [C]."""
+    _check_bias(activation, bias)
+    return activation + bias.astype(activation.dtype)
+
+
+def nhwc_bias_add_add(activation: jax.Array, bias: jax.Array,
+                      other: jax.Array) -> jax.Array:
+    """(activation + bias) + other — residual add fused with the bias pass."""
+    _check_bias(activation, bias)
+    return activation + bias.astype(activation.dtype) + other
+
+
+def nhwc_bias_add_bias_add(activation: jax.Array, bias: jax.Array,
+                           other: jax.Array, other_bias: jax.Array) -> jax.Array:
+    """(activation + bias) + (other + other_bias) — two biased streams summed."""
+    _check_bias(activation, bias)
+    _check_bias(other, other_bias)
+    return (activation + bias.astype(activation.dtype)
+            + other + other_bias.astype(other.dtype))
+
+
+registry.register("spatial", "xla", True)

@@ -221,3 +221,21 @@ def test_op_report():
     rep = op_report()
     assert "flash_attention" in rep
     assert "quantizer_int8" in rep
+
+
+def test_spatial_nhwc_bias_add_family():
+    from deepspeed_tpu.ops.spatial import (nhwc_bias_add, nhwc_bias_add_add,
+                                           nhwc_bias_add_bias_add)
+    x = jax.random.normal(jax.random.PRNGKey(20), (2, 4, 4, 8), jnp.bfloat16)
+    y = jax.random.normal(jax.random.PRNGKey(21), (2, 4, 4, 8), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(22), (8, ))
+    b2 = jax.random.normal(jax.random.PRNGKey(23), (8, ))
+    np.testing.assert_allclose(np.asarray(nhwc_bias_add(x, b), np.float32),
+                               np.asarray(x + b.astype(jnp.bfloat16), np.float32))
+    np.testing.assert_allclose(np.asarray(nhwc_bias_add_add(x, b, y), np.float32),
+                               np.asarray(x + b.astype(jnp.bfloat16) + y, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(nhwc_bias_add_bias_add(x, b, y, b2), np.float32),
+        np.asarray(x + b.astype(jnp.bfloat16) + y + b2.astype(jnp.bfloat16), np.float32))
+    with pytest.raises(ValueError):
+        nhwc_bias_add(x, jnp.zeros((4, )))
