@@ -247,8 +247,11 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
         if cfg.parallel_residual:
-            # Falcon/Phi: attention and MLP both read the SAME normed input
-            x = x + attn_out + _mlp_tok(h, lp, cfg)
+            # Falcon/Phi: attention and MLP both read the SAME normed input;
+            # GPT-NeoX (parallel_residual_norms=2): MLP norms x independently
+            h_mlp = (_norm_tok(x, lp["post_attention_layernorm"], cfg)
+                     if cfg.parallel_residual_norms == 2 else h)
+            x = x + attn_out + _mlp_tok(h_mlp, lp, cfg)
             continue
         x = x + attn_out
         h2 = _norm_tok(x, lp["post_attention_layernorm"], cfg)

@@ -60,6 +60,9 @@ class LlamaConfig:
     mlp_type: str = "swiglu"
     mlp_bias: bool = False            # fc1/fc2 biases (OPT/Phi)
     parallel_residual: bool = False   # Falcon/Phi: x + attn(ln(x)) + mlp(ln(x))
+    # GPT-NeoX: the parallel MLP branch reads its OWN norm of x
+    # (x + attn(ln1(x)) + mlp(ln2(x))); 1 = Falcon/Phi shared-norm form
+    parallel_residual_norms: int = 1
     lm_head_bias: bool = False        # Phi
     num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
     num_experts_per_tok: int = 2
@@ -295,7 +298,10 @@ class LlamaDecoderLayer(nn.Module):
         attn_out = LlamaAttention(cfg, name="self_attn")(normed, cos, sin, positions,
                                                          attn_mask)
         if cfg.parallel_residual:
-            # Falcon/Phi: one shared input norm feeds BOTH branches
+            # Falcon/Phi: one shared input norm feeds BOTH branches;
+            # GPT-NeoX (norms=2): the MLP branch norms x independently
+            if cfg.parallel_residual_norms == 2:
+                normed = _make_norm(cfg, "post_attention_layernorm")(x)
             return x + attn_out + LlamaMLP(cfg, name="mlp")(normed)
         h = x + attn_out
         normed2 = _make_norm(cfg, "post_attention_layernorm")(h)

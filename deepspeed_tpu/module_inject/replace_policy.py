@@ -370,6 +370,295 @@ class FalconPolicy(HFCheckpointPolicy):
         return out
 
 
+class GPT2Policy(HFCheckpointPolicy):
+    """GPT-2 (reference ``module_inject/containers/gpt2.py``): learned
+    positions (no offset), pre-LN LayerNorm, gelu_new fc MLP, biases
+    everywhere, fused Conv1D ``c_attn`` qkv. HF Conv1D stores weights
+    ``[in, out]`` — already the flax kernel layout, so nothing transposes."""
+    arch = "gpt2"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        h = hf_config["n_embd"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_hidden_layers=hf_config["n_layer"],
+            num_attention_heads=hf_config["n_head"],
+            num_key_value_heads=hf_config["n_head"],
+            max_position_embeddings=hf_config.get("n_positions", 1024),
+            rms_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,
+            attention_bias=True,
+            attention_out_bias=True,
+            norm_type="layernorm",
+            pos_embedding="learned",
+            mlp_type="gelu_tanh_fc",  # HF activation_function "gelu_new"
+            mlp_bias=True,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"transformer.h.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "ln_1.weight": (f + "input_layernorm/scale", False),
+            p + "ln_1.bias": (f + "input_layernorm/bias", False),
+            p + "ln_2.weight": (f + "post_attention_layernorm/scale", False),
+            p + "ln_2.bias": (f + "post_attention_layernorm/bias", False),
+            p + "attn.c_proj.weight": (f + "self_attn/o_proj/kernel", False),
+            p + "attn.c_proj.bias": (f + "self_attn/o_proj/bias", False),
+            p + "mlp.c_fc.weight": (f + "mlp/fc1/kernel", False),
+            p + "mlp.c_fc.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.c_proj.weight": (f + "mlp/fc2/kernel", False),
+            p + "mlp.c_proj.bias": (f + "mlp/fc2/bias", False),
+        }
+
+    def special_hf_names(self, layer: int):
+        p = f"transformer.h.{layer}.attn.c_attn."
+        return [p + "weight", p + "bias"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        """Split fused c_attn: Conv1D weight [h, 3h] columns are [q | k | v]."""
+        p = f"transformer.h.{layer}.attn.c_attn."
+        w = get_tensor(p + "weight")  # [h, 3h], already [in, out]
+        b = get_tensor(p + "bias")    # [3h]
+        h = cfg.hidden_size
+        f = f"layers_{layer}/self_attn/"
+        for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            put(f + f"{proj}/kernel", w[:, i * h:(i + 1) * h])
+            put(f + f"{proj}/bias", b[i * h:(i + 1) * h])
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        f = f"layers_{layer}/self_attn/"
+        p = f"transformer.h.{layer}.attn.c_attn."
+        return {
+            p + "weight": np.concatenate(
+                [flat[f + f"{x}/kernel"] for x in ("q_proj", "k_proj", "v_proj")], axis=1),
+            p + "bias": np.concatenate(
+                [flat[f + f"{x}/bias"] for x in ("q_proj", "k_proj", "v_proj")]),
+        }
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "transformer.wte.weight": ("embed_tokens/embedding", False),
+            "transformer.wpe.weight": ("embed_positions/embedding", False),
+            "transformer.ln_f.weight": ("norm/scale", False),
+            "transformer.ln_f.bias": ("norm/bias", False),
+        }
+
+
+class GPTNeoXPolicy(HFCheckpointPolicy):
+    """GPT-NeoX / Pythia (reference ``module_inject/containers/gptneox.py``):
+    partial rotary (rotary_pct), two-norm parallel residual
+    (x + attn(ln1 x) + mlp(ln2 x)), per-head-interleaved fused
+    query_key_value, biases everywhere, untied embed_out."""
+    arch = "gptneox"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        h = hf_config["hidden_size"]
+        nq = hf_config["num_attention_heads"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("intermediate_size", 4 * h),
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=nq,
+            num_key_value_heads=nq,
+            max_position_embeddings=hf_config.get("max_position_embeddings", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            rope_theta=hf_config.get("rotary_emb_base", 10000.0),
+            rotary_dim=int(hf_config.get("rotary_pct", 0.25) * (h // nq)),
+            tie_word_embeddings=False,
+            attention_bias=True,
+            attention_out_bias=True,
+            norm_type="layernorm",
+            mlp_type="gelu_fc",  # HF hidden_act "gelu" (erf)
+            mlp_bias=True,
+            parallel_residual=hf_config.get("use_parallel_residual", True),
+            parallel_residual_norms=2,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"gpt_neox.layers.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/scale",
+                                                    False),
+            p + "post_attention_layernorm.bias": (f + "post_attention_layernorm/bias",
+                                                  False),
+            p + "attention.dense.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "attention.dense.bias": (f + "self_attn/o_proj/bias", False),
+            p + "mlp.dense_h_to_4h.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.dense_h_to_4h.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.dense_4h_to_h.weight": (f + "mlp/fc2/kernel", True),
+            p + "mlp.dense_4h_to_h.bias": (f + "mlp/fc2/bias", False),
+        }
+
+    def special_hf_names(self, layer: int):
+        p = f"gpt_neox.layers.{layer}.attention.query_key_value."
+        return [p + "weight", p + "bias"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        """Un-interleave fused qkv: rows are grouped PER HEAD as
+        [q_i | k_i | v_i] (hd each), unlike falcon's [all q | k | v]."""
+        p = f"gpt_neox.layers.{layer}.attention.query_key_value."
+        hd = cfg.head_dim_
+        nq = cfg.num_attention_heads
+        w = get_tensor(p + "weight").reshape(nq, 3, hd, cfg.hidden_size)
+        b = get_tensor(p + "bias").reshape(nq, 3, hd)
+        f = f"layers_{layer}/self_attn/"
+        for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            put(f + f"{proj}/kernel", w[:, i].reshape(nq * hd, cfg.hidden_size).T)
+            put(f + f"{proj}/bias", b[:, i].reshape(nq * hd))
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        hd = cfg.head_dim_
+        nq = cfg.num_attention_heads
+        f = f"layers_{layer}/self_attn/"
+        w = np.stack([flat[f + f"{x}/kernel"].T.reshape(nq, hd, cfg.hidden_size)
+                      for x in ("q_proj", "k_proj", "v_proj")], axis=1)
+        b = np.stack([flat[f + f"{x}/bias"].reshape(nq, hd)
+                      for x in ("q_proj", "k_proj", "v_proj")], axis=1)
+        p = f"gpt_neox.layers.{layer}.attention.query_key_value."
+        return {p + "weight": w.reshape(3 * nq * hd, cfg.hidden_size),
+                p + "bias": b.reshape(3 * nq * hd)}
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "gpt_neox.embed_in.weight": ("embed_tokens/embedding", False),
+            "gpt_neox.final_layer_norm.weight": ("norm/scale", False),
+            "gpt_neox.final_layer_norm.bias": ("norm/bias", False),
+            "embed_out.weight": ("lm_head/kernel", True),
+        }
+
+
+class InternLMPolicy(HFCheckpointPolicy):
+    """InternLM-7B (reference ``module_inject/containers/internlm.py``):
+    llama graph plus biases on all four attention projections."""
+    arch = "internlm"
+
+    def config_from_hf(self, hf_config):
+        cfg = super().config_from_hf(hf_config)
+        import dataclasses
+        bias = hf_config.get("bias", True)
+        return dataclasses.replace(cfg, attention_bias=bias, attention_out_bias=bias)
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        if attention_bias:
+            p = f"model.layers.{layer}."
+            f = f"layers_{layer}/"
+            out[p + "self_attn.o_proj.bias"] = (f + "self_attn/o_proj/bias", False)
+        return out
+
+
+class Phi3Policy(HFCheckpointPolicy):
+    """Phi-3 (reference ``inference/v2/model_implementations/phi3``): llama
+    graph (rmsnorm, swiglu, untied head) with FUSED qkv_proj and
+    gate_up_proj tensors."""
+    arch = "phi3"
+
+    def config_from_hf(self, hf_config):
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config.get("num_key_value_heads",
+                                              hf_config["num_attention_heads"]),
+            max_position_embeddings=hf_config.get("max_position_embeddings", 4096),
+            rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "self_attn.o_proj.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "mlp.down_proj.weight": (f + "mlp/down_proj/kernel", True),
+            p + "input_layernorm.weight": (f + "input_layernorm/weight", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/weight",
+                                                    False),
+        }
+
+    def special_hf_names(self, layer: int):
+        p = f"model.layers.{layer}."
+        return [p + "self_attn.qkv_proj.weight", p + "mlp.gate_up_proj.weight"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        """qkv_proj rows are [all q | all k | all v]; gate_up_proj rows are
+        [gate | up]."""
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        hd = cfg.head_dim_
+        nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        w = get_tensor(p + "self_attn.qkv_proj.weight")
+        put(f + "self_attn/q_proj/kernel", w[:nq * hd].T)
+        put(f + "self_attn/k_proj/kernel", w[nq * hd:(nq + nkv) * hd].T)
+        put(f + "self_attn/v_proj/kernel", w[(nq + nkv) * hd:].T)
+        gu = get_tensor(p + "mlp.gate_up_proj.weight")
+        put(f + "mlp/gate_proj/kernel", gu[:cfg.intermediate_size].T)
+        put(f + "mlp/up_proj/kernel", gu[cfg.intermediate_size:].T)
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "self_attn.qkv_proj.weight": np.concatenate(
+                [flat[f + f"self_attn/{x}/kernel"].T for x in ("q_proj", "k_proj", "v_proj")],
+                axis=0),
+            p + "mlp.gate_up_proj.weight": np.concatenate(
+                [flat[f + "mlp/gate_proj/kernel"].T, flat[f + "mlp/up_proj/kernel"].T],
+                axis=0),
+        }
+
+
+class BaichuanPolicy(HFCheckpointPolicy):
+    """Baichuan-7B: llama graph with a fused W_pack qkv tensor (rows
+    [q | k | v]). The 13B variant uses ALiBi positions — not supported."""
+    arch = "baichuan"
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("position_embedding", "rope").lower() == "alibi" or \
+                hf_config.get("hidden_size", 0) >= 5120:
+            raise ValueError("baichuan-13B (ALiBi positions) is not supported; "
+                             "7B (rope) only")
+        return super().config_from_hf(hf_config)
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        p = f"model.layers.{layer}."
+        # qkv arrive fused as W_pack (convert_special)
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            out.pop(p + f"self_attn.{proj}.weight", None)
+        return out
+
+    def special_hf_names(self, layer: int):
+        return [f"model.layers.{layer}.self_attn.W_pack.weight"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        w = get_tensor(f"model.layers.{layer}.self_attn.W_pack.weight")
+        h = cfg.hidden_size
+        f = f"layers_{layer}/self_attn/"
+        put(f + "q_proj/kernel", w[:h].T)
+        put(f + "k_proj/kernel", w[h:2 * h].T)
+        put(f + "v_proj/kernel", w[2 * h:].T)
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        f = f"layers_{layer}/self_attn/"
+        return {f"model.layers.{layer}.self_attn.W_pack.weight": np.concatenate(
+            [flat[f + f"{x}/kernel"].T for x in ("q_proj", "k_proj", "v_proj")], axis=0)}
+
+
 _POLICIES = {
     "llama": LlamaPolicy,
     "LlamaForCausalLM": LlamaPolicy,
@@ -387,6 +676,17 @@ _POLICIES = {
     "PhiForCausalLM": PhiPolicy,
     "falcon": FalconPolicy,
     "FalconForCausalLM": FalconPolicy,
+    "gpt2": GPT2Policy,
+    "GPT2LMHeadModel": GPT2Policy,
+    "gptneox": GPTNeoXPolicy,
+    "gpt_neox": GPTNeoXPolicy,
+    "GPTNeoXForCausalLM": GPTNeoXPolicy,
+    "internlm": InternLMPolicy,
+    "InternLMForCausalLM": InternLMPolicy,
+    "phi3": Phi3Policy,
+    "Phi3ForCausalLM": Phi3Policy,
+    "baichuan": BaichuanPolicy,
+    "BaichuanForCausalLM": BaichuanPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -339,3 +339,134 @@ class TestStreamingSafetensors:
                 jax.tree_util.tree_leaves_with_path(params_d)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0,
                                        err_msg=str(p1))
+
+
+def _logits_match(arch, hf_model, hf_cfg_dict, ids=None, atol=2e-3):
+    ours_cfg, params = convert_hf_checkpoint(arch, hf_model.state_dict(), hf_cfg_dict)
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    ours = LlamaForCausalLM(dataclasses.replace(ours_cfg, dtype=jnp.float32,
+                                                attn_impl="xla"))
+    if ids is None:
+        ids = np.array([[1, 5, 9, 42, 17, 3]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=atol)
+    return ours_cfg, params
+
+
+def test_gpt2_logits_match_hf():
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        activation_function="gelu_new")
+    torch.manual_seed(2)
+    hf_model = transformers.GPT2LMHeadModel(cfg).eval()
+    ours_cfg, _ = _logits_match("gpt2", hf_model, cfg.to_dict())
+    assert ours_cfg.pos_embedding == "learned" and ours_cfg.tie_word_embeddings
+
+
+def test_gptneox_parallel_residual_logits_match_hf():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, hidden_act="gelu")
+    torch.manual_seed(3)
+    hf_model = transformers.GPTNeoXForCausalLM(cfg).eval()
+    ours_cfg, _ = _logits_match("gptneox", hf_model, cfg.to_dict())
+    assert ours_cfg.parallel_residual and ours_cfg.parallel_residual_norms == 2
+    assert ours_cfg.rotary_dim == 2  # 0.25 * head_dim 8
+
+
+def test_gptneox_sequential_residual_logits_match_hf():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64, rotary_pct=1.0,
+        use_parallel_residual=False, hidden_act="gelu")
+    torch.manual_seed(4)
+    hf_model = transformers.GPTNeoXForCausalLM(cfg).eval()
+    ours_cfg, _ = _logits_match("gptneox", hf_model, cfg.to_dict())
+    assert not ours_cfg.parallel_residual
+
+
+def test_phi3_fused_tensors_logits_match_hf():
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(5)
+    hf_model = transformers.Phi3ForCausalLM(cfg).eval()
+    _logits_match("phi3", hf_model, cfg.to_dict())
+
+
+def test_gpt2_export_roundtrip():
+    cfg = transformers.GPT2Config(vocab_size=64, n_embd=16, n_layer=1, n_head=2,
+                                  n_positions=32)
+    torch.manual_seed(6)
+    hf_model = transformers.GPT2LMHeadModel(cfg).eval()
+    sd = hf_model.state_dict()
+    ours_cfg, params = convert_hf_checkpoint("gpt2", sd, cfg.to_dict())
+    back = export_hf_checkpoint("gpt2", ours_cfg, params)
+    for name, w in back.items():
+        np.testing.assert_allclose(w, sd[name].float().numpy(), rtol=1e-6,
+                                   err_msg=name)
+
+
+def _synthetic_sd(names_shapes, seed=0):
+    rng = np.random.default_rng(seed)
+    return {n: rng.standard_normal(s).astype(np.float32) * 0.05
+            for n, s in names_shapes.items()}
+
+
+def test_internlm_policy_biases():
+    h, i, L, v = 16, 32, 1, 64
+    hf_cfg = {"vocab_size": v, "hidden_size": h, "intermediate_size": i,
+              "num_hidden_layers": L, "num_attention_heads": 2,
+              "max_position_embeddings": 32, "bias": True}
+    names = {"model.embed_tokens.weight": (v, h), "model.norm.weight": (h,),
+             "lm_head.weight": (v, h)}
+    for l in range(L):
+        p = f"model.layers.{l}."
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            names[p + f"self_attn.{proj}.weight"] = (h, h)
+            names[p + f"self_attn.{proj}.bias"] = (h,)
+        names.update({p + "mlp.gate_proj.weight": (i, h), p + "mlp.up_proj.weight": (i, h),
+                      p + "mlp.down_proj.weight": (h, i),
+                      p + "input_layernorm.weight": (h,),
+                      p + "post_attention_layernorm.weight": (h,)})
+    cfg, params = convert_hf_checkpoint("internlm", _synthetic_sd(names), hf_cfg)
+    assert cfg.attention_bias and cfg.attention_out_bias
+    sa = params["model"]["layers_0"]["self_attn"]
+    assert "bias" in sa["o_proj"] and sa["o_proj"]["kernel"].shape == (h, h)
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    ours = LlamaForCausalLM(dataclasses.replace(cfg, dtype=jnp.float32))
+    out = ours.apply({"params": params}, jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_baichuan_wpack_split():
+    h, i, L, v = 16, 32, 1, 64
+    hf_cfg = {"vocab_size": v, "hidden_size": h, "intermediate_size": i,
+              "num_hidden_layers": L, "num_attention_heads": 2,
+              "max_position_embeddings": 32}
+    names = {"model.embed_tokens.weight": (v, h), "model.norm.weight": (h,),
+             "lm_head.weight": (v, h)}
+    for l in range(L):
+        p = f"model.layers.{l}."
+        names.update({p + "self_attn.W_pack.weight": (3 * h, h),
+                      p + "self_attn.o_proj.weight": (h, h),
+                      p + "mlp.gate_proj.weight": (i, h), p + "mlp.up_proj.weight": (i, h),
+                      p + "mlp.down_proj.weight": (h, i),
+                      p + "input_layernorm.weight": (h,),
+                      p + "post_attention_layernorm.weight": (h,)})
+    sd = _synthetic_sd(names, seed=1)
+    cfg, params = convert_hf_checkpoint("baichuan", sd, hf_cfg)
+    sa = params["model"]["layers_0"]["self_attn"]
+    np.testing.assert_allclose(sa["q_proj"]["kernel"],
+                               sd["model.layers.0.self_attn.W_pack.weight"][:h].T)
+    np.testing.assert_allclose(sa["v_proj"]["kernel"],
+                               sd["model.layers.0.self_attn.W_pack.weight"][2 * h:].T)
+    back = export_hf_checkpoint("baichuan", cfg, params)
+    np.testing.assert_allclose(back["model.layers.0.self_attn.W_pack.weight"],
+                               sd["model.layers.0.self_attn.W_pack.weight"], rtol=1e-6)
+    with pytest.raises(ValueError):
+        policy_for("baichuan").config_from_hf({**hf_cfg, "position_embedding": "ALIBI"})
