@@ -88,6 +88,10 @@ class RaggedLlamaModel:
         # not a serving path)
         if attn_backend == "auto":
             attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
+        if config.pos_embedding == "alibi":
+            # the paged kernel has no logit-bias input; ALiBi rides the dense
+            # path's score tensor
+            attn_backend = "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -169,6 +173,9 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     p = params["model"]
     x = p["embed_tokens"]["embedding"][batch.tokens]  # [T, E]
+    if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+        x = _norm_tok(x, {"scale": p["embed_layernorm"]["scale"],
+                          "bias": p["embed_layernorm"]["bias"]}, cfg)
     if cfg.pos_embedding == "learned":  # OPT (table offset by pos_offset)
         x = x + p["embed_positions"]["embedding"][batch.token_pos + cfg.pos_offset]
     cos, sin = precompute_rope(cfg.rotary_dim or hd, cfg.max_position_embeddings,
@@ -236,6 +243,13 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             v_h = hist[:, :, 1].astype(x.dtype)
             qf = q_s.astype(jnp.float32)
             scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) / jnp.sqrt(hd).astype(jnp.float32)
+            if cfg.pos_embedding == "alibi":
+                from ...models.llama import alibi_slopes
+                slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)
+                # [S, N, KV, G, L]: slope_h * (key_pos - query_abs_pos)
+                dist = (key_pos[:, :, None, None, :]
+                        - q_abs[:, :, None, None, None]).astype(jnp.float32)
+                scores = scores + slopes[None, None, :, :, None] * dist
             scores = jnp.where(attn_mask[:, :, None, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             ctx = jnp.einsum("snkgl,slkd->snkgd", probs, v_h).reshape(S, N, nq * hd)

@@ -17,6 +17,7 @@ from functools import partial
 from typing import Any, Optional, Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import flax.linen as nn
 from flax.linen import partitioning as nn_partitioning
@@ -52,7 +53,8 @@ class LlamaConfig:
     attention_out_bias: bool = False  # OPT/Phi: bias on the output projection
     # ---- architecture variant knobs ----
     norm_type: str = "rmsnorm"        # "rmsnorm" | "layernorm" (scale+bias)
-    pos_embedding: str = "rope"       # "rope" | "learned" (OPT)
+    pos_embedding: str = "rope"       # "rope" | "learned" (OPT) | "alibi" (BLOOM)
+    embed_layernorm: bool = False     # BLOOM word_embeddings_layernorm
     pos_offset: int = 0               # OPT stores positions at index pos+2
     rotary_dim: Optional[int] = None  # Phi partial rotary; None = full head_dim
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
@@ -160,6 +162,20 @@ class RMSNorm(nn.Module):
         return (out * scale).astype(self.dtype)
 
 
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (HF ``build_alibi_tensor`` formula, including the
+    non-power-of-2 interpolation). Press et al., "Train Short, Test Long"."""
+    import math
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1)
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        extra = extra_base ** np.arange(1, 2 * (n_heads - closest), 2)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
 def _dense(features, name, axes, dtype, use_bias=False):
     return nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name,
                     kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), axes),
@@ -210,6 +226,7 @@ class LlamaAttention(nn.Module):
             return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
 
         use_flash = (cfg.attn_impl != "xla" and attn_mask is None
+                     and cfg.pos_embedding != "alibi"
                      and (s <= 128 or s % 128 == 0)
                      and (cfg.attn_impl == "flash"
                           or (jax.default_backend() == "tpu" and _attn_unsharded())))
@@ -221,7 +238,16 @@ class LlamaAttention(nn.Module):
             if attn_mask is not None:
                 # [b, s] key padding mask -> [b, 1, 1, s]
                 mask = attn_mask[:, None, None, :].astype(bool)
-            attn = jax.nn.dot_product_attention(q, k, v, mask=mask, is_causal=True)
+            bias = None
+            if cfg.pos_embedding == "alibi":
+                # BLOOM: logits += slope_h * (key_pos - query_pos); future
+                # positions are cut by the causal mask
+                slopes = jnp.asarray(alibi_slopes(nq))
+                dist = (positions[:, None, None, :]
+                        - positions[:, None, :, None]).astype(jnp.float32)
+                bias = slopes[None, :, None, None] * dist
+            attn = jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
+                                                is_causal=True)
         out = attn.reshape(b, s, nq * hd)
         return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype,
                       cfg.attention_out_bias)(out)
@@ -371,6 +397,9 @@ class LlamaModel(nn.Module):
                                                              (VOCAB, EMBED)),
                          name="embed_tokens")
         x = embed(input_ids)
+        if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+            x = nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
+                             name="embed_layernorm")(x)
         if cfg.pos_embedding == "learned":
             # OPT-style learned positions (HF offsets the table by pos_offset)
             pos_table = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,

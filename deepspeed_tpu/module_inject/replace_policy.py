@@ -659,6 +659,96 @@ class BaichuanPolicy(HFCheckpointPolicy):
             [flat[f + f"{x}/kernel"].T for x in ("q_proj", "k_proj", "v_proj")], axis=0)}
 
 
+class BloomPolicy(HFCheckpointPolicy):
+    """BLOOM (reference ``module_inject/containers/bloom.py``): ALiBi
+    positions, embedding LayerNorm, per-head-interleaved fused
+    query_key_value (same layout as NeoX), gelu-tanh MLP, biases
+    everywhere, tied embeddings."""
+    arch = "bloom"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("apply_residual_connection_post_layernorm"):
+            raise ValueError("bloom apply_residual_connection_post_layernorm=True "
+                             "is not supported (pre-LN residual only)")
+        h = hf_config.get("hidden_size") or hf_config["n_embed"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=4 * h,
+            num_hidden_layers=hf_config["n_layer"],
+            num_attention_heads=hf_config["n_head"],
+            num_key_value_heads=hf_config["n_head"],
+            max_position_embeddings=hf_config.get("seq_length", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,
+            attention_bias=True,
+            attention_out_bias=True,
+            norm_type="layernorm",
+            pos_embedding="alibi",
+            embed_layernorm=True,
+            mlp_type="gelu_tanh_fc",  # BloomGelu = tanh approximation
+            mlp_bias=True,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"transformer.h.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/scale",
+                                                    False),
+            p + "post_attention_layernorm.bias": (f + "post_attention_layernorm/bias",
+                                                  False),
+            p + "self_attention.dense.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "self_attention.dense.bias": (f + "self_attn/o_proj/bias", False),
+            p + "mlp.dense_h_to_4h.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.dense_h_to_4h.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.dense_4h_to_h.weight": (f + "mlp/fc2/kernel", True),
+            p + "mlp.dense_4h_to_h.bias": (f + "mlp/fc2/bias", False),
+        }
+
+    def special_hf_names(self, layer: int):
+        p = f"transformer.h.{layer}.self_attention.query_key_value."
+        return [p + "weight", p + "bias"]
+
+    def convert_special(self, layer: int, cfg: LlamaConfig, get_tensor, put):
+        """Fused qkv rows are grouped per head as [q_i | k_i | v_i]."""
+        p = f"transformer.h.{layer}.self_attention.query_key_value."
+        hd = cfg.head_dim_
+        nq = cfg.num_attention_heads
+        w = get_tensor(p + "weight").reshape(nq, 3, hd, cfg.hidden_size)
+        b = get_tensor(p + "bias").reshape(nq, 3, hd)
+        f = f"layers_{layer}/self_attn/"
+        for i, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            put(f + f"{proj}/kernel", w[:, i].reshape(nq * hd, cfg.hidden_size).T)
+            put(f + f"{proj}/bias", b[:, i].reshape(nq * hd))
+
+    def export_special(self, layer: int, cfg: LlamaConfig, flat):
+        hd = cfg.head_dim_
+        nq = cfg.num_attention_heads
+        f = f"layers_{layer}/self_attn/"
+        w = np.stack([flat[f + f"{x}/kernel"].T.reshape(nq, hd, cfg.hidden_size)
+                      for x in ("q_proj", "k_proj", "v_proj")], axis=1)
+        b = np.stack([flat[f + f"{x}/bias"].reshape(nq, hd)
+                      for x in ("q_proj", "k_proj", "v_proj")], axis=1)
+        p = f"transformer.h.{layer}.self_attention.query_key_value."
+        return {p + "weight": w.reshape(3 * nq * hd, cfg.hidden_size),
+                p + "bias": b.reshape(3 * nq * hd)}
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "transformer.word_embeddings.weight": ("embed_tokens/embedding", False),
+            "transformer.word_embeddings_layernorm.weight": ("embed_layernorm/scale",
+                                                             False),
+            "transformer.word_embeddings_layernorm.bias": ("embed_layernorm/bias", False),
+            "transformer.ln_f.weight": ("norm/scale", False),
+            "transformer.ln_f.bias": ("norm/bias", False),
+        }
+
+
 _POLICIES = {
     "llama": LlamaPolicy,
     "LlamaForCausalLM": LlamaPolicy,
@@ -687,6 +777,8 @@ _POLICIES = {
     "Phi3ForCausalLM": Phi3Policy,
     "baichuan": BaichuanPolicy,
     "BaichuanForCausalLM": BaichuanPolicy,
+    "bloom": BloomPolicy,
+    "BloomForCausalLM": BloomPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -31,7 +31,7 @@ def test_policy_registry():
     assert "llama" in SUPPORTED_ARCHS and "mistral" in SUPPORTED_ARCHS
     assert policy_for("LlamaForCausalLM").arch == "llama"
     with pytest.raises(ValueError):
-        policy_for("bloom")
+        policy_for("mamba")
 
 
 def test_convert_logits_match_hf(tiny_hf_llama):
@@ -470,3 +470,42 @@ def test_baichuan_wpack_split():
                                sd["model.layers.0.self_attn.W_pack.weight"], rtol=1e-6)
     with pytest.raises(ValueError):
         policy_for("baichuan").config_from_hf({**hf_cfg, "position_embedding": "ALIBI"})
+
+
+def test_bloom_alibi_logits_match_hf():
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(7)
+    hf_model = transformers.BloomForCausalLM(cfg).eval()
+    ours_cfg, _ = _logits_match("bloom", hf_model, cfg.to_dict())
+    assert ours_cfg.pos_embedding == "alibi" and ours_cfg.embed_layernorm
+
+
+def test_bloom_ragged_engine_serves():
+    cfg = transformers.BloomConfig(vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(8)
+    hf_model = transformers.BloomForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("bloom", hf_model.state_dict(),
+                                             cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(ours_cfg, params=params, dtype=jnp.float32, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    assert eng.model().attn_backend == "dense"  # ALiBi forces the dense path
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+
+    # decode one token: absolute-position ALiBi must hold across put() calls
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
