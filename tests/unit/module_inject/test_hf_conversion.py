@@ -509,3 +509,42 @@ def test_bloom_ragged_engine_serves():
         ref2 = hf_model(torch.tensor([prompt + [nxt]],
                                      dtype=torch.long)).logits.numpy()[0, -1]
     np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("arch", ["gpt2", "gptneox", "phi3"])
+def test_new_archs_serve_through_ragged_engine(arch):
+    """Every conversion policy's model variant must serve through the v2
+    ragged engine (reference inference/v2/model_implementations breadth)."""
+    if arch == "gpt2":
+        cfg = transformers.GPT2Config(vocab_size=128, n_embd=32, n_layer=2, n_head=4,
+                                      n_positions=64)
+        hf_model = transformers.GPT2LMHeadModel(cfg)
+    elif arch == "gptneox":
+        cfg = transformers.GPTNeoXConfig(vocab_size=128, hidden_size=32,
+                                         intermediate_size=64, num_hidden_layers=2,
+                                         num_attention_heads=4,
+                                         max_position_embeddings=64, rotary_pct=0.25,
+                                         use_parallel_residual=True, hidden_act="gelu")
+        hf_model = transformers.GPTNeoXForCausalLM(cfg)
+    else:
+        cfg = transformers.Phi3Config(vocab_size=128, hidden_size=32,
+                                      intermediate_size=64, num_hidden_layers=2,
+                                      num_attention_heads=4, num_key_value_heads=2,
+                                      max_position_embeddings=64,
+                                      tie_word_embeddings=False, pad_token_id=0)
+        hf_model = transformers.Phi3ForCausalLM(cfg)
+    torch.manual_seed(9)
+    hf_model = hf_model.eval()
+    ours_cfg, params = convert_hf_checkpoint(arch, hf_model.state_dict(), cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(ours_cfg, params=params, dtype=jnp.float32, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)

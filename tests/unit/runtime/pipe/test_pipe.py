@@ -327,3 +327,57 @@ def test_pipeline_engine_trains():
     losses = [float(eng.train_batch(data)) for _ in range(5)]
     assert eng.global_steps == 5
     assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+@pytest.mark.world_size(8)
+def test_pipeline_composes_pipe_model_data():
+    """3D composition pipe x model x data (VERDICT r2 row 39): a Megatron-TP
+    layer (col-parallel w1, row-parallel w2, activation constrained over the
+    model axis) inside the 1F1B pipelined body, data-parallel batch — loss
+    matches the unsharded sequential reference and training learns."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+    ctx = MeshContext.create(axis_sizes={"pipe": 2, "model": 2, "data": 2})
+    set_mesh_context(ctx)
+    d, L, B, V = 16, 4, 8, 32
+    rng = np.random.default_rng(0)
+    params = {
+        "embed": {"w": jnp.asarray(rng.normal(size=(V, d)), jnp.float32)},
+        "body": {"w1": jnp.asarray(rng.normal(size=(L, d, 4 * d)) / np.sqrt(d),
+                                   jnp.float32),
+                 "w2": jnp.asarray(rng.normal(size=(L, 4 * d, d)) / np.sqrt(4 * d),
+                                   jnp.float32)},
+        "head": {"w": jnp.asarray(rng.normal(size=(d, V)) / np.sqrt(d), jnp.float32)},
+    }
+
+    def embed(p, ids):
+        return p["w"][ids]
+
+    def layer(lp, h):
+        z = jnp.tanh(h @ lp["w1"])
+        z = jax.lax.with_sharding_constraint(z, P(None, None, "model"))
+        return h + z @ lp["w2"]
+
+    def head(p, h, labels):
+        logp = jax.nn.log_softmax(h @ p["w"])
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+    eng = PipelineEngine(embed, layer, head,
+                         jax.tree_util.tree_map(jnp.copy, params),
+                         config={"train_batch_size": B,
+                                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}},
+                         num_microbatches=4)
+    ids = jnp.asarray(rng.integers(0, V, size=(B, 8)), jnp.int32)
+
+    def ref_fn(p, ids, labels):
+        h = p["embed"]["w"][ids]
+        for l in range(L):
+            h = layer({"w1": p["body"]["w1"][l], "w2": p["body"]["w2"][l]}, h)
+        return head(p["head"], h, labels)
+
+    with ctx.mesh:
+        ref_loss = float(jax.jit(ref_fn)(params, ids, ids))
+    data = iter([(ids, ids)] * 12)
+    losses = [float(eng.train_batch(data)) for _ in range(5)]
+    np.testing.assert_allclose(losses[0], ref_loss, rtol=1e-5)
+    assert losses[-1] < losses[0], f"no learning: {losses}"
