@@ -1,0 +1,147 @@
+"""BERT-family encoder (TPU-native flax).
+
+Reference: ``module_inject/containers/bert.py`` (HFBertLayerPolicy) +
+``containers/distil_bert.py`` — the reference injects fused kernels into HF
+``BertLayer``s; here the whole encoder is a native flax module the HF
+checkpoint converts into (``module_inject/replace_policy.py BertPolicy``),
+jitted as one program.
+
+Post-LN architecture (attention → add&norm → FFN → add&norm), bidirectional
+attention with a key-padding mask, learned word+position(+token-type)
+embeddings with an embedding LayerNorm. DistilBERT is the same graph minus
+token-type embeddings and pooler (``distilbert=True``).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .llama import EMBED, HEADS, HIDDEN, VOCAB, _dense
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    distilbert: bool = False   # no token-type embeddings / pooler
+    # converter duck-typing (module_inject/replace_module.py walks these)
+    tie_word_embeddings: bool = True   # MLM decoder ties to word_embeddings
+    attention_bias: bool = True
+    num_local_experts: int = 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _ln(cfg, name):
+    return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name=name)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None):
+        cfg = self.config
+        b, s, _ = x.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(n * hd, "query", (EMBED, HEADS), cfg.dtype, True)(x).reshape(b, s, n, hd)
+        k = _dense(n * hd, "key", (EMBED, HEADS), cfg.dtype, True)(x).reshape(b, s, n, hd)
+        v = _dense(n * hd, "value", (EMBED, HEADS), cfg.dtype, True)(x).reshape(b, s, n, hd)
+        mask = None
+        if attn_mask is not None:
+            mask = attn_mask[:, None, None, :].astype(bool)  # key padding
+        attn = jax.nn.dot_product_attention(q, k, v, mask=mask)
+        out = attn.reshape(b, s, n * hd)
+        return _dense(cfg.hidden_size, "output", (HEADS, EMBED), cfg.dtype, True)(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None):
+        cfg = self.config
+        # post-LN: norm AFTER each residual add
+        attn = BertSelfAttention(cfg, name="attention")(x, attn_mask)
+        x = _ln(cfg, "attention_layernorm")(x + attn)
+        h = _dense(cfg.intermediate_size, "intermediate", (EMBED, HIDDEN),
+                   cfg.dtype, True)(x)
+        h = jax.nn.gelu(h, approximate=False)
+        h = _dense(cfg.hidden_size, "mlp_output", (HIDDEN, EMBED), cfg.dtype, True)(h)
+        return _ln(cfg, "output_layernorm")(x + h)
+
+
+class BertModel(nn.Module):
+    """Encoder trunk: [b, s] ids (+ mask, token types) → [b, s, h] states."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attn_mask=None, token_type_ids=None,
+                 return_embed_matrix: bool = False):
+        cfg = self.config
+        embed_mod = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                             embedding_init=nn.with_partitioning(
+                                 nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                             name="word_embeddings")
+        emb = embed_mod(input_ids)
+        pos = jnp.arange(input_ids.shape[1], dtype=jnp.int32)[None, :]
+        emb = emb + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                             dtype=cfg.dtype,
+                             embedding_init=nn.with_partitioning(
+                                 nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                             name="position_embeddings")(pos)
+        if not cfg.distilbert:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                                 embedding_init=nn.with_partitioning(
+                                     nn.initializers.normal(0.02), (VOCAB, EMBED)),
+                                 name="token_type_embeddings")(token_type_ids)
+        x = _ln(cfg, "embeddings_layernorm")(emb)
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attn_mask)
+        if return_embed_matrix:  # weight tying for the MLM decoder
+            mat = embed_mod.embedding
+            return x, (mat.unbox() if hasattr(mat, "unbox") else mat)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head: transform (dense+gelu+LN) then decode against the word
+    embeddings (HF ties the decoder to word_embeddings)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attn_mask=None, token_type_ids=None):
+        cfg = self.config
+        x, embed_mat = BertModel(cfg, name="bert")(input_ids, attn_mask, token_type_ids,
+                                                   return_embed_matrix=True)
+        x = _dense(cfg.hidden_size, "transform", (EMBED, EMBED), cfg.dtype, True)(x)
+        x = jax.nn.gelu(x, approximate=False)
+        x = _ln(cfg, "transform_layernorm")(x)
+        logits = jax.lax.dot_general(
+            x.astype(cfg.dtype), embed_mat.astype(cfg.dtype).T,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        bias = self.param("decoder_bias", nn.initializers.zeros, (cfg.vocab_size, ),
+                          jnp.float32)
+        return logits + bias
+
+
+def init_bert(cfg: BertConfig, seed: int = 0, mlm: bool = True):
+    model = (BertForMaskedLM if mlm else BertModel)(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), ids)["params"]
+    return model, params

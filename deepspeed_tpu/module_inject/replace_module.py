@@ -80,13 +80,15 @@ def convert_hf_checkpoint(arch: str,
 
             policy.convert_special(layer, cfg, get_tensor, put)
 
+    ignored = tuple(getattr(policy, "ignored_suffixes", ())) + ("rotary_emb.inv_freq", )
     leftovers = [k for k in hf_state_dict if k not in consumed
-                 and not k.endswith("rotary_emb.inv_freq")]
+                 and not k.endswith(ignored)]
     if leftovers:
         logger.warning(f"unconverted HF tensors: {leftovers[:8]}"
                        f"{'...' if len(leftovers) > 8 else ''}")
 
-    params = {"model": _nest(flat)}
+    root = getattr(policy, "root", "model")
+    params = {root: _nest(flat)} if root else _nest(flat)
     return cfg, params
 
 
@@ -102,7 +104,8 @@ def export_hf_checkpoint(arch: str, config: LlamaConfig, params: Dict) -> Dict[s
         else:
             flat[prefix[:-1]] = np.asarray(node, dtype=np.float32)
 
-    walk(params.get("model", params))
+    root = getattr(policy, "root", "model")
+    walk(params.get(root, params) if root else params)
     out = {}
     maps = dict(policy.global_map(config.tie_word_embeddings))
     for layer in range(config.num_hidden_layers):
@@ -215,7 +218,8 @@ def convert_hf_safetensors(arch: str,
     if missing:
         raise KeyError(f"checkpoint under {model_dir} is missing tensors for: "
                        f"{missing[:6]}{'...' if len(missing) > 6 else ''}")
-    return cfg, {"model": _nest(flat)}
+    root = getattr(policy, "root", "model")
+    return cfg, ({root: _nest(flat)} if root else _nest(flat))
 
 
 def replace_transformer_layer(arch_or_model_type: str,

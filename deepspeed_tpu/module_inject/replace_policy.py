@@ -749,6 +749,128 @@ class BloomPolicy(HFCheckpointPolicy):
         }
 
 
+class BertPolicy:
+    """BERT encoder (reference ``module_inject/containers/bert.py``
+    HFBertLayerPolicy): post-LN bidirectional layers, MLM head tied to the
+    word embeddings. Converts HF ``BertForMaskedLM`` into
+    ``models/bert.py BertForMaskedLM`` (root-less param tree)."""
+    arch = "bert"
+    root = None  # flax tree has no "model" wrapper; paths carry "bert/"
+    # tied-decoder duplicates + buffers the conversion legitimately skips
+    ignored_suffixes = ("cls.predictions.decoder.weight",
+                        "cls.predictions.decoder.bias",
+                        "embeddings.position_ids",
+                        "seq_relationship.weight", "seq_relationship.bias",
+                        "pooler.dense.weight", "pooler.dense.bias")
+    col_parallel = ["query", "key", "value", "intermediate"]
+    row_parallel = ["output", "mlp_output"]
+
+    def config_from_hf(self, hf_config):
+        from ..models.bert import BertConfig
+        return BertConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 512),
+            type_vocab_size=hf_config.get("type_vocab_size", 2),
+            layer_norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = True):
+        p = f"bert.encoder.layer.{layer}."
+        f = f"bert/layer_{layer}/"
+        out = {}
+        for hf, fx in (("attention.self.query", "attention/query"),
+                       ("attention.self.key", "attention/key"),
+                       ("attention.self.value", "attention/value"),
+                       ("attention.output.dense", "attention/output"),
+                       ("intermediate.dense", "intermediate"),
+                       ("output.dense", "mlp_output")):
+            out[p + hf + ".weight"] = (f + fx + "/kernel", True)
+            out[p + hf + ".bias"] = (f + fx + "/bias", False)
+        for hf, fx in (("attention.output.LayerNorm", "attention_layernorm"),
+                       ("output.LayerNorm", "output_layernorm")):
+            out[p + hf + ".weight"] = (f + fx + "/scale", False)
+            out[p + hf + ".bias"] = (f + fx + "/bias", False)
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "bert.embeddings.word_embeddings.weight": ("bert/word_embeddings/embedding",
+                                                       False),
+            "bert.embeddings.position_embeddings.weight":
+                ("bert/position_embeddings/embedding", False),
+            "bert.embeddings.token_type_embeddings.weight":
+                ("bert/token_type_embeddings/embedding", False),
+            "bert.embeddings.LayerNorm.weight": ("bert/embeddings_layernorm/scale", False),
+            "bert.embeddings.LayerNorm.bias": ("bert/embeddings_layernorm/bias", False),
+            "cls.predictions.transform.dense.weight": ("transform/kernel", True),
+            "cls.predictions.transform.dense.bias": ("transform/bias", False),
+            "cls.predictions.transform.LayerNorm.weight": ("transform_layernorm/scale",
+                                                           False),
+            "cls.predictions.transform.LayerNorm.bias": ("transform_layernorm/bias",
+                                                         False),
+            "cls.predictions.bias": ("decoder_bias", False),
+        }
+
+
+class DistilBertPolicy(BertPolicy):
+    """DistilBERT (reference ``module_inject/containers/distil_bert.py``):
+    the BERT graph minus token-type embeddings, different HF naming."""
+    arch = "distilbert"
+    ignored_suffixes = ("vocab_projector.weight", "embeddings.position_ids")
+
+    def config_from_hf(self, hf_config):
+        from ..models.bert import BertConfig
+        return BertConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["dim"],
+            intermediate_size=hf_config["hidden_dim"],
+            num_hidden_layers=hf_config["n_layers"],
+            num_attention_heads=hf_config["n_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 512),
+            layer_norm_eps=1e-12,
+            distilbert=True,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = True):
+        p = f"distilbert.transformer.layer.{layer}."
+        f = f"bert/layer_{layer}/"
+        out = {}
+        for hf, fx in (("attention.q_lin", "attention/query"),
+                       ("attention.k_lin", "attention/key"),
+                       ("attention.v_lin", "attention/value"),
+                       ("attention.out_lin", "attention/output"),
+                       ("ffn.lin1", "intermediate"),
+                       ("ffn.lin2", "mlp_output")):
+            out[p + hf + ".weight"] = (f + fx + "/kernel", True)
+            out[p + hf + ".bias"] = (f + fx + "/bias", False)
+        for hf, fx in (("sa_layer_norm", "attention_layernorm"),
+                       ("output_layer_norm", "output_layernorm")):
+            out[p + hf + ".weight"] = (f + fx + "/scale", False)
+            out[p + hf + ".bias"] = (f + fx + "/bias", False)
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "distilbert.embeddings.word_embeddings.weight":
+                ("bert/word_embeddings/embedding", False),
+            "distilbert.embeddings.position_embeddings.weight":
+                ("bert/position_embeddings/embedding", False),
+            "distilbert.embeddings.LayerNorm.weight": ("bert/embeddings_layernorm/scale",
+                                                       False),
+            "distilbert.embeddings.LayerNorm.bias": ("bert/embeddings_layernorm/bias",
+                                                     False),
+            "vocab_transform.weight": ("transform/kernel", True),
+            "vocab_transform.bias": ("transform/bias", False),
+            "vocab_layer_norm.weight": ("transform_layernorm/scale", False),
+            "vocab_layer_norm.bias": ("transform_layernorm/bias", False),
+            "vocab_projector.bias": ("decoder_bias", False),
+        }
+
+
 _POLICIES = {
     "llama": LlamaPolicy,
     "LlamaForCausalLM": LlamaPolicy,
@@ -779,6 +901,10 @@ _POLICIES = {
     "BaichuanForCausalLM": BaichuanPolicy,
     "bloom": BloomPolicy,
     "BloomForCausalLM": BloomPolicy,
+    "bert": BertPolicy,
+    "BertForMaskedLM": BertPolicy,
+    "distilbert": DistilBertPolicy,
+    "DistilBertForMaskedLM": DistilBertPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})
