@@ -32,15 +32,21 @@ from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 
 
-def _rope_tok(x, cos, sin, positions, rotary_dim=None):
+def _rope_tok(x, cos, sin, positions, rotary_dim=None, interleaved=False):
     """Token-major rope: x [T, H, D], positions [T]; partial rotary (Phi)
-    rotates only the leading rotary_dim dims."""
+    rotates only the leading rotary_dim dims; ``interleaved`` = GPT-J
+    adjacent-pair layout."""
     if rotary_dim is not None and rotary_dim < x.shape[-1]:
         xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
-        return jnp.concatenate([_rope_tok(xr, cos, sin, positions), xp],
+        return jnp.concatenate([_rope_tok(xr, cos, sin, positions,
+                                          interleaved=interleaved), xp],
                                -1).astype(x.dtype)
     c = cos[positions][:, None, :]
     s = sin[positions][:, None, :]
+    if interleaved:
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        return jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                         axis=-1).reshape(x.shape).astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1).astype(x.dtype)
 
@@ -218,8 +224,10 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         k = proj("k_proj", nkv)
         v = proj("v_proj", nkv)
         if cfg.pos_embedding == "rope":
-            q = _rope_tok(q, cos, sin, batch.token_pos, cfg.rotary_dim)
-            k = _rope_tok(k, cos, sin, batch.token_pos, cfg.rotary_dim)
+            q = _rope_tok(q, cos, sin, batch.token_pos, cfg.rotary_dim,
+                          cfg.rope_interleaved)
+            k = _rope_tok(k, cos, sin, batch.token_pos, cfg.rotary_dim,
+                          cfg.rope_interleaved)
 
         # paged write: one scatter of the new tokens' K/V into flat slots
         # (cache is [layer, 2, KV, slot, D]; advanced indexing puts the

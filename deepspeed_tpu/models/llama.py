@@ -57,6 +57,7 @@ class LlamaConfig:
     embed_layernorm: bool = False     # BLOOM word_embeddings_layernorm
     pos_offset: int = 0               # OPT stores positions at index pos+2
     rotary_dim: Optional[int] = None  # Phi partial rotary; None = full head_dim
+    rope_interleaved: bool = False    # GPT-J adjacent-pair rotary layout
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
     # "gelu_new", Phi) | "relu_fc" (OPT)
     mlp_type: str = "swiglu"
@@ -134,17 +135,26 @@ def precompute_rope(head_dim: int, max_len: int, theta: float, dtype=jnp.float32
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
-def apply_rope(x, cos, sin, positions, rotary_dim: Optional[int] = None):
+def apply_rope(x, cos, sin, positions, rotary_dim: Optional[int] = None,
+               interleaved: bool = False):
     """x: [b, s, h, d]; rotate-half formulation (reference
     csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu, rebuilt in jnp —
     XLA fuses this into the surrounding matmuls). ``rotary_dim < d`` rotates
-    only the leading slice (Phi-style partial rotary)."""
+    only the leading slice (Phi-style partial rotary). ``interleaved``
+    rotates adjacent pairs (x[2i], x[2i+1]) — GPT-J's layout — instead of the
+    half-split (x[i], x[i+d/2]) NeoX/Llama layout."""
     if rotary_dim is not None and rotary_dim < x.shape[-1]:
         xr, xp = x[..., :rotary_dim], x[..., rotary_dim:]
-        return jnp.concatenate([apply_rope(xr, cos, sin, positions), xp],
+        return jnp.concatenate([apply_rope(xr, cos, sin, positions,
+                                           interleaved=interleaved), xp],
                                axis=-1).astype(x.dtype)
     c = cos[positions][:, :, None, :]  # [b, s, 1, d/2]
     s = sin[positions][:, :, None, :]
+    if interleaved:
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
@@ -206,8 +216,8 @@ class LlamaAttention(nn.Module):
         k = k.reshape(b, s, nkv, hd)
         v = v.reshape(b, s, nkv, hd)
         if cfg.pos_embedding == "rope":
-            q = apply_rope(q, cos, sin, positions, cfg.rotary_dim)
-            k = apply_rope(k, cos, sin, positions, cfg.rotary_dim)
+            q = apply_rope(q, cos, sin, positions, cfg.rotary_dim, cfg.rope_interleaved)
+            k = apply_rope(k, cos, sin, positions, cfg.rotary_dim, cfg.rope_interleaved)
 
         # GQA handled natively by both paths (no materialized K/V head
         # repeat — 4x K/V bandwidth saving at 8B scale). The Pallas flash

@@ -749,6 +749,61 @@ class BloomPolicy(HFCheckpointPolicy):
         }
 
 
+class GPTJPolicy(HFCheckpointPolicy):
+    """GPT-J (reference ``module_inject/containers/gptj.py``): interleaved
+    (adjacent-pair) partial rotary, single-norm parallel residual, gelu_new
+    fc MLP (biased), bias-free attention, untied lm_head WITH bias."""
+    arch = "gptj"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        h = hf_config["n_embd"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_hidden_layers=hf_config["n_layer"],
+            num_attention_heads=hf_config["n_head"],
+            num_key_value_heads=hf_config["n_head"],
+            max_position_embeddings=hf_config.get("n_positions", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            rotary_dim=hf_config.get("rotary_dim", 64),
+            rope_interleaved=True,
+            tie_word_embeddings=False,
+            norm_type="layernorm",
+            mlp_type="gelu_tanh_fc",  # HF activation_function "gelu_new"
+            mlp_bias=True,
+            parallel_residual=True,
+            lm_head_bias=True,
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"transformer.h.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "ln_1.weight": (f + "input_layernorm/scale", False),
+            p + "ln_1.bias": (f + "input_layernorm/bias", False),
+            p + "attn.q_proj.weight": (f + "self_attn/q_proj/kernel", True),
+            p + "attn.k_proj.weight": (f + "self_attn/k_proj/kernel", True),
+            p + "attn.v_proj.weight": (f + "self_attn/v_proj/kernel", True),
+            p + "attn.out_proj.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "mlp.fc_in.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.fc_in.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.fc_out.weight": (f + "mlp/fc2/kernel", True),
+            p + "mlp.fc_out.bias": (f + "mlp/fc2/bias", False),
+        }
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "transformer.wte.weight": ("embed_tokens/embedding", False),
+            "transformer.ln_f.weight": ("norm/scale", False),
+            "transformer.ln_f.bias": ("norm/bias", False),
+            "lm_head.weight": ("lm_head/kernel", True),
+            "lm_head.bias": ("lm_head/bias", False),
+        }
+
+
 class BertPolicy:
     """BERT encoder (reference ``module_inject/containers/bert.py``
     HFBertLayerPolicy): post-LN bidirectional layers, MLM head tied to the
@@ -905,6 +960,8 @@ _POLICIES = {
     "BertForMaskedLM": BertPolicy,
     "distilbert": DistilBertPolicy,
     "DistilBertForMaskedLM": DistilBertPolicy,
+    "gptj": GPTJPolicy,
+    "GPTJForCausalLM": GPTJPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

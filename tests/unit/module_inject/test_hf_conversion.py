@@ -591,3 +591,14 @@ def test_distilbert_mlm_logits_match_hf():
         ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
     got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_gptj_interleaved_rotary_logits_match_hf():
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=4, activation_function="gelu_new")
+    torch.manual_seed(12)
+    hf_model = transformers.GPTJForCausalLM(cfg).eval()
+    ours_cfg, _ = _logits_match("gptj", hf_model, cfg.to_dict())
+    assert ours_cfg.rope_interleaved and ours_cfg.rotary_dim == 4
+    assert ours_cfg.parallel_residual and ours_cfg.parallel_residual_norms == 1
