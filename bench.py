@@ -112,6 +112,110 @@ def _measure_config(batch, seq, iters, remat):
     }
 
 
+def breakdown(batch=8, seq=1024, iters=10):
+    """Where-the-time-goes report (VERDICT r2 #1): fused step vs forward-only
+    vs optimizer-only, plus flash-vs-XLA attention and XLA cost analysis.
+    Prints one JSON object (not the driver metric line)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=2048, remat=False)
+    if jax.devices()[0].platform == "cpu":  # smoke-test sizing
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, max_position_embeddings=512)
+        batch, seq, iters = 2, 128, 2
+    model, params = init_llama(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": batch,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}, "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, seq)),
+                                     dtype=jnp.int32))
+
+    def _sync():
+        jax.block_until_ready(engine.params)
+        float(jax.tree_util.tree_leaves(engine.params)[0].ravel()[0])
+
+    def timeit(fn, sync=None, n=iters):
+        fn()  # compile
+        fn()
+        (sync or _sync)()
+        t0 = time.time()
+        for _ in range(n):
+            out = fn()
+        (sync or _sync)()
+        return (time.time() - t0) / n, out
+
+    report = {}
+    t_step, _ = timeit(lambda: engine.fused_train_step(ids, labels=ids))
+    report["fused_step_ms"] = round(t_step * 1e3, 2)
+
+    # forward-only (loss program, no bwd/opt) via the engine's compiled fn
+    try:
+        fwd_out = [None]
+        def fsync():
+            jax.block_until_ready(fwd_out[0])
+            float(np.asarray(jax.tree_util.tree_leaves(fwd_out[0])[0]).ravel()[0])
+        def frun():
+            fwd_out[0] = engine._fwd_only(engine.params, (ids, ), {"labels": ids}, ())
+            return fwd_out[0]
+        t_fwd, _ = timeit(frun, sync=fsync)
+        report["forward_ms"] = round(t_fwd * 1e3, 2)
+    except Exception as e:  # noqa: BLE001
+        report["forward_ms"] = f"n/a ({str(e)[:80]})"
+
+    # attention kernel micro-bench: flash vs XLA at bench shape
+    from deepspeed_tpu.ops.attention import flash_attention, _xla_attention
+    hd = cfg.head_dim_
+    q = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, seq, cfg.num_attention_heads, hd)), jnp.bfloat16))
+    fl = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+    xl = jax.jit(lambda q: _xla_attention(q, q, q, 1.0 / np.sqrt(hd), True))
+    for name, fn in (("flash_attn_ms", fl), ("xla_attn_ms", xl)):
+        try:
+            out = [None]
+            def asyncd():
+                jax.block_until_ready(out[0])
+                float(np.asarray(out[0]).ravel()[0])
+            def arun():
+                out[0] = fn(q)
+                return out[0]
+            t, _ = timeit(arun, sync=asyncd, n=20)
+            report[name] = round(t * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            report[name] = f"n/a ({str(e)[:80]})"
+
+    # exact compiled FLOPs of the fused step (XLA cost analysis)
+    try:
+        lowered = engine._train_step_fused.lower(
+            engine.params, engine.opt_state, engine.scale_state,
+            (ids, ), {"labels": ids}, ())
+        ca = lowered.compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        report["xla_flops_per_step"] = float(ca.get("flops", -1.0))
+    except Exception as e:  # noqa: BLE001
+        report["xla_flops_per_step"] = f"n/a ({str(e)[:80]})"
+
+    toks = batch * seq
+    report["tokens_per_step"] = toks
+    report["model_flops_per_step"] = 6 * n_params * toks \
+        + 6 * cfg.num_hidden_layers * seq * cfg.num_attention_heads * hd * toks
+    if isinstance(report.get("xla_flops_per_step"), float) and t_step > 0:
+        report["hw_flops_utilization"] = round(
+            report["xla_flops_per_step"] / t_step / 197e12, 4)
+        report["mfu"] = round(report["model_flops_per_step"] / t_step / 197e12, 4)
+    print(json.dumps(report), flush=True)
+
+
 def measure():
     # largest footprint first; OOM falls back (16 GB HBM: bs16 fills the MXU
     # when it fits, bs8 no-remat is the expected landing spot)
@@ -175,7 +279,9 @@ def supervise():
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--breakdown" in sys.argv:
+        breakdown()
+    elif "--child" in sys.argv:
         measure()
     else:
         sys.exit(supervise())
