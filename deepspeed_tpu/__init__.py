@@ -9,7 +9,12 @@ Public API mirrors the reference (``deepspeed/__init__.py``):
 
 from .version import __version__
 from . import comm
+from . import zero
+from . import moe
+from . import ops
 from .config import DeepSpeedTpuConfig
+from .runtime import pipe
+from .comm.comm import init_distributed
 
 __git_hash__ = None
 __git_branch__ = None

