@@ -53,7 +53,11 @@ def measure(platform: str):
                     max_context=max_ctx,
                     max_ragged_batch_size=chunk,  # prefill chunks must fit
                 ),
-                num_kv_blocks=(max_ctx // kv_block) + 8),
+                # enough blocks for the long single-sequence sweep AND the
+                # 32-way concurrent-decode measurement at contexts[0]
+                num_kv_blocks=max(
+                    (max_ctx // kv_block) + 8,
+                    32 * ((contexts[0] + decode_steps) // kv_block + 2))),
             kv_block_size=kv_block)
         model = eng.model()
         assert isinstance(model, RaggedLlamaModel)
@@ -93,6 +97,31 @@ def measure(platform: str):
                 "prefill_tok_s": round(ctx / prefill_s, 1),
             })
             eng.flush(uid)
+
+        # continuous-batching throughput (the FastGen headline shape): N
+        # concurrent sequences, one ragged batch per decode step
+        for nseq in ([8, 32] if on_tpu else [4]):
+            ctx = contexts[0]
+            uids = list(range(1 << 20, (1 << 20) + nseq))
+            for u in uids:
+                for off in range(0, ctx, chunk):
+                    eng.put([u], [rng.integers(0, cfg.vocab_size,
+                                               size=min(chunk, ctx - off)).tolist()])
+            toks = {u: 7 for u in uids}
+            out = eng.put(uids, [[toks[u]] for u in uids])  # warm batched decode
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(decode_steps):
+                out = eng.put(uids, [[toks[u]] for u in uids])
+            jax.block_until_ready(out)
+            float(np.asarray(out).ravel()[0])
+            dt = time.perf_counter() - t0
+            results.append({
+                "backend": backend, "context": ctx, "concurrent_seqs": nseq,
+                "batched_decode_tok_s": round(nseq * decode_steps / dt, 2),
+            })
+            for u in uids:
+                eng.flush(u)
     return results
 
 
