@@ -508,6 +508,7 @@ class DeepSpeedTpuEngine:
             # apply program exists (its state would defeat the offload)
             self._apply_step = None
             self._train_step_fused = None
+            self._train_batch_fused = None
             return
         self._apply_step = jax.jit(
             apply_step,
@@ -572,6 +573,36 @@ class DeepSpeedTpuEngine:
                     logger.warning("1-bit wire program unavailable (needs gas=1, "
                                    "ZeRO stage 0, bf16/fp32, pure-DP mesh, no "
                                    "clipping); falling back to fp32 reduce")
+
+        # gas>1 fused batch: lax.scan over stacked microbatches + optimizer
+        # apply, all in ONE XLA program (one dispatch per optimizer step
+        # instead of gas+1; the grad-accumulation buffer is a scan carry, and
+        # only one microbatch's activations are live at a time)
+        def train_batch_steps(params, opt_state, scale_state, stacked_args, static_kv):
+            scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def micro(carry, margs):
+                acc, loss_sum = carry
+                loss, acc = fwd_bwd(params, acc, scale, margs, {}, static_kv)
+                return (acc, loss_sum + loss), None
+
+            (acc, loss_sum), _ = jax.lax.scan(micro, (zeros, jnp.float32(0.0)),
+                                              stacked_args)
+            outs = apply_step(params, acc, opt_state, scale_state)
+            new_params, new_opt, _, new_scale_state, overflow, gnorm = outs
+            return (loss_sum / gas, new_params, new_opt, new_scale_state,
+                    overflow, gnorm)
+
+        self._train_batch_fused = jax.jit(
+            train_batch_steps,
+            donate_argnums=(0, 1),
+            static_argnums=(4, ),
+            out_shardings=(None, self.param_shardings, self.opt_state_shardings,
+                           scale_out, repl, repl),
+        ) if gas > 1 and self._device_tx is None and self._host_optimizer is None \
+            else None
 
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
@@ -794,6 +825,8 @@ class DeepSpeedTpuEngine:
             if not isinstance(batch, tuple):
                 batch = (batch, )
             return float(self.fused_train_step(*batch))
+        if self._train_batch_fused is not None:
+            return self._run_fused_train_batch(data_iter)
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(data_iter)
@@ -805,6 +838,40 @@ class DeepSpeedTpuEngine:
             losses.append(loss)  # device scalars; convert after the loop so
             # micro-steps pipeline instead of syncing the host every iteration
         return float(sum(float(l) for l in losses)) / self.gradient_accumulation_steps()
+
+    def _run_fused_train_batch(self, data_iter):
+        """gas>1 one-program path: pull gas microbatches, stack on a leading
+        axis, run the scan-fused program (one dispatch per optimizer step)."""
+        gas = self.gradient_accumulation_steps()
+        micros = []
+        for _ in range(gas):
+            batch = next(data_iter)
+            if not isinstance(batch, tuple):
+                batch = (batch, )
+            batch, kw = self._apply_data_efficiency(batch, {})
+            assert not kw, "fused gas path takes positional batch arrays only"
+            micros.append(batch)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micros)
+        stacked = jax.device_put(
+            stacked, self.zero_plan.batch_sharding(stacked, stacked=True))
+        self.tput_timer.start()
+        (loss, self.params, self.opt_state, self.scale_state, overflow,
+         gnorm) = self._train_batch_fused(self.params, self.opt_state,
+                                          self.scale_state, stacked, ())
+        self._last_grad_norm = gnorm
+        self.losses = loss
+        self.micro_steps += gas
+        if self._use_loss_scaling and bool(overflow):
+            self.skipped_steps += 1
+        else:
+            self._advance_schedule()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(global_step=True)
+        if self.monitor is not None:
+            self.monitor.write_events([("Train/Samples/train_loss", float(loss),
+                                        self.global_samples)])
+        return float(loss)
 
     def fused_train_step(self, *args, **kwargs):
         """One-program fwd+bwd+step (gas=1 only). Same semantics as

@@ -116,14 +116,17 @@ class ZeroShardingPlan:
             return tree_shardings(opt_state, self.ctx, self.zero_axes)
         return replicated_tree(opt_state, self.ctx)
 
-    def batch_sharding(self, batch):
-        """Batch is sharded over the full data-parallel world on dim 0."""
+    def batch_sharding(self, batch, stacked: bool = False):
+        """Batch is sharded over the full data-parallel world on dim 0
+        (``stacked=True``: dim 0 is a microbatch axis; shard dim 1)."""
         dp = tuple(a for a in ("data", "fsdp") if self.ctx.axis_size(a) > 1)
+        dim = 1 if stacked else 0
 
         def _one(leaf):
             shape = getattr(leaf, "shape", ())
-            if not dp or len(shape) == 0 or shape[0] % self.ctx.axis_size(dp) != 0:
+            if not dp or len(shape) <= dim or shape[dim] % self.ctx.axis_size(dp) != 0:
                 return NamedSharding(self.ctx.mesh, P())
-            return NamedSharding(self.ctx.mesh, P(dp if len(dp) > 1 else dp[0]))
+            spec = (None, ) * dim + (dp if len(dp) > 1 else dp[0], )
+            return NamedSharding(self.ctx.mesh, P(*spec))
 
         return jax.tree_util.tree_map(_one, batch)
