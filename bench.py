@@ -22,11 +22,24 @@ import subprocess
 import sys
 import time
 
-ATTEMPTS = 3
-BACKOFFS = [10, 20]
+ATTEMPTS = 4
+BACKOFFS = [60, 300, 600]
 # first TPU compile can take minutes on a cold relay, and the OOM-fallback
 # ladder may compile up to three footprints inside ONE child attempt
 ATTEMPT_TIMEOUT = 1800
+# cheap relay probe before each heavy attempt: a hard-down relay fails/hangs
+# here in <=150s instead of burning the full attempt timeout
+PROBE_SRC = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((512, 512), jnp.bfloat16); "
+             "jax.block_until_ready(jax.jit(lambda a: a @ a)(x))")
+
+
+def _relay_up(env, timeout=150) -> bool:
+    try:
+        return subprocess.run([sys.executable, "-c", PROBE_SRC], env=env,
+                              capture_output=True, timeout=timeout).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def _measure_config(batch, seq, iters, remat):
@@ -245,6 +258,14 @@ def supervise():
             # still try axon first and can hang, not just error)
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
+        if attempt < ATTEMPTS - 1 and not _relay_up(env):
+            # relay hard-down: cheap probe failed — burn backoff, not the
+            # 1800s child timeout (the last attempt runs regardless on CPU)
+            last_tail = f"attempt {attempt}: relay probe failed (TPU unreachable)"
+            print(last_tail, file=sys.stderr)
+            if attempt < len(BACKOFFS):
+                time.sleep(BACKOFFS[attempt])
+            continue
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
