@@ -256,8 +256,20 @@ class LlamaAttention(nn.Module):
                 dist = (positions[:, None, None, :]
                         - positions[:, None, :, None]).astype(jnp.float32)
                 bias = slopes[None, :, None, None] * dist
-            attn = jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
-                                                is_causal=True)
+
+            def _core_attn(q, k, v):
+                return jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
+                                                    is_causal=True)
+
+            from ..comm.mesh import mesh_is_initialized, get_mesh_context
+            if mesh_is_initialized() and get_mesh_context().axis_size("seq") > 1:
+                # Ulysses SP (sequence/layer.py): activations ride the mesh
+                # seq-sharded; the head/seq sharding constraints make GSPMD
+                # emit the all-to-all pair around full-sequence attention
+                from ..sequence.layer import ulysses_spmd
+                attn = ulysses_spmd(_core_attn, q, k, v)
+            else:
+                attn = _core_attn(q, k, v)
         out = attn.reshape(b, s, nq * hd)
         return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype,
                       cfg.attention_out_bias)(out)
