@@ -91,13 +91,23 @@ def ulysses_spmd(local_attention: Callable,
     pjit formulation of reference ``DistributedAttention.forward :181``.
     """
     ctx = mesh_ctx or get_mesh_context()
-    if ctx.axis_size(sequence_axis) == 1:
+    sp = ctx.axis_size(sequence_axis)
+    if sp == 1:
         return local_attention(query, key, value, *args, **kwargs)
     csr = jax.lax.with_sharding_constraint
     head_spec = ctx.sharding(None, None, sequence_axis, None)
     seq_spec = ctx.sharding(None, sequence_axis, None, None)
-    q = csr(query, head_spec)
-    k = csr(key, head_spec)
-    v = csr(value, head_spec)
+
+    def to_heads(x):
+        # GQA: a KV head count not divisible by sp (e.g. 2 kv heads, sp=4)
+        # cannot ride the head all-to-all — replicate those instead of
+        # forcing the partitioner into a full rematerialization
+        if x.shape[2] % sp != 0:
+            return csr(x, ctx.sharding(None, None, None, None))
+        return csr(x, head_spec)
+
+    q = to_heads(query)
+    k = to_heads(key)
+    v = to_heads(value)
     out = local_attention(q, k, v, *args, **kwargs)
     return csr(out, seq_spec)
