@@ -1,0 +1,86 @@
+"""Legacy fused transformer layer API.
+
+Reference: ``deepspeed/ops/transformer/transformer.py:296
+DeepSpeedTransformerLayer`` + ``DeepSpeedTransformerConfig :21`` — the
+BERT-era fused CUDA layer (``csrc/transformer/*.cu``, ~13k LoC of
+hand-fused gelu/dropout/softmax/norm kernels). Under XLA the fusion is the
+compiler's job: the layer here is the flax BERT encoder block
+(``models/bert.py``), which jit compiles into the same fused form. The
+config keeps the reference's field names so training scripts port.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference field names (transformer.py:21). Dropout ratios are
+    accepted for compat; inference/eval path is deterministic (pass
+    ``deterministic=False``-style rng plumbing at the flax level if dropout
+    training is needed)."""
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = False
+    local_rank: int = -1
+
+    def __post_init__(self):
+        if self.intermediate_size == -1 and self.hidden_size > 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer:
+    """One encoder layer with the reference's call shape:
+    ``layer(hidden_states, attention_mask)`` → hidden states.
+
+    Post-LN (the reference's default BERT ordering); ``pre_layer_norm`` is
+    rejected explicitly rather than silently mis-ordered.
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig, initial_params: Optional[Any] = None,
+                 seed: int = 0):
+        if config.pre_layer_norm:
+            raise NotImplementedError(
+                "pre_layer_norm=True: use models/llama.py (pre-LN decoder) or "
+                "a flax encoder variant; this legacy shim is the post-LN BERT "
+                "layer the reference kernels target")
+        from ...models.bert import BertConfig, BertLayer
+        import jax
+
+        self.config = config
+        self._cfg = BertConfig(
+            vocab_size=1,  # unused at layer granularity
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            num_hidden_layers=1,
+            num_attention_heads=config.heads,
+            layer_norm_eps=config.layer_norm_eps,
+            dtype=jnp.float16 if config.fp16 else jnp.float32,
+        )
+        self._layer = BertLayer(self._cfg)
+        if initial_params is None:
+            x = jnp.zeros((1, 8, config.hidden_size), self._cfg.dtype)
+            initial_params = self._layer.init(
+                jax.random.PRNGKey(seed if config.seed < 0 else config.seed),
+                x)["params"]
+        self.params = initial_params
+        self._fwd = jax.jit(lambda p, x, m: self._layer.apply({"params": p}, x, m))
+        self._fwd_nomask = jax.jit(lambda p, x: self._layer.apply({"params": p}, x))
+
+    def __call__(self, hidden_states, attention_mask=None):
+        if attention_mask is None:
+            return self._fwd_nomask(self.params, hidden_states)
+        return self._fwd(self.params, hidden_states, attention_mask)
+
+    forward = __call__

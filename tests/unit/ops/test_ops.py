@@ -239,3 +239,20 @@ def test_spatial_nhwc_bias_add_family():
         np.asarray(x + b.astype(jnp.bfloat16) + y + b2.astype(jnp.bfloat16), np.float32))
     with pytest.raises(ValueError):
         nhwc_bias_add(x, jnp.zeros((4, )))
+
+
+def test_legacy_transformer_layer_api():
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+    cfg = DeepSpeedTransformerConfig(batch_size=2, hidden_size=32, heads=4,
+                                     num_hidden_layers=2)
+    assert cfg.intermediate_size == 128  # reference default 4h
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 32))
+    mask = jnp.ones((2, 8), jnp.int32)
+    out = layer(x, mask)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+    with pytest.raises(NotImplementedError):
+        DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=32, heads=4, pre_layer_norm=True))
