@@ -33,8 +33,11 @@ def op_report():
     # probe ops so their registration side effects run
     from .ops import aio as _aio  # noqa: F401
     _aio.aio_available()
+    from .ops import cpu_optim as _cpu_optim  # noqa: F401
+    _cpu_optim.cpu_optim_available()
     for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope",
-                "evoformer_attn"):
+                "evoformer_attn", "spatial", "cpu_optim",
+                "sparse_attention.sparse_self_attention"):
         try:
             importlib.import_module(f".ops.{mod}", package=__package__)
         except ImportError:
