@@ -22,6 +22,7 @@ and skip fully-masked blocks under causal.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -361,21 +362,39 @@ def _bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
+# head_dim -> (block_q, block_k): smaller heads leave VMEM headroom for
+# bigger tiles (better MXU occupancy / fewer grid steps). Override for
+# on-chip tuning with DS_TPU_FLASH_BLOCKS="bq,bk".
+_BLOCK_TABLE = {64: (256, 256), 128: (128, 128)}
+
+
+def _default_blocks(head_dim: int):
+    env = os.environ.get("DS_TPU_FLASH_BLOCKS")
+    if env:
+        bq, bk = (int(x) for x in env.split(","))
+        return bq, bk
+    return _BLOCK_TABLE.get(head_dim, (128, 128))
+
+
 def flash_attention(q,
                     k,
                     v,
                     causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128,
-                    block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     force_pallas: Optional[bool] = None,
                     interpret: bool = False):
     """Blocked attention; q [B, S, H, D], k/v [B, S, KV, D] (GQA native).
 
     Dispatches to the Pallas kernels on TPU (or with interpret=True anywhere)
     for BOTH forward and backward; falls back to the fused XLA
-    softmax-attention path otherwise.
+    softmax-attention path otherwise. ``block_q/block_k`` default per
+    head_dim (env ``DS_TPU_FLASH_BLOCKS`` overrides for tuning).
     """
+    dq, dk = _default_blocks(q.shape[-1])
+    block_q = block_q if block_q is not None else dq
+    block_k = block_k if block_k is not None else dk
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if use_pallas(force_pallas) or interpret:
         return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret)
