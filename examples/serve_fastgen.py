@@ -1,0 +1,44 @@
+"""Serve with the ragged (FastGen-class) v2 engine.
+
+    python examples/serve_fastgen.py            # random tiny model
+    python examples/serve_fastgen.py --hf_dir /path/to/llama  # converted HF
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hf_dir", default=None,
+                    help="HF checkpoint dir (*.safetensors) to convert+serve")
+    ap.add_argument("--arch", default="llama")
+    ap.add_argument("--max_new_tokens", type=int, default=16)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    if args.hf_dir:
+        from deepspeed_tpu.module_inject import convert_hf_safetensors
+        cfg, params = convert_hf_safetensors(args.arch, args.hf_dir)
+    else:
+        from deepspeed_tpu.models import LlamaConfig
+        import dataclasses
+        cfg, params = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32), None
+
+    eng = build_llama_engine(cfg, params=params,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=512),
+                                 num_kv_blocks=256))
+    prompts = [[1, 15, 92, 7], [2, 44], [9, 9, 9, 9, 9]]
+    outs = eng.generate(prompts, max_new_tokens=args.max_new_tokens)
+    for p, o in zip(prompts, outs):
+        print(f"prompt {p} -> {o}")
+
+
+if __name__ == "__main__":
+    main()
