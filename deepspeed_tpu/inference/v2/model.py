@@ -21,6 +21,7 @@ from functools import partial
 from typing import Tuple
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from .config_v2 import KVCacheConfig
@@ -94,9 +95,9 @@ class RaggedLlamaModel:
         # not a serving path)
         if attn_backend == "auto":
             attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
-        if config.pos_embedding == "alibi":
-            # the paged kernel has no logit-bias input; ALiBi rides the dense
-            # path's score tensor
+        if config.pos_embedding == "alibi" or config.sliding_window is not None:
+            # the paged kernel has no logit-bias/window input; ALiBi and
+            # sliding-window ride the dense path's score tensor
             attn_backend = "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
@@ -250,7 +251,15 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             k_h = hist[:, :, 0].astype(jnp.float32)  # [S, L, KV, D]
             v_h = hist[:, :, 1].astype(x.dtype)
             qf = q_s.astype(jnp.float32)
-            scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) / jnp.sqrt(hd).astype(jnp.float32)
+            scale = (cfg.attn_scale if cfg.attn_scale is not None
+                     else 1.0 / float(np.sqrt(hd)))
+            scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) * jnp.float32(scale)
+            from ...models.llama import _layer_window
+            window = _layer_window(cfg, l)
+            if window is not None:
+                # Mistral/GPT-Neo local attention: keys older than the window
+                keep = key_pos > q_abs[:, :, None] - window  # [S, N, L]
+                scores = jnp.where(keep[:, :, None, None, :], scores, -1e30)
             if cfg.pos_embedding == "alibi":
                 from ...models.llama import alibi_slopes
                 slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)

@@ -58,6 +58,12 @@ class LlamaConfig:
     pos_offset: int = 0               # OPT stores positions at index pos+2
     rotary_dim: Optional[int] = None  # Phi partial rotary; None = full head_dim
     rope_interleaved: bool = False    # GPT-J adjacent-pair rotary layout
+    # Mistral/GPT-Neo local attention: keys older than sliding_window are
+    # masked. sliding_window_layers = indices using the window (None = all
+    # layers when sliding_window is set; GPT-Neo alternates local/global)
+    sliding_window: Optional[int] = None
+    sliding_window_layers: Optional[Tuple[int, ...]] = None
+    attn_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
     # "gelu_new", Phi) | "relu_fc" (OPT)
     mlp_type: str = "swiglu"
@@ -198,12 +204,24 @@ def _make_norm(cfg, name):
     return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name=name)
 
 
+def _layer_window(cfg, layer_idx: int):
+    """Sliding window for this layer (None = global attention)."""
+    if cfg.sliding_window is None:
+        return None
+    if (cfg.sliding_window_layers is not None
+            and layer_idx not in cfg.sliding_window_layers):
+        return None
+    return cfg.sliding_window
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
+        window = _layer_window(cfg, self.layer_idx)
         b, s, _ = x.shape
         hd = cfg.head_dim_
         nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
@@ -236,18 +254,24 @@ class LlamaAttention(nn.Module):
             return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
 
         use_flash = (cfg.attn_impl != "xla" and attn_mask is None
-                     and cfg.pos_embedding != "alibi"
+                     and cfg.pos_embedding != "alibi" and window is None
                      and (s <= 128 or s % 128 == 0)
                      and (cfg.attn_impl == "flash"
                           or (jax.default_backend() == "tpu" and _attn_unsharded())))
         if use_flash:
-            attn = flash_attention(q, k, v, causal=True,
+            attn = flash_attention(q, k, v, causal=True, scale=cfg.attn_scale,
                                    interpret=jax.default_backend() != "tpu")
         else:
             mask = None
             if attn_mask is not None:
                 # [b, s] key padding mask -> [b, 1, 1, s]
                 mask = attn_mask[:, None, None, :].astype(bool)
+            if window is not None:
+                # Mistral/GPT-Neo local attention: drop keys older than the
+                # window (the causal side is handled by is_causal)
+                keep = (positions[:, None, :, None] - positions[:, None, None, :]
+                        < window)
+                mask = keep if mask is None else (mask & keep)
             bias = None
             if cfg.pos_embedding == "alibi":
                 # BLOOM: logits += slope_h * (key_pos - query_pos); future
@@ -259,7 +283,8 @@ class LlamaAttention(nn.Module):
 
             def _core_attn(q, k, v):
                 return jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
-                                                    is_causal=True)
+                                                    is_causal=True,
+                                                    scale=cfg.attn_scale)
 
             from ..comm.mesh import mesh_is_initialized, get_mesh_context
             if mesh_is_initialized() and get_mesh_context().axis_size("seq") > 1:
@@ -338,13 +363,14 @@ class LlamaMoEBlock(nn.Module):
 
 class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
         normed = _make_norm(cfg, "input_layernorm")(x)
-        attn_out = LlamaAttention(cfg, name="self_attn")(normed, cos, sin, positions,
-                                                         attn_mask)
+        attn_out = LlamaAttention(cfg, self.layer_idx, name="self_attn")(
+            normed, cos, sin, positions, attn_mask)
         if cfg.parallel_residual:
             # Falcon/Phi: one shared input norm feeds BOTH branches;
             # GPT-NeoX (norms=2): the MLP branch norms x independently
@@ -437,6 +463,10 @@ class LlamaModel(nn.Module):
             # scan over depth: O(1) HLO in layer count (the 70B compile path);
             # gathered-live params are hard-bounded to ONE scan step's chunk
             # (the ZeRO-3 max_live_parameters governor, zero_governor.py)
+            if cfg.sliding_window_layers is not None:
+                raise ValueError(
+                    "scan_layers requires homogeneous layers; per-layer "
+                    "sliding_window_layers patterns need scan_layers=False")
             if cfg.num_hidden_layers % cfg.scan_chunk_size != 0:
                 raise ValueError(
                     f"num_hidden_layers={cfg.num_hidden_layers} not divisible "
@@ -451,7 +481,8 @@ class LlamaModel(nn.Module):
         else:
             layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_hidden_layers):
-                x = layer_cls(cfg, name=f"layers_{i}")(x, cos, sin, positions, attn_mask)
+                x = layer_cls(cfg, i, name=f"layers_{i}")(x, cos, sin, positions,
+                                                          attn_mask)
         x = _make_norm(cfg, "norm")(x)
         # unembed: bf16 inputs ride the MXU fast path (fp32 matmul is several×
         # slower), but the accumulator stays fp32 and the *output* is emitted

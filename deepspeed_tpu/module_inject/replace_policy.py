@@ -85,13 +85,14 @@ class LlamaPolicy(HFCheckpointPolicy):
 
 
 class MistralPolicy(HFCheckpointPolicy):
-    """Mistral: llama graph w/ sliding-window attn config (served dense here;
-    reference containers/mistral)."""
+    """Mistral: llama graph w/ sliding-window attention (reference
+    containers/mistral)."""
     arch = "mistral"
 
     def config_from_hf(self, hf_config):
         cfg = super().config_from_hf(hf_config)
-        return cfg  # sliding_window handled at attention level when present
+        import dataclasses
+        return dataclasses.replace(cfg, sliding_window=hf_config.get("sliding_window"))
 
 
 class Qwen2Policy(HFCheckpointPolicy):
@@ -804,6 +805,71 @@ class GPTJPolicy(HFCheckpointPolicy):
         }
 
 
+class GPTNeoPolicy(HFCheckpointPolicy):
+    """GPT-Neo (reference ``module_inject/containers/gptneo.py``): learned
+    positions, alternating global/LOCAL (sliding-window) attention,
+    UNSCALED attention logits (no 1/sqrt(d)), bias-free qkv with biased
+    out_proj, gelu_new MLP, tied embeddings."""
+    arch = "gptneo"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        h = hf_config["hidden_size"]
+        # attention_types [[["global","local"], N]] -> per-layer pattern
+        pattern = []
+        for spec, count in hf_config.get("attention_types",
+                                         [[["global"], hf_config["num_layers"]]]):
+            pattern.extend(list(spec) * count)
+        local_layers = tuple(i for i, t in enumerate(pattern) if t == "local")
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("intermediate_size") or 4 * h,
+            num_hidden_layers=hf_config["num_layers"],
+            num_attention_heads=hf_config["num_heads"],
+            num_key_value_heads=hf_config["num_heads"],
+            max_position_embeddings=hf_config.get("max_position_embeddings", 2048),
+            rms_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            tie_word_embeddings=True,
+            attention_out_bias=True,
+            norm_type="layernorm",
+            pos_embedding="learned",
+            mlp_type="gelu_tanh_fc",
+            mlp_bias=True,
+            sliding_window=hf_config.get("window_size", 256) if local_layers else None,
+            sliding_window_layers=local_layers or None,
+            attn_scale=1.0,  # GPT-Neo does not scale attention logits
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"transformer.h.{layer}."
+        f = f"layers_{layer}/"
+        return {
+            p + "ln_1.weight": (f + "input_layernorm/scale", False),
+            p + "ln_1.bias": (f + "input_layernorm/bias", False),
+            p + "ln_2.weight": (f + "post_attention_layernorm/scale", False),
+            p + "ln_2.bias": (f + "post_attention_layernorm/bias", False),
+            p + "attn.attention.q_proj.weight": (f + "self_attn/q_proj/kernel", True),
+            p + "attn.attention.k_proj.weight": (f + "self_attn/k_proj/kernel", True),
+            p + "attn.attention.v_proj.weight": (f + "self_attn/v_proj/kernel", True),
+            p + "attn.attention.out_proj.weight": (f + "self_attn/o_proj/kernel", True),
+            p + "attn.attention.out_proj.bias": (f + "self_attn/o_proj/bias", False),
+            p + "mlp.c_fc.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.c_fc.bias": (f + "mlp/fc1/bias", False),
+            p + "mlp.c_proj.weight": (f + "mlp/fc2/kernel", True),
+            p + "mlp.c_proj.bias": (f + "mlp/fc2/bias", False),
+        }
+
+    def global_map(self, tie_embeddings: bool):
+        return {
+            "transformer.wte.weight": ("embed_tokens/embedding", False),
+            "transformer.wpe.weight": ("embed_positions/embedding", False),
+            "transformer.ln_f.weight": ("norm/scale", False),
+            "transformer.ln_f.bias": ("norm/bias", False),
+        }
+
+
 class BertPolicy:
     """BERT encoder (reference ``module_inject/containers/bert.py``
     HFBertLayerPolicy): post-LN bidirectional layers, MLM head tied to the
@@ -962,6 +1028,9 @@ _POLICIES = {
     "DistilBertForMaskedLM": DistilBertPolicy,
     "gptj": GPTJPolicy,
     "GPTJForCausalLM": GPTJPolicy,
+    "gptneo": GPTNeoPolicy,
+    "gpt_neo": GPTNeoPolicy,
+    "GPTNeoForCausalLM": GPTNeoPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -602,3 +602,59 @@ def test_gptj_interleaved_rotary_logits_match_hf():
     ours_cfg, _ = _logits_match("gptj", hf_model, cfg.to_dict())
     assert ours_cfg.rope_interleaved and ours_cfg.rotary_dim == 4
     assert ours_cfg.parallel_residual and ours_cfg.parallel_residual_norms == 1
+
+
+def test_gptneo_local_attention_logits_match_hf():
+    """GPT-Neo: alternating global/local (sliding window) attention with
+    UNSCALED logits — window small enough that locality shows in a 10-token
+    sequence."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=4,
+        max_position_embeddings=64, intermediate_size=64)
+    torch.manual_seed(13)
+    hf_model = transformers.GPTNeoForCausalLM(cfg).eval()
+    ids = np.array([[1, 5, 9, 42, 17, 3, 77, 23, 51, 60]], dtype=np.int32)
+    ours_cfg, _ = _logits_match("gptneo", hf_model, cfg.to_dict(), ids=ids)
+    assert ours_cfg.attn_scale == 1.0
+    assert ours_cfg.sliding_window == 4 and ours_cfg.sliding_window_layers == (1, )
+
+
+def test_mistral_sliding_window_config():
+    pol = policy_for("mistral")
+    cfg = pol.config_from_hf({"vocab_size": 128, "hidden_size": 32,
+                              "intermediate_size": 64, "num_hidden_layers": 2,
+                              "num_attention_heads": 4, "num_key_value_heads": 2,
+                              "sliding_window": 4096})
+    assert cfg.sliding_window == 4096 and cfg.sliding_window_layers is None
+
+
+def test_gptneo_serves_through_ragged_engine():
+    """Local/global alternating attention + unscaled logits through the v2
+    paged engine, decode correctness across the window boundary."""
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=4,
+        max_position_embeddings=64, intermediate_size=64)
+    torch.manual_seed(14)
+    hf_model = transformers.GPTNeoForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("gptneo", hf_model.state_dict(),
+                                             cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(ours_cfg, params=params, dtype=jnp.float32, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17, 3, 77, 23]  # longer than window=4
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
