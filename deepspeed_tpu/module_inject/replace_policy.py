@@ -870,6 +870,133 @@ class GPTNeoPolicy(HFCheckpointPolicy):
         }
 
 
+class Starcoder2Policy(HFCheckpointPolicy):
+    """StarCoder2: GQA + LayerNorm + biased gelu-tanh fc MLP + sliding
+    window + tied embeddings (maps onto existing variant knobs)."""
+    arch = "starcoder2"
+    col_parallel = ["q_proj", "k_proj", "v_proj", "fc1"]
+    row_parallel = ["o_proj", "fc2"]
+
+    def config_from_hf(self, hf_config):
+        bias = hf_config.get("use_bias", True)
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config.get("num_key_value_heads",
+                                              hf_config["num_attention_heads"]),
+            max_position_embeddings=hf_config.get("max_position_embeddings", 4096),
+            rms_norm_eps=hf_config.get("norm_epsilon", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", True),
+            attention_bias=bias,
+            attention_out_bias=bias,
+            norm_type="layernorm",
+            mlp_type="gelu_tanh_fc",  # HF "gelu_pytorch_tanh"
+            mlp_bias=bias,
+            sliding_window=hf_config.get("sliding_window"),
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        out = {}
+        for hf, fx in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                       ("v_proj", "v_proj"), ("o_proj", "o_proj")):
+            out[p + f"self_attn.{hf}.weight"] = (f + f"self_attn/{fx}/kernel", True)
+            if attention_bias:
+                out[p + f"self_attn.{hf}.bias"] = (f + f"self_attn/{fx}/bias", False)
+        if attention_bias:
+            out[p + "mlp.c_fc.bias"] = (f + "mlp/fc1/bias", False)
+            out[p + "mlp.c_proj.bias"] = (f + "mlp/fc2/bias", False)
+        out.update({
+            p + "mlp.c_fc.weight": (f + "mlp/fc1/kernel", True),
+            p + "mlp.c_proj.weight": (f + "mlp/fc2/kernel", True),
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/scale",
+                                                    False),
+            p + "post_attention_layernorm.bias": (f + "post_attention_layernorm/bias",
+                                                  False),
+        })
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        out = {
+            "model.embed_tokens.weight": ("embed_tokens/embedding", False),
+            "model.norm.weight": ("norm/scale", False),
+            "model.norm.bias": ("norm/bias", False),
+        }
+        if not tie_embeddings:
+            out["lm_head.weight"] = ("lm_head/kernel", True)
+        return out
+
+
+class StableLmPolicy(HFCheckpointPolicy):
+    """StableLM: llama graph with LayerNorm(+bias) norms, partial rotary,
+    optional qkv biases, untied head."""
+    arch = "stablelm"
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("use_parallel_residual"):
+            raise ValueError("stablelm use_parallel_residual=True (NeoX form) "
+                             "checkpoints are not supported by this policy")
+        if hf_config.get("qk_layernorm"):
+            raise ValueError("stablelm qk_layernorm=True is not supported")
+        hd = hf_config["hidden_size"] // hf_config["num_attention_heads"]
+        return LlamaConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_hidden_layers=hf_config["num_hidden_layers"],
+            num_attention_heads=hf_config["num_attention_heads"],
+            num_key_value_heads=hf_config.get("num_key_value_heads",
+                                              hf_config["num_attention_heads"]),
+            max_position_embeddings=hf_config.get("max_position_embeddings", 4096),
+            rms_norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            rotary_dim=int(hf_config.get("partial_rotary_factor", 0.25) * hd),
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
+            attention_bias=hf_config.get("use_qkv_bias", False),
+            norm_type="layernorm",
+        )
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        out = {}
+        for hf, fx in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                       ("v_proj", "v_proj"), ("o_proj", "o_proj")):
+            out[p + f"self_attn.{hf}.weight"] = (f + f"self_attn/{fx}/kernel", True)
+        if attention_bias:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                out[p + f"self_attn.{proj}.bias"] = (f + f"self_attn/{proj}/bias", False)
+        out.update({
+            p + "mlp.gate_proj.weight": (f + "mlp/gate_proj/kernel", True),
+            p + "mlp.up_proj.weight": (f + "mlp/up_proj/kernel", True),
+            p + "mlp.down_proj.weight": (f + "mlp/down_proj/kernel", True),
+            p + "input_layernorm.weight": (f + "input_layernorm/scale", False),
+            p + "input_layernorm.bias": (f + "input_layernorm/bias", False),
+            p + "post_attention_layernorm.weight": (f + "post_attention_layernorm/scale",
+                                                    False),
+            p + "post_attention_layernorm.bias": (f + "post_attention_layernorm/bias",
+                                                  False),
+        })
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        out = {
+            "model.embed_tokens.weight": ("embed_tokens/embedding", False),
+            "model.norm.weight": ("norm/scale", False),
+            "model.norm.bias": ("norm/bias", False),
+        }
+        if not tie_embeddings:
+            out["lm_head.weight"] = ("lm_head/kernel", True)
+        return out
+
+
 class BertPolicy:
     """BERT encoder (reference ``module_inject/containers/bert.py``
     HFBertLayerPolicy): post-LN bidirectional layers, MLM head tied to the
@@ -1031,6 +1158,10 @@ _POLICIES = {
     "gptneo": GPTNeoPolicy,
     "gpt_neo": GPTNeoPolicy,
     "GPTNeoForCausalLM": GPTNeoPolicy,
+    "starcoder2": Starcoder2Policy,
+    "Starcoder2ForCausalLM": Starcoder2Policy,
+    "stablelm": StableLmPolicy,
+    "StableLmForCausalLM": StableLmPolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -658,3 +658,30 @@ def test_gptneo_serves_through_ragged_engine():
         ref2 = hf_model(torch.tensor([prompt + [nxt]],
                                      dtype=torch.long)).logits.numpy()[0, -1]
     np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
+
+
+def test_starcoder2_logits_match_hf():
+    cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=4, use_bias=True, tie_word_embeddings=True)
+    torch.manual_seed(15)
+    hf_model = transformers.Starcoder2ForCausalLM(cfg).eval()
+    ids = np.array([[1, 5, 9, 42, 17, 3, 77, 23]], dtype=np.int32)
+    ours_cfg, _ = _logits_match("starcoder2", hf_model, cfg.to_dict(), ids=ids)
+    assert ours_cfg.sliding_window == 4 and ours_cfg.mlp_bias
+
+
+def test_stablelm_partial_rotary_logits_match_hf():
+    cfg = transformers.StableLmConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        partial_rotary_factor=0.25, use_qkv_bias=True, tie_word_embeddings=False)
+    torch.manual_seed(16)
+    hf_model = transformers.StableLmForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if n.endswith("bias"):
+                p.normal_(0, 0.3)
+    ours_cfg, _ = _logits_match("stablelm", hf_model, cfg.to_dict())
+    assert ours_cfg.rotary_dim == 2 and ours_cfg.attention_bias
