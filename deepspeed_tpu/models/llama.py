@@ -254,12 +254,15 @@ class LlamaAttention(nn.Module):
             return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
 
         use_flash = (cfg.attn_impl != "xla" and attn_mask is None
-                     and cfg.pos_embedding != "alibi" and window is None
+                     and cfg.pos_embedding != "alibi"
                      and (s <= 128 or s % 128 == 0)
                      and (cfg.attn_impl == "flash"
                           or (jax.default_backend() == "tpu" and _attn_unsharded())))
         if use_flash:
+            # the Pallas kernel handles local (sliding-window) attention
+            # natively, skipping out-of-window blocks
             attn = flash_attention(q, k, v, causal=True, scale=cfg.attn_scale,
+                                   window=window,
                                    interpret=jax.default_backend() != "tpu")
         else:
             mask = None

@@ -42,16 +42,21 @@ NEG_INF = -1e30
 LSE_MASKED = 1e30  # rows that saw no key: exp(s - LSE_MASKED) == 0
 
 
-def _xla_attention(q, k, v, scale, causal):
+def _xla_attention(q, k, v, scale, causal, window=None):
     """Reference implementation; q [B, S, H, D], k/v [B, S, KV, D] (GQA ok)."""
     B, Sq, H, D = q.shape
     KV = k.shape[2]
     G = H // KV
     qg = q.reshape(B, Sq, KV, G, D)
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
-    if causal:
+    if causal or window is not None:
         n, m = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        mask = jnp.ones((n, m), bool)
+        if causal:
+            mask &= jnp.tril(mask, k=m - n)
+        if window is not None:
+            qpos = jnp.arange(n)[:, None] + (m - n)
+            mask &= qpos - jnp.arange(m)[None, :] < window
         s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v)
@@ -71,7 +76,7 @@ def _row_pos(shape, block_q, offset):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
-                *, scale, causal, block_q, block_k, num_kv):
+                *, scale, causal, block_q, block_k, num_kv, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -88,10 +93,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if causal:
+                s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if window is not None:  # local attention: drop keys out of window
+                s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
         m_prev, l_prev = m_s[:, 0], l_s[:, 0]
         m_cur = jnp.maximum(m_prev, s.max(axis=-1))
         m_safe = jnp.where(m_cur <= NEG_INF, 0.0, m_cur)
@@ -105,12 +113,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         m_s[:, 0] = m_cur
         l_s[:, 0] = l_cur
 
+    cond = True
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        cond = ki * block_k <= qi * block_q + block_q - 1
+    if window is not None:  # skip blocks entirely older than the window
+        cond = cond & (ki * block_k + block_k - 1 >= qi * block_q - (window - 1))
+    if cond is True:
+        _compute()
+    else:
+        @pl.when(cond)
         def _():
             _compute()
-    else:
-        _compute()
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
@@ -135,7 +148,7 @@ def _regroup(q, k, v):
     return qg, kt, vt
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
@@ -148,7 +161,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     qg, kt, vt = _regroup(q, k, v)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, num_kv=num_kv)
+                               block_q=block_q, block_k=block_k, num_kv=num_kv,
+                               window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * KV, num_q, num_kv),
@@ -183,7 +197,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, scale, causal, block_q, block_k, num_kv):
+               *, scale, causal, block_q, block_k, num_kv, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -202,10 +216,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if causal:
+                s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
@@ -214,12 +231,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
+    cond = True
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        cond = ki * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        cond = cond & (ki * block_k + block_k - 1 >= qi * block_q - (window - 1))
+    if cond is True:
+        _compute()
+    else:
+        @pl.when(cond)
         def _():
             _compute()
-    else:
-        _compute()
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
@@ -229,7 +251,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_acc, dv_acc,
-                 *, scale, causal, block_q, block_k, num_q):
+                 *, scale, causal, block_q, block_k, num_q, window=None):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -249,10 +271,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if causal:
+                s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         # dv += pᵀ @ do ; dk += dsᵀ @ q — over the folded G*BQ rows, which
@@ -265,13 +290,18 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
+    cond = True
     if causal:
         # a q block contributes iff its last row can see this kv block
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        cond = qi * block_q + block_q - 1 >= ki * block_k
+    if window is not None:  # ...and its first row is not past the window
+        cond = cond & (qi * block_q <= ki * block_k + block_k - 1 + (window - 1))
+    if cond is True:
+        _compute()
+    else:
+        @pl.when(cond)
         def _():
             _compute()
-    else:
-        _compute()
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -279,7 +309,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=None):
     q, k, v, o, lse = res
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
@@ -299,7 +329,8 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_kv=num_kv),
+                          block_q=block_q, block_k=block_k, num_kv=num_kv,
+                          window=window),
         grid=(B * KV, num_q, num_kv),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
@@ -314,7 +345,8 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret):
     r_spec2 = pl.BlockSpec((1, G, block_q), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=num_q),
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          window=window),
         grid=(B * KV, num_kv, num_q),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[
@@ -344,19 +376,19 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window)
     return o
 
 
-def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
+def _bwd_rule(scale, causal, block_q, block_k, interpret, window, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret, window)
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -383,6 +415,7 @@ def flash_attention(q,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    window: Optional[int] = None,
                     force_pallas: Optional[bool] = None,
                     interpret: bool = False):
     """Blocked attention; q [B, S, H, D], k/v [B, S, KV, D] (GQA native).
@@ -397,8 +430,9 @@ def flash_attention(q,
     block_k = block_k if block_k is not None else dk
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if use_pallas(force_pallas) or interpret:
-        return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret)
-    return _xla_attention(q, k, v, scale, causal)
+        return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,
+                                window)
+    return _xla_attention(q, k, v, scale, causal, window)
 
 
 registry.register("flash_attention", "pallas" if _HAS_PLTPU else "xla", True)

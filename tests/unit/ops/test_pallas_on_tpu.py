@@ -95,3 +95,13 @@ def test_fused_adam_kernel_compiles():
     # numerics vs the plain XLA path
     p3, m3, v3 = fused_adam_step(p, g, m, v, lr=1e-3, step=1, force_pallas=False)
     np.testing.assert_allclose(np.asarray(p2), np.asarray(p3), atol=1e-6)
+
+
+@pytest.mark.skipif(not _ON_TPU, reason="real-chip Mosaic lowering check")
+def test_flash_attention_window_on_tpu():
+    import numpy as np
+    from deepspeed_tpu.ops.attention import flash_attention, _xla_attention
+    q = jax.random.normal(jax.random.PRNGKey(40), (1, 256, 4, 64), jnp.float32)
+    out = flash_attention(q, q, q, causal=True, window=64, force_pallas=True)
+    ref = _xla_attention(q, q, q, 1.0 / np.sqrt(64), True, 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-3)
