@@ -291,9 +291,18 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             logits = h2.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
             probs = jax.nn.softmax(logits, axis=-1)
             w, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
-            w = (w / jnp.sum(w, -1, keepdims=True)).astype(x.dtype)
+            if cfg.moe_renormalize:  # Mixtral; Qwen2-MoE keeps raw mass
+                w = w / jnp.sum(w, -1, keepdims=True)
+            w = w.astype(x.dtype)
             # grouped GEMM: FLOPs ∝ top-k, not E (ops/grouped_matmul.py)
-            x = x + moe_grouped_mlp(h2, moe["w1"], moe["w3"], moe["w2"], idx, w)
+            moe_out = moe_grouped_mlp(h2, moe["w1"], moe["w3"], moe["w2"], idx, w)
+            if cfg.shared_expert_intermediate_size:  # Qwen2-MoE shared expert
+                se = moe["shared_expert"]
+                shared = (jax.nn.silu(h2 @ se["gate_proj"]["kernel"])
+                          * (h2 @ se["up_proj"]["kernel"])) @ se["down_proj"]["kernel"]
+                g = h2.astype(jnp.float32) @ moe["shared_expert_gate"]["kernel"]
+                moe_out = moe_out + jax.nn.sigmoid(g).astype(x.dtype) * shared
+            x = x + moe_out
         else:
             x = x + _mlp_tok(h2, lp, cfg)
 

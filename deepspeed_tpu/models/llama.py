@@ -75,6 +75,10 @@ class LlamaConfig:
     lm_head_bias: bool = False        # Phi
     num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
     num_experts_per_tok: int = 2
+    moe_renormalize: bool = True  # Mixtral renormalizes top-k; Qwen2-MoE not
+    # Qwen2-MoE: dense "shared expert" added to the sparse output, scaled by
+    # a sigmoid gate (None = no shared expert)
+    shared_expert_intermediate_size: Optional[int] = None
     moe_grouped: bool = True      # grouped GEMM (FLOPs ∝ top-k) vs dense-over-experts
     attn_impl: str = "auto"       # "auto" | "flash" (Pallas) | "xla"
     dtype: Any = jnp.bfloat16
@@ -347,7 +351,9 @@ class LlamaMoEBlock(nn.Module):
         logits = _dense(E, "gate", (EMBED, "expert"), jnp.float32)(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
         w, idx = jax.lax.top_k(probs, k)
-        w = (w / jnp.sum(w, -1, keepdims=True)).astype(cfg.dtype)  # renormalize top-k
+        if cfg.moe_renormalize:  # Mixtral; Qwen2-MoE keeps raw softmax mass
+            w = w / jnp.sum(w, -1, keepdims=True)
+        w = w.astype(cfg.dtype)
 
         init = nn.with_partitioning(nn.initializers.lecun_normal(), ("expert", EMBED, HIDDEN))
         w1 = self.param("w1", init, (E, H, F), jnp.float32).astype(cfg.dtype)
@@ -361,7 +367,16 @@ class LlamaMoEBlock(nn.Module):
         xt = x.reshape(-1, H)
         fn = moe_grouped_mlp if cfg.moe_grouped else moe_dense_mlp
         out = fn(xt, w1, w3, w2, idx.reshape(-1, k), w.reshape(-1, k))
-        return out.reshape(*lead, H)
+        out = out.reshape(*lead, H)
+        if cfg.shared_expert_intermediate_size:  # Qwen2-MoE
+            se_cfg = dataclasses.replace(
+                cfg, intermediate_size=cfg.shared_expert_intermediate_size,
+                num_local_experts=0)
+            shared = LlamaMLP(se_cfg, name="shared_expert")(x)
+            g = _dense(1, "shared_expert_gate", (EMBED, HIDDEN), jnp.float32)(
+                x.astype(jnp.float32))
+            out = out + jax.nn.sigmoid(g).astype(cfg.dtype) * shared
+        return out
 
 
 class LlamaDecoderLayer(nn.Module):

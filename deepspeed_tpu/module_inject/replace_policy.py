@@ -135,6 +135,47 @@ class MixtralPolicy(HFCheckpointPolicy):
         return gate, experts
 
 
+class Qwen2MoePolicy(MixtralPolicy):
+    """Qwen2-MoE (reference ``inference/v2/model_implementations/qwen_v2_moe``):
+    qwen2 attention (qkv biases) + sparse MoE with NON-renormalized top-k
+    and a sigmoid-gated shared expert."""
+    arch = "qwen2_moe"
+    supports_bias = True
+
+    def config_from_hf(self, hf_config):
+        if hf_config.get("mlp_only_layers") or hf_config.get("decoder_sparse_step", 1) != 1:
+            raise ValueError("qwen2-moe variants mixing dense-MLP layers "
+                             "(mlp_only_layers/decoder_sparse_step) are not supported")
+        import dataclasses
+        cfg = HFCheckpointPolicy.config_from_hf(self, hf_config)
+        return dataclasses.replace(
+            cfg,
+            attention_bias=True,
+            intermediate_size=hf_config["moe_intermediate_size"],
+            num_local_experts=hf_config.get("num_experts", 60),
+            num_experts_per_tok=hf_config.get("num_experts_per_tok", 4),
+            moe_renormalize=bool(hf_config.get("norm_topk_prob", False)),
+            shared_expert_intermediate_size=hf_config.get(
+                "shared_expert_intermediate_size"))
+
+    def moe_map(self, layer: int, num_experts: int):
+        p = f"model.layers.{layer}.mlp."
+        f = f"layers_{layer}/block_sparse_moe/"
+        gate = {
+            p + "gate.weight": (f + "gate/kernel", True),
+            p + "shared_expert_gate.weight": (f + "shared_expert_gate/kernel", True),
+        }
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            gate[p + f"shared_expert.{proj}.weight"] = (
+                f + f"shared_expert/{proj}/kernel", True)
+        experts = {}
+        for hf_name, fx in (("gate_proj", "w1"), ("up_proj", "w3"),
+                            ("down_proj", "w2")):
+            experts[f + fx] = [p + f"experts.{e}.{hf_name}.weight"
+                               for e in range(num_experts)]
+        return gate, experts
+
+
 class Gemma2Policy(HFCheckpointPolicy):
     """Gemma-2: llama-family graph with tied embeddings by default."""
     arch = "gemma2"
@@ -1128,6 +1169,9 @@ _POLICIES = {
     "Qwen2ForCausalLM": Qwen2Policy,
     "mixtral": MixtralPolicy,
     "MixtralForCausalLM": MixtralPolicy,
+    "qwen2_moe": Qwen2MoePolicy,
+    "qwen2moe": Qwen2MoePolicy,
+    "Qwen2MoeForCausalLM": Qwen2MoePolicy,
     "gemma2": Gemma2Policy,
     "Gemma2ForCausalLM": Gemma2Policy,
     "opt": OPTPolicy,

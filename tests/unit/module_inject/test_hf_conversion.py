@@ -685,3 +685,30 @@ def test_stablelm_partial_rotary_logits_match_hf():
                 p.normal_(0, 0.3)
     ours_cfg, _ = _logits_match("stablelm", hf_model, cfg.to_dict())
     assert ours_cfg.rotary_dim == 2 and ours_cfg.attention_bias
+
+
+def test_qwen2moe_shared_expert_logits_match_hf():
+    """Qwen2-MoE: non-renormalized top-k routing + sigmoid-gated shared
+    expert + qkv biases."""
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        decoder_sparse_step=1, mlp_only_layers=[])
+    torch.manual_seed(17)
+    hf_model = transformers.Qwen2MoeForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("qwen2_moe", hf_model.state_dict(),
+                                             cfg.to_dict())
+    assert not ours_cfg.moe_renormalize
+    assert ours_cfg.shared_expert_intermediate_size == 80
+    assert ours_cfg.intermediate_size == 48  # expert width
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    ours = LlamaForCausalLM(dataclasses.replace(ours_cfg, dtype=jnp.float32,
+                                                attn_impl="xla"))
+    ids = np.array([[1, 5, 9, 42, 17, 3]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
