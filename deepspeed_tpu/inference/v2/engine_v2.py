@@ -127,6 +127,28 @@ class InferenceEngineV2:
             return 0
         return self._model.get_remaining_block_capacity(seq_desc)
 
+    def warmup(self, prefill_lens=(128, ), batch_sizes=(1, )) -> int:
+        """Precompile the bucketed forward programs serving will hit, so the
+        first real request doesn't pay compile latency (the reference's
+        CUDA-graph warmup analog). Runs scratch sequences through put() —
+        prefill at each length, plus the decode (1-token) program at each
+        concurrent batch size — then flushes them. Returns the number of
+        compiled programs cached."""
+        base = 1 << 28  # scratch uid space clear of real uids
+        for n in prefill_lens:
+            uid = base
+            self.put([uid], [np.zeros(int(n), np.int32)], do_checks=False)
+            self.put([uid], [[0]])  # decode continuation bucket
+            self.flush(uid)
+        for bs in batch_sizes:
+            uids = list(range(base + 1, base + 1 + bs))
+            for u in uids:
+                self.put([u], [[0]])
+            self.put(uids, [[0]] * bs)  # batched decode bucket
+            for u in uids:
+                self.flush(u)
+        return len(self._model._fwd_cache)
+
     # ---- convenience decode loop (the MII surface over FastGen) ----
 
     @staticmethod

@@ -50,3 +50,29 @@ def test_generate_eos_frees_kv():
     assert len(outs[0]) <= 4
     # all KV blocks returned after completion
     assert eng._state_manager.free_blocks == free0
+
+
+def test_warmup_precompiles_serving_buckets():
+    import time
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    eng = build_llama_engine(
+        cfg, seed=5, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=256), num_kv_blocks=128))
+    n = eng.warmup(prefill_lens=(16, ), batch_sizes=(4, ))
+    assert n >= 2
+    # a request hitting a warmed bucket must not add a new compiled program
+    before = len(eng.model()._fwd_cache)
+    t0 = time.perf_counter()
+    eng.put([7], [list(range(1, 17))])
+    eng.put([7], [[3]])
+    warm_t = time.perf_counter() - t0
+    assert len(eng.model()._fwd_cache) == before
+    assert warm_t < 1.0, f"warmed request took {warm_t:.2f}s (compile leak?)"
+    eng.flush(7)
