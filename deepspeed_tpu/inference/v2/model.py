@@ -95,10 +95,6 @@ class RaggedLlamaModel:
         # not a serving path)
         if attn_backend == "auto":
             attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
-        if config.pos_embedding == "alibi" or config.sliding_window is not None:
-            # the paged kernel has no logit-bias/window input; ALiBi and
-            # sliding-window ride the dense path's score tensor
-            attn_backend = "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -240,10 +236,15 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         if attn_backend == "paged":
             # Pallas blocked-flash: stream the block-table pages, online
-            # softmax — no history gather (ops/paged_attention.py)
+            # softmax — no history gather (ops/paged_attention.py); local
+            # windows, ALiBi, and custom scale are handled in-kernel
+            from ...models.llama import _layer_window
             ctx = paged_attention(
                 q_s, cache, l, batch.block_table, batch.seq_seen, seq_lens,
                 page_size=block_size,
+                window=_layer_window(cfg, l),
+                attn_scale=cfg.attn_scale,
+                use_alibi=cfg.pos_embedding == "alibi",
                 interpret=jax.default_backend() != "tpu")
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:

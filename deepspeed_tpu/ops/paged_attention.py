@@ -19,6 +19,10 @@ KV cache). TPU design, rather than a port of the CUDA atom machinery:
 - GQA is native: queries arrive grouped ``[S, N, KV, G, D]`` and each grid
   step contracts the ``N*G`` query rows of one KV head against the page —
   KV is never expanded to Q heads.
+- Sliding-window (Mistral local attention) masks in-kernel and SKIPS pages
+  entirely older than the window; ALiBi (BLOOM) adds the per-head slope bias
+  to the scores in the ``[N, G, page]`` view (no gathers); ``attn_scale``
+  overrides 1/sqrt(D) (GPT-Neo uses 1.0).
 
 Cache layout: ``[layers, 2(k/v), kv_heads, num_slots, head_dim]`` with
 ``num_slots = num_pages * page_size`` — one (layer, plane, head, page) block
@@ -26,6 +30,7 @@ is a contiguous ``[page_size, head_dim]`` strip, the unit of DMA.
 """
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,9 +41,13 @@ NEG_INF = -1e30
 
 
 def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
-                       q_ref, kv_ref, o_ref,                   # blocks
-                       m_scr, l_scr, acc_scr,                  # scratch
-                       *, page_size: int, groups: int, scale: float):
+                       q_ref, kv_ref, *rest,
+                       page_size: int, groups: int, scale: float,
+                       window: Optional[int], has_alibi: bool):
+    if has_alibi:
+        slopes_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     b = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -52,11 +61,18 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
     hist_len = lens_ref[s]   # seen + new: valid key region
     seen = seen_ref[s]
 
-    @pl.when(b * page_size < hist_len)
+    live = b * page_size < hist_len
+    if window is not None:
+        # the whole page is older than the window for EVERY query row
+        # (earliest query is at absolute position `seen`)
+        live = live & ((b + 1) * page_size - 1 > seen - window)
+
+    @pl.when(live)
     def _accumulate():
         # q: [1, N, 1, G, D] -> [N*G, D]; kv: [1, 2, 1, page, D]
         q = q_ref[...].astype(jnp.float32)
-        ng, d = q.shape[1] * q.shape[3], q.shape[4]
+        n, g, d = q.shape[1], q.shape[3], q.shape[4]
+        ng = n * g
         q = q.reshape(ng, d)
         k = kv_ref[0, 0, 0].astype(jnp.float32)  # [page, D]
         v = kv_ref[0, 1, 0].astype(jnp.float32)
@@ -73,6 +89,15 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         q_abs = seen + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 0) // groups
         mask = (key_pos <= q_abs) & (key_pos < hist_len)
+        if window is not None:
+            mask &= key_pos > q_abs - window
+        if has_alibi:
+            # [N, G, page] view: slope varies over G, distance over (N, page)
+            s3 = scores.reshape(n, g, page_size)
+            kp3 = b * page_size + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 2)
+            qa3 = seen + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
+            bias = slopes_ref[0][None, :, None] * (kp3 - qa3).astype(jnp.float32)
+            scores = (s3 + bias).reshape(ng, page_size)
 
         m_prev = m_scr[...]
         l_prev = l_scr[...]
@@ -96,9 +121,13 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         o_ref[...] = out.reshape(1, n, 1, g, d).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret", "window",
+                                             "attn_scale", "use_alibi"))
 def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
-                    *, page_size: int, interpret: bool = False):
+                    *, page_size: int, interpret: bool = False,
+                    window: Optional[int] = None,
+                    attn_scale: Optional[float] = None,
+                    use_alibi: bool = False):
     """Blocked-flash attention over a paged KV cache.
 
     Args:
@@ -108,12 +137,14 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
       block_table: ``[S, B]`` int32 page ids per sequence.
       seq_seen: ``[S]`` history length before this step.
       seq_lens: ``[S]`` seen + n_new (valid key region).
+      window: sliding-window size (None = global); ``attn_scale`` overrides
+      1/sqrt(D); ``use_alibi`` adds BLOOM-style slope bias per query head.
     Returns:
       ``[S, N, KV, G, D]`` in q.dtype.
     """
     S, N, KV, G, D = q.shape
     B = block_table.shape[1]
-    scale = 1.0 / (D ** 0.5)
+    scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
 
     def q_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
         return (s, 0, k, 0, 0)
@@ -128,13 +159,21 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     def o_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
         return (s, 0, k, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, N, 1, G, D), q_map),
+        pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
+    ]
+    inputs = [q, cache]
+    if use_alibi:
+        from ..models.llama import alibi_slopes
+        slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
+        in_specs.append(pl.BlockSpec((1, G), lambda s, k, b, *_: (k, 0)))
+        inputs.append(slopes)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(S, KV, B),
-        in_specs=[
-            pl.BlockSpec((1, N, 1, G, D), q_map),
-            pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, N, 1, G, D), o_map),
         scratch_shapes=[
             # logically [NG, 1]; lane padding is the compiler's business —
@@ -146,32 +185,44 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     )
 
     kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
-                               groups=G, scale=scale)
+                               groups=G, scale=scale, window=window,
+                               has_alibi=use_alibi)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, N, KV, G, D), q.dtype),
         interpret=interpret,
     )(jnp.asarray([layer], jnp.int32), block_table.astype(jnp.int32),
-      seq_seen.astype(jnp.int32), seq_lens.astype(jnp.int32), q, cache)
+      seq_seen.astype(jnp.int32), seq_lens.astype(jnp.int32), *inputs)
 
 
 def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
-                              *, page_size: int):
+                              *, page_size: int, window: Optional[int] = None,
+                              attn_scale: Optional[float] = None,
+                              use_alibi: bool = False):
     """Dense-gather XLA reference (the round-1 path) for numerics tests."""
     S, N, KV, G, D = q.shape
     B = block_table.shape[1]
     L = B * page_size
+    scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
     j = jnp.arange(L, dtype=jnp.int32)
     slot_grid = block_table[:, j // page_size] * page_size + j % page_size
     hist = cache[layer][:, :, slot_grid, :]           # [2, KV, S, L, D]
     k_h = jnp.moveaxis(hist[0], 1, 0).astype(jnp.float32)  # [S, KV, L, D]
     v_h = jnp.moveaxis(hist[1], 1, 0).astype(jnp.float32)
     qf = q.astype(jnp.float32)
-    scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) / (D ** 0.5)
+    scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) * scale
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
     q_abs = seq_seen[:, None] + jnp.arange(N, dtype=jnp.int32)[None, :]
     mask = (key_pos <= q_abs[:, :, None]) & (key_pos < seq_lens[:, None, None])
+    if window is not None:
+        mask &= key_pos > q_abs[:, :, None] - window
+    if use_alibi:
+        from ..models.llama import alibi_slopes
+        slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
+        dist = (key_pos[:, :, None, None, :]
+                - q_abs[:, :, None, None, None]).astype(jnp.float32)
+        scores = scores + slopes[None, None, :, :, None] * dist
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     any_visible = mask.any(-1)[:, :, None, None, None]

@@ -712,3 +712,30 @@ def test_qwen2moe_shared_expert_logits_match_hf():
         ref = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
     got = np.asarray(ours.apply({"params": params}, jnp.asarray(ids)))
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_bloom_paged_backend_matches_dense():
+    """ALiBi now rides the paged kernel (in-kernel slope bias): paged and
+    dense backends must produce the same logits for a BLOOM conversion."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.model import RaggedLlamaModel
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = transformers.BloomConfig(vocab_size=128, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(18)
+    hf_model = transformers.BloomForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("bloom", hf_model.state_dict(),
+                                             cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+
+    def mk(backend):
+        model = RaggedLlamaModel(ours_cfg, params, dtype=jnp.float32,
+                                 kv_block_size=16, attn_backend=backend)
+        return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64), num_kv_blocks=16))
+
+    prompt = [1, 5, 9, 42, 17]
+    dense = np.asarray(mk("dense").put([0], [prompt]))[0]
+    paged = np.asarray(mk("paged").put([0], [prompt]))[0]
+    np.testing.assert_allclose(paged, dense, rtol=1e-4, atol=1e-4)

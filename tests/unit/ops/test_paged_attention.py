@@ -110,3 +110,35 @@ def test_ragged_forward_paged_matches_dense():
                                   attn_backend="paged")
     np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_d),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("window", [4, 16])
+def test_sliding_window_matches_reference(window):
+    """Local attention: out-of-window pages skipped, numerics match."""
+    rng = np.random.default_rng(5)
+    S, N, KV, G, D, ps, n_pages, B = 3, 2, 2, 2, 32, 8, 32, 4
+    q, cache, bt, seen, lens = _setup(rng, S, N, KV, G, D, ps, n_pages, B,
+                                      seen=[20, 3, 0], n_new=[2, 1, 2])
+    out_k = paged_attention(q, cache, 0, bt, seen, lens, page_size=ps,
+                            window=window, interpret=INTERP)
+    out_r = paged_attention_reference(q, cache, 0, bt, seen, lens, page_size=ps,
+                                      window=window)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5)
+    # and differs from global attention where history exceeds the window
+    out_g = paged_attention_reference(q, cache, 0, bt, seen, lens, page_size=ps)
+    assert not np.allclose(np.asarray(out_r[0]), np.asarray(out_g[0]))
+
+
+def test_alibi_and_scale_match_reference():
+    rng = np.random.default_rng(6)
+    S, N, KV, G, D, ps, n_pages, B = 2, 2, 2, 2, 32, 8, 16, 3
+    q, cache, bt, seen, lens = _setup(rng, S, N, KV, G, D, ps, n_pages, B,
+                                      seen=[10, 0], n_new=[2, 2])
+    out_k = paged_attention(q, cache, 0, bt, seen, lens, page_size=ps,
+                            use_alibi=True, attn_scale=1.0, interpret=INTERP)
+    out_r = paged_attention_reference(q, cache, 0, bt, seen, lens, page_size=ps,
+                                      use_alibi=True, attn_scale=1.0)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5)
+    out_noalibi = paged_attention_reference(q, cache, 0, bt, seen, lens,
+                                            page_size=ps, attn_scale=1.0)
+    assert not np.allclose(np.asarray(out_r), np.asarray(out_noalibi))
