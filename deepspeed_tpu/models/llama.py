@@ -76,6 +76,10 @@ class LlamaConfig:
     num_local_experts: int = 0    # >0 = Mixtral-style MoE MLP
     num_experts_per_tok: int = 2
     moe_renormalize: bool = True  # Mixtral renormalizes top-k; Qwen2-MoE not
+    # >0: sow the Switch/Mixtral load-balancing loss (reference
+    # sharded_moe.py l_aux); the engine adds sown "aux_loss" scalars to the
+    # training loss
+    router_aux_loss_coef: float = 0.0
     # Qwen2-MoE: dense "shared expert" added to the sparse output, scaled by
     # a sigmoid gate (None = no shared expert)
     shared_expert_intermediate_size: Optional[int] = None
@@ -351,6 +355,13 @@ class LlamaMoEBlock(nn.Module):
         logits = _dense(E, "gate", (EMBED, "expert"), jnp.float32)(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
         w, idx = jax.lax.top_k(probs, k)
+        if cfg.router_aux_loss_coef > 0:
+            # Switch/Mixtral load balance: E * sum_e(frac_routed_e * mean_prob_e)
+            pe = probs.reshape(-1, E).mean(axis=0)
+            fe = jax.nn.one_hot(idx.reshape(-1), E).mean(axis=0)
+            self.sow("aux_loss", "moe_load_balance",
+                     cfg.router_aux_loss_coef * E * jnp.sum(fe * pe),
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.float32(0.0))
         if cfg.moe_renormalize:  # Mixtral; Qwen2-MoE keeps raw softmax mass
             w = w / jnp.sum(w, -1, keepdims=True)
         w = w.astype(cfg.dtype)

@@ -61,7 +61,16 @@ def _as_apply_fn(model) -> Callable:
     if _HAS_FLAX and isinstance(model, nn.Module):
 
         def apply_fn(params, *args, **kwargs):
-            return model.apply({"params": params}, *args, **kwargs)
+            # "aux_loss" is the contract for modules that sow auxiliary
+            # training losses (MoE router load-balancing — reference
+            # sharded_moe.py l_aux): sown scalars are ADDED to a scalar
+            # model loss; logits outputs pass through untouched
+            out, mods = model.apply({"params": params}, *args, **kwargs,
+                                    mutable=["aux_loss"])
+            aux = jax.tree_util.tree_leaves(mods.get("aux_loss", {}))
+            if aux and hasattr(out, "ndim") and out.ndim == 0:
+                out = out + sum(jnp.sum(a) for a in aux)
+            return out
 
         return apply_fn
     if callable(model):
