@@ -226,7 +226,8 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                        engine_config: Optional[RaggedInferenceEngineConfig] = None,
                        seed: int = 0,
                        dtype=None,
-                       kv_block_size: int = 64) -> InferenceEngineV2:
+                       kv_block_size: int = 64,
+                       quantize=None) -> InferenceEngineV2:
     """Factory (reference ``engine_factory.py build_hf_engine``): build a
     ragged engine from a Llama config + trained params (random if None)."""
     import jax.numpy as jnp
@@ -235,5 +236,5 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
     if params is None:
         _, params = init_llama(config, seed=seed)
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
-                             kv_block_size=kv_block_size)
+                             kv_block_size=kv_block_size, quantize=quantize)
     return InferenceEngineV2(model, engine_config)

@@ -33,6 +33,13 @@ from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 
 
+def _kernel(d):
+    """Weight accessor: dequantizes WoQ kernels in-graph (XLA fuses the
+    dequant into the consuming matmul; HBM holds int8)."""
+    k = d["kernel"]
+    return k.dequantized() if hasattr(k, "dequantized") else k
+
+
 def _rope_tok(x, cos, sin, positions, rotary_dim=None, interleaved=False):
     """Token-major rope: x [T, H, D], positions [T]; partial rotary (Phi)
     rotates only the leading rotary_dim dims; ``interleaved`` = GPT-J
@@ -67,15 +74,15 @@ def _mlp_tok(x, lp, cfg):
     """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc."""
     mlp = lp["mlp"]
     if cfg.mlp_type == "swiglu":
-        gate = jax.nn.silu(x @ mlp["gate_proj"]["kernel"])
-        return (gate * (x @ mlp["up_proj"]["kernel"])) @ mlp["down_proj"]["kernel"]
+        gate = jax.nn.silu(x @ _kernel(mlp["gate_proj"]))
+        return (gate * (x @ _kernel(mlp["up_proj"]))) @ _kernel(mlp["down_proj"])
     act = {"gelu_fc": lambda y: jax.nn.gelu(y, approximate=False),
            "gelu_tanh_fc": lambda y: jax.nn.gelu(y, approximate=True),
            "relu_fc": jax.nn.relu}[cfg.mlp_type]
-    h = x @ mlp["fc1"]["kernel"]
+    h = x @ _kernel(mlp["fc1"])
     if "bias" in mlp["fc1"]:
         h = h + mlp["fc1"]["bias"]
-    out = act(h) @ mlp["fc2"]["kernel"]
+    out = act(h) @ _kernel(mlp["fc2"])
     if "bias" in mlp["fc2"]:
         out = out + mlp["fc2"]["bias"]
     return out
@@ -85,10 +92,13 @@ class RaggedLlamaModel:
     """Paged-KV decode/prefill model over a Llama param tree."""
 
     def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64,
-                 attn_backend: str = "auto"):
+                 attn_backend: str = "auto", quantize=None):
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+        self._quantize = quantize
         # "paged" = Pallas blocked-flash decode kernel (TPU; interpret-mode on
         # CPU), "dense" = XLA gather of the full history window, "auto" =
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
@@ -98,6 +108,28 @@ class RaggedLlamaModel:
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
+        if quantize == "int8":
+            # WoQ (reference inference/v2 mixed_gemm + linear/quantization):
+            # per-layer matmul weights stored int8 + scales, dequantized
+            # in-graph. Router gates / norms / embeddings / lm_head stay fp.
+            from ...linear.quantization import QuantizedParameter
+            model_p = self.params["model"]
+            for lname, lp in model_p.items():
+                if not lname.startswith("layers_"):
+                    continue
+                def _maybe_q(node):
+                    for key, sub in list(node.items()):
+                        if key in ("gate", "shared_expert_gate"):
+                            continue
+                        if isinstance(sub, dict):
+                            if "kernel" in sub and getattr(sub["kernel"], "ndim", 0) >= 2:
+                                sub["kernel"] = QuantizedParameter.quantize(
+                                    sub["kernel"])
+                            else:
+                                _maybe_q(sub)
+                        elif key in ("w1", "w2", "w3") and getattr(sub, "ndim", 0) >= 2:
+                            node[key] = QuantizedParameter.quantize(sub)
+                _maybe_q(lp)
         # unembed in fp32 (reference keeps logits fp32; lm_head lives under
         # "model" in the training tree)
         if "lm_head" in params.get("model", {}):
@@ -212,7 +244,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         h = _norm_tok(x, lp["input_layernorm"], cfg)
 
         def proj(name, heads):
-            y = h @ lp["self_attn"][name]["kernel"]
+            y = h @ _kernel(lp["self_attn"][name])
             if "bias" in lp["self_attn"][name]:  # qwen2/OPT/Phi biases
                 y = y + lp["self_attn"][name]["bias"]
             return y.reshape(T, heads, hd)
@@ -274,7 +306,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         # back to token-major and project out
         ctx_tok = ctx[batch.token_seq, jnp.clip(rel, 0, N - 1)]  # [T, H*D]
-        attn_out = ctx_tok @ lp["self_attn"]["o_proj"]["kernel"]
+        attn_out = ctx_tok @ _kernel(lp["self_attn"]["o_proj"])
         if "bias" in lp["self_attn"]["o_proj"]:
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
@@ -296,11 +328,14 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 w = w / jnp.sum(w, -1, keepdims=True)
             w = w.astype(x.dtype)
             # grouped GEMM: FLOPs ∝ top-k, not E (ops/grouped_matmul.py)
-            moe_out = moe_grouped_mlp(h2, moe["w1"], moe["w3"], moe["w2"], idx, w)
+            def _w(name):
+                t = moe[name]
+                return t.dequantized() if hasattr(t, "dequantized") else t
+            moe_out = moe_grouped_mlp(h2, _w("w1"), _w("w3"), _w("w2"), idx, w)
             if cfg.shared_expert_intermediate_size:  # Qwen2-MoE shared expert
                 se = moe["shared_expert"]
-                shared = (jax.nn.silu(h2 @ se["gate_proj"]["kernel"])
-                          * (h2 @ se["up_proj"]["kernel"])) @ se["down_proj"]["kernel"]
+                shared = (jax.nn.silu(h2 @ _kernel(se["gate_proj"]))
+                          * (h2 @ _kernel(se["up_proj"]))) @ _kernel(se["down_proj"])
                 g = h2.astype(jnp.float32) @ moe["shared_expert_gate"]["kernel"]
                 moe_out = moe_out + jax.nn.sigmoid(g).astype(x.dtype) * shared
             x = x + moe_out

@@ -76,3 +76,35 @@ def test_warmup_precompiles_serving_buckets():
     assert len(eng.model()._fwd_cache) == before
     assert warm_t < 1.0, f"warmed request took {warm_t:.2f}s (compile leak?)"
     eng.flush(7)
+
+
+def test_int8_woq_serving():
+    """Weight-only int8 serving (reference v2 mixed_gemm / WoQ): layer
+    matmul weights live as int8+scales, logits stay close to fp and the
+    greedy token agrees."""
+    import dataclasses
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.linear.quantization import QuantizedParameter
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    ec = lambda: RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(max_context=64), num_kv_blocks=32)
+    fp = build_llama_engine(cfg, seed=11, dtype=jnp.float32, kv_block_size=16,
+                            engine_config=ec())
+    q8 = build_llama_engine(cfg, seed=11, dtype=jnp.float32, kv_block_size=16,
+                            engine_config=ec(), quantize="int8")
+    lp = q8.model().params["model"]["layers_0"]
+    assert isinstance(lp["self_attn"]["q_proj"]["kernel"], QuantizedParameter)
+    assert isinstance(lp["mlp"]["gate_proj"]["kernel"], QuantizedParameter)
+
+    prompt = [1, 5, 9, 42, 17]
+    lf = np.asarray(fp.put([0], [prompt]))[0]
+    lq = np.asarray(q8.put([0], [prompt]))[0]
+    assert int(np.argmax(lf)) == int(np.argmax(lq))
+    # int8 blockwise keeps logits within a small relative band
+    denom = np.maximum(np.abs(lf).max(), 1e-6)
+    assert np.abs(lf - lq).max() / denom < 0.15, np.abs(lf - lq).max() / denom
