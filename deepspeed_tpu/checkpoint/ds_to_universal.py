@@ -1,0 +1,8 @@
+"""Reference CLI name alias: ``python -m deepspeed_tpu.checkpoint.ds_to_universal``
+(reference ``deepspeed/checkpoint/ds_to_universal.py:469 main``) — forwards to
+the universal-checkpoint converter in ``universal.py``."""
+
+from .universal import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
