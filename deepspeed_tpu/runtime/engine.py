@@ -955,6 +955,34 @@ class DeepSpeedTpuEngine:
                 return [self._base_lr]
         return [self._base_lr]
 
+    def set_lr(self, lr):
+        """Reference ``engine.py set_lr``: override the base learning rate.
+        With a scheduler attached, the scheduler keeps driving subsequent
+        steps — override its base instead (lr_schedules expose params)."""
+        self._base_lr = float(lr)
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "set_base_lr"):
+            self.lr_scheduler.set_base_lr(float(lr))
+
+    def get_mom(self):
+        """Reference ``engine.py get_mom``: current momentum/betas."""
+        op = dict(self._config.optimizer_params or {})
+        return [tuple(op.get("betas", (0.9, 0.999)))]
+
+    def empty_partition_cache(self):
+        """Reference ZeRO-3 ``empty_partition_cache``: drop gathered full
+        params. Under pjit there is no host-visible gather cache — XLA frees
+        gathered buffers when the step program ends — so this is a documented
+        no-op kept for API portability."""
+        return None
+
+    def destroy(self):
+        """Reference ``engine.destroy``: release engine state references so
+        device memory can be reclaimed between engines in one process."""
+        for attr in ("params", "opt_state", "scale_state", "_pending"):
+            setattr(self, attr, None)
+        self._fwd_bwd = self._fwd_only = self._apply_step = None
+        self._train_step_fused = self._train_batch_fused = None
+
     def get_global_grad_norm(self):
         return None if self._last_grad_norm is None else float(self._last_grad_norm)
 

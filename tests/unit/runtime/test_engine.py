@@ -198,3 +198,20 @@ def test_save_16bit_model(tmp_path):
     for k in names:
         got = archive[k].view(ml_dtypes.bfloat16).astype(np.float32)
         np.testing.assert_allclose(got, live[k], rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.world_size(8)
+def test_misc_engine_api():
+    """set_lr / get_mom / empty_partition_cache / destroy (reference
+    engine.py surface)."""
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=base_config())
+    assert engine.get_mom() == [(0.9, 0.999)]
+    engine.set_lr(5e-3)
+    assert engine.get_lr() == [5e-3]
+    losses = train_steps(engine, n=2)
+    assert all(np.isfinite(losses))
+    engine.empty_partition_cache()
+    engine.destroy()
+    assert engine.params is None
