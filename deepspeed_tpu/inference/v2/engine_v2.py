@@ -166,43 +166,129 @@ class InferenceEngineV2:
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
         sequence in ONE ragged batch per step (the N=1 fast path), free KV on
         completion. Returns the generated token list per prompt (no prompt
-        echo)."""
+        echo).
+
+        Admission reserves DECODE headroom, not just prompt KV: a sequence
+        only enters when blocks for ``len(feed) + max_new_tokens`` fit after
+        the projected growth of every live sequence, so the decode ``put``
+        cannot run the allocator dry mid-generation. If it still does (e.g.
+        admission fell back to best-effort), the newest live sequence is
+        evicted and later replayed (prompt + tokens so far) instead of the
+        whole batch crashing."""
         rng = np.random.default_rng(seed)
         prompts = [list(map(int, np.asarray(p).reshape(-1))) for p in prompts]
         uids = list(range(len(prompts)))
         outputs = {u: [] for u in uids}
+        # tokens to prefill on (re)admission: prompt, or prompt + generated
+        # so far after an eviction
+        feed = {u: list(prompts[u]) for u in uids}
         waiting = list(uids)
         live: list = []
         last_tok = {}
+        sm = self._config.state_manager
+        max_batch_tokens = sm.max_ragged_batch_size
+        # the decode batch feeds one token per live sequence, so live count is
+        # bounded by BOTH sequence and token limits
+        max_seqs = min(sm.max_ragged_sequence_count, max_batch_tokens)
+
+        def _future_blocks(seq_desc, extra: int) -> int:
+            # the allocator's own arithmetic, not a re-derivation: blocks
+            # `extra` more tokens would need given an unlimited budget
+            _, req = self._model.get_kv_requirements(seq_desc, extra, 1 << 30)
+            return req
+
+        def _live_reserve() -> int:
+            return sum(
+                _future_blocks(self._state_manager.get_sequence(u),
+                               max(0, max_new_tokens - len(outputs[u])))
+                for u in live)
+
+        def _prefill_chunked(u) -> None:
+            """Solo SplitFuse prefill for a feed longer than one ragged batch
+            (an evicted replay); only the final chunk's logits matter."""
+            for ofs in range(0, len(feed[u]), max_batch_tokens):
+                logits = np.asarray(self.put(
+                    [u], [feed[u][ofs:ofs + max_batch_tokens]],
+                    do_checks=False))[0]
+            last_tok[u] = self._sample(logits, temperature, rng)
+            outputs[u].append(last_tok[u])
+            live.append(u)
+
         while waiting or live:
-            admit = []
+            free = self._state_manager.free_blocks - _live_reserve()
+            admit, admit_blocks = [], 0
             for u in list(waiting):
-                trial = admit + [u]
-                if self.can_schedule(trial, [len(prompts[t]) for t in trial]) \
-                        == SchedulingResult.Success:
-                    admit.append(u)
-                    waiting.remove(u)
-                else:
+                if len(live) + len(admit) >= max_seqs:
                     break
-            if not admit and not live:
-                raise SchedulingError(self.can_schedule([waiting[0]],
-                                                        [len(prompts[waiting[0]])]))
+                if _future_blocks(PlaceholderSequenceDescriptor(), len(feed[u])) \
+                        > self._state_manager.kv_cache.num_blocks:
+                    # can never prefill even with the whole cache to itself
+                    raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+                need = _future_blocks(
+                    PlaceholderSequenceDescriptor(),
+                    len(feed[u]) + max(0, max_new_tokens - len(outputs[u])))
+                if len(feed[u]) > max_batch_tokens:
+                    if admit or need > free:
+                        break
+                    waiting.remove(u)
+                    _prefill_chunked(u)
+                    break
+                trial = admit + [u]
+                if self.can_schedule(trial, [len(feed[t]) for t in trial]) \
+                        != SchedulingResult.Success:
+                    break
+                if admit_blocks + need > free:
+                    break
+                admit.append(u)
+                admit_blocks += need
+                waiting.remove(u)
+            if not admit and not live and waiting:
+                # full decode headroom will never fit — admit ONE sequence on
+                # prefill feasibility alone (the eviction path below truncates
+                # it if the cache truly runs out) rather than deadlocking
+                u = waiting[0]
+                if len(feed[u]) > max_batch_tokens:
+                    waiting.remove(u)
+                    _prefill_chunked(u)
+                else:
+                    check = self.can_schedule([u], [len(feed[u])])
+                    if check != SchedulingResult.Success:
+                        raise SchedulingError(check)
+                    admit = [waiting.pop(0)]
             if admit:
-                logits = np.asarray(self.put(admit, [prompts[u] for u in admit],
+                logits = np.asarray(self.put(admit, [feed[u] for u in admit],
                                              do_checks=False))
                 for i, u in enumerate(admit):
                     last_tok[u] = self._sample(logits[i], temperature, rng)
                     outputs[u].append(last_tok[u])
                     live.append(u)
             for u in list(live):
+                seq = self._state_manager.get_sequence(u)
                 if (len(outputs[u]) >= max_new_tokens
                         or (eos_token_id is not None
-                            and outputs[u][-1] == eos_token_id)):
+                            and outputs[u][-1] == eos_token_id)
+                        # context ceiling: retire BEFORE the decode put would
+                        # raise SequenceTokenLimitExceeded for the whole batch
+                        or seq.seen_tokens + 1 > sm.max_context):
                     live.remove(u)
                     self.flush(u)
             if not live:
                 continue
-            logits = np.asarray(self.put(live, [[last_tok[u]] for u in live]))
+            while live:
+                try:
+                    logits = np.asarray(self.put(live,
+                                                 [[last_tok[u]] for u in live]))
+                    break
+                except SchedulingError:
+                    u = live.pop()  # newest first: oldest finish soonest
+                    self.flush(u)
+                    if live:
+                        feed[u] = prompts[u] + outputs[u]
+                        waiting.insert(0, u)  # replay once blocks free up
+                    # else: lone sequence exhausted the whole cache — its
+                    # generation is truncated at the tokens produced so far
+            if not live:
+                continue
             for i, u in enumerate(live):
                 last_tok[u] = self._sample(logits[i], temperature, rng)
                 outputs[u].append(last_tok[u])

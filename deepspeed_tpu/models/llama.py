@@ -500,8 +500,10 @@ class LlamaModel(nn.Module):
                 raise ValueError(
                     f"num_hidden_layers={cfg.num_hidden_layers} not divisible "
                     f"by scan_chunk_size={cfg.scan_chunk_size}")
+            # aux_loss rides the scan as a stacked per-step axis (the engine
+            # sums all leaves, so stacking ≡ the unscanned reduce_fn sum)
             ScanLayer = nn.scan(_ScanBody,
-                                variable_axes={"params": 0},
+                                variable_axes={"params": 0, "aux_loss": 0},
                                 split_rngs={"params": True},
                                 in_axes=nn.broadcast,
                                 length=cfg.num_hidden_layers // cfg.scan_chunk_size,

@@ -51,8 +51,25 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     d = q.shape[-1]
     scale = 1.0 / (d ** 0.5)
 
-    if block_size is None or block_size >= Lk or Lk % block_size != 0:
+    if block_size is None or block_size >= Lk:
         return _dense_attention(q, k, v, biases, scale)
+
+    pad = (-Lk) % block_size
+    if pad:
+        # pad K/V to a block multiple with a -inf logit tail so the
+        # online-softmax scan (the whole memory win) still applies at
+        # AlphaFold-scale lengths that aren't block multiples — the dense
+        # fallback here would materialize exactly the O(L^2) logits this op
+        # exists to avoid
+        kv_pad = [(0, 0)] * (k.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
+        k = jnp.pad(k, kv_pad)
+        v = jnp.pad(v, kv_pad)
+        biases = tuple(
+            jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, pad)])
+            if b.shape[-1] == Lk else b for b in biases)
+        tail_mask = jnp.where(jnp.arange(Lk + pad) < Lk, 0.0, -jnp.inf)
+        biases = biases + (tail_mask.astype(jnp.float32), )
+        Lk = Lk + pad
 
     nblocks = Lk // block_size
     # [*, H, Lq, Lk] biases, split along the key axis per scan step

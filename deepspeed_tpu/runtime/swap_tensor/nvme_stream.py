@@ -70,11 +70,10 @@ class NvmeToHbmStreamer:
             # per-chunk path below is the TPU shape (PCIe transfer of chunk i
             # rides alongside the NVMe read of chunk i+1; HBM concat is
             # effectively free).
-            # reused staging buffer: a fresh 2 GB np.empty page-faults its
-            # whole span on first touch, which costs more than the read
-            if getattr(self, "_staging", None) is None or self._staging.size < nbytes:
-                self._staging = np.empty(nbytes, np.uint8)
-            buf = self._staging[:nbytes]
+            # fresh per-call buffer: XLA zero-copy-aliases numpy inputs on
+            # this backend, so the buffer handed to device_put must never be
+            # reused — ownership transfers to the returned array
+            buf = np.empty(nbytes, np.uint8)
             got = self.aio.pread(path, buf)
             if got != nbytes:
                 raise IOError(f"short read from {path}: wanted {nbytes}, got {got}")

@@ -52,6 +52,111 @@ def test_generate_eos_frees_kv():
     assert eng._state_manager.free_blocks == free0
 
 
+def test_generate_tight_kv_reserves_decode_headroom():
+    """Admission must reserve decode growth, not just prompt KV: with blocks
+    for only two full generations, three prompts must be served in waves —
+    and greedy outputs still match the sequential oracle exactly (regression:
+    the decode put() used to raise SchedulingError mid-generation)."""
+    import numpy as np
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    mk = lambda nblocks: build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64),
+            num_kv_blocks=nblocks))
+    # horizon per sequence = ceil((3 + 10)/8) = 2 blocks; 3 sequences need 6,
+    # only 4 exist -> the third must wait for a finished sequence's blocks
+    eng = mk(4)
+    prompts = [[1, 5, 9], [2, 7, 4], [11, 3, 8]]
+    outs = eng.generate(prompts, max_new_tokens=10)
+    assert all(len(o) == 10 for o in outs)
+    assert eng._state_manager.free_blocks == 4
+
+    eng2 = mk(64)  # roomy oracle, one sequence at a time
+    for p, got in zip(prompts, outs):
+        logits = np.asarray(eng2.put([99], [p]))[0]
+        seq = []
+        for _ in range(10):
+            nxt = int(np.argmax(logits))
+            seq.append(nxt)
+            logits = np.asarray(eng2.put([99], [[nxt]]))[0]
+        eng2.flush(99)
+        assert seq == got, (seq, got)
+
+
+def test_generate_lone_sequence_truncates_instead_of_crashing():
+    """A single sequence whose horizon exceeds the whole cache is admitted
+    best-effort and truncated when blocks run out — not a SchedulingError."""
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    eng = build_llama_engine(
+        cfg, seed=4, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64), num_kv_blocks=2))
+    outs = eng.generate([[1, 2, 3]], max_new_tokens=20)
+    assert 0 < len(outs[0]) < 20  # truncated, produced what fit
+    assert eng._state_manager.free_blocks == 2  # everything reclaimed
+
+
+def test_generate_long_prompt_chunked_prefill():
+    """A prompt longer than max_ragged_batch_size is prefilled SplitFuse-style
+    in chunks instead of raising BatchTokenLimitExceeded; greedy continuation
+    matches an engine with a roomy batch limit."""
+    import numpy as np
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    mk = lambda batch_tokens: build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_context=64, max_ragged_batch_size=batch_tokens,
+                max_ragged_sequence_count=min(batch_tokens, 512)),
+            num_kv_blocks=64))
+    prompt = list(np.random.default_rng(5).integers(1, cfg.vocab_size, 40))
+    tight = mk(16).generate([prompt], max_new_tokens=4)
+    roomy = mk(768).generate([prompt], max_new_tokens=4)
+    assert tight == roomy and len(tight[0]) == 4
+
+
+def test_generate_caps_live_at_sequence_limit():
+    """Admission must count already-live sequences against
+    max_ragged_sequence_count — the decode batch may never exceed it."""
+    import numpy as np
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    mk = lambda nseq: build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64,
+                                               max_ragged_sequence_count=nseq),
+            num_kv_blocks=64))
+    prompts = [[1, 5, 9], [2, 7, 4], [11, 3, 8]]
+    capped = mk(2).generate(prompts, max_new_tokens=6)
+    roomy = mk(512).generate(prompts, max_new_tokens=6)
+    assert capped == roomy and all(len(o) == 6 for o in capped)
+
+
 def test_warmup_precompiles_serving_buckets():
     import time
     import dataclasses

@@ -179,3 +179,39 @@ def test_router_aux_loss_through_engine():
     # perfectly balanced routing gives aux = coef * 1.0 per layer; any real
     # routing gives >= that — the loss must strictly increase
     assert l1 > l0 + 0.05, (l0, l1)
+
+
+@pytest.mark.world_size(8)
+def test_router_aux_loss_with_scan_layers():
+    """Regression: sow('aux_loss') inside nn.scan needs the collection
+    declared in variable_axes — scan_layers=True + router_aux_loss_coef>0
+    used to raise on the undeclared collection. The sown loss must also
+    MATCH the unscanned stack exactly (same params, same data)."""
+    import dataclasses
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+
+    base = dataclasses.replace(LlamaConfig.tiny(), num_local_experts=4,
+                               num_experts_per_tok=2, dtype=jnp.float32,
+                               router_aux_loss_coef=0.1)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, base.vocab_size,
+                                                        (4, 16)), jnp.int32)
+
+    def total_aux(cfg, params=None):
+        model, p = init_llama(cfg, seed=7)
+        p = params if params is not None else p
+        _, mods = model.apply({"params": p}, ids, mutable=["aux_loss"])
+        return sum(float(jnp.sum(a))
+                   for a in jax.tree_util.tree_leaves(mods["aux_loss"])), p
+
+    scanned, sp = total_aux(dataclasses.replace(base, scan_layers=True))
+    assert scanned > 0.1 * base.num_hidden_layers * 0.99  # >= coef per layer
+    # unscanned oracle on the SAME weights: stack the scanned params' leading
+    # layer axis into per-layer trees
+    unscanned_cfg = dataclasses.replace(base, scan_layers=False)
+    model_u, pu = init_llama(unscanned_cfg, seed=7)
+    stacked = sp["model"]["layers"]
+    for i in range(base.num_hidden_layers):
+        pu["model"][f"layers_{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], stacked["layer"])
+    got, _ = total_aux(unscanned_cfg, pu)
+    np.testing.assert_allclose(got, scanned, rtol=1e-5)

@@ -72,6 +72,34 @@ def test_reference_alias_and_bias_count():
         evoformer_attention(q, k, v, (b1, b2, b1))
 
 
+def test_non_multiple_length_stays_blocked(monkeypatch):
+    """Regression: Lk % block_size != 0 silently fell back to the dense
+    O(L^2) path. Now K/V are padded with a -inf logit tail and the
+    online-softmax scan runs — values and gradients must still match."""
+    import deepspeed_tpu.ops.evoformer_attn as ev
+
+    def boom(*a, **k):
+        raise AssertionError("dense fallback taken for non-multiple Lk")
+
+    monkeypatch.setattr(ev, "_dense_attention", boom)
+    q, k, v, b1, b2 = _inputs(jax.random.PRNGKey(5), L=48)  # 48 % 32 != 0
+    out = ev.evoformer_attention(q, k, v, (b1, b2), block_size=32)
+    monkeypatch.undo()
+    ref = _oracle(q, k, v, (b1, b2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss_fused(q, k, v, b1, b2):
+        return jnp.sum(ev.evoformer_attention(q, k, v, (b1, b2), block_size=32) ** 2)
+
+    def loss_ref(q, k, v, b1, b2):
+        return jnp.sum(_oracle(q, k, v, (b1, b2)) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(q, k, v, b1, b2)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q, k, v, b1, b2)
+    for a, b in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
 def test_bf16_io_fp32_softmax():
     q, k, v, b1, b2 = _inputs(jax.random.PRNGKey(4), L=32, dtype=jnp.bfloat16)
     out = evoformer_attention(q, k, v, (b1, b2), block_size=16)

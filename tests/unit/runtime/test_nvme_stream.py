@@ -80,6 +80,23 @@ def test_read_to_sharded_per_device(tmp_path):
     s.close()
 
 
+def test_two_reads_do_not_alias(tmp_path):
+    """Regression: the CPU fast path device_put a view of the reused staging
+    buffer; XLA's CPU backend zero-copy-aliases numpy inputs, so the SECOND
+    read silently rewrote the array returned by the FIRST."""
+    a = np.full(65536, 7, np.uint8)
+    b = np.full(65536, 9, np.uint8)
+    pa, pb = tmp_path / "a.bin", tmp_path / "b.bin"
+    pa.write_bytes(a.tobytes())
+    pb.write_bytes(b.tobytes())
+    s = NvmeToHbmStreamer(AioConfig())
+    arr_a = s.read_to_device(str(pa), a.nbytes, jnp.uint8, a.shape)
+    arr_b = s.read_to_device(str(pb), b.nbytes, jnp.uint8, b.shape)
+    np.testing.assert_array_equal(np.asarray(arr_a), a)  # must survive read #2
+    np.testing.assert_array_equal(np.asarray(arr_b), b)
+    s.close()
+
+
 def test_short_read_raises(tmp_path):
     path = tmp_path / "short.bin"
     path.write_bytes(b"\x00" * 100)
