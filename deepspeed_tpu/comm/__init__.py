@@ -2,6 +2,7 @@ from .comm import *  # noqa: F401,F403
 from .comm import (all_reduce, all_gather, all_gather_into_tensor, reduce_scatter, reduce_scatter_tensor,
                    all_to_all, all_to_all_single, broadcast, barrier, init_distributed, is_initialized,
                    get_world_size, get_rank, get_local_rank, get_axis_index, ppermute, inference_all_reduce,
-                   initialize_mesh_device, log_summary, configure, CommHandle)
+                   initialize_mesh_device, log_summary, configure, CommHandle,
+                   mpi_discovery, parse_slurm_nodelist)
 from .mesh import MeshContext, get_mesh_context, set_mesh_context, reset_mesh_context, MESH_AXES
 from .reduce_op import ReduceOp

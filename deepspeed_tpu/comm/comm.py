@@ -103,6 +103,121 @@ def timed_op(func):
 # ---------------------------------------------------------------------------
 
 
+def parse_slurm_nodelist(nodelist: str) -> list:
+    """Expand Slurm's compact nodelist syntax ("n[001-003,007],login-0",
+    bracket groups may carry suffixes or repeat: "rack[1-2]-n[1-4]") into
+    hostnames, without shelling out to ``scontrol show hostnames``."""
+
+    def _split_top(s):
+        parts, depth, cur = [], 0, []
+        for ch in s:
+            if ch == "[":
+                depth += 1
+            elif ch == "]":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            parts.append("".join(cur))
+        return parts
+
+    def _expand(tok):
+        i = tok.find("[")
+        if i < 0:
+            return [tok]
+        j = tok.index("]", i)
+        prefix, body, rest = tok[:i], tok[i + 1:j], tok[j + 1:]
+        vals = []
+        for part in body.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                width = len(lo)
+                vals.extend(f"{v:0{width}d}" for v in range(int(lo), int(hi) + 1))
+            else:
+                vals.append(part)
+        return [prefix + v + tail for v in vals for tail in _expand(rest)]
+
+    return [h for tok in _split_top(nodelist) if tok for h in _expand(tok)]
+
+
+def mpi_discovery(distributed_port: int = 29500):
+    """Derive ``(coordinator_address, num_processes, process_id)`` from the
+    scheduler environment — the rendezvous analog of reference
+    ``comm/comm.py:688 mpi_discovery`` (which allgathers rank 0's hostname
+    over mpi4py; here the coordinator is read from the launcher's env
+    directly, no MPI dependency).
+
+    Recognized environments, in priority order:
+    - explicit: ``JAX_COORDINATOR_ADDRESS`` / ``COORDINATOR_ADDRESS`` +
+      ``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` (what ``bin/deepspeed``'s ssh
+      fan-out exports)
+    - OpenMPI (``mpirun``): ``OMPI_COMM_WORLD_SIZE/RANK``; coordinator from
+      ``OMPI_MCA_orte_hnp_uri`` ("...;tcp://ip1,ip2:port" — first IP of the
+      head node)
+    - Slurm (``srun``): ``SLURM_NTASKS``/``SLURM_PROCID``; coordinator =
+      first host of ``SLURM_STEP_NODELIST``/``SLURM_JOB_NODELIST``
+    - PDSH-style: ``DS_HOSTLIST`` (comma-separated, exported identically to
+      every node) — process_id = this host's position in the list
+
+    Returns ``(None, 1, 0)`` when nothing distributed is detected.
+    """
+
+    def _env(*names, default=None):
+        for n in names:
+            if os.environ.get(n) not in (None, ""):
+                return os.environ[n]
+        return default
+
+    coord = _env("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    nproc = _env("JAX_NUM_PROCESSES", "NUM_PROCESSES")
+    pid = _env("JAX_PROCESS_ID", "PROCESS_ID")
+
+    if nproc is None and _env("OMPI_COMM_WORLD_SIZE"):
+        nproc = _env("OMPI_COMM_WORLD_SIZE")
+        pid = pid if pid is not None else _env("OMPI_COMM_WORLD_RANK", default="0")
+        if coord is None:
+            uri = _env("OMPI_MCA_orte_hnp_uri", "PMIX_SERVER_URI2", default="")
+            if "tcp://" in uri:
+                head = uri.split("tcp://", 1)[1].split(",")[0].split(":")[0]
+                coord = f"{head}:{distributed_port}"
+
+    if nproc is None and _env("SLURM_NTASKS"):
+        # STEP-scoped task count first: inside `salloc`/`sbatch` WITHOUT an
+        # srun step, SLURM_NTASKS reflects the allocation (e.g. 4) while the
+        # running shell/batch step is a single task — treating that as a
+        # 4-process rendezvous would block forever waiting for peers
+        nproc = _env("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS")
+        pid = pid if pid is not None else _env("SLURM_PROCID", default="0")
+        if coord is None:
+            nodelist = _env("SLURM_STEP_NODELIST", "SLURM_JOB_NODELIST")
+            if nodelist:
+                coord = f"{parse_slurm_nodelist(nodelist)[0]}:{distributed_port}"
+
+    if nproc is None and _env("DS_HOSTLIST"):
+        import socket
+        hosts = [h for h in _env("DS_HOSTLIST").split(",") if h]
+        nproc = str(len(hosts))
+        if pid is None:
+            me = socket.gethostname()
+            cands = [i for i, h in enumerate(hosts)
+                     if h == me or h.split(".")[0] == me.split(".")[0]]
+            if not cands:
+                raise RuntimeError(
+                    f"DS_HOSTLIST={_env('DS_HOSTLIST')} does not contain this "
+                    f"host ({me}); every node would claim process_id=0 and "
+                    "the rendezvous would hang. Use hostnames matching "
+                    "`hostname` output in the hostfile, or export "
+                    "JAX_PROCESS_ID explicitly.")
+            pid = str(cands[0])
+        if coord is None:
+            coord = f"{hosts[0]}:{distributed_port}"
+
+    return coord, int(nproc or "1"), int(pid or "0")
+
+
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
                      distributed_port: int = 29500,
@@ -123,19 +238,14 @@ def init_distributed(dist_backend: str = "xla",
     """
     global _INITIALIZED
 
-    def _env(*names, default=None):
-        for n in names:
-            if os.environ.get(n) not in (None, ""):
-                return os.environ[n]
-        return default
-
-    # the launcher exports the JAX_-prefixed spellings (launcher/runner.py
-    # build_commands); bare + OpenMPI spellings cover manual/mpirun launches
-    coord = _env("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
-    nproc = int(_env("JAX_NUM_PROCESSES", "NUM_PROCESSES",
-                     "OMPI_COMM_WORLD_SIZE", default="1"))
-    pid = int(_env("JAX_PROCESS_ID", "PROCESS_ID",
-                   "OMPI_COMM_WORLD_RANK", default="0"))
+    # scheduler env discovery: ssh fan-out (JAX_*), mpirun (OMPI_*),
+    # srun (SLURM_*), pdsh (DS_HOSTLIST) — see mpi_discovery
+    coord, nproc, pid = (mpi_discovery(distributed_port)
+                         if auto_mpi_discovery else (None, 1, 0))
+    if rank >= 0:
+        pid = rank
+    if world_size > 0:
+        nproc = world_size
     # NOTE: decide from env only — touching jax.process_count() here would
     # initialize the XLA backend and make jax.distributed.initialize raise
     # ("must be called before any JAX computations").

@@ -220,6 +220,11 @@ class InferenceEngineV2:
             for u in list(waiting):
                 if len(live) + len(admit) >= max_seqs:
                     break
+                if len(feed[u]) > sm.max_context:
+                    # chunked prefill bypasses put()'s checks, so the context
+                    # ceiling must be enforced here (a mid-chunk ValueError
+                    # from extend_kv_cache would leak the allocated blocks)
+                    raise SchedulingError(SchedulingResult.SequenceTokenLimitExceeded)
                 if _future_blocks(PlaceholderSequenceDescriptor(), len(feed[u])) \
                         > self._state_manager.kv_cache.num_blocks:
                     # can never prefill even with the whole cache to itself

@@ -77,6 +77,16 @@ def _export_env(extra: List[str]) -> Dict[str, str]:
     return env
 
 
+def _remote_command(env: Dict[str, str], script: str,
+                    script_args: List[str]) -> str:
+    """cd-to-cwd + env + python invocation, shell-quoted (shared by the ssh
+    and pdsh fan-outs so quoting/cwd fixes can't drift apart)."""
+    env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    return (f"cd {shlex.quote(os.getcwd())} && {env_str} "
+            f"{sys.executable} {shlex.quote(script)} "
+            f"{' '.join(shlex.quote(a) for a in script_args)}")
+
+
 def build_commands(hosts: List[str], master_addr: str, master_port: int,
                    script: str, script_args: List[str],
                    exports: Dict[str, str]) -> List[List[str]]:
@@ -87,10 +97,7 @@ def build_commands(hosts: List[str], master_addr: str, master_port: int,
         env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
         env["JAX_NUM_PROCESSES"] = str(len(hosts))
         env["JAX_PROCESS_ID"] = str(pid)
-        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " \
-                 f"{sys.executable} {shlex.quote(script)} " \
-                 f"{' '.join(shlex.quote(a) for a in script_args)}"
+        remote = _remote_command(env, script, script_args)
         if host in ("localhost", "127.0.0.1"):
             # local processes exec directly, no ssh (also lets tests drive a
             # real 2-process rendezvous by calling build_commands with
@@ -99,6 +106,92 @@ def build_commands(hosts: List[str], master_addr: str, master_port: int,
         else:
             cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
     return cmds
+
+
+# ---------------------------------------------------------------------------
+# multinode runners (reference launcher/multinode_runner.py:51-418)
+# ---------------------------------------------------------------------------
+
+
+class MultiNodeRunner:
+    """One fan-out backend = one command synthesis. The reference subclasses
+    (PDSH :51, OpenMPI :118, Slurm :328) each build a single launcher command
+    that starts every rank; the per-rank rendezvous env is then derived by
+    ``comm.mpi_discovery`` on each node (OMPI_*/SLURM_*/DS_HOSTLIST), so no
+    runner needs per-host command lines."""
+
+    name = "base"
+
+    def __init__(self, hosts: List[str], master_addr: str, master_port: int,
+                 exports: Dict[str, str]):
+        self.hosts = list(hosts)
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.exports = dict(exports)
+
+    def backend_exists(self) -> bool:
+        from shutil import which
+        return which(self._probe_binary) is not None
+
+    def get_cmd(self, script: str, script_args: List[str]) -> List[str]:
+        raise NotImplementedError
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out: identical command on every host; each node finds its
+    process id by locating its hostname in DS_HOSTLIST (mpi_discovery)."""
+
+    name = "pdsh"
+    _probe_binary = "pdsh"
+
+    def get_cmd(self, script, script_args):
+        env = dict(self.exports)
+        env["DS_HOSTLIST"] = ",".join(self.hosts)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.master_addr}:{self.master_port}"
+        remote = _remote_command(env, script, script_args)
+        return ["pdsh", "-S", "-f", "1024", "-w", ",".join(self.hosts), remote]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun fan-out: OMPI_COMM_WORLD_SIZE/RANK reach every rank natively;
+    the coordinator address is pinned explicitly (the OMPI hnp uri is only a
+    fallback) so rendezvous never depends on OpenMPI internals."""
+
+    name = "openmpi"
+    _probe_binary = "mpirun"
+
+    def get_cmd(self, script, script_args):
+        env = dict(self.exports)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.master_addr}:{self.master_port}"
+        cmd = ["mpirun", "-np", str(len(self.hosts)), "--host",
+               ",".join(self.hosts), "--map-by", "ppr:1:node",
+               "--allow-run-as-root"]
+        for k, v in env.items():
+            cmd += ["-x", f"{k}={v}"]
+        return cmd + [sys.executable, script] + list(script_args)
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun fan-out: SLURM_NTASKS/SLURM_PROCID reach every task; one task
+    per node (JAX is one process per host)."""
+
+    name = "slurm"
+    _probe_binary = "srun"
+
+    def get_cmd(self, script, script_args):
+        env = dict(self.exports)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.master_addr}:{self.master_port}"
+        # env rides the caller environment (--export=ALL propagates it) via
+        # an `env` prefix: srun's --export=K=V list breaks on values that
+        # contain commas, which XLA_FLAGS and friends routinely do
+        return (["env"] + [f"{k}={v}" for k, v in env.items()]
+                + ["srun", "-N", str(len(self.hosts)), "--ntasks",
+                   str(len(self.hosts)), "--ntasks-per-node", "1",
+                   "--nodelist", ",".join(self.hosts), "--export=ALL",
+                   sys.executable, script] + list(script_args))
+
+
+RUNNERS = {r.name: r for r in (PDSHRunner, OpenMPIRunner, SlurmRunner)}
 
 
 def main(argv=None):
@@ -112,6 +205,9 @@ def main(argv=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--export", action="append", default=[],
                         help="extra env var names to forward")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh"] + sorted(RUNNERS),
+                        help="fan-out backend (reference multinode_runner.py)")
     parser.add_argument("--dry_run", action="store_true",
                         help="print commands without executing")
     parser.add_argument("script")
@@ -133,8 +229,16 @@ def main(argv=None):
         os.execvpe(sys.executable, [sys.executable, args.script] + args.script_args,
                    os.environ)
 
-    cmds = build_commands(hosts, master, args.master_port, args.script,
-                          args.script_args, _export_env(args.export))
+    if args.launcher != "ssh":
+        runner = RUNNERS[args.launcher](hosts, master, args.master_port,
+                                        _export_env(args.export))
+        if not args.dry_run and not runner.backend_exists():
+            raise RuntimeError(f"--launcher {args.launcher}: "
+                               f"{runner._probe_binary} not found in PATH")
+        cmds = [runner.get_cmd(args.script, args.script_args)]
+    else:
+        cmds = build_commands(hosts, master, args.master_port, args.script,
+                              args.script_args, _export_env(args.export))
     if args.dry_run:
         for c in cmds:
             print(" ".join(shlex.quote(x) for x in c))

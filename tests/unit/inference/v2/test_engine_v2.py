@@ -134,6 +134,32 @@ def test_generate_long_prompt_chunked_prefill():
     assert tight == roomy and len(tight[0]) == 4
 
 
+def test_generate_overlong_prompt_raises_scheduling_error():
+    """A prompt beyond max_context must surface as SchedulingError BEFORE any
+    KV is allocated — not a mid-chunk ValueError that leaks blocks."""
+    import numpy as np
+    import dataclasses
+    import jax.numpy as jnp
+    import pytest
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    eng = build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_context=64, max_ragged_batch_size=16,
+                max_ragged_sequence_count=16),
+            num_kv_blocks=64))
+    prompt = list(np.random.default_rng(5).integers(1, cfg.vocab_size, 100))
+    with pytest.raises(SchedulingError):
+        eng.generate([prompt], max_new_tokens=4)
+    assert eng._state_manager.free_blocks == 64  # nothing leaked
+
+
 def test_generate_caps_live_at_sequence_limit():
     """Admission must count already-live sequences against
     max_ragged_sequence_count — the decode batch may never exceed it."""

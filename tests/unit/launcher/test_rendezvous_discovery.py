@@ -1,0 +1,159 @@
+"""Scheduler rendezvous discovery + multinode runner command synthesis.
+
+Parity targets: reference ``comm/comm.py:688 mpi_discovery`` (OpenMPI env →
+rank/world/master) and ``launcher/multinode_runner.py`` (PDSH :51,
+OpenMPI :118, Slurm :328 command builders).
+"""
+
+import sys
+
+import pytest
+
+from deepspeed_tpu.comm.comm import mpi_discovery, parse_slurm_nodelist
+from deepspeed_tpu.launcher.runner import (PDSHRunner, OpenMPIRunner,
+                                           SlurmRunner, RUNNERS, main)
+
+SCHED_VARS = [
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+    "NUM_PROCESSES", "JAX_PROCESS_ID", "PROCESS_ID", "OMPI_COMM_WORLD_SIZE",
+    "OMPI_COMM_WORLD_RANK", "OMPI_MCA_orte_hnp_uri", "PMIX_SERVER_URI2",
+    "SLURM_NTASKS", "SLURM_PROCID", "SLURM_STEP_NODELIST",
+    "SLURM_JOB_NODELIST", "DS_HOSTLIST",
+]
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for v in SCHED_VARS:
+        monkeypatch.delenv(v, raising=False)
+    return monkeypatch
+
+
+# ---- nodelist expansion ----
+
+@pytest.mark.parametrize("spec,hosts", [
+    ("node1", ["node1"]),
+    ("a,b,c", ["a", "b", "c"]),
+    ("n[1-3]", ["n1", "n2", "n3"]),
+    ("n[001-003]", ["n001", "n002", "n003"]),
+    ("n[001-002,007]", ["n001", "n002", "n007"]),
+    ("gpu[1-2],login-0", ["gpu1", "gpu2", "login-0"]),
+    ("tpu-vm-[09-11]", ["tpu-vm-09", "tpu-vm-10", "tpu-vm-11"]),
+    ("rack[1-2]-n1", ["rack1-n1", "rack2-n1"]),  # suffix after brackets
+    ("r[1-2]n[1-2]", ["r1n1", "r1n2", "r2n1", "r2n2"]),  # repeated groups
+])
+def test_parse_slurm_nodelist(spec, hosts):
+    assert parse_slurm_nodelist(spec) == hosts
+
+
+# ---- env discovery ----
+
+def test_discovery_nothing_set(clean_env):
+    assert mpi_discovery() == (None, 1, 0)
+
+
+def test_discovery_explicit_env_wins(clean_env):
+    clean_env.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:29500")
+    clean_env.setenv("JAX_NUM_PROCESSES", "4")
+    clean_env.setenv("JAX_PROCESS_ID", "2")
+    clean_env.setenv("SLURM_NTASKS", "16")  # must not override explicit env
+    assert mpi_discovery() == ("10.0.0.1:29500", 4, 2)
+
+
+def test_discovery_openmpi(clean_env):
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "5")
+    clean_env.setenv("OMPI_MCA_orte_hnp_uri",
+                     "158913789952.0;tcp://10.137.0.5,10.106.0.5:48335")
+    coord, nproc, pid = mpi_discovery(distributed_port=29511)
+    assert coord == "10.137.0.5:29511" and nproc == 8 and pid == 5
+
+
+def test_discovery_slurm(clean_env):
+    clean_env.setenv("SLURM_NTASKS", "4")
+    clean_env.setenv("SLURM_PROCID", "3")
+    clean_env.setenv("SLURM_JOB_NODELIST", "tpu[001-004]")
+    coord, nproc, pid = mpi_discovery()
+    assert coord == "tpu001:29500" and nproc == 4 and pid == 3
+
+
+def test_discovery_slurm_step_nodelist_preferred(clean_env):
+    clean_env.setenv("SLURM_NTASKS", "2")
+    clean_env.setenv("SLURM_PROCID", "1")
+    clean_env.setenv("SLURM_JOB_NODELIST", "all[1-8]")
+    clean_env.setenv("SLURM_STEP_NODELIST", "all[3-4]")
+    assert mpi_discovery()[0] == "all3:29500"
+
+
+def test_discovery_slurm_alloc_without_srun_stays_single(clean_env):
+    """`python train.py` inside salloc/sbatch WITHOUT srun: the allocation
+    advertises SLURM_NTASKS=4 but the running step is one task — a 4-way
+    rendezvous here would block forever waiting for peers."""
+    clean_env.setenv("SLURM_NTASKS", "4")
+    clean_env.setenv("SLURM_PROCID", "0")
+    clean_env.setenv("SLURM_STEP_NUM_TASKS", "1")
+    clean_env.setenv("SLURM_JOB_NODELIST", "n[1-4]")
+    assert mpi_discovery()[1] == 1
+
+
+def test_discovery_pdsh_hostlist(clean_env):
+    import socket
+    me = socket.gethostname()
+    clean_env.setenv("DS_HOSTLIST", f"head-0,{me},tail-2")
+    coord, nproc, pid = mpi_discovery()
+    assert coord == "head-0:29500" and nproc == 3 and pid == 1
+
+
+def test_discovery_pdsh_unknown_host_raises(clean_env):
+    """A hostlist that doesn't contain this machine must fail loudly —
+    silently claiming process_id=0 on every node hangs the rendezvous."""
+    clean_env.setenv("DS_HOSTLIST", "10.0.0.1,10.0.0.2")
+    with pytest.raises(RuntimeError, match="does not contain this host"):
+        mpi_discovery()
+
+
+# ---- runner command synthesis ----
+
+def test_pdsh_runner_cmd():
+    r = PDSHRunner(["h0", "h1"], "h0", 29500, {"JAX_PLATFORMS": "tpu"})
+    cmd = r.get_cmd("train.py", ["--lr", "1"])
+    assert cmd[0] == "pdsh" and cmd[cmd.index("-w") + 1] == "h0,h1"
+    remote = cmd[-1]
+    assert "DS_HOSTLIST=h0,h1" in remote
+    assert "JAX_COORDINATOR_ADDRESS=h0:29500" in remote
+    assert "train.py --lr 1" in remote
+
+
+def test_openmpi_runner_cmd():
+    r = OpenMPIRunner(["h0", "h1", "h2"], "h0", 29501, {"DS_X": "1"})
+    cmd = r.get_cmd("train.py", [])
+    assert cmd[:3] == ["mpirun", "-np", "3"]
+    assert cmd[cmd.index("--host") + 1] == "h0,h1,h2"
+    assert "-x" in cmd and "JAX_COORDINATOR_ADDRESS=h0:29501" in cmd
+    assert cmd[-2:] == [sys.executable, "train.py"][-2:]
+
+
+def test_slurm_runner_cmd():
+    # env values with commas (XLA_FLAGS etc.) must survive: they ride an
+    # `env` prefix + --export=ALL, never srun's comma-separated K=V list
+    r = SlurmRunner(["n1", "n2"], "n1", 29502, {"XLA_FLAGS": "--a=1,2 --b"})
+    cmd = r.get_cmd("train.py", ["--z"])
+    assert cmd[0] == "env"
+    assert "XLA_FLAGS=--a=1,2 --b" in cmd
+    assert "JAX_COORDINATOR_ADDRESS=n1:29502" in cmd
+    s = cmd.index("srun")
+    assert cmd[cmd.index("--ntasks-per-node") + 1] == "1"
+    assert cmd[cmd.index("--nodelist") + 1] == "n1,n2"
+    assert "--export=ALL" in cmd and cmd[-1] == "--z" and s > 0
+
+
+def test_main_dry_run_with_launcher(tmp_path, capsys):
+    hf = tmp_path / "hostfile"
+    hf.write_text("h0 slots=1\nh1 slots=1\n")
+    rc = main(["-H", str(hf), "--launcher", "slurm", "--dry_run", "train.py"])
+    out = capsys.readouterr().out
+    assert rc == 0 and out.startswith("env ") and "--ntasks 2" in out
+
+
+def test_runner_registry_names():
+    assert set(RUNNERS) == {"pdsh", "openmpi", "slurm"}
