@@ -143,7 +143,7 @@ def parse_slurm_nodelist(nodelist: str) -> list:
     return [h for tok in _split_top(nodelist) if tok for h in _expand(tok)]
 
 
-def mpi_discovery(distributed_port: int = 29500):
+def mpi_discovery(distributed_port: int = 29500, auto: bool = True):
     """Derive ``(coordinator_address, num_processes, process_id)`` from the
     scheduler environment — the rendezvous analog of reference
     ``comm/comm.py:688 mpi_discovery`` (which allgathers rank 0's hostname
@@ -162,7 +162,12 @@ def mpi_discovery(distributed_port: int = 29500):
     - PDSH-style: ``DS_HOSTLIST`` (comma-separated, exported identically to
       every node) — process_id = this host's position in the list
 
-    Returns ``(None, 1, 0)`` when nothing distributed is detected.
+    Returns ``(None, 1, 0)`` when nothing distributed is detected. Each of
+    the three fields is resolved INDEPENDENTLY: explicit env always wins,
+    and whichever scheduler family is present fills only the missing pieces
+    (so ``mpirun -x JAX_NUM_PROCESSES=4`` still gets its rank from
+    ``OMPI_COMM_WORLD_RANK``). ``auto=False`` disables scheduler probing but
+    keeps the explicit env contract.
     """
 
     def _env(*names, default=None):
@@ -175,31 +180,30 @@ def mpi_discovery(distributed_port: int = 29500):
     nproc = _env("JAX_NUM_PROCESSES", "NUM_PROCESSES")
     pid = _env("JAX_PROCESS_ID", "PROCESS_ID")
 
-    if nproc is None and _env("OMPI_COMM_WORLD_SIZE"):
-        nproc = _env("OMPI_COMM_WORLD_SIZE")
+    if auto and _env("OMPI_COMM_WORLD_SIZE"):
+        nproc = nproc if nproc is not None else _env("OMPI_COMM_WORLD_SIZE")
         pid = pid if pid is not None else _env("OMPI_COMM_WORLD_RANK", default="0")
         if coord is None:
             uri = _env("OMPI_MCA_orte_hnp_uri", "PMIX_SERVER_URI2", default="")
             if "tcp://" in uri:
                 head = uri.split("tcp://", 1)[1].split(",")[0].split(":")[0]
                 coord = f"{head}:{distributed_port}"
-
-    if nproc is None and _env("SLURM_NTASKS"):
+    elif auto and _env("SLURM_NTASKS"):
         # STEP-scoped task count first: inside `salloc`/`sbatch` WITHOUT an
         # srun step, SLURM_NTASKS reflects the allocation (e.g. 4) while the
         # running shell/batch step is a single task — treating that as a
         # 4-process rendezvous would block forever waiting for peers
-        nproc = _env("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS")
+        nproc = nproc if nproc is not None \
+            else _env("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS")
         pid = pid if pid is not None else _env("SLURM_PROCID", default="0")
         if coord is None:
             nodelist = _env("SLURM_STEP_NODELIST", "SLURM_JOB_NODELIST")
             if nodelist:
                 coord = f"{parse_slurm_nodelist(nodelist)[0]}:{distributed_port}"
-
-    if nproc is None and _env("DS_HOSTLIST"):
+    elif auto and _env("DS_HOSTLIST"):
         import socket
         hosts = [h for h in _env("DS_HOSTLIST").split(",") if h]
-        nproc = str(len(hosts))
+        nproc = nproc if nproc is not None else str(len(hosts))
         if pid is None:
             me = socket.gethostname()
             cands = [i for i, h in enumerate(hosts)
@@ -238,10 +242,10 @@ def init_distributed(dist_backend: str = "xla",
     """
     global _INITIALIZED
 
-    # scheduler env discovery: ssh fan-out (JAX_*), mpirun (OMPI_*),
-    # srun (SLURM_*), pdsh (DS_HOSTLIST) — see mpi_discovery
-    coord, nproc, pid = (mpi_discovery(distributed_port)
-                         if auto_mpi_discovery else (None, 1, 0))
+    # scheduler env discovery: ssh fan-out (JAX_*, always honored), plus
+    # mpirun (OMPI_*) / srun (SLURM_*) / pdsh (DS_HOSTLIST) probing unless
+    # auto_mpi_discovery=False — see mpi_discovery
+    coord, nproc, pid = mpi_discovery(distributed_port, auto=auto_mpi_discovery)
     if rank >= 0:
         pid = rank
     if world_size > 0:

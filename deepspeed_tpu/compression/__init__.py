@@ -1,4 +1,5 @@
-from .compress import init_compression, redundancy_clean, CompressionTransform
+from .compress import (init_compression, redundancy_clean, CompressionTransform,
+                       student_initialization)
 from .basic_layer import (quantize_weight_ste, quantize_activation, prune_magnitude,
                           prune_rows, prune_heads, prune_channels)
 from .scheduler import CompressionScheduler

@@ -81,7 +81,18 @@ class CompressionTransform:
         rules = []
         for tech in _TECHNIQUES:
             block = cc.get(tech)
-            if not block or tech == "layer_reduction":
+            if tech == "layer_reduction":
+                if block and block.get("enabled", False):
+                    # not a per-forward transform: depth reduction happens at
+                    # init via student_initialization — surface that instead
+                    # of silently accepting the key
+                    logger.warning(
+                        "layer_reduction is applied by "
+                        "compression.student_initialization(student_params, "
+                        "teacher_params, ds_config) at model build time, not "
+                        "by init_compression's forward transform")
+                continue
+            if not block:
                 continue
             shared = block.get("shared_parameters", {})
             if not shared.get("enabled", False):
@@ -149,6 +160,79 @@ def init_compression(apply_fn: Callable, ds_config, mpu=None,
         return apply_fn(transform(params, step), *args, **kwargs)
 
     return compressed_apply, transform
+
+
+def _resolve_path(tree: dict, dotted: str):
+    """Walk 'a.b.c' into a nested dict; returns (parent, leaf_key) or None."""
+    parts = [p for p in dotted.split(".") if p]
+    node, parent, key = tree, None, None
+    for p in parts:
+        if not isinstance(node, dict) or p not in node:
+            return None
+        parent, key = node, p
+        node = node[p]
+    return parent, key
+
+
+def student_initialization(student_params, teacher_params, ds_config):
+    """Depth-reduction (distillation) student init — reference
+    ``compression/compress.py:192 student_initialization``: copy the teacher
+    layers listed in ``teacher_layer`` onto the student's (fewer) layers, and
+    copy ``other_module_name`` subtrees (embeddings, pooler, lm head)
+    verbatim. Returns a NEW student param tree.
+
+    Config block (same keys as the reference)::
+
+        "compression_training": {"layer_reduction": {
+            "enabled": true,
+            "keep_number_layer": 2,
+            "module_name_prefix": "model",   # subtree holding layers_{i}
+            "teacher_layer": [1, 3],          # teacher depth indices to keep
+            "other_module_name": ["model.embed_tokens", "model.norm",
+                                  "model.lm_head"]}}
+
+    The reference addresses torch modules ``{prefix}.{i}.``; flax layer
+    children are ``layers_{i}`` under the prefix subtree (both spellings of
+    the prefix — with or without a trailing ``.layers`` — are accepted).
+    """
+    cc = check_deepspeed_config(ds_config).get("layer_reduction", {})
+    if not cc or not cc.get("enabled", False):
+        return student_params
+    keep = int(cc["keep_number_layer"])
+    teacher_layer = list(cc["teacher_layer"])
+    if len(teacher_layer) != keep:
+        raise ValueError(f"layer_reduction: keep_number_layer={keep} but "
+                         f"teacher_layer has {len(teacher_layer)} entries")
+    prefix = cc.get("module_name_prefix", "model")
+    if prefix.endswith(".layers"):  # torch spelling of the flax layers_{i}
+        prefix = prefix[:-len(".layers")]
+
+    student = jax.tree_util.tree_map(lambda x: x, student_params)  # copy tree
+
+    def _subtree(tree, dotted):
+        hit = _resolve_path(tree, dotted)
+        if hit is None:
+            raise KeyError(f"layer_reduction: '{dotted}' not found in params "
+                           f"(top-level keys: {list(tree)})")
+        parent, key = hit
+        return parent[key], parent, key
+
+    t_sub, _, _ = _subtree(teacher_params, prefix)
+    s_sub, _, _ = _subtree(student, prefix)
+    for j, t_idx in enumerate(teacher_layer):
+        t_name, s_name = f"layers_{t_idx}", f"layers_{j}"
+        if t_name not in t_sub:
+            raise KeyError(f"layer_reduction: teacher has no '{prefix}.{t_name}' "
+                           "(scan_layers trees are stacked — unstack first)")
+        if s_name not in s_sub:
+            raise KeyError(f"layer_reduction: student has no '{prefix}.{s_name}' "
+                           f"(expected {keep} layers)")
+        s_sub[s_name] = jax.tree_util.tree_map(lambda x: x, t_sub[t_name])
+    for name in cc.get("other_module_name", []):
+        src, _, _ = _subtree(teacher_params, name)
+        _, parent, key = _subtree(student, name)
+        parent[key] = jax.tree_util.tree_map(lambda x: x, src)
+    return student
 
 
 def redundancy_clean(params, ds_config, mpu=None):

@@ -17,6 +17,7 @@ logits_gather). TPU design:
   a trained checkpoint serves directly.
 """
 
+import functools
 from functools import partial
 from typing import Tuple
 
@@ -174,7 +175,36 @@ class RaggedLlamaModel:
             seq_desc.extend_kv_cache(self._state_manager.allocate_blocks(req))
 
     def maybe_free_kv(self, seq_desc) -> None:
-        pass  # dense cache retains all blocks until flush
+        """Mid-sequence trailing-window block release (reference
+        ``inference_model_base.py:234`` — the sliding-window example in its
+        docstring). Global attention retains every block until flush; when
+        ALL layers attend through a local window, tokens at positions
+        ``<= seen - W`` can never be attended again, so whole leading blocks
+        return to the allocator while the sequence keeps decoding."""
+        W = self._uniform_window
+        if W is None:
+            return
+        # the next query position is seen_tokens; the window mask keeps
+        # key_pos > q_pos - W, so the first position still reachable is
+        # seen - W + 1 — blocks wholly below it are dead
+        first_needed = seq_desc.seen_tokens - W + 1
+        if first_needed <= 0:
+            return
+        freed = seq_desc.free_prefix_blocks(first_needed // self.kv_block_size)
+        if freed:
+            self._state_manager.release_blocks(freed)
+
+    @functools.cached_property
+    def _uniform_window(self):
+        """max window when EVERY layer attends locally, else None (any
+        global layer pins the whole history). Pure function of the config —
+        hoisted off the per-token decode path."""
+        cfg = self.config
+        if cfg.sliding_window is None:
+            return None
+        from ...models.llama import _layer_window
+        windows = [_layer_window(cfg, l) for l in range(cfg.num_hidden_layers)]
+        return None if any(w is None for w in windows) else max(windows)
 
     def prepare_batch(self, batch) -> None:
         pass

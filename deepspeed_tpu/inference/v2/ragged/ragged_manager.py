@@ -83,3 +83,9 @@ class DSStateManager:
 
     def allocate_blocks(self, n_blocks: int):
         return self._allocator.allocate(n_blocks)
+
+    def release_blocks(self, blocks) -> None:
+        """Return individual blocks mid-sequence (trailing-window release,
+        model.maybe_free_kv) without touching sequence tracking."""
+        if len(blocks):
+            self._allocator.free(blocks)

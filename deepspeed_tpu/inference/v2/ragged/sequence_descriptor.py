@@ -47,6 +47,7 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
         self._in_flight_tokens = 0
         self._max_blocks = max_blocks_per_seq
         self._blocks: List[int] = []
+        self._freed_through = 0  # table indices < this are released (None)
 
     @property
     def seen_tokens(self) -> int:
@@ -62,15 +63,36 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
 
     @property
     def kv_blocks(self) -> List[int]:
-        return self._blocks
+        """LIVE block ids (prefix entries released by free_prefix_blocks are
+        excluded — they belong to the allocator again)."""
+        return [b for b in self._blocks if b is not None]
 
     def block_table(self, width: int) -> np.ndarray:
         """Dense int32 block table padded to `width` with 0 (padded entries are
-        masked out by position bounds in the attention kernel)."""
+        masked out by position bounds in the attention kernel; freed-prefix
+        entries keep their POSITION with a 0 placeholder — every reader of
+        those positions is masked by the attention window that justified the
+        free)."""
         t = np.zeros(width, dtype=np.int32)
         n = min(len(self._blocks), width)
-        t[:n] = self._blocks[:n]
+        t[:n] = [0 if b is None else b for b in self._blocks[:n]]
         return t
+
+    def free_prefix_blocks(self, through_block: int) -> List[int]:
+        """Release the blocks at table indices [0, through_block) — their
+        whole token range has fallen out of every attention window. Returns
+        the freed block ids; table positions are retained (the position→block
+        mapping for live tail blocks must not shift)."""
+        freed = []
+        # cursor: each block is visited exactly once over a generation, not
+        # O(dead prefix) per decoded token
+        for i in range(self._freed_through, min(through_block, len(self._blocks))):
+            if self._blocks[i] is not None:
+                freed.append(self._blocks[i])
+                self._blocks[i] = None
+        self._freed_through = max(self._freed_through,
+                                  min(through_block, len(self._blocks)))
+        return freed
 
     def extend_kv_cache(self, new_blocks) -> None:
         blocks = [int(b) for b in np.atleast_1d(new_blocks)]

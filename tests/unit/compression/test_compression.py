@@ -128,3 +128,89 @@ class TestConfigDriven:
                                        "schedule_offset_end": 3}])
         assert sched.active(0) == {"a": False, "b": True}
         assert sched.active(6) == {"a": True, "b": False}
+
+
+class TestLayerReduction:
+    """Depth-reduction student init (reference compress.py:192
+    student_initialization): teacher layers map onto the shallower student,
+    and the distillation loss beats random init."""
+
+    CFG = {"compression_training": {"layer_reduction": {
+        "enabled": True,
+        "keep_number_layer": 2,
+        "module_name_prefix": "model",
+        "teacher_layer": [1, 3],
+        "other_module_name": ["model.embed_tokens", "model.norm",
+                              "model.lm_head"]}}}
+
+    def _models(self):
+        import dataclasses
+        from deepspeed_tpu.models import LlamaConfig, init_llama
+        base = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        t_cfg = dataclasses.replace(base, num_hidden_layers=4)
+        s_cfg = dataclasses.replace(base, num_hidden_layers=2)
+        teacher, t_params = init_llama(t_cfg, seed=0)
+        student, s_params = init_llama(s_cfg, seed=123)
+        return teacher, t_params, student, s_params, t_cfg
+
+    def test_student_initialization_maps_layers(self):
+        from deepspeed_tpu.compression import student_initialization
+        _, t_params, _, s_params, _ = self._models()
+        out = student_initialization(s_params, t_params, self.CFG)
+        for j, t_idx in enumerate([1, 3]):
+            a = jax.tree_util.tree_leaves(out["model"][f"layers_{j}"])
+            b = jax.tree_util.tree_leaves(t_params["model"][f"layers_{t_idx}"])
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        np.testing.assert_array_equal(
+            np.asarray(out["model"]["embed_tokens"]["embedding"]),
+            np.asarray(t_params["model"]["embed_tokens"]["embedding"]))
+        # untouched: the original student tree was not mutated
+        assert not np.array_equal(
+            np.asarray(s_params["model"]["layers_0"]["mlp"]["gate_proj"]["kernel"]),
+            np.asarray(out["model"]["layers_0"]["mlp"]["gate_proj"]["kernel"]))
+
+    def test_bad_config_raises(self):
+        from deepspeed_tpu.compression import student_initialization
+        _, t_params, _, s_params, _ = self._models()
+        bad = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "module_name_prefix": "model", "teacher_layer": [1, 2, 3]}}}
+        with pytest.raises(ValueError, match="keep_number_layer"):
+            student_initialization(s_params, t_params, bad)
+        bad2 = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 1,
+            "module_name_prefix": "nope", "teacher_layer": [0]}}}
+        with pytest.raises(KeyError, match="not found"):
+            student_initialization(s_params, t_params, bad2)
+
+    def test_distillation_beats_random_init(self):
+        import optax
+        from deepspeed_tpu.compression import student_initialization
+        teacher, t_params, student, s_params, t_cfg = self._models()
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, t_cfg.vocab_size, (8, 16)), jnp.int32)
+        t_logits = teacher.apply({"params": t_params}, ids)
+
+        def kl_loss(params):
+            s_logits = student.apply({"params": params}, ids)
+            t_lp = jax.nn.log_softmax(t_logits)
+            s_lp = jax.nn.log_softmax(s_logits)
+            return jnp.mean(jnp.sum(jnp.exp(t_lp) * (t_lp - s_lp), axis=-1))
+
+        def train(params, steps=15):
+            opt = optax.adam(3e-3)
+            state = opt.init(params)
+
+            @jax.jit
+            def one(p, s):
+                g = jax.grad(kl_loss)(p)
+                u, s = opt.update(g, s, p)
+                return optax.apply_updates(p, u), s
+            for _ in range(steps):
+                params, state = one(params, state)
+            return float(kl_loss(params))
+
+        distilled = train(student_initialization(s_params, t_params, self.CFG))
+        scratch = train(s_params)
+        assert distilled < scratch, (distilled, scratch)

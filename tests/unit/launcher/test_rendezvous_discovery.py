@@ -85,6 +85,28 @@ def test_discovery_slurm_step_nodelist_preferred(clean_env):
     assert mpi_discovery()[0] == "all3:29500"
 
 
+def test_discovery_explicit_env_survives_auto_off(clean_env):
+    """auto=False (init_distributed(auto_mpi_discovery=False)) disables
+    scheduler probing but must keep the launcher's explicit env contract."""
+    clean_env.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:29500")
+    clean_env.setenv("JAX_NUM_PROCESSES", "2")
+    clean_env.setenv("JAX_PROCESS_ID", "1")
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "8")  # probing-only: ignored
+    assert mpi_discovery(auto=False) == ("10.0.0.1:29500", 2, 1)
+    clean_env.delenv("JAX_COORDINATOR_ADDRESS")
+    assert mpi_discovery(auto=False) == (None, 2, 1)
+
+
+def test_discovery_fields_resolve_independently(clean_env):
+    """`mpirun -x JAX_NUM_PROCESSES=4`: nproc comes from explicit env but the
+    RANK must still come from OMPI_COMM_WORLD_RANK (not default to 0)."""
+    clean_env.setenv("JAX_NUM_PROCESSES", "4")
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "3")
+    clean_env.setenv("OMPI_MCA_orte_hnp_uri", "1.0;tcp://10.1.0.9:400")
+    assert mpi_discovery() == ("10.1.0.9:29500", 4, 3)
+
+
 def test_discovery_slurm_alloc_without_srun_stays_single(clean_env):
     """`python train.py` inside salloc/sbatch WITHOUT srun: the allocation
     advertises SLURM_NTASKS=4 but the running step is one task — a 4-way
