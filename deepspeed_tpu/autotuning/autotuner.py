@@ -3,16 +3,25 @@
 Reference: ``deepspeed/autotuning/autotuner.py:42 Autotuner`` +
 ``scheduler.py:32 ResourceManager`` + ``tuner/{grid_search,random,
 model_based}``. The reference forks whole training jobs per experiment over
-the launcher; on TPU (single-controller SPMD) each experiment is an
-in-process engine build + a few timed steps — the search logic and result
-layout carry over, the multi-node experiment scheduler collapses away.
+the launcher; on TPU (single-controller SPMD) each experiment is an engine
+build + a few timed steps — in-process by default, or in a fresh child
+process per experiment (``exp_isolation``, the reference scheduler's
+process-per-experiment shape) so an XLA OOM/abort cannot poison the rest of
+the search.
 
 Search space (reference tune_space): ZeRO stage ∈ {0,1,2,3}, micro-batch ∈
 powers of two up to the HBM ceiling (OOM candidates are caught and marked
 infeasible, the reference's "error" exp status), remat on/off. Metric:
 latency | throughput | flops (reference autotuning config metric).
+
+``tuner_type="model_based"`` is a sequential model-based search (reference
+``tuner/model_based_tuner.py:19``): seed measurements → fit a ridge cost
+model on config features → evaluate the best-predicted unvisited candidate,
+with ε-greedy random exploration — XGBoost swapped for a closed-form
+surrogate with the same fit/predict/argmax loop (no extra dependency).
 """
 
+import inspect
 import itertools
 import json
 import os
@@ -20,6 +29,7 @@ import random
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
 import jax
 
 from ..utils.logging import logger
@@ -38,6 +48,103 @@ class _Experiment:
     def record(self) -> Dict[str, Any]:
         return {"exp_id": self.exp_id, "config": self.config, "status": self.status,
                 "metric_val": self.metric_val, "error": self.error}
+
+
+class CostModel:
+    """Ridge-regression surrogate over candidate features (the reference's
+    ``XGBoostCostModel`` role: fit measured configs, rank the rest)."""
+
+    def __init__(self, ridge: float = 1e-3):
+        self.ridge = ridge
+        self._w: Optional[np.ndarray] = None
+
+    @staticmethod
+    def features(cand: Dict[str, Any]) -> np.ndarray:
+        mb = float(cand["train_micro_batch_size_per_gpu"])
+        lb = np.log2(mb)
+        stage = float(cand["zero_stage"])
+        remat = float(bool(cand["remat"]))
+        # quadratic basis: batch-size sweet spots and stage overheads are
+        # unimodal, which a purely linear surrogate cannot rank
+        return np.array([1.0, lb, lb * lb, stage, stage * stage, remat,
+                         lb * stage, stage * remat, lb * remat], np.float64)
+
+    def fit(self, cands: List[Dict[str, Any]], perf: List[float]) -> None:
+        X = np.stack([self.features(c) for c in cands])
+        y = np.asarray(perf, np.float64)
+        A = X.T @ X + self.ridge * np.eye(X.shape[1])
+        self._w = np.linalg.solve(A, X.T @ y)
+
+    def predict(self, cands: List[Dict[str, Any]]) -> np.ndarray:
+        if self._w is None:
+            return np.zeros(len(cands))
+        return np.stack([self.features(c) for c in cands]) @ self._w
+
+
+def _build_exp_config(base_config: Dict[str, Any], cand: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+    cfg = json.loads(json.dumps(base_config))  # deep copy; exps must not alias
+    cfg.pop("autotuning", None)
+    cfg["train_micro_batch_size_per_gpu"] = cand["train_micro_batch_size_per_gpu"]
+    cfg.pop("train_batch_size", None)
+    cfg["gradient_accumulation_steps"] = cfg.get("gradient_accumulation_steps", 1)
+    cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
+    if cand["remat"]:
+        cfg["activation_checkpointing"] = {"remat_policy": "nothing_saveable"}
+    return cfg
+
+
+def run_candidate(base_config: Dict[str, Any], cand: Dict[str, Any],
+                  steps: int, model_builder: Callable, metric: str
+                  ) -> Dict[str, Any]:
+    """One experiment, start to finish (module-level so ``exp_isolation`` can
+    ship it to a spawned child). Returns {"status", "metric_val", "error"}."""
+    import deepspeed_tpu
+    from ..comm.mesh import reset_mesh_context
+    import jax.numpy as jnp
+
+    try:
+        cfg = _build_exp_config(base_config, cand)
+        reset_mesh_context()
+        # builders may accept the candidate (per-exp model wiring, the
+        # reference's per-exp ds_config) or take no arguments
+        if len(inspect.signature(model_builder).parameters) >= 1:
+            model, params = model_builder(cand)
+        else:
+            model, params = model_builder()
+        engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                              config=cfg)
+        hidden = np.asarray(jax.tree_util.tree_leaves(params)[0]).shape[0]
+        bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+        x = jnp.ones((bs, hidden), jnp.float32)
+        y = jnp.zeros_like(x)
+        # warmup (compile), then timed steps
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.forward(x, y)
+            engine.backward(loss)
+            engine.step()
+        float(loss)  # host sync closes the timing region
+        dt = (time.perf_counter() - t0) / steps
+        if metric == "latency":
+            val = -dt  # maximize
+        else:  # throughput (samples/s); flops metric folds into this rank
+            val = engine.train_batch_size() / dt
+        return {"status": "done", "metric_val": val, "error": None}
+    except Exception as e:  # infeasible config (OOM etc.)
+        return {"status": "error", "metric_val": None,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def _isolated_child(conn, base_config, cand, steps, model_builder, metric):
+    """Spawned-process entry: run the experiment, ship the result back."""
+    try:
+        conn.send(run_candidate(base_config, cand, steps, model_builder, metric))
+    finally:
+        conn.close()
 
 
 class Autotuner:
@@ -88,67 +195,89 @@ class Autotuner:
             rng.shuffle(space)
             return space
         if kind == "model_based":
-            # cheap surrogate: larger micro-batch and lower stage first
-            # (higher predicted throughput), refine from measurements
+            # seed ordering only (the adaptive loop re-ranks after every
+            # measurement): larger micro-batch and lower stage first
             return sorted(space, key=lambda c: (-c["train_micro_batch_size_per_gpu"],
                                                 c["zero_stage"], c["remat"]))
         return space  # gridsearch
 
-    # ---- experiment runner (reference scheduler.run_job, in-process) ----
+    # ---- experiment runner (reference scheduler.run_job) ----
+
+    def _measure(self, cand: Dict[str, Any], steps: int) -> Dict[str, Any]:
+        if not self.cfg.exp_isolation:
+            return run_candidate(self.base_config, cand, steps,
+                                 self.model_builder, self.cfg.metric)
+        # fresh child per experiment (reference scheduler.py:32 isolates
+        # experiments for exactly this reason): a hard death — XLA OOM abort,
+        # SIGKILL — is an "error" experiment, not a dead search. Raw Process
+        # (not ProcessPoolExecutor, whose shutdown blocks on a hung worker)
+        # so exp_timeout can terminate a wedged child for real.
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        recv, send = ctx.Pipe(duplex=False)
+        try:
+            proc = ctx.Process(target=_isolated_child,
+                               args=(send, self.base_config, cand, steps,
+                                     self.model_builder, self.cfg.metric))
+            proc.start()
+        except Exception as e:  # unpicklable builder etc.
+            return {"status": "error", "metric_val": None,
+                    "error": f"{type(e).__name__}: {e}"}
+        send.close()  # our copy; the child's stays open until it exits
+        try:
+            if recv.poll(self.cfg.exp_timeout):
+                try:
+                    return recv.recv()
+                except EOFError:  # child died before sending (OOM/abort)
+                    return {"status": "error", "metric_val": None,
+                            "error": "child process died (OOM/abort)"}
+            proc.terminate()
+            return {"status": "error", "metric_val": None,
+                    "error": f"experiment exceeded {self.cfg.exp_timeout}s"}
+        finally:
+            proc.join(5)
+            if proc.is_alive():
+                proc.kill()
+                proc.join()
+            recv.close()
 
     def _run_experiment(self, exp: _Experiment, steps: int) -> None:
-        import deepspeed_tpu
-        from ..comm.mesh import reset_mesh_context
-        import jax.numpy as jnp
-        import numpy as np
-
-        cand = exp.config
-        cfg = json.loads(json.dumps(self.base_config))  # deep copy; exps must not alias
-        cfg.pop("autotuning", None)
-        mb = cand["train_micro_batch_size_per_gpu"]
-        cfg["train_micro_batch_size_per_gpu"] = mb
-        cfg.pop("train_batch_size", None)
-        cfg["gradient_accumulation_steps"] = cfg.get("gradient_accumulation_steps", 1)
-        cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
-        if cand["remat"]:
-            cfg["activation_checkpointing"] = {"remat_policy": "nothing_saveable"}
-        try:
-            reset_mesh_context()
-            model, params = self.model_builder()
-            engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
-                                                  config=cfg)
-            hidden = np.asarray(jax.tree_util.tree_leaves(params)[0]).shape[0]
-            bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
-            x = jnp.ones((bs, hidden), jnp.float32)
-            y = jnp.zeros_like(x)
-            # warmup (compile), then timed steps
-            loss = engine.forward(x, y)
-            engine.backward(loss)
-            engine.step()
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = engine.forward(x, y)
-                engine.backward(loss)
-                engine.step()
-            float(loss)  # host sync closes the timing region
-            dt = (time.perf_counter() - t0) / steps
-            if self.cfg.metric == "latency":
-                exp.metric_val = -dt  # maximize
-            else:  # throughput (samples/s); flops metric folds into this rank
-                exp.metric_val = engine.train_batch_size() / dt
-            exp.status = "done"
-        except Exception as e:  # infeasible config (OOM etc.)
-            exp.status = "error"
-            exp.error = f"{type(e).__name__}: {e}"
+        res = self._measure(exp.config, steps)
+        exp.status = res["status"]
+        exp.metric_val = res["metric_val"]
+        exp.error = res["error"]
 
     # ---- main loop (reference autotuner.tune) ----
+
+    def _next_candidates(self, space, visited, model, rng):
+        """Model-based selection: best predicted unvisited candidate, with
+        ε-greedy exploration (reference model_based_tuner.py:19 next_batch)."""
+        open_idx = [i for i in range(len(space)) if i not in visited]
+        if not open_idx:
+            return None
+        if rng.random() < 0.2:  # random_exploration_ratio
+            return rng.choice(open_idx)
+        preds = model.predict([space[i] for i in open_idx])
+        return open_idx[int(np.argmax(preds))]
 
     def tune(self, steps: int = 3) -> Optional[Dict[str, Any]]:
         assert self.model_builder is not None, "model_builder is required to tune"
         space = self._order(self.experiment_space())
-        space = space[:self.cfg.tuner_num_trials]
+        adaptive = self.cfg.tuner_type == "model_based"
+        if not adaptive:
+            space = space[:self.cfg.tuner_num_trials]
+        model, rng = CostModel(), random.Random(0)
+        visited: set = set()
         stagnant = 0
-        for i, cand in enumerate(space):
+        for i in range(min(len(space), self.cfg.tuner_num_trials)):
+            if adaptive and i >= 2:  # INIT_NUM seed measurements, then SMBO
+                idx = self._next_candidates(space, visited, model, rng)
+                if idx is None:
+                    break
+            else:
+                idx = i
+            visited.add(idx)
+            cand = space[idx]
             exp = _Experiment(i, cand)
             self.exps.append(exp)
             self._run_experiment(exp, steps)
@@ -158,6 +287,11 @@ class Autotuner:
                 stagnant = 0
             else:
                 stagnant += 1
+            if adaptive:
+                done = [(e.config, e.metric_val) for e in self.exps
+                        if e.status == "done"]
+                if len(done) >= 2:
+                    model.fit([c for c, _ in done], [v for _, v in done])
             logger.info(f"autotune exp {i}: {cand} -> {exp.status} "
                         f"metric={exp.metric_val}")
             if stagnant >= self.cfg.tuner_early_stopping:

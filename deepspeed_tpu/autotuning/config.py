@@ -24,3 +24,8 @@ class AutotuningConfig(ConfigModel):
     min_train_micro_batch_size_per_gpu: int = 1
     num_tuning_micro_batch_sizes: int = 3
     zero_stages: Optional[List[int]] = None  # restrict search space
+    # run each experiment in a spawned child process (reference
+    # scheduler.py:32 isolates experiments so an OOM/abort of one candidate
+    # cannot poison the rest of the search)
+    exp_isolation: bool = False
+    exp_timeout: float = 600.0

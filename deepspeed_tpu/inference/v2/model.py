@@ -97,8 +97,9 @@ class RaggedLlamaModel:
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
-        if quantize not in (None, "int8"):
-            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+        if quantize not in (None, "int8", "fp6", "int4"):
+            raise ValueError("quantize must be None, 'int8', 'fp6' or 'int4', "
+                             f"got {quantize!r}")
         self._quantize = quantize
         # "paged" = Pallas blocked-flash decode kernel (TPU; interpret-mode on
         # CPU), "dense" = XLA gather of the full history window, "auto" =
@@ -109,11 +110,15 @@ class RaggedLlamaModel:
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
-        if quantize == "int8":
+        if quantize is not None:
             # WoQ (reference inference/v2 mixed_gemm + linear/quantization):
-            # per-layer matmul weights stored int8 + scales, dequantized
-            # in-graph. Router gates / norms / embeddings / lm_head stay fp.
+            # per-layer matmul weights stored packed (int8 / fp6-e3m2 /
+            # int4) + scales, dequantized in-graph. Router gates / norms /
+            # embeddings / lm_head stay fp.
+            from ...linear.config import QuantizationConfig
             from ...linear.quantization import QuantizedParameter
+            qcfg = QuantizationConfig(
+                q_bits={"int8": 8, "fp6": 6, "int4": 4}[quantize])
             model_p = self.params["model"]
             for lname, lp in model_p.items():
                 if not lname.startswith("layers_"):
@@ -125,11 +130,11 @@ class RaggedLlamaModel:
                         if isinstance(sub, dict):
                             if "kernel" in sub and getattr(sub["kernel"], "ndim", 0) >= 2:
                                 sub["kernel"] = QuantizedParameter.quantize(
-                                    sub["kernel"])
+                                    sub["kernel"], qcfg)
                             else:
                                 _maybe_q(sub)
                         elif key in ("w1", "w2", "w3") and getattr(sub, "ndim", 0) >= 2:
-                            node[key] = QuantizedParameter.quantize(sub)
+                            node[key] = QuantizedParameter.quantize(sub, qcfg)
                 _maybe_q(lp)
         # unembed in fp32 (reference keeps logits fp32; lm_head lives under
         # "model" in the training tree)

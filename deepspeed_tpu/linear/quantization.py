@@ -2,9 +2,11 @@
 
 Reference: ``deepspeed/linear/quantization.py:18 QuantizedParameter`` — a
 tensor subclass that stores FP6/FP8-quantized data and dequantizes on use.
-TPU version: a small container of (int8 values, bf16 scales) produced by the
-blockwise Pallas/XLA quantizer (``ops/quantizer.py``), dequantized inside
-jit where XLA fuses it into the consuming matmul.
+TPU version: a small container of (packed values, fp32 scales) produced by
+the blockwise Pallas/XLA quantizer (``ops/quantizer.py``), dequantized
+inside jit where XLA fuses it into the consuming matmul. Formats: int8
+(1 byte/weight), fp6 e3m2 (0.75 bytes/weight, the FP6-LLM point —
+``ops/fp_quantizer/quantize.py:43``), int4 (0.5 bytes/weight).
 """
 
 from typing import Any, Tuple
@@ -12,39 +14,55 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.quantizer import dequantize_int8_blockwise, quantize_int8_blockwise
+from ..ops.quantizer import (dequantize_int8_blockwise, quantize_int8_blockwise,
+                             dequantize_int4_blockwise, quantize_int4_blockwise,
+                             dequantize_fp6_blockwise, quantize_fp6_blockwise)
 from .config import QuantizationConfig
+
+_FMTS = {
+    8: (quantize_int8_blockwise, dequantize_int8_blockwise),
+    6: (quantize_fp6_blockwise, dequantize_fp6_blockwise),
+    4: (quantize_int4_blockwise, dequantize_int4_blockwise),
+}
 
 
 class QuantizedParameter:
 
     def __init__(self, values, scales, shape: Tuple[int, ...], block_size: int,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, q_bits: int = 8):
         self.values = values
         self.scales = scales
         self.shape = tuple(shape)
         self.block_size = block_size
         self.dtype = dtype
+        self.q_bits = q_bits
 
     @staticmethod
     def quantize(w, config: QuantizationConfig = None) -> "QuantizedParameter":
         config = config or QuantizationConfig()
-        assert config.q_bits == 8, "int8 is the supported quantized storage"
-        values, scales = quantize_int8_blockwise(w, block_size=config.group_size)
+        if config.q_bits not in _FMTS:
+            raise ValueError(f"q_bits must be one of {sorted(_FMTS)} "
+                             f"(int8 / fp6-e3m2 / int4), got {config.q_bits}")
+        quant, _ = _FMTS[config.q_bits]
+        values, scales = quant(w, block_size=config.group_size)
         return QuantizedParameter(values, scales, w.shape, config.group_size,
-                                  dtype=w.dtype)
+                                  dtype=w.dtype, q_bits=config.q_bits)
 
     def dequantized(self):
-        return dequantize_int8_blockwise(self.values, self.scales, self.shape,
-                                         self.block_size).astype(self.dtype)
+        _, dequant = _FMTS[self.q_bits]
+        return dequant(self.values, self.scales, self.shape,
+                       self.block_size).astype(self.dtype)
 
     @property
     def nbytes(self) -> int:
-        return int(self.values.size + self.scales.size * self.scales.dtype.itemsize)
+        return int(self.values.size * self.values.dtype.itemsize
+                   + self.scales.size * self.scales.dtype.itemsize)
 
 
 # pytree registration so QuantizedParameter flows through jit/device_put
 jax.tree_util.register_pytree_node(
     QuantizedParameter,
-    lambda qp: ((qp.values, qp.scales), (qp.shape, qp.block_size, qp.dtype)),
-    lambda aux, kids: QuantizedParameter(kids[0], kids[1], aux[0], aux[1], aux[2]))
+    lambda qp: ((qp.values, qp.scales),
+                (qp.shape, qp.block_size, qp.dtype, qp.q_bits)),
+    lambda aux, kids: QuantizedParameter(kids[0], kids[1], aux[0], aux[1],
+                                         aux[2], aux[3]))

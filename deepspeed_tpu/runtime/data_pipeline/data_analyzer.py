@@ -1,24 +1,42 @@
 """Offline dataset difficulty analysis for curriculum learning.
 
 Reference: ``runtime/data_pipeline/data_sampling/data_analyzer.py:22
-DataAnalyzer`` — a map/reduce job computing per-sample metrics (seqlen,
-vocab rarity, ...) over the whole dataset, writing indexed metric files the
-curriculum sampler consumes. The reference shards work across
-workers×threads with file-based merge; here the map is a multiprocessing
-pool over index ranges and the reduce is in-memory numpy (a TPU-VM host
-comfortably holds billions of int32 metric values), with the same output
-artifacts: ``{metric}_sample_to_metric`` (per-sample value) and
-``{metric}_metric_to_sample`` (value → sample ids) plus percentile stats.
+DataAnalyzer`` (file-based map/reduce: each worker writes partial metric
+files, worker 0 merges — ``:455 DistributedDataAnalyzer`` does the same over
+collectives) — computing per-sample metrics (seqlen, vocab rarity, ...) over
+the whole dataset, writing indexed metric files the curriculum sampler
+consumes.
+
+Three execution shapes, same artifacts:
+
+- ``DataAnalyzer(dataset)`` — one driver, in-process pool over index ranges.
+- ``DataAnalyzer(dataset, num_workers=N, worker_id=k)`` — THIS process is
+  shard k of N (one per host, any scheduler): ``run_map`` writes partial
+  files, worker 0's ``run_reduce`` waits for all partials and merges them in
+  worker order (the reference's ``merge_map_results`` file protocol).
+- ``DistributedDataAnalyzer(dataset)`` — SPMD multi-process JAX: shards by
+  ``jax.process_index()``, merges via a cross-process allgather, process 0
+  writes.
+
+Artifacts per metric: ``{metric}_sample_to_metric.npy`` (per-sample value),
+``{metric}_metric_to_sample.npy`` (sample ids sorted by value),
+``{metric}_stats.json`` — and for ``accumulate_value_over_samples`` metrics
+``{metric}_accumulated.npy`` (elementwise sum over the dataset, e.g. vocab
+frequency counts).
 """
 
 import json
 import os
+import time
 from concurrent.futures import ProcessPoolExecutor
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ...utils.logging import logger
+
+SINGLE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
 
 
 def metric_seqlen(sample) -> int:
@@ -37,72 +55,254 @@ def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
     return fn
 
 
+def metric_vocab_freq(vocab_size: int) -> Callable:
+    """Built-in ACCUMULATE metric (reference curriculum recipe step 1):
+    per-token occurrence counts, summed over the whole dataset."""
+
+    def fn(sample) -> np.ndarray:
+        ids = np.asarray(sample).reshape(-1)
+        return np.bincount(ids, minlength=vocab_size).astype(np.int64)
+
+    return fn
+
+
 class DataAnalyzer:
 
     def __init__(self,
                  dataset,
                  num_workers: int = 1,
+                 worker_id: Optional[int] = None,
                  metric_names: Optional[List[str]] = None,
                  metric_functions: Optional[List[Callable]] = None,
                  save_path: str = "./data_analysis",
                  metric_types: Optional[List[str]] = None,
-                 batch_size: int = 1024):
+                 batch_size: int = 1024,
+                 merge_timeout: float = 600.0,
+                 run_id: str = "0"):
         self.dataset = dataset
         self.num_workers = max(1, num_workers)
+        # None: one driver fans out in-process. int: THIS process is one
+        # shard of the reference's multi-worker file protocol.
+        self.worker_id = worker_id
         self.metric_names = metric_names or ["seqlen"]
         self.metric_functions = metric_functions or [metric_seqlen]
-        self.metric_types = metric_types or ["single_value_per_sample"] * len(self.metric_names)
+        self.metric_types = metric_types or [SINGLE] * len(self.metric_names)
+        for t in self.metric_types:
+            if t not in (SINGLE, ACCUMULATE):
+                raise ValueError(f"metric_type {t} not implemented")
         self.save_path = save_path
         self.batch_size = batch_size
+        self.merge_timeout = merge_timeout
+        # partial files and the done marker are scoped by run_id so a rerun
+        # in the same save_path (new dataset, new metrics) can never merge a
+        # previous run's stale partials or return its stale stats — pass a
+        # fresh run_id per analysis job (all workers must agree on it)
+        self.run_id = str(run_id)
 
     # ---- map (reference run_map) ----
 
+    def _worker_range(self, k: int):
+        chunks = np.linspace(0, len(self.dataset), self.num_workers + 1, dtype=int)
+        return int(chunks[k]), int(chunks[k + 1])
+
     def _map_range(self, lo: int, hi: int) -> Dict[str, np.ndarray]:
-        out = {name: np.empty(hi - lo, dtype=np.int64) for name in self.metric_names}
-        for i in range(lo, hi):
-            sample = self.dataset[i]
-            for name, fn in zip(self.metric_names, self.metric_functions):
-                out[name][i - lo] = fn(sample)
+        out = {}
+        for name, fn, mtype in zip(self.metric_names, self.metric_functions,
+                                   self.metric_types):
+            if mtype == SINGLE:
+                vals = np.empty(hi - lo, dtype=np.int64)
+                for i in range(lo, hi):
+                    vals[i - lo] = fn(self.dataset[i])
+                out[name] = vals
+            else:  # ACCUMULATE: elementwise sum of fn(sample) over the range
+                acc = None
+                for i in range(lo, hi):
+                    v = np.asarray(fn(self.dataset[i]))
+                    acc = v.copy() if acc is None else acc + v
+                out[name] = acc if acc is not None else np.zeros(0, np.int64)
         return out
+
+    def _partial_path(self, k: int, name: str) -> str:
+        return os.path.join(self.save_path,
+                            f"worker{k}_{name}_r{self.run_id}_partial.npy")
 
     def run_map(self) -> Dict[str, np.ndarray]:
         n = len(self.dataset)
+        if self.worker_id is not None:
+            lo, hi = self._worker_range(self.worker_id)
+            part = self._map_range(lo, hi)
+            os.makedirs(self.save_path, exist_ok=True)
+            for name, vals in part.items():
+                tmp = self._partial_path(self.worker_id, name) + ".tmp"
+                with open(tmp, "wb") as f:  # np.save(path) would append .npy
+                    np.save(f, vals)
+                # atomic publish: the merger must never read a half-written file
+                os.replace(tmp, self._partial_path(self.worker_id, name))
+            return part
         chunks = np.linspace(0, n, self.num_workers + 1, dtype=int)
         if self.num_workers == 1:
             parts = [self._map_range(0, n)]
         else:
             with ProcessPoolExecutor(max_workers=self.num_workers) as pool:
                 parts = list(pool.map(self._map_range, chunks[:-1], chunks[1:]))
-        return {name: np.concatenate([p[name] for p in parts]) for name in self.metric_names}
+        return self._merge_parts(parts)
+
+    def _merge_parts(self, parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            arrs = [p[name] for p in parts if p[name].size]
+            if mtype == SINGLE:
+                out[name] = np.concatenate(arrs) if arrs else np.zeros(0, np.int64)
+            else:
+                out[name] = np.sum(arrs, axis=0) if arrs else np.zeros(0, np.int64)
+        return out
+
+    def _wait_for_partials(self) -> Dict[str, np.ndarray]:
+        """Worker 0's merge barrier: poll for every worker's partial files
+        (reference merge_map_results reads each worker's output in order)."""
+        deadline = time.time() + self.merge_timeout
+        needed = [(k, name) for k in range(self.num_workers)
+                  for name in self.metric_names]
+        while True:
+            missing = [p for p in needed
+                       if not os.path.exists(self._partial_path(*p))]
+            if not missing:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"data analysis merge: missing partial files after "
+                    f"{self.merge_timeout}s: "
+                    + ", ".join(self._partial_path(*p) for p in missing[:4]))
+            time.sleep(0.2)
+        parts = [{name: np.load(self._partial_path(k, name))
+                  for name in self.metric_names}
+                 for k in range(self.num_workers)]
+        return self._merge_parts(parts)
 
     # ---- reduce (reference run_reduce / merge_map_results) ----
 
-    def run_reduce(self, mapped: Dict[str, np.ndarray]) -> Dict[str, dict]:
+    def run_reduce(self, mapped: Optional[Dict[str, np.ndarray]] = None
+                   ) -> Dict[str, dict]:
+        if mapped is None:
+            mapped = self._wait_for_partials()
         os.makedirs(self.save_path, exist_ok=True)
         results = {}
-        for name in self.metric_names:
+        for name, mtype in zip(self.metric_names, self.metric_types):
             vals = mapped[name]
-            np.save(os.path.join(self.save_path, f"{name}_sample_to_metric.npy"), vals)
-            order = np.argsort(vals, kind="stable")
-            np.save(os.path.join(self.save_path, f"{name}_metric_to_sample.npy"), order)
-            stats = {
-                "num_samples": int(vals.size),
-                "min": int(vals.min()), "max": int(vals.max()),
-                "mean": float(vals.mean()),
-                "percentiles": {str(p): int(np.percentile(vals, p))
-                                for p in (1, 5, 25, 50, 75, 95, 99)},
-            }
+            if mtype == ACCUMULATE:
+                np.save(os.path.join(self.save_path, f"{name}_accumulated.npy"),
+                        vals)
+                stats = {"size": int(vals.size), "sum": int(vals.sum()),
+                         "nonzero": int(np.count_nonzero(vals))}
+            else:
+                np.save(os.path.join(self.save_path,
+                                     f"{name}_sample_to_metric.npy"), vals)
+                order = np.argsort(vals, kind="stable")
+                np.save(os.path.join(self.save_path,
+                                     f"{name}_metric_to_sample.npy"), order)
+                stats = {
+                    "num_samples": int(vals.size),
+                    "min": int(vals.min()), "max": int(vals.max()),
+                    "mean": float(vals.mean()),
+                    "percentiles": {str(p): int(np.percentile(vals, p))
+                                    for p in (1, 5, 25, 50, 75, 95, 99)},
+                }
             with open(os.path.join(self.save_path, f"{name}_stats.json"), "w") as f:
                 json.dump(stats, f, indent=2)
             results[name] = stats
-            logger.info(f"data analysis '{name}': {stats['percentiles']}")
+            logger.info(f"data analysis '{name}': {stats}")
+        done_tmp = os.path.join(self.save_path, "analysis_done.json.tmp")
+        with open(done_tmp, "w") as f:
+            json.dump({"metrics": self.metric_names, "run_id": self.run_id}, f)
+        os.replace(done_tmp, os.path.join(self.save_path, "analysis_done.json"))
         return results
 
     def run_map_reduce(self, comm_group=None) -> Dict[str, dict]:
-        """Reference run_map_reduce — the one-call entry."""
-        return self.run_reduce(self.run_map())
+        """Reference run_map_reduce — the one-call entry. In worker-sharded
+        mode every worker maps; worker 0 merges + writes; the rest wait for
+        the done marker and load the published stats."""
+        if self.worker_id is None:
+            return self.run_reduce(self.run_map())
+        self.run_map()
+        done = os.path.join(self.save_path, "analysis_done.json")
+        if self.worker_id == 0:
+            return self.run_reduce()
+        deadline = time.time() + self.merge_timeout
+
+        def _published() -> bool:
+            if not os.path.exists(done):
+                return False
+            try:  # a marker from an older run in the same dir is NOT done
+                return json.load(open(done)).get("run_id") == self.run_id
+            except (json.JSONDecodeError, OSError):
+                return False
+
+        while not _published():
+            if time.time() > deadline:
+                raise TimeoutError("worker 0 never published analysis_done.json "
+                                   f"for run_id={self.run_id}")
+            time.sleep(0.2)
+        return {name: json.load(open(os.path.join(self.save_path,
+                                                  f"{name}_stats.json")))
+                for name in self.metric_names}
+
+
+class DistributedDataAnalyzer:
+    """SPMD analyzer (reference ``data_analyzer.py:455``): shards the dataset
+    by JAX process, merges partial results with a cross-process allgather,
+    process 0 writes the same artifacts as ``DataAnalyzer``."""
+
+    def __init__(self, dataset, metric_names=None, metric_functions=None,
+                 metric_types=None, save_path: str = "./data_analysis",
+                 comm_group=None):
+        import jax
+        self.worker_id = jax.process_index()
+        self.num_workers = jax.process_count()
+        self._inner = DataAnalyzer(dataset, num_workers=self.num_workers,
+                                   worker_id=self.worker_id,
+                                   metric_names=metric_names,
+                                   metric_functions=metric_functions,
+                                   metric_types=metric_types,
+                                   save_path=save_path)
+
+    def run_map_reduce(self) -> Dict[str, dict]:
+        import jax
+        from jax.experimental import multihost_utils
+        inner = self._inner
+        lo, hi = inner._worker_range(self.worker_id)
+        part = inner._map_range(lo, hi)
+        # allgather each metric across processes; SINGLE ranges can be
+        # uneven, so pad to the max range length and trim by true lengths
+        merged = {}
+        for name, mtype in zip(inner.metric_names, inner.metric_types):
+            vals = part[name]
+            if mtype == SINGLE:
+                width = int(np.ceil(len(inner.dataset) / self.num_workers))
+                padded = np.zeros(width, np.int64)
+                padded[:vals.size] = vals
+                gathered = np.asarray(multihost_utils.process_allgather(padded))
+                gathered = gathered.reshape(self.num_workers, width)
+                pieces = []
+                for k in range(self.num_workers):
+                    klo, khi = inner._worker_range(k)
+                    pieces.append(gathered[k, :khi - klo])
+                merged[name] = np.concatenate(pieces)
+            else:
+                gathered = np.asarray(multihost_utils.process_allgather(vals))
+                merged[name] = gathered.reshape(self.num_workers, -1).sum(axis=0)
+        if self.worker_id == 0:
+            results = inner.run_reduce(merged)
+        else:
+            results = {name: None for name in inner.metric_names}
+        multihost_utils.sync_global_devices("data_analysis_reduce")
+        return results
 
 
 def load_metric(save_path: str, metric_name: str) -> np.ndarray:
     """Per-sample metric values for DeepSpeedDataSampler's metric_values."""
     return np.load(os.path.join(save_path, f"{metric_name}_sample_to_metric.npy"))
+
+
+def load_accumulated(save_path: str, metric_name: str) -> np.ndarray:
+    """Dataset-wide accumulated metric (e.g. vocab frequency counts)."""
+    return np.load(os.path.join(save_path, f"{metric_name}_accumulated.npy"))

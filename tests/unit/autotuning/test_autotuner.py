@@ -57,3 +57,102 @@ def test_tune_end_to_end(tmp_path):
     assert os.path.exists(tmp_path / "results" / "best.json")
     records = at.get_best_space_records()
     assert "z1" in records
+
+
+sys.path.insert(0, os.path.dirname(__file__))  # spawn children import by name
+
+
+def crash_builder(cand):
+    """Simulates an XLA OOM hard-abort for one candidate: the process DIES,
+    it does not raise."""
+    if cand["train_micro_batch_size_per_gpu"] == 2:
+        os._exit(9)
+    from simple_model import simple_model_and_params
+    return simple_model_and_params()
+
+
+def test_cost_model_tuner_beats_grid(tmp_path):
+    """Reference model_based_tuner.py:19: the fitted cost model must find the
+    known-best config in FEWER measured trials than grid order reaches it."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, CostModel
+    import numpy as np
+
+    def synth_metric(cand):
+        # unimodal surface: sweet spot mb=4, stage=2, remat hurts
+        lb = np.log2(cand["train_micro_batch_size_per_gpu"])
+        return 10.0 - (lb - 2.0) ** 2 - 0.5 * (cand["zero_stage"] - 2) ** 2 \
+            - 0.3 * cand["remat"]
+
+    best_cand = {"train_micro_batch_size_per_gpu": 4, "zero_stage": 2,
+                 "remat": False}
+
+    def make(tuner_type, trials):
+        cfg = AutotuningConfig(enabled=True, tuner_type=tuner_type,
+                               num_tuning_micro_batch_sizes=4,
+                               results_dir=str(tmp_path / tuner_type),
+                               tuner_num_trials=trials,
+                               tuner_early_stopping=100)
+        at = Autotuner(BASE, cfg, model_builder=lambda: None)
+        at._measure = lambda cand, steps: {"status": "done", "error": None,
+                                           "metric_val": synth_metric(cand)}
+        return at
+
+    # grid order: mb ascending x stage x remat -> best (mb=4, stage=2) sits
+    # deep in the enumeration (position 21 of 32)
+    grid = make("gridsearch", 12)
+    grid.tune(steps=0)
+    assert grid.best.config != best_cand  # 12 grid trials never reach it
+
+    smbo = make("model_based", 12)
+    smbo.tune(steps=0)
+    assert smbo.best.config == best_cand, smbo.best.config
+    # and it got there with measurements, not enumeration
+    hit = next(i for i, e in enumerate(smbo.exps) if e.config == best_cand)
+    assert hit < 12
+
+    cm = CostModel()
+    cands = [{"train_micro_batch_size_per_gpu": m, "zero_stage": s, "remat": r}
+             for m in (1, 2, 4, 8) for s in (0, 1, 2, 3) for r in (False, True)]
+    cm.fit(cands, [synth_metric(c) for c in cands])
+    pred_best = cands[int(np.argmax(cm.predict(cands)))]
+    assert pred_best == best_cand  # quadratic basis represents the surface
+
+
+def test_exp_isolation_survives_child_death(tmp_path):
+    """Reference scheduler.py:32 isolates experiments in processes: a child
+    hard-killed mid-experiment (XLA OOM abort) is an 'error' record, the
+    search continues and still returns a best config."""
+    cfg = AutotuningConfig(enabled=True, num_tuning_micro_batch_sizes=2,
+                           zero_stages=[0], results_dir=str(tmp_path),
+                           exp_isolation=True, exp_timeout=240.0,
+                           tuner_early_stopping=100)
+    at = Autotuner(BASE, cfg, model_builder=crash_builder)
+    best = at.tune(steps=1)
+    assert best is not None and best["train_micro_batch_size_per_gpu"] == 1
+    statuses = {(e.config["train_micro_batch_size_per_gpu"], e.status)
+                for e in at.exps}
+    assert (2, "error") in statuses and (1, "done") in statuses
+    died = [e for e in at.exps if e.status == "error"]
+    assert all("died" in e.error or "exceeded" in e.error for e in died)
+
+
+def hang_builder(cand):
+    """Simulates a wedged XLA compile: the child never returns."""
+    import time as _t
+    _t.sleep(300)
+
+
+def test_exp_isolation_kills_hung_child(tmp_path):
+    """exp_timeout must TERMINATE a wedged child and record an error — the
+    pool-based shape blocked forever in shutdown(wait=True)."""
+    import time as _t
+    cfg = AutotuningConfig(enabled=True, num_tuning_micro_batch_sizes=1,
+                           zero_stages=[0], results_dir=str(tmp_path),
+                           exp_isolation=True, exp_timeout=8.0,
+                           tuner_early_stopping=100)
+    at = Autotuner(BASE, cfg, model_builder=hang_builder)
+    t0 = _t.time()
+    best = at.tune(steps=1)
+    assert _t.time() - t0 < 120  # 2 candidates x (spawn + 8s timeout + kill)
+    assert best is None
+    assert all(e.status == "error" and "exceeded" in e.error for e in at.exps)

@@ -305,3 +305,145 @@ class TestDataAnalyzer:
         batch = next(iter(sampler))
         # early curriculum: only short samples are eligible
         assert all(metric[i] <= 64 for i in batch)
+
+
+class TestDistributedDataAnalyzer:
+    """Worker-sharded file map-reduce + SPMD analyzer (reference
+    data_analyzer.py:455 DistributedDataAnalyzer): every execution shape
+    must produce bit-identical artifacts to the single-process run."""
+
+    @staticmethod
+    def _dataset(n=97):
+        rng = np.random.default_rng(7)
+        return [rng.integers(0, 50, size=rng.integers(4, 40)).astype(np.int32)
+                for _ in range(n)]
+
+    def test_worker_sharded_matches_single_process(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, load_metric, load_accumulated, metric_seqlen,
+            metric_vocab_freq, SINGLE, ACCUMULATE)
+        ds = self._dataset()
+        names = ["seqlen", "vocab_freq"]
+        fns = [metric_seqlen, metric_vocab_freq(50)]
+        types = [SINGLE, ACCUMULATE]
+
+        single = tmp_path / "single"
+        DataAnalyzer(ds, metric_names=names, metric_functions=fns,
+                     metric_types=types, save_path=str(single)).run_map_reduce()
+
+        sharded = tmp_path / "sharded"
+        # workers 1 and 2 map first; worker 0 merges their published partials
+        for k in (1, 2):
+            DataAnalyzer(ds, num_workers=3, worker_id=k, metric_names=names,
+                         metric_functions=fns, metric_types=types,
+                         save_path=str(sharded)).run_map()
+        stats = DataAnalyzer(ds, num_workers=3, worker_id=0, metric_names=names,
+                             metric_functions=fns, metric_types=types,
+                             save_path=str(sharded)).run_map_reduce()
+        assert stats["seqlen"]["num_samples"] == len(ds)
+        np.testing.assert_array_equal(load_metric(str(sharded), "seqlen"),
+                                      load_metric(str(single), "seqlen"))
+        np.testing.assert_array_equal(load_accumulated(str(sharded), "vocab_freq"),
+                                      load_accumulated(str(single), "vocab_freq"))
+        # token conservation: accumulated counts == total tokens
+        assert load_accumulated(str(sharded), "vocab_freq").sum() == \
+            sum(len(s) for s in ds)
+
+    def test_nonzero_worker_waits_for_reduce(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+        ds = self._dataset(20)
+        # worker 1 with nothing published must time out, not hang forever
+        an = DataAnalyzer(ds, num_workers=2, worker_id=1, save_path=str(tmp_path),
+                          merge_timeout=1.0)
+        an.run_map()
+        with pytest.raises(TimeoutError):
+            an.run_map_reduce()
+
+    def test_merge_times_out_on_missing_partials(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+        ds = self._dataset(20)
+        an = DataAnalyzer(ds, num_workers=4, worker_id=0, save_path=str(tmp_path),
+                          merge_timeout=1.0)
+        an.run_map()  # only worker 0's partial exists
+        with pytest.raises(TimeoutError, match="missing partial"):
+            an.run_reduce()
+
+    def test_spmd_two_process_matches_single(self, tmp_path):
+        """2 real JAX processes: DistributedDataAnalyzer's allgather merge
+        equals the single-process artifacts."""
+        import os as _os
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+        from deepspeed_tpu.launcher.runner import build_commands
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, load_metric)
+
+        ds = self._dataset(61)
+        single = tmp_path / "single"
+        DataAnalyzer(ds, save_path=str(single)).run_map_reduce()
+
+        child = textwrap.dedent("""
+            import sys
+            import numpy as np
+            import deepspeed_tpu.comm as dist
+            from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+                DistributedDataAnalyzer)
+            dist.init_distributed()
+            rng = np.random.default_rng(7)
+            ds = [rng.integers(0, 50, size=rng.integers(4, 40)).astype(np.int32)
+                  for _ in range(61)]
+            DistributedDataAnalyzer(ds, save_path=sys.argv[1]).run_map_reduce()
+            print("ANALYZER_OK", flush=True)
+        """)
+        script = tmp_path / "child.py"
+        script.write_text(child)
+        out_dir = tmp_path / "spmd"
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        repo = _os.path.abspath(_os.path.join(_os.path.dirname(__file__),
+                                              "..", "..", ".."))
+        cmds = build_commands(["localhost", "localhost"], "127.0.0.1", port,
+                              str(script), [str(out_dir)],
+                              {"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+        env = {k: v for k, v in _os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+        procs = [subprocess.Popen(c, env=env, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+                 for c in cmds]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        for p, o in zip(procs, outs):
+            assert p.returncode == 0 and "ANALYZER_OK" in o, o[-2000:]
+        np.testing.assert_array_equal(load_metric(str(out_dir), "seqlen"),
+                                      load_metric(str(single), "seqlen"))
+
+    def test_rerun_with_new_run_id_ignores_stale_files(self, tmp_path):
+        """A second analysis in the same save_path must not consume the
+        first run's partials or done marker (regression: reruns silently
+        merged stale data)."""
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer, load_metric)
+        ds1 = self._dataset(30)
+        for k in (1,):
+            DataAnalyzer(ds1, num_workers=2, worker_id=k,
+                         save_path=str(tmp_path), run_id="a").run_map()
+        DataAnalyzer(ds1, num_workers=2, worker_id=0,
+                     save_path=str(tmp_path), run_id="a").run_map_reduce()
+        v1 = load_metric(str(tmp_path), "seqlen")
+
+        ds2 = self._dataset(30)[::-1]  # different data, same length
+        # worker 1 of run "b" must TIME OUT waiting for run b's reduce even
+        # though run a's done marker sits in the directory
+        an_b1 = DataAnalyzer(ds2, num_workers=2, worker_id=1,
+                             save_path=str(tmp_path), run_id="b",
+                             merge_timeout=1.0)
+        with pytest.raises(TimeoutError, match="run_id=b"):
+            an_b1.run_map_reduce()
+        # and run b's reduce merges only run-b partials
+        DataAnalyzer(ds2, num_workers=2, worker_id=0,
+                     save_path=str(tmp_path), run_id="b").run_map_reduce()
+        v2 = load_metric(str(tmp_path), "seqlen")
+        np.testing.assert_array_equal(v2, [len(s) for s in ds2])
+        assert not np.array_equal(v1, v2)
