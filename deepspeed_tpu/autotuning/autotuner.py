@@ -221,6 +221,8 @@ class Autotuner:
                                      self.model_builder, self.cfg.metric))
             proc.start()
         except Exception as e:  # unpicklable builder etc.
+            recv.close()
+            send.close()
             return {"status": "error", "metric_val": None,
                     "error": f"{type(e).__name__}: {e}"}
         send.close()  # our copy; the child's stays open until it exits

@@ -139,12 +139,16 @@ class DataAnalyzer:
                 # atomic publish: the merger must never read a half-written file
                 os.replace(tmp, self._partial_path(self.worker_id, name))
             return part
-        chunks = np.linspace(0, n, self.num_workers + 1, dtype=int)
+        # same shard boundaries as the worker-sharded/SPMD modes — the
+        # bit-identical-artifacts invariant depends on one chunking formula
+        ranges = [self._worker_range(k) for k in range(self.num_workers)]
         if self.num_workers == 1:
             parts = [self._map_range(0, n)]
         else:
             with ProcessPoolExecutor(max_workers=self.num_workers) as pool:
-                parts = list(pool.map(self._map_range, chunks[:-1], chunks[1:]))
+                parts = list(pool.map(self._map_range,
+                                      [r[0] for r in ranges],
+                                      [r[1] for r in ranges]))
         return self._merge_parts(parts)
 
     def _merge_parts(self, parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -288,8 +292,16 @@ class DistributedDataAnalyzer:
                     pieces.append(gathered[k, :khi - klo])
                 merged[name] = np.concatenate(pieces)
             else:
-                gathered = np.asarray(multihost_utils.process_allgather(vals))
-                merged[name] = gathered.reshape(self.num_workers, -1).sum(axis=0)
+                # a process whose shard is EMPTY has a zero-size partial but
+                # the collective needs identical shapes: gather sizes first,
+                # pad empties to the common width (zeros contribute nothing)
+                size = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([vals.size], np.int64)))
+                width = int(size.max())
+                padded = np.zeros(width, vals.dtype if vals.size else np.int64)
+                padded[:vals.size] = vals
+                gathered = np.asarray(multihost_utils.process_allgather(padded))
+                merged[name] = gathered.reshape(self.num_workers, width).sum(axis=0)
         if self.worker_id == 0:
             results = inner.run_reduce(merged)
         else:

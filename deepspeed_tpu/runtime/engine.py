@@ -155,6 +155,7 @@ class DeepSpeedTpuEngine:
         self.micro_steps = 0
         self.skipped_steps = 0
         self._pending = None  # (grads, loss) from forward awaiting backward
+        self._training = True  # torch module.train()/eval() semantics
         self._last_grad_norm = None
         self.losses = None
         self.last_fwd_spec = None  # abstract fwd arg spec (flops profiler)
@@ -652,8 +653,24 @@ class DeepSpeedTpuEngine:
                 kwargs["random_ltd_keep"] = int(self.random_ltd_scheduler.get_current_seq())
         return args, kwargs
 
+    def train(self, mode: bool = True):
+        """Torch-style mode switch (reference engine is an nn.Module). In
+        eval mode ``forward()`` runs the grad-free compiled path — a ported
+        eval loop that calls ``engine.eval(); engine.forward(batch)`` does
+        NOT silently pay a full backward."""
+        self._training = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
     def forward(self, *args, **kwargs):
-        """Compute loss AND cache gradients (see module docstring)."""
+        """Compute loss AND cache gradients (see module docstring). After
+        ``engine.eval()`` this is forward-only (identical to
+        ``eval_batch``); ``engine.train()`` restores the fused
+        grad-at-forward training path."""
+        if not self._training:
+            return self.eval_batch(*args, **kwargs)
         if self._pending is not None:
             # forward() accumulates grads at forward time (module docstring);
             # a second forward without backward() would silently contaminate
@@ -661,7 +678,8 @@ class DeepSpeedTpuEngine:
             # ported eval loops must use eval_batch()/module_forward()
             raise RuntimeError(
                 "forward() called twice without backward(); for inference/eval "
-                "use eval_batch() or module_forward() (grad-free compiled path)")
+                "use engine.eval() (then forward() is grad-free), eval_batch() "
+                "or module_forward()")
         self.timers(FORWARD_MICRO_TIMER).start()
         scale = self.scale_state.cur_scale if self._use_loss_scaling else self._one
         args, kwargs = self._apply_data_efficiency(args, kwargs)
@@ -829,6 +847,11 @@ class DeepSpeedTpuEngine:
     def train_batch(self, data_iter=None):
         """Pipeline-engine-style full batch step (reference pipe/engine.py:337):
         runs gradient_accumulation_steps micro-batches + the optimizer step."""
+        # train_batch IS training: restore train mode so an eval loop's
+        # engine.eval() doesn't strand the non-fused path (forward would
+        # reroute to eval_batch and backward() would fail) — matches the
+        # reference, where eval mode never blocks train_batch
+        self._training = True
         if self._train_step_fused is not None:
             batch = next(data_iter)
             if not isinstance(batch, tuple):

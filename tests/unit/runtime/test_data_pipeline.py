@@ -395,6 +395,15 @@ class TestDistributedDataAnalyzer:
             ds = [rng.integers(0, 50, size=rng.integers(4, 40)).astype(np.int32)
                   for _ in range(61)]
             DistributedDataAnalyzer(ds, save_path=sys.argv[1]).run_map_reduce()
+            # ACCUMULATE with an EMPTY shard: 1 sample over 2 processes —
+            # the padded allgather must not shape-mismatch (regression)
+            from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+                metric_vocab_freq, ACCUMULATE)
+            DistributedDataAnalyzer(
+                ds[:1], metric_names=["vf"],
+                metric_functions=[metric_vocab_freq(50)],
+                metric_types=[ACCUMULATE],
+                save_path=sys.argv[1] + "_acc").run_map_reduce()
             print("ANALYZER_OK", flush=True)
         """)
         script = tmp_path / "child.py"
@@ -418,6 +427,10 @@ class TestDistributedDataAnalyzer:
             assert p.returncode == 0 and "ANALYZER_OK" in o, o[-2000:]
         np.testing.assert_array_equal(load_metric(str(out_dir), "seqlen"),
                                       load_metric(str(single), "seqlen"))
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            load_accumulated)
+        acc = load_accumulated(str(out_dir) + "_acc", "vf")
+        assert acc.sum() == len(ds[0])  # one sample's tokens, empty shard ok
 
     def test_rerun_with_new_run_id_ignores_stale_files(self, tmp_path):
         """A second analysis in the same save_path must not consume the
