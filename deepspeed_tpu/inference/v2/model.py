@@ -61,13 +61,19 @@ def _rope_tok(x, cos, sin, positions, rotary_dim=None, interleaved=False):
 
 
 def _norm_tok(x, p, cfg):
-    """rmsnorm or layernorm(+bias) per the config (token-major)."""
-    if cfg.norm_type == "layernorm":
+    """rmsnorm or layernorm variant per the config (token-major):
+    "layernorm" scale+bias, "layernorm_nobias" (Cohere) scale only,
+    "layernorm_np" (OLMo) non-parametric."""
+    if cfg.norm_type.startswith("layernorm"):
         xf = x.astype(jnp.float32)
         mu = xf.mean(-1, keepdims=True)
         var = ((xf - mu) ** 2).mean(-1, keepdims=True)
         out = (xf - mu) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
-        return (out * p["scale"] + p["bias"]).astype(x.dtype)
+        if cfg.norm_type == "layernorm":
+            out = out * p["scale"] + p["bias"]
+        elif cfg.norm_type == "layernorm_nobias":
+            out = out * p["scale"]
+        return out.astype(x.dtype)
     return rms_norm(x, p["weight"], cfg.rms_norm_eps)
 
 
@@ -276,7 +282,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
-        h = _norm_tok(x, lp["input_layernorm"], cfg)
+        h = _norm_tok(x, lp.get("input_layernorm"), cfg)  # None: OLMo np-norm
 
         def proj(name, heads):
             y = h @ _kernel(lp["self_attn"][name])
@@ -287,6 +293,10 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         q = proj("q_proj", nq)
         k = proj("k_proj", nkv)
         v = proj("v_proj", nkv)
+        if cfg.clip_qkv is not None:  # OLMo stability clamp
+            q = jnp.clip(q, -cfg.clip_qkv, cfg.clip_qkv)
+            k = jnp.clip(k, -cfg.clip_qkv, cfg.clip_qkv)
+            v = jnp.clip(v, -cfg.clip_qkv, cfg.clip_qkv)
         if cfg.pos_embedding == "rope":
             q = _rope_tok(q, cos, sin, batch.token_pos, cfg.rotary_dim,
                           cfg.rope_interleaved)
@@ -348,12 +358,12 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         if cfg.parallel_residual:
             # Falcon/Phi: attention and MLP both read the SAME normed input;
             # GPT-NeoX (parallel_residual_norms=2): MLP norms x independently
-            h_mlp = (_norm_tok(x, lp["post_attention_layernorm"], cfg)
+            h_mlp = (_norm_tok(x, lp.get("post_attention_layernorm"), cfg)
                      if cfg.parallel_residual_norms == 2 else h)
             x = x + attn_out + _mlp_tok(h_mlp, lp, cfg)
             continue
         x = x + attn_out
-        h2 = _norm_tok(x, lp["post_attention_layernorm"], cfg)
+        h2 = _norm_tok(x, lp.get("post_attention_layernorm"), cfg)
         if cfg.num_local_experts > 0:  # Mixtral MoE block (matches models/llama.py)
             moe = lp["block_sparse_moe"]
             logits = h2.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
@@ -377,7 +387,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         else:
             x = x + _mlp_tok(h2, lp, cfg)
 
-    x = _norm_tok(x, p["norm"], cfg)
+    x = _norm_tok(x, p.get("norm"), cfg)
     final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]
     if cfg.tie_word_embeddings:
         logits = final @ p["embed_tokens"]["embedding"].astype(jnp.float32).T
@@ -385,4 +395,6 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         logits = final @ p["lm_head"]["kernel"].astype(jnp.float32)
         if "bias" in p["lm_head"]:  # Phi
             logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
+    if cfg.logit_scale is not None:  # Cohere
+        logits = logits * jnp.float32(cfg.logit_scale)
     return logits, cache

@@ -52,7 +52,9 @@ class LlamaConfig:
     attention_bias: bool = False  # qwen2-style qkv biases
     attention_out_bias: bool = False  # OPT/Phi: bias on the output projection
     # ---- architecture variant knobs ----
-    norm_type: str = "rmsnorm"        # "rmsnorm" | "layernorm" (scale+bias)
+    # "rmsnorm" | "layernorm" (scale+bias) | "layernorm_nobias" (Cohere:
+    # scale only) | "layernorm_np" (OLMo: non-parametric, no scale/bias)
+    norm_type: str = "rmsnorm"
     pos_embedding: str = "rope"       # "rope" | "learned" (OPT) | "alibi" (BLOOM)
     embed_layernorm: bool = False     # BLOOM word_embeddings_layernorm
     pos_offset: int = 0               # OPT stores positions at index pos+2
@@ -64,6 +66,8 @@ class LlamaConfig:
     sliding_window: Optional[int] = None
     sliding_window_layers: Optional[Tuple[int, ...]] = None
     attn_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
+    clip_qkv: Optional[float] = None  # OLMo: clamp q/k/v projections to ±clip
+    logit_scale: Optional[float] = None  # Cohere: logits *= logit_scale
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
     # "gelu_new", Phi) | "relu_fc" (OPT)
     mlp_type: str = "swiglu"
@@ -209,6 +213,12 @@ def _dense(features, name, axes, dtype, use_bias=False):
 def _make_norm(cfg, name):
     if cfg.norm_type == "layernorm":
         return nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype, name=name)
+    if cfg.norm_type == "layernorm_nobias":  # Cohere: mean-subtracted, scale only
+        return nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
+                            use_bias=False, name=name)
+    if cfg.norm_type == "layernorm_np":  # OLMo: no learnable params at all
+        return nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
+                            use_bias=False, use_scale=False, name=name)
     return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name=name)
 
 
@@ -237,6 +247,10 @@ class LlamaAttention(nn.Module):
         q = _dense(nq * hd, "q_proj", (EMBED, HEADS), cfg.dtype, cfg.attention_bias)(x)
         k = _dense(nkv * hd, "k_proj", (EMBED, KV), cfg.dtype, cfg.attention_bias)(x)
         v = _dense(nkv * hd, "v_proj", (EMBED, KV), cfg.dtype, cfg.attention_bias)(x)
+        if cfg.clip_qkv is not None:  # OLMo stability clamp
+            q = jnp.clip(q, -cfg.clip_qkv, cfg.clip_qkv)
+            k = jnp.clip(k, -cfg.clip_qkv, cfg.clip_qkv)
+            v = jnp.clip(v, -cfg.clip_qkv, cfg.clip_qkv)
 
         q = q.reshape(b, s, nq, hd)
         k = k.reshape(b, s, nkv, hd)
@@ -527,6 +541,8 @@ class LlamaModel(nn.Module):
         else:
             logits = LMHead(cfg.vocab_size, cfg.dtype, use_bias=cfg.lm_head_bias,
                             name="lm_head")(x)
+        if cfg.logit_scale is not None:  # Cohere
+            logits = logits * jnp.float32(cfg.logit_scale)
         return logits
 
 

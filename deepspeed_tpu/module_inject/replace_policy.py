@@ -106,6 +106,66 @@ class Qwen2Policy(HFCheckpointPolicy):
         return dataclasses.replace(cfg, attention_bias=True)
 
 
+class OlmoPolicy(HFCheckpointPolicy):
+    """OLMo (AllenAI): llama module graph with NON-PARAMETRIC layernorm —
+    no norm weights exist in the checkpoint — plus an optional q/k/v clamp
+    (HF ``modeling_olmo.py`` OlmoLayerNorm / config.clip_qkv)."""
+    arch = "olmo"
+
+    def config_from_hf(self, hf_config):
+        import dataclasses
+        cfg = super().config_from_hf(hf_config)
+        return dataclasses.replace(cfg, norm_type="layernorm_np",
+                                   rms_norm_eps=1e-5,  # OlmoLayerNorm hardcodes
+                                   clip_qkv=hf_config.get("clip_qkv"))
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        return {k: v for k, v in out.items() if "layernorm" not in k}
+
+    def global_map(self, tie_embeddings: bool):
+        out = super().global_map(tie_embeddings)
+        out.pop("model.norm.weight")  # non-parametric final norm
+        return out
+
+
+class CoherePolicy(HFCheckpointPolicy):
+    """Cohere Command-R: weight-only layernorm, PARALLEL attn+mlp residual
+    off ONE shared input norm, GPT-J-style interleaved rotary
+    (repeat_interleave cos/sin), tied embeddings with ``logit_scale`` on the
+    unembed (HF ``modeling_cohere.py`` — 'main diff from Llama')."""
+    arch = "cohere"
+
+    def config_from_hf(self, hf_config):
+        import dataclasses
+        if hf_config.get("use_qk_norm"):
+            raise ValueError("cohere: use_qk_norm=True is not supported")
+        cfg = super().config_from_hf(hf_config)
+        return dataclasses.replace(
+            cfg, norm_type="layernorm_nobias",
+            rms_norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            rope_interleaved=True,
+            parallel_residual=True, parallel_residual_norms=1,
+            tie_word_embeddings=hf_config.get("tie_word_embeddings", True),
+            # HF CohereConfig default (NOT 1.0)
+            logit_scale=hf_config.get("logit_scale", 0.0625))
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        # one shared norm per layer; flax LayerNorm stores its weight as
+        # "scale"
+        out = {k: v for k, v in out.items()
+               if "post_attention_layernorm" not in k}
+        out[f"model.layers.{layer}.input_layernorm.weight"] = \
+            (f"layers_{layer}/input_layernorm/scale", False)
+        return out
+
+    def global_map(self, tie_embeddings: bool):
+        out = super().global_map(tie_embeddings)
+        out["model.norm.weight"] = ("norm/scale", False)
+        return out
+
+
 class MixtralPolicy(HFCheckpointPolicy):
     """Mixtral: llama attention + sparse-MoE MLP (reference
     inference/v2/model_implementations/mixtral). Per-expert HF tensors are
@@ -1206,6 +1266,10 @@ _POLICIES = {
     "Starcoder2ForCausalLM": Starcoder2Policy,
     "stablelm": StableLmPolicy,
     "StableLmForCausalLM": StableLmPolicy,
+    "olmo": OlmoPolicy,
+    "OlmoForCausalLM": OlmoPolicy,
+    "cohere": CoherePolicy,
+    "CohereForCausalLM": CoherePolicy,
 }
 
 SUPPORTED_ARCHS = sorted({p.arch for p in _POLICIES.values()})

@@ -739,3 +739,104 @@ def test_bloom_paged_backend_matches_dense():
     dense = np.asarray(mk("dense").put([0], [prompt]))[0]
     paged = np.asarray(mk("paged").put([0], [prompt]))[0]
     np.testing.assert_allclose(paged, dense, rtol=1e-4, atol=1e-4)
+
+
+def test_olmo_nonparametric_norm_logits_match_hf():
+    """OLMo: layernorm with NO learnable params + clip_qkv clamp."""
+    cfg = transformers.OlmoConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, clip_qkv=0.4, tie_word_embeddings=False)
+    torch.manual_seed(18)
+    hf_model = transformers.OlmoForCausalLM(cfg).eval()
+    ours_cfg, params = _logits_match("olmo", hf_model, cfg.to_dict())
+    assert ours_cfg.norm_type == "layernorm_np"
+    assert ours_cfg.clip_qkv == 0.4
+    # no norm weights anywhere in the converted tree
+    flat = str(jax.tree_util.tree_structure(params))
+    assert "layernorm" not in flat and "'norm'" not in flat
+
+
+def test_cohere_parallel_residual_logit_scale_logits_match_hf():
+    """Cohere Command-R: weight-only LN, shared-norm parallel residual,
+    interleaved rotary, tied embeddings, logit_scale on the unembed."""
+    cfg = transformers.CohereConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.125, use_qk_norm=False,
+        tie_word_embeddings=True)
+    torch.manual_seed(19)
+    hf_model = transformers.CohereForCausalLM(cfg).eval()
+    # give the LN scales non-unit values so the mapping is actually tested
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if "layernorm" in n or n.endswith("norm.weight"):
+                p.normal_(1.0, 0.1)
+    ours_cfg, params = _logits_match("cohere", hf_model, cfg.to_dict())
+    assert ours_cfg.norm_type == "layernorm_nobias"
+    assert ours_cfg.parallel_residual and ours_cfg.parallel_residual_norms == 1
+    assert ours_cfg.rope_interleaved and ours_cfg.logit_scale == 0.125
+
+    # logit_scale must actually matter (guard against a silent no-op)
+    import numpy as _np
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    unscaled = LlamaForCausalLM(dataclasses.replace(
+        ours_cfg, dtype=jnp.float32, attn_impl="xla", logit_scale=None))
+    ids = _np.array([[1, 5, 9, 42]], dtype=_np.int32)
+    scaled = LlamaForCausalLM(dataclasses.replace(ours_cfg, dtype=jnp.float32,
+                                                  attn_impl="xla"))
+    a = _np.asarray(scaled.apply({"params": params}, jnp.asarray(ids)))
+    b = _np.asarray(unscaled.apply({"params": params}, jnp.asarray(ids)))
+    _np.testing.assert_allclose(a, b * 0.125, rtol=1e-6)
+
+
+def test_cohere_qk_norm_rejected():
+    with pytest.raises(ValueError, match="use_qk_norm"):
+        from deepspeed_tpu.module_inject.replace_policy import CoherePolicy
+        CoherePolicy().config_from_hf({"use_qk_norm": True, "vocab_size": 128,
+                                       "hidden_size": 32, "intermediate_size": 64,
+                                       "num_hidden_layers": 2,
+                                       "num_attention_heads": 4})
+
+
+@pytest.mark.parametrize("arch", ["olmo", "cohere"])
+def test_olmo_cohere_serve_through_ragged_engine(arch):
+    """OLMo's non-parametric norms and Cohere's shared-norm parallel
+    residual + logit_scale must hold through the v2 paged-KV engine,
+    prefill AND decode."""
+    if arch == "olmo":
+        cfg = transformers.OlmoConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, clip_qkv=0.4, tie_word_embeddings=False)
+        hf_model = transformers.OlmoForCausalLM(cfg)
+    else:
+        cfg = transformers.CohereConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, logit_scale=0.125,
+            use_qk_norm=False, tie_word_embeddings=True)
+        hf_model = transformers.CohereForCausalLM(cfg)
+    torch.manual_seed(21)
+    hf_model = hf_model.eval()
+    ours_cfg, params = convert_hf_checkpoint(arch, hf_model.state_dict(),
+                                             cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(ours_cfg, params=params, dtype=jnp.float32,
+                             kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
