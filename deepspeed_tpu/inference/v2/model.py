@@ -282,16 +282,21 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
-        h = _norm_tok(x, lp.get("input_layernorm"), cfg)  # None: OLMo np-norm
+        # post_norm (OLMo2): the raw stream feeds the sublayers, norms land
+        # on the sublayer outputs below; None param: OLMo's np-norm
+        h = x if cfg.post_norm else _norm_tok(x, lp.get("input_layernorm"), cfg)
 
-        def proj(name, heads):
+        def proj(name, heads, norm=None):
             y = h @ _kernel(lp["self_attn"][name])
             if "bias" in lp["self_attn"][name]:  # qwen2/OPT/Phi biases
                 y = y + lp["self_attn"][name]["bias"]
+            if norm is not None:  # OLMo2 qk-norm on the FLAT projection
+                y = rms_norm(y, lp["self_attn"][norm]["weight"],
+                             cfg.rms_norm_eps)
             return y.reshape(T, heads, hd)
 
-        q = proj("q_proj", nq)
-        k = proj("k_proj", nkv)
+        q = proj("q_proj", nq, "q_norm" if cfg.qk_norm else None)
+        k = proj("k_proj", nkv, "k_norm" if cfg.qk_norm else None)
         v = proj("v_proj", nkv)
         if cfg.clip_qkv is not None:  # OLMo stability clamp
             q = jnp.clip(q, -cfg.clip_qkv, cfg.clip_qkv)
@@ -355,6 +360,11 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         if "bias" in lp["self_attn"]["o_proj"]:
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
+        if cfg.post_norm:  # OLMo2: x + norm(attn(x)), then x + norm(mlp(x))
+            x = x + _norm_tok(attn_out, lp["post_attention_layernorm"], cfg)
+            x = x + _norm_tok(_mlp_tok(x, lp, cfg),
+                              lp["post_feedforward_layernorm"], cfg)
+            continue
         if cfg.parallel_residual:
             # Falcon/Phi: attention and MLP both read the SAME normed input;
             # GPT-NeoX (parallel_residual_norms=2): MLP norms x independently

@@ -68,6 +68,12 @@ class LlamaConfig:
     attn_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
     clip_qkv: Optional[float] = None  # OLMo: clamp q/k/v projections to ±clip
     logit_scale: Optional[float] = None  # Cohere: logits *= logit_scale
+    # OLMo2: RMSNorm on the FLAT q/k projections (q_norm over nq*hd, k_norm
+    # over nkv*hd) before the head reshape + rope
+    qk_norm: bool = False
+    # OLMo2: post-norm residual — x + norm(attn(x)), then x + norm(mlp(x));
+    # layer norms are post_attention_layernorm / post_feedforward_layernorm
+    post_norm: bool = False
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
     # "gelu_new", Phi) | "relu_fc" (OPT)
     mlp_type: str = "swiglu"
@@ -251,6 +257,9 @@ class LlamaAttention(nn.Module):
             q = jnp.clip(q, -cfg.clip_qkv, cfg.clip_qkv)
             k = jnp.clip(k, -cfg.clip_qkv, cfg.clip_qkv)
             v = jnp.clip(v, -cfg.clip_qkv, cfg.clip_qkv)
+        if cfg.qk_norm:  # OLMo2: normalize the flat projections pre-reshape
+            q = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="k_norm")(k)
 
         q = q.reshape(b, s, nq, hd)
         k = k.reshape(b, s, nkv, hd)
@@ -411,6 +420,16 @@ class LlamaDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
+        if cfg.post_norm:
+            # OLMo2: no input norms — the SUBLAYER OUTPUT is normalized
+            attn_out = LlamaAttention(cfg, self.layer_idx, name="self_attn")(
+                x, cos, sin, positions, attn_mask)
+            h = x + _make_norm(cfg, "post_attention_layernorm")(attn_out)
+            if cfg.num_local_experts > 0:
+                mlp_out = LlamaMoEBlock(cfg, name="block_sparse_moe")(h)
+            else:
+                mlp_out = LlamaMLP(cfg, name="mlp")(h)
+            return h + _make_norm(cfg, "post_feedforward_layernorm")(mlp_out)
         normed = _make_norm(cfg, "input_layernorm")(x)
         attn_out = LlamaAttention(cfg, self.layer_idx, name="self_attn")(
             normed, cos, sin, positions, attn_mask)

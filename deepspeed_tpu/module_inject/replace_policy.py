@@ -129,6 +129,29 @@ class OlmoPolicy(HFCheckpointPolicy):
         return out
 
 
+class Olmo2Policy(HFCheckpointPolicy):
+    """OLMo2: parametric RMSNorm moved to the SUBLAYER OUTPUTS (post-norm:
+    x + norm(attn(x)), x + norm(mlp(x))) plus RMSNorm on the flat q/k
+    projections (HF ``modeling_olmo2.py`` Olmo2DecoderLayer/Olmo2Attention)."""
+    arch = "olmo2"
+
+    def config_from_hf(self, hf_config):
+        import dataclasses
+        cfg = super().config_from_hf(hf_config)
+        return dataclasses.replace(cfg, qk_norm=True, post_norm=True)
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        out.pop(p + "input_layernorm.weight")  # no pre-norms in OLMo2
+        out[p + "post_feedforward_layernorm.weight"] = \
+            (f + "post_feedforward_layernorm/weight", False)
+        out[p + "self_attn.q_norm.weight"] = (f + "self_attn/q_norm/weight", False)
+        out[p + "self_attn.k_norm.weight"] = (f + "self_attn/k_norm/weight", False)
+        return out
+
+
 class CoherePolicy(HFCheckpointPolicy):
     """Cohere Command-R: weight-only layernorm, PARALLEL attn+mlp residual
     off ONE shared input norm, GPT-J-style interleaved rotary
@@ -1268,6 +1291,8 @@ _POLICIES = {
     "StableLmForCausalLM": StableLmPolicy,
     "olmo": OlmoPolicy,
     "OlmoForCausalLM": OlmoPolicy,
+    "olmo2": Olmo2Policy,
+    "Olmo2ForCausalLM": Olmo2Policy,
     "cohere": CoherePolicy,
     "CohereForCausalLM": CoherePolicy,
 }

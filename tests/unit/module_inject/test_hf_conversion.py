@@ -840,3 +840,52 @@ def test_olmo_cohere_serve_through_ragged_engine(arch):
         ref2 = hf_model(torch.tensor([prompt + [nxt]],
                                      dtype=torch.long)).logits.numpy()[0, -1]
     np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
+
+
+def test_olmo2_postnorm_qknorm_logits_match_hf():
+    """OLMo2: post-norm residual + flat q/k RMSNorm."""
+    cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(22)
+    hf_model = transformers.Olmo2ForCausalLM(cfg).eval()
+    with torch.no_grad():  # non-unit norm scales so the mapping is tested
+        for n, p in hf_model.named_parameters():
+            if "norm" in n:
+                p.normal_(1.0, 0.1)
+    ours_cfg, params = _logits_match("olmo2", hf_model, cfg.to_dict())
+    assert ours_cfg.qk_norm and ours_cfg.post_norm
+    lp = params["model"]["layers_0"]
+    assert "q_norm" in lp["self_attn"] and "post_feedforward_layernorm" in lp
+    assert "input_layernorm" not in lp
+
+
+def test_olmo2_serves_through_ragged_engine():
+    cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(23)
+    hf_model = transformers.Olmo2ForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("olmo2", hf_model.state_dict(),
+                                             cfg.to_dict())
+    ours_cfg = dataclasses.replace(ours_cfg, dtype=jnp.float32)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(ours_cfg, params=params, dtype=jnp.float32,
+                             kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
