@@ -290,6 +290,8 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             y = h @ _kernel(lp["self_attn"][name])
             if "bias" in lp["self_attn"][name]:  # qwen2/OPT/Phi biases
                 y = y + lp["self_attn"][name]["bias"]
+            if cfg.clip_qkv is not None:  # OLMo clamp — BEFORE qk-norm,
+                y = jnp.clip(y, -cfg.clip_qkv, cfg.clip_qkv)  # as llama.py
             if norm is not None:  # OLMo2 qk-norm on the FLAT projection
                 y = rms_norm(y, lp["self_attn"][norm]["weight"],
                              cfg.rms_norm_eps)
@@ -298,10 +300,6 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         q = proj("q_proj", nq, "q_norm" if cfg.qk_norm else None)
         k = proj("k_proj", nkv, "k_norm" if cfg.qk_norm else None)
         v = proj("v_proj", nkv)
-        if cfg.clip_qkv is not None:  # OLMo stability clamp
-            q = jnp.clip(q, -cfg.clip_qkv, cfg.clip_qkv)
-            k = jnp.clip(k, -cfg.clip_qkv, cfg.clip_qkv)
-            v = jnp.clip(v, -cfg.clip_qkv, cfg.clip_qkv)
         if cfg.pos_embedding == "rope":
             q = _rope_tok(q, cos, sin, batch.token_pos, cfg.rotary_dim,
                           cfg.rope_interleaved)
@@ -360,23 +358,12 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         if "bias" in lp["self_attn"]["o_proj"]:
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
-        if cfg.post_norm:  # OLMo2: x + norm(attn(x)), then x + norm(mlp(x))
-            x = x + _norm_tok(attn_out, lp["post_attention_layernorm"], cfg)
-            x = x + _norm_tok(_mlp_tok(x, lp, cfg),
-                              lp["post_feedforward_layernorm"], cfg)
-            continue
-        if cfg.parallel_residual:
-            # Falcon/Phi: attention and MLP both read the SAME normed input;
-            # GPT-NeoX (parallel_residual_norms=2): MLP norms x independently
-            h_mlp = (_norm_tok(x, lp.get("post_attention_layernorm"), cfg)
-                     if cfg.parallel_residual_norms == 2 else h)
-            x = x + attn_out + _mlp_tok(h_mlp, lp, cfg)
-            continue
-        x = x + attn_out
-        h2 = _norm_tok(x, lp.get("post_attention_layernorm"), cfg)
-        if cfg.num_local_experts > 0:  # Mixtral MoE block (matches models/llama.py)
+        def _ffn(h_in):
+            """Dense MLP or Mixtral-style MoE block (matches models/llama.py)."""
+            if cfg.num_local_experts == 0:
+                return _mlp_tok(h_in, lp, cfg)
             moe = lp["block_sparse_moe"]
-            logits = h2.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
+            logits = h_in.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
             probs = jax.nn.softmax(logits, axis=-1)
             w, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
             if cfg.moe_renormalize:  # Mixtral; Qwen2-MoE keeps raw mass
@@ -386,16 +373,28 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             def _w(name):
                 t = moe[name]
                 return t.dequantized() if hasattr(t, "dequantized") else t
-            moe_out = moe_grouped_mlp(h2, _w("w1"), _w("w3"), _w("w2"), idx, w)
+            moe_out = moe_grouped_mlp(h_in, _w("w1"), _w("w3"), _w("w2"), idx, w)
             if cfg.shared_expert_intermediate_size:  # Qwen2-MoE shared expert
                 se = moe["shared_expert"]
-                shared = (jax.nn.silu(h2 @ _kernel(se["gate_proj"]))
-                          * (h2 @ _kernel(se["up_proj"]))) @ _kernel(se["down_proj"])
-                g = h2.astype(jnp.float32) @ moe["shared_expert_gate"]["kernel"]
+                shared = (jax.nn.silu(h_in @ _kernel(se["gate_proj"]))
+                          * (h_in @ _kernel(se["up_proj"]))) @ _kernel(se["down_proj"])
+                g = h_in.astype(jnp.float32) @ moe["shared_expert_gate"]["kernel"]
                 moe_out = moe_out + jax.nn.sigmoid(g).astype(x.dtype) * shared
-            x = x + moe_out
-        else:
-            x = x + _mlp_tok(h2, lp, cfg)
+            return moe_out
+
+        if cfg.post_norm:  # OLMo2: x + norm(attn(x)), then x + norm(ffn(x))
+            x = x + _norm_tok(attn_out, lp["post_attention_layernorm"], cfg)
+            x = x + _norm_tok(_ffn(x), lp["post_feedforward_layernorm"], cfg)
+            continue
+        if cfg.parallel_residual:
+            # Falcon/Phi: attention and MLP both read the SAME normed input;
+            # GPT-NeoX (parallel_residual_norms=2): MLP norms x independently
+            h_mlp = (_norm_tok(x, lp.get("post_attention_layernorm"), cfg)
+                     if cfg.parallel_residual_norms == 2 else h)
+            x = x + attn_out + _ffn(h_mlp)
+            continue
+        x = x + attn_out
+        x = x + _ffn(_norm_tok(x, lp.get("post_attention_layernorm"), cfg))
 
     x = _norm_tok(x, p.get("norm"), cfg)
     final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]
