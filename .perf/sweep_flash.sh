@@ -1,0 +1,12 @@
+#!/bin/bash
+# Flash-attention block-size sweep on the real chip (run manually once
+# .perf/TPU_UP exists; uses bench.py's one-line JSON output per config).
+cd /root/repo
+OUT=/root/repo/.perf/flash_sweep_r4.out
+: > $OUT
+for B in "" "128,128" "128,256" "256,256" "256,512" "512,512" "512,1024"; do
+  if [ -z "$B" ]; then label="auto"; unset DS_TPU_FLASH_BLOCKS; else label="$B"; export DS_TPU_FLASH_BLOCKS="$B"; fi
+  echo "=== DS_TPU_FLASH_BLOCKS=$label $(date -u +%T)" >> $OUT
+  timeout 1800 python bench.py 2>&1 | tail -1 >> $OUT
+done
+echo "sweep done $(date -u +%FT%TZ)" >> $OUT
