@@ -36,8 +36,8 @@ def op_report():
     from .ops import cpu_optim as _cpu_optim  # noqa: F401
     _cpu_optim.cpu_optim_available()
     for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope",
-                "evoformer_attn", "spatial", "cpu_optim",
-                "sparse_attention.sparse_self_attention"):
+                "evoformer_attn", "spatial", "cpu_optim", "paged_attention",
+                "grouped_matmul", "sparse_attention.sparse_self_attention"):
         try:
             importlib.import_module(f".ops.{mod}", package=__package__)
         except ImportError:

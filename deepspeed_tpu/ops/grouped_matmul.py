@@ -84,3 +84,10 @@ def moe_dense_mlp(x, w1, w3, w2, top_idx, top_w, *, activation=jax.nn.silu):
     a = activation(jnp.einsum("th,ehf->tef", x, w1)) * jnp.einsum("th,ehf->tef", x, w3)
     y = jnp.einsum("tef,efh->teh", a, w2)
     return jnp.einsum("te,teh->th", cw.astype(y.dtype), y).astype(x.dtype)
+
+
+from .registry import registry  # noqa: E402
+
+registry.register("grouped_matmul", "xla", True,
+                  "MoE grouped GEMM, FLOPs proportional to top-k (reference "
+                  "cutlass_ops moe_gemm)")

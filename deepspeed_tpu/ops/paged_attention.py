@@ -35,7 +35,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover - pallas-less jax installs
+    pltpu = None
+    _HAS_PLTPU = False
 
 NEG_INF = -1e30
 
@@ -228,3 +234,10 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     any_visible = mask.any(-1)[:, :, None, None, None]
     out = jnp.einsum("snkgl,skld->snkgd", probs, v_h)
     return jnp.where(any_visible, out, 0.0).astype(q.dtype)
+
+
+from .registry import registry  # noqa: E402
+
+registry.register("paged_attention", "pallas" if _HAS_PLTPU else "xla", True,
+                  "ragged blocked-flash decode over paged KV (block tables, "
+                  "window/ALiBi/scale in-kernel; reference ragged_ops)")

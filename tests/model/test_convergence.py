@@ -1,0 +1,95 @@
+"""Model-level convergence sanity (reference ``tests/model``:
+BingBertSquad/Megatron_GPT2 ``run_sanity_check.py`` — does the full stack
+actually LEARN, not just run).
+
+Task: induction heads on synthetic sequences (a b ... a -> b). A 2-layer
+attention model must drive loss far below the unigram floor; this exercises
+the optimizer, lr schedule, loss scaling, ZeRO sharding, and the fused
+train step together over hundreds of real steps.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm.mesh import reset_mesh_context  # noqa: E402
+from deepspeed_tpu.models import LlamaConfig, init_llama  # noqa: E402
+import dataclasses  # noqa: E402
+
+VOCAB, SEQ, BATCH = 64, 32, 16
+
+
+def _induction_batch(rng):
+    """Random token pairs repeated: every second occurrence is predictable."""
+    half = rng.integers(2, VOCAB, (BATCH, SEQ // 2))
+    ids = np.concatenate([half, half], axis=1)
+    return jnp.asarray(ids, jnp.int32)
+
+
+def _train(config_over, steps=150, lr=3e-3, dtype=jnp.float32):
+    reset_mesh_context()
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), vocab_size=VOCAB, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=SEQ, dtype=dtype)
+    model, params = init_llama(cfg, seed=0)
+    ds_config = {"train_batch_size": BATCH,
+                 "optimizer": {"type": "AdamW",
+                               "params": {"lr": lr, "weight_decay": 0.01}},
+                 "scheduler": {"type": "WarmupLR",
+                               "params": {"warmup_min_lr": 0.0,
+                                          "warmup_max_lr": lr,
+                                          "warmup_num_steps": 20}},
+                 "gradient_clipping": 1.0,
+                 "steps_per_print": 10000}
+    ds_config.update(config_over)
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=ds_config)
+    rng = np.random.default_rng(1)
+    first = last = None
+    for i in range(steps):
+        ids = _induction_batch(rng)
+        loss = engine.forward(ids, labels=ids)
+        engine.backward(loss)
+        engine.step()
+        if i == 0:
+            first = float(loss)
+        last = float(loss)
+    return first, last, engine
+
+
+@pytest.mark.world_size(8)
+@pytest.mark.parametrize("over", [
+    {},                                              # plain DP
+    {"zero_optimization": {"stage": 2}},             # sharded grads/opt
+    {"zero_optimization": {"stage": 3},
+     "mesh": {"data": 2, "fsdp": 4}},                # param sharding, 2D mesh
+    {"bf16": {"enabled": True}},                     # mixed precision
+])
+def test_induction_convergence(over):
+    dtype = jnp.bfloat16 if over.get("bf16", {}).get("enabled") else jnp.float32
+    first, last, eng = _train(over, dtype=dtype)
+    # unigram floor for the predictable half is ~log(62)≈4.1; an induction
+    # circuit cuts total loss far under the initial ~4.2
+    assert first > 3.5, first
+    assert last < first * 0.55, (first, last)
+    # eval-mode forward on a held-out batch must land in the trained-loss
+    # neighborhood (the task distribution is stationary) — a train-mode
+    # leak or broken no-grad path would not
+    eng.eval()
+    ids = _induction_batch(np.random.default_rng(99))
+    ev = float(eng.forward(ids, labels=ids))
+    assert np.isfinite(ev) and ev < first * 0.7, (ev, first, last)
+
+
+@pytest.mark.world_size(8)
+def test_fp16_loss_scaling_convergence():
+    """Dynamic loss scaling path trains to the same place as fp32."""
+    _, last16, eng = _train({"fp16": {"enabled": True,
+                                      "initial_scale_power": 12}},
+                            dtype=jnp.bfloat16)
+    _, last32, _ = _train({})
+    assert last16 < 2.6 and last32 < 2.6, (last16, last32)
+    assert eng.skipped_steps <= 3  # a few early overflows are fine
