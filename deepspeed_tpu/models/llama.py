@@ -284,11 +284,16 @@ class LlamaAttention(nn.Module):
             shape = dict(get_mesh_context().mesh.shape)
             return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
 
-        use_flash = (cfg.attn_impl != "xla" and attn_mask is None
-                     and cfg.pos_embedding != "alibi"
-                     and (s <= 128 or s % 128 == 0)
-                     and (cfg.attn_impl == "flash"
-                          or (jax.default_backend() == "tpu" and _attn_unsharded())))
+        # shared flash eligibility (shape/mask/positions); the sharded and
+        # unsharded dispatch conditions below both build on it
+        flash_shape_ok = (cfg.attn_impl != "xla" and attn_mask is None
+                          and cfg.pos_embedding != "alibi"
+                          and (s <= 128 or s % 128 == 0))
+        on_flash_backend = (cfg.attn_impl == "flash"
+                            or jax.default_backend() == "tpu")
+        # the raw pallas_call can't auto-partition: under a nontrivial
+        # seq/model mesh the sharded dispatch below owns the kernel path
+        use_flash = flash_shape_ok and on_flash_backend and _attn_unsharded()
         if use_flash:
             # the Pallas kernel handles local (sliding-window) attention
             # natively, skipping out-of-window blocks
@@ -322,11 +327,19 @@ class LlamaAttention(nn.Module):
 
             from ..comm.mesh import mesh_is_initialized, get_mesh_context
             if mesh_is_initialized() and get_mesh_context().axis_size("seq") > 1:
-                # Ulysses SP (sequence/layer.py): activations ride the mesh
-                # seq-sharded; the head/seq sharding constraints make GSPMD
-                # emit the all-to-all pair around full-sequence attention
-                from ..sequence.layer import ulysses_spmd
-                attn = ulysses_spmd(_core_attn, q, k, v)
+                # Ulysses SP (sequence/layer.py): flash-inside-shard_map when
+                # the shapes allow it (the 32k-seq memory-safe path); GSPMD
+                # sharding constraints + XLA attention otherwise
+                from ..sequence.layer import ulysses_spmd, ulysses_flash
+                attn = None
+                if flash_shape_ok and on_flash_backend:
+                    # interpret-mode only when the kernel is explicitly
+                    # forced off-TPU (numerics tool, not a serving path)
+                    attn = ulysses_flash(
+                        q, k, v, window=window, scale=cfg.attn_scale,
+                        interpret=jax.default_backend() != "tpu")
+                if attn is None:
+                    attn = ulysses_spmd(_core_attn, q, k, v)
             else:
                 attn = _core_attn(q, k, v)
         out = attn.reshape(b, s, nq * hd)

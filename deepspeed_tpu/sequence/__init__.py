@@ -5,7 +5,8 @@ context-parallel extension the reference lacks (SURVEY.md §2.4: flagged as the
 TPU CP analog).
 """
 
-from .layer import DistributedAttention, seq_all_to_all, ulysses_spmd
+from .layer import (DistributedAttention, seq_all_to_all, ulysses_spmd,
+                    ulysses_flash)
 from .ring import ring_attention
 from .cross_entropy import vocab_sequence_parallel_cross_entropy
 
@@ -13,6 +14,7 @@ __all__ = [
     "DistributedAttention",
     "seq_all_to_all",
     "ulysses_spmd",
+    "ulysses_flash",
     "ring_attention",
     "vocab_sequence_parallel_cross_entropy",
 ]
