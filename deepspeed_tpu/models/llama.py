@@ -275,14 +275,11 @@ class LlamaAttention(nn.Module):
         # dot_product_attention otherwise.
         from ..ops.attention import flash_attention
 
-        def _attn_unsharded():
-            # a pallas_call doesn't auto-partition under GSPMD: only take the
-            # kernel path when the head/sequence mesh axes are trivial
-            from ..comm.mesh import mesh_is_initialized, get_mesh_context
-            if not mesh_is_initialized():
-                return True
-            shape = dict(get_mesh_context().mesh.shape)
-            return shape.get("model", 1) == 1 and shape.get("seq", 1) == 1
+        from ..comm.mesh import mesh_is_initialized, get_mesh_context
+        mesh_shape = (dict(get_mesh_context().mesh.shape)
+                      if mesh_is_initialized() else {})
+        sp_sz = mesh_shape.get("seq", 1)
+        mp_sz = mesh_shape.get("model", 1)
 
         # shared flash eligibility (shape/mask/positions); the sharded and
         # unsharded dispatch conditions below both build on it
@@ -291,9 +288,11 @@ class LlamaAttention(nn.Module):
                           and (s <= 128 or s % 128 == 0))
         on_flash_backend = (cfg.attn_impl == "flash"
                             or jax.default_backend() == "tpu")
-        # the raw pallas_call can't auto-partition: under a nontrivial
-        # seq/model mesh the sharded dispatch below owns the kernel path
-        use_flash = flash_shape_ok and on_flash_backend and _attn_unsharded()
+        # a raw pallas_call doesn't auto-partition under GSPMD: with a
+        # nontrivial seq/model mesh the sharded dispatch below owns the
+        # kernel path
+        use_flash = (flash_shape_ok and on_flash_backend
+                     and sp_sz == 1 and mp_sz == 1)
         if use_flash:
             # the Pallas kernel handles local (sliding-window) attention
             # natively, skipping out-of-window blocks
@@ -325,22 +324,21 @@ class LlamaAttention(nn.Module):
                                                     is_causal=True,
                                                     scale=cfg.attn_scale)
 
-            from ..comm.mesh import mesh_is_initialized, get_mesh_context
-            if mesh_is_initialized() and get_mesh_context().axis_size("seq") > 1:
-                # Ulysses SP (sequence/layer.py): flash-inside-shard_map when
-                # the shapes allow it (the 32k-seq memory-safe path); GSPMD
-                # sharding constraints + XLA attention otherwise
-                from ..sequence.layer import ulysses_spmd, ulysses_flash
-                attn = None
-                if flash_shape_ok and on_flash_backend:
-                    # interpret-mode only when the kernel is explicitly
-                    # forced off-TPU (numerics tool, not a serving path)
-                    attn = ulysses_flash(
-                        q, k, v, window=window, scale=cfg.attn_scale,
-                        interpret=jax.default_backend() != "tpu")
-                if attn is None:
-                    attn = ulysses_spmd(_core_attn, q, k, v)
-            else:
+            attn = None
+            if (sp_sz > 1 or mp_sz > 1) and flash_shape_ok and on_flash_backend:
+                # flash-inside-shard_map: seq axis = Ulysses all-to-alls
+                # (the 32k-seq memory-safe path), model axis = per-head-block
+                # kernel (a raw pallas_call can't auto-partition under GSPMD)
+                from ..sequence.layer import ulysses_flash
+                attn = ulysses_flash(
+                    q, k, v, window=window, scale=cfg.attn_scale,
+                    interpret=jax.default_backend() != "tpu")
+            if attn is None and sp_sz > 1:
+                # GSPMD Ulysses: sharding constraints make XLA emit the
+                # all-to-all pair around full-sequence attention
+                from ..sequence.layer import ulysses_spmd
+                attn = ulysses_spmd(_core_attn, q, k, v)
+            if attn is None:
                 attn = _core_attn(q, k, v)
         out = attn.reshape(b, s, nq * hd)
         return _dense(cfg.hidden_size, "o_proj", (HEADS, EMBED), cfg.dtype,

@@ -18,11 +18,13 @@ Two implementations, matching the two JAX programming styles:
    the compiler free to overlap them with the qkv projections.
 3. `ulysses_flash` — the long-context fast path: explicit all-to-alls
    around the Pallas flash kernel inside a partial-manual ``shard_map``
-   (seq axis manual, everything else GSPMD). The pure-GSPMD form can't use
-   a pallas_call (it doesn't auto-partition), so its local attention falls
-   back to XLA, which materializes O(S²) logits per head — at the 32k-seq
-   Ulysses operating point (blogs/deepspeed-ulysses: 54%-of-peak bar) that
-   is the difference between flash-bounded HBM and OOM.
+   (the seq AND model axes are manual when nontrivial; every other axis
+   stays GSPMD). The pure-GSPMD form can't use a pallas_call (it doesn't
+   auto-partition), so its local attention falls back to XLA, which
+   materializes O(S²) logits per head — at the 32k-seq Ulysses operating
+   point (blogs/deepspeed-ulysses: 54%-of-peak bar) that is the difference
+   between flash-bounded HBM and OOM. The model axis alone also routes
+   here: per-head-block kernel, no collectives.
 """
 
 from typing import Callable, Optional
@@ -122,37 +124,52 @@ def ulysses_spmd(local_attention: Callable,
 
 def ulysses_flash(q, k, v, *, window: Optional[int] = None,
                   scale: Optional[float] = None,
-                  sequence_axis: str = "seq", mesh_ctx=None,
-                  interpret: bool = False):
-    """Ulysses with the Pallas flash kernel per device (see module doc §3).
+                  sequence_axis: str = "seq", model_axis: str = "model",
+                  mesh_ctx=None, interpret: bool = False):
+    """Ulysses/TP with the Pallas flash kernel per device (module doc §3).
 
-    [b, S/P, h, d] inputs under the global mesh → all-to-all to
-    [b, S, h/P, d] → causal flash over the full sequence on local heads →
-    all-to-all back. Requires ``nq % P == 0 and nkv % P == 0`` so the GQA
-    group mapping survives the head split (any misaligned layout provably
-    reduces to per-device KV slices of size zero, so there is no third
-    layout to fall back to). Returns ``None`` when ineligible — the caller
-    falls back to the GSPMD formulation.
+    [b, S/sp, h/mp, d] inputs under the global mesh → all-to-all over the
+    seq axis to [b, S, h/(sp·mp), d] → causal flash over the full sequence
+    on the local head block → all-to-all back. Both axes are optional:
+    seq-only is classic Ulysses; model-only needs NO collectives (attention
+    is embarrassingly parallel over heads) but still gets the kernel, which
+    a pallas_call under plain GSPMD cannot (no auto-partitioning). Requires
+    heads divisible by sp·mp so the GQA group mapping survives the split
+    (any misaligned layout provably reduces to empty per-device KV slices,
+    so there is no third layout to fall back to). Returns ``None`` when
+    ineligible — the caller falls back to the GSPMD formulation.
     """
     ctx = mesh_ctx or get_mesh_context()
-    sp = ctx.axis_size(sequence_axis)
-    if sp == 1 or dict(ctx.mesh.shape).get("model", 1) > 1:
+    shape = dict(ctx.mesh.shape)
+    sp = shape.get(sequence_axis, 1)
+    mp = shape.get(model_axis, 1)
+    if sp == 1 and mp == 1:
         return None
     nq, nkv = q.shape[2], k.shape[2]
-    if nq % sp or nkv % sp or q.shape[1] % sp:
-        return None  # heads/sequence must divide the manual seq axis
+    if nq % (sp * mp) or nkv % (sp * mp) or q.shape[1] % sp:
+        return None  # heads/sequence must divide the manual axes
 
     from ..ops.attention import flash_attention
 
-    def body(q_l, k_l, v_l):
-        qh = seq_all_to_all(q_l, sequence_axis, 2, 1)  # [b, S, nq/P, d]
-        kh = seq_all_to_all(k_l, sequence_axis, 2, 1)
-        vh = seq_all_to_all(v_l, sequence_axis, 2, 1)
-        out = flash_attention(qh, kh, vh, causal=True, scale=scale,
-                              window=window, interpret=interpret)
-        return seq_all_to_all(out, sequence_axis, 1, 2)  # [b, S/P, nq, d]
+    manual = set()
+    if sp > 1:
+        manual.add(sequence_axis)
+    if mp > 1:
+        manual.add(model_axis)
 
-    spec = P(None, sequence_axis, None, None)
+    def body(q_l, k_l, v_l):
+        if sp > 1:
+            q_l = seq_all_to_all(q_l, sequence_axis, 2, 1)  # [b,S,h/(sp·mp),d]
+            k_l = seq_all_to_all(k_l, sequence_axis, 2, 1)
+            v_l = seq_all_to_all(v_l, sequence_axis, 2, 1)
+        out = flash_attention(q_l, k_l, v_l, causal=True, scale=scale,
+                              window=window, interpret=interpret)
+        if sp > 1:
+            out = seq_all_to_all(out, sequence_axis, 1, 2)  # [b,S/sp,h/mp,d]
+        return out
+
+    spec = P(None, sequence_axis if sp > 1 else None,
+             model_axis if mp > 1 else None, None)
     return jax.shard_map(body, mesh=ctx.mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={sequence_axis},
+                         out_specs=spec, axis_names=frozenset(manual),
                          check_vma=False)(q, k, v)
