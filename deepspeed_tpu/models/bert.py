@@ -62,7 +62,16 @@ class BertSelfAttention(nn.Module):
         mask = None
         if attn_mask is not None:
             mask = attn_mask[:, None, None, :].astype(bool)  # key padding
-        attn = jax.nn.dot_product_attention(q, k, v, mask=mask)
+        # unmasked encoder attention rides the Pallas flash kernel on TPU
+        # (bidirectional; the legacy DeepSpeedTransformerLayer training path
+        # — reference csrc/transformer fused BERT kernels); padding masks
+        # and non-tiling lengths use XLA's fused attention
+        if (mask is None and jax.default_backend() == "tpu"
+                and (s <= 128 or s % 128 == 0)):
+            from ..ops.attention import flash_attention
+            attn = flash_attention(q, k, v, causal=False)
+        else:
+            attn = jax.nn.dot_product_attention(q, k, v, mask=mask)
         out = attn.reshape(b, s, n * hd)
         return _dense(cfg.hidden_size, "output", (HEADS, EMBED), cfg.dtype, True)(out)
 
