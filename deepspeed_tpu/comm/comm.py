@@ -177,24 +177,26 @@ def mpi_discovery(distributed_port: int = 29500, auto: bool = True):
         return default
 
     coord = _env("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
-    nproc = _env("JAX_NUM_PROCESSES", "NUM_PROCESSES")
-    pid = _env("JAX_PROCESS_ID", "PROCESS_ID")
+    # mpirun's size/rank env is part of the EXPLICIT contract (the pre-probe
+    # code honored it unconditionally, and reference auto_mpi_discovery=False
+    # only disables the mpi4py probing, not the env) — auto gates only the
+    # coordinator guessing and the Slurm/pdsh families below
+    nproc = _env("JAX_NUM_PROCESSES", "NUM_PROCESSES", "OMPI_COMM_WORLD_SIZE")
+    pid = _env("JAX_PROCESS_ID", "PROCESS_ID", "OMPI_COMM_WORLD_RANK")
 
     if auto and _env("OMPI_COMM_WORLD_SIZE"):
-        nproc = nproc if nproc is not None else _env("OMPI_COMM_WORLD_SIZE")
-        pid = pid if pid is not None else _env("OMPI_COMM_WORLD_RANK", default="0")
         if coord is None:
             uri = _env("OMPI_MCA_orte_hnp_uri", "PMIX_SERVER_URI2", default="")
             if "tcp://" in uri:
                 head = uri.split("tcp://", 1)[1].split(",")[0].split(":")[0]
                 coord = f"{head}:{distributed_port}"
-    elif auto and _env("SLURM_NTASKS"):
-        # STEP-scoped task count first: inside `salloc`/`sbatch` WITHOUT an
-        # srun step, SLURM_NTASKS reflects the allocation (e.g. 4) while the
-        # running shell/batch step is a single task — treating that as a
-        # 4-process rendezvous would block forever waiting for peers
-        nproc = nproc if nproc is not None \
-            else _env("SLURM_STEP_NUM_TASKS", "SLURM_NTASKS")
+    elif auto and _env("SLURM_STEP_NUM_TASKS"):
+        # STEP-scoped vars only: srun sets SLURM_STEP_NUM_TASKS per task,
+        # while a bare `sbatch`/`salloc` shell has SLURM_NTASKS (the
+        # allocation) without any step — treating the allocation size as a
+        # rendezvous world would block forever waiting for peers that were
+        # never launched
+        nproc = nproc if nproc is not None else _env("SLURM_STEP_NUM_TASKS")
         pid = pid if pid is not None else _env("SLURM_PROCID", default="0")
         if coord is None:
             nodelist = _env("SLURM_STEP_NODELIST", "SLURM_JOB_NODELIST")

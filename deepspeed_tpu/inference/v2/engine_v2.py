@@ -253,6 +253,14 @@ class InferenceEngineV2:
                 # it if the cache truly runs out) rather than deadlocking
                 u = waiting[0]
                 if len(feed[u]) > max_batch_tokens:
+                    # chunked prefill bypasses put()'s checks: the FEED must
+                    # fit the blocks actually free NOW (external put()-created
+                    # sequences may pin part of the cache), else the
+                    # allocator would raise a raw error mid-chunk
+                    if _future_blocks(PlaceholderSequenceDescriptor(),
+                                      len(feed[u])) \
+                            > self._state_manager.free_blocks:
+                        raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
                     waiting.remove(u)
                     _prefill_chunked(u)
                 else:

@@ -64,9 +64,15 @@ class BertSelfAttention(nn.Module):
             mask = attn_mask[:, None, None, :].astype(bool)  # key padding
         # unmasked encoder attention rides the Pallas flash kernel on TPU
         # (bidirectional; the legacy DeepSpeedTransformerLayer training path
-        # — reference csrc/transformer fused BERT kernels); padding masks
-        # and non-tiling lengths use XLA's fused attention
-        if (mask is None and jax.default_backend() == "tpu"
+        # — reference csrc/transformer fused BERT kernels); padding masks,
+        # non-tiling lengths, and nontrivial seq/model meshes (a raw
+        # pallas_call can't auto-partition under GSPMD) use XLA attention
+        from ..comm.mesh import mesh_is_initialized, get_mesh_context
+        mesh_shape = (dict(get_mesh_context().mesh.shape)
+                      if mesh_is_initialized() else {})
+        unsharded = (mesh_shape.get("seq", 1) == 1
+                     and mesh_shape.get("model", 1) == 1)
+        if (mask is None and unsharded and jax.default_backend() == "tpu"
                 and (s <= 128 or s % 128 == 0)):
             from ..ops.attention import flash_attention
             attn = flash_attention(q, k, v, causal=False)

@@ -38,6 +38,11 @@ from ...utils.logging import logger
 SINGLE = "single_value_per_sample"
 ACCUMULATE = "accumulate_value_over_samples"
 
+# dtype.num -> dtype for the ACCUMULATE collective's descriptor exchange
+# (every process must pad with the SAME dtype, including empty shards)
+_DT_BY_NUM = {np.dtype(t).num: np.dtype(t)
+              for t in (np.int32, np.int64, np.float32, np.float64)}
+
 
 def metric_seqlen(sample) -> int:
     """Built-in metric (reference analyzer's seqlen example)."""
@@ -293,12 +298,19 @@ class DistributedDataAnalyzer:
                 merged[name] = np.concatenate(pieces)
             else:
                 # a process whose shard is EMPTY has a zero-size partial but
-                # the collective needs identical shapes: gather sizes first,
-                # pad empties to the common width (zeros contribute nothing)
-                size = np.asarray(multihost_utils.process_allgather(
-                    np.asarray([vals.size], np.int64)))
-                width = int(size.max())
-                padded = np.zeros(width, vals.dtype if vals.size else np.int64)
+                # the collective needs identical shapes AND dtypes: exchange
+                # (size, dtype enum) first, pad empties with zeros of the
+                # dtype some non-empty peer reported
+                desc = np.asarray([vals.size,
+                                   np.dtype(vals.dtype).num if vals.size else -1],
+                                  np.int64)
+                descs = np.asarray(multihost_utils.process_allgather(desc))
+                descs = descs.reshape(self.num_workers, 2)
+                width = int(descs[:, 0].max())
+                dt_nums = [int(d) for d in descs[:, 1] if d >= 0]
+                dt = _DT_BY_NUM.get(dt_nums[0], np.dtype(np.int64)) \
+                    if dt_nums else np.dtype(np.int64)
+                padded = np.zeros(width, dt)
                 padded[:vals.size] = vals
                 gathered = np.asarray(multihost_utils.process_allgather(padded))
                 merged[name] = gathered.reshape(self.num_workers, width).sum(axis=0)

@@ -71,6 +71,7 @@ def test_discovery_openmpi(clean_env):
 
 def test_discovery_slurm(clean_env):
     clean_env.setenv("SLURM_NTASKS", "4")
+    clean_env.setenv("SLURM_STEP_NUM_TASKS", "4")  # set by srun per task
     clean_env.setenv("SLURM_PROCID", "3")
     clean_env.setenv("SLURM_JOB_NODELIST", "tpu[001-004]")
     coord, nproc, pid = mpi_discovery()
@@ -78,7 +79,7 @@ def test_discovery_slurm(clean_env):
 
 
 def test_discovery_slurm_step_nodelist_preferred(clean_env):
-    clean_env.setenv("SLURM_NTASKS", "2")
+    clean_env.setenv("SLURM_STEP_NUM_TASKS", "2")
     clean_env.setenv("SLURM_PROCID", "1")
     clean_env.setenv("SLURM_JOB_NODELIST", "all[1-8]")
     clean_env.setenv("SLURM_STEP_NODELIST", "all[3-4]")
@@ -109,13 +110,23 @@ def test_discovery_fields_resolve_independently(clean_env):
 
 def test_discovery_slurm_alloc_without_srun_stays_single(clean_env):
     """`python train.py` inside salloc/sbatch WITHOUT srun: the allocation
-    advertises SLURM_NTASKS=4 but the running step is one task — a 4-way
-    rendezvous here would block forever waiting for peers."""
+    advertises SLURM_NTASKS=4 but no srun step exists (SLURM_STEP_NUM_TASKS
+    absent) — a 4-way rendezvous here would block forever waiting for peers
+    that were never launched."""
     clean_env.setenv("SLURM_NTASKS", "4")
     clean_env.setenv("SLURM_PROCID", "0")
-    clean_env.setenv("SLURM_STEP_NUM_TASKS", "1")
     clean_env.setenv("SLURM_JOB_NODELIST", "n[1-4]")
     assert mpi_discovery()[1] == 1
+
+
+def test_discovery_mpirun_env_survives_auto_off(clean_env):
+    """mpirun's size/rank env is the explicit contract (the reference's
+    auto_mpi_discovery=False only disables probing): auto=False must NOT
+    degrade an mpirun launch to N independent single-process runs."""
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "2")
+    clean_env.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.9:29500")
+    assert mpi_discovery(auto=False) == ("10.0.0.9:29500", 4, 2)
 
 
 def test_discovery_pdsh_hostlist(clean_env):
