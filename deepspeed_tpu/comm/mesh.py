@@ -99,7 +99,9 @@ class MeshContext:
             return self.world_size
         if isinstance(axis, (tuple, list)):
             return int(np.prod([self.axis_size(a) for a in axis]))
-        return self.mesh.shape[axis]
+        # an axis the mesh doesn't name is unsharded (custom axis_order
+        # meshes via initialize_mesh_device routinely omit standard axes)
+        return dict(self.mesh.shape).get(axis, 1)
 
     @property
     def world_size(self) -> int:

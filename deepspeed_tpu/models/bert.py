@@ -68,10 +68,9 @@ class BertSelfAttention(nn.Module):
         # non-tiling lengths, and nontrivial seq/model meshes (a raw
         # pallas_call can't auto-partition under GSPMD) use XLA attention
         from ..comm.mesh import mesh_is_initialized, get_mesh_context
-        mesh_shape = (dict(get_mesh_context().mesh.shape)
-                      if mesh_is_initialized() else {})
-        unsharded = (mesh_shape.get("seq", 1) == 1
-                     and mesh_shape.get("model", 1) == 1)
+        unsharded = (not mesh_is_initialized()
+                     or (get_mesh_context().axis_size("seq") == 1
+                         and get_mesh_context().axis_size("model") == 1))
         if (mask is None and unsharded and jax.default_backend() == "tpu"
                 and (s <= 128 or s % 128 == 0)):
             from ..ops.attention import flash_attention

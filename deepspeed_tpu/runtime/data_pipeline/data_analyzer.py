@@ -41,7 +41,9 @@ ACCUMULATE = "accumulate_value_over_samples"
 # dtype.num -> dtype for the ACCUMULATE collective's descriptor exchange
 # (every process must pad with the SAME dtype, including empty shards)
 _DT_BY_NUM = {np.dtype(t).num: np.dtype(t)
-              for t in (np.int32, np.int64, np.float32, np.float64)}
+              for t in (np.bool_, np.int8, np.int16, np.int32, np.int64,
+                        np.uint8, np.uint16, np.uint32, np.uint64,
+                        np.float16, np.float32, np.float64)}
 
 
 def metric_seqlen(sample) -> int:
@@ -308,8 +310,12 @@ class DistributedDataAnalyzer:
                 descs = descs.reshape(self.num_workers, 2)
                 width = int(descs[:, 0].max())
                 dt_nums = [int(d) for d in descs[:, 1] if d >= 0]
-                dt = _DT_BY_NUM.get(dt_nums[0], np.dtype(np.int64)) \
-                    if dt_nums else np.dtype(np.int64)
+                if dt_nums and dt_nums[0] not in _DT_BY_NUM:
+                    raise TypeError(
+                        f"ACCUMULATE metric '{name}' uses an unsupported "
+                        f"dtype (num={dt_nums[0]}); supported: "
+                        f"{sorted(str(d) for d in _DT_BY_NUM.values())}")
+                dt = _DT_BY_NUM[dt_nums[0]] if dt_nums else np.dtype(np.int64)
                 padded = np.zeros(width, dt)
                 padded[:vals.size] = vals
                 gathered = np.asarray(multihost_utils.process_allgather(padded))

@@ -53,7 +53,8 @@ class DeepSpeedDataLoader:
                  collate_fn: Optional[Callable] = None,
                  shuffle: bool = False,
                  seed: int = 0,
-                 drop_last: bool = True):
+                 drop_last: bool = True,
+                 sampler=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate
@@ -61,15 +62,28 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
+        # index sampler (DeepSpeedDataSampler): yields index arrays —
+        # curriculum difficulty gating lives there, not here
+        self.sampler = sampler
 
     def __len__(self):
+        if self.sampler is not None:
+            # the sampler counts GLOBAL batches but yields gas micro-batches
+            # per global batch — len must match what __iter__ yields
+            return len(self.sampler) * getattr(self.sampler, "gas", 1)
         n = len(self.dataset) / self.batch_size
         return math.floor(n) if self.drop_last else math.ceil(n)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self):
+        if self.sampler is not None:
+            for sel in self.sampler:
+                yield self.collate_fn([self.dataset[int(i)] for i in sel])
+            return
         idx = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)

@@ -323,7 +323,8 @@ class DeepSpeedTpuEngine:
             self.training_dataloader = DeepSpeedDataLoader(
                 training_data,
                 batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
-                collate_fn=collate_fn)
+                collate_fn=collate_fn,
+                sampler=self._build_curriculum_sampler(training_data))
 
         log_dist(
             f"DeepSpeedTpuEngine ready: zero_stage={zc.stage} dtype={self.compute_dtype.__name__} "
@@ -617,6 +618,48 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
     # ------------------------------------------------------------------
+
+    def _build_curriculum_sampler(self, training_data):
+        """``data_efficiency.data_sampling.curriculum_learning`` → a
+        difficulty-gated DeepSpeedDataSampler over the analyzer's metric
+        files (reference deepspeed_io consuming data_sampling config;
+        ``data_sampling/data_sampler.py:36``). Returns None when disabled.
+
+        Under single-controller SPMD the sampler draws the GLOBAL batch
+        (dp_size=1, micro = per-device micro × dp world); the engine's
+        batch sharding splits it over devices."""
+        ds_cfg = (self._config.data_efficiency_config or {}).get("data_sampling", {})
+        cl = ds_cfg.get("curriculum_learning", {})
+        if not (ds_cfg.get("enabled", False) and cl.get("enabled", False)):
+            return None
+        metrics = cl.get("curriculum_metrics", {})
+        if len(metrics) != 1:
+            raise ValueError(
+                "data_sampling.curriculum_learning.curriculum_metrics must "
+                f"contain exactly one metric (got {sorted(metrics)}); the "
+                "reference's multi-metric clustering is not implemented")
+        from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+        from .data_pipeline.data_analyzer import load_metric
+        from .data_pipeline.data_sampler import DeepSpeedDataSampler
+        name, m = next(iter(metrics.items()))
+        values = load_metric(m["metric_path"], name)
+        if len(values) != len(training_data):
+            raise ValueError(
+                f"metric '{name}' covers {len(values)} samples but the "
+                f"dataset has {len(training_data)} — rerun the data analyzer")
+        sched = CurriculumScheduler({
+            "curriculum_type": name,
+            "min_difficulty": m["min_difficulty"],
+            "max_difficulty": m["max_difficulty"],
+            "schedule_type": m.get("schedule_type", "fixed_linear"),
+            "schedule_config": m.get("schedule_config", {})})
+        return DeepSpeedDataSampler(
+            total_samples=len(training_data),
+            micro_batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            gradient_accumulation_steps=self.gradient_accumulation_steps(),
+            curriculum_scheduler=sched, metric_values=values,
+            shuffle=ds_cfg.get("shuffle", True),
+            seed=ds_cfg.get("seed", 1234))
 
     def _apply_data_efficiency(self, args, kwargs):
         """Per-micro-batch data-efficiency hooks (reference engine.py:1877-1883):
@@ -1066,6 +1109,10 @@ class DeepSpeedTpuEngine:
             sd["random_ltd"] = self.random_ltd_scheduler.state_dict()
         if self.curriculum_scheduler_legacy is not None:
             sd["curriculum_state"] = dict(self.curriculum_scheduler_legacy.get_state())
+        sampler = getattr(self.training_dataloader, "sampler", None) \
+            if self.training_dataloader is not None else None
+        if sampler is not None and hasattr(sampler, "state_dict"):
+            sd["data_sampler"] = sampler.state_dict()
         return sd
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
@@ -1168,4 +1215,10 @@ class DeepSpeedTpuEngine:
             if (self.curriculum_scheduler_legacy is not None
                     and "curriculum_state" in host_state):
                 self.curriculum_scheduler_legacy.set_state(host_state["curriculum_state"])
+            sampler = getattr(self.training_dataloader, "sampler", None) \
+                if self.training_dataloader is not None else None
+            if sampler is not None and "data_sampler" in host_state:
+                # resume consumed_samples + curriculum difficulty: training
+                # continues on the right difficulty band, no replayed data
+                sampler.load_state_dict(host_state["data_sampler"])
         return path, client_state

@@ -140,9 +140,8 @@ def ulysses_flash(q, k, v, *, window: Optional[int] = None,
     ineligible — the caller falls back to the GSPMD formulation.
     """
     ctx = mesh_ctx or get_mesh_context()
-    shape = dict(ctx.mesh.shape)
-    sp = shape.get(sequence_axis, 1)
-    mp = shape.get(model_axis, 1)
+    sp = ctx.axis_size(sequence_axis)
+    mp = ctx.axis_size(model_axis)
     if sp == 1 and mp == 1:
         return None
     nq, nkv = q.shape[2], k.shape[2]
