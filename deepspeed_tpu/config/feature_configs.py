@@ -57,7 +57,26 @@ class ZeroConfig(ConfigModel):
       stage 0 = pure DP; stage 1 = optimizer-state sharding;
       stage 2 = + gradient (accumulation buffer) sharding;
       stage 3 = + parameter sharding (XLA inserts gather/scatter).
-    Bucket-size knobs are kept for API parity and inform scan-chunking.
+
+    Knob disposition (the audit of every accepted key):
+    - WIRED: stage, offload_param/offload_optimizer (device/ratio),
+      max_live_parameters (scan-chunk governor), param_persistence_threshold,
+      zero_hpz_partition_size, zero_quantized_weights/gradients (qwZ/qgZ),
+      mics_shard_size, gather_16bit_weights_on_model_save (consolidated
+      16-bit export with every checkpoint).
+    - MOOT by construction (accepted for config-file compatibility, the
+      guarantee they buy is unconditional here): elastic_checkpoint (orbax
+      restores across any topology), load_from_fp32_weights (master weights
+      are always fp32), ignore_unused_parameters (no hook machinery to
+      trip), contiguous_gradients (XLA owns layout).
+    - TORCH-MECHANISM knobs with no XLA seam (accepted, inert, the
+      scheduler/compiler owns the behavior they tuned): bucket sizes,
+      overlap_comm, round_robin_gradients, sub_group_size, prefetch/
+      reuse-distance/module-granularity thresholds, legacy_stage1,
+      use_all_reduce_for_fetch_params, use_multi_rank_bucket_allreduce,
+      memory_efficient_linear, pipeline_loading_checkpoint,
+      override_module_apply, cpu_offload* legacy spellings (the offload_*
+      sub-configs are the wired path).
     """
     stage: int = Field(0, ge=0, le=3)
     contiguous_gradients: bool = True

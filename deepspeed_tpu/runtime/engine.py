@@ -1122,6 +1122,11 @@ class DeepSpeedTpuEngine:
         path = os.path.join(save_dir, str(tag))
         self.checkpoint_engine.save(self._state_dict(), path,
                                     host_state=self._host_state(client_state))
+        if self._config.zero_config.gather_16bit_weights_on_model_save:
+            # reference stage3_gather_16bit_weights_on_model_save
+            # (engine.py:3538): every checkpoint also carries consolidated
+            # 16-bit weights a serving stack can load without the topology
+            self.save_16bit_model(path)
         if save_latest and jax.process_index() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))

@@ -244,3 +244,31 @@ def test_misc_engine_api():
     engine.empty_partition_cache()
     engine.destroy()
     assert engine.params is None
+
+
+@pytest.mark.world_size(8)
+def test_gather_16bit_weights_on_model_save(tmp_path):
+    """stage3_gather_16bit_weights_on_model_save: every checkpoint also
+    carries the consolidated 16-bit weights (reference engine.py:3538)."""
+    import ml_dtypes
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(bf16={"enabled": True},
+                           zero_optimization={
+                               "stage": 3,
+                               "stage3_gather_16bit_weights_on_model_save": True}))
+    x = jnp.ones((8, 16))
+    loss = engine.forward(x, jnp.zeros_like(x))
+    engine.backward(loss)
+    engine.step()
+    engine.save_checkpoint(tmp_path, tag="t16")
+    consolidated = tmp_path / "t16" / "pytorch_model.npz"
+    assert consolidated.exists()
+    arc = np.load(consolidated)
+    assert str(arc["__dtype__"]) == "bfloat16"
+    live = jax.tree_util.tree_leaves(engine.params)
+    n_live = sum(1 for _ in live)
+    assert len([k for k in arc.files if k != "__dtype__"]) == n_live
