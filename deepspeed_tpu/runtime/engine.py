@@ -1088,6 +1088,25 @@ class DeepSpeedTpuEngine:
             sd["opt_state"] = self.opt_state
         return sd
 
+    def _checkpoint_tag_validation(self, tag) -> None:
+        """All processes must agree on the tag before anyone writes
+        (reference engine.py:3092 _checkpoint_tag_validation): a diverged
+        tag fragments one logical checkpoint across directories."""
+        from ..config.feature_configs import ValidationMode
+        mode = self._config.checkpoint_config.tag_validation
+        if jax.process_count() == 1 or mode == ValidationMode.IGNORE:
+            return
+        import zlib
+        from jax.experimental import multihost_utils
+        h = np.asarray([zlib.crc32(str(tag).encode())], np.int64)
+        all_h = np.asarray(multihost_utils.process_allgather(h)).ravel()
+        if not (all_h == all_h[0]).all():
+            msg = (f"checkpoint tag '{tag}' is not consistent across "
+                   "processes — a mixed-tag save fragments the checkpoint")
+            if mode == ValidationMode.FAIL:
+                raise ValueError(msg)
+            logger.warning(msg)
+
     def _host_state(self, client_state):
         sd = {
             "global_steps": self.global_steps,
@@ -1118,6 +1137,7 @@ class DeepSpeedTpuEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         tag = tag or f"global_step{self.global_steps}"
+        self._checkpoint_tag_validation(tag)
         self.checkpoint_engine.create(tag)
         path = os.path.join(save_dir, str(tag))
         self.checkpoint_engine.save(self._state_dict(), path,

@@ -115,3 +115,52 @@ def test_two_process_zero3_train_and_resume(tmp_path):
     tok = [line for line in outs[0].splitlines() if line.startswith("DCN_OK")][0]
     tok1 = [line for line in outs[1].splitlines() if line.startswith("DCN_OK")][0]
     assert tok.split()[-1] == tok1.split()[-1]
+
+
+CHILD_TAG = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax, jax.numpy as jnp
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    sys.path.insert(0, sys.argv[2])
+    from simple_model import simple_model_and_params
+
+    dist.init_distributed(mesh_axes={"data": 2})
+    model, params = simple_model_and_params(seed=0)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "checkpoint": {"tag_validation": "FAIL"},
+                "steps_per_print": 1000})
+    # mixed tags must FAIL on every process before anything is written
+    try:
+        eng.save_checkpoint(sys.argv[1], tag=f"rank{jax.process_index()}")
+        print("TAG_NO_ERROR", flush=True)
+    except ValueError:
+        print("TAG_FAIL_OK", flush=True)
+    # agreed tag succeeds
+    eng.save_checkpoint(sys.argv[1], tag="agreed")
+    print("TAG_AGREED_OK", flush=True)
+""")
+
+
+def test_checkpoint_tag_validation_across_processes(tmp_path):
+    """Reference engine.py:3092 _checkpoint_tag_validation: a diverged tag
+    fails BEFORE anyone writes (FAIL mode); an agreed tag saves fine."""
+    script = tmp_path / "child_tag.py"
+    script.write_text(CHILD_TAG)
+    unit_dir = os.path.join(REPO, "tests", "unit")
+    exports = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    cmds = build_commands(["localhost", "localhost"], "127.0.0.1", _free_port(),
+                          str(script), [str(tmp_path / "ck"), unit_dir], exports)
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    procs = [subprocess.Popen(c, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True) for c in cmds]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+        assert "TAG_FAIL_OK" in out and "TAG_AGREED_OK" in out, out[-2000:]
+        assert "TAG_NO_ERROR" not in out
