@@ -269,6 +269,12 @@ class ParallelWriteConfig(ConfigModel):
 
 
 class CheckpointConfig(ConfigModel):
+    """Knob disposition: tag_validation WIRED (cross-process tag agreement
+    check before any write, reference engine.py:3092); load_universal WIRED
+    (engine.load_universal_checkpoint path). use_node_local_storage and
+    parallel_write.pipeline_stage are torch-engine IO staging knobs with no
+    seam here — orbax owns per-process shard writes and async staging —
+    accepted inert for config-file compatibility."""
     tag_validation: ValidationMode = ValidationMode.WARN
     load_universal: bool = False
     use_node_local_storage: bool = False

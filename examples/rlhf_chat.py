@@ -1,0 +1,89 @@
+"""DeepSpeed-Chat-style RLHF loop with the hybrid engine.
+
+The reference's headline RLHF recipe (README.md: "15x over SOTA RLHF
+systems"; DeepSpeed-Chat step 3) interleaves GENERATION (experience
+collection) with TRAINING inside one engine — the hybrid engine flips
+between the paged-KV inference path and the fused training step over the
+SAME live weights (``runtime/hybrid_engine.py``).
+
+This example runs RAFT-style reward-ranked fine-tuning (the rejection-
+sampling cousin of PPO) on a toy reward — it demonstrates exactly the
+plumbing a full DeepSpeed-Chat port exercises:
+
+1. actor engine with ``hybrid_engine.enabled``: ``engine.generate`` serves
+   rollouts through the v2 paged KV cache over the LIVE training weights
+   (refreshed automatically after every optimizer step);
+2. experience collection: prompts → sampled rollouts → rewards;
+3. the update through the standard ``forward/backward/step`` contract on
+   the reward-selected rollouts.
+
+Usage:  python examples/rlhf_chat.py [--iters 8]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--rollouts", type=int, default=16)
+    ap.add_argument("--gen-len", type=int, default=12)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=128, intermediate_size=352,
+                      num_hidden_layers=2, num_attention_heads=8,
+                      num_key_value_heads=4, max_position_embeddings=64,
+                      dtype=jnp.float32)
+    model, params = init_llama(cfg, seed=0)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": args.rollouts // 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 5e-4}},
+                "hybrid_engine": {"enabled": True, "fp16": False,
+                                  "kv_block_size": 16, "num_kv_blocks": 256,
+                                  "max_out_tokens": 64},
+                "steps_per_print": 1000},
+        llama_config=cfg)
+
+    def reward_fn(tokens):
+        """Toy reward model: token diversity of the generated suffix."""
+        gen = tokens[-args.gen_len:]
+        return len(set(gen)) / len(gen)
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(2, cfg.vocab_size, (args.rollouts, 4)).astype(np.int32)
+
+    for it in range(args.iters):
+        # 1) experience: sampled rollouts from the LIVE weights (the hybrid
+        #    engine recasts its serving view lazily after each step())
+        rollouts = engine.generate(prompts, max_new_tokens=args.gen_len,
+                                   do_sample=True, temperature=1.0, seed=it)
+        rewards = np.asarray([reward_fn(r) for r in rollouts], np.float32)
+
+        # 2) select: keep the reward-top half (RAFT / best-of-n)
+        keep = np.argsort(rewards)[-(args.rollouts // 2):]
+        batch = np.asarray([rollouts[i] for i in keep], np.int32)
+
+        # 3) update through the standard engine contract
+        ids = jnp.asarray(batch)
+        loss = engine.forward(ids[:, :-1], labels=ids[:, 1:])
+        engine.backward(loss)
+        engine.step()
+        print(f"iter {it}: mean_reward={rewards.mean():.3f} "
+              f"kept_reward={rewards[keep].mean():.3f} loss={float(loss):.4f}")
+
+    print("done — every iteration generated from live weights (hybrid "
+          "engine paged-KV serving) and trained through the fused step.")
+
+
+if __name__ == "__main__":
+    main()
