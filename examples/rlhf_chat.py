@@ -34,6 +34,8 @@ def main():
     ap.add_argument("--rollouts", type=int, default=16)
     ap.add_argument("--gen-len", type=int, default=12)
     args = ap.parse_args()
+    if args.rollouts < 2 or args.rollouts % 2:
+        ap.error("--rollouts must be an even number >= 2 (top-half selection)")
 
     import jax.numpy as jnp
     import deepspeed_tpu
@@ -73,9 +75,10 @@ def main():
         keep = np.argsort(rewards)[-(args.rollouts // 2):]
         batch = np.asarray([rollouts[i] for i in keep], np.int32)
 
-        # 3) update through the standard engine contract
+        # 3) update through the standard engine contract (the model's CE
+        #    shifts internally: pass UNSHIFTED ids as both input and labels)
         ids = jnp.asarray(batch)
-        loss = engine.forward(ids[:, :-1], labels=ids[:, 1:])
+        loss = engine.forward(ids, labels=ids)
         engine.backward(loss)
         engine.step()
         print(f"iter {it}: mean_reward={rewards.mean():.3f} "
