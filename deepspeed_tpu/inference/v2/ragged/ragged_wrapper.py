@@ -105,22 +105,43 @@ class RaggedBatchWrapper:
         last_token_idx = np.zeros(S, dtype=np.int32)
         q_tok_idx = np.zeros((S, N), dtype=np.int32)
 
-        cursor = 0
-        for i, (seq, toks) in enumerate(zip(self._seqs, self._token_lists)):
-            n = toks.size
-            seq_start[i] = cursor
-            seq_n_new[i] = n
-            seq_seen[i] = seq.seen_tokens
-            bt = seq.block_table(B)
-            block_table[i] = bt
-            tokens[cursor:cursor + n] = toks
-            token_seq[cursor:cursor + n] = i
-            pos = seq.seen_tokens + np.arange(n, dtype=np.int32)
-            token_pos[cursor:cursor + n] = pos
-            token_slot[cursor:cursor + n] = bt[pos // bs] * bs + pos % bs
-            last_token_idx[i] = cursor + n - 1
-            q_tok_idx[i, :n] = cursor + np.arange(n, dtype=np.int32)
-            cursor += n
+        Sq = len(self._seqs)
+        if Sq and all(t.size == 1 for t in self._token_lists):
+            # pure-decode fast path (the steady state of serving): the whole
+            # assembly collapses to vector ops — one token per sequence,
+            # token index == sequence index
+            ar = np.arange(Sq, dtype=np.int32)
+            seen = np.fromiter((s.seen_tokens for s in self._seqs),
+                               np.int32, Sq)
+            for i, seq in enumerate(self._seqs):
+                block_table[i] = seq.block_table(B)  # cached per descriptor
+            tokens[:Sq] = np.fromiter((t[0] for t in self._token_lists),
+                                      np.int32, Sq)
+            token_seq[:Sq] = ar
+            token_pos[:Sq] = seen
+            token_slot[:Sq] = block_table[ar, seen // bs] * bs + seen % bs
+            seq_start[:Sq] = ar
+            seq_n_new[:Sq] = 1
+            seq_seen[:Sq] = seen
+            last_token_idx[:Sq] = ar
+            q_tok_idx[:Sq, 0] = ar
+        else:
+            cursor = 0
+            for i, (seq, toks) in enumerate(zip(self._seqs, self._token_lists)):
+                n = toks.size
+                seq_start[i] = cursor
+                seq_n_new[i] = n
+                seq_seen[i] = seq.seen_tokens
+                bt = seq.block_table(B)
+                block_table[i] = bt
+                tokens[cursor:cursor + n] = toks
+                token_seq[cursor:cursor + n] = i
+                pos = seq.seen_tokens + np.arange(n, dtype=np.int32)
+                token_pos[cursor:cursor + n] = pos
+                token_slot[cursor:cursor + n] = bt[pos // bs] * bs + pos % bs
+                last_token_idx[i] = cursor + n - 1
+                q_tok_idx[i, :n] = cursor + np.arange(n, dtype=np.int32)
+                cursor += n
 
         # ONE batched host->device transfer for all ten metadata arrays —
         # ten separate puts cost ~0.3 ms dispatch overhead EACH, which at

@@ -48,6 +48,7 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
         self._max_blocks = max_blocks_per_seq
         self._blocks: List[int] = []
         self._freed_through = 0  # table indices < this are released (None)
+        self._table_cache = {}  # width -> padded np table (decode hot path)
 
     @property
     def seen_tokens(self) -> int:
@@ -72,10 +73,15 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
         masked out by position bounds in the attention kernel; freed-prefix
         entries keep their POSITION with a 0 placeholder — every reader of
         those positions is masked by the attention window that justified the
-        free)."""
-        t = np.zeros(width, dtype=np.int32)
-        n = min(len(self._blocks), width)
-        t[:n] = [0 if b is None else b for b in self._blocks[:n]]
+        free). Cached per width — the block list changes once per
+        ``block_size`` decoded tokens, not per decode step; callers must
+        not mutate the returned array."""
+        t = self._table_cache.get(width)
+        if t is None:
+            t = np.zeros(width, dtype=np.int32)
+            n = min(len(self._blocks), width)
+            t[:n] = [0 if b is None else b for b in self._blocks[:n]]
+            self._table_cache[width] = t
         return t
 
     def free_prefix_blocks(self, through_block: int) -> List[int]:
@@ -92,6 +98,8 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
                 self._blocks[i] = None
         self._freed_through = max(self._freed_through,
                                   min(through_block, len(self._blocks)))
+        if freed:
+            self._table_cache.clear()
         return freed
 
     def extend_kv_cache(self, new_blocks) -> None:
@@ -99,6 +107,7 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
         if len(self._blocks) + len(blocks) > self._max_blocks:
             raise ValueError(f"Sequence {self.uid} exceeds max_blocks_per_seq={self._max_blocks}")
         self._blocks.extend(blocks)
+        self._table_cache.clear()
 
     def pre_forward(self, num_tokens: int) -> None:
         """Reference sequence_descriptor: record in-flight tokens."""
