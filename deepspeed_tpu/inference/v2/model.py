@@ -74,14 +74,22 @@ def _norm_tok(x, p, cfg):
         elif cfg.norm_type == "layernorm_nobias":
             out = out * p["scale"]
         return out.astype(x.dtype)
-    return rms_norm(x, p["weight"], cfg.rms_norm_eps)
+    w = p["weight"]
+    if getattr(cfg, "norm_plus_one", False):
+        # Gemma stores (weight - 1); the +1 must happen in fp32 — in bf16
+        # the ~1e-3 learned deltas round away against 1.0 (HF GemmaRMSNorm
+        # also computes (1 + weight.float()) in fp32)
+        w = 1.0 + w.astype(jnp.float32)
+    return rms_norm(x, w, cfg.rms_norm_eps)
 
 
 def _mlp_tok(x, lp, cfg):
     """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc."""
     mlp = lp["mlp"]
-    if cfg.mlp_type == "swiglu":
-        gate = jax.nn.silu(x @ _kernel(mlp["gate_proj"]))
+    if cfg.mlp_type in ("swiglu", "geglu_tanh"):
+        pre = x @ _kernel(mlp["gate_proj"])
+        gate = (jax.nn.silu(pre) if cfg.mlp_type == "swiglu"
+                else jax.nn.gelu(pre, approximate=True))
         return (gate * (x @ _kernel(mlp["up_proj"]))) @ _kernel(mlp["down_proj"])
     act = {"gelu_fc": lambda y: jax.nn.gelu(y, approximate=False),
            "gelu_tanh_fc": lambda y: jax.nn.gelu(y, approximate=True),
@@ -112,7 +120,14 @@ class RaggedLlamaModel:
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
         # not a serving path)
         if attn_backend == "auto":
-            attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
+            attn_backend = ("paged" if jax.default_backend() == "tpu"
+                            and config.attn_logit_softcapping is None
+                            else "dense")
+        if config.attn_logit_softcapping is not None and attn_backend == "paged":
+            raise ValueError(
+                "attn_backend='paged': the Pallas kernel has no logit "
+                "softcap; use attn_backend='dense' (or 'auto', which "
+                "resolves to dense under softcapping) for Gemma-2")
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -249,6 +264,8 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     p = params["model"]
     x = p["embed_tokens"]["embedding"][batch.tokens]  # [T, E]
+    if cfg.embed_scale is not None:  # Gemma sqrt(hidden) normalizer
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
         x = _norm_tok(x, {"scale": p["embed_layernorm"]["scale"],
                           "bias": p["embed_layernorm"]["bias"]}, cfg)
@@ -335,6 +352,9 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             scale = (cfg.attn_scale if cfg.attn_scale is not None
                      else 1.0 / float(np.sqrt(hd)))
             scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) * jnp.float32(scale)
+            if cfg.attn_logit_softcapping is not None:  # Gemma-2, pre-mask
+                cap = jnp.float32(cfg.attn_logit_softcapping)
+                scores = cap * jnp.tanh(scores / cap)
             from ...models.llama import _layer_window
             window = _layer_window(cfg, l)
             if window is not None:
@@ -382,6 +402,11 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 moe_out = moe_out + jax.nn.sigmoid(g).astype(x.dtype) * shared
             return moe_out
 
+        if cfg.sandwich_norm:  # Gemma-2: pre+post norms on both sublayers
+            x = x + _norm_tok(attn_out, lp["post_attention_layernorm"], cfg)
+            h2 = _norm_tok(x, lp["pre_feedforward_layernorm"], cfg)
+            x = x + _norm_tok(_ffn(h2), lp["post_feedforward_layernorm"], cfg)
+            continue
         if cfg.post_norm:  # OLMo2: x + norm(attn(x)), then x + norm(ffn(x))
             x = x + _norm_tok(attn_out, lp["post_attention_layernorm"], cfg)
             x = x + _norm_tok(_ffn(x), lp["post_feedforward_layernorm"], cfg)
@@ -406,4 +431,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             logits = logits + p["lm_head"]["bias"].astype(jnp.float32)
     if cfg.logit_scale is not None:  # Cohere
         logits = logits * jnp.float32(cfg.logit_scale)
+    if cfg.final_logit_softcapping is not None:  # Gemma-2
+        cap = jnp.float32(cfg.final_logit_softcapping)
+        logits = cap * jnp.tanh(logits / cap)
     return logits, cache

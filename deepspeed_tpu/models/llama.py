@@ -74,6 +74,16 @@ class LlamaConfig:
     # OLMo2: post-norm residual — x + norm(attn(x)), then x + norm(mlp(x));
     # layer norms are post_attention_layernorm / post_feedforward_layernorm
     post_norm: bool = False
+    # Gemma-2: x + post_norm(attn(pre_norm(x))) for BOTH sublayers (norms:
+    # input/post_attention + pre_feedforward/post_feedforward)
+    sandwich_norm: bool = False
+    # Gemma: RMSNorm scales stored as (weight - 1); apply (1 + w) * x_hat
+    norm_plus_one: bool = False
+    # Gemma: embeddings scaled by sqrt(hidden_size) after lookup
+    embed_scale: Optional[float] = None
+    # Gemma-2 softcaps: x -> cap * tanh(x / cap)
+    attn_logit_softcapping: Optional[float] = None
+    final_logit_softcapping: Optional[float] = None
     # "swiglu" | "gelu_fc" (exact erf, Falcon) | "gelu_tanh_fc" (HF
     # "gelu_new", Phi) | "relu_fc" (OPT)
     mlp_type: str = "swiglu"
@@ -114,7 +124,7 @@ class LlamaConfig:
         h, hd = self.hidden_size, self.head_dim_
         attn = h * (self.num_attention_heads * hd) * 2 \
             + h * (self.num_key_value_heads * hd) * 2
-        proj = 3 if self.mlp_type == "swiglu" else 2
+        proj = 3 if self.mlp_type in ("swiglu", "geglu_tanh") else 2
         if self.num_local_experts > 0:
             mlp = proj * h * self.intermediate_size * self.num_local_experts \
                 + h * self.num_local_experts
@@ -186,10 +196,16 @@ def apply_rope(x, cos, sin, positions, rotary_dim: Optional[int] = None,
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    plus_one: bool = False  # Gemma stores scales as (weight - 1)
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param("weight", nn.initializers.ones, (x.shape[-1], ), jnp.float32)
+        scale = self.param("weight",
+                           nn.initializers.zeros if self.plus_one
+                           else nn.initializers.ones,
+                           (x.shape[-1], ), jnp.float32)
+        if self.plus_one:
+            scale = 1.0 + scale
         xf = x.astype(jnp.float32)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
         out = xf * jax.lax.rsqrt(var + self.eps)
@@ -225,7 +241,8 @@ def _make_norm(cfg, name):
     if cfg.norm_type == "layernorm_np":  # OLMo: no learnable params at all
         return nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
                             use_bias=False, use_scale=False, name=name)
-    return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name=name)
+    return RMSNorm(cfg.rms_norm_eps, cfg.dtype, plus_one=cfg.norm_plus_one,
+                   name=name)
 
 
 def _layer_window(cfg, layer_idx: int):
@@ -285,6 +302,7 @@ class LlamaAttention(nn.Module):
         # unsharded dispatch conditions below both build on it
         flash_shape_ok = (cfg.attn_impl != "xla" and attn_mask is None
                           and cfg.pos_embedding != "alibi"
+                          and cfg.attn_logit_softcapping is None
                           and (s <= 128 or s % 128 == 0))
         on_flash_backend = (cfg.attn_impl == "flash"
                             or jax.default_backend() == "tpu")
@@ -320,6 +338,29 @@ class LlamaAttention(nn.Module):
                 bias = slopes[None, :, None, None] * dist
 
             def _core_attn(q, k, v):
+                if cfg.attn_logit_softcapping is not None:
+                    # Gemma-2: scores -> cap*tanh(scores/cap) BEFORE masking;
+                    # tanh is not expressible as an additive bias, so this
+                    # path computes dense attention by hand — grouped over
+                    # KV heads (no materialized GQA repeat)
+                    cap = jnp.float32(cfg.attn_logit_softcapping)
+                    kvh = k.shape[2]
+                    g = q.shape[2] // kvh
+                    scl = (cfg.attn_scale if cfg.attn_scale is not None
+                           else 1.0 / float(np.sqrt(hd)))
+                    qg = q.reshape(b, s, kvh, g, hd).astype(jnp.float32)
+                    scores = jnp.einsum("bqkgd,blkd->bkgql", qg,
+                                        k.astype(jnp.float32)) * jnp.float32(scl)
+                    scores = cap * jnp.tanh(scores / cap)
+                    causal = (positions[:, :, None]
+                              >= positions[:, None, :])[:, None, None]
+                    keep_all = causal if mask is None \
+                        else (causal & mask[:, :, None])
+                    scores = jnp.where(keep_all, scores, -1e30)
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    out = jnp.einsum("bkgql,blkd->bqkgd", probs,
+                                     v.astype(jnp.float32))
+                    return out.reshape(b, s, q.shape[2], hd).astype(q.dtype)
                 return jax.nn.dot_product_attention(q, k, v, bias=bias, mask=mask,
                                                     is_causal=True,
                                                     scale=cfg.attn_scale)
@@ -351,11 +392,14 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        if cfg.mlp_type == "swiglu":
+        if cfg.mlp_type in ("swiglu", "geglu_tanh"):
+            # gated MLP: silu gate (llama) or tanh-gelu gate (gemma)
             gate = _dense(cfg.intermediate_size, "gate_proj", (EMBED, HIDDEN), cfg.dtype)(x)
             up = _dense(cfg.intermediate_size, "up_proj", (EMBED, HIDDEN), cfg.dtype)(x)
+            g = (nn.silu(gate) if cfg.mlp_type == "swiglu"
+                 else nn.gelu(gate, approximate=True))
             return _dense(cfg.hidden_size, "down_proj", (HIDDEN, EMBED),
-                          cfg.dtype)(nn.silu(gate) * up)
+                          cfg.dtype)(g * up)
         # fc1/fc2 form: Falcon uses exact (erf) GELU, Phi HF "gelu_new" is
         # the tanh approximation, OPT is ReLU
         act = {"gelu_fc": lambda y: nn.gelu(y, approximate=False),
@@ -431,6 +475,15 @@ class LlamaDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
+        if cfg.sandwich_norm:
+            # Gemma-2: pre AND post norms around both sublayers
+            attn_out = LlamaAttention(cfg, self.layer_idx, name="self_attn")(
+                _make_norm(cfg, "input_layernorm")(x), cos, sin, positions,
+                attn_mask)
+            h = x + _make_norm(cfg, "post_attention_layernorm")(attn_out)
+            mlp_out = LlamaMLP(cfg, name="mlp")(
+                _make_norm(cfg, "pre_feedforward_layernorm")(h))
+            return h + _make_norm(cfg, "post_feedforward_layernorm")(mlp_out)
         if cfg.post_norm:
             # OLMo2: no input norms — the SUBLAYER OUTPUT is normalized
             attn_out = LlamaAttention(cfg, self.layer_idx, name="self_attn")(
@@ -518,6 +571,9 @@ class LlamaModel(nn.Module):
                                                              (VOCAB, EMBED)),
                          name="embed_tokens")
         x = embed(input_ids)
+        if cfg.embed_scale is not None:  # Gemma: sqrt(hidden) normalizer,
+            # rounded through the compute dtype exactly as HF does
+            x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
         if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
             x = nn.LayerNorm(epsilon=cfg.rms_norm_eps, dtype=cfg.dtype,
                              name="embed_layernorm")(x)
@@ -573,6 +629,9 @@ class LlamaModel(nn.Module):
                             name="lm_head")(x)
         if cfg.logit_scale is not None:  # Cohere
             logits = logits * jnp.float32(cfg.logit_scale)
+        if cfg.final_logit_softcapping is not None:  # Gemma-2
+            cap = jnp.float32(cfg.final_logit_softcapping)
+            logits = cap * jnp.tanh(logits / cap)
         return logits
 
 

@@ -259,14 +259,52 @@ class Qwen2MoePolicy(MixtralPolicy):
         return gate, experts
 
 
-class Gemma2Policy(HFCheckpointPolicy):
-    """Gemma-2: llama-family graph with tied embeddings by default."""
+class GemmaPolicy(HFCheckpointPolicy):
+    """Gemma (v1): llama graph with (1+weight) RMSNorm, sqrt(hidden) embed
+    normalizer (rounded through the compute dtype, as HF does), tanh-gelu
+    gated MLP, explicit head_dim, tied embeddings."""
+    arch = "gemma"
+
+    def config_from_hf(self, hf_config):
+        import dataclasses
+        cfg = super().config_from_hf(hf_config)
+        return dataclasses.replace(
+            cfg, tie_word_embeddings=True, norm_plus_one=True,
+            head_dim=hf_config.get(
+                "head_dim",
+                hf_config["hidden_size"] // hf_config["num_attention_heads"]),
+            embed_scale=float(hf_config["hidden_size"]) ** 0.5,
+            mlp_type="geglu_tanh")
+
+
+class Gemma2Policy(GemmaPolicy):
+    """Gemma-2 adds sandwich norms (pre+post around both sublayers),
+    attention/final logit softcapping, query_pre_attn_scalar-derived scale,
+    and a sliding window on every EVEN layer (HF: ``not bool(layer_idx %
+    2)``)."""
     arch = "gemma2"
 
     def config_from_hf(self, hf_config):
-        cfg = super().config_from_hf(hf_config)
         import dataclasses
-        return dataclasses.replace(cfg, tie_word_embeddings=True)
+        cfg = super().config_from_hf(hf_config)
+        return dataclasses.replace(
+            cfg, sandwich_norm=True,
+            attn_scale=float(hf_config.get("query_pre_attn_scalar", 256)) ** -0.5,
+            attn_logit_softcapping=hf_config.get("attn_logit_softcapping", 50.0),
+            final_logit_softcapping=hf_config.get("final_logit_softcapping", 30.0),
+            sliding_window=hf_config.get("sliding_window"),
+            sliding_window_layers=tuple(
+                range(0, hf_config["num_hidden_layers"], 2)))
+
+    def weight_map(self, layer: int, attention_bias: bool = False):
+        out = super().weight_map(layer, attention_bias)
+        p = f"model.layers.{layer}."
+        f = f"layers_{layer}/"
+        out[p + "pre_feedforward_layernorm.weight"] = \
+            (f + "pre_feedforward_layernorm/weight", False)
+        out[p + "post_feedforward_layernorm.weight"] = \
+            (f + "post_feedforward_layernorm/weight", False)
+        return out
 
 
 class OPTPolicy(HFCheckpointPolicy):
@@ -1255,6 +1293,8 @@ _POLICIES = {
     "qwen2_moe": Qwen2MoePolicy,
     "qwen2moe": Qwen2MoePolicy,
     "Qwen2MoeForCausalLM": Qwen2MoePolicy,
+    "gemma": GemmaPolicy,
+    "GemmaForCausalLM": GemmaPolicy,
     "gemma2": Gemma2Policy,
     "Gemma2ForCausalLM": Gemma2Policy,
     "opt": OPTPolicy,

@@ -865,3 +865,96 @@ def test_olmo2_postnorm_qknorm_logits_match_hf():
     lp = params["model"]["layers_0"]
     assert "q_norm" in lp["self_attn"] and "post_feedforward_layernorm" in lp
     assert "input_layernorm" not in lp
+
+
+def test_gemma_v1_logits_match_hf():
+    """Gemma: (1+w) RMSNorm, sqrt(hidden) embed normalizer, tanh-gelu gated
+    MLP, explicit head_dim, tied embeddings."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64)
+    torch.manual_seed(24)
+    hf_model = transformers.GemmaForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if "norm" in n:
+                p.normal_(0.0, 0.1)  # gemma stores (weight - 1)
+    ours_cfg, _ = _logits_match("gemma", hf_model, cfg.to_dict())
+    assert ours_cfg.norm_plus_one and ours_cfg.mlp_type == "geglu_tanh"
+    assert abs(ours_cfg.embed_scale - 32 ** 0.5) < 1e-9
+
+
+def test_gemma2_logits_match_hf():
+    """Gemma-2 (regression: the policy existed untested and was numerically
+    wrong — sandwich norms dropped, no softcaps, no (1+w)): now exact."""
+    cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, sliding_window=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16)
+    torch.manual_seed(25)
+    hf_model = transformers.Gemma2ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if "norm" in n:
+                p.normal_(0.0, 0.1)
+    ours_cfg, params = _logits_match("gemma2", hf_model, cfg.to_dict(),
+                                     ids=np.array([list(range(1, 25))], np.int32))
+    assert ours_cfg.sandwich_norm and ours_cfg.attn_logit_softcapping == 50.0
+    assert ours_cfg.final_logit_softcapping == 30.0
+    assert ours_cfg.sliding_window_layers == (0, )  # even layers only
+    assert abs(ours_cfg.attn_scale - 16 ** -0.5) < 1e-9
+
+    # and through the paged v2 engine (dense fallback under softcap)
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(dataclasses.replace(ours_cfg, dtype=jnp.float32),
+                             params=params, dtype=jnp.float32, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)
+
+
+def test_gemma2_bf16_serving_keeps_norm_deltas():
+    """Regression: the (1+w) offset must be applied in fp32 — in bf16 the
+    ~1e-2 learned norm deltas round away against 1.0, skewing every layer.
+    bf16 serving logits must stay close to the fp32 HF reference."""
+    cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, query_pre_attn_scalar=16)
+    torch.manual_seed(26)
+    hf_model = transformers.Gemma2ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for n, p in hf_model.named_parameters():
+            if "norm" in n:
+                p.normal_(0.0, 0.01)  # small deltas: the bf16 rounding trap
+    ours_cfg, params = convert_hf_checkpoint("gemma2", hf_model.state_dict(),
+                                             cfg.to_dict())
+    from deepspeed_tpu.inference.v2 import build_llama_engine, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    eng = build_llama_engine(dataclasses.replace(ours_cfg, dtype=jnp.bfloat16),
+                             params=params, dtype=jnp.bfloat16, kv_block_size=16,
+                             engine_config=RaggedInferenceEngineConfig(
+                                 state_manager=DSStateManagerConfig(max_context=64),
+                                 num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]), np.float32)[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    assert int(np.argmax(logits)) == int(np.argmax(ref))
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(logits - ref).max() / denom < 0.08
