@@ -302,7 +302,6 @@ class LlamaAttention(nn.Module):
         # unsharded dispatch conditions below both build on it
         flash_shape_ok = (cfg.attn_impl != "xla" and attn_mask is None
                           and cfg.pos_embedding != "alibi"
-                          and cfg.attn_logit_softcapping is None
                           and (s <= 128 or s % 128 == 0))
         on_flash_backend = (cfg.attn_impl == "flash"
                             or jax.default_backend() == "tpu")
@@ -316,6 +315,7 @@ class LlamaAttention(nn.Module):
             # natively, skipping out-of-window blocks
             attn = flash_attention(q, k, v, causal=True, scale=cfg.attn_scale,
                                    window=window,
+                                   softcap=cfg.attn_logit_softcapping,
                                    interpret=jax.default_backend() != "tpu")
         else:
             mask = None
@@ -373,6 +373,7 @@ class LlamaAttention(nn.Module):
                 from ..sequence.layer import ulysses_flash
                 attn = ulysses_flash(
                     q, k, v, window=window, scale=cfg.attn_scale,
+                    softcap=cfg.attn_logit_softcapping,
                     interpret=jax.default_backend() != "tpu")
             if attn is None and sp_sz > 1:
                 # GSPMD Ulysses: sharding constraints make XLA emit the

@@ -42,13 +42,15 @@ NEG_INF = -1e30
 LSE_MASKED = 1e30  # rows that saw no key: exp(s - LSE_MASKED) == 0
 
 
-def _xla_attention(q, k, v, scale, causal, window=None):
+def _xla_attention(q, k, v, scale, causal, window=None, softcap=None):
     """Reference implementation; q [B, S, H, D], k/v [B, S, KV, D] (GQA ok)."""
     B, Sq, H, D = q.shape
     KV = k.shape[2]
     G = H // KV
     qg = q.reshape(B, Sq, KV, G, D)
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32) * scale
+    if softcap is not None:  # Gemma-2: cap BEFORE masking
+        s = softcap * jnp.tanh(s / softcap)
     if causal or window is not None:
         n, m = q.shape[1], k.shape[1]
         mask = jnp.ones((n, m), bool)
@@ -76,7 +78,8 @@ def _row_pos(shape, block_q, offset):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
-                *, scale, causal, block_q, block_k, num_kv, window=None):
+                *, scale, causal, block_q, block_k, num_kv, window=None,
+                softcap=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -93,6 +96,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if softcap is not None:  # Gemma-2: cap BEFORE masking
+            s = softcap * jnp.tanh(s / softcap)
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -148,7 +153,8 @@ def _regroup(q, k, v):
     return qg, kt, vt
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
+               softcap=None):
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
@@ -162,7 +168,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None)
     qg, kt, vt = _regroup(q, k, v)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, num_kv=num_kv,
-                               window=window)
+                               window=window, softcap=softcap)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * KV, num_q, num_kv),
@@ -197,7 +203,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, scale, causal, block_q, block_k, num_kv, window=None):
+               *, scale, causal, block_q, block_k, num_kv, window=None,
+               softcap=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -216,6 +223,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -228,6 +238,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
+        if softcap is not None:  # chain through d/ds cap*tanh(s/cap) = 1 - t^2
+            ds = ds * (1.0 - t * t)
         dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -251,7 +263,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_acc, dv_acc,
-                 *, scale, causal, block_q, block_k, num_q, window=None):
+                 *, scale, causal, block_q, block_k, num_q, window=None,
+                 softcap=None):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -271,6 +284,9 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -287,6 +303,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
+        if softcap is not None:
+            ds = ds * (1.0 - t * t)
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -309,7 +327,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=None):
+def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=None,
+               softcap=None):
     q, k, v, o, lse = res
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
@@ -330,7 +349,7 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_kv=num_kv,
-                          window=window),
+                          window=window, softcap=softcap),
         grid=(B * KV, num_q, num_kv),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
@@ -346,7 +365,7 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,
-                          window=window),
+                          window=window, softcap=softcap),
         grid=(B * KV, num_kv, num_q),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=[
@@ -376,19 +395,25 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,
+                     window=None, softcap=None):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      window, softcap)
     return o
 
 
-def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret, window=None):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window)
+def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
+              softcap=None):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        window, softcap)
     return o, (q, k, v, o, lse)
 
 
-def _bwd_rule(scale, causal, block_q, block_k, interpret, window, res, g):
-    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret, window)
+def _bwd_rule(scale, causal, block_q, block_k, interpret, window, softcap,
+              res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
+                      window, softcap)
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -416,6 +441,7 @@ def flash_attention(q,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     window: Optional[int] = None,
+                    softcap: Optional[float] = None,
                     force_pallas: Optional[bool] = None,
                     interpret: bool = False):
     """Blocked attention; q [B, S, H, D], k/v [B, S, KV, D] (GQA native).
@@ -431,8 +457,8 @@ def flash_attention(q,
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if use_pallas(force_pallas) or interpret:
         return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,
-                                window)
-    return _xla_attention(q, k, v, scale, causal, window)
+                                window, softcap)
+    return _xla_attention(q, k, v, scale, causal, window, softcap)
 
 
 registry.register("flash_attention", "pallas" if _HAS_PLTPU else "xla", True)

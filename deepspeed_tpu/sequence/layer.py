@@ -124,6 +124,7 @@ def ulysses_spmd(local_attention: Callable,
 
 def ulysses_flash(q, k, v, *, window: Optional[int] = None,
                   scale: Optional[float] = None,
+                  softcap: Optional[float] = None,
                   sequence_axis: str = "seq", model_axis: str = "model",
                   mesh_ctx=None, interpret: bool = False):
     """Ulysses/TP with the Pallas flash kernel per device (module doc §3).
@@ -162,7 +163,8 @@ def ulysses_flash(q, k, v, *, window: Optional[int] = None,
             k_l = seq_all_to_all(k_l, sequence_axis, 2, 1)
             v_l = seq_all_to_all(v_l, sequence_axis, 2, 1)
         out = flash_attention(q_l, k_l, v_l, causal=True, scale=scale,
-                              window=window, interpret=interpret)
+                              window=window, softcap=softcap,
+                              interpret=interpret)
         if sp > 1:
             out = seq_all_to_all(out, sequence_axis, 1, 2)  # [b,S/sp,h/mp,d]
         return out

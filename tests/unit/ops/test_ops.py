@@ -281,3 +281,36 @@ def test_flash_attention_sliding_window(window):
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_flash_attention_softcap_values_and_grads(window):
+    """Gemma-2 logit softcapping inside the kernel: cap*tanh(s/cap) BEFORE
+    masking, gradient chained through (1 - tanh^2) — values and all three
+    gradients must match the XLA oracle, incl. combined with local windows
+    and GQA."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    B, S, H, KV, D = 2, 64, 4, 2, 32
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+    cap = 5.0  # small cap so the tanh region is genuinely exercised
+
+    out = flash_attention(q, k, v, causal=True, softcap=cap, window=window,
+                          block_q=16, block_k=16, interpret=True)
+    ref = _xla_attention(q, k, v, 1.0 / np.sqrt(D), True, window, cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss_k(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, softcap=cap,
+                                       window=window, block_q=16, block_k=16,
+                                       interpret=True) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, 1.0 / np.sqrt(D), True,
+                                      window, cap) ** 2)
+
+    g_k = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_k, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
