@@ -120,14 +120,7 @@ class RaggedLlamaModel:
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
         # not a serving path)
         if attn_backend == "auto":
-            attn_backend = ("paged" if jax.default_backend() == "tpu"
-                            and config.attn_logit_softcapping is None
-                            else "dense")
-        if config.attn_logit_softcapping is not None and attn_backend == "paged":
-            raise ValueError(
-                "attn_backend='paged': the Pallas kernel has no logit "
-                "softcap; use attn_backend='dense' (or 'auto', which "
-                "resolves to dense under softcapping) for Gemma-2")
+            attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -342,6 +335,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 window=_layer_window(cfg, l),
                 attn_scale=cfg.attn_scale,
                 use_alibi=cfg.pos_embedding == "alibi",
+                softcap=cfg.attn_logit_softcapping,
                 interpret=jax.default_backend() != "tpu")
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:

@@ -49,7 +49,8 @@ NEG_INF = -1e30
 def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
                        q_ref, kv_ref, *rest,
                        page_size: int, groups: int, scale: float,
-                       window: Optional[int], has_alibi: bool):
+                       window: Optional[int], has_alibi: bool,
+                       softcap: Optional[float] = None):
     if has_alibi:
         slopes_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -86,6 +87,8 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         scores = jax.lax.dot_general(
             q, k, (((1, ), (1, )), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [NG, page]
+        if softcap is not None:  # Gemma-2: cap BEFORE masks/bias
+            scores = softcap * jnp.tanh(scores / softcap)
 
         # causal + length mask in absolute positions: page b covers
         # [b*page, (b+1)*page); query row r belongs to new-token n = r // G
@@ -128,12 +131,14 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret", "window",
-                                             "attn_scale", "use_alibi"))
+                                             "attn_scale", "use_alibi",
+                                             "softcap"))
 def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
                     *, page_size: int, interpret: bool = False,
                     window: Optional[int] = None,
                     attn_scale: Optional[float] = None,
-                    use_alibi: bool = False):
+                    use_alibi: bool = False,
+                    softcap: Optional[float] = None):
     """Blocked-flash attention over a paged KV cache.
 
     Args:
@@ -192,6 +197,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
 
     kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
                                groups=G, scale=scale, window=window,
+                               softcap=softcap,
                                has_alibi=use_alibi)
     return pl.pallas_call(
         kernel,
@@ -205,7 +211,8 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
 def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
                               *, page_size: int, window: Optional[int] = None,
                               attn_scale: Optional[float] = None,
-                              use_alibi: bool = False):
+                              use_alibi: bool = False,
+                              softcap: Optional[float] = None):
     """Dense-gather XLA reference (the round-1 path) for numerics tests."""
     S, N, KV, G, D = q.shape
     B = block_table.shape[1]
@@ -218,6 +225,8 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     v_h = jnp.moveaxis(hist[1], 1, 0).astype(jnp.float32)
     qf = q.astype(jnp.float32)
     scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
     q_abs = seq_seen[:, None] + jnp.arange(N, dtype=jnp.int32)[None, :]
     mask = (key_pos <= q_abs[:, :, None]) & (key_pos < seq_lens[:, None, None])

@@ -958,3 +958,36 @@ def test_gemma2_bf16_serving_keeps_norm_deltas():
     assert int(np.argmax(logits)) == int(np.argmax(ref))
     denom = max(np.abs(ref).max(), 1e-6)
     assert np.abs(logits - ref).max() / denom < 0.08
+
+
+def test_gemma2_paged_backend_matches_hf():
+    """Gemma-2 through the PAGED kernel (softcap now in-kernel): prefill and
+    decode logits match transformers."""
+    cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, sliding_window=16,
+        query_pre_attn_scalar=16)
+    torch.manual_seed(27)
+    hf_model = transformers.Gemma2ForCausalLM(cfg).eval()
+    ours_cfg, params = convert_hf_checkpoint("gemma2", hf_model.state_dict(),
+                                             cfg.to_dict())
+    from deepspeed_tpu.inference.v2.model import RaggedLlamaModel
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    model = RaggedLlamaModel(dataclasses.replace(ours_cfg, dtype=jnp.float32),
+                             params, dtype=jnp.float32, kv_block_size=16,
+                             attn_backend="paged")
+    eng = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(max_context=64), num_kv_blocks=16))
+    prompt = [1, 5, 9, 42, 17]
+    logits = np.asarray(eng.put([0], [prompt]))[0]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt], dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits, ref, rtol=2e-3, atol=2e-3)
+    nxt = int(np.argmax(logits))
+    logits2 = np.asarray(eng.put([0], [[nxt]]))[0]
+    with torch.no_grad():
+        ref2 = hf_model(torch.tensor([prompt + [nxt]],
+                                     dtype=torch.long)).logits.numpy()[0, -1]
+    np.testing.assert_allclose(logits2, ref2, rtol=2e-3, atol=2e-3)

@@ -142,3 +142,23 @@ def test_alibi_and_scale_match_reference():
     out_noalibi = paged_attention_reference(q, cache, 0, bt, seen, lens,
                                             page_size=ps, attn_scale=1.0)
     assert not np.allclose(np.asarray(out_r), np.asarray(out_noalibi))
+
+
+def test_softcap_matches_reference():
+    """Gemma-2 logit softcap in-kernel: cap*tanh(s/cap) before masks, both
+    alone and combined with a sliding window."""
+    rng = np.random.default_rng(7)
+    S, N, KV, G, D, ps, n_pages, B = 2, 2, 2, 2, 32, 8, 16, 3
+    q, cache, bt, seen, lens = _setup(rng, S, N, KV, G, D, ps, n_pages, B,
+                                      seen=[18, 4], n_new=[2, 2])
+    for window in (None, 12):
+        out_k = paged_attention(q, cache, 0, bt, seen, lens, page_size=ps,
+                                softcap=5.0, window=window, interpret=INTERP)
+        out_r = paged_attention_reference(q, cache, 0, bt, seen, lens,
+                                          page_size=ps, softcap=5.0,
+                                          window=window)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                                   atol=2e-5)
+    # the cap must actually bite (differs from uncapped)
+    out_u = paged_attention_reference(q, cache, 0, bt, seen, lens, page_size=ps)
+    assert not np.allclose(np.asarray(out_r), np.asarray(out_u))
