@@ -347,8 +347,9 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                      else 1.0 / float(np.sqrt(hd)))
             scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) * jnp.float32(scale)
             if cfg.attn_logit_softcapping is not None:  # Gemma-2, pre-mask
-                cap = jnp.float32(cfg.attn_logit_softcapping)
-                scores = cap * jnp.tanh(scores / cap)
+                from ...ops.attention import softcap_scores
+                scores = softcap_scores(scores,
+                                        jnp.float32(cfg.attn_logit_softcapping))
             from ...models.llama import _layer_window
             window = _layer_window(cfg, l)
             if window is not None:

@@ -349,9 +349,10 @@ class LlamaAttention(nn.Module):
                     scl = (cfg.attn_scale if cfg.attn_scale is not None
                            else 1.0 / float(np.sqrt(hd)))
                     qg = q.reshape(b, s, kvh, g, hd).astype(jnp.float32)
+                    from ..ops.attention import softcap_scores
                     scores = jnp.einsum("bqkgd,blkd->bkgql", qg,
                                         k.astype(jnp.float32)) * jnp.float32(scl)
-                    scores = cap * jnp.tanh(scores / cap)
+                    scores = softcap_scores(scores, cap)
                     causal = (positions[:, :, None]
                               >= positions[:, None, :])[:, None, None]
                     keep_all = causal if mask is None \

@@ -42,6 +42,14 @@ NEG_INF = -1e30
 LSE_MASKED = 1e30  # rows that saw no key: exp(s - LSE_MASKED) == 0
 
 
+def softcap_scores(s, cap):
+    """Gemma-2 logit softcapping: cap * tanh(s / cap), applied AFTER the
+    scale and BEFORE any mask/bias — the single definition every attention
+    path (flash fwd/bwd kernels, paged kernel, XLA fallbacks, model dense
+    branches) shares so kernel and reference numerics cannot drift."""
+    return cap * jnp.tanh(s / cap)
+
+
 def _xla_attention(q, k, v, scale, causal, window=None, softcap=None):
     """Reference implementation; q [B, S, H, D], k/v [B, S, KV, D] (GQA ok)."""
     B, Sq, H, D = q.shape
@@ -97,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:  # Gemma-2: cap BEFORE masking
-            s = softcap * jnp.tanh(s / softcap)
+            s = softcap_scores(s, softcap)
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -225,7 +233,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:
             t = jnp.tanh(s / softcap)
-            s = softcap * t
+            s = softcap * t  # == softcap_scores; t reused for d/ds = 1 - t^2
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -286,7 +294,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:
             t = jnp.tanh(s / softcap)
-            s = softcap * t
+            s = softcap * t  # == softcap_scores; t reused for d/ds = 1 - t^2
         if causal or window is not None:
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)

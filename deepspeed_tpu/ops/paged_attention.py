@@ -88,7 +88,8 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
             q, k, (((1, ), (1, )), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [NG, page]
         if softcap is not None:  # Gemma-2: cap BEFORE masks/bias
-            scores = softcap * jnp.tanh(scores / softcap)
+            from .attention import softcap_scores
+            scores = softcap_scores(scores, softcap)
 
         # causal + length mask in absolute positions: page b covers
         # [b*page, (b+1)*page); query row r belongs to new-token n = r // G
@@ -226,7 +227,8 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     qf = q.astype(jnp.float32)
     scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) * scale
     if softcap is not None:
-        scores = softcap * jnp.tanh(scores / softcap)
+        from .attention import softcap_scores
+        scores = softcap_scores(scores, softcap)
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
     q_abs = seq_seen[:, None] + jnp.arange(N, dtype=jnp.int32)[None, :]
     mask = (key_pos <= q_abs[:, :, None]) & (key_pos < seq_lens[:, None, None])
