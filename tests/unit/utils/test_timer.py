@@ -1,0 +1,129 @@
+"""Timer/throughput accounting tests (reference ``tests/unit/utils`` +
+``utils/timer.py:44/199``): wall-clock timers, throughput math, and the
+engine's ``wall_clock_breakdown`` wiring."""
+
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, NoopTimer,
+                                       ThroughputTimer)
+
+
+class TestSynchronizedWallClockTimer:
+
+    def test_elapsed_measures_wall_time(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("unit")
+        t.start()
+        time.sleep(0.05)
+        t.stop()
+        sec = t.elapsed(reset=False)
+        assert 0.04 <= sec <= 0.5, sec  # seconds (log() scales for display)
+
+    def test_accumulates_and_resets(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("acc")
+        for _ in range(3):
+            t.start()
+            time.sleep(0.01)
+            t.stop()
+        total = t.elapsed(reset=True)
+        assert total >= 0.025
+        assert t.elapsed(reset=False) == 0.0  # reset cleared it
+
+    def test_mean_over_records(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("m")
+        for _ in range(2):
+            t.start()
+            time.sleep(0.01)
+            t.stop(record=True)
+        assert t.mean() > 0
+
+    def test_log_and_get_mean(self, caplog):
+        timers = SynchronizedWallClockTimer()
+        for name in ("fwd", "bwd"):
+            t = timers(name)
+            t.start()
+            time.sleep(0.005)
+            t.stop(record=True)  # get_mean averages RECORDED laps
+        means = timers.get_mean(["fwd", "bwd"], reset=False)
+        assert set(means) == {"fwd", "bwd"} and all(v > 0 for v in means.values())
+        timers.log(["fwd", "bwd"])  # must not raise
+
+    def test_double_start_raises(self):
+        t = SynchronizedWallClockTimer()("x")
+        t.start()
+        with pytest.raises(AssertionError):
+            t.start()
+
+
+def test_noop_timer_is_inert():
+    timers = NoopTimer()
+    t = timers("anything")
+    t.start()
+    t.stop()
+    assert t.elapsed() == 0.0 and t.mean() == 0.0
+    timers.log(["anything"])
+    assert timers.get_mean(["anything"]) is None or True  # no raise
+
+
+class TestThroughputTimer:
+
+    def test_avg_samples_per_sec(self):
+        tt = ThroughputTimer(config=None, batch_size=32, start_step=1)
+        for _ in range(4):
+            tt.start()
+            time.sleep(0.01)
+            tt.stop(global_step=True)
+        sps = tt.avg_samples_per_sec()
+        # 32 samples / >=10ms steps: sane band (generous for CI jitter)
+        assert 50 < sps < 32 / 0.01 * 2, sps
+
+    def test_warmup_steps_excluded(self):
+        tt = ThroughputTimer(config=None, batch_size=8, start_step=2)
+        tt.start()
+        time.sleep(0.05)  # a slow "compile" step that must NOT count
+        tt.stop(global_step=True)
+        assert tt.total_elapsed_time == 0.0
+        assert tt.avg_samples_per_sec() == float("-inf")
+
+    def test_periodic_report(self):
+        lines = []
+        tt = ThroughputTimer(config=None, batch_size=4, start_step=0,
+                             steps_per_output=2, logging_fn=lines.append)
+        for _ in range(4):
+            tt.start()
+            tt.stop(global_step=True)
+        assert len(lines) == 2 and "SamplesPerSec" in lines[0]
+
+
+@pytest.mark.world_size(8)
+def test_engine_wall_clock_breakdown():
+    """wall_clock_breakdown=true engages real timers in the engine and
+    produces positive per-phase elapsed times."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from simple_model import simple_model_and_params
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+
+    reset_mesh_context()
+    model, params = simple_model_and_params(seed=0)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "wall_clock_breakdown": True, "steps_per_print": 1000})
+    assert isinstance(eng.timers, SynchronizedWallClockTimer)
+    x = jnp.ones((8, 16))
+    loss = eng.forward(x, jnp.zeros_like(x))
+    eng.backward(loss)
+    eng.step()
+    names = list(eng.timers.get_timers())
+    assert names, "no timers recorded under wall_clock_breakdown"
+    means = eng.timers.get_mean(names, reset=False)
+    assert any(v >= 0 for v in means.values())
