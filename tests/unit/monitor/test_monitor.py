@@ -65,3 +65,38 @@ def test_master_fans_out(tmp_path):
     assert master.enabled
     master.write_events([("a/b", 2.0, 3)])
     assert os.path.exists(tmp_path / "fan" / "a_b.csv")
+
+
+def test_tensorboard_monitor_writes_or_degrades(tmp_path):
+    """TB writer: if torch's SummaryWriter is importable, event files land
+    under output_path/job_name; otherwise the monitor disables itself
+    gracefully (reference monitor.py TensorBoardMonitor)."""
+    from deepspeed_tpu.monitor.monitor import TensorBoardMonitor
+    from deepspeed_tpu.config.feature_configs import TensorBoardConfig
+    cfg = TensorBoardConfig(enabled=True, output_path=str(tmp_path),
+                            job_name="tbjob")
+    mon = TensorBoardMonitor(cfg)
+    mon.write_events([("loss", 1.5, 1), ("lr", 1e-3, 1)])
+    if mon.enabled:
+        files = list((tmp_path / "tbjob").glob("events.out.tfevents*"))
+        assert files, "enabled TB monitor wrote no event files"
+    else:
+        assert mon.summary_writer is None  # degraded, no crash
+
+
+def test_wandb_monitor_degrades_without_login(monkeypatch):
+    """wandb init failures (no login/network) must disable, not crash."""
+    import builtins
+    real_import = builtins.__import__
+
+    def deny(name, *a, **k):
+        if name == "wandb":
+            raise ImportError("no wandb here")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", deny)
+    from deepspeed_tpu.monitor.monitor import WandbMonitor
+    from deepspeed_tpu.config.feature_configs import WandbConfig
+    mon = WandbMonitor(WandbConfig(enabled=True))
+    assert not mon.enabled
+    mon.write_events([("loss", 1.0, 0)])  # inert

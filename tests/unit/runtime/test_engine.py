@@ -272,3 +272,36 @@ def test_gather_16bit_weights_on_model_save(tmp_path):
     live = jax.tree_util.tree_leaves(engine.params)
     n_live = sum(1 for _ in live)
     assert len([k for k in arc.files if k != "__dtype__"]) == n_live
+
+
+@pytest.mark.world_size(8)
+def test_load_module_only_keeps_fresh_optimizer(tmp_path):
+    """load_checkpoint(load_module_only=True): weights restore, optimizer
+    state does NOT (the fine-tune-from-pretrained path — reference
+    engine.py load_module_only)."""
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    e1, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                           config=base_config())
+    train_steps(e1, n=3, seed=1)
+    e1.save_checkpoint(str(tmp_path), tag="pre")
+    saved_params = jax.tree_util.tree_map(np.asarray, e1.params)
+
+    reset_mesh_context()
+    model2, params2 = simple_model_and_params(seed=9)
+    e2, _, _, _ = deepspeed_tpu.initialize(model=model2, model_parameters=params2,
+                                           config=base_config())
+    train_steps(e2, n=1, seed=2)
+    opt_before = jax.tree_util.tree_map(np.asarray, e2.opt_state)
+    e2.load_checkpoint(str(tmp_path), tag="pre", load_module_only=True)
+    # params == checkpoint
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        e2.params, saved_params)
+    # optimizer state untouched (NOT the checkpoint's)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        e2.opt_state, opt_before)
+    # and training continues from the loaded weights without error
+    train_steps(e2, n=1, seed=3)
