@@ -1008,6 +1008,28 @@ class DeepSpeedTpuEngine:
     def gradient_accumulation_steps(self):
         return self._config.gradient_accumulation_steps
 
+    def set_train_batch_size(self, train_batch_size):
+        """Adjust the GLOBAL batch by changing gradient-accumulation steps;
+        the micro batch is untouched (reference engine.py:455). The gas>1
+        fused program retraces automatically on the new stacked shape."""
+        denom = self.train_micro_batch_size_per_gpu() * self.dp_world_size
+        if train_batch_size % denom != 0:
+            raise ValueError(
+                f"train_batch_size={train_batch_size} must be divisible by "
+                f"micro_batch*dp={denom}")
+        self._config.train_batch_size = train_batch_size
+        self._config.gradient_accumulation_steps = train_batch_size // denom
+
+    def set_train_micro_batch_size(self, micro_batch_size):
+        """Adjust the micro batch, keeping gradient-accumulation steps
+        (reference engine.py:473); the global batch follows."""
+        if micro_batch_size <= 0:
+            raise ValueError(f"micro_batch_size must be positive, got "
+                             f"{micro_batch_size}")
+        gas = self.gradient_accumulation_steps()
+        self._config.train_micro_batch_size_per_gpu = micro_batch_size
+        self._config.train_batch_size = micro_batch_size * gas * self.dp_world_size
+
     def get_lr(self):
         sched = self.lr_scheduler
         if sched is not None and hasattr(sched, "get_last_lr"):

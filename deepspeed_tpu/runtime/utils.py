@@ -1,0 +1,39 @@
+"""Runtime utilities (reference ``deepspeed/runtime/utils.py`` — the pieces
+with a TPU seam; grad-norm/flatten helpers live in the engine/jnp natively).
+"""
+
+import resource
+
+import jax
+
+from ..utils.logging import logger
+
+
+def see_memory_usage(message: str, force: bool = False, ranks=(0, )) -> dict:
+    """Log device + host memory (reference ``runtime/utils.py
+    see_memory_usage``: torch.cuda allocated/reserved + psutil RSS). TPU:
+    PJRT per-device stats (bytes_in_use / peak_bytes_in_use) + getrusage
+    RSS. Returns the numbers so callers can assert on them."""
+    stats = {}
+    try:
+        dev = jax.devices()[0]
+        ms = dev.memory_stats() or {}
+        stats["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+        stats["device_peak_bytes_in_use"] = int(ms.get("peak_bytes_in_use", 0))
+        stats["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
+    except Exception:  # backends without memory_stats (some CPU builds)
+        stats["device_bytes_in_use"] = 0
+        stats["device_peak_bytes_in_use"] = 0
+        stats["device_bytes_limit"] = 0
+    # ru_maxrss is KiB on Linux
+    stats["host_max_rss_bytes"] = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss * 1024
+    if force or jax.process_index() in ranks:
+        gb = 1024 ** 3
+        logger.info(
+            f"{message} | device in-use "
+            f"{stats['device_bytes_in_use'] / gb:.2f} GB "
+            f"(peak {stats['device_peak_bytes_in_use'] / gb:.2f} GB, "
+            f"limit {stats['device_bytes_limit'] / gb:.2f} GB) | "
+            f"host max-RSS {stats['host_max_rss_bytes'] / gb:.2f} GB")
+    return stats

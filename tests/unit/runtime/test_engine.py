@@ -305,3 +305,35 @@ def test_load_module_only_keeps_fresh_optimizer(tmp_path):
         e2.opt_state, opt_before)
     # and training continues from the loaded weights without error
     train_steps(e2, n=1, seed=3)
+
+
+@pytest.mark.world_size(8)
+def test_set_train_batch_size_adjusts_gas():
+    """Dynamic global-batch adjustment via gradient accumulation
+    (reference engine.py:455): gas follows, micro batch fixed, training
+    continues through the new fused shape."""
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    cfg = base_config(train_batch_size=16, gradient_accumulation_steps=2)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                            config=cfg)
+    assert eng.gradient_accumulation_steps() == 2
+    eng.set_train_batch_size(32)  # micro 1 x dp 8 -> gas 4
+    assert eng.train_batch_size() == 32
+    assert eng.gradient_accumulation_steps() == 4
+    assert eng.train_micro_batch_size_per_gpu() == 1
+    loader = iter(random_dataloader(16, total_samples=64, batch_size=8))
+    loss = eng.train_batch(loader)  # pulls 4 micro batches now
+    assert np.isfinite(loss) and eng.global_steps == 1
+    with pytest.raises(ValueError, match="divisible"):
+        eng.set_train_batch_size(17)
+    eng.set_train_micro_batch_size(2)
+    assert eng.train_batch_size() == 2 * 4 * 8
+
+
+def test_see_memory_usage_reports():
+    from deepspeed_tpu.runtime.utils import see_memory_usage
+    stats = see_memory_usage("unit-test", force=True)
+    assert stats["host_max_rss_bytes"] > 1 << 20  # this process uses >1MiB
+    assert set(stats) >= {"device_bytes_in_use", "device_peak_bytes_in_use"}
