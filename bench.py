@@ -169,6 +169,12 @@ def breakdown(batch=8, seq=1024, iters=10):
         return (time.time() - t0) / n, out
 
     report = {}
+    # dispatch sanity: every previous chip bench silently ran the XLA
+    # fallbacks because the axon platform string is not "tpu" — make the
+    # fast-path decision visible in the artifact so it can never hide again
+    from deepspeed_tpu.ops.registry import on_tpu, use_pallas
+    report["on_tpu"] = bool(on_tpu())
+    report["use_pallas"] = bool(use_pallas())
     t_step, _ = timeit(lambda: engine.fused_train_step(ids, labels=ids))
     report["fused_step_ms"] = round(t_step * 1e3, 2)
 

@@ -32,6 +32,7 @@ from ...ops.paged_attention import paged_attention
 from ...ops.grouped_matmul import moe_grouped_mlp
 from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
+from ...ops.registry import on_tpu
 
 
 def _kernel(d):
@@ -120,7 +121,7 @@ class RaggedLlamaModel:
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
         # not a serving path)
         if attn_backend == "auto":
-            attn_backend = "paged" if jax.default_backend() == "tpu" else "dense"
+            attn_backend = "paged" if on_tpu() else "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
         self.attn_backend = attn_backend
         self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -336,7 +337,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 attn_scale=cfg.attn_scale,
                 use_alibi=cfg.pos_embedding == "alibi",
                 softcap=cfg.attn_logit_softcapping,
-                interpret=jax.default_backend() != "tpu")
+                interpret=not on_tpu())
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
             hist = cache[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]

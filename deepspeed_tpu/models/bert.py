@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from .llama import EMBED, HEADS, HIDDEN, VOCAB, _dense
+from ..ops.registry import on_tpu
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,7 +72,7 @@ class BertSelfAttention(nn.Module):
         unsharded = (not mesh_is_initialized()
                      or (get_mesh_context().axis_size("seq") == 1
                          and get_mesh_context().axis_size("model") == 1))
-        if (mask is None and unsharded and jax.default_backend() == "tpu"
+        if (mask is None and unsharded and on_tpu()
                 and (s <= 128 or s % 128 == 0)):
             from ..ops.attention import flash_attention
             attn = flash_attention(q, k, v, causal=False)

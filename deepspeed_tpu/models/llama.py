@@ -21,6 +21,7 @@ import numpy as np
 import jax.numpy as jnp
 import flax.linen as nn
 from flax.linen import partitioning as nn_partitioning
+from ..ops.registry import on_tpu
 
 # logical axis names; mapped onto mesh axes by parallel/tp.py rules
 EMBED = "embed"
@@ -303,8 +304,7 @@ class LlamaAttention(nn.Module):
         flash_shape_ok = (cfg.attn_impl != "xla" and attn_mask is None
                           and cfg.pos_embedding != "alibi"
                           and (s <= 128 or s % 128 == 0))
-        on_flash_backend = (cfg.attn_impl == "flash"
-                            or jax.default_backend() == "tpu")
+        on_flash_backend = cfg.attn_impl == "flash" or on_tpu()
         # a raw pallas_call doesn't auto-partition under GSPMD: with a
         # nontrivial seq/model mesh the sharded dispatch below owns the
         # kernel path
@@ -316,7 +316,7 @@ class LlamaAttention(nn.Module):
             attn = flash_attention(q, k, v, causal=True, scale=cfg.attn_scale,
                                    window=window,
                                    softcap=cfg.attn_logit_softcapping,
-                                   interpret=jax.default_backend() != "tpu")
+                                   interpret=not on_tpu())
         else:
             mask = None
             if attn_mask is not None:
@@ -375,7 +375,7 @@ class LlamaAttention(nn.Module):
                 attn = ulysses_flash(
                     q, k, v, window=window, scale=cfg.attn_scale,
                     softcap=cfg.attn_logit_softcapping,
-                    interpret=jax.default_backend() != "tpu")
+                    interpret=not on_tpu())
             if attn is None and sp_sz > 1:
                 # GSPMD Ulysses: sharding constraints make XLA emit the
                 # all-to-all pair around full-sequence attention

@@ -113,18 +113,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                 s = jnp.where(k_pos > q_pos, NEG_INF, s)
             if window is not None:  # local attention: drop keys out of window
                 s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
-        m_prev, l_prev = m_s[:, 0], l_s[:, 0]
-        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        # Everything row-wise stays 2D [G*BQ, 1]: Mosaic cannot shape-cast a
+        # lane-dim vector into a sublane column ((1,G,BQ)->(G*BQ,1) is an
+        # "unsupported shape cast"), so no 1D intermediates are ever formed.
+        m_prev, l_prev = m_s[:], l_s[:]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         m_safe = jnp.where(m_cur <= NEG_INF, 0.0, m_cur)
-        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.exp(s - m_safe)
         p = jnp.where(s <= NEG_INF, 0.0, p)
         corr = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - m_safe))
-        l_cur = l_prev * corr + p.sum(axis=-1)
+        l_cur = l_prev * corr + p.sum(axis=-1, keepdims=True)
         pv = jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc[:] = acc[:] * corr[:, None] + pv
-        m_s[:, 0] = m_cur
-        l_s[:, 0] = l_cur
+        acc[:] = acc[:] * corr + pv
+        m_s[:] = m_cur
+        l_s[:] = l_cur
 
     cond = True
     if causal:
@@ -141,12 +144,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     @pl.when(ki == num_kv - 1)
     def _finalize():
         g, bq = o_ref.shape[1], o_ref.shape[2]
-        l = l_s[:, 0]
+        l = l_s[:]  # [G*BQ, 1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc[:] / safe_l[:, None]).reshape(g, bq, -1).astype(o_ref.dtype)
-        m_safe = jnp.where(m_s[:, 0] <= NEG_INF, 0.0, m_s[:, 0])
+        o_ref[0] = (acc[:] / safe_l).reshape(g, bq, -1).astype(o_ref.dtype)
+        m_safe = jnp.where(m_s[:] <= NEG_INF, 0.0, m_s[:])
         lse = jnp.where(l == 0.0, LSE_MASKED, m_safe + jnp.log(safe_l))
-        lse_ref[0] = lse.reshape(g, bq)
+        lse_ref[0] = lse.reshape(g, bq, 1)
 
 
 def _regroup(q, k, v):
@@ -187,11 +190,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
         ],
         out_specs=[
             pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0)),
-            pl.BlockSpec((1, G, block_q), lambda b, i, j: (b, 0, i)),
+            # trailing unit lane dim: every reshape of the LSE then keeps the
+            # minormost dim intact (a supported Mosaic shape cast), unlike
+            # (1,G,BQ)->(G*BQ,1) which fails to lower for G > 1
+            pl.BlockSpec((1, G, block_q, 1), lambda b, i, j: (b, 0, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * KV, G, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * KV, G, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B * KV, G, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((G * block_q, D), jnp.float32),
@@ -226,8 +232,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
-        lse = lse_ref[0].reshape(g * bq)
-        delta = delta_ref[0].reshape(g * bq)
+        # lse/delta carry a trailing unit lane dim so this reshape is a
+        # supported Mosaic cast (minormost dim preserved); no 1D intermediates
+        lse = lse_ref[0].reshape(g * bq, 1)
+        delta = delta_ref[0].reshape(g * bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -241,11 +249,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
                 s = jnp.where(k_pos > q_pos, NEG_INF, s)
             if window is not None:
                 s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         if softcap is not None:  # chain through d/ds cap*tanh(s/cap) = 1 - t^2
             ds = ds * (1.0 - t * t)
         dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
@@ -287,8 +295,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
-        lse = lse_ref[0].reshape(g * bq)
-        delta = delta_ref[0].reshape(g * bq)
+        lse = lse_ref[0].reshape(g * bq, 1)
+        delta = delta_ref[0].reshape(g * bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -302,7 +310,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 s = jnp.where(k_pos > q_pos, NEG_INF, s)
             if window is not None:
                 s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         p = jnp.where(s <= NEG_INF, 0.0, p)
         # dv += pᵀ @ do ; dk += dsᵀ @ q — over the folded G*BQ rows, which
         # also sums the G query heads sharing this KV head (GQA reduce)
@@ -310,7 +318,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         if softcap is not None:
             ds = ds * (1.0 - t * t)
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
@@ -348,11 +356,12 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
     qg, kt, vt = _regroup(q, k, v)
     dog, _, _ = _regroup(g_out, k, v)
     og, _, _ = _regroup(o, k, v)
-    delta = jnp.sum(dog.astype(jnp.float32) * og.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dog.astype(jnp.float32) * og.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [B*KV, G, Sq, 1] — unit lane dim, see lse
 
     q_spec = pl.BlockSpec((1, G, block_q, D), lambda b, i, j: (b, 0, i, 0))
     k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
-    r_spec = pl.BlockSpec((1, G, block_q), lambda b, i, j: (b, 0, i))
+    r_spec = pl.BlockSpec((1, G, block_q, 1), lambda b, i, j: (b, 0, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -369,7 +378,7 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
     # kv-major grid for dk/dv: q sweep innermost
     q_spec2 = pl.BlockSpec((1, G, block_q, D), lambda b, j, i: (b, 0, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
-    r_spec2 = pl.BlockSpec((1, G, block_q), lambda b, j, i: (b, 0, i))
+    r_spec2 = pl.BlockSpec((1, G, block_q, 1), lambda b, j, i: (b, 0, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,

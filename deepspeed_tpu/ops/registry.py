@@ -41,9 +41,16 @@ registry = OpRegistry()
 
 @functools.cache
 def on_tpu() -> bool:
+    """Canonical is-this-a-TPU probe — EVERY fast-path gate must use this.
+    The axon relay registers its PJRT plugin under platform name "axon"
+    (not "tpu"), so a bare ``default_backend() == "tpu"`` check silently
+    routes real chips onto the XLA fallback paths."""
     try:
-        return jax.default_backend() == "tpu" or any(
-            d.platform == "tpu" for d in jax.devices())
+        if jax.default_backend() in ("tpu", "axon"):
+            return True
+        return any(d.platform in ("tpu", "axon") or
+                   "TPU" in (getattr(d, "device_kind", "") or "")
+                   for d in jax.devices())
     except Exception:
         return False
 

@@ -44,8 +44,9 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int,
         raise ValueError("the splash kernel does not take key_padding_mask; "
                          "fold padding into the layout or use the dense path")
     if use_kernel is None:
+        from ..registry import on_tpu
         use_kernel = (key_padding_mask is None and s % block == 0
-                      and jax.default_backend() == "tpu")
+                      and on_tpu())
     if use_kernel:
         from .splash import splash_sparse_attention
         return splash_sparse_attention(q, k, v, layout, block, scale=scale)

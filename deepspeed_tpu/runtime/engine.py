@@ -1010,15 +1010,21 @@ class DeepSpeedTpuEngine:
 
     def set_train_batch_size(self, train_batch_size):
         """Adjust the GLOBAL batch by changing gradient-accumulation steps;
-        the micro batch is untouched (reference engine.py:455). The gas>1
-        fused program retraces automatically on the new stacked shape."""
+        the micro batch is untouched (reference engine.py:455). The compiled
+        programs closed over the old gas (loss /gas scaling and the
+        gas==1-vs-scan fused-path choice are baked in at build time), so they
+        are rebuilt here — shape retracing alone would keep stale closures."""
         denom = self.train_micro_batch_size_per_gpu() * self.dp_world_size
-        if train_batch_size % denom != 0:
+        if train_batch_size <= 0 or train_batch_size % denom != 0:
             raise ValueError(
-                f"train_batch_size={train_batch_size} must be divisible by "
-                f"micro_batch*dp={denom}")
+                f"train_batch_size={train_batch_size} must be a positive "
+                f"multiple of micro_batch*dp={denom}")
+        new_gas = train_batch_size // denom
+        gas_changed = new_gas != self.gradient_accumulation_steps()
         self._config.train_batch_size = train_batch_size
-        self._config.gradient_accumulation_steps = train_batch_size // denom
+        self._config.gradient_accumulation_steps = new_gas
+        if gas_changed:  # gas is the only value baked into the closures
+            self._build_compiled_fns()
 
     def set_train_micro_batch_size(self, micro_batch_size):
         """Adjust the micro batch, keeping gradient-accumulation steps

@@ -16,7 +16,10 @@ def see_memory_usage(message: str, force: bool = False, ranks=(0, )) -> dict:
     RSS. Returns the numbers so callers can assert on them."""
     stats = {}
     try:
-        dev = jax.devices()[0]
+        # local_devices: on a multi-host pod, jax.devices()[0] can be another
+        # process's device, whose memory_stats() raises — and this log line
+        # matters most on exactly the non-primary host that is OOMing
+        dev = jax.local_devices()[0]
         ms = dev.memory_stats() or {}
         stats["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
         stats["device_peak_bytes_in_use"] = int(ms.get("peak_bytes_in_use", 0))

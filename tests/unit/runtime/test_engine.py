@@ -326,10 +326,38 @@ def test_set_train_batch_size_adjusts_gas():
     loader = iter(random_dataloader(16, total_samples=64, batch_size=8))
     loss = eng.train_batch(loader)  # pulls 4 micro batches now
     assert np.isfinite(loss) and eng.global_steps == 1
-    with pytest.raises(ValueError, match="divisible"):
+    with pytest.raises(ValueError, match="positive multiple"):
         eng.set_train_batch_size(17)
+    with pytest.raises(ValueError, match="positive multiple"):
+        eng.set_train_batch_size(0)
     eng.set_train_micro_batch_size(2)
     assert eng.train_batch_size() == 2 * 4 * 8
+
+
+@pytest.mark.world_size(8)
+def test_set_train_batch_size_rebuilds_compiled_fns():
+    """The compiled programs close over gas (loss /gas scaling and the
+    gas==1-vs-scan path choice); set_train_batch_size must rebuild them.
+    Regression: a gas 1->2 change used to keep the single-microbatch fast
+    path (silently training on half the requested batch), and a 2->4 change
+    kept dividing the loss by the stale gas."""
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    cfg = base_config(train_batch_size=8, gradient_accumulation_steps=1)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                            config=cfg)
+    assert eng._train_step_fused is not None  # gas==1 fast path active
+    eng.set_train_batch_size(16)  # gas 1 -> 2
+    assert eng._train_step_fused is None  # fast path must yield to the scan
+    assert eng._train_batch_fused is not None
+    loader = iter(random_dataloader(16, total_samples=64, batch_size=8))
+    loss = eng.train_batch(loader)
+    assert np.isfinite(loss) and eng.global_steps == 1
+    eng.set_train_batch_size(8)  # back to gas 1: fast path restored
+    assert eng._train_step_fused is not None
+    loss2 = eng.train_batch(loader)
+    assert np.isfinite(loss2) and eng.global_steps == 2
 
 
 def test_see_memory_usage_reports():
