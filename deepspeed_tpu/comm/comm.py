@@ -202,6 +202,15 @@ def mpi_discovery(distributed_port: int = 29500, auto: bool = True):
             nodelist = _env("SLURM_STEP_NODELIST", "SLURM_JOB_NODELIST")
             if nodelist:
                 coord = f"{parse_slurm_nodelist(nodelist)[0]}:{distributed_port}"
+    elif auto and (_env("MV2_COMM_WORLD_SIZE") or _env("PMI_SIZE")):
+        # MPICH / Intel MPI hydra (PMI_RANK/PMI_SIZE) and MVAPICH2
+        # (MV2_COMM_WORLD_RANK/SIZE) — reference multinode_runner.py
+        # MPICH/IMPI/MVAPICH runners. The PMI v1 env carries no coordinator
+        # address, so the launcher must pin JAX_COORDINATOR_ADDRESS (ours
+        # do); without it the explicit-env requirement surfaces below.
+        nproc = nproc if nproc is not None else _env("MV2_COMM_WORLD_SIZE", "PMI_SIZE")
+        pid = pid if pid is not None else _env("MV2_COMM_WORLD_RANK", "PMI_RANK",
+                                               default="0")
     elif auto and _env("DS_HOSTLIST"):
         import socket
         hosts = [h for h in _env("DS_HOSTLIST").split(",") if h]

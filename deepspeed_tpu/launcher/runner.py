@@ -191,7 +191,56 @@ class SlurmRunner(MultiNodeRunner):
                    sys.executable, script] + list(script_args))
 
 
-RUNNERS = {r.name: r for r in (PDSHRunner, OpenMPIRunner, SlurmRunner)}
+class MPICHRunner(MultiNodeRunner):
+    """MPICH hydra fan-out (reference multinode_runner.py MPICHRunner):
+    PMI_RANK/PMI_SIZE reach every rank; the coordinator address is pinned
+    via -genv because the PMI v1 env carries none."""
+
+    name = "mpich"
+    _probe_binary = "mpiexec.hydra"
+
+    def get_cmd(self, script, script_args):
+        env = dict(self.exports)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.master_addr}:{self.master_port}"
+        cmd = ["mpiexec.hydra", "-np", str(len(self.hosts)), "-ppn", "1",
+               "-hosts", ",".join(self.hosts)]
+        for k, v in env.items():
+            cmd += ["-genv", k, v]
+        return cmd + [sys.executable, script] + list(script_args)
+
+
+class IMPIRunner(MPICHRunner):
+    """Intel MPI fan-out (reference IMPIRunner): hydra-compatible CLI, but
+    probes Intel's mpiexec and turns off its rank pinning, which fights the
+    one-process-per-host JAX model."""
+
+    name = "impi"
+    _probe_binary = "mpiexec"
+
+    def get_cmd(self, script, script_args):
+        cmd = super().get_cmd(script, script_args)
+        cmd[0] = "mpiexec"
+        # one controller process per host owns all local chips: no pinning
+        return cmd[:1] + ["-genv", "I_MPI_PIN", "0"] + cmd[1:]
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """mpirun_rsh fan-out (reference MVAPICHRunner): hosts and K=V env pairs
+    inline; ranks read MV2_COMM_WORLD_RANK/SIZE."""
+
+    name = "mvapich"
+    _probe_binary = "mpirun_rsh"
+
+    def get_cmd(self, script, script_args):
+        env = dict(self.exports)
+        env["JAX_COORDINATOR_ADDRESS"] = f"{self.master_addr}:{self.master_port}"
+        return (["mpirun_rsh", "-np", str(len(self.hosts))] + list(self.hosts)
+                + [f"{k}={v}" for k, v in env.items()]
+                + [sys.executable, script] + list(script_args))
+
+
+RUNNERS = {r.name: r for r in (PDSHRunner, OpenMPIRunner, SlurmRunner,
+                               MPICHRunner, IMPIRunner, MVAPICHRunner)}
 
 
 def main(argv=None):

@@ -11,14 +11,17 @@ import pytest
 
 from deepspeed_tpu.comm.comm import mpi_discovery, parse_slurm_nodelist
 from deepspeed_tpu.launcher.runner import (PDSHRunner, OpenMPIRunner,
-                                           SlurmRunner, RUNNERS, main)
+                                           SlurmRunner, MPICHRunner,
+                                           IMPIRunner, MVAPICHRunner,
+                                           RUNNERS, main)
 
 SCHED_VARS = [
     "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
     "NUM_PROCESSES", "JAX_PROCESS_ID", "PROCESS_ID", "OMPI_COMM_WORLD_SIZE",
     "OMPI_COMM_WORLD_RANK", "OMPI_MCA_orte_hnp_uri", "PMIX_SERVER_URI2",
     "SLURM_NTASKS", "SLURM_PROCID", "SLURM_STEP_NODELIST",
-    "SLURM_JOB_NODELIST", "DS_HOSTLIST",
+    "SLURM_JOB_NODELIST", "DS_HOSTLIST", "PMI_SIZE", "PMI_RANK",
+    "MV2_COMM_WORLD_SIZE", "MV2_COMM_WORLD_RANK",
 ]
 
 
@@ -189,4 +192,58 @@ def test_main_dry_run_with_launcher(tmp_path, capsys):
 
 
 def test_runner_registry_names():
-    assert set(RUNNERS) == {"pdsh", "openmpi", "slurm"}
+    assert set(RUNNERS) == {"pdsh", "openmpi", "slurm", "mpich", "impi",
+                            "mvapich"}
+
+
+# ---- MPICH / Intel MPI / MVAPICH (reference multinode_runner.py) ----
+
+def test_discovery_pmi_hydra(clean_env):
+    """MPICH/Intel-MPI hydra: PMI_RANK/PMI_SIZE; coordinator must come from
+    the launcher-pinned env (PMI v1 carries no address)."""
+    clean_env.setenv("PMI_SIZE", "4")
+    clean_env.setenv("PMI_RANK", "3")
+    clean_env.setenv("JAX_COORDINATOR_ADDRESS", "h0:29500")
+    assert mpi_discovery() == ("h0:29500", 4, 3)
+
+
+def test_discovery_mvapich(clean_env):
+    clean_env.setenv("MV2_COMM_WORLD_SIZE", "2")
+    clean_env.setenv("MV2_COMM_WORLD_RANK", "1")
+    assert mpi_discovery() == (None, 2, 1)
+
+
+def test_discovery_explicit_beats_pmi(clean_env):
+    clean_env.setenv("PMI_SIZE", "8")
+    clean_env.setenv("PMI_RANK", "5")
+    clean_env.setenv("JAX_NUM_PROCESSES", "2")
+    clean_env.setenv("JAX_PROCESS_ID", "0")
+    assert mpi_discovery() == (None, 2, 0)
+
+
+def test_mpich_runner_cmd():
+    r = MPICHRunner(["h0", "h1"], "h0", 29500, {"DS_X": "1"})
+    cmd = r.get_cmd("train.py", ["--lr", "1"])
+    assert cmd[:5] == ["mpiexec.hydra", "-np", "2", "-ppn", "1"]
+    assert cmd[cmd.index("-hosts") + 1] == "h0,h1"
+    g = cmd.index("-genv")
+    assert "JAX_COORDINATOR_ADDRESS" in cmd and "h0:29500" in cmd and g > 0
+    assert cmd[-4:] == [sys.executable, "train.py", "--lr", "1"]
+
+
+def test_impi_runner_cmd():
+    r = IMPIRunner(["h0", "h1"], "h0", 29500, {})
+    cmd = r.get_cmd("train.py", [])
+    assert cmd[0] == "mpiexec"
+    pin = cmd.index("I_MPI_PIN")
+    assert cmd[pin - 1] == "-genv" and cmd[pin + 1] == "0"
+    assert cmd[cmd.index("-hosts") + 1] == "h0,h1"
+
+
+def test_mvapich_runner_cmd():
+    r = MVAPICHRunner(["h0", "h1"], "h0", 29503, {"DS_X": "1"})
+    cmd = r.get_cmd("train.py", ["--z"])
+    assert cmd[:3] == ["mpirun_rsh", "-np", "2"]
+    assert cmd[3:5] == ["h0", "h1"]
+    assert "DS_X=1" in cmd and "JAX_COORDINATOR_ADDRESS=h0:29503" in cmd
+    assert cmd[-3:] == [sys.executable, "train.py", "--z"]
