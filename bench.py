@@ -44,7 +44,9 @@ def _relay_up(env, timeout=150) -> bool:
 
 def _measure_config(batch, seq, iters, remat):
     """One measurement at a given batch/remat setting; raises on OOM so the
-    caller can fall back to a smaller footprint."""
+    caller can fall back to a smaller footprint. ``remat`` is False, True
+    (full recompute) or a jax.checkpoint_policies name (selective remat —
+    bigger batches without full-remat's recompute tax)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -52,10 +54,12 @@ def _measure_config(batch, seq, iters, remat):
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
     platform = jax.devices()[0].platform
+    policy = remat if isinstance(remat, str) else None
     # ~0.4B params: sized to fit one v5e chip (16 GB HBM) with Adam fp32 states
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                       num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-                      max_position_embeddings=2048, remat=remat)
+                      max_position_embeddings=2048, remat=bool(remat),
+                      remat_policy=policy)
     if platform == "cpu":
         # diagnostic-fallback sizing: same model family, tractable on host
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
@@ -116,7 +120,8 @@ def _measure_config(batch, seq, iters, remat):
         mfu = achieved / peak
         mfu_ratio = round(mfu / 0.54, 4)
         unit = (f"tokens/s (0.4B llama, bf16, fused step, "
-                f"bs{batch}xseq{seq}{', remat' if remat else ''})")
+                f"bs{batch}xseq{seq}"
+                f"{', remat=' + str(remat) if remat else ''})")
     return {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -238,7 +243,8 @@ def breakdown(batch=8, seq=1024, iters=10):
 def measure():
     # largest footprint first; OOM falls back (16 GB HBM: bs16 fills the MXU
     # when it fits, bs8 no-remat is the expected landing spot)
-    attempts = [(16, 1024, 20, False), (8, 1024, 20, False), (4, 1024, 10, True)]
+    attempts = [(16, 1024, 20, False), (16, 1024, 20, "dots_saveable"),
+                (8, 1024, 20, False), (4, 1024, 10, True)]
     last_err = None
     for batch, seq, iters, remat in attempts:
         try:

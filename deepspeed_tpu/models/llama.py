@@ -114,6 +114,10 @@ class LlamaConfig:
     # tightest ceiling; larger chunks trade memory for fewer scan steps.
     scan_chunk_size: int = 1
     remat: bool = False
+    # jax.checkpoint_policies name for selective remat (e.g. "dots_saveable":
+    # save matmul outputs, recompute elementwise/norms — most of the memory
+    # saving at a fraction of full remat's recompute). None = full recompute.
+    remat_policy: "Optional[str]" = None
 
     @property
     def head_dim_(self):
@@ -542,6 +546,17 @@ class LMHead(nn.Module):
         return out
 
 
+def _remat_layer_cls(cfg):
+    """nn.remat with the configured jax.checkpoint_policies policy (selective
+    remat — reference activation_checkpointing config's TPU analog)."""
+    if cfg.remat_policy:
+        pol = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        if pol is None:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+        return nn.remat(LlamaDecoderLayer, policy=pol)
+    return nn.remat(LlamaDecoderLayer)
+
+
 class _ScanBody(nn.Module):
     """nn.scan adapter: scan bodies must return (carry, out). With
     ``scan_chunk_size > 1`` one scan step applies a chunk of layers (the
@@ -551,7 +566,7 @@ class _ScanBody(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, attn_mask=None):
         cfg = self.config
-        layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
+        layer_cls = _remat_layer_cls(cfg) if cfg.remat else LlamaDecoderLayer
         if cfg.scan_chunk_size <= 1:
             return layer_cls(cfg, name="layer")(x, cos, sin, positions, attn_mask), None
         for i in range(cfg.scan_chunk_size):
@@ -612,7 +627,7 @@ class LlamaModel(nn.Module):
                                 metadata_params={nn.PARTITION_NAME: "layers"})
             x, _ = ScanLayer(cfg, name="layers")(x, cos, sin, positions, attn_mask)
         else:
-            layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
+            layer_cls = _remat_layer_cls(cfg) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_hidden_layers):
                 x = layer_cls(cfg, i, name=f"layers_{i}")(x, cos, sin, positions,
                                                           attn_mask)

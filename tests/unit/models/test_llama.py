@@ -108,3 +108,33 @@ def test_llama_memorizes_fixed_batch():
         last = loss
     assert first > 3.0, f"initial CE should be near ln(vocab): {first}"
     assert last < 0.1, f"failed to memorize: {first} -> {last}"
+
+
+def test_remat_policy_selective():
+    """remat_policy (selective remat: jax.checkpoint_policies name) must
+    produce identical loss/grads to no-remat, and unknown names must raise."""
+    import jax
+    cfg_kw = dict(num_hidden_layers=2, hidden_size=64, intermediate_size=160)
+    base = LlamaConfig.tiny(**cfg_kw)
+    sel = LlamaConfig.tiny(**cfg_kw, remat=True, remat_policy="dots_saveable")
+    model_a, params = init_llama(base, seed=0)
+    model_b, _ = init_llama(sel, seed=0)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, base.vocab_size, size=(2, 32)), jnp.int32)
+
+    def loss_of(m):
+        return jax.jit(lambda p: m.apply({"params": p}, ids, labels=ids))
+
+    la = loss_of(model_a)(params)
+    lb = loss_of(model_b)(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    ga = jax.grad(lambda p: model_a.apply({"params": p}, ids, labels=ids))(params)
+    gb = jax.grad(lambda p: model_b.apply({"params": p}, ids, labels=ids))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5), ga, gb)
+
+    import pytest
+    bad = LlamaConfig.tiny(**cfg_kw, remat=True, remat_policy="no_such_policy")
+    with pytest.raises(ValueError, match="remat_policy"):
+        init_llama(bad)
