@@ -56,10 +56,13 @@ def _measure_config(batch, seq, iters, remat):
     platform = jax.devices()[0].platform
     policy = remat if isinstance(remat, str) else None
     # ~0.4B params: sized to fit one v5e chip (16 GB HBM) with Adam fp32 states
+    # ce_chunk_size: streamed unembed+CE (ops/chunked_ce.py) — the [tokens,
+    # 32k] logits tensor (2.1 GB fp32 at bs16) never materializes, which is
+    # what lets the bigger MXU footprints fit
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                       num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
                       max_position_embeddings=2048, remat=bool(remat),
-                      remat_policy=policy)
+                      remat_policy=policy, ce_chunk_size=8000)
     if platform == "cpu":
         # diagnostic-fallback sizing: same model family, tractable on host
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,

@@ -118,6 +118,11 @@ class LlamaConfig:
     # save matmul outputs, recompute elementwise/norms — most of the memory
     # saving at a fraction of full remat's recompute). None = full recompute.
     remat_policy: "Optional[str]" = None
+    # chunked unembed+CE (ops/chunked_ce.py): vocab-chunk size for the
+    # streamed logsumexp that never materializes [tokens, vocab] logits.
+    # None/0 = dense CE. The big win is large-vocab training (32k: ~2 GB
+    # of saved activation at bs16 x 1k; Gemma 256k: ~8 GB).
+    ce_chunk_size: "Optional[int]" = None
 
     @property
     def head_dim_(self):
@@ -530,19 +535,22 @@ class LMHead(nn.Module):
     use_bias: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, return_params=False):
         kernel = self.param(
             "kernel",
             nn.with_partitioning(nn.initializers.lecun_normal(), (EMBED, VOCAB)),
             (x.shape[-1], self.features))
+        bias = self.param(
+            "bias", nn.with_partitioning(nn.initializers.zeros, (VOCAB, )),
+            (self.features, ), jnp.float32) if self.use_bias else None
+        if return_params:  # chunked-CE path: same param tree, no matmul here
+            return kernel, bias
         out = jax.lax.dot_general(
             x.astype(self.dtype), kernel.astype(self.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         if self.use_bias:
-            out = out + self.param(
-                "bias", nn.with_partitioning(nn.initializers.zeros, (VOCAB, )),
-                (self.features, ), jnp.float32)
+            out = out + bias
         return out
 
 
@@ -578,7 +586,8 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, attn_mask=None):
+    def __call__(self, input_ids, positions=None, attn_mask=None,
+                 return_unembed=False):
         cfg = self.config
         if positions is None:
             positions = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
@@ -632,6 +641,15 @@ class LlamaModel(nn.Module):
                 x = layer_cls(cfg, i, name=f"layers_{i}")(x, cos, sin, positions,
                                                           attn_mask)
         x = _make_norm(cfg, "norm")(x)
+        if return_unembed:
+            # chunked-CE path (ops/chunked_ce.py): hand back the raw unembed
+            # weight [H, V] (+bias) instead of materialized logits; scale and
+            # softcap are applied per chunk inside the op
+            if cfg.tie_word_embeddings:
+                return x, embed.embedding.T, None
+            w, b = LMHead(cfg.vocab_size, cfg.dtype, use_bias=cfg.lm_head_bias,
+                          name="lm_head")(x, return_params=True)
+            return x, w, b
         # unembed: bf16 inputs ride the MXU fast path (fp32 matmul is several×
         # slower), but the accumulator stays fp32 and the *output* is emitted
         # fp32 (preferred_element_type) — rounding logits to bf16 before the
@@ -670,7 +688,18 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None, attn_mask=None):
-        logits = LlamaModel(self.config, name="model")(input_ids, positions, attn_mask)
+        cfg = self.config
+        if labels is not None and cfg.ce_chunk_size:
+            from ..ops.chunked_ce import chunked_cross_entropy_loss
+            x, w, b = LlamaModel(cfg, name="model")(input_ids, positions,
+                                                    attn_mask,
+                                                    return_unembed=True)
+            return chunked_cross_entropy_loss(
+                x, w, b, labels, cfg.ce_chunk_size,
+                logit_scale=cfg.logit_scale,
+                softcap=cfg.final_logit_softcapping,
+                compute_dtype=cfg.dtype)
+        logits = LlamaModel(cfg, name="model")(input_ids, positions, attn_mask)
         if labels is None:
             return logits
         return cross_entropy_loss(logits, labels)

@@ -1,0 +1,187 @@
+"""Memory-efficient (chunked) unembed + cross-entropy.
+
+TPU analog of the reference's fused-softmax/logit kernels for large vocab
+(``csrc/transformer/inference/csrc/softmax.cu`` handles the on-device
+softmax; training CE in the reference stays torch — at 32k–256k vocab the
+``[tokens, vocab]`` logits tensor is the single biggest training activation:
+bs16 x seq1024 x 32k fp32 is 2.1 GB saved for backward, 8+ GB at Gemma's
+256k).
+
+This op never materializes the full logits matrix in either pass:
+
+- forward: ``lax.scan`` over vocab chunks; each step computes the chunk's
+  logits ``x @ w[:, c]`` on the MXU and folds them into a running online
+  logsumexp (m, s) plus the gold-label logit — O(T) state, O(T * chunk)
+  transient.
+- backward (``jax.custom_vjp``): re-runs the same chunk sweep, rebuilding
+  ``p_c = exp(logits_c - lse)`` and accumulating ``dx += dl_c @ w_cᵀ``,
+  ``dw_c = xᵀ @ dl_c`` per chunk — the one extra chunk-matmul sweep costs
+  ~2% of a 0.4B-model step, the 2.1 GB saved activation costs nothing.
+
+Cohere ``logit_scale`` and Gemma-2 ``final_logit_softcapping`` are applied
+per chunk (elementwise), so the models that most need chunking (Gemma's
+256k vocab) keep their exact logit semantics.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _num_chunks(V: int, chunk: int) -> int:
+    return -(-V // chunk)
+
+
+def _pad_to_chunks(w, bias, chunk):
+    """Right-pad the vocab axis to a chunk multiple: dynamic_slice CLAMPS
+    out-of-range starts (the last ragged chunk would silently re-read
+    earlier columns), so every slice must be in-bounds by construction."""
+    V = w.shape[1]
+    Vp = _num_chunks(V, chunk) * chunk
+    if Vp != V:
+        w = jnp.pad(w, ((0, 0), (0, Vp - V)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, Vp - V))
+    return w, bias
+
+
+def _chunk_logits(x, w, bias, c0, chunk, V, logit_scale, softcap,
+                  compute_dtype):
+    """fp32 logits for vocab columns [c0, c0+chunk) of the PADDED w
+    (+scale/softcap), plus the tanh(l/cap) needed for the softcap chain
+    rule; ``V`` is the true vocab size for masking the padded tail."""
+    wc = jax.lax.dynamic_slice_in_dim(w, c0, chunk, axis=1)
+    lc = jax.lax.dot_general(x.astype(compute_dtype), wc.astype(compute_dtype),
+                             (((1, ), (0, )), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if bias is not None:
+        lc = lc + jax.lax.dynamic_slice_in_dim(
+            bias.astype(jnp.float32), c0, chunk, axis=0)
+    if logit_scale is not None:
+        lc = lc * jnp.float32(logit_scale)
+    t = None
+    if softcap is not None:
+        t = jnp.tanh(lc / softcap)
+        lc = softcap * t
+    # mask padded columns (V not divisible by chunk) out of the softmax
+    col = c0 + jax.lax.broadcasted_iota(jnp.int32, lc.shape, 1)
+    lc = jnp.where(col < V, lc, -jnp.inf)
+    return lc, t, col
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def chunked_unembed_ce(x, w, bias, targets, chunk: int,
+                       logit_scale: Optional[float] = None,
+                       softcap: Optional[float] = None,
+                       compute_dtype=jnp.bfloat16):
+    """Per-token NLL of ``softmax(x @ w + bias)`` without materializing the
+    logits. ``x`` [T, H], ``w`` [H, V], ``bias`` [V] or None, ``targets``
+    [T] int (callers mask ignore_index outside). Returns nll [T] fp32."""
+    nll, _ = _fwd_sweep(x, w, bias, targets, chunk, logit_scale, softcap,
+                        compute_dtype)
+    return nll
+
+
+def _fwd_sweep(x, w, bias, targets, chunk, logit_scale, softcap, compute_dtype):
+    T = x.shape[0]
+    V = w.shape[1]
+    nc = _num_chunks(V, chunk)
+    wp, biasp = _pad_to_chunks(w, bias, chunk)
+
+    def step(carry, ci):
+        m, s, gold = carry
+        lc, _, col = _chunk_logits(x, wp, biasp, ci * chunk, chunk, V,
+                                   logit_scale, softcap, compute_dtype)
+        m_new = jnp.maximum(m, lc.max(axis=-1))
+        # exp(-inf - -inf) guards: a fully-masked chunk must not poison s
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m - m_new))
+        s = s * corr + jnp.where(jnp.isneginf(lc), 0.0,
+                                 jnp.exp(lc - m_new[:, None])).sum(axis=-1)
+        hit = col == targets[:, None]
+        gold = gold + jnp.where(hit, jnp.where(jnp.isneginf(lc), 0.0, lc),
+                                0.0).sum(axis=-1)
+        return (m_new, s, gold), None
+
+    init = (jnp.full((T, ), -jnp.inf, jnp.float32),
+            jnp.zeros((T, ), jnp.float32),
+            jnp.zeros((T, ), jnp.float32))
+    (m, s, gold), _ = jax.lax.scan(step, init, jnp.arange(nc))
+    lse = m + jnp.log(s)
+    return lse - gold, (m, s)
+
+
+def _ce_fwd(x, w, bias, targets, chunk, logit_scale, softcap, compute_dtype):
+    nll, (m, s) = _fwd_sweep(x, w, bias, targets, chunk, logit_scale, softcap,
+                             compute_dtype)
+    lse = m + jnp.log(s)
+    return nll, (x, w, bias, targets, lse)
+
+
+def _ce_bwd(chunk, logit_scale, softcap, compute_dtype, res, g):
+    x, w, bias, targets, lse = res
+    V = w.shape[1]
+    nc = _num_chunks(V, chunk)
+    T, H = x.shape
+
+    wp, biasp = _pad_to_chunks(w, bias, chunk)
+
+    def step(carry, ci):
+        dx, dw, dbias = carry
+        c0 = ci * chunk
+        lc, t, col = _chunk_logits(x, wp, biasp, c0, chunk, V,
+                                   logit_scale, softcap, compute_dtype)
+        p = jnp.where(jnp.isneginf(lc), 0.0, jnp.exp(lc - lse[:, None]))
+        dl = (p - (col == targets[:, None]).astype(jnp.float32)) * g[:, None]
+        # chain back through softcap then logit_scale (applied in that order
+        # forward: scale -> softcap), zeroing padded columns
+        if softcap is not None:
+            dl = dl * (1.0 - t * t)
+        if logit_scale is not None:
+            dl = dl * jnp.float32(logit_scale)
+        dl = jnp.where(col < V, dl, 0.0)
+        wc = jax.lax.dynamic_slice_in_dim(wp, c0, chunk, axis=1)
+        dx = dx + jax.lax.dot_general(
+            dl.astype(compute_dtype), wc.astype(compute_dtype),
+            (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(
+            x.astype(compute_dtype), dl.astype(compute_dtype),
+            (((0, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        dw = jax.lax.dynamic_update_slice_in_dim(
+            dw, dwc.astype(dw.dtype), c0, axis=1)
+        if dbias is not None:
+            dbias = jax.lax.dynamic_update_slice_in_dim(
+                dbias, dl.sum(axis=0).astype(dbias.dtype), c0, axis=0)
+        return (dx, dw, dbias), None
+
+    Vp = nc * chunk
+    init = (jnp.zeros((T, H), jnp.float32),
+            jnp.zeros((H, Vp), jnp.float32),
+            None if bias is None else jnp.zeros((Vp, ), jnp.float32))
+    (dx, dw, dbias), _ = jax.lax.scan(step, init, jnp.arange(nc))
+    dx = dx.astype(x.dtype)
+    dw = dw[:, :V].astype(w.dtype)
+    dbias = None if bias is None else dbias[:V].astype(bias.dtype)
+    return dx, dw, dbias, None
+
+
+chunked_unembed_ce.defvjp(_ce_fwd, _ce_bwd)
+
+
+def chunked_cross_entropy_loss(x, w, bias, labels, chunk: int,
+                               ignore_index: int = -100,
+                               logit_scale: Optional[float] = None,
+                               softcap: Optional[float] = None,
+                               compute_dtype=jnp.bfloat16):
+    """Token-mean causal-LM CE (shift-by-one, ignore_index) over a streamed
+    unembed — drop-in for ``models.llama.cross_entropy_loss`` fed hidden
+    states instead of logits. ``x`` [B, S, H], ``labels`` [B, S]."""
+    B, S, H = x.shape
+    xs = x[:, :-1].reshape(B * (S - 1), H)
+    tg = labels[:, 1:].reshape(B * (S - 1))
+    mask = (tg != ignore_index).astype(jnp.float32)
+    tg = jnp.where(tg == ignore_index, 0, tg)
+    nll = chunked_unembed_ce(xs, w, bias, tg, chunk, logit_scale, softcap,
+                             compute_dtype)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
