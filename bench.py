@@ -143,9 +143,11 @@ def breakdown(batch=8, seq=1024, iters=10):
     import deepspeed_tpu
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
+    # mirrors the measure() config (incl. chunked CE) so the breakdown
+    # explains the bench's fused step, not a different program
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                       num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-                      max_position_embeddings=2048, remat=False)
+                      max_position_embeddings=2048, remat=False, ce_chunk_size=8000)
     if jax.devices()[0].platform == "cpu":  # smoke-test sizing
         cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
