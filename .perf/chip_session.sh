@@ -1,0 +1,31 @@
+#!/bin/bash
+# One TPU relay window -> full evidence capture, priority-ordered so a short
+# window still lands the headline number first.
+cd /root/repo
+P=/root/repo/.perf
+LOG=$P/watcher.log
+echo "CHIP SESSION start $(date -u +%FT%TZ)" >> $LOG
+
+run() { # name timeout cmd...
+  local name=$1 to=$2; shift 2
+  echo "== $name $(date -u +%T)" >> $LOG
+  timeout "$to" "$@" > "$P/${name}_r4.out" 2>&1
+  echo "$name rc=$?" >> $LOG
+}
+
+# 1. headline train number (ladder: bs16 -> bs16+dots -> bs8 -> bs4)
+run bench 2400 python bench.py
+# 2. where-the-time-goes (drives the MFU iteration)
+run bench_breakdown 1200 python bench.py --breakdown
+# 3. serving decode (writes BENCH_SERVING.json at repo root)
+run bench_serving 2400 python bench_serving.py
+# 4. Mosaic lowering revalidation
+run pallas_tpu 1200 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q
+# 5. NVMe bandwidth (GDS-analog evidence)
+run nvme 1200 python bin/ds_nvme_bench --o_direct
+# 6. flash block sweep (three strongest candidates only)
+for B in "256,512" "512,512"; do
+  run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B python bench.py
+done
+echo "CHIP SESSION done $(date -u +%FT%TZ)" >> $LOG
+touch $P/SUITE_DONE

@@ -1,22 +1,16 @@
 #!/bin/bash
-# TPU relay watcher r4: probe every 10 min; on success run the full bench suite.
+# TPU relay watcher r4.2: probe every 10 min; on success run chip_session.sh.
 cd /root/repo
 PROBE=/tmp/probe_tpu.py
 LOG=/root/repo/.perf/watcher.log
-echo "watcher v2 start $(date -u +%FT%TZ)" >> $LOG
+echo "watcher v3 start $(date -u +%FT%TZ)" >> $LOG
 N=0
 while true; do
   N=$((N+1))
   if timeout 150 python $PROBE >> $LOG 2>&1; then
     echo "PROBE OK #$N $(date -u +%FT%TZ)" >> $LOG
     touch /root/repo/.perf/TPU_UP
-    timeout 2400 python bench.py > /root/repo/.perf/bench_r4.out 2>&1;               echo "bench rc=$?" >> $LOG
-    timeout 2400 python bench.py --breakdown > /root/repo/.perf/bench_breakdown_r4.out 2>&1; echo "breakdown rc=$?" >> $LOG
-    timeout 2400 python bench_serving.py > /root/repo/.perf/bench_serving_r4.out 2>&1;  echo "serving rc=$?" >> $LOG
-    timeout 1200 python bin/ds_nvme_bench --o_direct > /root/repo/.perf/nvme_r4.out 2>&1; echo "nvme rc=$?" >> $LOG
-    timeout 2400 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q > /root/repo/.perf/pallas_tpu_r4.out 2>&1; echo "pallas rc=$?" >> $LOG
-    echo "SUITE DONE $(date -u +%FT%TZ)" >> $LOG
-    touch /root/repo/.perf/SUITE_DONE
+    bash /root/repo/.perf/chip_session.sh
     break
   else
     echo "probe fail #$N $(date -u +%FT%TZ)" >> $LOG
