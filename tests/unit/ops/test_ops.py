@@ -314,3 +314,54 @@ def test_flash_attention_softcap_values_and_grads(window):
     g_r = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_k, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+class TestOnTpuGate:
+    """Regression: the axon PJRT plugin registers platform name "axon", not
+    "tpu" — every chip bench before r4 silently ran the XLA fallbacks
+    because the gates compared against "tpu" only."""
+
+    def _probe(self, monkeypatch, backend, platforms):
+        import importlib
+        import jax
+        reg = importlib.import_module("deepspeed_tpu.ops.registry")
+
+        class _Dev:
+            def __init__(self, platform):
+                self.platform = platform
+                self.device_kind = ""
+
+        monkeypatch.setattr(jax, "default_backend", lambda: backend)
+        monkeypatch.setattr(jax, "devices",
+                            lambda *a: [_Dev(p) for p in platforms])
+        reg.on_tpu.cache_clear()
+        try:
+            return reg.on_tpu()
+        finally:
+            reg.on_tpu.cache_clear()
+
+    def test_axon_backend_is_tpu(self, monkeypatch):
+        assert self._probe(monkeypatch, "axon", ["axon"]) is True
+
+    def test_tpu_backend_is_tpu(self, monkeypatch):
+        assert self._probe(monkeypatch, "tpu", ["tpu"]) is True
+
+    def test_cpu_backend_is_not_tpu(self, monkeypatch):
+        assert self._probe(monkeypatch, "cpu", ["cpu"]) is False
+
+    def test_tpu_device_kind_recognized(self, monkeypatch):
+        import importlib
+        import jax
+        reg = importlib.import_module("deepspeed_tpu.ops.registry")
+
+        class _Dev:
+            platform = "weird"
+            device_kind = "TPU v5 lite"
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "weird")
+        monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+        reg.on_tpu.cache_clear()
+        try:
+            assert reg.on_tpu() is True
+        finally:
+            reg.on_tpu.cache_clear()
