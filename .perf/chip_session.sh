@@ -27,5 +27,8 @@ run nvme 1200 python bin/ds_nvme_bench --o_direct
 for B in "256,512" "512,512"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B python bench.py
 done
+# 7. driver-entry compile check on the real chip (the driver only runs it
+# single-chip; prove it here while we have silicon)
+run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
 echo "CHIP SESSION done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE
