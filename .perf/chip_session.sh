@@ -1,34 +1,45 @@
 #!/bin/bash
-# One TPU relay window -> full evidence capture, priority-ordered so a short
-# window still lands the headline number first.
+# One TPU relay window -> full evidence capture. Relay windows have been
+# ~10 min; order is strictly cheapest-first so a short window still lands
+# the Mosaic revalidation + a train number before the long jobs. Sessions
+# repeat (watcher keeps looping), so every output carries a per-session
+# suffix — a later flaky window can never clobber earlier good evidence.
 cd /root/repo
 P=/root/repo/.perf
 LOG=$P/watcher.log
-echo "CHIP SESSION start $(date -u +%FT%TZ)" >> $LOG
+SFX=$(date -u +%m%dT%H%M)
+echo "CHIP SESSION $SFX start $(date -u +%FT%TZ)" >> $LOG
 
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
   echo "== $name $(date -u +%T)" >> $LOG
-  timeout "$to" "$@" > "$P/${name}_r4.out" 2>&1
+  timeout "$to" "$@" > "$P/${name}_r4_${SFX}.out" 2>&1
   echo "$name rc=$?" >> $LOG
 }
 
-# 1. headline train number (ladder: bs16 -> bs16+dots -> bs8 -> bs4)
+# 1. Mosaic lowering revalidation (known ~80s when relay healthy)
+run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q
+# 2. fast train number (ONE compile at the known-fits footprint — lands a
+# real tok/s inside a short window)
+run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
+# 3. cheap compile triage: 4-layer fused step, xla vs flash attention
+# (stage 4 == the full bench config, covered by the bench runs themselves)
+run triage 1200 python .perf/triage_compile.py 2 3
+# 4. headline train number (ladder: bs16 -> bs16+dots -> bs8 -> bs4)
 run bench 2400 python bench.py
-# 2. where-the-time-goes (drives the MFU iteration)
-run bench_breakdown 1200 python bench.py --breakdown
-# 3. serving decode (writes BENCH_SERVING.json at repo root)
+# 5. where-the-time-goes (drives the MFU iteration)
+run bench_breakdown 1800 python bench.py --breakdown
+# 6. serving decode (writes BENCH_SERVING.json at repo root)
 run bench_serving 2400 python bench_serving.py
-# 4. Mosaic lowering revalidation
-run pallas_tpu 1200 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q
-# 5. NVMe bandwidth (GDS-analog evidence)
+[ -f BENCH_SERVING.json ] && cp BENCH_SERVING.json "$P/BENCH_SERVING_${SFX}.json"
+# 7. NVMe bandwidth (GDS-analog evidence)
 run nvme 1200 python bin/ds_nvme_bench --o_direct
-# 6. flash block sweep (three strongest candidates only)
+# 8. driver-entry compile check on the real chip (the driver only runs it
+# single-chip; prove it here while we have silicon)
+run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
+# 9. flash block sweep (two strongest candidates)
 for B in "256,512" "512,512"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B python bench.py
 done
-# 7. driver-entry compile check on the real chip (the driver only runs it
-# single-chip; prove it here while we have silicon)
-run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
-echo "CHIP SESSION done $(date -u +%FT%TZ)" >> $LOG
+echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE

@@ -1,0 +1,91 @@
+"""Chip triage: where does the fused-step compile time go?
+
+Stages (each prints a flushed timestamped line BEFORE starting, so a hang
+identifies its stage):
+  0. relay probe matmul
+  1. standalone flash fwd+bwd kernel jit (256 seq)
+  2. 4-layer llama fused step, attn_impl=xla
+  3. 4-layer llama fused step, attn_impl=flash (auto on chip)
+  4. 24-layer (bench config) fused step, flash
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+# sys.path[0] is .perf/ when run as a script; bench.py lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def stamp(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main():
+    t0 = time.time()
+    stamp("importing jax")
+    import jax
+    import jax.numpy as jnp
+    stamp(f"devices: {jax.devices()} ({time.time()-t0:.1f}s)")
+
+    t = time.time()
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    stamp(f"stage0 probe matmul ok ({time.time()-t:.1f}s)")
+
+    from deepspeed_tpu.ops.attention import flash_attention
+
+    t = time.time()
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 256, 8, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(2, 256, 2, 64)), jnp.bfloat16)
+
+    def loss(q, k):
+        return (flash_attention(q, k, k, causal=True, force_pallas=True)
+                .astype(jnp.float32) ** 2).mean()
+
+    g = jax.jit(jax.grad(loss))(q, k)
+    jax.block_until_ready(g)
+    stamp(f"stage1 flash fwd+bwd kernel ok ({time.time()-t:.1f}s)")
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import init_llama
+    from bench import bench_config
+
+    def fused(nlayers, attn_impl, tag, batch=8):
+        t = time.time()
+        # the bench's own config (single source of truth) at reduced depth
+        cfg = bench_config(num_hidden_layers=nlayers, attn_impl=attn_impl)
+        model, params = init_llama(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": batch,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True}, "steps_per_print": 0})
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, 1024)),
+                          dtype=jnp.int32)
+        stamp(f"{tag}: engine built ({time.time()-t:.1f}s), compiling step...")
+        t = time.time()
+        engine.fused_train_step(ids, labels=ids)
+        jax.block_until_ready(engine.params)
+        stamp(f"{tag}: first step done ({time.time()-t:.1f}s)")
+        t = time.time()
+        for _ in range(3):
+            engine.fused_train_step(ids, labels=ids)
+        jax.block_until_ready(engine.params)
+        stamp(f"{tag}: 3 steps in {time.time()-t:.2f}s "
+              f"({3*batch*1024/(time.time()-t):.0f} tok/s)")
+
+    which = set(sys.argv[1:]) or {"2", "3", "4"}
+    if "2" in which:
+        fused(4, "xla", "stage2 4L-xla")
+    if "3" in which:
+        fused(4, "auto", "stage3 4L-flash")
+    if "4" in which:
+        fused(24, "auto", "stage4 24L-flash(bench cfg)")
+    stamp("triage complete")
+
+
+if __name__ == "__main__":
+    main()

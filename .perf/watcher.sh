@@ -1,9 +1,12 @@
 #!/bin/bash
-# TPU relay watcher r4.2: probe every 10 min; on success run chip_session.sh.
+# TPU relay watcher r4.3: probe every 5 min; on success run chip_session.sh.
+# Relay windows have been short (~10 min) — probe more often than v3's 10 min
+# so we don't miss half a window, and KEEP watching after a session completes
+# (more windows -> more sweep coverage; chip_session skips nothing on rerun).
 cd /root/repo
 PROBE=/tmp/probe_tpu.py
 LOG=/root/repo/.perf/watcher.log
-echo "watcher v3 start $(date -u +%FT%TZ)" >> $LOG
+echo "watcher v4 start $(date -u +%FT%TZ)" >> $LOG
 N=0
 while true; do
   N=$((N+1))
@@ -11,9 +14,9 @@ while true; do
     echo "PROBE OK #$N $(date -u +%FT%TZ)" >> $LOG
     touch /root/repo/.perf/TPU_UP
     bash /root/repo/.perf/chip_session.sh
-    break
+    echo "session over; resuming watch $(date -u +%FT%TZ)" >> $LOG
   else
     echo "probe fail #$N $(date -u +%FT%TZ)" >> $LOG
   fi
-  sleep 600
+  sleep 300
 done
