@@ -7,6 +7,7 @@ identifies its stage):
   2. 4-layer llama fused step, attn_impl=xla
   3. 4-layer llama fused step, attn_impl=flash (auto on chip)
   4. 24-layer (bench config) fused step, flash
+  5. 24-layer fused step, flash, scan_layers (one compiled layer body)
 """
 import os
 import sys
@@ -53,10 +54,11 @@ def main():
     from deepspeed_tpu.models import init_llama
     from bench import bench_config
 
-    def fused(nlayers, attn_impl, tag, batch=8):
+    def fused(nlayers, attn_impl, tag, batch=8, scan=False):
         t = time.time()
         # the bench's own config (single source of truth) at reduced depth
-        cfg = bench_config(num_hidden_layers=nlayers, attn_impl=attn_impl)
+        cfg = bench_config(num_hidden_layers=nlayers, attn_impl=attn_impl,
+                           scan_layers=scan)
         model, params = init_llama(cfg)
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, model_parameters=params,
@@ -77,13 +79,17 @@ def main():
         stamp(f"{tag}: 3 steps in {time.time()-t:.2f}s "
               f"({3*batch*1024/(time.time()-t):.0f} tok/s)")
 
-    which = set(sys.argv[1:]) or {"2", "3", "4"}
+    which = set(sys.argv[1:]) or {"2", "3", "4", "5"}
     if "2" in which:
         fused(4, "xla", "stage2 4L-xla")
     if "3" in which:
         fused(4, "auto", "stage3 4L-flash")
     if "4" in which:
         fused(24, "auto", "stage4 24L-flash(bench cfg)")
+    if "5" in which:
+        # scanned stack: one layer body to compile instead of 24 — if stage4
+        # is compile-bound over the relay, this is the escape hatch
+        fused(24, "auto", "stage5 24L-flash-scan", scan=True)
     stamp("triage complete")
 
 

@@ -60,11 +60,14 @@ def bench_config(remat=False, **overrides):
     return LlamaConfig(**kw)
 
 
-def _measure_config(batch, seq, iters, remat):
+def _measure_config(batch, seq, iters, remat, scan=False):
     """One measurement at a given batch/remat setting; raises on OOM so the
     caller can fall back to a smaller footprint. ``remat`` is False, True
     (full recompute) or a jax.checkpoint_policies name (selective remat —
-    bigger batches without full-remat's recompute tax)."""
+    bigger batches without full-remat's recompute tax). ``scan`` compiles
+    the 24 layers as one nn.scan body (numerics-identical, tested) — ~10x
+    less HLO to compile, which matters when the relay window is shorter
+    than the unrolled compile."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -72,7 +75,7 @@ def _measure_config(batch, seq, iters, remat):
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
     platform = jax.devices()[0].platform
-    cfg = bench_config(remat)
+    cfg = bench_config(remat, scan_layers=scan)
     if platform == "cpu":
         # diagnostic-fallback sizing: same model family, tractable on host
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
@@ -134,7 +137,8 @@ def _measure_config(batch, seq, iters, remat):
         mfu_ratio = round(mfu / 0.54, 4)
         unit = (f"tokens/s (0.4B llama, bf16, fused step, "
                 f"bs{batch}xseq{seq}"
-                f"{', remat=' + str(remat) if remat else ''})")
+                f"{', remat=' + str(remat) if remat else ''}"
+                f"{', scan_layers' if scan else ''})")
     return {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -186,6 +190,19 @@ def breakdown(batch=8, seq=1024, iters=10):
         (sync or _sync)()
         return (time.time() - t0) / n, out
 
+    def timed(build, n=iters):
+        """Time `build()` (returns a device pytree): compile+warm, then n
+        timed calls ended by a host readback — the ONE place the
+        relay-early-return barrier idiom lives (see _measure_config)."""
+        box = [None]
+        def sync():
+            jax.block_until_ready(box[0])
+            float(np.asarray(jax.tree_util.tree_leaves(box[0])[0]).ravel()[0])
+        def run():
+            box[0] = build()
+            return box[0]
+        return timeit(run, sync=sync, n=n)
+
     report = {}
     # dispatch sanity: every previous chip bench silently ran the XLA
     # fallbacks because the axon platform string is not "tpu" — make the
@@ -198,14 +215,8 @@ def breakdown(batch=8, seq=1024, iters=10):
 
     # forward-only (loss program, no bwd/opt) via the engine's compiled fn
     try:
-        fwd_out = [None]
-        def fsync():
-            jax.block_until_ready(fwd_out[0])
-            float(np.asarray(jax.tree_util.tree_leaves(fwd_out[0])[0]).ravel()[0])
-        def frun():
-            fwd_out[0] = engine._fwd_only(engine.params, (ids, ), {"labels": ids}, ())
-            return fwd_out[0]
-        t_fwd, _ = timeit(frun, sync=fsync)
+        t_fwd, _ = timed(lambda: engine._fwd_only(
+            engine.params, (ids, ), {"labels": ids}, ()))
         report["forward_ms"] = round(t_fwd * 1e3, 2)
     except Exception as e:  # noqa: BLE001
         report["forward_ms"] = f"n/a ({str(e)[:80]})"
@@ -219,17 +230,71 @@ def breakdown(batch=8, seq=1024, iters=10):
     xl = jax.jit(lambda q: _xla_attention(q, q, q, 1.0 / np.sqrt(hd), True))
     for name, fn in (("flash_attn_ms", fl), ("xla_attn_ms", xl)):
         try:
-            out = [None]
-            def asyncd():
-                jax.block_until_ready(out[0])
-                float(np.asarray(out[0]).ravel()[0])
-            def arun():
-                out[0] = fn(q)
-                return out[0]
-            t, _ = timeit(arun, sync=asyncd, n=20)
+            t, _ = timed(lambda fn=fn: fn(q), n=20)
             report[name] = round(t * 1e3, 3)
         except Exception as e:  # noqa: BLE001
             report[name] = f"n/a ({str(e)[:80]})"
+
+    # MXU peak calibration: what TFLOP/s can THIS chip over THIS relay
+    # actually sustain on a pure big-matmul chain? The fused-step gap
+    # attribution needs this anchor — if the probe itself lands well under
+    # 197 TF/s, the ceiling is the chip/relay, not our program.
+    try:
+        M, K = (8192, 1024) if report["on_tpu"] else (256, 128)
+        w = jax.device_put(jnp.asarray(
+            rng.standard_normal((K, K)) / np.sqrt(K), jnp.bfloat16))
+        y0 = jax.device_put(jnp.asarray(
+            rng.standard_normal((M, K)), jnp.bfloat16))
+        CHAIN = 64
+
+        @jax.jit
+        def matmul_chain(y, w):
+            return jax.lax.scan(lambda c, _: (c @ w, None), y,
+                                None, length=CHAIN)[0]
+        t, _ = timed(lambda: matmul_chain(y0, w), n=10)
+        report["mxu_peak_probe_tflops"] = round(
+            2 * M * K * K * CHAIN / t / 1e12, 1)
+    except Exception as e:  # noqa: BLE001
+        report["mxu_peak_probe_tflops"] = f"n/a ({str(e)[:80]})"
+
+    # FFN fwd+bwd micro-bench: the non-attention half of the layer under
+    # XLA fusion alone (no Pallas). If this sustains near-probe TFLOP/s the
+    # reference's fused-training-block kernel has nothing left to win here
+    # and the remaining fused-step gap lives in scheduling/attention.
+    try:
+        T, H, I = batch * seq, cfg.hidden_size, cfg.intermediate_size
+        xf = jax.device_put(jnp.asarray(
+            rng.standard_normal((T, H)), jnp.bfloat16))
+        w1 = jax.device_put(jnp.asarray(
+            rng.standard_normal((H, I)) / np.sqrt(H), jnp.bfloat16))
+        w3 = jax.device_put(jnp.asarray(
+            rng.standard_normal((H, I)) / np.sqrt(H), jnp.bfloat16))
+        w2 = jax.device_put(jnp.asarray(
+            rng.standard_normal((I, H)) / np.sqrt(I), jnp.bfloat16))
+
+        def ffn_loss(x, w1, w3, w2):
+            h = jax.nn.silu(x @ w1) * (x @ w3)
+            return ((h @ w2).astype(jnp.float32) ** 2).mean()
+        # grad wrt x AND weights so the executed FLOPs are the full
+        # 18*T*H*I backward (weight-only grads would let XLA drop the two
+        # dx matmuls and overstate TFLOP/s by ~29%)
+        ffn_grad = jax.jit(jax.grad(ffn_loss, argnums=(0, 1, 2, 3)))
+        t, _ = timed(lambda: ffn_grad(xf, w1, w3, w2), n=10)
+        report["ffn_fwdbwd_ms"] = round(t * 1e3, 3)
+        report["ffn_fwdbwd_tflops"] = round(18 * T * H * I / t / 1e12, 1)
+    except Exception as e:  # noqa: BLE001
+        report["ffn_fwdbwd_tflops"] = f"n/a ({str(e)[:80]})"
+
+    # flash fwd+bwd (the in-step reality is grad-of-attention, not fwd-only)
+    try:
+        def attn_loss(q):
+            return (flash_attention(q, q, q, causal=True)
+                    .astype(jnp.float32) ** 2).mean()
+        fb = jax.jit(jax.grad(attn_loss))
+        t, _ = timed(lambda: fb(q), n=10)
+        report["flash_fwdbwd_ms"] = round(t * 1e3, 3)
+    except Exception as e:  # noqa: BLE001
+        report["flash_fwdbwd_ms"] = f"n/a ({str(e)[:80]})"
 
     # exact compiled FLOPs of the fused step (XLA cost analysis)
     try:
@@ -258,15 +323,19 @@ def measure():
     # when it fits, bs8 no-remat is the expected landing spot)
     attempts = [(16, 1024, 20, False), (16, 1024, 20, "dots_saveable"),
                 (8, 1024, 20, False), (4, 1024, 10, True)]
+    scan = bool(os.environ.get("DS_BENCH_SCAN"))
     if os.environ.get("DS_BENCH_FAST"):
         # relay windows are short (~10 min observed) and every OOM fallback
         # costs a full compile — go straight to the footprint that is known
-        # to fit so ONE compile lands a real number inside the window
+        # to fit, with the layer stack scanned (one layer body to compile
+        # instead of 24 inlined copies), so ONE fast compile lands a real
+        # number inside the window
         attempts = [(8, 1024, 12, False)]
+        scan = True
     last_err = None
     for batch, seq, iters, remat in attempts:
         try:
-            out = _measure_config(batch, seq, iters, remat)
+            out = _measure_config(batch, seq, iters, remat, scan=scan)
             print(json.dumps(out), flush=True)
             return
         except Exception as e:  # noqa: BLE001 — RESOURCE_EXHAUSTED etc.
