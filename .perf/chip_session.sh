@@ -29,9 +29,14 @@ run triage 1200 python .perf/triage_compile.py 2 3
 run bench 2400 python bench.py
 # 5. where-the-time-goes (drives the MFU iteration)
 run bench_breakdown 1800 python bench.py --breakdown
-# 6. serving decode (writes BENCH_SERVING.json at repo root)
+# 6. serving decode, fast first (paged @1k ctx, 2-3 compiles) then the
+# full sweep (writes BENCH_SERVING.json at repo root, incrementally)
+run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
 run bench_serving 2400 python bench_serving.py
-[ -f BENCH_SERVING.json ] && cp BENCH_SERVING.json "$P/BENCH_SERVING_${SFX}.json"
+for f in BENCH_SERVING.json BENCH_SERVING_FAST.json \
+         BENCH_SERVING.json.partial BENCH_SERVING_FAST.json.partial; do
+  [ -f "$f" ] && cp "$f" "$P/${f/.json/_${SFX}.json}"
+done
 # 7. NVMe bandwidth (GDS-analog evidence)
 run nvme 1200 python bin/ds_nvme_bench --o_direct
 # 8. driver-entry compile check on the real chip (the driver only runs it
