@@ -9,6 +9,7 @@ P=/root/repo/.perf
 LOG=$P/watcher.log
 SFX=$(date -u +%m%dT%H%M)
 echo "CHIP SESSION $SFX start $(date -u +%FT%TZ)" >> $LOG
+touch "$P/.session_start"  # mtime marker: snapshot only THIS session's files
 
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
@@ -30,12 +31,14 @@ run bench 2400 python bench.py
 # 5. where-the-time-goes (drives the MFU iteration)
 run bench_breakdown 1800 python bench.py --breakdown
 # 6. serving decode, fast first (paged @1k ctx, 2-3 compiles) then the
-# full sweep (writes BENCH_SERVING.json at repo root, incrementally)
+# full sweep (writes BENCH_SERVING.json at repo root, incrementally).
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
 run bench_serving 2400 python bench_serving.py
+# snapshot only files actually (re)written THIS session — stale evidence
+# from an earlier run must not get restamped with a new session id
 for f in BENCH_SERVING.json BENCH_SERVING_FAST.json \
          BENCH_SERVING.json.partial BENCH_SERVING_FAST.json.partial; do
-  [ -f "$f" ] && cp "$f" "$P/${f/.json/_${SFX}.json}"
+  [ -f "$f" ] && [ "$f" -nt "$P/.session_start" ] && cp "$f" "$P/${f/.json/_${SFX}.json}"
 done
 # 7. NVMe bandwidth (GDS-analog evidence)
 run nvme 1200 python bin/ds_nvme_bench --o_direct

@@ -18,5 +18,7 @@ while true; do
   else
     echo "probe fail #$N $(date -u +%FT%TZ)" >> $LOG
   fi
-  sleep 300
+  # a DOWN-relay probe already burns ~2.5 min hanging to its timeout; keep
+  # the added sleep short so the full cycle stays ~4.5 min (windows are ~10)
+  sleep 120
 done

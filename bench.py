@@ -22,6 +22,12 @@ import subprocess
 import sys
 import time
 
+def env_flag(name: str) -> bool:
+    """Conventional env bool: unset/empty/'0' are off (raw truthiness would
+    read DS_BENCH_FAST=0 as ON)."""
+    return os.environ.get(name, "") not in ("", "0")
+
+
 ATTEMPTS = 4
 BACKOFFS = [60, 300, 600]
 # first TPU compile can take minutes on a cold relay, and the OOM-fallback
@@ -323,8 +329,8 @@ def measure():
     # when it fits, bs8 no-remat is the expected landing spot)
     attempts = [(16, 1024, 20, False), (16, 1024, 20, "dots_saveable"),
                 (8, 1024, 20, False), (4, 1024, 10, True)]
-    scan = bool(os.environ.get("DS_BENCH_SCAN"))
-    if os.environ.get("DS_BENCH_FAST"):
+    scan = env_flag("DS_BENCH_SCAN")
+    if env_flag("DS_BENCH_FAST"):
         # relay windows are short (~10 min observed) and every OOM fallback
         # costs a full compile — go straight to the footprint that is known
         # to fit, with the layer stack scanned (one layer body to compile

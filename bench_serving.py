@@ -42,8 +42,9 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
         backends = ["dense"]
         decode_steps = 16
         kv_block = 64
+    from bench import env_flag
     batch_sizes = [8, 32] if on_tpu else [4]
-    if on_tpu and os.environ.get("DS_BENCH_FAST"):
+    if on_tpu and env_flag("DS_BENCH_FAST"):
         # short relay window: one context, paged only, one batched shape —
         # two or three compiles total instead of a dozen
         contexts = [1024]
@@ -63,10 +64,11 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                     max_ragged_batch_size=chunk,  # prefill chunks must fit
                 ),
                 # enough blocks for the long single-sequence sweep AND the
-                # 32-way concurrent-decode measurement at contexts[0]
+                # widest concurrent-decode measurement at contexts[0]
                 num_kv_blocks=max(
                     (max_ctx // kv_block) + 8,
-                    32 * ((contexts[0] + decode_steps) // kv_block + 2))),
+                    max(batch_sizes)
+                    * ((contexts[0] + decode_steps) // kv_block + 2))),
             kv_block_size=kv_block)
         model = eng.model()
         assert isinstance(model, RaggedLlamaModel)

@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -159,17 +160,28 @@ private:
             size_t chunk = std::min(block_size_, req.nbytes - done);
             ssize_t n;
             if (req.is_read) {
-                if (direct) {
+                char* dst = req.buf + done;
+                bool dst_aligned =
+                    (reinterpret_cast<uintptr_t>(dst) % kAlign) == 0 &&
+                    align_up(chunk) == chunk &&
+                    ((req.offset + done) % kAlign) == 0;
+                if (direct && dst_aligned) {
+                    // destination satisfies O_DIRECT alignment: read straight
+                    // into it — no bounce copy on the hot NVMe->HBM feed path
+                    // (callers allocate 4096-aligned buffers for exactly this;
+                    // the bounce branch below is the unaligned fallback)
+                    n = pread(fd, dst, chunk, req.offset + done);
+                } else if (direct) {
                     // aligned read through the bounce buffer, then copy out
                     size_t aligned = align_up(chunk);
                     n = pread(fd, bounce, aligned, req.offset + done);
                     if (n > 0) {
                         size_t usable = std::min(static_cast<size_t>(n), chunk);
-                        memcpy(req.buf + done, bounce, usable);
+                        memcpy(dst, bounce, usable);
                         n = usable;
                     }
                 } else {
-                    n = pread(fd, req.buf + done, chunk, req.offset + done);
+                    n = pread(fd, dst, chunk, req.offset + done);
                 }
             } else {
                 if (direct && align_up(chunk) == chunk &&

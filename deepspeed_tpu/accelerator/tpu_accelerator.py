@@ -99,14 +99,11 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     # ---- pinned host memory (AIO staging) ----
     def pin_memory(self, tensor, align_bytes=4096):
         """Page-aligned host copy (what O_DIRECT AIO wants)."""
+        from ..ops.aio import aligned_empty  # one owner of the alignment trick
         arr = np.asarray(tensor)
-        nbytes = arr.nbytes
-        buf = np.empty(nbytes + align_bytes, dtype=np.uint8)
-        offset = (-buf.ctypes.data) % align_bytes
-        aligned = buf[offset:offset + nbytes].view(arr.dtype).reshape(
-            arr.shape).view(_PinnedArray)
+        aligned = aligned_empty(arr.nbytes, align_bytes).view(
+            arr.dtype).reshape(arr.shape).view(_PinnedArray)
         aligned[...] = arr
-        aligned._ds_pinned_base = buf  # keeps the backing allocation alive
         return aligned
 
     def is_pinned(self, tensor):

@@ -66,6 +66,19 @@ def aio_available() -> bool:
     return _jit_load() is not None
 
 
+def aligned_empty(nbytes: int, align: int = 4096) -> np.ndarray:
+    """Uninitialized uint8 buffer whose data pointer is `align`-aligned
+    (reference csrc/aio pins page-aligned bounce buffers for O_DIRECT).
+    A 4096-aligned destination lets the native lib pread STRAIGHT into it
+    under O_DIRECT instead of bouncing+memcpying every block. The returned
+    array is a view into a slightly larger allocation; its ``.base`` keeps
+    the backing alive, so ownership transfers (e.g. to jax.device_put)
+    work as with a plain np.empty."""
+    raw = np.empty(nbytes + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + nbytes]
+
+
 class AsyncIOHandle:
     """Submission handle (reference csrc/aio/py_lib/deepspeed_py_io_handle.cpp
     semantics: submit read/write of a host buffer, wait on completion).
@@ -160,6 +173,41 @@ class AsyncIOHandle:
     # sync conveniences (reference sync_pread/sync_pwrite)
     def pread(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
         return self.wait(self.submit_read(path, buffer, offset))
+
+    def pread_striped(self, path: str, buffer: np.ndarray, offset: int = 0,
+                      stripes: Optional[int] = None) -> int:
+        """Parallel pread: split the range into `stripes` aligned sub-ranges
+        (default: one per pool thread) and fan them out. One Request is
+        executed serially by ONE worker (reference deepspeed_aio_thread.cpp
+        semantics), so a single big pread leaves thread_count-1 workers
+        idle — striping is what actually engages the pool for bulk loads."""
+        # assert on the CALLER's buffer: reshape(-1) of a non-contiguous view
+        # would copy, the stripes would land in the copy, and the caller's
+        # buffer would silently hold garbage
+        assert buffer.flags["C_CONTIGUOUS"] and buffer.flags["WRITEABLE"]
+        n = int(buffer.nbytes)
+        k = max(1, min(stripes or self.thread_count, n // (1 << 20) or 1))
+        if k == 1:
+            return self.pread(path, buffer, offset)
+        # stripe boundaries stay 4096-multiples so O_DIRECT offsets (and
+        # aligned-destination preads) hold on every stripe
+        per = -(-n // k)
+        per += (-per) % 4096
+        flat = buffer.reshape(-1).view(np.uint8)
+        rids = []
+        for s in range(0, n, per):
+            e = min(s + per, n)
+            rids.append(self.submit_read(path, flat[s:e], offset + s))
+        total = 0
+        err = None
+        for rid in rids:
+            try:
+                total += self.wait(rid)
+            except OSError as ex:  # drain every stripe before raising
+                err = err or ex
+        if err is not None:
+            raise err
+        return total
 
     def pwrite(self, path: str, buffer: np.ndarray, offset: int = 0) -> int:
         return self.wait(self.submit_write(path, buffer, offset))

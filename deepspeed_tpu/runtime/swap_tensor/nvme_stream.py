@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...ops.aio import AsyncIOHandle
+from ...ops.aio import AsyncIOHandle, aligned_empty
 from .aio_config import AioConfig
 
 DEFAULT_CHUNK = 64 << 20  # 64 MiB: big enough to saturate, small enough to ring
@@ -40,8 +40,9 @@ class NvmeToHbmStreamer:
                                  thread_count=cfg.thread_count,
                                  use_o_direct=use_o_direct)
         self.chunk_bytes = int(chunk_bytes)
-        # reusable host staging ring (≙ the reference's pinned bounce buffers)
-        self._ring = [np.empty(self.chunk_bytes, np.uint8)
+        # reusable host staging ring (≙ the reference's pinned bounce
+        # buffers); 4096-aligned so O_DIRECT preads land straight in them
+        self._ring = [aligned_empty(self.chunk_bytes)
                       for _ in range(max(2, num_buffers))]
         # XLA's CPU backend zero-copy-aliases numpy inputs — reusing the ring
         # would corrupt "device" chunks there; TPU device_put always copies
@@ -72,9 +73,12 @@ class NvmeToHbmStreamer:
             # effectively free).
             # fresh per-call buffer: XLA zero-copy-aliases numpy inputs on
             # this backend, so the buffer handed to device_put must never be
-            # reused — ownership transfers to the returned array
-            buf = np.empty(nbytes, np.uint8)
-            got = self.aio.pread(path, buf)
+            # reused — ownership transfers to the returned array (the view's
+            # .base keeps the aligned backing alive). Striped pread: one
+            # Request is served serially by one worker, so the fan-out is
+            # what actually engages the thread pool on this bulk load.
+            buf = aligned_empty(nbytes)
+            got = self.aio.pread_striped(path, buf)
             if got != nbytes:
                 raise IOError(f"short read from {path}: wanted {nbytes}, got {got}")
             arr = jax.device_put(buf.view(np.dtype(dtype)).reshape(shape))
@@ -151,7 +155,7 @@ class NvmeToHbmStreamer:
             host = range_cache.get((start, stop))
             if host is None:
                 n = (stop - start) * row_bytes
-                host = np.empty(n, np.uint8)
+                host = aligned_empty(n)
                 # pipelined: chunk i+1's read flies while chunk i memcpys out
                 # of the AIO ring into the shard buffer
                 n_chunks = max(1, (n + self.chunk_bytes - 1) // self.chunk_bytes)

@@ -105,3 +105,49 @@ def test_short_read_raises(tmp_path):
     with pytest.raises(IOError, match="short read"):
         s.read_to_device(str(path), 4096, jnp.uint8, (4096, ))
     s.close()
+
+
+def test_aligned_empty_alignment_and_ownership():
+    from deepspeed_tpu.ops.aio import aligned_empty
+    for n in (1, 4095, 4096, 1 << 20):
+        buf = aligned_empty(n)
+        assert buf.nbytes == n
+        assert buf.ctypes.data % 4096 == 0
+        assert buf.base is not None  # view keeps the backing allocation alive
+        buf[:] = 7  # writable end to end
+        assert int(buf[-1]) == 7
+
+
+def test_pread_striped_matches_serial(tmp_path):
+    """Striping fans a bulk read across the pool; bytes must be identical to
+    one serial pread for aligned and odd sizes, with and without offset."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle, aligned_empty
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=9 << 20, dtype=np.uint8)  # 9 MiB: odd
+    path = tmp_path / "stripe.bin"
+    path.write_bytes(data.tobytes())
+    h = AsyncIOHandle(thread_count=4)
+    try:
+        for off, n in ((0, data.nbytes), (4096, 5 << 20), (12345, 3 << 20)):
+            want = data[off:off + n]
+            serial = np.empty(n, np.uint8)
+            assert h.pread(str(path), serial, offset=off) == n
+            striped = aligned_empty(n)
+            assert h.pread_striped(str(path), striped, offset=off) == n
+            np.testing.assert_array_equal(striped, want)
+            np.testing.assert_array_equal(serial, want)
+    finally:
+        h.close()
+
+
+def test_pread_striped_truncated_file_reports_short(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle, aligned_empty
+    path = tmp_path / "trunc.bin"
+    path.write_bytes(b"\x01" * (2 << 20))  # 2 MiB file
+    h = AsyncIOHandle(thread_count=4)
+    try:
+        buf = aligned_empty(8 << 20)  # ask for 8 MiB
+        got = h.pread_striped(str(path), buf)
+        assert got < buf.nbytes  # caller (read_to_device) raises on mismatch
+    finally:
+        h.close()
