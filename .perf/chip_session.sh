@@ -28,7 +28,9 @@ run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
 run triage 1200 python .perf/triage_compile.py 2 3
 # 4. headline train number (ladder: bs16 -> bs16+dots -> bs8 -> bs4)
 run bench 2400 python bench.py
-# 5. where-the-time-goes (drives the MFU iteration)
+# 5. where-the-time-goes (drives the MFU iteration); scanned first (fast
+# compile, matches bench_fast's program), then the unrolled ladder program
+run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
 run bench_breakdown 1800 python bench.py --breakdown
 # 6. serving decode, fast first (paged @1k ctx, 2-3 compiles) then the
 # full sweep (writes BENCH_SERVING.json at repo root, incrementally).

@@ -164,8 +164,10 @@ def breakdown(batch=8, seq=1024, iters=10):
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
     # same config object as measure() (incl. chunked CE) so the breakdown
-    # explains the bench's fused step, not a different program
-    cfg = bench_config(remat=False)
+    # explains the bench's fused step, not a different program;
+    # DS_BENCH_SCAN=1 matches the scanned fast-mode program when the
+    # unrolled 24-layer compile won't fit a relay window
+    cfg = bench_config(remat=False, scan_layers=env_flag("DS_BENCH_SCAN"))
     if jax.devices()[0].platform == "cpu":  # smoke-test sizing
         cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -216,6 +218,7 @@ def breakdown(batch=8, seq=1024, iters=10):
     from deepspeed_tpu.ops.registry import on_tpu, use_pallas
     report["on_tpu"] = bool(on_tpu())
     report["use_pallas"] = bool(use_pallas())
+    report["scan_layers"] = bool(cfg.scan_layers)
     t_step, _ = timeit(lambda: engine.fused_train_step(ids, labels=ids))
     report["fused_step_ms"] = round(t_step * 1e3, 2)
 
