@@ -26,7 +26,8 @@ run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
 # 3. cheap compile triage: 4-layer fused step, xla vs flash attention
 # (stage 4 == the full bench config, covered by the bench runs themselves)
 run triage 1200 python .perf/triage_compile.py 2 3
-# 4. headline train number (ladder: bs16 -> bs16+dots -> bs8 -> bs4)
+# 4. headline train number (anytime ladder: safe bs8 first, then bs16 /
+# bs16+dots try to beat it; last printed line = best completed rung)
 run bench 2400 python bench.py
 # 5. where-the-time-goes (drives the MFU iteration); scanned first (fast
 # compile, matches bench_fast's program), then the unrolled ladder program
