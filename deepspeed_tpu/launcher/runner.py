@@ -97,6 +97,13 @@ def build_commands(hosts: List[str], master_addr: str, master_port: int,
         env["JAX_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
         env["JAX_NUM_PROCESSES"] = str(len(hosts))
         env["JAX_PROCESS_ID"] = str(pid)
+        # reference launch.py exports these unconditionally and ported
+        # scripts (plus utils/logging, config) read them on every rank
+        env["RANK"] = str(pid)
+        env["LOCAL_RANK"] = "0"  # one process per host under SPMD
+        env["WORLD_SIZE"] = str(len(hosts))
+        env["MASTER_ADDR"] = master_addr
+        env["MASTER_PORT"] = str(master_port)
         remote = _remote_command(env, script, script_args)
         if host in ("localhost", "127.0.0.1"):
             # local processes exec directly, no ssh (also lets tests drive a
@@ -273,10 +280,22 @@ def main(argv=None):
         hosts = hosts[:args.num_nodes]
     master = args.master_addr or hosts[0]
 
-    if len(hosts) == 1 and not args.dry_run:
-        # single host: exec in place, no rendezvous env needed
+    if (len(hosts) == 1 and hosts[0] in ("localhost", "127.0.0.1")
+            and not args.dry_run):
+        # single LOCAL host: exec in place. No rendezvous happens, but
+        # scripts ported from the reference read RANK/WORLD_SIZE/MASTER_*
+        # even single-node (reference launch.py exports them
+        # unconditionally). A single REMOTE host falls through to the ssh
+        # fan-out below — exec'ing it here would run the script on the
+        # launch box instead.
+        env = dict(os.environ)
+        env.setdefault("RANK", "0")
+        env.setdefault("LOCAL_RANK", "0")
+        env.setdefault("WORLD_SIZE", "1")
+        env.setdefault("MASTER_ADDR", master)
+        env.setdefault("MASTER_PORT", str(args.master_port))
         os.execvpe(sys.executable, [sys.executable, args.script] + args.script_args,
-                   os.environ)
+                   env)
 
     if args.launcher != "ssh":
         runner = RUNNERS[args.launcher](hosts, master, args.master_port,

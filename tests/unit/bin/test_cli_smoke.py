@@ -12,10 +12,11 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
 
 
-def _run(args, timeout=240):
+def _run(args, timeout=240, extra_env=None):
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    env.update(extra_env or {})
     return subprocess.run([sys.executable, os.path.join(REPO, "bin", args[0])]
                           + args[1:], env=env, capture_output=True, text=True,
                           timeout=timeout)
@@ -52,3 +53,61 @@ def test_ds_bench_runs_collective_sweep():
     rows = [l.split() for l in r.stdout.splitlines()
             if l.strip() and l.split()[0].isdigit()]
     assert rows and all(float(r_[2]) > 0 for r_ in rows)
+
+
+def test_deepspeed_launcher_runs_local_script(tmp_path):
+    """bin/deepspeed single-node path: launches the script as a local process
+    with the rendezvous env set (reference bin/deepspeed semantics)."""
+    script = tmp_path / "train_stub.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: os.environ.get(k) for k in\n"
+        "      ('RANK', 'WORLD_SIZE', 'MASTER_ADDR')}))\n")
+    r = _run(["deepspeed", str(script)])
+    assert r.returncode == 0, r.stderr[-1500:]
+    envs = json.loads([l for l in r.stdout.splitlines() if l.startswith("{")][-1])
+    assert envs["RANK"] == "0" and envs["WORLD_SIZE"] == "1"
+    assert envs["MASTER_ADDR"]
+
+
+def test_deepspeed_launcher_dry_run_multinode(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-1 slots=1\nworker-2 slots=1\n")
+    r = _run(["deepspeed", "-H", str(hostfile), "--dry_run", "train.py"])
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "worker-1" in r.stdout and "worker-2" in r.stdout
+    assert "ssh" in r.stdout
+
+
+def test_ds_and_dsr_are_launcher_aliases():
+    for cli in ("ds", "dsr"):
+        r = _run([cli, "--help"])
+        assert r.returncode == 0 and "hostfile" in r.stdout.lower(), cli
+
+
+def test_ds_ssh_fans_out_with_stub_ssh(tmp_path):
+    """ds_ssh runs the command on every hostfile host; a PATH-stubbed ssh
+    records the invocations (no network in CI)."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("nodeA slots=1\nnodeB slots=1\n")
+    stub_dir = tmp_path / "stub"
+    stub_dir.mkdir()
+    log = tmp_path / "ssh.log"
+    stub = stub_dir / "ssh"
+    stub.write_text(f"#!/bin/sh\necho \"$@\" >> {log}\n")
+    stub.chmod(0o755)
+    r = _run(["ds_ssh", "-f", str(hostfile), "uptime"], timeout=120,
+             extra_env={"PATH": f"{stub_dir}:{os.environ.get('PATH', '')}"})
+    assert r.returncode == 0, r.stderr[-1500:]
+    logged = log.read_text()
+    assert "nodeA" in logged and "nodeB" in logged and "uptime" in logged
+
+
+def test_ds_nvme_bench_small_run(tmp_path):
+    r = _run(["ds_nvme_bench", "--size_gb", "0.01",
+              "--path", str(tmp_path / "scratch.bin"), "--iters", "1"])
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "nvme_to_hbm_read"
+    assert doc["pipelined_gbps"] > 0 and doc["serial_gbps"] > 0
