@@ -48,7 +48,10 @@ run nvme 1200 python bin/ds_nvme_bench --o_direct
 # 8. driver-entry compile check on the real chip (the driver only runs it
 # single-chip; prove it here while we have silicon)
 run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
-# 9. flash block sweep (two strongest candidates)
+# 9. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
+# flash + selective remat)
+run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
+# 10. flash block sweep (two strongest candidates)
 for B in "256,512" "512,512"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B python bench.py
 done

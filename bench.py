@@ -84,7 +84,8 @@ def _measure_config(batch, seq, iters, remat, scan=False):
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
     platform = jax.devices()[0].platform
-    cfg = bench_config(remat, scan_layers=scan)
+    cfg = bench_config(remat, scan_layers=scan,
+                       max_position_embeddings=max(2048, seq))
     if platform == "cpu":
         # diagnostic-fallback sizing: same model family, tractable on host
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
@@ -341,6 +342,14 @@ def measure():
                 (16, 1024, 20, "dots_saveable"),
                 (4, 1024, 10, True)]             # full-remat floor (r2 config)
     scan = env_flag("DS_BENCH_SCAN")
+    if env_flag("DS_BENCH_LONGSEQ"):
+        # the Ulysses bar (blogs/deepspeed-ulysses/README.md:82-83) is a
+        # LONG-SEQUENCE sustained-utilization number — measure the flash
+        # kernel's long-context regime: same model, 16k/32k tokens in one
+        # sequence, selective remat (full activations at 32k don't fit)
+        attempts = [(1, 16384, 8, "dots_saveable"),
+                    (1, 32768, 6, "dots_saveable"),
+                    (1, 16384, 8, True)]
     if env_flag("DS_BENCH_FAST"):
         # short relay window: one compile, scanned stack (one layer body
         # instead of 24 inlined copies)
@@ -371,7 +380,11 @@ def measure():
             import jax
             gc.collect()
             jax.clear_caches()
-        if best is None or out["value"] > best["value"]:
+        # rank rungs by MFU first (fair across different seq lengths — a
+        # 32k rung has more attention FLOPs per token, so raw tok/s would
+        # always pick the short sequence), tok/s as the CPU-mode tiebreak
+        if best is None or ((out["vs_baseline"], out["value"])
+                            > (best["vs_baseline"], best["value"])):
             best = out
             print(json.dumps(out), flush=True)
         if "DIAGNOSTIC" in out["unit"]:

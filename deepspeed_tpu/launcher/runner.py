@@ -69,6 +69,19 @@ def filter_resources(resources: "OrderedDict[str, int]", include: str = "",
     return out
 
 
+def _is_local_host(host: str) -> bool:
+    """True when `host` is this machine (reference runner.py treats the
+    one-line hostfile naming the local node as a local launch, not
+    ssh-to-self)."""
+    import socket
+    if host in ("localhost", "127.0.0.1", "::1"):
+        return True
+    try:
+        return host in (socket.gethostname(), socket.getfqdn())
+    except OSError:  # hostname lookup failure: treat as remote
+        return False
+
+
 def _export_env(extra: List[str]) -> Dict[str, str]:
     env = {k: v for k, v in os.environ.items() if k.startswith(EXPORT_PREFIXES)}
     for name in extra:
@@ -280,20 +293,21 @@ def main(argv=None):
         hosts = hosts[:args.num_nodes]
     master = args.master_addr or hosts[0]
 
-    if (len(hosts) == 1 and hosts[0] in ("localhost", "127.0.0.1")
-            and not args.dry_run):
-        # single LOCAL host: exec in place. No rendezvous happens, but
-        # scripts ported from the reference read RANK/WORLD_SIZE/MASTER_*
-        # even single-node (reference launch.py exports them
-        # unconditionally). A single REMOTE host falls through to the ssh
-        # fan-out below — exec'ing it here would run the script on the
-        # launch box instead.
+    if len(hosts) == 1 and _is_local_host(hosts[0]) and not args.dry_run:
+        # single LOCAL host (localhost or this machine's own hostname — the
+        # common one-line DLTS hostfile): exec in place with the FULL
+        # environment. Scripts ported from the reference read
+        # RANK/WORLD_SIZE/MASTER_* even single-node, and the reference
+        # exports them unconditionally — stale values from a previous
+        # multi-node shell must not leak through. A single REMOTE host
+        # falls through to the ssh fan-out below — exec'ing it here would
+        # run the script on the launch box instead.
         env = dict(os.environ)
-        env.setdefault("RANK", "0")
-        env.setdefault("LOCAL_RANK", "0")
-        env.setdefault("WORLD_SIZE", "1")
-        env.setdefault("MASTER_ADDR", master)
-        env.setdefault("MASTER_PORT", str(args.master_port))
+        env["RANK"] = "0"
+        env["LOCAL_RANK"] = "0"
+        env["WORLD_SIZE"] = "1"
+        env["MASTER_ADDR"] = master
+        env["MASTER_PORT"] = str(args.master_port)
         os.execvpe(sys.executable, [sys.executable, args.script] + args.script_args,
                    env)
 
@@ -314,7 +328,8 @@ def main(argv=None):
     procs = [subprocess.Popen(c) for c in cmds]
     rc = 0
     for p in procs:
-        rc = rc or p.wait()
+        r = p.wait()  # wait EVERY rank; `rc or p.wait()` would orphan the rest
+        rc = rc or r
     return rc
 
 

@@ -63,7 +63,9 @@ def test_deepspeed_launcher_runs_local_script(tmp_path):
         "import os, json\n"
         "print(json.dumps({k: os.environ.get(k) for k in\n"
         "      ('RANK', 'WORLD_SIZE', 'MASTER_ADDR')}))\n")
-    r = _run(["deepspeed", str(script)])
+    hostfile = tmp_path / "hostfile"  # hermetic: never read /job/hostfile
+    hostfile.write_text("localhost slots=1\n")
+    r = _run(["deepspeed", "-H", str(hostfile), str(script)])
     assert r.returncode == 0, r.stderr[-1500:]
     envs = json.loads([l for l in r.stdout.splitlines() if l.startswith("{")][-1])
     assert envs["RANK"] == "0" and envs["WORLD_SIZE"] == "1"
@@ -111,3 +113,20 @@ def test_ds_nvme_bench_small_run(tmp_path):
     doc = json.loads(line)
     assert doc["metric"] == "nvme_to_hbm_read"
     assert doc["pipelined_gbps"] > 0 and doc["serial_gbps"] > 0
+
+
+def test_launcher_own_hostname_is_local_and_env_unconditional(tmp_path):
+    """A one-line hostfile naming THIS machine execs locally (no ssh-to-self),
+    and stale RANK/WORLD_SIZE from the calling shell are overwritten."""
+    import socket
+    script = tmp_path / "stub.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps([os.environ['RANK'], os.environ['WORLD_SIZE']]))\n")
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(f"{socket.gethostname()} slots=1\n")
+    r = _run(["deepspeed", "-H", str(hostfile), str(script)],
+             extra_env={"RANK": "2", "WORLD_SIZE": "4"})  # stale shell env
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("[")][-1]
+    assert json.loads(line) == ["0", "1"]
