@@ -118,10 +118,11 @@ def build_commands(hosts: List[str], master_addr: str, master_port: int,
         env["MASTER_ADDR"] = master_addr
         env["MASTER_PORT"] = str(master_port)
         remote = _remote_command(env, script, script_args)
-        if host in ("localhost", "127.0.0.1"):
+        if _is_local_host(host):
             # local processes exec directly, no ssh (also lets tests drive a
             # real 2-process rendezvous by calling build_commands with
-            # repeated localhost entries)
+            # repeated localhost entries); same predicate as main()'s
+            # single-host gate so dry-run output matches real behavior
             cmds.append(["bash", "-c", remote])
         else:
             cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
@@ -308,6 +309,12 @@ def main(argv=None):
         env["WORLD_SIZE"] = "1"
         env["MASTER_ADDR"] = master
         env["MASTER_PORT"] = str(args.master_port)
+        for stale in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                      "JAX_PROCESS_ID", "DS_HOSTLIST"):
+            # rendezvous discovery (comm.mpi_discovery) honors these FIRST;
+            # leftovers from a previous multi-node shell would make
+            # init_distributed wait forever for ranks we never launch
+            env.pop(stale, None)
         os.execvpe(sys.executable, [sys.executable, args.script] + args.script_args,
                    env)
 

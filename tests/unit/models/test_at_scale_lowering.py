@@ -1,0 +1,84 @@
+"""Abstract-shape lowering at REAL model scale.
+
+The CPU mesh can execute only toy sizes, but tracing + SPMD partitioning at
+Llama-3-8B/70B dimensions (BASELINE.md target configs 2 and 5) costs no
+array memory: params are ShapeDtypeStructs, the fused fwd+bwd+adam step is
+``jit(...).lower()``-ed (not compiled/run) over an 8-device ZeRO-3 mesh.
+This is the class of bug interpret-mode toys can't catch — a sharding rule
+that divides 4096 but not 28672, a chunked-CE reshape that breaks at 128256
+vocab, GQA head-replication math at 64q/8kv — caught without a pod.
+(Reference analog: unit configs in tests/unit/runtime/zero; ours must also
+prove the 70B construction the reference runs on 128 GPUs.)
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from deepspeed_tpu.comm import MeshContext, reset_mesh_context, set_mesh_context
+from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.runtime.zero_sharding import ZeroShardingPlan
+
+
+def _abstract_params(cfg: LlamaConfig, seq: int = 8):
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((1, seq), dtype=jnp.int32)
+    shapes = jax.eval_shape(
+        functools.partial(model.init, jax.random.PRNGKey(0)), ids)
+    from deepspeed_tpu.models.llama import unbox_params
+    return model, unbox_params(shapes["params"])
+
+
+@pytest.mark.parametrize("cfg_name,mesh_axes", [
+    ("llama3_8b", {"fsdp": 8}),                 # BASELINE target 2: ZeRO-3
+    ("llama3_70b", {"fsdp": 4, "model": 2}),    # BASELINE target 5 shape
+])
+def test_fused_step_lowers_at_scale(cfg_name, mesh_axes):
+    reset_mesh_context()
+    ctx = MeshContext.create(axis_sizes=mesh_axes)
+    set_mesh_context(ctx)
+    cfg = getattr(LlamaConfig, cfg_name)(
+        remat=True, remat_policy="dots_saveable", ce_chunk_size=8016)
+    model, aparams = _abstract_params(cfg)
+
+    plan = ZeroShardingPlan(ctx, stage=3)
+    pshard = plan.param_shardings(aparams)
+    tx = optax.adamw(1e-4)
+    aopt = jax.eval_shape(tx.init, aparams)
+    oshard = plan.opt_state_shardings(aopt, aparams)
+
+    batch = 4
+    ids = jax.ShapeDtypeStruct((batch, 512), jnp.int32)
+
+    def step(params, opt_state, ids):
+        def loss_fn(p):
+            out = model.apply({"params": p}, ids, labels=ids)
+            return out[0] if isinstance(out, tuple) else out
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), new_opt
+
+    with ctx.mesh:
+        lowered = jax.jit(
+            step,
+            in_shardings=(pshard, oshard, plan.batch_sharding(ids)),
+            out_shardings=(None, pshard, oshard),
+        ).lower(aparams, aopt, ids)
+    # the StableHLO must exist and mention real collectives-to-be (sharding
+    # custom calls); lowering alone has already validated every sharding
+    # rule divides the real dims and the program traces at this scale
+    text = lowered.as_text()
+    assert "stablehlo" in text or "module" in text
+    import math
+    # python-int math: a stacked 80-layer scan leaf holds >2^31 elements,
+    # which silently overflows jnp's int32 prod on CPU
+    n_params = sum(math.prod(l.shape)
+                   for l in jax.tree_util.tree_leaves(aparams))
+    expected = {"llama3_8b": 8.0e9, "llama3_70b": 70.0e9}[cfg_name]
+    assert abs(n_params - expected) / expected < 0.02, (
+        f"{cfg_name} param count {n_params/1e9:.2f}B drifted from "
+        f"{expected/1e9:.0f}B — config no longer matches the checkpoint family")
+    reset_mesh_context()
