@@ -142,7 +142,8 @@ def _measure_config(batch, seq, iters, remat, scan=False):
         mfu_ratio = 0.0
         unit = f"tokens/s (DIAGNOSTIC cpu fallback, {n_params/1e6:.0f}M llama)"
     else:
-        peak = 197e12  # v5e bf16 peak ≈ 197 TFLOP/s/chip
+        from deepspeed_tpu.accelerator import get_accelerator
+        peak = get_accelerator().peak_bf16_flops()  # device_kind-aware
         mfu = achieved / peak
         mfu_ratio = round(mfu / 0.54, 4)
         unit = (f"tokens/s (0.4B llama, bf16, fused step, "
@@ -324,10 +325,13 @@ def breakdown(batch=8, seq=1024, iters=10):
     report["tokens_per_step"] = toks
     report["model_flops_per_step"] = 6 * n_params * toks \
         + 6 * cfg.num_hidden_layers * seq * cfg.num_attention_heads * hd * toks
+    from deepspeed_tpu.accelerator import get_accelerator
+    peak = get_accelerator().peak_bf16_flops()
+    report["peak_tflops_assumed"] = round(peak / 1e12, 1)
     if isinstance(report.get("xla_flops_per_step"), float) and t_step > 0:
         report["hw_flops_utilization"] = round(
-            report["xla_flops_per_step"] / t_step / 197e12, 4)
-        report["mfu"] = round(report["model_flops_per_step"] / t_step / 197e12, 4)
+            report["xla_flops_per_step"] / t_step / peak, 4)
+        report["mfu"] = round(report["model_flops_per_step"] / t_step / peak, 4)
     print(json.dumps(report), flush=True)
 
 

@@ -65,6 +65,13 @@ class DeepSpeedAccelerator(abc.ABC):
         return None
 
     # ---- dtype support ----
+    def peak_bf16_flops(self, device_index=None) -> float:
+        """Per-chip bf16 peak for MFU accounting. Default is v5e's figure;
+        accelerator flavors override with device_kind-aware values (see
+        TPU_Accelerator). Part of the public surface — bench/profiling
+        call this through get_accelerator()."""
+        return 197e12
+
     @abc.abstractmethod
     def is_bf16_supported(self): ...
 

@@ -85,6 +85,31 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     def memory_stats(self, device_index=None):
         return self._stats(device_index)
 
+    def peak_bf16_flops(self, device_index=None) -> float:
+        """Per-chip bf16 peak for MFU accounting, keyed on device_kind.
+        Published peaks: v4 275, v5e 197, v5p 459, v6e (Trillium) 918
+        TFLOP/s. MFU = achieved/peak, so over-claiming requires a peak
+        that is too SMALL — an unknown kind therefore falls back to the
+        LARGEST known peak (under-claims on slower chips, never inflates)
+        with a logged warning. Table order matters: 'v5 lite' must match
+        before the bare 'v5' (plain 'TPU v5' is how v5p can report)."""
+        from ..utils.logging import logger
+        dev = self._device(device_index)
+        if getattr(dev, "platform", "") not in ("tpu", "axon"):
+            # host-CPU diagnostic runs: no chip, no kind to key on — use the
+            # ABC default silently (the numbers are flagged DIAGNOSTIC anyway)
+            return super().peak_bf16_flops(device_index)
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+        table = {"v6": 918e12, "v5p": 459e12, "v5 lite": 197e12,
+                 "v5e": 197e12, "v5": 459e12, "v4": 275e12}
+        for key, peak in table.items():
+            if key in kind:
+                return peak
+        logger.warning(f"unknown TPU device_kind {kind!r}: assuming the "
+                       f"largest known peak (918 TF/s) so MFU is never "
+                       f"over-claimed")
+        return 918e12
+
     # ---- dtypes ----
     def is_bf16_supported(self):
         return True

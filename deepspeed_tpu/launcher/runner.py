@@ -309,9 +309,13 @@ def main(argv=None):
         env["WORLD_SIZE"] = "1"
         env["MASTER_ADDR"] = master
         env["MASTER_PORT"] = str(args.master_port)
-        for stale in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
-                      "JAX_PROCESS_ID", "DS_HOSTLIST"):
-            # rendezvous discovery (comm.mpi_discovery) honors these FIRST;
+        for stale in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                      "JAX_NUM_PROCESSES", "NUM_PROCESSES",
+                      "JAX_PROCESS_ID", "PROCESS_ID",
+                      "OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK",
+                      "DS_HOSTLIST"):
+            # every name comm.mpi_discovery resolves coord/size/rank from
+            # (comm.py:179-185 incl. the unprefixed and OMPI aliases);
             # leftovers from a previous multi-node shell would make
             # init_distributed wait forever for ranks we never launch
             env.pop(stale, None)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 import pytest
 
-from deepspeed_tpu.comm import MeshContext, reset_mesh_context, set_mesh_context
+from deepspeed_tpu.comm import MeshContext, set_mesh_context
 from deepspeed_tpu.models import LlamaConfig, LlamaForCausalLM
 from deepspeed_tpu.runtime.zero_sharding import ZeroShardingPlan
 
@@ -37,7 +37,7 @@ def _abstract_params(cfg: LlamaConfig, seq: int = 8):
     ("llama3_70b", {"fsdp": 4, "model": 2}),    # BASELINE target 5 shape
 ])
 def test_fused_step_lowers_at_scale(cfg_name, mesh_axes):
-    reset_mesh_context()
+    # conftest's autouse _reset_global_mesh resets around every test
     ctx = MeshContext.create(axis_sizes=mesh_axes)
     set_mesh_context(ctx)
     cfg = getattr(LlamaConfig, cfg_name)(
@@ -81,4 +81,3 @@ def test_fused_step_lowers_at_scale(cfg_name, mesh_axes):
     assert abs(n_params - expected) / expected < 0.02, (
         f"{cfg_name} param count {n_params/1e9:.2f}B drifted from "
         f"{expected/1e9:.0f}B — config no longer matches the checkpoint family")
-    reset_mesh_context()
