@@ -219,8 +219,15 @@ class FlopsProfiler:
         lines.append(f"bytes accessed:         {num_to_string(self._bytes)}B")
         lines.append(f"profiled duration:      {self.get_total_duration(True)}")
         if self._duration > 0:
-            lines.append(f"achieved throughput:    "
-                         f"{flops_to_string(self._flops / self._duration)}/s")
+            achieved = self._flops / self._duration
+            lines.append(f"achieved throughput:    {flops_to_string(achieved)}/s")
+            from ...accelerator import get_accelerator
+            try:
+                peak = get_accelerator().peak_bf16_flops()
+                lines.append(f"hw utilization:         {achieved / peak:.2%} "
+                             f"of {flops_to_string(peak)}/s peak")
+            except Exception:  # pragma: no cover — exotic accelerator
+                pass
         tree = None
         if detailed and self._params_tree is not None:
             tree = _build_tree(self._params_tree, batch_tokens)

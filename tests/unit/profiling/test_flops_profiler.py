@@ -70,3 +70,21 @@ def test_string_helpers():
     assert flops_to_string(2.5e9).startswith("2.5 G")
     assert params_to_string(1_500_000).startswith("1.5 M")
     assert duration_to_string(0.002).endswith("ms")
+
+
+def test_report_includes_hw_utilization():
+    """The profile report states achieved throughput as a fraction of the
+    accelerator's device-kind peak (peak_bf16_flops) so users read MFU
+    directly instead of dividing by a datasheet number."""
+    from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    prof = FlopsProfiler()
+    prof.start_profile()
+    prof.profile_fn(f, jnp.ones((32, 64)), jnp.ones((64, 64)))
+    prof.stop_profile()
+    report = prof.print_model_profile(output_file="/dev/null")
+    assert "hw utilization" in report and "% of" in report
+    prof.end_profile()
