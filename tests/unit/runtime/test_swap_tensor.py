@@ -129,3 +129,33 @@ class TestOptimizerSwapper:
         for g in groups:  # every group's state advanced exactly once
             m = sw.swap_in_optimizer_state(g, ["m"])["m"]
             np.testing.assert_array_equal(m, np.ones(1024, np.float32))
+
+
+class TestPythonFallbackPool:
+    """The no-toolchain fallback (reference is_compatible-probe behavior)
+    must honor the same API contract as the native lib, including striping."""
+
+    def _fallback_handle(self, **kw):
+        from deepspeed_tpu.ops import aio as aio_mod
+        h = AsyncIOHandle(**kw)
+        if h._h is not None:  # force the ThreadPoolExecutor path
+            h.close()
+            h._lib = None
+            h._h = None
+            from concurrent.futures import ThreadPoolExecutor
+            h._pool = ThreadPoolExecutor(max_workers=kw.get("thread_count", 4))
+            h._futures = {}
+            h._next_id = 1
+        return h
+
+    def test_roundtrip_and_striped(self, tmp_path):
+        from deepspeed_tpu.ops.aio import aligned_empty
+        h = self._fallback_handle(thread_count=4)
+        data = np.random.default_rng(3).integers(
+            0, 256, size=5 << 20, dtype=np.uint8)
+        path = str(tmp_path / "fb.bin")
+        assert h.pwrite(path, data) == data.nbytes
+        out = aligned_empty(data.nbytes)
+        assert h.pread_striped(path, out) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+        h.close()
