@@ -1,0 +1,130 @@
+"""FP8 training (TransformerEngine-composability analog).
+
+Reference: DeepSpeed composes with TransformerEngine's fp8 autocast and
+proves it across ZeRO stages (``tests/unit/runtime/half_precision/
+test_fp8.py:23 TestFp8ComposabilityAcrossZero``). There is no TE on TPU;
+the TPU-native form is a functional fp8 matmul with per-tensor CURRENT
+scaling (TE's "current scaling" recipe — scales computed from the tensor
+being cast, no history state to thread through jit) and the HYBRID format:
+
+- forward operands in ``float8_e4m3fn`` (more mantissa),
+- backward gradient operand in ``float8_e5m2`` (more range),
+- accumulation always fp32 (``preferred_element_type``).
+
+XLA lowers fp8 ``dot_general`` natively (hardware fp8 MXU paths where the
+chip has them; wider-math emulation elsewhere), so the same program is
+correct on every backend and fast where silicon allows. The residuals
+saved for backward are the QUANTIZED operands + scales — the fp8 memory
+saving applies to saved activations too, which is the actual training win
+on HBM-bound steps.
+
+Composability with ZeRO needs nothing special by construction: params stay
+in the base dtype (fp32/bf16 master semantics are the engine's business),
+and the fp8 cast lives inside the traced step, so stages 0-3 shard the
+same pytrees they always shard. ``tests/unit/runtime/test_fp8.py`` proves
+stage-identical trajectories, mirroring the reference test's shape.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+
+
+def _quantize(t: jax.Array, dtype) -> tuple:
+    """Per-tensor current scaling: q = t / scale in `dtype`, with
+    scale = amax / dtype_max so the largest magnitude maps to the top of
+    the representable range. Returns (q, scale_f32)."""
+    fmax = jnp.float32(jnp.finfo(dtype).max)
+    amax = jnp.max(jnp.abs(t)).astype(jnp.float32)
+    scale = jnp.maximum(amax, 1e-12) / fmax
+    q = (t.astype(jnp.float32) / scale).astype(dtype)
+    return q, scale
+
+
+def _dot_f32(a, b):
+    return jax.lax.dot_general(a, b, (((a.ndim - 1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@jax.custom_vjp
+def _fp8_matmul_2d(x: jax.Array, w: jax.Array) -> jax.Array:
+    qx, sx = _quantize(x, E4M3)
+    qw, sw = _quantize(w, E4M3)
+    return _dot_f32(qx, qw) * (sx * sw)
+
+
+def _fp8_fwd(x, w):
+    qx, sx = _quantize(x, E4M3)
+    qw, sw = _quantize(w, E4M3)
+    y = _dot_f32(qx, qw) * (sx * sw)
+    # residuals are the fp8 tensors — backward re-reads 1 byte/elem; the
+    # primal dtypes ride along (as 0-d tokens: a raw np.dtype is not a
+    # valid residual leaf) so cotangents match bf16/fp32 primals
+    return y, (qx, sx, qw, sw, jnp.zeros((), x.dtype), jnp.zeros((), w.dtype))
+
+
+def _fp8_bwd(res, g):
+    qx, sx, qw, sw, xtok, wtok = res
+    qg, sg = _quantize(g, E5M2)
+    # dx = g @ w^T ; dw = x^T @ g — both with an e5m2 grad operand and an
+    # e4m3 saved operand, fp32 accumulation
+    dx = _dot_f32(qg, qw.T) * (sg * sw)
+    dw = _dot_f32(qx.T, qg) * (sx * sg)
+    return dx.astype(xtok.dtype), dw.astype(wtok.dtype)
+
+
+_fp8_matmul_2d.defvjp(_fp8_fwd, _fp8_bwd)
+
+
+def fp8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """y = x @ w with e4m3 operands and fp32 accumulation; gradients flow
+    through e5m2-quantized cotangents (HYBRID recipe). ``x`` may carry
+    leading batch dims (contracted against 2D ``w``)."""
+    if w.ndim != 2:
+        raise ValueError(f"fp8_matmul expects 2D weights, got {w.shape}")
+    lead = x.shape[:-1]
+    y = _fp8_matmul_2d(x.reshape(-1, x.shape[-1]), w)
+    return y.reshape(*lead, w.shape[-1])
+
+
+class Fp8Linear(nn.Module):
+    """Drop-in linear whose matmul runs in fp8 (reference analog:
+    ``transformer_engine.Linear`` under ``fp8_autocast``; composability
+    contract from ``test_fp8.py:23``). Params stay in ``param_dtype`` —
+    ZeRO/bf16-master semantics are untouched."""
+    features: int
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        kinit = self.kernel_init or nn.initializers.lecun_normal()
+        kernel = self.param("kernel", kinit, (d_in, self.features),
+                            self.param_dtype)
+        y = fp8_matmul(x, kernel)
+        # keep the surrounding model's activation dtype: emitting raw fp32
+        # from every fp8 layer would silently double activation memory in
+        # a bf16 model — the opposite of the fp8 point
+        out_dt = jnp.promote_types(x.dtype, self.param_dtype)
+        y = y.astype(out_dt)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features, ), self.param_dtype)
+            y = y + bias.astype(out_dt)
+        return y
+
+
+def quantization_error(t: jax.Array, dtype=E4M3) -> float:
+    """Relative L2 error of one fp8 round-trip at the current scale —
+    the observability hook the reference gets from TE's amax history."""
+    q, s = _quantize(t, dtype)
+    back = q.astype(jnp.float32) * s
+    num = jnp.linalg.norm(t.astype(jnp.float32) - back)
+    return float(num / jnp.maximum(jnp.linalg.norm(t.astype(jnp.float32)), 1e-12))

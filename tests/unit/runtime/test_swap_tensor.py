@@ -135,22 +135,14 @@ class TestPythonFallbackPool:
     """The no-toolchain fallback (reference is_compatible-probe behavior)
     must honor the same API contract as the native lib, including striping."""
 
-    def _fallback_handle(self, **kw):
+    def test_roundtrip_and_striped(self, tmp_path, monkeypatch):
         from deepspeed_tpu.ops import aio as aio_mod
-        h = AsyncIOHandle(**kw)
-        if h._h is not None:  # force the ThreadPoolExecutor path
-            h.close()
-            h._lib = None
-            h._h = None
-            from concurrent.futures import ThreadPoolExecutor
-            h._pool = ThreadPoolExecutor(max_workers=kw.get("thread_count", 4))
-            h._futures = {}
-            h._next_id = 1
-        return h
-
-    def test_roundtrip_and_striped(self, tmp_path):
         from deepspeed_tpu.ops.aio import aligned_empty
-        h = self._fallback_handle(thread_count=4)
+        # the REAL fallback constructor branch, not a hand-built replica:
+        # _jit_load returning None is exactly the no-toolchain condition
+        monkeypatch.setattr(aio_mod, "_jit_load", lambda: None)
+        h = AsyncIOHandle(thread_count=4)
+        assert h._h is None and h._pool is not None  # fallback engaged
         data = np.random.default_rng(3).integers(
             0, 256, size=5 << 20, dtype=np.uint8)
         path = str(tmp_path / "fb.bin")
