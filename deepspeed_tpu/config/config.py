@@ -37,10 +37,15 @@ ADAGRAD_OPTIMIZER = "adagrad"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
 ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
 ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+# muP width-scaled variants (reference runtime/config.py:79-81)
+MUADAM_OPTIMIZER = "muadam"
+MUADAMW_OPTIMIZER = "muadamw"
+MUSGD_OPTIMIZER = "musgd"
 
 DEEPSPEED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER, ADAGRAD_OPTIMIZER,
-    ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER
+    ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER,
+    MUADAM_OPTIMIZER, MUADAMW_OPTIMIZER, MUSGD_OPTIMIZER
 ]
 
 TRAIN_BATCH_SIZE = "train_batch_size"

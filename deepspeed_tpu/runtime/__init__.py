@@ -1,5 +1,6 @@
 from .engine import DeepSpeedTpuEngine
 from .fp8 import Fp8Linear, fp8_matmul
+from .mup import make_base_shapes
 from .lr_schedules import (LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR,
                            get_lr_schedule)
 from .zero_sharding import ZeroShardingPlan

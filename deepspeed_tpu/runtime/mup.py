@@ -1,0 +1,171 @@
+"""muP (maximal update parametrization) optimizers.
+
+Reference: ``runtime/config.py:79-81`` accepts ``optimizer.type`` =
+MuAdam/MuAdamW/MuSGD and delegates the width-dependent per-parameter
+learning rates to the external ``mup`` package (models annotated via
+``mup.set_base_shapes``; exercised by
+``tests/unit/runtime/test_mup_optimizers.py``). The TPU rebuild keeps the
+same JSON surface but makes the width bookkeeping functional: the user
+derives ``base_shapes`` from a BASE-width param tree once
+(:func:`make_base_shapes` — a JSON-able {path: shape} dict) and passes it
+in ``optimizer.params.base_shapes``; the optimizer factory scales each
+leaf's update by the μTransfer rule.
+
+Rules (Tensor Programs V / μTransfer Table 3), with a dimension counted
+"infinite" when it differs from the base shape, and the trailing two axes
+of an ndim≥2 kernel read as ``(fan_in, fan_out)`` (flax ``[..., in, out]``
+convention; leading axes such as a scan-stacked layer dim are layout, not
+width):
+
+==========  ===========================  ==================
+leaf kind   infinite dims                LR multiplier
+==========  ===========================  ==================
+Adam-family hidden/output (fan_in inf)   1 / fan_in_mult
+Adam-family input-like, biases           1
+SGD         hidden (both inf)            1
+SGD         input-like / bias (out inf)  fan_out_mult
+SGD         output-like (fan_in inf)     1 / fan_in_mult
+==========  ===========================  ==================
+
+At the base width every multiplier is exactly 1, so a μ-optimizer on the
+base model is bit-identical to its plain counterpart — asserted in tests,
+as is μTransfer's point: hidden-layer effective LR shrinks ∝ 1/width when
+the model widens while input/bias LRs hold.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import optax
+
+from ..utils.logging import logger
+
+
+def _path_str(path: Tuple) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def make_base_shapes(base_params) -> Dict[str, List[int]]:
+    """Record the BASE-width shapes as a JSON-able {path: [dims]} dict
+    (the ``mup.make_base_shapes`` analog — run once on the narrow model)."""
+    flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+    return {_path_str(path): list(leaf.shape) for path, leaf in flat}
+
+
+def _leaf_mult(shape: Tuple[int, ...], base: List[int], family: str,
+               path: str) -> float:
+    if list(shape) == list(base):
+        return 1.0
+    if len(shape) != len(base):
+        raise ValueError(
+            f"muP base shape for {path} has rank {len(base)} but the model "
+            f"leaf has rank {len(shape)} — base_shapes from a different model?")
+    if len(shape) == 0:
+        return 1.0
+    if len(shape) == 1:
+        mult = shape[0] / base[0]
+        # a widening vector (bias / layernorm scale) is "input-like":
+        # Adam leaves it alone, SGD scales it up with width
+        return mult if family == "sgd" else 1.0
+    fan_in_mult = shape[-2] / base[-2]
+    fan_out_mult = shape[-1] / base[-1]
+    fan_in_inf = shape[-2] != base[-2]
+    fan_out_inf = shape[-1] != base[-1]
+    if family == "adam":
+        # hidden AND output weights: lr ∝ 1/fan_in; input-like unchanged
+        return 1.0 / fan_in_mult if fan_in_inf else 1.0
+    # sgd
+    if fan_in_inf and fan_out_inf:
+        return 1.0
+    if fan_out_inf:
+        return fan_out_mult
+    if fan_in_inf:
+        return 1.0 / fan_in_mult
+    return 1.0
+
+
+def width_multipliers(params, base_shapes: Dict[str, Any], family: str):
+    """Per-leaf LR multiplier tree for ``family`` in {"adam", "sgd"}."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    mults = []
+    missing = []
+    for path, leaf in flat:
+        key = _path_str(path)
+        if key not in base_shapes:
+            missing.append(key)
+            mults.append(1.0)
+        else:
+            mults.append(_leaf_mult(tuple(leaf.shape), base_shapes[key],
+                                    family, key))
+    if missing:
+        raise ValueError(
+            f"muP base_shapes missing {len(missing)} param paths "
+            f"(e.g. {missing[:3]}) — regenerate with make_base_shapes() "
+            f"on a BASE-width model with the same structure")
+    return jax.tree_util.tree_unflatten(treedef, mults)
+
+
+def scale_updates_by_mup(base_shapes: Dict[str, Any],
+                         family: str) -> optax.GradientTransformation:
+    """optax transform multiplying each leaf's update by its μP LR
+    multiplier. Shapes are static under jit, so the multiplier tree is
+    resolved at trace time from the updates themselves."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        mults = width_multipliers(updates, base_shapes, family)
+        scaled = jax.tree_util.tree_map(lambda u, m: u * m, updates, mults)
+        return scaled, state
+
+    return optax.GradientTransformation(init, update)
+
+
+def build_mu_optimizer(name: str, params: Dict[str, Any],
+                       learning_rate) -> optax.GradientTransformation:
+    """Factory for optimizer.type muadam/muadamw/musgd
+    (reference ``runtime/config.py:79-81``)."""
+    from .optimizers import ADAM_DEFAULT_BETAS  # one source for defaults
+
+    base_shapes = params.get("base_shapes")
+    if not isinstance(base_shapes, dict) or not base_shapes:
+        raise ValueError(
+            f"{name} needs optimizer.params.base_shapes "
+            f"(make_base_shapes(base_width_params) — the mup "
+            f"set_base_shapes analog)")
+    betas = params.get("betas", ADAM_DEFAULT_BETAS)
+    eps = float(params.get("eps", 1e-8))
+    wd = float(params.get("weight_decay", 0.0))
+    momentum = float(params.get("momentum", 0.0))
+    nesterov = bool(params.get("nesterov", False))
+    if name in ("muadam", "muadamw"):
+        chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+                 scale_updates_by_mup(base_shapes, "adam")]
+        if name == "muadamw" and wd:
+            # decoupled wd stays UNSCALED relative to the global lr
+            # (μTransfer's "independent weight decay")
+            chain.append(optax.add_decayed_weights(wd))
+        elif wd:
+            logger.warning("muadam ignores weight_decay (use muadamw)")
+        chain.append(optax.scale_by_learning_rate(learning_rate))
+        return optax.chain(*chain)
+    if name == "musgd":
+        chain = []
+        if wd:
+            # L2-style (into the gradient), matching the plain sgd branch
+            chain.append(optax.add_decayed_weights(wd))
+        if momentum:
+            chain.append(optax.trace(decay=momentum, nesterov=nesterov))
+        chain.append(scale_updates_by_mup(base_shapes, "sgd"))
+        chain.append(optax.scale_by_learning_rate(learning_rate))
+        return optax.chain(*chain)
+    raise ValueError(f"unknown mu optimizer {name}")

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 import optax
 
 from ..config.config import (ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER,
-                             LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+                             LION_OPTIMIZER, MUADAM_OPTIMIZER, MUADAMW_OPTIMIZER,
+                             MUSGD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
                              SGD_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER)
 from ..utils.logging import logger
 
@@ -74,6 +75,10 @@ def build_optimizer(name: Optional[str],
         )
     elif name == ADAGRAD_OPTIMIZER:
         tx = optax.adagrad(learning_rate, eps=eps)
+    elif name in (MUADAM_OPTIMIZER, MUADAMW_OPTIMIZER, MUSGD_OPTIMIZER):
+        # muP width-scaled LRs (reference runtime/config.py:79-81)
+        from .mup import build_mu_optimizer
+        tx = build_mu_optimizer(name, params, learning_rate)
     elif name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
         # 1-bit optimizers (reference runtime/fp16/onebit/) need the
         # error-compensated compressed allreduce; built in runtime/onebit.py.
