@@ -136,6 +136,7 @@ class DeepSpeedTpuEngine:
                  config=None,
                  mesh_param=None,
                  dont_shard=False,
+                 loss_fn=None,
                  **kwargs):
         # Resolve the true data-parallel world BEFORE validating the batch
         # triangle: it depends on the mesh shape (dp = data*fsdp), not on
@@ -146,6 +147,11 @@ class DeepSpeedTpuEngine:
             raw = config if config is not None else {}
             self._config = DeepSpeedTpuConfig(raw, world_size=self._dp_world_from(raw))
         self.module = model
+        # multi-output models (reference test_multi_output_model.py): the
+        # torch pattern combines the returned losses BETWEEN forward and
+        # backward; under the fused step the combiner must live inside the
+        # traced program — loss_fn(model_output) -> scalar does exactly that
+        self._loss_fn = loss_fn
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
         self.training_dataloader = None
@@ -464,7 +470,10 @@ class DeepSpeedTpuEngine:
                 params = qwz_gather(params)
             cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
             out = apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))
-            loss, _ = _extract_loss(out)
+            if self._loss_fn is not None:
+                loss = self._loss_fn(out)
+            else:
+                loss, _ = _extract_loss(out)
             # scale_loss_by_gas (engine.py:1816) + fp16 loss scaling
             scaled = loss.astype(jnp.float32) / gas
             if use_scaling:

@@ -207,6 +207,7 @@ def test_eval_mode_forward_is_grad_free():
 def test_save_16bit_model(tmp_path):
     import ml_dtypes
     from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -252,6 +253,7 @@ def test_gather_16bit_weights_on_model_save(tmp_path):
     carries the consolidated 16-bit weights (reference engine.py:3538)."""
     import ml_dtypes
     from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -280,6 +282,7 @@ def test_load_module_only_keeps_fresh_optimizer(tmp_path):
     state does NOT (the fine-tune-from-pretrained path — reference
     engine.py load_module_only)."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     e1, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
@@ -288,6 +291,7 @@ def test_load_module_only_keeps_fresh_optimizer(tmp_path):
     e1.save_checkpoint(str(tmp_path), tag="pre")
     saved_params = jax.tree_util.tree_map(np.asarray, e1.params)
 
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model2, params2 = simple_model_and_params(seed=9)
     e2, _, _, _ = deepspeed_tpu.initialize(model=model2, model_parameters=params2,
@@ -313,6 +317,7 @@ def test_set_train_batch_size_adjusts_gas():
     (reference engine.py:455): gas follows, micro batch fixed, training
     continues through the new fused shape."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     cfg = base_config(train_batch_size=16, gradient_accumulation_steps=2)
@@ -342,6 +347,7 @@ def test_set_train_batch_size_rebuilds_compiled_fns():
     path (silently training on half the requested batch), and a 2->4 change
     kept dividing the loss by the stale gas."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     cfg = base_config(train_batch_size=8, gradient_accumulation_steps=1)
@@ -365,3 +371,46 @@ def test_see_memory_usage_reports():
     stats = see_memory_usage("unit-test", force=True)
     assert stats["host_max_rss_bytes"] > 1 << 20  # this process uses >1MiB
     assert set(stats) >= {"device_bytes_in_use", "device_peak_bytes_in_use"}
+
+
+def test_multi_output_model_with_loss_fn():
+    """Reference test_multi_output_model.py: the model returns a TUPLE of
+    losses and the user combines them. The torch pattern combines between
+    forward and backward; under the fused step the combiner rides inside
+    the traced program via initialize(..., loss_fn=...)."""
+    import flax.linen as fnn
+
+    class TwoLoss(fnn.Module):
+        @fnn.compact
+        def __call__(self, xs, ys):
+            dense = fnn.Dense(8, use_bias=False)
+            losses = []
+            for i in range(2):
+                logits = dense(xs[:, i])
+                logp = jax.nn.log_softmax(logits)
+                losses.append(-jnp.take_along_axis(
+                    logp, ys[:, i][:, None], axis=-1).mean())
+            return tuple(losses)
+
+    from deepspeed_tpu.comm import reset_mesh_context
+    reset_mesh_context()
+    model = TwoLoss()
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(8, 2, 8)), jnp.float32)
+    ys = jnp.asarray(rng.integers(0, 8, size=(8, 2)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), xs, ys)["params"]
+
+    weights = (1.0, 0.5)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "steps_per_print": 0},
+        loss_fn=lambda outs: weights[0] * outs[0] + weights[1] * outs[1])
+    first = None
+    for _ in range(6):
+        loss = engine.forward(xs, ys)
+        engine.backward(loss)
+        engine.step()
+        first = first if first is not None else float(loss)
+    assert float(loss) < first  # the COMBINED loss is what trains
