@@ -207,7 +207,6 @@ def test_eval_mode_forward_is_grad_free():
 def test_save_16bit_model(tmp_path):
     import ml_dtypes
     from deepspeed_tpu.comm.mesh import reset_mesh_context
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -253,7 +252,6 @@ def test_gather_16bit_weights_on_model_save(tmp_path):
     carries the consolidated 16-bit weights (reference engine.py:3538)."""
     import ml_dtypes
     from deepspeed_tpu.comm.mesh import reset_mesh_context
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -282,7 +280,6 @@ def test_load_module_only_keeps_fresh_optimizer(tmp_path):
     state does NOT (the fine-tune-from-pretrained path — reference
     engine.py load_module_only)."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     e1, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
@@ -291,7 +288,6 @@ def test_load_module_only_keeps_fresh_optimizer(tmp_path):
     e1.save_checkpoint(str(tmp_path), tag="pre")
     saved_params = jax.tree_util.tree_map(np.asarray, e1.params)
 
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model2, params2 = simple_model_and_params(seed=9)
     e2, _, _, _ = deepspeed_tpu.initialize(model=model2, model_parameters=params2,
@@ -317,7 +313,6 @@ def test_set_train_batch_size_adjusts_gas():
     (reference engine.py:455): gas follows, micro batch fixed, training
     continues through the new fused shape."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     cfg = base_config(train_batch_size=16, gradient_accumulation_steps=2)
@@ -347,7 +342,6 @@ def test_set_train_batch_size_rebuilds_compiled_fns():
     path (silently training on half the requested batch), and a 2->4 change
     kept dividing the loss by the stale gas."""
     from deepspeed_tpu.comm.mesh import reset_mesh_context
-    from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
     model, params = simple_model_and_params()
     cfg = base_config(train_batch_size=8, gradient_accumulation_steps=1)
