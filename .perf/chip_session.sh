@@ -7,6 +7,10 @@
 cd /root/repo
 P=/root/repo/.perf
 LOG=$P/watcher.log
+# persistent XLA compile cache: a compile that finishes in ANY window is
+# reused from disk in every later one (bench.py sets the same default)
+export JAX_COMPILATION_CACHE_DIR=$P/jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=5
 SFX=$(date -u +%m%dT%H%M)
 echo "CHIP SESSION $SFX start $(date -u +%FT%TZ)" >> $LOG
 touch "$P/.session_start"  # mtime marker: snapshot only THIS session's files

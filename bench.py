@@ -401,6 +401,13 @@ def supervise():
     last_tail = ""
     for attempt in range(ATTEMPTS):
         env = dict(os.environ)
+        # persistent compile cache: a fused-step compile that finishes once
+        # in ANY relay window is reused from disk in every later window —
+        # the single biggest lever when windows are shorter than a compile
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    ".perf", "jax_cache"))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
         if attempt == ATTEMPTS - 1:
             # last resort: scrub the axon plugin entirely and run on host CPU
             # so we record *something* rather than nothing (auto-pick would
