@@ -239,3 +239,28 @@ def test_int8_woq_serving():
     # int8 blockwise keeps logits within a small relative band
     denom = np.maximum(np.abs(lf).max(), 1e-6)
     assert np.abs(lf - lq).max() / denom < 0.15, np.abs(lf - lq).max() / denom
+
+
+def test_decode_steps_reuse_one_compiled_bucket():
+    """Steady-state decode must hit ONE compiled program per bucket shape —
+    a per-step recompile (signature leak in the ragged metadata) would turn
+    ~ms decode steps into ~seconds over the relay."""
+    import dataclasses
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import LlamaConfig
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    eng = build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64),
+            num_kv_blocks=64))
+    uid = 11
+    eng.put([uid], [list(range(24))])
+    for step in range(6):
+        eng.put([uid], [[5]])
+    # one prefill bucket + one decode bucket
+    assert len(eng.model()._fwd_cache) == 2, list(eng.model()._fwd_cache)
+    eng.flush(uid)

@@ -148,3 +148,38 @@ def test_gas_fused_respects_zero_and_scaling():
                         jnp.zeros((8, 16), jnp.float32)) for _ in range(4)])
         losses.append(eng.train_batch(micros))
     assert losses[-1] < losses[0], losses
+
+
+def test_steps_compile_once_across_run():
+    """Per-step recompilation is the classic silent 10x step-time killer
+    (every jit signature change costs a fresh XLA compile over the relay).
+    Both training paths must hit their jit caches on every step after the
+    first: loop-carried state (params/opt_state/scale) keeps ONE sharding
+    + aval signature, fresh same-shape batches keep one input aval."""
+    engine = make_engine(optimizer={"type": "AdamW", "params": {"lr": 1e-3}})
+    assert engine._train_step_fused is not None
+    rng = np.random.default_rng(0)
+
+    def fresh_batch():
+        return jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+
+    def split_step():
+        x = fresh_batch()
+        loss = engine.forward(x, jnp.zeros_like(x))
+        engine.backward(loss)
+        engine.step()
+
+    # fused path (what bench/train_batch run at gas=1)
+    engine.fused_train_step(fresh_batch(), jnp.zeros((8, 16), jnp.float32))
+    fused0 = engine._train_step_fused._cache_size()
+    # split path (forward/backward/step — compiles _fwd_bwd + _apply_step)
+    split_step()
+    fwdbwd0 = engine._fwd_bwd._cache_size()
+    apply0 = engine._apply_step._cache_size()
+    for _ in range(4):
+        engine.fused_train_step(fresh_batch(), jnp.zeros((8, 16), jnp.float32))
+        split_step()
+    assert engine._train_step_fused._cache_size() == fused0, (
+        "fused train step recompiled mid-run — a signature/sharding leak")
+    assert engine._fwd_bwd._cache_size() == fwdbwd0
+    assert engine._apply_step._cache_size() == apply0
