@@ -152,15 +152,35 @@ class InferenceEngineV2:
     # ---- convenience decode loop (the MII surface over FastGen) ----
 
     @staticmethod
-    def _sample(row: np.ndarray, temperature: float, rng) -> int:
+    def _sample(row: np.ndarray, temperature: float, rng,
+                top_k: int = 0, top_p: float = 1.0) -> int:
         if temperature <= 0:
             return int(np.argmax(row))
-        # Gumbel-max: argmax(logits/T + G) ~ softmax(logits/T) sample
-        g = rng.gumbel(size=row.shape)
-        return int(np.argmax(row.astype(np.float64) / temperature + g))
+        logits = row.astype(np.float64) / temperature
+        if top_k > 0 and top_k < logits.size:  # <=0 = disabled (vLLM style)
+            kth = np.partition(logits, -top_k)[-top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        if 0.0 < top_p < 1.0:
+            # nucleus: keep the smallest set of tokens whose softmax mass
+            # reaches top_p (the highest-prob token always survives:
+            # cumsum(p)-p < top_p is True at the first position for any
+            # positive top_p)
+            order = np.argsort(logits)[::-1]
+            p = np.exp(logits[order] - np.max(logits))
+            p = p / p.sum()
+            keep = np.cumsum(p) - p < top_p
+            drop = np.ones_like(logits, dtype=bool)
+            drop[order[keep]] = False
+            logits = np.where(drop, -np.inf, logits)
+        elif top_p <= 0.0:
+            return int(np.argmax(logits))  # degenerate nucleus = greedy
+        # Gumbel-max: argmax(logits + G) ~ softmax(logits) sample
+        g = rng.gumbel(size=logits.shape)
+        return int(np.argmax(logits + g))
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
                  seed: int = 0):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
@@ -210,7 +230,7 @@ class InferenceEngineV2:
                 logits = np.asarray(self.put(
                     [u], [feed[u][ofs:ofs + max_batch_tokens]],
                     do_checks=False))[0]
-            last_tok[u] = self._sample(logits, temperature, rng)
+            last_tok[u] = self._sample(logits, temperature, rng, top_k, top_p)
             outputs[u].append(last_tok[u])
             live.append(u)
 
@@ -272,7 +292,7 @@ class InferenceEngineV2:
                 logits = np.asarray(self.put(admit, [feed[u] for u in admit],
                                              do_checks=False))
                 for i, u in enumerate(admit):
-                    last_tok[u] = self._sample(logits[i], temperature, rng)
+                    last_tok[u] = self._sample(logits[i], temperature, rng, top_k, top_p)
                     outputs[u].append(last_tok[u])
                     live.append(u)
             for u in list(live):
@@ -303,7 +323,7 @@ class InferenceEngineV2:
             if not live:
                 continue
             for i, u in enumerate(live):
-                last_tok[u] = self._sample(logits[i], temperature, rng)
+                last_tok[u] = self._sample(logits[i], temperature, rng, top_k, top_p)
                 outputs[u].append(last_tok[u])
         return [outputs[u] for u in uids]
 

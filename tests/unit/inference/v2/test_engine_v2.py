@@ -264,3 +264,26 @@ def test_decode_steps_reuse_one_compiled_bucket():
     # one prefill bucket + one decode bucket
     assert len(eng.model()._fwd_cache) == 2, list(eng.model()._fwd_cache)
     eng.flush(uid)
+
+
+def test_generate_topk_topp_sampling():
+    """top-k keeps only the k best logits; top-p keeps the nucleus — both
+    restrict which tokens can ever be sampled (MII sampler surface)."""
+    import numpy as np
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    rng = np.random.default_rng(0)
+    row = np.asarray([10.0, 9.0, 1.0, 0.5, -3.0])
+    for _ in range(20):
+        tok = InferenceEngineV2._sample(row, 1.0, rng, top_k=2)
+        assert tok in (0, 1)
+    # a sharply-peaked distribution with top_p=0.5: only the argmax survives
+    peaked = np.asarray([20.0, 1.0, 0.8, 0.2, 0.1])
+    for _ in range(10):
+        assert InferenceEngineV2._sample(peaked, 1.0, rng, top_p=0.5) == 0
+    # temperature<=0 stays greedy regardless
+    assert InferenceEngineV2._sample(row, 0.0, rng, top_k=1, top_p=0.1) == 0
+    # degenerate/disabled sentinels: top_p<=0 is greedy, top_k<=0 is off
+    assert InferenceEngineV2._sample(row, 1.0, rng, top_p=0.0) == 0
+    seen = {InferenceEngineV2._sample(row, 5.0, rng, top_k=-1)
+            for _ in range(200)}
+    assert len(seen) > 2  # no silent pruning with the vLLM disabled value
