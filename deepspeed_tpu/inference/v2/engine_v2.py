@@ -152,11 +152,29 @@ class InferenceEngineV2:
     # ---- convenience decode loop (the MII surface over FastGen) ----
 
     @staticmethod
-    def _sample(row: np.ndarray, temperature: float, rng,
-                top_k: int = 0, top_p: float = 1.0) -> int:
+    def _sample_with_logprob(row: np.ndarray, temperature: float, rng,
+                             top_k: int = 0, top_p: float = 1.0,
+                             want_lp: bool = True) -> Tuple[int, float]:
+        """Returns (token, logprob-of-token) under the temperature-scaled,
+        top-k/top-p-filtered distribution (MII returns logprobs; greedy
+        logprobs come from the raw softmax). ``want_lp=False`` skips the
+        O(vocab) softmax pass — the default generate() path pays nothing
+        for the logprob surface it isn't using."""
+
+        def lp_at(logits, tok):
+            if not want_lp:
+                return 0.0
+            # exp(-inf - m) is 0, so this is also correct on FILTERED
+            # logits (the renormalized nucleus/top-k distribution)
+            m = np.max(logits)
+            return float(logits[tok] - m
+                         - np.log(np.sum(np.exp(logits - m))))
+
+        raw = row.astype(np.float64)
         if temperature <= 0:
-            return int(np.argmax(row))
-        logits = row.astype(np.float64) / temperature
+            tok = int(np.argmax(raw))
+            return tok, lp_at(raw, tok)
+        logits = raw / temperature
         if top_k > 0 and top_k < logits.size:  # <=0 = disabled (vLLM style)
             kth = np.partition(logits, -top_k)[-top_k]
             logits = np.where(logits < kth, -np.inf, logits)
@@ -173,14 +191,24 @@ class InferenceEngineV2:
             drop[order[keep]] = False
             logits = np.where(drop, -np.inf, logits)
         elif top_p <= 0.0:
-            return int(np.argmax(logits))  # degenerate nucleus = greedy
+            tok = int(np.argmax(logits))  # degenerate nucleus = greedy
+            return tok, lp_at(logits, tok)
         # Gumbel-max: argmax(logits + G) ~ softmax(logits) sample
+        # (-inf + G stays -inf, so filtered tokens can never win)
         g = rng.gumbel(size=logits.shape)
-        return int(np.argmax(logits + g))
+        tok = int(np.argmax(logits + g))
+        return tok, lp_at(logits, tok)
+
+    @classmethod
+    def _sample(cls, row: np.ndarray, temperature: float, rng,
+                top_k: int = 0, top_p: float = 1.0) -> int:
+        return cls._sample_with_logprob(row, temperature, rng, top_k, top_p,
+                                        want_lp=False)[0]
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
+                 return_logprobs: bool = False,
                  seed: int = 0):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
@@ -199,6 +227,7 @@ class InferenceEngineV2:
         prompts = [list(map(int, np.asarray(p).reshape(-1))) for p in prompts]
         uids = list(range(len(prompts)))
         outputs = {u: [] for u in uids}
+        logprobs = {u: [] for u in uids}
         # tokens to prefill on (re)admission: prompt, or prompt + generated
         # so far after an eviction
         feed = {u: list(prompts[u]) for u in uids}
@@ -230,8 +259,11 @@ class InferenceEngineV2:
                 logits = np.asarray(self.put(
                     [u], [feed[u][ofs:ofs + max_batch_tokens]],
                     do_checks=False))[0]
-            last_tok[u] = self._sample(logits, temperature, rng, top_k, top_p)
+            last_tok[u], lp = self._sample_with_logprob(
+                logits, temperature, rng, top_k, top_p,
+                want_lp=return_logprobs)
             outputs[u].append(last_tok[u])
+            logprobs[u].append(lp)
             live.append(u)
 
         while waiting or live:
@@ -292,8 +324,11 @@ class InferenceEngineV2:
                 logits = np.asarray(self.put(admit, [feed[u] for u in admit],
                                              do_checks=False))
                 for i, u in enumerate(admit):
-                    last_tok[u] = self._sample(logits[i], temperature, rng, top_k, top_p)
+                    last_tok[u], lp = self._sample_with_logprob(
+                        logits[i], temperature, rng, top_k, top_p,
+                        want_lp=return_logprobs)
                     outputs[u].append(last_tok[u])
+                    logprobs[u].append(lp)
                     live.append(u)
             for u in list(live):
                 seq = self._state_manager.get_sequence(u)
@@ -323,8 +358,13 @@ class InferenceEngineV2:
             if not live:
                 continue
             for i, u in enumerate(live):
-                last_tok[u] = self._sample(logits[i], temperature, rng, top_k, top_p)
+                last_tok[u], lp = self._sample_with_logprob(
+                    logits[i], temperature, rng, top_k, top_p,
+                    want_lp=return_logprobs)
                 outputs[u].append(last_tok[u])
+                logprobs[u].append(lp)
+        if return_logprobs:
+            return [outputs[u] for u in uids], [logprobs[u] for u in uids]
         return [outputs[u] for u in uids]
 
     def flush(self, uid: int) -> None:

@@ -287,3 +287,30 @@ def test_generate_topk_topp_sampling():
     seen = {InferenceEngineV2._sample(row, 5.0, rng, top_k=-1)
             for _ in range(200)}
     assert len(seen) > 2  # no silent pruning with the vLLM disabled value
+
+
+def test_generate_return_logprobs():
+    """MII surface: generate(return_logprobs=True) yields one logprob per
+    generated token; greedy logprobs are raw-softmax log-likelihoods."""
+    import dataclasses
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import LlamaConfig
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    eng = build_llama_engine(
+        cfg, seed=3, dtype=jnp.float32, kv_block_size=8,
+        engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(max_context=64),
+            num_kv_blocks=64))
+    toks, lps = eng.generate([[1, 5, 9], [2, 7]], max_new_tokens=4,
+                             return_logprobs=True)
+    assert len(toks) == 2 and len(lps) == 2
+    for t, l in zip(toks, lps):
+        assert len(t) == len(l) == 4
+        assert all(x <= 0.0 and np.isfinite(x) for x in l)
+    # same engine, logprobs off: token stream identical (greedy determinism)
+    toks2 = eng.generate([[1, 5, 9], [2, 7]], max_new_tokens=4)
+    assert toks2 == toks
