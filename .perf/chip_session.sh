@@ -35,8 +35,9 @@ run triage 1200 python .perf/triage_compile.py 2 3
 run bench 2400 python bench.py
 # 5. where-the-time-goes (drives the MFU iteration); scanned first (fast
 # compile, matches bench_fast's program), then the unrolled ladder program
+# with an xprof capture of 3 fused steps
 run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
-run bench_breakdown 1800 python bench.py --breakdown
+run bench_breakdown 1800 env DS_BENCH_TRACE=$P/xprof_$SFX python bench.py --breakdown
 # 6. serving decode, fast first (paged @1k ctx, 2-3 compiles) then the
 # full sweep (writes BENCH_SERVING.json at repo root, incrementally).
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json

@@ -321,6 +321,20 @@ def breakdown(batch=8, seq=1024, iters=10):
     except Exception as e:  # noqa: BLE001
         report["xla_flops_per_step"] = f"n/a ({str(e)[:80]})"
 
+    # optional xprof capture (DS_BENCH_TRACE=dir): 3 fused steps under
+    # jax.profiler.trace — host dispatch timelines always; device timelines
+    # where the backend supports tracing through the relay
+    trace_dir = os.environ.get("DS_BENCH_TRACE")
+    if trace_dir:
+        try:
+            with jax.profiler.trace(trace_dir):
+                for _ in range(3):
+                    engine.fused_train_step(ids, labels=ids)
+                jax.block_until_ready(engine.params)
+            report["trace_dir"] = trace_dir
+        except Exception as e:  # noqa: BLE001
+            report["trace_dir"] = f"n/a ({str(e)[:80]})"
+
     toks = batch * seq
     report["tokens_per_step"] = toks
     report["model_flops_per_step"] = 6 * n_params * toks \
