@@ -1,15 +1,23 @@
 #!/bin/bash
-# TPU relay watcher r4.3: probe every 5 min; on success run chip_session.sh.
-# Relay windows have been short (~10 min) — probe more often than v3's 10 min
-# so we don't miss half a window, and KEEP watching after a session completes
-# (more windows -> more sweep coverage; chip_session skips nothing on rerun).
+# TPU relay watcher r4.4: the relay is a LOCAL tunnel (PALLAS_AXON_POOL_IPS
+# = 127.0.0.1, port 8471); when it's down the port is closed, so a TCP
+# check fails INSTANTLY where the jax probe hangs ~2.5 min to its timeout.
+# Cycle: fast port check every ~75s; only on an open port run the real jax
+# probe (compile+matmul readiness) and then the full chip session. KEEP
+# watching after a session completes (more windows -> more sweep coverage).
 cd /root/repo
 PROBE=/tmp/probe_tpu.py
 LOG=/root/repo/.perf/watcher.log
-echo "watcher v4 start $(date -u +%FT%TZ)" >> $LOG
+echo "watcher v4.4 start $(date -u +%FT%TZ)" >> $LOG
 N=0
 while true; do
   N=$((N+1))
+  if ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
+    [ $((N % 8)) -eq 1 ] && echo "port closed #$N $(date -u +%FT%TZ)" >> $LOG
+    sleep 75
+    continue
+  fi
+  echo "PORT OPEN #$N $(date -u +%FT%TZ) — running jax probe" >> $LOG
   if timeout 150 python $PROBE >> $LOG 2>&1; then
     echo "PROBE OK #$N $(date -u +%FT%TZ)" >> $LOG
     touch /root/repo/.perf/TPU_UP
@@ -17,8 +25,6 @@ while true; do
     echo "session over; resuming watch $(date -u +%FT%TZ)" >> $LOG
   else
     echo "probe fail #$N $(date -u +%FT%TZ)" >> $LOG
+    sleep 60
   fi
-  # a DOWN-relay probe already burns ~2.5 min hanging to its timeout; keep
-  # the added sleep short so the full cycle stays ~4.5 min (windows are ~10)
-  sleep 120
 done
