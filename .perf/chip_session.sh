@@ -22,6 +22,8 @@ run() { # name timeout cmd...
   echo "$name rc=$?" >> $LOG
 }
 
+# 0. op compatibility matrix on real silicon (seconds, no compile)
+run ds_report 300 python bin/ds_report
 # 1. Mosaic lowering revalidation (known ~80s when relay healthy)
 run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q
 # 2. fast train number (ONE compile at the known-fits footprint — lands a
