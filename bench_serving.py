@@ -105,6 +105,7 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             results.append({
                 "backend": backend, "context": ctx,
                 "decode_tok_s": round(decode_steps / dt, 2),
+                "decode_step_ms": round(1e3 * dt / decode_steps, 2),
                 "prefill_tok_s": round(ctx / prefill_s, 1),
             })
             checkpoint()  # relay windows die mid-run: persist each point
@@ -131,6 +132,9 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             results.append({
                 "backend": backend, "context": ctx, "concurrent_seqs": nseq,
                 "batched_decode_tok_s": round(nseq * decode_steps / dt, 2),
+                # per-user token latency at this concurrency — the SLA side
+                # of FastGen's effective-throughput framing
+                "decode_step_ms": round(1e3 * dt / decode_steps, 2),
             })
             checkpoint()
             for u in uids:
