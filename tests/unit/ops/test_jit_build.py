@@ -1,0 +1,50 @@
+"""JIT build scheme (reference op_builder/builder.py:535 jit_load):
+content-hash-named artifacts, rebuild on source change, stale purge."""
+
+import os
+import subprocess
+
+import pytest
+
+from deepspeed_tpu.ops.jit_build import jit_build
+
+SRC = '''
+extern "C" long answer() { return %dL; }
+'''
+
+
+def _make(tmp_path, val):
+    src = tmp_path / "toy.cpp"
+    src.write_text(SRC % val)
+    return str(src)
+
+
+def test_builds_caches_and_rebuilds_on_change(tmp_path, monkeypatch):
+    monkeypatch.setenv("DS_TPU_BUILD_DIR", str(tmp_path / "build"))
+    src = _make(tmp_path, 41)
+    so1 = jit_build(src, "libtoy")
+    assert os.path.exists(so1)
+    mtime1 = os.path.getmtime(so1)
+    # identical source: cached, not rebuilt
+    assert jit_build(src, "libtoy") == so1
+    assert os.path.getmtime(so1) == mtime1
+    # changed source: NEW hash-named artifact, old one purged
+    src = _make(tmp_path, 42)
+    so2 = jit_build(src, "libtoy")
+    assert so2 != so1 and os.path.exists(so2)
+    assert not os.path.exists(so1), "stale artifact must be purged"
+    import ctypes
+    lib = ctypes.CDLL(so2)
+    lib.answer.restype = ctypes.c_long
+    assert lib.answer() == 42
+
+
+def test_compile_failure_raises_and_leaves_no_artifact(tmp_path, monkeypatch):
+    monkeypatch.setenv("DS_TPU_BUILD_DIR", str(tmp_path / "build"))
+    src = tmp_path / "broken.cpp"
+    src.write_text("this is not C++")
+    with pytest.raises(subprocess.CalledProcessError):
+        jit_build(str(src), "libbroken")
+    build = tmp_path / "build"
+    if build.exists():
+        assert not [f for f in os.listdir(build) if f.endswith(".so")]
