@@ -471,6 +471,23 @@ def flash_attention(q,
     dq, dk = _default_blocks(q.shape[-1])
     block_q = block_q if block_q is not None else dq
     block_k = block_k if block_k is not None else dk
+    # blocks must DIVIDE the sequence: the dispatch gate admits any
+    # s % 128 == 0, but the default 256 blocks would reject s=384/640/...
+    # Fit = largest power-of-two divisor of S that is <= the requested
+    # block (every eligible s reaches 128; an odd override can't silently
+    # degrade to block 1 — the kernels' divisibility assert still guards)
+    def _fit(S, b):
+        if S <= b or S % b == 0:
+            return b
+        p = 1
+        while p * 2 <= b and S % (p * 2) == 0:
+            p *= 2
+        # a degenerate fit (odd S, or an override with no usable divisor)
+        # keeps the requested block so the kernels' divisibility assert
+        # fails LOUDLY instead of silently running 1-wide blocks
+        return p if p >= 32 else b
+    block_q = _fit(q.shape[1], block_q)
+    block_k = _fit(k.shape[1], block_k)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if use_pallas(force_pallas) or interpret:
         return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,

@@ -2,9 +2,13 @@
 content-hash-named artifacts, rebuild on source change, stale purge."""
 
 import os
+import shutil
 import subprocess
 
 import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="needs g++ (toolchain-less image)")
 
 from deepspeed_tpu.ops.jit_build import jit_build
 
