@@ -58,9 +58,11 @@ run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g
 # 9. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
 # flash + selective remat)
 run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
-# 10. flash block sweep (two strongest candidates)
-for B in "256,512" "512,512"; do
-  run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B python bench.py
+# 10. flash block sweep. VMEM math at hd=64/seq1024: even 1024-wide
+# blocks fit comfortably (<1MB/step scratch), so include whole-sequence
+# blocks — fewest grid steps, max MXU work per program.
+for B in "256,512" "512,512" "512,1024" "1024,1024"; do
+  run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B DS_BENCH_FAST=1 python bench.py
 done
 echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE
