@@ -121,3 +121,29 @@ def test_engine_trains_with_muadamw():
         engine.step()
         losses.append(float(loss))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_engine_trains_with_musgd():
+    """MuSGD with momentum + weight decay through the engine (the reference
+    parametrizes all three mu optimizers; MuAdamW is covered above)."""
+    model, wide = _params(32)
+    _, base = _params(8)
+    shapes = make_base_shapes(base)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8, )), jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=wide,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "MuSGD",
+                              "params": {"lr": 5e-3, "momentum": 0.9,
+                                         "weight_decay": 1e-4,
+                                         "base_shapes": shapes}},
+                "steps_per_print": 0})
+    losses = []
+    for _ in range(8):
+        loss = engine.forward(x, labels=y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
