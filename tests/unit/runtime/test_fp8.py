@@ -126,3 +126,25 @@ def test_fp8_linear_preserves_bf16_activation_dtype():
     assert jax.tree_util.tree_leaves(gp)[0].dtype == jnp.bfloat16
     assert all(bool(jnp.all(jnp.isfinite(l.astype(jnp.float32))))
                for l in jax.tree_util.tree_leaves((gp, gx)))
+
+
+def test_fp8_fused_train_step_path():
+    """fp8 layers through the gas=1 FUSED one-program step (the stage sweep
+    above drives the split forward/backward/step path)."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(16, )), jnp.float32)
+    reset_mesh_context()
+    model = _Fp8MLP()
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 16,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "steps_per_print": 0})
+    assert engine._train_step_fused is not None
+    first = None
+    for _ in range(6):
+        loss = engine.fused_train_step(x, labels=y)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first and np.isfinite(float(loss))
