@@ -1,0 +1,88 @@
+"""Chip memory triage: which bench footprints FIT in HBM, and where the
+bytes go. Compile-only (no execution): ``lowered.compile()`` runs XLA
+buffer assignment, which raises RESOURCE_EXHAUSTED for programs that
+exceed HBM and yields ``memory_analysis()`` numbers for ones that fit.
+Every successful compile lands in the persistent cache, so the real bench
+ladder skips that compile later — the probe is never wasted work.
+
+Usage: python .perf/mem_triage.py [config_index ...]
+"""
+import gc
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GiB = 2**30
+
+
+def stamp(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+# (label, scan_layers, remat, batches-to-probe)
+GRID = [
+    ("unroll/none", False, False, (4, 8)),
+    ("scan/none", True, False, (4, 8)),
+    ("unroll/dots", False, "dots_saveable", (8, 16)),
+    ("scan/dots", True, "dots_saveable", (8, 16)),
+    ("scan/full", True, True, (8, 16)),
+]
+
+
+def probe(label, scan, remat, batches):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import init_llama
+    from bench import bench_config
+
+    cfg = bench_config(remat=remat, scan_layers=scan)
+    model, params = init_llama(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": batches[0],
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True}, "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    for batch in batches:
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, 1024)),
+                          dtype=jnp.int32)
+        t = time.time()
+        try:
+            lowered = engine._train_step_fused.lower(
+                engine.params, engine.opt_state, engine.scale_state,
+                (ids,), {"labels": ids}, ())
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            stamp(f"{label} bs{batch}: FITS ({time.time()-t:.0f}s compile) "
+                  f"temp={ma.temp_size_in_bytes/GiB:.2f}G "
+                  f"args={ma.argument_size_in_bytes/GiB:.2f}G "
+                  f"out={ma.output_size_in_bytes/GiB:.2f}G "
+                  f"alias={ma.alias_size_in_bytes/GiB:.2f}G "
+                  f"tot={(ma.temp_size_in_bytes + ma.argument_size_in_bytes + ma.output_size_in_bytes - ma.alias_size_in_bytes)/GiB:.2f}G")
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)
+            head = msg.splitlines()[0][:160] if msg else type(e).__name__
+            kind = "OOM" if ("RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()) \
+                else "ERR"
+            stamp(f"{label} bs{batch}: {kind} ({time.time()-t:.0f}s) {head}")
+    del engine, params, model
+    gc.collect()
+    jax.clear_caches()
+
+
+def main():
+    import jax
+    stamp(f"devices: {jax.devices()}")
+    picks = [int(a) for a in sys.argv[1:]] or range(len(GRID))
+    for i in picks:
+        probe(*GRID[i])
+    stamp("mem triage complete")
+
+
+if __name__ == "__main__":
+    main()
