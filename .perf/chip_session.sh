@@ -1,9 +1,10 @@
 #!/bin/bash
-# One TPU relay window -> full evidence capture. Relay windows have been
-# ~10 min; order is strictly cheapest-first so a short window still lands
-# the Mosaic revalidation + a train number before the long jobs. Sessions
-# repeat (watcher keeps looping), so every output carries a per-session
-# suffix — a later flaky window can never clobber earlier good evidence.
+# One TPU relay window -> full evidence capture. Windows have ranged
+# ~10-30 min; order is strictly cheapest-first so a short window still
+# lands the Mosaic revalidation + a train number before the long jobs.
+# Sessions repeat (watcher keeps looping), so every output carries a
+# per-session suffix — a later flaky window can never clobber earlier
+# good evidence.
 cd /root/repo
 P=/root/repo/.perf
 LOG=$P/watcher.log
@@ -22,43 +23,52 @@ run() { # name timeout cmd...
   echo "$name rc=$?" >> $LOG
 }
 
+snapshot() {
+  # suffix-copy serving artifacts (re)written THIS session — idempotent,
+  # run after every producer so a mid-suite death can't leave evidence
+  # clobberable by the next session
+  local f
+  for f in BENCH_SERVING.json BENCH_SERVING_FAST.json \
+           BENCH_SERVING.json.partial BENCH_SERVING_FAST.json.partial; do
+    [ -f "$f" ] && [ "$f" -nt "$P/.session_start" ] && cp "$f" "$P/${f/.json/_${SFX}.json}"
+  done
+}
+
 # 0. op compatibility matrix on real silicon (seconds, no compile)
 run ds_report 300 python bin/ds_report
-# 1. Mosaic lowering revalidation (known ~80s when relay healthy)
+# 1. Mosaic lowering revalidation (~55s with warm cache, 12:28 UTC window)
 run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test_pallas_on_tpu.py -q
-# 2. fast train number (ONE compile at the known-fits footprint — lands a
-# real tok/s inside a short window)
+# 2. HBM fit map for the scanned ladder rungs (compile-only; every probe
+# compile lands in the persistent cache, so the ladder skips it later).
+# The 12:27 window proved bs8/no-remat OOMs — this replaces assumption
+# with measurement before any bench burns window time.
+run mem_triage 1500 python -u .perf/mem_triage.py 0 1 2
+# 3. fast train number: scanned mini-ladder (compiles cached by step 2)
 run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
-# 3. cheap compile triage: 4-layer fused step, xla vs flash attention
-# (stage 4 == the full bench config, covered by the bench runs themselves)
-run triage 1200 python .perf/triage_compile.py 2 3
-# 4. headline train number (anytime ladder: safe bs8 first, then bs16 /
-# bs16+dots try to beat it; last printed line = best completed rung)
-run bench 2400 python bench.py
-# 5. where-the-time-goes (drives the MFU iteration); scanned first (fast
-# compile, matches bench_fast's program), then the unrolled ladder program
-# with an xprof capture of 3 fused steps
+# 4. where-the-time-goes, scanned program (matches bench_fast's program)
 run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
-run bench_breakdown 1800 env DS_BENCH_TRACE=$P/xprof_$SFX python bench.py --breakdown
-# 6. serving decode, fast first (paged @1k ctx, 2-3 compiles) then the
-# full sweep (writes BENCH_SERVING.json at repo root, incrementally).
+# 5. serving decode, fast (paged @1k ctx, 2-3 compiles)
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
+snapshot  # serving evidence suffixed NOW — a session death during the
+          # long steps 6-8 must not leave it clobberable by the next window
+# 6. headline train number (full anytime ladder: scanned rungs first,
+# then the unrolled programs — their cold compile only pays off once the
+# persistent cache carries it across windows)
+run bench 2400 python bench.py
+# 7. where-the-time-goes, unrolled + xprof capture of 3 fused steps
+run bench_breakdown 1800 env DS_BENCH_TRACE=$P/xprof_$SFX python bench.py --breakdown
+# 8. serving full sweep (writes BENCH_SERVING.json at repo root, incrementally)
 run bench_serving 2400 python bench_serving.py
-# snapshot only files actually (re)written THIS session — stale evidence
-# from an earlier run must not get restamped with a new session id
-for f in BENCH_SERVING.json BENCH_SERVING_FAST.json \
-         BENCH_SERVING.json.partial BENCH_SERVING_FAST.json.partial; do
-  [ -f "$f" ] && [ "$f" -nt "$P/.session_start" ] && cp "$f" "$P/${f/.json/_${SFX}.json}"
-done
-# 7. NVMe bandwidth (GDS-analog evidence)
+snapshot
+# 9. NVMe bandwidth (GDS-analog evidence)
 run nvme 1200 python bin/ds_nvme_bench --o_direct
-# 8. driver-entry compile check on the real chip (the driver only runs it
+# 10. driver-entry compile check on the real chip (the driver only runs it
 # single-chip; prove it here while we have silicon)
 run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
-# 9. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
+# 11. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
 # flash + selective remat)
 run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
-# 10. flash block sweep. VMEM math at hd=64/seq1024: even 1024-wide
+# 12. flash block sweep. VMEM math at hd=64/seq1024: even 1024-wide
 # blocks fit comfortably (<1MB/step scratch), so include whole-sequence
 # blocks — fewest grid steps, max MXU work per program.
 for B in "256,512" "512,512" "512,1024" "1024,1024"; do

@@ -52,19 +52,17 @@ def main():
 
     import deepspeed_tpu
     from deepspeed_tpu.models import init_llama
-    from bench import bench_config
+    from bench import bench_config, bench_engine_config
 
     def fused(nlayers, attn_impl, tag, batch=8, scan=False):
         t = time.time()
-        # the bench's own config (single source of truth) at reduced depth
+        # the bench's own configs (single source of truth) at reduced depth
         cfg = bench_config(num_hidden_layers=nlayers, attn_impl=attn_impl,
                            scan_layers=scan)
         model, params = init_llama(cfg)
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, model_parameters=params,
-            config={"train_batch_size": batch,
-                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-                    "bf16": {"enabled": True}, "steps_per_print": 0})
+            config=bench_engine_config(batch))
         ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, 1024)),
                           dtype=jnp.int32)
         stamp(f"{tag}: engine built ({time.time()-t:.1f}s), compiling step...")

@@ -465,10 +465,7 @@ class DeepSpeedTpuEngine:
             qwz_gather = make_qwz_param_gather(self.mesh_ctx, self.param_shardings,
                                                qgz=zc.zero_quantized_gradients)
 
-        def loss_of(params, args, kwargs, static_kv, scale):
-            if qwz_gather is not None:
-                params = qwz_gather(params)
-            cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
+        def loss_from_cparams(cparams, args, kwargs, static_kv, scale):
             out = apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))
             if self._loss_fn is not None:
                 loss = self._loss_fn(out)
@@ -480,8 +477,32 @@ class DeepSpeedTpuEngine:
                 scaled = scaled * scale
             return scaled, loss
 
+        def loss_of(params, args, kwargs, static_kv, scale):
+            if qwz_gather is not None:
+                params = qwz_gather(params)
+            cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
+            return loss_from_cparams(cparams, args, kwargs, static_kv, scale)
+
+        def value_and_grads(params, args, kwargs, static_kv, scale):
+            """((scaled, loss), grads) for one microbatch. When possible,
+            differentiate wrt the COMPUTE-dtype cast of the params, not the
+            fp32 masters: bit-identical values (the cast's VJP is an exact
+            bf16->fp32 up-cast, so the fp32 cotangent holds the same
+            bf16-representable numbers), but the grad tree is STORED at
+            compute dtype — half the gradient HBM at the global-norm
+            barrier, where every grad is live at once, and the consumers'
+            up-casts fuse into each leaf's optimizer update / accumulate."""
+            if compute_dtype != jnp.float32 and qwz_gather is None:
+                cparams = jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype), params)
+                return jax.value_and_grad(loss_from_cparams, has_aux=True)(
+                    cparams, args, kwargs, static_kv, scale)
+            return jax.value_and_grad(loss_of, has_aux=True)(
+                params, args, kwargs, static_kv, scale)
+
         def fwd_bwd(params, acc, scale, args, kwargs, static_kv):
-            (scaled, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            # fp32 acc keeps full accumulation precision across microbatches
+            (scaled, loss), grads = value_and_grads(
                 params, args, kwargs, static_kv, scale)
             new_acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, grads)
             return loss, new_acc
@@ -543,7 +564,7 @@ class DeepSpeedTpuEngine:
         # host-driven kernel launches; under XLA the fusion is free win)
         def train_step(params, opt_state, scale_state, args, kwargs, static_kv):
             scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
-            (_, loss), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            (_, loss), grads = value_and_grads(
                 params, args, kwargs, static_kv, scale)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / scale, grads)
             overflow = has_overflow(grads) if use_scaling else jnp.bool_(False)
