@@ -31,20 +31,22 @@ GRID = [
     ("scan/none", True, False, (8, 4)),
     ("scan/dots", True, "dots_saveable", (8, 16)),
     ("scan/full", True, True, (4,)),
+    # same params/FLOPs, MXU-friendlier head shape (bench ladder rung);
+    # scanned, so it stays AHEAD of the >=25-min unrolled monsters
+    ("scan/none/hd128", True, False, (8,), 8),
     ("unroll/none", False, False, (8,)),
     ("unroll/dots", False, "dots_saveable", (16,)),
 ]
 
 
-def probe(label, scan, remat, batches):
+def probe(label, scan, remat, batches, heads=None):
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu
     from deepspeed_tpu.models import init_llama
-    from bench import bench_config
+    from bench import bench_config, bench_engine_config
 
-    from bench import bench_engine_config
-    cfg = bench_config(remat=remat, scan_layers=scan)
+    cfg = bench_config(remat=remat, heads=heads, scan_layers=scan)
     model, params = init_llama(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,

@@ -51,7 +51,7 @@ def _relay_up(env, timeout=150) -> bool:
         return False
 
 
-def bench_config(remat=False, **overrides):
+def bench_config(remat=False, heads=None, **overrides):
     """THE bench model: ~0.4B params, sized to fit one v5e chip (16 GB HBM)
     with Adam fp32 states. ce_chunk_size: streamed unembed+CE
     (ops/chunked_ce.py) — the [tokens, 32k] logits tensor (2.1 GB fp32 at
@@ -65,6 +65,13 @@ def bench_config(remat=False, **overrides):
               num_hidden_layers=24, num_attention_heads=16,
               num_key_value_heads=16, max_position_embeddings=2048,
               remat=bool(remat), remat_policy=policy, ce_chunk_size=8000)
+    if heads is not None:
+        # head-count override at the SAME hidden size: 8h x hd128 keeps
+        # params and FLOPs identical to 16h x hd64 (d_attn = 1024 either
+        # way) but contracts the flash q.kT matmul over the MXU's full
+        # 128-deep K dim. One mapping here so the ladder rung and the
+        # mem_triage probe can't compile different HLO.
+        kw.update(num_attention_heads=heads, num_key_value_heads=heads)
     kw.update(overrides)
     return LlamaConfig(**kw)
 
@@ -80,14 +87,18 @@ def bench_engine_config(batch):
             "steps_per_print": 0}
 
 
-def _measure_config(batch, seq, iters, remat, scan=False):
+def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
     """One measurement at a given batch/remat setting; raises on OOM so the
     caller can fall back to a smaller footprint. ``remat`` is False, True
     (full recompute) or a jax.checkpoint_policies name (selective remat —
     bigger batches without full-remat's recompute tax). ``scan`` compiles
     the 24 layers as one nn.scan body (numerics-identical, tested) — ~10x
     less HLO to compile, which matters when the relay window is shorter
-    than the unrolled compile."""
+    than the unrolled compile. ``heads`` overrides the head count at the
+    SAME hidden size: 8 heads x hd128 has identical params and FLOPs to
+    the default 16 x hd64 (d_attn = 1024 either way) but contracts the
+    flash q.kT matmul over 128 elements — the MXU's full K depth — where
+    hd64 wastes half of it. Apples-to-apples on MFU, friendlier silicon."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -95,7 +106,7 @@ def _measure_config(batch, seq, iters, remat, scan=False):
     from deepspeed_tpu.models import LlamaConfig, init_llama
 
     platform = jax.devices()[0].platform
-    cfg = bench_config(remat, scan_layers=scan,
+    cfg = bench_config(remat, heads=heads, scan_layers=scan,
                        max_position_embeddings=max(2048, seq))
     if platform == "cpu":
         # diagnostic-fallback sizing: same model family, tractable on host
@@ -155,7 +166,8 @@ def _measure_config(batch, seq, iters, remat, scan=False):
         unit = (f"tokens/s (0.4B llama, bf16, fused step, "
                 f"bs{batch}xseq{seq}"
                 f"{', remat=' + str(remat) if remat else ''}"
-                f"{', scan_layers' if scan else ''})")
+                f"{', scan_layers' if scan else ''}"
+                f"{f', {heads}h x hd{cfg.head_dim_}' if heads else ''})")
     return {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -398,6 +410,9 @@ def measure():
     # UTC window proved bs8/no-remat can OOM — so the ladder interleaves
     # memory fallbacks instead of assuming a landing spot.
     scan_only = env_flag("DS_BENCH_SCAN")
+    # optional 6th element: head-count override at the same hidden size
+    # (8h x hd128 = identical params/FLOPs to 16h x hd64, but the flash
+    # q.kT contraction uses the MXU's full 128-deep K dim instead of half)
     attempts = [(8, 1024, 20, False, True),             # scanned safe start
                 (8, 1024, 20, "dots_saveable", True),   # memory fallback
                 (4, 1024, 20, False, True),             # second fallback
@@ -406,6 +421,7 @@ def measure():
                 # run BEFORE the unrolled rungs (their >=25-min cold compile
                 # can eat the window; the floor is skipped anyway once any
                 # rung above succeeded)
+                (8, 1024, 20, False, True, 8),          # hd128 head shape
                 (8, 1024, 20, False, False),            # unrolled: scheduling edge
                 (16, 1024, 20, "dots_saveable", False)]
     if env_flag("DS_BENCH_LONGSEQ"):
@@ -424,15 +440,17 @@ def measure():
                     (4, 1024, 10, True, True)]
     best = None
     last_err = None
-    for batch, seq, iters, remat, scan in attempts:
+    for batch, seq, iters, remat, scan, *rest in attempts:
+        heads = rest[0] if rest else None
         if scan_only and not scan:
             continue  # DS_BENCH_SCAN=1: scanned programs only (compile budget)
         if best is not None and remat is True:
             continue  # the full-remat floor can't beat a no-remat success
-        print(f"ladder: trying bs{batch} seq{seq} remat={remat} scan={scan}",
-              file=sys.stderr)
+        print(f"ladder: trying bs{batch} seq{seq} remat={remat} scan={scan}"
+              f"{f' heads={heads}' if heads else ''}", file=sys.stderr)
         try:
-            out = _measure_config(batch, seq, iters, remat, scan=scan)
+            out = _measure_config(batch, seq, iters, remat, scan=scan,
+                                  heads=heads)
         except Exception as e:  # noqa: BLE001 — RESOURCE_EXHAUSTED etc.
             msg = str(e)
             if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg:

@@ -99,9 +99,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
 
     def _compute():
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
-        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
+        # matmul operands stay in the INPUT dtype (bf16 on the training
+        # path): the MXU's fast path is bf16 x bf16 with fp32 accumulation
+        # (preferred_element_type) — casting operands to fp32 first would
+        # run every dot at the several-fold-slower fp32 rate. All softmax
+        # arithmetic happens on the fp32 accumulator outputs.
+        q = q_ref[0].reshape(g * bq, d)
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:  # Gemma-2: cap BEFORE masking
@@ -123,7 +128,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         p = jnp.where(s <= NEG_INF, 0.0, p)
         corr = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - m_safe))
         l_cur = l_prev * corr + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
+        # p back to the input dtype for the MXU (standard flash practice —
+        # GPU flash uses fp16/bf16 P too); the accumulator stays fp32
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr + pv
         m_s[:] = m_cur
@@ -228,10 +235,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
 
     def _compute():
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
-        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        # operands stay in the input dtype for the MXU fast path (see
+        # _fwd_kernel); fp32 only on accumulator outputs + softmax math
+        q = q_ref[0].reshape(g * bq, d)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].reshape(g * bq, d)
         # lse/delta carry a trailing unit lane dim so this reshape is a
         # supported Mosaic cast (minormost dim preserved); no 1D intermediates
         lse = lse_ref[0].reshape(g * bq, 1)
@@ -256,7 +265,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         ds = p * (dp - delta) * scale
         if softcap is not None:  # chain through d/ds cap*tanh(s/cap) = 1 - t^2
             ds = ds * (1.0 - t * t)
-        dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
+        dq_acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     cond = True
@@ -291,10 +301,12 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def _compute():
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
-        q = q_ref[0].reshape(g * bq, d).astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].reshape(g * bq, d).astype(jnp.float32)
+        # operands stay in the input dtype for the MXU fast path (see
+        # _fwd_kernel); fp32 only on accumulator outputs + softmax math
+        q = q_ref[0].reshape(g * bq, d)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].reshape(g * bq, d)
         lse = lse_ref[0].reshape(g * bq, 1)
         delta = delta_ref[0].reshape(g * bq, 1)
 
@@ -314,14 +326,16 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(s <= NEG_INF, 0.0, p)
         # dv += pᵀ @ do ; dk += dsᵀ @ q — over the folded G*BQ rows, which
         # also sums the G query heads sharing this KV head (GQA reduce)
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         if softcap is not None:
             ds = ds * (1.0 - t * t)
-        dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     cond = True

@@ -76,13 +76,16 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
 
     @pl.when(live)
     def _accumulate():
-        # q: [1, N, 1, G, D] -> [N*G, D]; kv: [1, 2, 1, page, D]
-        q = q_ref[...].astype(jnp.float32)
+        # q: [1, N, 1, G, D] -> [N*G, D]; kv: [1, 2, 1, page, D].
+        # Operands stay in the cache dtype: the MXU fast path is
+        # bf16 x bf16 with fp32 accumulation (preferred_element_type);
+        # pre-casting to fp32 would run the dots several-fold slower.
+        q = q_ref[...]
         n, g, d = q.shape[1], q.shape[3], q.shape[4]
         ng = n * g
         q = q.reshape(ng, d)
-        k = kv_ref[0, 0, 0].astype(jnp.float32)  # [page, D]
-        v = kv_ref[0, 1, 0].astype(jnp.float32)
+        k = kv_ref[0, 0, 0]  # [page, D]
+        v = kv_ref[0, 1, 0]
 
         scores = jax.lax.dot_general(
             q, k, (((1, ), (1, )), ((), ())),
@@ -119,7 +122,8 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         p = jnp.where(mask, jnp.exp(masked - m_new), 0.0)  # [NG, page]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = m_new
         l_scr[...] = l_new
 

@@ -73,9 +73,12 @@ def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(ai < count_ref[h, qi])
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [block, D]
-        k = k_ref[0].astype(jnp.float32)  # [block, D]
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in the input dtype: the MXU fast path is
+        # bf16 x bf16 with fp32 accumulation (preferred_element_type);
+        # softmax math runs on the fp32 accumulator outputs
+        q = q_ref[0]  # [block, D]
+        k = k_ref[0]  # [block, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         m_prev, l_prev = m_s[:, 0], l_s[:, 0]
@@ -83,7 +86,7 @@ def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_cur[:, None])
         corr = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - m_cur))
         l_s[:, 0] = l_prev * corr + p.sum(axis=-1)
-        pv = jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr[:, None] + pv
         m_s[:, 0] = m_cur

@@ -1,0 +1,81 @@
+"""Bench ladder contract tests (no chip needed).
+
+The anytime ladder is the round's perf-evidence instrument; these pin the
+invariants a relay window depends on:
+- every rung parses (5-tuple or 6-tuple with a head-count override);
+- reliably-landing rungs (scanned / full-remat floor) come before any
+  unrolled rung, whose cold compile is the >=25-min monster;
+- the 8h x hd128 rung is the SAME model (param count) as 16h x hd64, so
+  its MFU is apples-to-apples (bench.py ranks rungs by vs_baseline);
+- bench_engine_config is the single config source the triage scripts
+  import (HLO identity is what makes cache pre-warming real).
+"""
+
+import numpy as np
+import pytest
+
+
+def _ladder(monkeypatch, **env):
+    import bench
+    for k in ("DS_BENCH_FAST", "DS_BENCH_LONGSEQ", "DS_BENCH_SCAN"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    captured = {}
+
+    def fake_measure(batch, seq, iters, remat, scan=False, heads=None):
+        captured.setdefault("rungs", []).append((batch, seq, remat, scan, heads))
+        # pretend every rung OOMs so the full ladder unrolls
+        raise RuntimeError("RESOURCE_EXHAUSTED (test)")
+
+    monkeypatch.setattr(bench, "_measure_config", fake_measure)
+    with pytest.raises(RuntimeError, match="all bench footprints OOMed"):
+        bench.measure()
+    return captured["rungs"]
+
+
+def test_default_ladder_orders_reliable_rungs_first(monkeypatch):
+    rungs = _ladder(monkeypatch)
+    scans = [r[3] for r in rungs]
+    # every scanned rung (incl. the full-remat floor) precedes every
+    # unrolled rung
+    first_unrolled = scans.index(False)
+    assert all(s is False for s in scans[first_unrolled:])
+    assert any(r[2] is True for r in rungs[:first_unrolled]), \
+        "full-remat floor must run before the unrolled cold compiles"
+    # the hd128 head-shape rung is present and scanned
+    assert (8, 1024, False, True, 8) in rungs
+
+
+def test_fast_ladder_is_scanned_with_fallbacks(monkeypatch):
+    rungs = _ladder(monkeypatch, DS_BENCH_FAST="1")
+    assert len(rungs) >= 3, "FAST mode must be a ladder, not a single rung"
+    assert all(r[3] for r in rungs), "FAST rungs must all be scanned"
+    assert rungs[-1][2] is True, "FAST ladder needs the full-remat floor"
+
+
+def test_scan_only_filter_drops_unrolled(monkeypatch):
+    rungs = _ladder(monkeypatch, DS_BENCH_SCAN="1")
+    assert rungs and all(r[3] for r in rungs)
+
+
+def test_head_override_is_param_identical():
+    import jax
+    from bench import bench_config
+    from deepspeed_tpu.models import init_llama
+
+    n = lambda cfg: sum(int(np.prod(p.shape))
+                        for p in jax.tree_util.tree_leaves(init_llama(cfg)[1]))
+    c16 = bench_config(False, num_hidden_layers=1)
+    c8 = bench_config(False, heads=8, num_hidden_layers=1)
+    assert c8.head_dim_ == 128 and c16.head_dim_ == 64
+    assert n(c16) == n(c8)
+
+
+def test_triage_scripts_share_the_engine_config():
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[3]
+    for probe in (".perf/mem_triage.py", ".perf/triage_compile.py"):
+        src = (root / probe).read_text()
+        assert "bench_engine_config" in src, probe
+        assert '"optimizer"' not in src, f"{probe} hand-rolls the DS config"
