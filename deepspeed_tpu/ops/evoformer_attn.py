@@ -26,7 +26,10 @@ from .registry import registry
 
 
 def _dense_attention(q, k, v, biases, scale):
-    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32) * scale
+    # operands stay in the input dtype (MXU bf16 fast path); fp32 comes
+    # from the dot's accumulator (preferred_element_type), not a pre-cast
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     for b in biases:
         logits = logits + b.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -75,12 +78,13 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # [*, H, Lq, Lk] biases, split along the key axis per scan step
     bcast = [jnp.broadcast_to(b, b.shape[:-2] + (q.shape[-3], Lk)) for b in biases]
 
-    qf = (q.astype(jnp.float32) * scale)
-
     def body(carry, blk):
         m_prev, l_prev, acc = carry
         kb, vb, bias_blk = blk
-        logits = jnp.einsum("...qhd,...khd->...hqk", qf, kb.astype(jnp.float32))
+        # operands in input dtype (MXU bf16 fast path); the fp32 comes from
+        # the dot accumulator, and scale applies to the fp32 logits
+        logits = jnp.einsum("...qhd,...khd->...hqk", q, kb,
+                            preferred_element_type=jnp.float32) * scale
         for b in bias_blk:
             logits = logits + b.astype(jnp.float32)
         m_cur = jnp.max(logits, axis=-1)
@@ -88,7 +92,8 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(logits - m_new[..., None])
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("...hqk,...khd->...qhd", p, vb.astype(jnp.float32))
+        pv = jnp.einsum("...hqk,...khd->...qhd", p.astype(vb.dtype), vb,
+                        preferred_element_type=jnp.float32)
         # acc is [*, Lq, H, D]; corr is [*, H, Lq] -> move heads behind queries
         acc_new = acc * jnp.moveaxis(corr, -2, -1)[..., None] + pv
         return (m_new, l_new, acc_new), None

