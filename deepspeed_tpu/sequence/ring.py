@@ -30,8 +30,11 @@ def _block_attn(q, k, v, bias, o, m, l, scale):
 
     q [b,sq,h,d], k/v [b,sk,h,d], bias broadcastable to [b,h,sq,sk];
     o [b,sq,h,d] fp32 accumulator, m/l [b,h,sq] running max / normalizer.
+    Matmul operands stay in the input dtype (MXU bf16 fast path); fp32
+    comes from the dot accumulators (preferred_element_type).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -41,7 +44,8 @@ def _block_attn(q, k, v, bias, o, m, l, scale):
     p = jnp.where(s <= NEG_INF, 0.0, p)
     correction = jnp.exp(jnp.where(m <= NEG_INF, NEG_INF, m - m_safe))
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return o_new, m_new, l_new
 
@@ -65,9 +69,14 @@ def ring_attention(q,
 
     q_pos = _global_positions(rank, s_local, p, layout)
 
-    o = jnp.zeros(q.shape, jnp.float32)
-    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s_local), jnp.float32)
+    # accumulators are seq-varying from birth (shard_map axis-variance
+    # tracking: the cond skip-branch and the fori_loop carry both require
+    # the branches'/iterations' types to agree)
+    o = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name, to="varying")
+    m = lax.pcast(jnp.full((b, h, s_local), NEG_INF, jnp.float32),
+                  axis_name, to="varying")
+    l = lax.pcast(jnp.zeros((b, h, s_local), jnp.float32),
+                  axis_name, to="varying")
 
     perm = [(i, (i + 1) % p) for i in range(p)]
 
@@ -75,11 +84,32 @@ def ring_attention(q,
         o, m, l, k_cur, v_cur = carry
         kv_rank = (rank - i) % p
         kv_pos = _global_positions(kv_rank, s_local, p, layout)
-        bias = None
         if causal:
-            mask = kv_pos[None, :] > q_pos[:, None]  # [sq, sk]
-            bias = jnp.where(mask, NEG_INF, 0.0)[None, None]
-        o, m, l = _block_attn(q, k_cur, v_cur, bias, o, m, l, scale)
+            # skip ring steps whose K/V shard is ENTIRELY in this Q shard's
+            # future (contiguous layout: every step with kv_rank > rank).
+            # The per-core scalar cond turns the causal triangle into real
+            # skipped FLOPs — ~(P+1)/2P of the dense work on average —
+            # while the unconditional ppermute below keeps the ring in
+            # lockstep (no collective ever sits inside the branch). The
+            # mask is built INSIDE the taken branch so skipped steps pay
+            # nothing.
+            visible = jnp.min(kv_pos) <= jnp.max(q_pos)
+
+            def _attend(args):
+                q_, k_, v_, o_, m_, l_ = args
+                mask = kv_pos[None, :] > q_pos[:, None]  # [sq, sk]
+                bias = jnp.where(mask, NEG_INF, 0.0)[None, None]
+                return _block_attn(q_, k_, v_, bias, o_, m_, l_, scale)
+
+            # the carries are seq-varying from init, so the passthrough
+            # matches the compute branch's axis-variance exactly
+            o, m, l = lax.cond(
+                visible,
+                _attend,
+                lambda args: (args[3], args[4], args[5]),
+                (q, k_cur, v_cur, o, m, l))
+        else:
+            o, m, l = _block_attn(q, k_cur, v_cur, None, o, m, l, scale)
         # rotate K/V to the next rank (the final hop restores the original
         # shard; unconditional rotation keeps the loop body branch-free)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
