@@ -194,8 +194,10 @@ class NvmeToHbmStreamer:
             arr = self.read_to_device(path, nbytes, jnp.uint8, (nbytes, ))
             jax.block_until_ready(arr)
         piped = nbytes * iters / (time.perf_counter() - t0)
-        # serial baseline
-        buf = np.empty(nbytes, np.uint8)
+        # serial baseline — aligned destination so O_DIRECT preads land
+        # straight in it (unaligned would bounce+memcpy and understate the
+        # baseline; the comparison must be against serial's best case)
+        buf = aligned_empty(nbytes)
         t0 = time.perf_counter()
         for _ in range(iters):
             self.aio.pread(path, buf)
