@@ -95,13 +95,17 @@ def _build_exp_config(base_config: Dict[str, Any], cand: Dict[str, Any]
 
 
 def run_candidate(base_config: Dict[str, Any], cand: Dict[str, Any],
-                  steps: int, model_builder: Callable, metric: str
-                  ) -> Dict[str, Any]:
+                  steps: int, model_builder: Callable, metric: str,
+                  compile_only: bool = False) -> Dict[str, Any]:
     """One experiment, start to finish (module-level so ``exp_isolation`` can
-    ship it to a spawned child). Returns {"status", "metric_val", "error"}."""
+    ship it to a spawned child). Returns {"status", "metric_val", "error"}.
+
+    ``compile_only``: lower+compile the fused train program and return XLA
+    buffer assignment's exact peak-memory verdict instead of running steps —
+    {"status": "fits", "predicted_bytes": N} / {"status": "oom", ...} /
+    {"status": "skip_prefit"} when no one-program step exists to lower."""
     import deepspeed_tpu
     from ..comm.mesh import reset_mesh_context
-    import jax.numpy as jnp
 
     try:
         cfg = _build_exp_config(base_config, cand)
@@ -116,17 +120,57 @@ def run_candidate(base_config: Dict[str, Any], cand: Dict[str, Any],
                                               config=cfg)
         hidden = np.asarray(jax.tree_util.tree_leaves(params)[0]).shape[0]
         bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
-        x = jnp.ones((bs, hidden), jnp.float32)
-        y = jnp.zeros_like(x)
-        # warmup (compile), then timed steps
-        loss = engine.forward(x, y)
-        engine.backward(loss)
-        engine.step()
-        t0 = time.perf_counter()
-        for _ in range(steps):
+        # batch built on HOST, device_put straight into the sharding
+        # fused_train_step uses (both branches, so the prefit's compiled
+        # program is the experiment's program): a jnp.ones would materialize
+        # the FULL global batch on one device first, and lowering replicated
+        # host arrays would charge it to every device — both falsely OOM
+        # viable candidates.
+        xh = np.ones((bs, hidden), np.float32)
+        yh = np.zeros_like(xh)
+        if compile_only:
+            fn = engine._train_step_fused
+            if fn is None:
+                return {"status": "skip_prefit", "metric_val": None, "error": None}
+            # the transfer sits inside the try so an allocation
+            # RESOURCE_EXHAUSTED classifies as oom, same as a compile one
+            try:
+                args = jax.device_put(
+                    (xh, yh), engine.zero_plan.batch_sharding((xh, yh)))
+                compiled = fn.lower(engine.params, engine.opt_state,
+                                    engine.scale_state, args, {}, ()).compile()
+            except Exception as e:  # noqa: BLE001
+                msg = str(e)
+                if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                    return {"status": "oom", "metric_val": None,
+                            "error": msg.splitlines()[0][:200] if msg else "OOM"}
+                raise
+            ma = compiled.memory_analysis()
+            pred = None
+            if ma is not None:
+                pred = int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                           + ma.output_size_in_bytes
+                           - getattr(ma, "alias_size_in_bytes", 0))
+            return {"status": "fits", "metric_val": None, "error": None,
+                    "predicted_bytes": pred}
+
+        x, y = jax.device_put((xh, yh), engine.zero_plan.batch_sharding((xh, yh)))
+        # warmup (compile), then timed steps — through the same dispatch
+        # production train_batch uses: the fused one-program step when it
+        # exists (also what the memory prefit compiled, so its verdict and
+        # warmed compile cache describe THIS program), else fwd/bwd/step
+        def one_step():
+            if engine._train_step_fused is not None:
+                return engine.fused_train_step(x, y)
             loss = engine.forward(x, y)
             engine.backward(loss)
             engine.step()
+            return loss
+
+        loss = one_step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = one_step()
         float(loss)  # host sync closes the timing region
         dt = (time.perf_counter() - t0) / steps
         if metric == "latency":
@@ -139,10 +183,12 @@ def run_candidate(base_config: Dict[str, Any], cand: Dict[str, Any],
                 "error": f"{type(e).__name__}: {e}"}
 
 
-def _isolated_child(conn, base_config, cand, steps, model_builder, metric):
+def _isolated_child(conn, base_config, cand, steps, model_builder, metric,
+                    compile_only=False):
     """Spawned-process entry: run the experiment, ship the result back."""
     try:
-        conn.send(run_candidate(base_config, cand, steps, model_builder, metric))
+        conn.send(run_candidate(base_config, cand, steps, model_builder, metric,
+                                compile_only=compile_only))
     finally:
         conn.close()
 
@@ -160,6 +206,14 @@ class Autotuner:
         self.model_builder = model_builder
         self.exps: List[_Experiment] = []
         self.best: Optional[_Experiment] = None
+        # (mb, stage, remat) -> XLA buffer-assignment peak bytes, filled by
+        # the compile-only memory prefit for every candidate it proved fits
+        self.prefit_predicted_bytes: Dict[Any, int] = {}
+
+    @staticmethod
+    def _cand_key(cand: Dict[str, Any]):
+        return (cand["train_micro_batch_size_per_gpu"], cand["zero_stage"],
+                bool(cand["remat"]))
 
     # ---- search space (reference _generate_experiments) ----
 
@@ -203,10 +257,12 @@ class Autotuner:
 
     # ---- experiment runner (reference scheduler.run_job) ----
 
-    def _measure(self, cand: Dict[str, Any], steps: int) -> Dict[str, Any]:
+    def _measure(self, cand: Dict[str, Any], steps: int,
+                 compile_only: bool = False) -> Dict[str, Any]:
         if not self.cfg.exp_isolation:
             return run_candidate(self.base_config, cand, steps,
-                                 self.model_builder, self.cfg.metric)
+                                 self.model_builder, self.cfg.metric,
+                                 compile_only=compile_only)
         # fresh child per experiment (reference scheduler.py:32 isolates
         # experiments for exactly this reason): a hard death — XLA OOM abort,
         # SIGKILL — is an "error" experiment, not a dead search. Raw Process
@@ -218,7 +274,8 @@ class Autotuner:
         try:
             proc = ctx.Process(target=_isolated_child,
                                args=(send, self.base_config, cand, steps,
-                                     self.model_builder, self.cfg.metric))
+                                     self.model_builder, self.cfg.metric,
+                                     compile_only))
             proc.start()
         except Exception as e:  # unpicklable builder etc.
             recv.close()
@@ -262,9 +319,132 @@ class Autotuner:
         preds = model.predict([space[i] for i in open_idx])
         return open_idx[int(np.argmax(preds))]
 
+    def _prefit_enabled(self) -> bool:
+        """memory_prefit=None means auto: prefit only where the compile-time
+        OOM oracle exists (TPU buffer assignment); on CPU every probe would
+        return "fits", paying an engine build per group for zero pruning."""
+        if self.cfg.memory_prefit is not None:
+            return self.cfg.memory_prefit
+        from ..ops.registry import on_tpu
+        return on_tpu()
+
+    def _memory_prefit(self, space: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Compile-only HBM prefit (config ``memory_prefit``): XLA buffer
+        assignment is an exact fit/OOM oracle on the target backend, so
+        provably-OOM candidates never spawn an experiment. Prunes
+        monotonically — once a micro-batch OOMs at a given (stage, remat),
+        every larger micro-batch there is pruned unprobed — and annotates
+        survivors with ``predicted_bytes``. Any unexpected prefit failure
+        keeps the candidate (the experiment itself remains the arbiter)."""
+        def probe(cand):
+            # through _measure so exp_isolation/exp_timeout protect the prefit
+            # exactly like an experiment (a builder that hard-aborts or hangs
+            # must not kill the search before it starts)
+            try:
+                return self._measure(cand, 0, compile_only=True)
+            except Exception as e:  # noqa: BLE001 — prefit never kills a search
+                logger.warning(f"autotune prefit error for {cand}: {e}")
+                # NOT skip_prefit: a transient probe failure says nothing
+                # about whether a fused program exists, so it must not bail
+                # the whole prefit (and discard other groups' proven prunes)
+                return {"status": "probe_error"}
+
+        def note(cand, res):
+            if res.get("predicted_bytes") is not None:
+                self.prefit_predicted_bytes[self._cand_key(cand)] = \
+                    res["predicted_bytes"]
+
+        by_group: Dict[Any, List[Dict[str, Any]]] = {}
+        for c in space:
+            by_group.setdefault(
+                (c.get("zero_stage"), c.get("remat")), []).append(c)
+        pruned: set = set()
+        for group in by_group.values():
+            group.sort(key=lambda c: c["train_micro_batch_size_per_gpu"])
+            # monotone fit boundary, found by bisection from the top: if the
+            # LARGEST micro-batch fits (the common case) the whole group is
+            # cleared with ONE compile; otherwise ~log2(len) probes locate
+            # the first OOM and everything at/above it is pruned unprobed
+            lo, hi = 0, len(group) - 1
+            res = probe(group[hi])
+            if res["status"] == "fits":
+                note(group[hi], res)
+                continue
+            if res["status"] == "skip_prefit":
+                # no fused program exists — a base-config property (gas>1,
+                # host-offload optimizer), not a candidate property: every
+                # further probe would pay an engine build for the same answer
+                logger.info("autotune prefit: no fused one-program step for "
+                            "this config — prefit skipped")
+                return space
+            if res["status"] != "oom":
+                # probe_error / build failure / backend hiccup: only a
+                # compile-proven OOM may prune — experiments decide this
+                # group, and other groups' proven prunes are kept
+                continue
+            first_oom = hi  # group[hi] OOMed; find the boundary below it
+            while lo < first_oom:
+                mid = (lo + first_oom) // 2
+                r = probe(group[mid])
+                if r["status"] == "oom":
+                    first_oom = mid
+                elif r["status"] == "fits":
+                    note(group[mid], r)
+                    lo = mid + 1
+                else:  # inconclusive mid-search: stop pruning below this point
+                    break
+            for cand in group[first_oom:]:
+                pruned.add(id(cand))
+                logger.info(f"autotune prefit: pruned {cand} (compile OOM)")
+        return [c for c in space if id(c) not in pruned]
+
+    def _enable_compile_cache(self) -> Callable[[], None]:
+        """Point JAX's persistent compilation cache at results_dir for the
+        search (unless the user already configured one). Fresh engines and
+        spawned children share NO in-memory jit cache, so this is the only
+        mechanism by which a prefit compile actually warms the matching
+        experiment's compile — set as env so exp_isolation children inherit.
+        Returns an undo() restoring prior state so the redirect does not
+        outlive the search (production compiles must not land in a
+        tuner-owned, disposable directory)."""
+        if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or getattr(jax.config, "jax_compilation_cache_dir", None)):
+            return lambda: None  # user's cache wins — search compiles warm it
+        path = os.path.join(self.cfg.results_dir, "jax_cache")
+        os.makedirs(path, exist_ok=True)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+        prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+        applied = False
+        try:
+            jax.config.update("jax_compilation_cache_dir", path)  # this process
+            applied = True
+        except Exception as e:  # pragma: no cover — cache is an optimization
+            logger.warning(f"autotune: persistent compile cache unavailable: {e}")
+
+        def undo() -> None:
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+            if applied:
+                try:
+                    jax.config.update("jax_compilation_cache_dir", prev)
+                except Exception:  # pragma: no cover
+                    pass
+        return undo
+
     def tune(self, steps: int = 3) -> Optional[Dict[str, Any]]:
         assert self.model_builder is not None, "model_builder is required to tune"
+        # the cache's one job is warming the prefit→experiment compile pair;
+        # without a prefit it is pure disk I/O + a global env mutation
+        undo_cache = (self._enable_compile_cache() if self._prefit_enabled()
+                      else (lambda: None))
+        try:
+            return self._tune_inner(steps)
+        finally:
+            undo_cache()
+
+    def _tune_inner(self, steps: int) -> Optional[Dict[str, Any]]:
         space = self._order(self.experiment_space())
+        if self._prefit_enabled():
+            space = self._memory_prefit(space)
         adaptive = self.cfg.tuner_type == "model_based"
         if not adaptive:
             space = space[:self.cfg.tuner_num_trials]

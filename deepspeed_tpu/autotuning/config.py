@@ -29,3 +29,19 @@ class AutotuningConfig(ConfigModel):
     # cannot poison the rest of the search)
     exp_isolation: bool = False
     exp_timeout: float = 600.0
+    # compile-only HBM prefit before any experiment runs: XLA buffer
+    # assignment is an EXACT memory oracle on TPU (the reference's
+    # model-based memory estimate, minus the estimation), so provably-OOM
+    # candidates never cost a timed experiment, and every candidate the
+    # prefit proved to fit carries its predicted peak bytes
+    # (``Autotuner.prefit_predicted_bytes``). Monotone pruning: once a
+    # micro-batch OOMs at a given (stage, remat), every larger micro-batch
+    # there is pruned too. Probes run under the same exp_isolation/
+    # exp_timeout protection as experiments; tune() points JAX's persistent
+    # compilation cache at results_dir (unless one is configured) so a
+    # prefit compile warms the matching experiment's compile — including
+    # across exp_isolation child processes. Default None = auto: prefit on
+    # TPU backends (where compile-time buffer assignment actually raises
+    # RESOURCE_EXHAUSTED), off elsewhere (CPU compiles never OOM, so probes
+    # would be pure overhead); True/False force it.
+    memory_prefit: Optional[bool] = None

@@ -118,6 +118,92 @@ def test_cost_model_tuner_beats_grid(tmp_path):
     assert pred_best == best_cand  # quadratic basis represents the surface
 
 
+def test_memory_prefit_auto_gating(tmp_path):
+    """memory_prefit=None (the default) resolves by backend: off on CPU where
+    compile never OOMs (probes would be pure overhead), on for TPU; an
+    explicit True/False always wins."""
+    mk = lambda v: Autotuner(BASE, AutotuningConfig(
+        enabled=True, results_dir=str(tmp_path), memory_prefit=v))
+    assert mk(True)._prefit_enabled() is True
+    assert mk(False)._prefit_enabled() is False
+    from deepspeed_tpu.ops.registry import on_tpu
+    assert mk(None)._prefit_enabled() is on_tpu()  # CPU mesh in CI -> False
+
+
+def test_memory_prefit_prunes_monotone(tmp_path):
+    """Compile-only HBM prefit: a proven OOM at micro-batch B prunes every
+    micro-batch >= B in the same (stage, remat) group, fits are annotated
+    with predicted bytes, and the boundary is found in O(log n) probes —
+    NOT one compile per candidate."""
+    cfg = AutotuningConfig(enabled=True, num_tuning_micro_batch_sizes=4,
+                           zero_stages=[0, 2], results_dir=str(tmp_path))
+    at = Autotuner(BASE, cfg, model_builder=lambda: None)
+    probes = []
+
+    def oracle(cand, steps, compile_only=False):
+        assert compile_only and steps == 0
+        probes.append(at._cand_key(cand))
+        mb, stage = cand["train_micro_batch_size_per_gpu"], cand["zero_stage"]
+        limit = 2 if stage == 0 else 8  # stage-2 sharding fits more
+        if mb > limit:
+            return {"status": "oom", "metric_val": None, "error": "RESOURCE_EXHAUSTED"}
+        return {"status": "fits", "metric_val": None, "error": None,
+                "predicted_bytes": mb * 1000 + stage}
+
+    at._measure = oracle
+    space = at.experiment_space()  # mb {1,2,4,8} x stage {0,2} x remat = 16
+    kept = at._memory_prefit(space)
+    for c in kept:
+        assert c["train_micro_batch_size_per_gpu"] <= (2 if c["zero_stage"] == 0 else 8)
+    # stage 0 loses mb 4+8 in both remat groups; stage 2 keeps all
+    assert len(kept) == 16 - 4
+    # stage-2 groups: ONE top probe (mb=8 fits) cleared 4 candidates
+    assert len([k for k in probes if k[1] == 2]) == 2
+    assert at.prefit_predicted_bytes[(8, 2, False)] == 8002
+    assert (2, 0, True) in at.prefit_predicted_bytes
+    # no candidate dict was polluted with annotation keys
+    assert all(set(c) == {"train_micro_batch_size_per_gpu", "zero_stage", "remat"}
+               for c in kept)
+
+
+def test_memory_prefit_errors_never_prune(tmp_path):
+    """A builder failure / missing fused program / backend hiccup during the
+    prefit must leave the space untouched — only a compile-proven OOM prunes
+    (the experiment itself stays the arbiter of everything else)."""
+    cfg = AutotuningConfig(enabled=True, num_tuning_micro_batch_sizes=3,
+                           zero_stages=[1], results_dir=str(tmp_path))
+    at = Autotuner(BASE, cfg, model_builder=lambda: None)
+    at._measure = lambda cand, steps, compile_only=False: {
+        "status": "error", "metric_val": None, "error": "builder exploded"}
+    space = at.experiment_space()
+    assert at._memory_prefit(space) == space
+
+    at2 = Autotuner(BASE, cfg, model_builder=lambda: None)
+    # _measure that pre-dates the compile_only kwarg (a user-stubbed runner):
+    # probe() must swallow the TypeError and skip, not crash tune()
+    at2._measure = lambda cand, steps: {"status": "done"}
+    assert at2._memory_prefit(space) == space
+
+
+def test_memory_prefit_skip_bails_after_one_probe(tmp_path):
+    """skip_prefit means no fused one-program step exists — a base-config
+    property (gas>1 / host offload), not a candidate property. The prefit
+    must bail after ONE probe, not pay an engine build per group."""
+    cfg = AutotuningConfig(enabled=True, num_tuning_micro_batch_sizes=4,
+                           zero_stages=[0, 1, 2, 3], results_dir=str(tmp_path))
+    at = Autotuner(BASE, cfg, model_builder=lambda: None)
+    calls = []
+
+    def oracle(cand, steps, compile_only=False):
+        calls.append(cand)
+        return {"status": "skip_prefit", "metric_val": None, "error": None}
+
+    at._measure = oracle
+    space = at.experiment_space()  # 4 mb x 4 stages x 2 remat = 32
+    assert at._memory_prefit(space) == space
+    assert len(calls) == 1
+
+
 def test_exp_isolation_survives_child_death(tmp_path):
     """Reference scheduler.py:32 isolates experiments in processes: a child
     hard-killed mid-experiment (XLA OOM abort) is an 'error' record, the
