@@ -44,7 +44,7 @@ def probe(label, scan, remat, batches, heads=None):
     import jax.numpy as jnp
     import deepspeed_tpu
     from deepspeed_tpu.models import init_llama
-    from bench import bench_config, bench_engine_config
+    from bench import bench_config, bench_engine_config, journal_triage_record
 
     cfg = bench_config(remat=remat, heads=heads, scan_layers=scan)
     model, params = init_llama(cfg)
@@ -62,18 +62,29 @@ def probe(label, scan, remat, batches, heads=None):
                 (ids,), {"labels": ids}, ())
             compiled = lowered.compile()
             ma = compiled.memory_analysis()
+            tot = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                   + ma.output_size_in_bytes - ma.alias_size_in_bytes)
             stamp(f"{label} bs{batch}: FITS ({time.time()-t:.0f}s compile) "
                   f"temp={ma.temp_size_in_bytes/GiB:.2f}G "
                   f"args={ma.argument_size_in_bytes/GiB:.2f}G "
                   f"out={ma.output_size_in_bytes/GiB:.2f}G "
                   f"alias={ma.alias_size_in_bytes/GiB:.2f}G "
-                  f"tot={(ma.temp_size_in_bytes + ma.argument_size_in_bytes + ma.output_size_in_bytes - ma.alias_size_in_bytes)/GiB:.2f}G")
+                  f"tot={tot/GiB:.2f}G")
+            journal_triage_record(batch, 1024, remat, scan, heads, "fit",
+                                  nbytes=int(tot))
         except Exception as e:  # noqa: BLE001
             msg = str(e)
             head = msg.splitlines()[0][:160] if msg else type(e).__name__
-            kind = "OOM" if ("RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()) \
-                else "ERR"
-            stamp(f"{label} bs{batch}: {kind} ({time.time()-t:.0f}s) {head}")
+            # STRICT classifier (same as the bench ladder's): a journaled
+            # "oom" verdict suppresses a rung for 24h, so a transient error
+            # that merely mentions "memory" must record as "err", not "oom"
+            oom = "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+            stamp(f"{label} bs{batch}: {'OOM' if oom else 'ERR'} "
+                  f"({time.time()-t:.0f}s) {head}")
+            # the journal verdict lets the bench ladder SKIP a proven-OOM
+            # rung instead of re-paying its doomed (uncacheable) compile
+            journal_triage_record(batch, 1024, remat, scan, heads,
+                                  "oom" if oom else "err")
     del engine, params, model
     gc.collect()
     jax.clear_caches()

@@ -197,6 +197,39 @@ def _git_rev():
         return None
 
 
+def _journal_append(path, rec):
+    """Append one journal record, stamped with UTC time and git revision
+    (shared by the chip-result and mem-triage journals — one writer)."""
+    try:
+        rec = dict(rec, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                   ts=time.time(), rev=_git_rev())
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def _journal_records(path):
+    """All parseable dict records in a journal. A torn tail write (killed
+    mid-append) must never void the good lines before it."""
+    recs = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    r = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(r, dict):
+                    recs.append(r)
+    except OSError:
+        pass
+    return recs
+
+
 def _journal_chip_result(out):
     """Every real-chip measurement is appended to a journal the moment it
     lands, stamped with UTC time and the git revision. The relay is up in
@@ -204,14 +237,7 @@ def _journal_chip_result(out):
     that case the supervisor replays the best SAME-REVISION, fresh
     journaled chip number (with provenance) instead of recording a
     meaningless CPU diagnostic over real evidence."""
-    try:
-        rec = dict(out, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                   ts=time.time(), rev=_git_rev())
-        os.makedirs(os.path.dirname(_journal_path()), exist_ok=True)
-        with open(_journal_path(), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-    except OSError:
-        pass
+    _journal_append(_journal_path(), out)
 
 
 _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
@@ -224,20 +250,8 @@ def _best_journaled_chip_result(max_age_h=24.0):
     exact-rev matching would discard the round's evidence) but the
     measuring revision is stamped into the label, so a replay can never
     silently attribute an old number to new code."""
-    recs = []
-    try:
-        with open(_journal_path()) as f:
-            for ln in f:
-                if not ln.strip():
-                    continue
-                try:
-                    r = json.loads(ln)
-                except ValueError:
-                    continue  # a torn write must not void the good lines
-                if isinstance(r, dict) and _REQUIRED_KEYS <= r.keys():
-                    recs.append(r)
-    except OSError:
-        return None
+    recs = [r for r in _journal_records(_journal_path())
+            if _REQUIRED_KEYS <= r.keys()]
     now = time.time()
     recs = [r for r in recs
             if r.get("vs_baseline", 0) > 0
@@ -254,6 +268,65 @@ def _best_journaled_chip_result(max_age_h=24.0):
     best["unit"] += (f" [chip measurement {ts} @{mrev}, replayed: "
                      f"relay down at report time]")
     return best
+
+
+def _triage_journal_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".perf", "mem_triage.jsonl")
+
+
+def _device_kind():
+    try:
+        import jax
+        return getattr(jax.devices()[0], "device_kind", None)
+    except Exception:  # noqa: BLE001 — relay down / no backend
+        return None
+
+
+def journal_triage_record(batch, seq, remat, scan, heads, status, nbytes=None):
+    """Append one mem-triage probe verdict (fit/oom/err) so the bench ladder
+    can act on it. mem_triage.py (compile-only probes, run by chip_session
+    ahead of the bench) imports and calls this — one journal format, one
+    writer. Records are scoped to git revision and device kind: a verdict
+    earned by other code or another chip must never skip a rung."""
+    _journal_append(_triage_journal_path(),
+                    {"batch": batch, "seq": seq, "remat": remat,
+                     "scan": bool(scan), "heads": heads, "status": status,
+                     "bytes": nbytes, "device_kind": _device_kind()})
+
+
+def _triage_verdicts(max_age_h=24.0):
+    """Latest fresh fit/oom verdict per rung, keyed
+    ``(batch, seq, remat, scan, heads)``. Only records whose git revision
+    AND device kind match the present ones are trusted (memory layout
+    moves with code; HBM size with the chip). Computed once per ladder —
+    not per rung — so git/jax/the journal are consulted once."""
+    kind = _device_kind()
+    rev = _git_rev()
+    if kind is None or rev is None:
+        return {}
+    now = time.time()
+    best = {}
+    for r in _journal_records(_triage_journal_path()):
+        if not (r.get("rev") == rev and r.get("device_kind") == kind
+                and isinstance(r.get("ts"), (int, float))
+                and now - r["ts"] < max_age_h * 3600
+                and r.get("status") in ("fit", "oom")):
+            continue
+        k = (r.get("batch"), r.get("seq"), r.get("remat"),
+             bool(r.get("scan")), r.get("heads"))
+        if k not in best or r["ts"] > best[k]["ts"]:
+            best[k] = r
+    return {k: r["status"] for k, r in best.items()}
+
+
+def _triage_verdict(batch, seq, remat, scan, heads, max_age_h=24.0):
+    """Single-rung lookup over ``_triage_verdicts``. The ladder uses 'oom'
+    to skip a rung without re-paying its doomed compile (failed compiles
+    are never cached, so re-proving an OOM costs the full compile time out
+    of a live relay window)."""
+    return _triage_verdicts(max_age_h).get(
+        (batch, seq, remat, bool(scan), heads))
 
 
 def breakdown(batch=8, seq=1024, iters=10):
@@ -520,12 +593,21 @@ def measure():
                     (4, 1024, 10, True, True)]
     best = None
     last_err = None
+    verdicts = _triage_verdicts()  # one git/jax/journal consult per ladder
     for batch, seq, iters, remat, scan, *rest in attempts:
         heads = rest[0] if rest else None
         if scan_only and not scan:
             continue  # DS_BENCH_SCAN=1: scanned programs only (compile budget)
         if best is not None and remat is True:
             continue  # the full-remat floor can't beat a no-remat success
+        if verdicts.get((batch, seq, remat, bool(scan), heads)) == "oom":
+            # the compile-only triage already PROVED this rung exceeds HBM
+            # at this revision on this chip — re-proving it would burn a
+            # full (uncacheable, failed) compile out of the relay window
+            print(f"ladder: skipping bs{batch} remat={remat} scan={scan}"
+                  f"{f' heads={heads}' if heads else ''} (triage: proven OOM)",
+                  file=sys.stderr)
+            continue
         print(f"ladder: trying bs{batch} seq{seq} remat={remat} scan={scan}"
               f"{f' heads={heads}' if heads else ''}", file=sys.stderr)
         try:
@@ -558,7 +640,9 @@ def measure():
         if "DIAGNOSTIC" in out["unit"]:
             return  # CPU fallback sizing ignores the ladder; once is enough
     if best is None:
-        raise RuntimeError(f"all bench footprints OOMed: {last_err[-500:]}")
+        raise RuntimeError("all bench footprints OOMed: "
+                           + (last_err or "every rung skipped by triage "
+                              "verdicts")[-500:])
 
 
 def supervise():

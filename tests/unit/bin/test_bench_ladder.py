@@ -115,6 +115,59 @@ def test_chip_journal_replay_picks_best_and_stamps_provenance(tmp_path, monkeypa
     assert bench._best_journaled_chip_result()["value"] == 26000.0
 
 
+def test_triage_verdict_skips_proven_oom_rungs(tmp_path, monkeypatch):
+    """A mem-triage 'oom' verdict (same rev + device kind, fresh) makes the
+    ladder SKIP that rung — re-proving a known OOM costs a full uncacheable
+    compile out of a live relay window. Verdicts from another revision,
+    another chip, or beyond the freshness window never skip anything."""
+    import json
+    import time as _time
+    import bench
+
+    monkeypatch.setattr(bench, "_triage_journal_path",
+                        lambda: str(tmp_path / "mem_triage.jsonl"))
+    monkeypatch.setattr(bench, "_git_rev", lambda: "cafe123")
+    monkeypatch.setattr(bench, "_device_kind", lambda: "TPU v5e")
+
+    bench.journal_triage_record(8, 1024, False, True, None, "oom")
+    bench.journal_triage_record(8, 1024, "dots_saveable", True, None, "fit",
+                                nbytes=12_000_000_000)
+    assert bench._triage_verdict(8, 1024, False, True, None) == "oom"
+    assert bench._triage_verdict(8, 1024, "dots_saveable", True, None) == "fit"
+    assert bench._triage_verdict(4, 1024, False, True, None) is None  # unprobed
+
+    rungs = _ladder(monkeypatch)
+    assert (8, 1024, False, True, None) not in rungs, \
+        "proven-OOM rung must be skipped"
+    assert (8, 1024, "dots_saveable", True, None) in rungs  # fit still runs
+
+    # a LATER fit verdict supersedes the old oom (e.g. after an HBM fix
+    # landed in the same revision's working tree was re-probed)
+    bench.journal_triage_record(8, 1024, False, True, None, "fit")
+    assert bench._triage_verdict(8, 1024, False, True, None) == "fit"
+    assert (8, 1024, False, True, None) in _ladder(monkeypatch)
+
+    # scoping: other revision / other chip / stale -> verdict is ignored
+    monkeypatch.setattr(bench, "_git_rev", lambda: "newrev99")
+    assert bench._triage_verdict(8, 1024, "dots_saveable", True, None) is None
+    monkeypatch.setattr(bench, "_git_rev", lambda: "cafe123")
+    monkeypatch.setattr(bench, "_device_kind", lambda: "TPU v4")
+    assert bench._triage_verdict(8, 1024, "dots_saveable", True, None) is None
+    monkeypatch.setattr(bench, "_device_kind", lambda: "TPU v5e")
+    rec = {"batch": 16, "seq": 1024, "remat": "dots_saveable", "scan": True,
+           "heads": None, "status": "oom", "rev": "cafe123",
+           "device_kind": "TPU v5e", "ts": _time.time() - 90 * 3600}
+    with open(tmp_path / "mem_triage.jsonl", "a") as f:
+        f.write(json.dumps(rec) + "\n" + "{torn")
+    assert bench._triage_verdict(16, 1024, "dots_saveable", True, None) is None
+    # the torn tail line must not void earlier verdicts
+    assert bench._triage_verdict(8, 1024, False, True, None) == "fit"
+
+    # no device kind (relay down at lookup time) -> never skip
+    monkeypatch.setattr(bench, "_device_kind", lambda: None)
+    assert bench._triage_verdict(8, 1024, False, True, None) is None
+
+
 def test_triage_scripts_share_the_engine_config():
     import pathlib
     root = pathlib.Path(__file__).resolve().parents[3]
