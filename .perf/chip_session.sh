@@ -42,7 +42,9 @@ run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test
 # compile lands in the persistent cache, so the ladder skips it later).
 # The 12:27 window proved bs8/no-remat OOMs — this replaces assumption
 # with measurement before any bench burns window time.
-run mem_triage 1500 python -u .perf/mem_triage.py 0 1 2 3
+# (1800s: the chunk6 probe added ~one multi-minute compile; with a warm
+# persistent cache the whole stage is seconds)
+run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4
 # 3. fast train number: scanned mini-ladder (compiles cached by step 2)
 run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
 # 4. where-the-time-goes, scanned program (matches bench_fast's program)

@@ -72,6 +72,15 @@ def bench_config(remat=False, heads=None, **overrides):
         # 128-deep K dim. One mapping here so the ladder rung and the
         # mem_triage probe can't compile different HLO.
         kw.update(num_attention_heads=heads, num_key_value_heads=heads)
+    # scan_layers accepts the ladder's scan value directly: False/True, or an
+    # int chunk size N>1 = scan over chunks of N unrolled layers — the
+    # compile-time/perf middle ground between per-layer scan (~10x less HLO,
+    # least scheduling freedom) and fully unrolled (the >=25-min compile)
+    scan = overrides.pop("scan_layers", False)
+    if isinstance(scan, int) and not isinstance(scan, bool) and scan > 1:
+        kw.update(scan_layers=True, scan_chunk_size=scan)
+    else:
+        kw.update(scan_layers=bool(scan))
     kw.update(overrides)
     return LlamaConfig(**kw)
 
@@ -163,10 +172,13 @@ def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
         peak = get_accelerator().peak_bf16_flops()  # device_kind-aware
         mfu = achieved / peak
         mfu_ratio = round(mfu / 0.54, 4)
+        scan_tag = (f", scan_layers/chunk{cfg.scan_chunk_size}"
+                    if cfg.scan_chunk_size > 1 else
+                    (", scan_layers" if scan else ""))
         unit = (f"tokens/s (0.4B llama, bf16, fused step, "
                 f"bs{batch}xseq{seq}"
                 f"{', remat=' + str(remat) if remat else ''}"
-                f"{', scan_layers' if scan else ''}"
+                f"{scan_tag}"
                 f"{f', {heads}h x hd{cfg.head_dim_}' if heads else ''})")
     out = {
         "metric": "train_tokens_per_sec_per_chip",
@@ -199,13 +211,23 @@ def _git_rev():
 
 def _journal_append(path, rec):
     """Append one journal record, stamped with UTC time and git revision
-    (shared by the chip-result and mem-triage journals — one writer)."""
+    (shared by the chip-result and mem-triage journals — one writer).
+    Self-healing: if the file ends in a torn line (a writer killed
+    mid-append leaves no trailing newline), start on a fresh line so the
+    new record isn't concatenated into the torn one and lost with it."""
     try:
         rec = dict(rec, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                    ts=time.time(), rev=_git_rev())
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        needs_nl = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                needs_nl = f.read(1) != b"\n"
+        except (OSError, ValueError):  # missing or empty file
+            pass
         with open(path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+            f.write(("\n" if needs_nl else "") + json.dumps(rec) + "\n")
     except OSError:
         pass
 
@@ -291,7 +313,7 @@ def journal_triage_record(batch, seq, remat, scan, heads, status, nbytes=None):
     earned by other code or another chip must never skip a rung."""
     _journal_append(_triage_journal_path(),
                     {"batch": batch, "seq": seq, "remat": remat,
-                     "scan": bool(scan), "heads": heads, "status": status,
+                     "scan": scan, "heads": heads, "status": status,
                      "bytes": nbytes, "device_kind": _device_kind()})
 
 
@@ -313,8 +335,11 @@ def _triage_verdicts(max_age_h=24.0):
                 and now - r["ts"] < max_age_h * 3600
                 and r.get("status") in ("fit", "oom")):
             continue
+        # scan is kept RAW in the key: a chunk-size rung (scan=6) compiles a
+        # different program than per-layer scan (scan=True) — one's verdict
+        # must never suppress the other
         k = (r.get("batch"), r.get("seq"), r.get("remat"),
-             bool(r.get("scan")), r.get("heads"))
+             r.get("scan"), r.get("heads"))
         if k not in best or r["ts"] > best[k]["ts"]:
             best[k] = r
     return {k: r["status"] for k, r in best.items()}
@@ -325,8 +350,7 @@ def _triage_verdict(batch, seq, remat, scan, heads, max_age_h=24.0):
     to skip a rung without re-paying its doomed compile (failed compiles
     are never cached, so re-proving an OOM costs the full compile time out
     of a live relay window)."""
-    return _triage_verdicts(max_age_h).get(
-        (batch, seq, remat, bool(scan), heads))
+    return _triage_verdicts(max_age_h).get((batch, seq, remat, scan, heads))
 
 
 def breakdown(batch=8, seq=1024, iters=10):
@@ -575,6 +599,9 @@ def measure():
                 # can eat the window; the floor is skipped anyway once any
                 # rung above succeeded)
                 (8, 1024, 20, False, True, 8),          # hd128 head shape
+                (8, 1024, 20, False, 6),                # chunked scan (4 steps
+                # x 6 unrolled layers): most of unrolled's scheduling freedom
+                # at ~1/6 the HLO — probe this before the unrolled monsters
                 (8, 1024, 20, False, False),            # unrolled: scheduling edge
                 (16, 1024, 20, "dots_saveable", False)]
     if env_flag("DS_BENCH_LONGSEQ"):
@@ -596,11 +623,14 @@ def measure():
     verdicts = _triage_verdicts()  # one git/jax/journal consult per ladder
     for batch, seq, iters, remat, scan, *rest in attempts:
         heads = rest[0] if rest else None
-        if scan_only and not scan:
-            continue  # DS_BENCH_SCAN=1: scanned programs only (compile budget)
+        if scan_only and scan is not True:
+            # DS_BENCH_SCAN=1: per-layer-scan programs ONLY — the mode exists
+            # for windows too short for big compiles, and a chunked rung's
+            # compile (~6x the per-layer HLO) is exactly that class
+            continue
         if best is not None and remat is True:
             continue  # the full-remat floor can't beat a no-remat success
-        if verdicts.get((batch, seq, remat, bool(scan), heads)) == "oom":
+        if verdicts.get((batch, seq, remat, scan, heads)) == "oom":
             # the compile-only triage already PROVED this rung exceeds HBM
             # at this revision on this chip — re-proving it would burn a
             # full (uncacheable, failed) compile out of the relay window

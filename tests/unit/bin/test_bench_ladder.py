@@ -45,6 +45,9 @@ def test_default_ladder_orders_reliable_rungs_first(monkeypatch):
         "full-remat floor must run before the unrolled cold compiles"
     # the hd128 head-shape rung is present and scanned
     assert (8, 1024, False, True, 8) in rungs
+    # the chunked-scan rung sits between the scanned rungs and the
+    # unrolled monsters (a fraction of their HLO, most of their freedom)
+    assert rungs.index((8, 1024, False, 6, None)) < first_unrolled
 
 
 def test_fast_ladder_is_scanned_with_fallbacks(monkeypatch):
@@ -56,7 +59,9 @@ def test_fast_ladder_is_scanned_with_fallbacks(monkeypatch):
 
 def test_scan_only_filter_drops_unrolled(monkeypatch):
     rungs = _ladder(monkeypatch, DS_BENCH_SCAN="1")
-    assert rungs and all(r[3] for r in rungs)
+    # per-layer scan ONLY: unrolled (False) and chunked (int) rungs are both
+    # multi-minute compiles the mode exists to exclude
+    assert rungs and all(r[3] is True for r in rungs)
 
 
 def test_head_override_is_param_identical():
@@ -70,6 +75,19 @@ def test_head_override_is_param_identical():
     c8 = bench_config(False, heads=8, num_hidden_layers=1)
     assert c8.head_dim_ == 128 and c16.head_dim_ == 64
     assert n(c16) == n(c8)
+
+
+def test_bench_config_scan_value_mapping():
+    """The ladder's scan value maps onto the model config in one place:
+    False/True toggle per-layer scan; an int N>1 is chunked scan (N
+    unrolled layers per scan step). 24 % 6 == 0 so the chunk rung traces."""
+    from bench import bench_config
+    assert bench_config(False).scan_layers is False
+    c = bench_config(False, scan_layers=True)
+    assert c.scan_layers and c.scan_chunk_size == 1
+    c6 = bench_config(False, scan_layers=6)
+    assert c6.scan_layers and c6.scan_chunk_size == 6
+    assert c6.num_hidden_layers % c6.scan_chunk_size == 0
 
 
 def test_chip_journal_replay_picks_best_and_stamps_provenance(tmp_path, monkeypatch):
@@ -162,6 +180,14 @@ def test_triage_verdict_skips_proven_oom_rungs(tmp_path, monkeypatch):
     assert bench._triage_verdict(16, 1024, "dots_saveable", True, None) is None
     # the torn tail line must not void earlier verdicts
     assert bench._triage_verdict(8, 1024, False, True, None) == "fit"
+
+    # a per-layer-scan verdict must NEVER suppress the chunked-scan rung
+    # (scan=True vs scan=6 compile different programs)
+    assert bench._triage_verdict(8, 1024, False, 6, None) is None
+    bench.journal_triage_record(8, 1024, False, 6, None, "oom")
+    assert bench._triage_verdict(8, 1024, False, 6, None) == "oom"
+    assert bench._triage_verdict(8, 1024, False, True, None) == "fit"
+    assert (8, 1024, False, 6, None) not in _ladder(monkeypatch)
 
     # no device kind (relay down at lookup time) -> never skip
     monkeypatch.setattr(bench, "_device_kind", lambda: None)
