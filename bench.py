@@ -378,8 +378,18 @@ def breakdown(batch=8, seq=1024, iters=10):
         seq, iters = 128, 2
     rng = np.random.default_rng(0)
     engine = None
+    scan_val = env_flag("DS_BENCH_SCAN")
+    verdicts = _triage_verdicts()
+    skipped = 0
     for batch, remat in footprints:
-        cfg = bench_config(remat=remat, scan_layers=env_flag("DS_BENCH_SCAN"))
+        if verdicts.get((batch, seq, remat, scan_val, None)) == "oom":
+            # compile-only triage already proved this footprint exceeds HBM
+            # at this revision on this chip — don't re-pay the doomed compile
+            print(f"breakdown: skipping bs{batch} remat={remat} "
+                  f"(triage: proven OOM)", file=sys.stderr)
+            skipped += 1
+            continue
+        cfg = bench_config(remat=remat, scan_layers=scan_val)
         if on_cpu:
             cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                               num_hidden_layers=2, num_attention_heads=4,
@@ -410,7 +420,10 @@ def breakdown(batch=8, seq=1024, iters=10):
             gc.collect()
             jax.clear_caches()
     if engine is None:
-        raise RuntimeError("breakdown: every footprint OOMed")
+        raise RuntimeError(
+            "breakdown: every footprint OOMed"
+            + (" (all skipped by triage verdicts — nothing compiled this "
+               "session)" if skipped == len(footprints) else ""))
     remat_used = remat
 
     def _sync():

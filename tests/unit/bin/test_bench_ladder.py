@@ -194,6 +194,21 @@ def test_triage_verdict_skips_proven_oom_rungs(tmp_path, monkeypatch):
     assert bench._triage_verdict(8, 1024, False, True, None) is None
 
 
+def test_breakdown_consults_triage_verdicts(monkeypatch, capsys):
+    """breakdown()'s OOM-retry mini-ladder must also skip footprints the
+    compile-only triage proved exceed HBM — its chip-session stages run
+    after the triage and must not re-pay doomed compiles."""
+    import bench
+    monkeypatch.setattr(
+        bench, "_triage_verdicts",
+        lambda max_age_h=24.0: {(2, 128, False, False, None): "oom"})
+    monkeypatch.delenv("DS_BENCH_SCAN", raising=False)
+    with pytest.raises(RuntimeError,
+                       match="all skipped by triage verdicts"):
+        bench.breakdown()  # CPU sizing: single (2, False) footprint @seq128
+    assert "triage: proven OOM" in capsys.readouterr().err
+
+
 def test_triage_scripts_share_the_engine_config():
     import pathlib
     root = pathlib.Path(__file__).resolve().parents[3]
