@@ -47,12 +47,14 @@ run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test
 run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4
 # 3. fast train number: scanned mini-ladder (compiles cached by step 2)
 run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
-# 4. where-the-time-goes, scanned program (matches bench_fast's program)
-run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
-# 5. serving decode, fast (paged @1k ctx, 2-3 compiles)
+# 4. serving decode, fast (paged @1k ctx, 2-3 compiles) — the SECOND
+# headline metric comes before any diagnostic: a short window that dies
+# mid-breakdown must still have landed train + serving numbers
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
 snapshot  # serving evidence suffixed NOW — a session death during the
-          # long steps 6-8 must not leave it clobberable by the next window
+          # long steps must not leave it clobberable by the next window
+# 5. where-the-time-goes, scanned program (matches bench_fast's program)
+run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
 # 6. headline train number (full anytime ladder: scanned rungs first,
 # then the unrolled programs — their cold compile only pays off once the
 # persistent cache carries it across windows)
