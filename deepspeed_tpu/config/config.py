@@ -24,6 +24,7 @@ from .feature_configs import (
     FP16Config,
     MeshConfig,
     MonitorConfig,
+    TensorParallelConfig,
     ZeroConfig,
 )
 from ..utils.logging import logger
@@ -178,6 +179,8 @@ class DeepSpeedTpuConfig:
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
         self.compile_config = CompileConfig(**pd.get("compile", {}))
         self.mesh_config = MeshConfig(**pd.get("mesh", {}))
+        self.tensor_parallel_config = TensorParallelConfig(
+            **pd.get("tensor_parallel", {}))
 
         self.elasticity_enabled = bool(pd.get("elasticity", {}).get("enabled", False))
         self.elasticity_config = pd.get("elasticity", {})

@@ -305,3 +305,20 @@ class MeshConfig(ConfigModel):
     expert: int = 1
     model: int = 1
     axis_order: List[str] = ["pipe", "data", "fsdp", "seq", "expert", "model"]
+
+
+class TensorParallelConfig(ConfigModel):
+    """Native tensor-parallel TRAINING (extension beyond the reference,
+    which delegates training TP to a user-provided Megatron ``mpu`` —
+    ``deepspeed/runtime/engine.py`` mpu plumbing, ``utils/groups.py:68``).
+    Here TP is a sharding rule composed WITH the ZeRO plan: linear weights
+    are column/row-sharded over the mesh ``model`` axis (AutoTP name
+    heuristics / logical-axis rules, ``parallel/tp.py``) and ZeRO shards a
+    dimension TP left free, so ZeRO-1/2/3 x TP compose in one program and
+    XLA inserts the per-layer psum the reference's mpu codes by hand.
+
+    ``tp_size`` also creates the mesh ``model`` axis when the mesh config
+    doesn't name one (the inference config's ``tensor_parallel.tp_size``
+    spelling). ``enabled`` engages composition on an existing model axis."""
+    enabled: bool = False
+    tp_size: Optional[int] = None

@@ -115,6 +115,16 @@ class DeepSpeedTpuEngine:
             return get_mesh_context().dp_size
         mesh_cfg = dict(raw.get("mesh", {})) if isinstance(raw, dict) else {}
         mesh_cfg.pop("axis_order", None)
+        tp_sz = ((raw.get("tensor_parallel") or {}).get("tp_size")
+                 if isinstance(raw, dict) else None)
+        if not isinstance(tp_sz, int):
+            tp_sz = None  # "auto"/null tolerated like every ConfigModel field
+        if tp_sz and tp_sz > 1 and mesh_cfg.get("model", 1) == 1:
+            # tensor_parallel.tp_size will create the model axis — the dp
+            # estimate (and the batch triangle it validates) must see it.
+            # SAME condition as the mesh-creation injection below (model
+            # absent OR explicitly 1), or the two dp worlds diverge.
+            mesh_cfg["model"] = tp_sz
         # partial specs (e.g. {"model": 2}) leave "data" to absorb leftovers,
         # mirroring MeshContext.create
         if mesh_cfg and all(v != -1 for v in mesh_cfg.values()) and "data" not in mesh_cfg:
@@ -170,6 +180,20 @@ class DeepSpeedTpuEngine:
         if not mesh_is_initialized():
             mc = self._config.mesh_config
             axes = {a: getattr(mc, a) for a in mc.axis_order}
+            tp_sz = self._config.tensor_parallel_config.tp_size
+            if tp_sz and tp_sz > 1 and axes.get("model", 1) == 1:
+                # tensor_parallel.tp_size creates the model axis when the
+                # mesh config doesn't name one (inference-config spelling)
+                axes["model"] = tp_sz
+            elif (tp_sz and tp_sz > 1
+                  and axes.get("model", 1) not in (tp_sz, -1)):
+                # -1 means the user delegated the size to absorption — only
+                # an EXPLICIT different size is a real conflict
+                from ..utils.logging import logger as _logger
+                _logger.warning(
+                    f"tensor_parallel.tp_size={tp_sz} conflicts with mesh "
+                    f"model={axes.get('model')} — the mesh axis wins; TP "
+                    f"runs at {axes.get('model')}")
             hpz = self._config.zero_config.zero_hpz_partition_size
             if hpz > 1 and axes.get("fsdp", 1) == 1:
                 # hpZ (ZeRO++ secondary partition): shard params over the
@@ -228,10 +252,20 @@ class DeepSpeedTpuEngine:
                                                           self._config.optimizer_params, lr_fn=lr_fn)
         self.optimizer = self  # engine exposes optimizer-ish API (reference returns the wrapper)
 
-        # ---- ZeRO sharding plan ----
+        # ---- ZeRO sharding plan (optionally composed with native TP) ----
         zc = self._config.zero_config
+        tpc = self._config.tensor_parallel_config
+        tp_requested = tpc.enabled or (tpc.tp_size or 0) > 1
+        self._tp_training = tp_requested and self.mesh_ctx.axis_size("model") > 1
+        if tp_requested and not self._tp_training:
+            from ..utils.logging import logger as _logger
+            _logger.warning(
+                "tensor_parallel requested but the mesh has no model axis "
+                "> 1 — TP sharding disabled (add model to the mesh config "
+                "or set tensor_parallel.tp_size)")
         self.zero_plan = ZeroShardingPlan(self.mesh_ctx, zc.stage,
-                                          param_persistence_threshold=zc.param_persistence_threshold)
+                                          param_persistence_threshold=zc.param_persistence_threshold,
+                                          tp=self._tp_training)
         if zc.stage >= 3 and model_parameters is not None:
             # max_live_parameters governor advisory (zero_governor.py): the
             # structural ceiling is scan chunking — warn when the model's
@@ -463,7 +497,8 @@ class DeepSpeedTpuEngine:
         if zc.zero_quantized_weights and self.zero_plan.stage >= 3 and self.zero_plan.zero_axes:
             from .zeropp import make_qwz_param_gather
             qwz_gather = make_qwz_param_gather(self.mesh_ctx, self.param_shardings,
-                                               qgz=zc.zero_quantized_gradients)
+                                               qgz=zc.zero_quantized_gradients,
+                                               zero_axes=self.zero_plan.zero_axes)
 
         def loss_from_cparams(cparams, args, kwargs, static_kv, scale):
             out = apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))

@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...comm.mesh import MeshContext
+from ...utils.logging import logger
 from ..zero_sharding import ZeroShardingPlan, leaf_spec
 from .spmd import spmd_pipeline_1f1b, spmd_pipeline_eval
 
@@ -237,7 +238,21 @@ class PipelineEngine:
         apply_fn = make_pipeline_apply(embed_apply, layer_apply, head_apply,
                                        mesh_ctx, mb)
         self.engine.apply_fn = apply_fn
-        self.engine.zero_plan = PipeZeroPlan(mesh_ctx, self.engine._config.zero_config.stage)
+        if getattr(self.engine, "_tp_training", False):
+            # the pipelined body stacks layers into anonymous [L, ...]
+            # leaves, so the AutoTP name heuristics have nothing to match —
+            # composed TP inside the pipe executor is not implemented. Be
+            # loud: the user asked for TP and is not getting it.
+            logger.warning(
+                "tensor_parallel is not composed with the pipeline executor: "
+                "TP sharding is DISABLED for all params (the stacked body's "
+                "anonymous leaves give the AutoTP heuristics nothing to "
+                "match) — drop the model axis or use ZeRO/fsdp for the "
+                "non-pipe dimension")
+        self.engine.zero_plan = PipeZeroPlan(
+            mesh_ctx, self.engine._config.zero_config.stage,
+            param_persistence_threshold=(
+                self.engine._config.zero_config.param_persistence_threshold))
         self.engine._init_state(params)
         self.engine._build_compiled_fns()
         self.micro_batches = mb

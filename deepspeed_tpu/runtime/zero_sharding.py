@@ -84,6 +84,56 @@ def replicated_tree(tree: Any, ctx: MeshContext):
     return jax.tree_util.tree_map(lambda _: NamedSharding(ctx.mesh, P()), tree)
 
 
+def composed_tp_zero_spec(path: str, shape: Sequence[int], ctx: MeshContext,
+                          zero_axes: Tuple[str, ...], zero_size: int,
+                          min_size: int = 0) -> P:
+    """Tensor-parallel spec (column/row rules over the ``model`` axis,
+    ``parallel/tp.py``) composed with ZeRO: ZeRO shards the largest dim TP
+    left free (earliest wins ties, matching ``choose_partition_dim``); when
+    no free dim divides, the TP dim is co-sharded by (model, zero) if the
+    per-TP-shard extent still divides. Leaves TP doesn't match degrade to
+    the plain ZeRO rule — so norm scales, biases and embeddings behave
+    exactly as without TP."""
+    from ..parallel.tp import heuristic_spec
+    mp = ctx.axis_size("model")
+    tp = tuple(heuristic_spec(path, shape, mp)) if mp > 1 else ()
+    spec = list(tp) + [None] * (len(shape) - len(tp))
+    if not zero_axes or zero_size <= 1 or int(np.prod(shape)) <= min_size:
+        return P(*spec)
+    zax = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    free = [d for d in range(len(shape))
+            if spec[d] is None and shape[d] % zero_size == 0
+            and shape[d] >= zero_size]
+    if free:
+        d = max(free, key=lambda i: (shape[i], -i))
+        spec[d] = zax
+        return P(*spec)
+    for d in sorted((i for i in range(len(shape)) if spec[i] is not None),
+                    key=lambda i: -shape[i]):
+        if shape[d] % (mp * zero_size) == 0:
+            cur = spec[d] if isinstance(spec[d], tuple) else (spec[d], )
+            spec[d] = cur + tuple(zero_axes)
+            break
+    return P(*spec)
+
+
+def tree_shardings_tp_zero(tree: Any, ctx: MeshContext,
+                           zero_axes: Tuple[str, ...], min_size: int = 0):
+    """NamedSharding pytree composing TP (model axis) with ZeRO sharding.
+    Works for params AND optimizer state: the AutoTP name heuristics match
+    by substring, and optimizer-state paths (``.../mu/model/layers_0/...``)
+    embed the param path, so moments shard exactly like their weights."""
+    from ..parallel.tp import path_str
+    zsize = ctx.axis_size(zero_axes) if zero_axes else 1
+
+    def _one(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(ctx.mesh, composed_tp_zero_spec(
+            path_str(path), shape, ctx, zero_axes, zsize, min_size))
+
+    return jax.tree_util.tree_map_with_path(_one, tree)
+
+
 class ZeroShardingPlan:
     """Resolved sharding plan for a given ZeRO stage.
 
@@ -91,13 +141,23 @@ class ZeroShardingPlan:
     pytrees) for params / grads(accumulation buffer) / optimizer state.
     """
 
-    def __init__(self, ctx: MeshContext, stage: int, param_persistence_threshold: int = 0):
+    def __init__(self, ctx: MeshContext, stage: int, param_persistence_threshold: int = 0,
+                 tp: bool = False):
         self.ctx = ctx
         self.stage = stage
         self.zero_axes = zero_axes_for(ctx) if stage > 0 else ()
         self.param_persistence_threshold = param_persistence_threshold
+        # native TP training (config tensor_parallel): every pytree the plan
+        # places gets the column/row model-axis sharding composed in — TP
+        # applies at EVERY stage (that is its memory/compute point), ZeRO
+        # keeps its stage gates for which trees it shards
+        self.tp = tp and ctx.axis_size("model") > 1
 
     def param_shardings(self, params):
+        if self.tp:
+            zaxes = self.zero_axes if self.stage >= 3 else ()
+            return tree_shardings_tp_zero(params, self.ctx, zaxes,
+                                          min_size=self.param_persistence_threshold)
         if self.stage >= 3 and self.zero_axes:
             return tree_shardings(params, self.ctx, self.zero_axes,
                                   min_size=self.param_persistence_threshold)
@@ -105,6 +165,9 @@ class ZeroShardingPlan:
 
     def grad_shardings(self, params):
         """Sharding of the gradient-accumulation buffer (stage>=2 sharded)."""
+        if self.tp:
+            return tree_shardings_tp_zero(
+                params, self.ctx, self.zero_axes if self.stage >= 2 else ())
         if self.stage >= 2 and self.zero_axes:
             return tree_shardings(params, self.ctx, self.zero_axes)
         return replicated_tree(params, self.ctx)
@@ -112,6 +175,9 @@ class ZeroShardingPlan:
     def opt_state_shardings(self, opt_state, params=None):
         """Stage>=1: shard every optimizer-state leaf that matches a
         partitionable shape; scalars (count, loss scale) stay replicated."""
+        if self.tp:
+            return tree_shardings_tp_zero(
+                opt_state, self.ctx, self.zero_axes if self.stage >= 1 else ())
         if self.stage >= 1 and self.zero_axes:
             return tree_shardings(opt_state, self.ctx, self.zero_axes)
         return replicated_tree(opt_state, self.ctx)

@@ -133,23 +133,34 @@ def hpz_mesh_axes(n_devices: int, hpz_partition_size: int) -> Dict[str, int]:
 
 
 def make_qwz_param_gather(mesh_ctx, param_shardings, qgz: bool = False,
-                          block: int = 2048):
+                          block: int = 2048,
+                          zero_axes: tuple = ("data", "fsdp")):
     """Build `gather(params) -> full params` for use inside jit: every leaf
     sharded over the ZeRO axes is explicitly gathered through the int8 wire
     (fwd) and its gradient reduce-scattered through int8 (bwd, if qgz).
 
     Engine wiring for zero_quantized_weights: wraps the apply closure so XLA
     emits int8 collectives instead of implicit bf16 resharding.
+
+    Only the dim sharded purely by ``zero_axes`` goes through the wire:
+    under composed TP (``tensor_parallel``) a weight's model-axis dim is
+    consumed sharded — there is no TP weight allgather to replace, and
+    routing it through lossy int8 would change TP numerics. The shard_map
+    is partial-manual over the ZeRO axes only, so a leaf's model-axis
+    sharding rides through the wire gather untouched.
     """
     mesh = mesh_ctx.mesh
 
     def _leaf_gather(leaf, sharding):
         spec = sharding.spec if isinstance(sharding, NamedSharding) else P()
-        # find the (single) sharded dim + its axes
+        # find the first dim sharded purely by ZeRO axes
         dim, axes = None, None
         for d, entry in enumerate(spec):
-            if entry is not None:
-                dim, axes = d, entry if isinstance(entry, tuple) else (entry, )
+            if entry is None:
+                continue
+            entry_t = entry if isinstance(entry, tuple) else (entry, )
+            if all(a in zero_axes for a in entry_t):
+                dim, axes = d, entry_t
                 break
         if dim is None:
             return leaf
@@ -160,9 +171,11 @@ def make_qwz_param_gather(mesh_ctx, param_shardings, qgz: bool = False,
             full = quantized_gather_param(moved, axis_name, qgz, block)
             return jnp.moveaxis(full, 0, dim)
 
-        in_spec = spec
-        out_spec_entries = [None if d == dim else e for d, e in enumerate(spec)]
-        out_spec = P(*out_spec_entries)
+        # specs name ONLY the manual (ZeRO) axes: non-manual sharding (a TP
+        # model axis on another dim) stays outside the manual region and is
+        # preserved by the partial-manual shard_map
+        in_spec = P(*(e if d == dim else None for d, e in enumerate(spec)))
+        out_spec = P(*([None] * len(spec)))
         manual = set(axes)
         return _smap(per_shard, mesh, (in_spec, ), out_spec, manual)(leaf)
 

@@ -87,6 +87,43 @@ def test_hpz_mesh_axes():
 
 
 @pytest.mark.world_size(8)
+def test_qwz_gather_respects_tp_model_sharding():
+    """Under composed TP (tensor_parallel), the int8 wire must gather ONLY
+    the ZeRO dim: a TP weight is consumed model-sharded — there is no TP
+    allgather to replace, and quantizing it would change TP numerics."""
+    from jax.sharding import NamedSharding
+    ctx = MeshContext.create(axis_sizes={"model": 2, "fsdp": 4})
+    set_mesh_context(ctx)
+    # o_proj-style composed sharding: row-parallel model on dim 0, ZeRO on 1
+    spec = P("model", "fsdp")
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 128))
+    w_sharded = jax.device_put(w, NamedSharding(ctx.mesh, spec))
+    shardings = {"w": NamedSharding(ctx.mesh, spec)}
+    gather = make_qwz_param_gather(ctx, shardings, zero_axes=("fsdp", ))
+    # every wire collective must run over the ZeRO axis, never over model —
+    # the jaxpr's axis_name params are the ground truth for that
+    import re
+    jaxpr_s = str(jax.make_jaxpr(gather)({"w": w_sharded}))
+    axes_used = set(re.findall(r"axis_name=\(?'?\"?([a-z]+)", jaxpr_s))
+    assert axes_used == {"fsdp"}, axes_used
+    out = jax.jit(lambda p: gather(p))({"w": w_sharded})["w"]
+    out = jax.block_until_ready(out)
+    # the ZeRO dim is gathered (full extent visible everywhere)
+    assert out.shape == (64, 128)
+    # values round-trip within int8 blockwise error
+    rel = np.abs(np.asarray(out) - w).max() / np.abs(w).max()
+    assert rel < 0.03
+
+    # a leaf sharded ONLY by model must bypass the wire entirely
+    spec_m = P("model", None)
+    w2 = jax.device_put(w, NamedSharding(ctx.mesh, spec_m))
+    gather2 = make_qwz_param_gather(ctx, {"w": NamedSharding(ctx.mesh, spec_m)},
+                                    zero_axes=("fsdp", ))
+    out2 = jax.jit(lambda p: gather2(p))({"w": w2})["w"]
+    np.testing.assert_array_equal(np.asarray(out2), w)  # untouched, exact
+
+
+@pytest.mark.world_size(8)
 def test_engine_with_zeropp_trains():
     """Full engine with stage 3 + qwZ + qgZ + hpZ on the CPU mesh."""
     import flax.linen as nn
