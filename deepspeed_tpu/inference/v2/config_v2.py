@@ -6,7 +6,7 @@ KVCacheConfig). Same knobs, pydantic-validated, TPU notes where semantics
 shift (static shapes → bucketing).
 """
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 from pydantic import Field, model_validator
 
@@ -22,6 +22,10 @@ class KVCacheConfig(ConfigModel):
     cache_shape: Tuple[int, int, int] = (1, 1, 64)
     cache_dtype: str = "bfloat16"
     max_blocks_per_allocation_group: int = 64
+    # TP serving: NamedSharding the cache is ALLOCATED under (head dim over
+    # the model axis) — allocating unsharded first would OOM exactly the
+    # tp-sized caches the sharding exists for. None = default placement.
+    cache_sharding: Optional[Any] = None
 
 
 class DSStateManagerConfig(ConfigModel):

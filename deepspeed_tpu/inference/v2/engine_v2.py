@@ -395,5 +395,6 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
     if params is None:
         _, params = init_llama(config, seed=seed)
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
-                             kv_block_size=kv_block_size, quantize=quantize)
+                             kv_block_size=kv_block_size, quantize=quantize,
+                             tp_size=engine_config.tensor_parallel.tp_size)
     return InferenceEngineV2(model, engine_config)

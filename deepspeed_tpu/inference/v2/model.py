@@ -108,7 +108,7 @@ class RaggedLlamaModel:
     """Paged-KV decode/prefill model over a Llama param tree."""
 
     def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64,
-                 attn_backend: str = "auto", quantize=None):
+                 attn_backend: str = "auto", quantize=None, tp_size: int = 1):
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
@@ -116,6 +116,13 @@ class RaggedLlamaModel:
             raise ValueError("quantize must be None, 'int8', 'fp6' or 'int4', "
                              f"got {quantize!r}")
         self._quantize = quantize
+        self.tp_size = int(tp_size or 1)
+        if self.tp_size > 1 and quantize is not None:
+            # packed WoQ kernels have collapsed shapes the TP heuristics
+            # cannot row/col-shard — refuse loudly rather than serve a
+            # silently-replicated "TP" engine
+            raise ValueError("tensor_parallel serving does not compose with "
+                             "weight quantization yet; pick one")
         # "paged" = Pallas blocked-flash decode kernel (TPU; interpret-mode on
         # CPU), "dense" = XLA gather of the full history window, "auto" =
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
@@ -123,8 +130,63 @@ class RaggedLlamaModel:
         if attn_backend == "auto":
             attn_backend = "paged" if on_tpu() else "dense"
         assert attn_backend in ("paged", "dense"), attn_backend
+        self._mesh_ctx = None
+        self._cache_sharding = None
+        if self.tp_size > 1:
+            # TP serving (reference FastGen serves TP-sharded): weights are
+            # column/row-sharded over the mesh model axis via the AutoTP
+            # heuristics; GSPMD propagates head-sharded attention and inserts
+            # the per-layer psum on the row-parallel projections
+            from ...comm.mesh import (MeshContext, get_mesh_context,
+                                      mesh_is_initialized, set_mesh_context)
+            if mesh_is_initialized():
+                ctx = get_mesh_context()
+                if ctx.axis_size("model") != self.tp_size:
+                    raise ValueError(
+                        f"tp_size={self.tp_size} but the initialized mesh has "
+                        f"model={ctx.axis_size('model')} — if that mesh "
+                        f"belongs to a discarded engine, call "
+                        f"deepspeed_tpu.comm.reset_mesh_context() first")
+            else:
+                ctx = MeshContext.create(
+                    axis_sizes={"model": self.tp_size, "data": -1})
+                set_mesh_context(ctx)
+            self._mesh_ctx = ctx
+            if attn_backend == "paged":
+                # a raw pallas_call doesn't auto-partition under GSPMD; until
+                # the paged kernel gets a shard_map dispatch, TP serving runs
+                # the dense attention path (XLA partitions it cleanly)
+                from ...utils.logging import logger
+                logger.warning("TP serving: paged kernel is not SPMD-"
+                               "partitioned yet — using dense attention")
+                attn_backend = "dense"
         self.attn_backend = attn_backend
-        self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=dtype), params)
+        if self._mesh_ctx is not None:
+            # place each leaf DIRECTLY into its TP sharding — a plain
+            # jnp.asarray would commit the full tree to one device first,
+            # and a model that needs TP to fit per-chip HBM would OOM right
+            # there. Host leaves cast on host (ml_dtypes bf16); device
+            # leaves reshard then cast per-shard.
+            from ...parallel.tp import tp_shardings
+            shardings = tp_shardings(params, self._mesh_ctx)
+
+            def _place(x, s):
+                if isinstance(x, jax.Array):
+                    return jax.device_put(x, s).astype(dtype)
+                return jax.device_put(np.asarray(x).astype(dtype), s)
+
+            self.params = jax.tree_util.tree_map(_place, params, shardings)
+            # KV cache [L, 2, KV, slot, D] shards over the head dim — each
+            # chip holds 1/tp of the cache, the memory point of TP serving.
+            # GQA with kv_heads % tp != 0 replicates (correct, larger).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            n_kv = config.num_key_value_heads
+            spec = (P(None, None, "model", None, None)
+                    if n_kv % self.tp_size == 0 else P())
+            self._cache_sharding = NamedSharding(self._mesh_ctx.mesh, spec)
+        else:
+            self.params = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, dtype=dtype), params)
         if quantize is not None:
             # WoQ (reference inference/v2 mixed_gemm + linear/quantization):
             # per-layer matmul weights stored packed (int8 / fp6-e3m2 /
@@ -154,8 +216,19 @@ class RaggedLlamaModel:
         # unembed in fp32 (reference keeps logits fp32; lm_head lives under
         # "model" in the training tree)
         if "lm_head" in params.get("model", {}):
+            if self._mesh_ctx is not None:
+                # mesh-replicated placement, same shard-first discipline as
+                # _place: jnp.asarray would commit to (or keep) one device
+                # and clash with the tp-mesh params inside the jitted forward
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                repl = NamedSharding(self._mesh_ctx.mesh, P())
+                fp32_put = lambda x: jax.device_put(
+                    np.asarray(x, np.float32) if not isinstance(x, jax.Array)
+                    else x, repl).astype(jnp.float32)
+            else:
+                fp32_put = lambda x: jnp.asarray(x, jnp.float32)
             self.params["model"]["lm_head"] = jax.tree_util.tree_map(
-                lambda x: jnp.asarray(x, jnp.float32), params["model"]["lm_head"])
+                fp32_put, params["model"]["lm_head"])
         self._state_manager = None
         self._fwd_cache = {}  # bucket key -> compiled fn
 
@@ -169,7 +242,8 @@ class RaggedLlamaModel:
         return KVCacheConfig(
             block_size=self.kv_block_size,
             cache_shape=(cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim_),
-            cache_dtype="bfloat16" if self.dtype == jnp.bfloat16 else "float32")
+            cache_dtype="bfloat16" if self.dtype == jnp.bfloat16 else "float32",
+            cache_sharding=self._cache_sharding)
 
     # ---- scheduling arithmetic (reference get_kv_requirements) ----
 
@@ -236,10 +310,15 @@ class RaggedLlamaModel:
         key = batch.bucket_key
         fn = self._fwd_cache.get(key)
         if fn is None:
+            # under TP the cache's head sharding is pinned on the OUTPUT too:
+            # the donated buffer must come back with the same layout or the
+            # next step pays a reshard and the donation is wasted
+            kw = ({"out_shardings": (None, self._cache_sharding)}
+                  if self._mesh_ctx is not None else {})
             fn = jax.jit(partial(_ragged_forward, config=self.config,
                                  block_size=self.kv_block_size,
                                  attn_backend=self.attn_backend),
-                         donate_argnums=(1, ))
+                         donate_argnums=(1, ), **kw)
             self._fwd_cache[key] = fn
         logits, new_cache = fn(self.params, kv.cache, batch)
         kv.update(new_cache)

@@ -35,7 +35,14 @@ class BlockedKVCache:
         n_layers, n_kv, head_dim = config.cache_shape
         self.dtype = _DTYPES.get(config.cache_dtype, jnp.bfloat16)
         self.shape = (n_layers, 2, n_kv, num_blocks * config.block_size, head_dim)
-        self.cache = jnp.zeros(self.shape, dtype=self.dtype)
+        if config.cache_sharding is not None:
+            # allocate DIRECTLY under the sharding (TP serving: head dim
+            # over the model axis) — a default-placement zeros would OOM
+            # exactly the tp-sized caches the sharding exists for
+            self.cache = jax.jit(lambda: jnp.zeros(self.shape, self.dtype),
+                                 out_shardings=config.cache_sharding)()
+        else:
+            self.cache = jnp.zeros(self.shape, dtype=self.dtype)
 
     @property
     def per_token_bytes(self) -> int:
