@@ -54,6 +54,22 @@ class DSStateManagerConfig(ConfigModel):
             raise ValueError("max_ragged_sequence_count cannot exceed max_tracked_sequences")
         if self.max_ragged_sequence_count > self.max_ragged_batch_size:
             raise ValueError("max_ragged_sequence_count cannot exceed max_ragged_batch_size")
+        if self.offload:
+            # reference manager_configs.py:171: "Currently unsupported" —
+            # reject loudly rather than accept-and-ignore
+            raise ValueError("KV-cache offload is not supported")
+        if self.memory_config_mode == "reserve":
+            if not 0.0 < self.memory_config_size <= 1.0:
+                raise ValueError(
+                    "memory_config_mode='reserve' takes a fraction of free "
+                    f"HBM: 0 < memory_config_size <= 1, got {self.memory_config_size}")
+        elif self.memory_config_mode == "allocate":
+            if self.memory_config_size < 1 or self.memory_config_size != int(self.memory_config_size):
+                raise ValueError(
+                    "memory_config_mode='allocate' takes an integral block "
+                    f"count >= 1, got {self.memory_config_size}")
+        else:
+            raise ValueError("memory_config_mode must be 'reserve' or 'allocate'")
         return self
 
 

@@ -392,6 +392,21 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
     import jax.numpy as jnp
     config = config or LlamaConfig.tiny()
     engine_config = engine_config or RaggedInferenceEngineConfig()
+    mode = engine_config.quantization.quantization_mode
+    if mode:
+        # the reference spells WoQ via quantization_mode ('wf6af16' =
+        # weight-fp6 / activation-fp16, the FP6-LLM mode) — map it onto the
+        # model's quantize knob instead of accepting-and-ignoring it
+        mapped = {"wf6af16": "fp6", "fp6": "fp6",
+                  "int8": "int8", "int4": "int4"}.get(mode)
+        if mapped is None:
+            raise ValueError(f"unknown quantization_mode {mode!r}; "
+                             "supported: wf6af16 (fp6), fp6, int8, int4")
+        if quantize is not None and quantize != mapped:
+            raise ValueError(
+                f"quantize={quantize!r} conflicts with "
+                f"quantization_mode={mode!r} (= {mapped!r}) — set one")
+        quantize = mapped
     if params is None:
         _, params = init_llama(config, seed=seed)
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,

@@ -21,13 +21,33 @@ class DSStateManager:
         self._config = config
         self._kv_config = kv_config
         if num_blocks is None:
-            # default sizing: enough blocks for max_tracked_sequences at one
-            # block each plus the ragged batch; real deployments size from HBM
-            # via estimate_kv_blocks
-            num_blocks = max(64, config.max_tracked_sequences)
+            num_blocks = self._size_from_memory_config(config, kv_config)
         self._allocator = BlockedAllocator(num_blocks)
         self._kv_cache = BlockedKVCache(kv_config, num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    @staticmethod
+    def _size_from_memory_config(config: DSStateManagerConfig,
+                                 kv_config: KVCacheConfig) -> int:
+        """Reference memory_config sizing (manager_configs.py): 'allocate' =
+        memory_config_size IS the block count; 'reserve' = that fraction of
+        free HBM becomes KV blocks. Reserve engages only on a real TPU
+        (PJRT memory stats); elsewhere the deterministic default keeps CPU
+        tests from sizing a cache off host RAM."""
+        if config.memory_config_mode == "allocate":
+            return max(1, int(config.memory_config_size))
+        from ....ops.registry import on_tpu
+        if on_tpu():
+            try:
+                from ....accelerator import get_accelerator
+                free = get_accelerator().available_memory()
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                free = None
+            if free and free > 0:
+                from .kv_cache import estimate_kv_blocks
+                return max(64, estimate_kv_blocks(
+                    kv_config, free, config.memory_config_size))
+        return max(64, config.max_tracked_sequences)
 
     # ---- sequence tracking (reference ragged_manager.py:96-160) ----
 

