@@ -147,20 +147,30 @@ class FlopsProfiler:
 
     # ---- lifecycle (reference start_profile/stop_profile/end_profile) ----
 
-    def start_profile(self, ignore_list=None):
+    def start_profile(self, ignore_list=None, skip_engine_cost=False):
+        """``skip_engine_cost``: don't accrue the engine's split-path
+        ``_fwd_bwd`` cost — the caller is about to ``profile_fn`` a fused
+        program that already CONTAINS the fwd+bwd (counting both would
+        double the reported flops)."""
+        if self.started:
+            return  # idempotent: engine auto-hook + a manual start must not
+            # double-count the compiled program's flops
         self.started = True
-        self._t0 = time.perf_counter()
         if self.ds_engine is not None:
             self._params_tree = self.ds_engine.params
             # exact flops of the engine's compiled fwd+bwd at current shapes
             try:
                 spec = self.ds_engine.last_fwd_spec
-                if spec is not None:
+                if spec is not None and not skip_engine_cost:
                     costs = profile_compiled(self.ds_engine._fwd_bwd, *spec)
                     self._flops += costs["flops"]
                     self._bytes += costs["bytes_accessed"]
             except Exception as e:  # cost analysis is best-effort per backend
                 logger.debug(f"flops cost analysis unavailable: {e}")
+        # timing window opens AFTER the cost analysis: its AOT
+        # lower().compile() can take seconds and would otherwise be billed
+        # to the step, wrecking achieved-throughput / hw-utilization
+        self._t0 = time.perf_counter()
 
     def profile_fn(self, fn, *args, **kwargs):
         """Accumulate exact costs of one more compiled fn (multi-program
@@ -168,6 +178,10 @@ class FlopsProfiler:
         costs = profile_compiled(fn, *args, **kwargs)
         self._flops += costs["flops"]
         self._bytes += costs["bytes_accessed"]
+        if self.started:
+            # same rule as start_profile: analysis compile time is not step
+            # time — restart the wall-clock window
+            self._t0 = time.perf_counter()
         return costs
 
     def stop_profile(self):

@@ -321,6 +321,7 @@ class DeepSpeedTpuEngine:
 
         # flops profiler (reference engine.py flops_profiler hook)
         self.flops_profiler = None
+        self._flops_auto_active = False  # session opened by the auto-hook
         if self._config.flops_profiler_config.enabled:
             from ..profiling.flops_profiler.profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(
@@ -803,6 +804,10 @@ class DeepSpeedTpuEngine:
         self.last_fwd_spec = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") else x,
             (self.params, self.grad_acc, scale, args, kwargs, static_kv))
+        # AFTER the spec records THIS step's shapes (curriculum can resize
+        # per step); dispatch above is async, so the timing window still
+        # covers the device execution
+        self._flops_profile_pre()
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
@@ -821,6 +826,45 @@ class DeepSpeedTpuEngine:
 
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps % self.gradient_accumulation_steps()) == 0
+
+    def _flops_profile_pre(self, step_fn=None, step_args=None):
+        """Reference engine.py flops-profiler hooks: the engine itself starts
+        the profile when global_steps reaches ``profile_step`` — the config
+        knob used to be accepted and silently ignored (a user enabling
+        ``flops_profiler`` got no output without driving the profiler by
+        hand). ``step_fn``/``step_args``: the fused one-program step, whose
+        exact compiled cost is recorded (the split path's cost comes from
+        ``last_fwd_spec`` inside ``start_profile``)."""
+        fp = self.flops_profiler
+        c = self._config.flops_profiler_config
+        if fp is None or fp.started or self.global_steps != c.profile_step:
+            return
+        # the fused program already contains fwd+bwd+step: accruing the
+        # split-path _fwd_bwd cost too would double the reported flops
+        fp.start_profile(skip_engine_cost=step_fn is not None)
+        self._flops_auto_active = True
+        if step_fn is not None and step_args is not None:
+            try:
+                fp.profile_fn(step_fn, *step_args)
+            except Exception as e:  # noqa: BLE001 — cost analysis best-effort
+                logger.debug(f"flops profiler: fused cost analysis skipped: {e}")
+
+    def _flops_profile_post(self):
+        fp = self.flops_profiler
+        c = self._config.flops_profiler_config
+        if (fp is None or not fp.started or self.global_steps <= c.profile_step
+                or not getattr(self, "_flops_auto_active", False)):
+            # only close sessions the auto-hook opened — a profile the USER
+            # started via the manual reference API is theirs to stop/print
+            return
+        self._flops_auto_active = False
+        fp.stop_profile()
+        fp.print_model_profile(profile_step=c.profile_step,
+                               module_depth=c.module_depth,
+                               top_modules=c.top_modules, detailed=c.detailed,
+                               output_file=c.output_file,
+                               batch_tokens=self.train_batch_size())
+        fp.end_profile()
 
     def step(self, lr_kwargs=None):
         """Optimizer step at gradient-accumulation boundaries (engine.py:2176)."""
@@ -857,6 +901,7 @@ class DeepSpeedTpuEngine:
                     f"step={self.global_steps}, skipped={self.skipped_steps}, "
                     f"lr={self.get_lr()}, loss={float(self.losses) if self.losses is not None else None}",
                     ranks=[0])
+            self._flops_profile_post()
         self.timers(STEP_MICRO_TIMER).stop()
 
     def _host_offload_step(self):
@@ -995,6 +1040,9 @@ class DeepSpeedTpuEngine:
         stacked = jax.device_put(
             stacked, self.zero_plan.batch_sharding(stacked, stacked=True))
         self.tput_timer.start()
+        self._flops_profile_pre(self._train_batch_fused,
+                                (self.params, self.opt_state, self.scale_state,
+                                 stacked, ()))
         (loss, self.params, self.opt_state, self.scale_state, overflow,
          gnorm) = self._train_batch_fused(self.params, self.opt_state,
                                           self.scale_state, stacked, ())
@@ -1011,6 +1059,7 @@ class DeepSpeedTpuEngine:
         if self.monitor is not None:
             self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                         self.global_samples)])
+        self._flops_profile_post()
         return float(loss)
 
     def fused_train_step(self, *args, **kwargs):
@@ -1028,6 +1077,9 @@ class DeepSpeedTpuEngine:
             # post-warmup: packed 1-bit momentum exchange replaces the fp32
             # grad reduce (the reference's freeze_step phase switch)
             step_fn = self._wire_step
+        self._flops_profile_pre(step_fn, (self.params, self.opt_state,
+                                          self.scale_state, args, kwargs,
+                                          static_kv))
         (loss, self.params, self.opt_state, self.scale_state, overflow,
          gnorm) = step_fn(self.params, self.opt_state, self.scale_state,
                           args, kwargs, static_kv)
@@ -1044,6 +1096,7 @@ class DeepSpeedTpuEngine:
         if self.monitor is not None:
             self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                         self.global_samples)])
+        self._flops_profile_post()
         return loss
 
     def eval_batch(self, *args, **kwargs):

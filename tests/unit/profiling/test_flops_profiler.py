@@ -35,22 +35,130 @@ def test_get_model_profile():
     assert params == 64 * 64
 
 
-def test_engine_integration():
+def test_engine_auto_profiles_at_profile_step(tmp_path):
+    """config flops_profiler.enabled must PRODUCE the report by itself at
+    profile_step (reference engine.py behavior) — the knob used to be
+    accepted and silently ignored without a manual start/stop/print."""
     reset_mesh_context()
+    out = tmp_path / "prof.txt"
     model, params = simple_model_and_params()
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={"train_batch_size": 8,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-                "flops_profiler": {"enabled": True, "profile_step": 1}})
+                "flops_profiler": {"enabled": True, "profile_step": 2,
+                                   "output_file": str(out)}})
     assert engine.flops_profiler is not None
+    x = jnp.ones((8, 16))
+    y = jnp.zeros((8, 16))
+    for _ in range(3):
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+    report = out.read_text()
+    assert "Flops Profiler" in report and "step 2" in report
+    assert "params:" in report and "flops per step:" in report
+    # exact compiled-program flops made it into the report (not 0.00)
+    assert "flops per step:         0.0" not in report
+    mtime = out.stat().st_mtime_ns
+    loss = engine.forward(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert out.stat().st_mtime_ns == mtime  # one-shot, like the reference
+
+
+def test_engine_auto_profiles_fused_path(tmp_path):
+    """Same contract through the one-program fused step (train_batch)."""
+    reset_mesh_context()
+    out = tmp_path / "prof_fused.txt"
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1,
+                                   "output_file": str(out)}})
+    x = jnp.ones((8, 16))
+    y = jnp.zeros((8, 16))
+    data = iter([(x, y)] * 3)
+    for _ in range(3):
+        engine.train_batch(data)
+    report = out.read_text()
+    assert "Flops Profiler" in report and "step 1" in report
+    assert "flops per step:         0.0" not in report
+
+
+def test_engine_auto_profiles_gas2_batch_path(tmp_path):
+    """Same contract through the gas>1 scan-fused batch program."""
+    reset_mesh_context()
+    out = tmp_path / "prof_gas2.txt"
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1,
+                                   "output_file": str(out)}})
+    x = jnp.ones((4, 16))
+    y = jnp.zeros((4, 16))
+    data = iter([(x, y)] * 8)
+    for _ in range(3):
+        engine.train_batch(data)
+    report = out.read_text()
+    assert "Flops Profiler" in report and "step 1" in report
+    assert "flops per step:         0.0" not in report
+
+
+def test_auto_hook_never_closes_a_manual_session(tmp_path):
+    """A profile the USER started via the reference API must survive
+    engine.step() — the auto-hook only closes sessions it opened."""
+    reset_mesh_context()
+    out = tmp_path / "prof.txt"
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1,
+                                   "output_file": str(out)}})
+    x = jnp.ones((8, 16))
+    y = jnp.zeros((8, 16))
+    for _ in range(2):  # auto session opens at step 1, closes at step 2
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+    assert "Flops Profiler" in out.read_text()
+    prof = engine.flops_profiler
+    prof.start_profile()  # manual session, well past profile_step
+    loss = engine.forward(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert prof.started, "auto-hook closed the user's manual session"
+    prof.stop_profile()
+    assert prof.get_total_flops() > 0
+
+
+def test_manual_profile_api_still_works():
+    """The reference manual start/stop/print surface stays available (and a
+    double start cannot double-count)."""
+    reset_mesh_context()
+    model, params = simple_model_and_params()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+    prof = FlopsProfiler(model, ds_engine=engine)
     x = jnp.ones((8, 16))
     y = jnp.zeros((8, 16))
     loss = engine.forward(x, y)
     engine.backward(loss)
     engine.step()
-    prof = engine.flops_profiler
     prof.start_profile()
+    flops_once = prof.get_total_flops()
+    prof.start_profile()  # idempotent — no double count
+    assert prof.get_total_flops() == flops_once
     loss = engine.forward(x, y)
     engine.backward(loss)
     engine.step()
