@@ -27,9 +27,7 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_FLAX = False
 
-_DTYPES = {"float32": jnp.float32, "fp32": jnp.float32, "float16": jnp.float16,
-           "fp16": jnp.float16, "half": jnp.float16, "bfloat16": jnp.bfloat16,
-           "bf16": jnp.bfloat16, "int8": jnp.int8}
+from ..utils.dtypes import resolve_dtype
 
 
 class InferenceEngine:
@@ -37,7 +35,7 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None, params=None):
         self._config = config or DeepSpeedInferenceConfig()
         self.module = model
-        self.dtype = _DTYPES.get(str(self._config.dtype).replace("torch.", ""), jnp.bfloat16)
+        self.dtype = resolve_dtype(self._config.dtype, jnp.bfloat16)
 
         if not mesh_is_initialized():
             tp = self._config.tensor_parallel.tp_size

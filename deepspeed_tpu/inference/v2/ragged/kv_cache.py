@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..config_v2 import KVCacheConfig
 
-_DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
+from ....utils.dtypes import resolve_dtype
 
 
 class BlockedKVCache:
@@ -33,7 +33,7 @@ class BlockedKVCache:
         self.num_blocks = num_blocks
         self.block_size = config.block_size
         n_layers, n_kv, head_dim = config.cache_shape
-        self.dtype = _DTYPES.get(config.cache_dtype, jnp.bfloat16)
+        self.dtype = resolve_dtype(config.cache_dtype, jnp.bfloat16)
         self.shape = (n_layers, 2, n_kv, num_blocks * config.block_size, head_dim)
         if config.cache_sharding is not None:
             # allocate DIRECTLY under the sharding (TP serving: head dim
@@ -62,6 +62,6 @@ def estimate_kv_blocks(config: KVCacheConfig, hbm_bytes: int, fraction: float) -
     """Size the cache from an HBM budget (reference memory_config 'reserve')."""
     n_layers, n_kv, head_dim = config.cache_shape
     per_block = (n_layers * 2 * n_kv * head_dim *
-                 jnp.dtype(_DTYPES.get(config.cache_dtype, jnp.bfloat16)).itemsize *
+                 jnp.dtype(resolve_dtype(config.cache_dtype, jnp.bfloat16)).itemsize *
                  config.block_size)
     return max(1, int(hbm_bytes * fraction) // per_block)

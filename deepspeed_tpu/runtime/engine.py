@@ -222,6 +222,26 @@ class DeepSpeedTpuEngine:
             self.compute_dtype = jnp.float32
         self.scaler_cfg = LossScalerConfig.from_fp16_config(self._config.fp16_config)
         self._use_loss_scaling = self._config.fp16_enabled
+        # data_types.grad_accum_dtype (reference engine.py:938-944): dtype of
+        # the gradient-accumulation buffer/scan-carry. None = fp32 (full
+        # accumulation precision); bf16 halves the buffer at a documented
+        # precision cost. apply_step up-casts to fp32 before the update.
+        from ..utils.dtypes import resolve_dtype
+        try:
+            self.grad_accum_dtype = resolve_dtype(
+                self._config.data_types_config.grad_accum_dtype, jnp.float32)
+        except ValueError as e:
+            raise ValueError(f"data_types.grad_accum_dtype: {e}") from None
+        if self.grad_accum_dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+            raise ValueError("data_types.grad_accum_dtype must be "
+                             "fp32/bf16/fp16")
+        if self.grad_accum_dtype == jnp.float16 and not self._use_loss_scaling:
+            # fp16 accumulation saturates at 65504; only the fp16 loss-scaler
+            # path runs the overflow check that turns saturation into a
+            # skipped step instead of silent inf/NaN params
+            raise ValueError("grad_accum_dtype=fp16 requires fp16 training "
+                             "(loss scaling + overflow skip); use bf16 or "
+                             "fp32 accumulation otherwise")
 
         # ---- apply fn (+ activation checkpointing) ----
         self.apply_fn = _as_apply_fn(model)
@@ -385,8 +405,11 @@ class DeepSpeedTpuEngine:
         self.params = jax.device_put(params, self.param_shardings)
 
         self.grad_shardings = self.zero_plan.grad_shardings(params)
-        zeros_fn = jax.jit(lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
-                           out_shardings=self.grad_shardings)
+        acc_dtype = self.grad_accum_dtype
+        zeros_fn = jax.jit(
+            lambda p: jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, acc_dtype), p),
+            out_shardings=self.grad_shardings)
         self.grad_acc = zeros_fn(self.params)
 
         if self._offload_device in ("cpu", "nvme") and self._offload_ratio >= 1.0:
@@ -537,7 +560,8 @@ class DeepSpeedTpuEngine:
                 params, args, kwargs, static_kv, scale)
 
         def fwd_bwd(params, acc, scale, args, kwargs, static_kv):
-            # fp32 acc keeps full accumulation precision across microbatches
+            # acc dtype = grad_accum_dtype (fp32 default: full accumulation
+            # precision across microbatches; bf16 opt-in halves the buffer)
             (scaled, loss), grads = value_and_grads(
                 params, args, kwargs, static_kv, scale)
             new_acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, grads)
@@ -657,8 +681,9 @@ class DeepSpeedTpuEngine:
         # only one microbatch's activations are live at a time)
         def train_batch_steps(params, opt_state, scale_state, stacked_args, static_kv):
             scale = scale_state.cur_scale if use_scaling else jnp.float32(1.0)
+            acc_dtype = self.grad_accum_dtype
             zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                lambda p: jnp.zeros(p.shape, acc_dtype), params)
 
             def micro(carry, margs):
                 acc, loss_sum = carry

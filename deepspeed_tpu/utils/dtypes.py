@@ -1,0 +1,30 @@
+"""One dtype-name resolver for every config surface (the reference scatters
+``DtypeEnum``/torch-dtype parsing across engines; here one table keeps the
+accepted spellings from drifting between the training engine, the v1
+inference engine and the KV cache config)."""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_dtype(name, default=None) -> Optional[type]:
+    """'bf16' / 'torch.float16' / jnp dtype -> jnp dtype; ``default`` when
+    name is falsy; raises on an unknown spelling (silent fallbacks hide
+    config typos)."""
+    if not name:
+        return default
+    if name in _DTYPES.values():
+        return name
+    key = str(name).replace("torch.", "").lower()
+    if key not in _DTYPES:
+        raise ValueError(f"unknown dtype {name!r}; expected one of "
+                         f"{sorted(set(_DTYPES))}")
+    return _DTYPES[key]

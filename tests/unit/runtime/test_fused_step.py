@@ -183,3 +183,50 @@ def test_steps_compile_once_across_run():
         "fused train step recompiled mid-run — a signature/sharding leak")
     assert engine._fwd_bwd._cache_size() == fwdbwd0
     assert engine._apply_step._cache_size() == apply0
+
+
+def test_grad_accum_dtype_knob():
+    """data_types.grad_accum_dtype (reference engine.py:938-944) controls the
+    accumulation buffer dtype on both the split path (persistent buffer) and
+    the gas>1 scan carry; bf16 halves the buffer and the trajectory stays
+    close to fp32 accumulation. Unknown dtypes are rejected at build."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from simple_model import simple_model_and_params
+
+    def run(gad):
+        reset_mesh_context()
+        model, params = simple_model_and_params()
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0}
+        if gad:
+            cfg["data_types"] = {"grad_accum_dtype": gad}
+        engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                              config=cfg)
+        x = jnp.ones((engine.train_micro_batch_size_per_gpu() * engine.dp_world_size, 16))
+        data = iter([(x, jnp.zeros_like(x))] * 6)
+        losses = [engine.train_batch(data) for _ in range(3)]
+        return engine, losses
+
+    ref_engine, ref = run(None)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(ref_engine.grad_acc))
+
+    bf_engine, bf = run("bf16")
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(bf_engine.grad_acc))
+    np.testing.assert_allclose(bf, ref, rtol=5e-3)
+
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        run("int8")
+
+    # fp16 accumulation without fp16 loss scaling saturates silently at
+    # 65504 — no overflow check runs to skip the step, so it's rejected
+    with pytest.raises(ValueError, match="fp16"):
+        run("fp16")
