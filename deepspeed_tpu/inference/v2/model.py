@@ -25,6 +25,20 @@ import jax
 import numpy as np
 import jax.numpy as jnp
 
+try:
+    from jax import shard_map as _shard_map_new
+
+    def _smap(f, mesh, in_specs, out_specs, manual):
+        return _shard_map_new(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, axis_names=frozenset(manual),
+                              check_vma=False)
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def _smap(f, mesh, in_specs, out_specs, manual):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 from .config_v2 import KVCacheConfig
 from ...models.llama import LlamaConfig, precompute_rope
 from ...ops.normalization import rms_norm
@@ -153,13 +167,21 @@ class RaggedLlamaModel:
                 set_mesh_context(ctx)
             self._mesh_ctx = ctx
             if attn_backend == "paged":
-                # a raw pallas_call doesn't auto-partition under GSPMD; until
-                # the paged kernel gets a shard_map dispatch, TP serving runs
-                # the dense attention path (XLA partitions it cleanly)
-                from ...utils.logging import logger
-                logger.warning("TP serving: paged kernel is not SPMD-"
-                               "partitioned yet — using dense attention")
-                attn_backend = "dense"
+                # a raw pallas_call can't auto-partition under GSPMD, but
+                # attention is embarrassingly parallel over heads: the paged
+                # branch runs the kernel per head-block inside a
+                # partial-manual shard_map (same design as ulysses_flash).
+                # Ineligible: kv heads not divisible by tp (GQA group
+                # mapping wouldn't survive the split) or ALiBi (the kernel
+                # derives slopes from LOCAL head indices — wrong per shard).
+                if (config.num_key_value_heads % self.tp_size != 0
+                        or config.pos_embedding == "alibi"):
+                    from ...utils.logging import logger
+                    logger.warning(
+                        "TP serving: paged kernel ineligible "
+                        f"(kv_heads={config.num_key_value_heads} % tp="
+                        f"{self.tp_size} or ALiBi) — using dense attention")
+                    attn_backend = "dense"
         self.attn_backend = attn_backend
         if self._mesh_ctx is not None:
             # place each leaf DIRECTLY into its TP sharding — a plain
@@ -317,7 +339,10 @@ class RaggedLlamaModel:
                   if self._mesh_ctx is not None else {})
             fn = jax.jit(partial(_ragged_forward, config=self.config,
                                  block_size=self.kv_block_size,
-                                 attn_backend=self.attn_backend),
+                                 attn_backend=self.attn_backend,
+                                 tp_size=self.tp_size,
+                                 mesh=(self._mesh_ctx.mesh
+                                       if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
             self._fwd_cache[key] = fn
         logits, new_cache = fn(self.params, kv.cache, batch)
@@ -326,7 +351,8 @@ class RaggedLlamaModel:
 
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
-                    block_size: int, attn_backend: str = "dense"):
+                    block_size: int, attn_backend: str = "dense",
+                    tp_size: int = 1, mesh=None):
     """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
     cfg = config
     T = batch.tokens.shape[0]
@@ -409,14 +435,34 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             # softmax — no history gather (ops/paged_attention.py); local
             # windows, ALiBi, and custom scale are handled in-kernel
             from ...models.llama import _layer_window
-            ctx = paged_attention(
-                q_s, cache, l, batch.block_table, batch.seq_seen, seq_lens,
-                page_size=block_size,
-                window=_layer_window(cfg, l),
-                attn_scale=cfg.attn_scale,
-                use_alibi=cfg.pos_embedding == "alibi",
-                softcap=cfg.attn_logit_softcapping,
-                interpret=not on_tpu())
+            kernel_kw = dict(page_size=block_size,
+                             window=_layer_window(cfg, l),
+                             attn_scale=cfg.attn_scale,
+                             use_alibi=cfg.pos_embedding == "alibi",
+                             softcap=cfg.attn_logit_softcapping,
+                             interpret=not on_tpu())
+            if tp_size > 1:
+                # TP: kernel per LOCAL head block inside a partial-manual
+                # shard_map (heads are independent — no collectives); q and
+                # the cache shard on their head dims, metadata replicated.
+                # ``mesh`` is the model's OWN mesh, threaded in explicitly —
+                # a global lookup at retrace time could bind a newer
+                # engine's mesh and clash with this jit's pinned shardings
+                from jax.sharding import PartitionSpec as P
+                hspec = P(None, None, "model", None, None)
+                rep = P()
+
+                def _paged_local(q_l, cache_l, bt, seen, lens):
+                    return paged_attention(q_l, cache_l, l, bt, seen, lens,
+                                           **kernel_kw)
+
+                ctx = _smap(
+                    _paged_local, mesh,
+                    (hspec, hspec, rep, rep, rep), hspec, {"model"},
+                )(q_s, cache, batch.block_table, batch.seq_seen, seq_lens)
+            else:
+                ctx = paged_attention(q_s, cache, l, batch.block_table,
+                                      batch.seq_seen, seq_lens, **kernel_kw)
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
             hist = cache[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]
