@@ -32,11 +32,15 @@ def _abstract_params(cfg: LlamaConfig, seq: int = 8):
     return model, unbox_params(shapes["params"])
 
 
-@pytest.mark.parametrize("cfg_name,mesh_axes", [
-    ("llama3_8b", {"fsdp": 8}),                 # BASELINE target 2: ZeRO-3
-    ("llama3_70b", {"fsdp": 4, "model": 2}),    # BASELINE target 5 shape
+@pytest.mark.parametrize("cfg_name,mesh_axes,tp", [
+    ("llama3_8b", {"fsdp": 8}, False),              # BASELINE target 2: ZeRO-3
+    ("llama3_70b", {"fsdp": 4, "model": 2}, False),  # BASELINE target 5 shape
+    # composed TP x ZeRO-3 at 70B dims: proves the column/row heuristics and
+    # the ZeRO free-dim choice divide the REAL projection shapes (q [8192,
+    # 8192], kv [8192, 1024], mlp [8192, 28672]) — not just the toys
+    ("llama3_70b", {"fsdp": 4, "model": 2}, True),
 ])
-def test_fused_step_lowers_at_scale(cfg_name, mesh_axes):
+def test_fused_step_lowers_at_scale(cfg_name, mesh_axes, tp):
     # conftest's autouse _reset_global_mesh resets around every test
     ctx = MeshContext.create(axis_sizes=mesh_axes)
     set_mesh_context(ctx)
@@ -44,8 +48,19 @@ def test_fused_step_lowers_at_scale(cfg_name, mesh_axes):
         remat=True, remat_policy="dots_saveable", ce_chunk_size=8016)
     model, aparams = _abstract_params(cfg)
 
-    plan = ZeroShardingPlan(ctx, stage=3)
-    pshard = plan.param_shardings(aparams)
+    plan = ZeroShardingPlan(ctx, stage=3, tp=tp)
+    pshard_pre = plan.param_shardings(aparams)
+    if tp:
+        from deepspeed_tpu.parallel.tp import path_str
+        flat = {path_str(path): s for path, s in
+                jax.tree_util.tree_leaves_with_path(pshard_pre)}
+        for name in ("q_proj/kernel", "o_proj/kernel"):
+            s = next((v for k, v in flat.items() if name in k), None)
+            assert s is not None, f"{name} not found in the 70B param tree"
+            # scanned stacked leaves: [L, in, out] — model on the matmul
+            # dim, ZeRO on a free dim
+            assert "model" in tuple(s.spec), (name, s.spec)
+    pshard = pshard_pre
     tx = optax.adamw(1e-4)
     aopt = jax.eval_shape(tx.init, aparams)
     oshard = plan.opt_state_shardings(aopt, aparams)
