@@ -144,6 +144,22 @@ def test_tp2_matches_tp1_at_same_global_batch():
 
 
 @pytest.mark.world_size(8)
+def test_tp_composes_with_ulysses_and_dp():
+    """3-axis engine run: model x seq x data with tensor_parallel on —
+    TP shards the weights, Ulysses shards the sequence, data shards the
+    batch; the trajectory must match plain DP at the same global batch."""
+    engine1, cfg = _engine({"data": 8}, stage=1, seed=13, micro=1)
+    ref = _train(engine1, cfg, 2, seed=31, batch=8)
+
+    engine2, cfg = _engine({"model": 2, "seq": 2, "data": 2}, stage=1,
+                           tp={"enabled": True}, seed=13, micro=4)
+    q = _leaf(engine2.params, "model", "layers_0", "self_attn", "q_proj", "kernel")
+    assert "model" in tuple(q.sharding.spec)
+    got = _train(engine2, cfg, 2, seed=31, batch=8)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.world_size(8)
 def test_tp_checkpoint_resumes_across_tp_degrees(tmp_path):
     """Reference test_configurable_parallel_mp.py semantics: train at MP=2,
     save, resume at MP=1 (and 1 -> 2); training continues identically."""
