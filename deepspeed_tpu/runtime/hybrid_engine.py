@@ -67,8 +67,13 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
         if self._gen_params_version == self.global_steps and self._gen_engine is not None:
             return
         params = self.params
+        # under native TP training the live weights are model-sharded; the
+        # serving model must run its TP dispatch (shard_map'd paged kernel,
+        # head-sharded KV) or the raw kernel would see sharded operands
+        tp = (self.mesh_ctx.axis_size("model")
+              if getattr(self, "_tp_training", False) else 1)
         model = RaggedLlamaModel(self._llama_config, params, dtype=self._he_dtype,
-                                 kv_block_size=self._kv_block_size)
+                                 kv_block_size=self._kv_block_size, tp_size=tp)
         if self._gen_engine is None:
             cfg = RaggedInferenceEngineConfig(
                 state_manager=DSStateManagerConfig(max_context=self._max_context),
@@ -78,6 +83,15 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
             # keep the KV cache + state manager; swap the weights (this is
             # the in-place weight sharing the reference gets from containers)
             model.set_state_manager(self._gen_engine._state_manager)
+            old = self._gen_engine._model
+            if (old.attn_backend == model.attn_backend
+                    and old.tp_size == model.tp_size):
+                # the compiled serving fns take params as an ARGUMENT and
+                # close only over refresh-invariants (config, block size,
+                # backend, tp, mesh) — carrying them over skips a full
+                # retrace+XLA recompile per optimizer step (under TP, a
+                # multi-device GSPMD compile)
+                model._fwd_cache = old._fwd_cache
             self._gen_engine._model = model
         self._gen_params_version = self.global_steps
 

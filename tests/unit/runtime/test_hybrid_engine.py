@@ -91,3 +91,62 @@ def test_sampled_generation_deterministic_by_seed(engine):
     c = engine.generate([[1, 2, 3]], max_new_tokens=4, do_sample=True, seed=12)
     assert a == b
     assert isinstance(c[0], list)
+
+
+@pytest.mark.world_size(8)
+def test_hybrid_generate_under_tp_training():
+    """RLHF under native TP training: the live weights are model-sharded, so
+    the rollout engine must run its TP serving dispatch (head-sharded KV,
+    sharded kernel) — greedy rollouts must match the non-TP hybrid engine's
+    and training must continue on the shared sharded weights."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, num_key_value_heads=4)
+
+    def build(mesh, tp):
+        reset_mesh_context()
+        model, params = init_llama(cfg, seed=0)
+        c = {"train_batch_size": 8,
+             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+             "hybrid_engine": {"enabled": True, "fp16": False,
+                               "kv_block_size": 16, "num_kv_blocks": 64,
+                               "max_out_tokens": 128},
+             "mesh": mesh,
+             "steps_per_print": 1000}
+        if tp:
+            c["tensor_parallel"] = {"enabled": True}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=c, llama_config=cfg)
+        return engine
+
+    ref = build({"data": 8}, tp=False).generate([[1, 5, 9], [2, 4, 6, 8]],
+                                                max_new_tokens=4)
+    eng = build({"model": 2, "data": 4}, tp=True)
+    assert eng._tp_training
+    out = eng.generate([[1, 5, 9], [2, 4, 6, 8]], max_new_tokens=4)
+    assert out == ref
+    # KV cache of the rollout engine is head-sharded
+    kv = eng._gen_engine._state_manager.kv_cache
+    assert tuple(kv.cache.sharding.spec)[:3] == (None, None, "model")
+    # training continues on the shared sharded weights
+    ids, labels = _batch()
+    loss = eng.forward(ids, labels)
+    eng.backward(loss)
+    eng.step()
+    assert np.isfinite(float(loss))
+
+
+def test_weight_swap_keeps_compiled_serving_fns(engine):
+    """The rollout engine's compiled forwards close only over
+    refresh-invariants; a post-step weight swap must reuse them — a
+    retrace per optimizer step would recompile the whole serving model
+    (under TP, a multi-device GSPMD compile) every RLHF iteration."""
+    engine.generate([[1, 2, 3, 4]], max_new_tokens=2)
+    cache_before = dict(engine._gen_engine._model._fwd_cache)
+    assert cache_before, "no compiled serving fn after generate()"
+    ids, labels = _batch()
+    loss = engine.forward(ids, labels)
+    engine.backward(loss)
+    engine.step()
+    engine.generate([[1, 2, 3, 4]], max_new_tokens=2)
+    cache_after = engine._gen_engine._model._fwd_cache
+    for k, fn in cache_before.items():
+        assert cache_after.get(k) is fn, "serving fn recompiled after swap"
