@@ -24,6 +24,10 @@ def main():
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="native tensor-parallel degree: creates the mesh "
+                         "model axis and composes column/row weight sharding "
+                         "with the ZeRO stage (config key: tensor_parallel)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -32,6 +36,8 @@ def main():
 
     with open(args.config) as f:
         config = json.load(f)
+    if args.tp > 1:
+        config["tensor_parallel"] = {"tp_size": args.tp}
 
     cfg = LlamaConfig(vocab_size=4096, hidden_size=args.hidden,
                       intermediate_size=int(2.75 * args.hidden),
