@@ -23,7 +23,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...comm.mesh import MeshContext
 from ...utils.logging import logger
-from ..zero_sharding import ZeroShardingPlan, leaf_spec
+from ..zero_sharding import ZeroShardingPlan, composed_tp_zero_spec, leaf_spec
+from ...parallel.tp import path_str
 from .spmd import spmd_pipeline_1f1b, spmd_pipeline_eval
 
 try:
@@ -43,8 +44,11 @@ except ImportError:  # pragma: no cover — older jax
 
 class PipeZeroPlan(ZeroShardingPlan):
     """ZeRO sharding with the pipe dimension consumed first: body leaves are
-    [L, ...] with dim0 sharded over ``pipe``; the ZeRO rule applies to the
-    remaining dims."""
+    [L, ...] with dim0 sharded over ``pipe``; the ZeRO rule — composed with
+    TP when ``tp=True`` — applies to the remaining dims. The 1F1B executor's
+    shard_map is partial-manual over ``pipe`` only, so model/zero sharding
+    on the trailing dims stays GSPMD-managed inside the pipeline (psums on
+    row-parallel weights land inside each stage)."""
 
     def __init__(self, ctx: MeshContext, stage: int, body_key: str = "body", **kw):
         super().__init__(ctx, stage, **kw)
@@ -52,7 +56,8 @@ class PipeZeroPlan(ZeroShardingPlan):
 
     def param_shardings(self, params):
         base = super().param_shardings(params)
-        return self._override_body(params, base, self.stage >= 3)
+        return self._override_body(params, base, self.stage >= 3,
+                                   min_size=self.param_persistence_threshold)
 
     def grad_shardings(self, params):
         base = super().grad_shardings(params)
@@ -62,19 +67,26 @@ class PipeZeroPlan(ZeroShardingPlan):
         base = super().opt_state_shardings(opt_state)
         return self._override_body(opt_state, base, self.stage >= 1)
 
-    def _override_body(self, tree, base, zero_active):
+    def _override_body(self, tree, base, zero_active, min_size: int = 0):
         pipe = self.ctx.axis_size("pipe")
         if pipe <= 1:
             return base
-
         def _one(path, leaf, cur):
             names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
             shape = getattr(leaf, "shape", ())
             if self.body_key not in names or len(shape) == 0 or shape[0] % pipe != 0:
                 return cur
-            rest = P()
-            if zero_active and self.zero_axes:
-                rest = leaf_spec(shape[1:], self.zero_axes, self.ctx.axis_size(self.zero_axes))
+            zaxes = self.zero_axes if (zero_active and self.zero_axes) else ()
+            if self.tp:
+                rest = composed_tp_zero_spec(
+                    path_str(path), shape[1:], self.ctx, zaxes,
+                    self.ctx.axis_size(zaxes) if zaxes else 1,
+                    min_size=min_size)
+            elif zaxes:
+                rest = leaf_spec(shape[1:], zaxes,
+                                 self.ctx.axis_size(zaxes), min_size=min_size)
+            else:
+                rest = P()
             return NamedSharding(self.ctx.mesh, P("pipe", *tuple(rest)))
 
         return jax.tree_util.tree_map_with_path(_one, tree, base)
@@ -87,12 +99,34 @@ def _zero_cotangent(x):
     return np.zeros(x.shape, jax.dtypes.float0)
 
 
+def pipe_compute_specs(tree, ctx: MeshContext, tp: bool, leading_pipe: bool):
+    """Gather-for-compute shardings for the pre-pipeline constraint: the
+    ZeRO axes are gathered ONCE per step (stage-3 semantics — collectives
+    inside the scan's cond branches would also deadlock the CPU runtime's
+    rendezvous), but under TP the model axis must STAY sharded — replicating
+    it would silently defeat TP's compute/memory point every step.
+    ``leading_pipe``: body leaves are [L, ...] with dim 0 on the pipe axis."""
+    def _one(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        lead = ("pipe", ) if leading_pipe and len(shape) > 0 else ()
+        rest_shape = shape[1:] if lead else shape
+        if tp:
+            rest = tuple(composed_tp_zero_spec(path_str(path), rest_shape,
+                                               ctx, (), 1))
+        else:
+            rest = ()
+        return NamedSharding(ctx.mesh, P(*lead, *rest))
+
+    return jax.tree_util.tree_map_with_path(_one, tree)
+
+
 def make_pipeline_apply(embed_apply: Callable,
                         layer_apply: Callable,
                         head_apply: Callable,
                         mesh_ctx: MeshContext,
                         num_microbatches: int,
-                        remat_layers: bool = True):
+                        remat_layers: bool = True,
+                        tp: bool = False):
     """Build an `apply_fn(params, *batch) -> loss` running {embed -> pipelined
     body -> head}. `params` = {"embed", "body" ([L,...] stacked), "head"}.
 
@@ -183,14 +217,17 @@ def make_pipeline_apply(embed_apply: Callable,
             tgt_mbs = _microbatch(tuple(targets), M)
             # ZeRO-3 x PP: gather params over the ZeRO axis ONCE per step,
             # OUTSIDE the pipeline scan (gather-for-compute, shard-at-rest —
-            # stage3 semantics). Collectives inside the scan's per-tick cond
-            # branches would also deadlock the CPU runtime's rendezvous.
+            # stage3 semantics); under TP the model axis stays sharded
+            # (pipe_compute_specs) — the partial-manual executor carries it
             body = jax.lax.with_sharding_constraint(
-                params["body"], NamedSharding(mesh, P("pipe")))
+                params["body"],
+                pipe_compute_specs(params["body"], mesh_ctx, tp, True))
             embed = jax.lax.with_sharding_constraint(
-                params["embed"], NamedSharding(mesh, P()))
+                params["embed"],
+                pipe_compute_specs(params["embed"], mesh_ctx, tp, False))
             head = jax.lax.with_sharding_constraint(
-                params["head"], NamedSharding(mesh, P()))
+                params["head"],
+                pipe_compute_specs(params["head"], mesh_ctx, tp, False))
             return pipelined(body, embed, head, in_mbs, tgt_mbs)
         # pipe=1: plain sequential execution (no pipeline region)
         h = embed_apply(params["embed"], *inputs)
@@ -236,21 +273,13 @@ class PipelineEngine:
         mesh_ctx = self.engine.mesh_ctx
         mb = num_microbatches or mesh_ctx.axis_size("pipe") * 2
         apply_fn = make_pipeline_apply(embed_apply, layer_apply, head_apply,
-                                       mesh_ctx, mb)
+                                       mesh_ctx, mb,
+                                       tp=getattr(self.engine, "_tp_training",
+                                                  False))
         self.engine.apply_fn = apply_fn
-        if getattr(self.engine, "_tp_training", False):
-            # the pipelined body stacks layers into anonymous [L, ...]
-            # leaves, so the AutoTP name heuristics have nothing to match —
-            # composed TP inside the pipe executor is not implemented. Be
-            # loud: the user asked for TP and is not getting it.
-            logger.warning(
-                "tensor_parallel is not composed with the pipeline executor: "
-                "TP sharding is DISABLED for all params (the stacked body's "
-                "anonymous leaves give the AutoTP heuristics nothing to "
-                "match) — drop the model axis or use ZeRO/fsdp for the "
-                "non-pipe dimension")
         self.engine.zero_plan = PipeZeroPlan(
             mesh_ctx, self.engine._config.zero_config.stage,
+            tp=getattr(self.engine, "_tp_training", False),
             param_persistence_threshold=(
                 self.engine._config.zero_config.param_persistence_threshold))
         self.engine._init_state(params)
