@@ -1,0 +1,87 @@
+"""Dynamic loss-scale semantics (reference
+``tests/unit/runtime/half_precision/test_dynamic_loss_scale.py`` +
+``fp16/loss_scaler.py:91 DynamicLossScaler``): growth cadence, overflow
+halving with hysteresis, min/max clamps — as PURE update-rule tests so the
+arithmetic is pinned independently of any engine path (the engine-level
+overflow cascade lives in test_failure_paths.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.loss_scaler import (LossScaleState, LossScalerConfig,
+                                               has_overflow, make_dynamic_state,
+                                               make_static_state, update_scale)
+
+
+def _run(state, overflows, **kw):
+    scales = []
+    for ov in overflows:
+        state = update_scale(state, jnp.bool_(ov), **kw)
+        scales.append(float(state.cur_scale))
+    return state, scales
+
+
+def test_growth_every_scale_window_clean_iters():
+    """Reference loss_scaler.py:199: with last_overflow_iter=-1 the first
+    doubling lands on the (window-1)-th 0-based clean iter, then every
+    window after."""
+    s = make_dynamic_state(init_scale_power=4, delayed_shift=1)  # scale 16
+    _, scales = _run(s, [False] * 10, scale_window=4)
+    # iters 0..9; growth at iter 3 and 7 ((i - (-1)) % 4 == 0)
+    assert scales == [16, 16, 16, 32, 32, 32, 32, 64, 64, 64]
+
+
+def test_overflow_halves_and_resets_growth_clock():
+    s = make_dynamic_state(init_scale_power=4, delayed_shift=1)
+    s, scales = _run(s, [False, True, False, False], scale_window=4)
+    assert scales[1] == 8.0  # halved on overflow
+    # growth clock restarts at the overflow iter (1): next double when
+    # (iter - 1) % 4 == 0 -> iter 5, i.e. after 4 clean iters (2,3,4,5) —
+    # the reference's (cur_iter - last_overflow_iter) % window formula
+    _, scales2 = _run(s, [False] * 4, scale_window=4)  # iters 4..7
+    assert scales2 == [8, 16, 16, 16]
+
+
+def test_hysteresis_burns_before_halving():
+    """delayed_shift=2: the FIRST overflow only burns the hysteresis
+    credit; the second actually halves (reference delayed-shift)."""
+    s = make_dynamic_state(init_scale_power=4, delayed_shift=2)
+    s, scales = _run(s, [True, True, True], scale_window=1000)
+    assert scales == [16, 8, 4]
+    assert int(s.cur_hysteresis) == 1
+
+
+def test_consecutive_hysteresis_refills_on_clean_step():
+    """consecutive_hysteresis=True: a clean step restores the credit, so
+    ALTERNATING overflow/clean never halves."""
+    s = make_dynamic_state(init_scale_power=4, delayed_shift=2)
+    _, scales = _run(s, [True, False] * 4, scale_window=1000,
+                     consecutive_hysteresis=True, delayed_shift=2)
+    assert all(x == 16.0 for x in scales)
+
+
+def test_min_and_max_scale_clamps():
+    s = make_dynamic_state(init_scale_power=2, delayed_shift=1)  # 4.0
+    _, scales = _run(s, [True] * 6, min_scale=1.0)
+    assert scales == [2, 1, 1, 1, 1, 1]  # floor holds
+    s2 = make_dynamic_state(init_scale_power=4, delayed_shift=1)
+    _, scales2 = _run(s2, [False] * 3, scale_window=1, max_scale=32.0)
+    assert scales2 == [32, 32, 32]  # ceiling holds
+
+
+def test_static_scale_never_moves():
+    cfg = LossScalerConfig(dynamic=False, init_scale_power=16, scale_window=1000,
+                           hysteresis=2, consecutive_hysteresis=False,
+                           min_scale=1.0, static_scale=128.0)
+    s = cfg.initial_state()
+    for ov in (False, True, False):
+        s = cfg.update(s, jnp.bool_(ov))
+    assert float(s.cur_scale) == 128.0 and int(s.iter) == 3
+
+
+def test_has_overflow_detects_nan_and_inf():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    assert bool(has_overflow({**good, "c": jnp.array([1.0, np.nan])}))
+    assert bool(has_overflow({**good, "c": jnp.array([np.inf, 0.0])}))
+    assert not bool(has_overflow({}))  # empty tree: no overflow
