@@ -285,7 +285,8 @@ class DeepSpeedTpuEngine:
                 "or set tensor_parallel.tp_size)")
         self.zero_plan = ZeroShardingPlan(self.mesh_ctx, zc.stage,
                                           param_persistence_threshold=zc.param_persistence_threshold,
-                                          tp=self._tp_training)
+                                          tp=self._tp_training,
+                                          logical_axes=kwargs.get("logical_axes"))
         if zc.stage >= 3 and model_parameters is not None:
             # max_live_parameters governor advisory (zero_governor.py): the
             # structural ceiling is scan chunking — warn when the model's

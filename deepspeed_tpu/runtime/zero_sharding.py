@@ -86,17 +86,38 @@ def replicated_tree(tree: Any, ctx: MeshContext):
 
 def composed_tp_zero_spec(path: str, shape: Sequence[int], ctx: MeshContext,
                           zero_axes: Tuple[str, ...], zero_size: int,
-                          min_size: int = 0) -> P:
+                          min_size: int = 0, logical=None) -> P:
     """Tensor-parallel spec (column/row rules over the ``model`` axis,
     ``parallel/tp.py``) composed with ZeRO: ZeRO shards the largest dim TP
     left free (earliest wins ties, matching ``choose_partition_dim``); when
     no free dim divides, the TP dim is co-sharded by (model, zero) if the
     per-TP-shard extent still divides. Leaves TP doesn't match degrade to
     the plain ZeRO rule — so norm scales, biases and embeddings behave
-    exactly as without TP."""
-    from ..parallel.tp import heuristic_spec
+    exactly as without TP.
+
+    ``logical``: this leaf's flax logical-axis names (t5x-style
+    ``nn.with_partitioning`` metadata) — when given, the TP part comes from
+    the LOGICAL_RULES table instead of the name heuristics, so custom
+    modules whose param names the AutoTP regexes can't match still TP."""
+    from ..parallel.tp import heuristic_spec, spec_from_logical
     mp = ctx.axis_size("model")
-    tp = tuple(heuristic_spec(path, shape, mp)) if mp > 1 else ()
+    if mp > 1 and logical is not None:
+        # honor every LIVE mesh axis the rules name (model, expert, ...);
+        # an axis may appear once per spec (first dim wins — LOGICAL_RULES
+        # maps both 'heads' and 'kv' to model) and only when the dim divides
+        raw = tuple(spec_from_logical(logical))[:len(shape)]
+        used, tp_l = set(), []
+        for d, e in enumerate(raw):
+            ok = (e is not None and e not in used
+                  and ctx.axis_size(e) > 1 and shape[d] % ctx.axis_size(e) == 0)
+            tp_l.append(e if ok else None)
+            if ok:
+                used.add(e)
+        tp = tuple(tp_l)
+    elif mp > 1:
+        tp = tuple(heuristic_spec(path, shape, mp))
+    else:
+        tp = ()
     spec = list(tp) + [None] * (len(shape) - len(tp))
     if not zero_axes or zero_size <= 1 or int(np.prod(shape)) <= min_size:
         return P(*spec)
@@ -110,27 +131,40 @@ def composed_tp_zero_spec(path: str, shape: Sequence[int], ctx: MeshContext,
         return P(*spec)
     for d in sorted((i for i in range(len(shape)) if spec[i] is not None),
                     key=lambda i: -shape[i]):
-        if shape[d] % (mp * zero_size) == 0:
-            cur = spec[d] if isinstance(spec[d], tuple) else (spec[d], )
+        cur = spec[d] if isinstance(spec[d], tuple) else (spec[d], )
+        taken = int(np.prod([ctx.axis_size(a) for a in cur]))
+        if shape[d] % (taken * zero_size) == 0:
             spec[d] = cur + tuple(zero_axes)
             break
     return P(*spec)
 
 
 def tree_shardings_tp_zero(tree: Any, ctx: MeshContext,
-                           zero_axes: Tuple[str, ...], min_size: int = 0):
+                           zero_axes: Tuple[str, ...], min_size: int = 0,
+                           logical_axes: Any = None):
     """NamedSharding pytree composing TP (model axis) with ZeRO sharding.
     Works for params AND optimizer state: the AutoTP name heuristics match
     by substring, and optimizer-state paths (``.../mu/model/layers_0/...``)
-    embed the param path, so moments shard exactly like their weights."""
+    embed the param path, so moments shard exactly like their weights.
+    ``logical_axes``: optional pytree of per-leaf logical-name tuples
+    (matching ``tree``'s structure) that overrides the name heuristics."""
     from ..parallel.tp import path_str
     zsize = ctx.axis_size(zero_axes) if zero_axes else 1
 
-    def _one(path, leaf):
+    def _one(path, leaf, logical=None):
         shape = getattr(leaf, "shape", ())
         return NamedSharding(ctx.mesh, composed_tp_zero_spec(
-            path_str(path), shape, ctx, zero_axes, zsize, min_size))
+            path_str(path), shape, ctx, zero_axes, zsize, min_size,
+            logical=logical))
 
+    if logical_axes is not None:
+        # map over the LOGICAL tree (its tuple/None entries are leaves by
+        # is_leaf; as the first tree they never get descended into) with the
+        # param tree alongside
+        return jax.tree_util.tree_map_with_path(
+            lambda path, logical, leaf: _one(path, leaf, logical),
+            logical_axes, tree,
+            is_leaf=lambda x: x is None or isinstance(x, tuple))
     return jax.tree_util.tree_map_with_path(_one, tree)
 
 
@@ -142,7 +176,7 @@ class ZeroShardingPlan:
     """
 
     def __init__(self, ctx: MeshContext, stage: int, param_persistence_threshold: int = 0,
-                 tp: bool = False):
+                 tp: bool = False, logical_axes: Any = None):
         self.ctx = ctx
         self.stage = stage
         self.zero_axes = zero_axes_for(ctx) if stage > 0 else ()
@@ -152,12 +186,18 @@ class ZeroShardingPlan:
         # applies at EVERY stage (that is its memory/compute point), ZeRO
         # keeps its stage gates for which trees it shards
         self.tp = tp and ctx.axis_size("model") > 1
+        # optional t5x-style logical-axis metadata (per-leaf name tuples,
+        # param-tree structure): overrides the AutoTP name heuristics for
+        # params/grads; optimizer state (different tree structure) falls
+        # back to the path heuristics
+        self.logical_axes = logical_axes
 
     def param_shardings(self, params):
         if self.tp:
             zaxes = self.zero_axes if self.stage >= 3 else ()
             return tree_shardings_tp_zero(params, self.ctx, zaxes,
-                                          min_size=self.param_persistence_threshold)
+                                          min_size=self.param_persistence_threshold,
+                                          logical_axes=self.logical_axes)
         if self.stage >= 3 and self.zero_axes:
             return tree_shardings(params, self.ctx, self.zero_axes,
                                   min_size=self.param_persistence_threshold)
@@ -167,17 +207,53 @@ class ZeroShardingPlan:
         """Sharding of the gradient-accumulation buffer (stage>=2 sharded)."""
         if self.tp:
             return tree_shardings_tp_zero(
-                params, self.ctx, self.zero_axes if self.stage >= 2 else ())
+                params, self.ctx, self.zero_axes if self.stage >= 2 else (),
+                logical_axes=self.logical_axes)
         if self.stage >= 2 and self.zero_axes:
             return tree_shardings(params, self.ctx, self.zero_axes)
         return replicated_tree(params, self.ctx)
+
+    def _logical_by_suffix(self):
+        """{param-path-tuple: logical-names} for suffix lookup: optimizer
+        moments embed the param subtree (``.../mu/<param path>``), so the
+        LONGEST param path that suffixes an opt leaf's path carries that
+        leaf's logical metadata — moments then shard exactly like their
+        weights even when the param names match no AutoTP regex."""
+        if self.logical_axes is None:
+            return None
+        flat = {}
+        for path, names in jax.tree_util.tree_flatten_with_path(
+                self.logical_axes,
+                is_leaf=lambda x: x is None or isinstance(x, tuple))[0]:
+            key = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+            flat[key] = names
+        return flat
 
     def opt_state_shardings(self, opt_state, params=None):
         """Stage>=1: shard every optimizer-state leaf that matches a
         partitionable shape; scalars (count, loss scale) stay replicated."""
         if self.tp:
-            return tree_shardings_tp_zero(
-                opt_state, self.ctx, self.zero_axes if self.stage >= 1 else ())
+            zaxes = self.zero_axes if self.stage >= 1 else ()
+            suffix_map = self._logical_by_suffix()
+            if not suffix_map:
+                return tree_shardings_tp_zero(opt_state, self.ctx, zaxes)
+            from ..parallel.tp import path_str
+            zsize = self.ctx.axis_size(zaxes) if zaxes else 1
+
+            def _one(path, leaf):
+                keys = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                             for k in path)
+                logical = None
+                for start in range(len(keys)):  # longest suffix wins
+                    if keys[start:] in suffix_map:
+                        logical = suffix_map[keys[start:]]
+                        break
+                return NamedSharding(self.ctx.mesh, composed_tp_zero_spec(
+                    path_str(path), getattr(leaf, "shape", ()), self.ctx,
+                    zaxes, zsize, logical=logical))
+
+            return jax.tree_util.tree_map_with_path(_one, opt_state)
         if self.stage >= 1 and self.zero_axes:
             return tree_shardings(opt_state, self.ctx, self.zero_axes)
         return replicated_tree(opt_state, self.ctx)

@@ -210,3 +210,76 @@ def test_tp_checkpoint_resumes_across_tp_degrees(tmp_path):
     e3.load_checkpoint(str(tmp_path / "ck2"), tag="tp1")
     got2 = _train(e3, cfg, 1, seed=23, batch=8)
     np.testing.assert_allclose(got2, ref2, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.world_size(8)
+def test_tp_via_logical_axes_metadata():
+    """t5x-style logical-axis TP: a custom module whose param names the
+    AutoTP regexes can't match still TP-shards when the user passes
+    per-leaf logical names (LOGICAL_RULES: 'mlp' -> model axis) to
+    initialize(logical_axes=...). Trajectory matches the non-TP run."""
+    import flax.linen as nn
+
+    class _Custom(nn.Module):
+        width: int = 64
+
+        @nn.compact
+        def __call__(self, x, labels=None):
+            win = self.param("alpha", nn.initializers.lecun_normal(), (16, self.width))
+            wout = self.param("beta", nn.initializers.lecun_normal(), (self.width, 16))
+            out = jnp.tanh(x @ win) @ wout
+            if labels is None:
+                return out
+            return ((out - labels) ** 2).mean()
+
+    # names chosen to NOT match the AutoTP regexes ("win" would —
+    # it contains "wi", the T5 spelling)
+    logical = {"alpha": ("embed", "mlp"), "beta": ("mlp", "embed")}
+
+    def build(mesh, tp, micro, logical_axes=None):
+        reset_mesh_context()
+        model = _Custom()
+        params = model.init(jax.random.PRNGKey(2), jnp.ones((1, 16)))["params"]
+        c = {"train_micro_batch_size_per_gpu": micro,
+             "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+             "zero_optimization": {"stage": 1},
+             "mesh": mesh, "steps_per_print": 0}
+        if tp:
+            c["tensor_parallel"] = {"enabled": True}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=c,
+            logical_axes=logical_axes)
+        return engine
+
+    def train(engine, steps, seed):
+        rng = np.random.default_rng(seed)
+        losses = []
+        for _ in range(steps):
+            x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+            loss = engine.forward(x, labels=x)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return losses
+
+    ref = train(build({"data": 8}, tp=False, micro=1), 3, seed=17)
+
+    eng = build({"model": 2, "data": 4}, tp=True, micro=2,
+                logical_axes=logical)
+    win = eng.params["alpha"]
+    wout = eng.params["beta"]
+    assert tuple(win.sharding.spec) == (None, "model"), win.sharding.spec
+    assert tuple(wout.sharding.spec) == ("model", None), wout.sharding.spec
+    # moments follow their weights via LONGEST-SUFFIX lookup of the logical
+    # tree in the optimizer state's paths (no regex can match 'alpha')
+    mu_specs = [tuple(l.sharding.spec)
+                for p, l in jax.tree_util.tree_leaves_with_path(eng.opt_state)
+                if "alpha" in "/".join(str(getattr(k, "key", k)) for k in p)]
+    assert mu_specs and all("model" in sp for sp in mu_specs), mu_specs
+    got = train(eng, 3, seed=17)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    # WITHOUT metadata the same model stays replicated over model (the
+    # regexes don't match 'win'/'wout') — the metadata is what engages TP
+    eng2 = build({"model": 2, "data": 4}, tp=True, micro=2)
+    assert "model" not in tuple(eng2.params["alpha"].sharding.spec)
