@@ -4,6 +4,7 @@ TestFp8ComposabilityAcrossZero`` — TE fp8 Linear trained under every ZeRO
 stage). TPU form: ``runtime/fp8.py`` current-scaling HYBRID fp8 matmul."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -71,6 +72,31 @@ class _Fp8MLP(nn.Module):
         return out
 
 
+def _run_fp8(mesh_axes, x, y, stage=0, steps=8, tp=False, logical_axes=None):
+    """Shared fp8 engine-run helper: build mesh + _Fp8MLP + engine, train
+    ``steps``, return (engine, losses)."""
+    reset_mesh_context()
+    set_mesh_context(MeshContext.create(axis_sizes=mesh_axes))
+    model = _Fp8MLP()
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": stage},
+           "steps_per_print": 0}
+    if tp:
+        cfg["tensor_parallel"] = {"enabled": True}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg,
+        logical_axes=logical_axes)
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(x, labels=y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return engine, losses
+
+
 def test_fp8_trains_under_every_zero_stage():
     """The reference test's contract: an fp8 model trains under each ZeRO
     stage; stages shard state, not math, so trajectories must agree. One
@@ -81,23 +107,7 @@ def test_fp8_trains_under_every_zero_stage():
     y = jnp.asarray(rng.normal(size=(16, )), jnp.float32)
 
     def run_stage(stage):
-        reset_mesh_context()
-        set_mesh_context(MeshContext.create(axis_sizes={"data": 2, "fsdp": 4}))
-        model = _Fp8MLP()
-        params = model.init(jax.random.PRNGKey(0), x)["params"]
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_batch_size": 16,
-                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
-                    "zero_optimization": {"stage": stage},
-                    "steps_per_print": 0})
-        losses = []
-        for _ in range(8):
-            loss = engine.forward(x, labels=y)
-            engine.backward(loss)
-            engine.step()
-            losses.append(float(loss))
-        return losses
+        return _run_fp8({"data": 2, "fsdp": 4}, x, y, stage=stage)[1]
 
     base = run_stage(0)
     assert all(np.isfinite(base))
@@ -148,3 +158,26 @@ def test_fp8_fused_train_step_path():
         loss = engine.fused_train_step(x, labels=y)
         first = first if first is not None else float(loss)
     assert float(loss) < first and np.isfinite(float(loss))
+
+
+@pytest.mark.world_size(8)
+def test_fp8_composes_with_tp_via_logical_axes():
+    """fp8 x TP x ZeRO: Fp8Linear's param names match no AutoTP regex, so
+    TP engages through initialize(logical_axes=...). The fp8 amax is a
+    GLOBAL reduce under SPMD (runtime/fp8.py _quantize uses jnp.max over
+    the logical tensor), so quantization semantics are identical to the
+    unsharded run — the trajectory must agree within the same envelope as
+    the stage sweep, and a dropped psum would blow straight through it."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(16, )), jnp.float32)
+    logical = {"Fp8Linear_0": {"kernel": ("embed", "mlp"), "bias": ("mlp", )},
+               "Fp8Linear_1": {"kernel": ("mlp", "embed")}}
+
+    _, base = _run_fp8({"data": 8}, x, y, stage=1, steps=6)
+    eng, got = _run_fp8({"model": 2, "data": 4}, x, y, stage=1, steps=6,
+                        tp=True, logical_axes=logical)
+    k0 = eng.params["Fp8Linear_0"]["kernel"]
+    assert "model" in tuple(k0.sharding.spec), k0.sharding.spec
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-5)
+    assert got[-1] < got[0] * 0.9
