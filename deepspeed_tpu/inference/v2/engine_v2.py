@@ -386,7 +386,8 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                        seed: int = 0,
                        dtype=None,
                        kv_block_size: int = 64,
-                       quantize=None) -> InferenceEngineV2:
+                       quantize=None,
+                       attn_backend: str = "auto") -> InferenceEngineV2:
     """Factory (reference ``engine_factory.py build_hf_engine``): build a
     ragged engine from a Llama config + trained params (random if None)."""
     import jax.numpy as jnp
@@ -411,5 +412,6 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
         _, params = init_llama(config, seed=seed)
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
                              kv_block_size=kv_block_size, quantize=quantize,
+                             attn_backend=attn_backend,
                              tp_size=engine_config.tensor_parallel.tp_size)
     return InferenceEngineV2(model, engine_config)

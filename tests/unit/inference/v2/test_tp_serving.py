@@ -81,7 +81,6 @@ def test_tp_paged_kernel_matches_dense():
     match the dense single-chip reference. ALiBi configs fall back to dense
     (the kernel derives slopes from local head indices)."""
     from deepspeed_tpu.inference.v2.model import RaggedLlamaModel
-    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
     cfg = LlamaConfig.tiny(num_key_value_heads=4)
     _, params = init_llama(cfg, seed=5)
 
@@ -91,10 +90,10 @@ def test_tp_paged_kernel_matches_dense():
 
     reset_mesh_context()
     ec = RaggedInferenceEngineConfig(tensor_parallel={"tp_size": 2})
-    model = RaggedLlamaModel(cfg, params, dtype=jnp.float32,
-                             attn_backend="paged", tp_size=2)
+    engine = build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                                engine_config=ec, attn_backend="paged")
+    model = engine.model()
     assert model.attn_backend == "paged"  # eligible: 4 kv heads % 2 == 0
-    engine = InferenceEngineV2(model, ec)
     got = _logits(engine, [0, 1], PROMPTS[:2])
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
