@@ -157,6 +157,35 @@ def test_unflatten_into_unsorted_key_order():
         np.testing.assert_allclose(v, flat[k] + 1, err_msg=k)
 
 
+def make_llama_engine(mesh, llama_cfg, zero_stage=1, seed=3, extra_cfg=None):
+    """Tiny-llama engine builder shared by the MoE-topology and
+    TP-universal classes (one init/config pattern to maintain)."""
+    from deepspeed_tpu.models import init_llama
+    reset_mesh_context()
+    model, params = init_llama(llama_cfg, seed=seed)
+    c = {"train_batch_size": 8,
+         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+         "zero_optimization": {"stage": zero_stage},
+         "mesh": mesh, "steps_per_print": 1000}
+    c.update(extra_cfg or {})
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=c)
+    return eng
+
+
+def train_llama_ids(eng, llama_cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = jnp.asarray(rng.integers(0, llama_cfg.vocab_size, size=(8, 16)),
+                          jnp.int32)
+        loss = eng.forward(ids, labels=ids)
+        eng.backward(loss)
+        eng.step()
+        out.append(float(loss))
+    return out
+
+
 class TestMoECheckpointTopology:
     """MoE expert-shard checkpointing (reference engine.py:3210
     _save_moe_checkpoint + largest_layer merge): save with one expert-
@@ -175,28 +204,8 @@ class TestMoECheckpointTopology:
             LlamaConfig.tiny(num_hidden_layers=1), num_local_experts=4,
             num_experts_per_tok=2, dtype=jnp.float32)
 
-        def mk(mesh):
-            reset_mesh_context()
-            model, params = init_llama(cfg, seed=3)
-            eng, *_ = deepspeed_tpu.initialize(
-                model=model, model_parameters=params,
-                config={"train_batch_size": 8,
-                        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-                        "zero_optimization": {"stage": 1},
-                        "mesh": mesh, "steps_per_print": 1000})
-            return eng
-
-        def step(eng, n, seed):
-            rng = np.random.default_rng(seed)
-            out = []
-            for _ in range(n):
-                ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, 16)),
-                                  jnp.int32)
-                loss = eng.forward(ids, labels=ids)
-                eng.backward(loss)
-                eng.step()
-                out.append(float(loss))
-            return out
+        mk = lambda mesh: make_llama_engine(mesh, cfg)  # noqa: E731
+        step = lambda eng, n, seed: train_llama_ids(eng, cfg, n, seed)  # noqa: E731
 
         e1 = mk(save_mesh)
         step(e1, 2, seed=21)
@@ -365,3 +374,40 @@ class TestCheckpointSchedulerAndTiedWeights:
         # and the restored model still produces logits through the tied head
         out = eng2.eval_batch(ids, labels=ids)
         assert np.isfinite(float(out))
+
+
+class TestUniversalFromTPSave:
+    """The offline converter over a TP-sharded save (reference
+    ds_to_universal merges TP slices, ``checkpoint/ds_to_universal.py:232``):
+    a model-axis-sharded checkpoint converts to per-param fp32 fragments
+    and resumes on a plain DP topology with the trajectory intact."""
+
+    @pytest.mark.world_size(8)
+    def test_tp_save_converts_and_resumes_plain(self, tmp_path):
+        from deepspeed_tpu.models import LlamaConfig
+
+        # fp32 so the cross-topology loss comparison is robust on the MXU
+        # (same reasoning as TestMoECheckpointTopology); only the deltas
+        # from tiny()'s defaults are spelled out
+        cfg = LlamaConfig.tiny(num_key_value_heads=4, attn_impl="xla",
+                               dtype=jnp.float32)
+
+        def llama_engine(mesh, tp):
+            extra = {"tensor_parallel": {"enabled": True}} if tp else None
+            return make_llama_engine(mesh, cfg, zero_stage=2, seed=4,
+                                     extra_cfg=extra), cfg
+
+        train_ids = lambda e, cfg, n, seed: train_llama_ids(e, cfg, n, seed)  # noqa: E731
+
+        e1, cfg = llama_engine({"model": 2, "data": 4}, tp=True)
+        q = e1.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+        assert "model" in tuple(q.sharding.spec)  # genuinely TP-sharded save
+        train_ids(e1, cfg, 3, seed=6)
+        e1.save_checkpoint(tmp_path / "ckpt", tag="tp")
+        ds_to_universal(str(tmp_path / "ckpt" / "tp"), str(tmp_path / "uni"))
+        ref = train_ids(e1, cfg, 2, seed=7)
+
+        e2, cfg = llama_engine({"data": 8}, tp=False)
+        e2.load_universal_checkpoint(str(tmp_path / "uni"))
+        got = train_ids(e2, cfg, 2, seed=7)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
