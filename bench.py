@@ -93,6 +93,11 @@ def bench_engine_config(batch):
     return {"train_batch_size": batch,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "bf16": {"enabled": True},
+            # llama threads dtype through every use site, so the fp32->bf16
+            # cast happens per scan chunk inside the model — kills the
+            # whole-model-sized convert_element_type temps that OOMed the
+            # round-4 window (.perf/bench_fast_r4_0731T1228.out)
+            "param_cast": "model",
             "steps_per_print": 0}
 
 

@@ -208,6 +208,18 @@ class DeepSpeedTpuConfig:
         self.graph_harvesting = pd.get("graph_harvesting", False)
         self.seed = pd.get("seed", 42)
 
+        # TPU-native extension (no reference key): where the fp32-master ->
+        # compute-dtype cast happens. "engine" casts the whole tree before
+        # apply (safe for models that ignore dtype); "model" passes fp32
+        # masters straight through and relies on the model's use-site casts
+        # (the flax `dtype=` convention). For nn.scan-stacked models "model"
+        # is the structural fix for whole-model-sized convert_element_type
+        # temps: each scan step casts only its chunk's slice.
+        self.param_cast = pd.get("param_cast", "engine")
+        if self.param_cast not in ("engine", "model"):
+            raise ValueError(
+                f'param_cast must be "engine" or "model", got {self.param_cast!r}')
+
     # ------------------------------------------------------------------
 
     def _configure_train_batch_size(self):

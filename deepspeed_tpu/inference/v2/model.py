@@ -131,6 +131,7 @@ class RaggedLlamaModel:
                              f"got {quantize!r}")
         self._quantize = quantize
         self.tp_size = int(tp_size or 1)
+        self._kv_pad = 0  # KV-head padding for nondivisible GQA under TP
         if self.tp_size > 1 and quantize is not None:
             # packed WoQ kernels have collapsed shapes the TP heuristics
             # cannot row/col-shard — refuse loudly rather than serve a
@@ -171,17 +172,22 @@ class RaggedLlamaModel:
                 # attention is embarrassingly parallel over heads: the paged
                 # branch runs the kernel per head-block inside a
                 # partial-manual shard_map (same design as ulysses_flash).
-                # Ineligible: kv heads not divisible by tp (GQA group
-                # mapping wouldn't survive the split) or ALiBi (the kernel
-                # derives slopes from LOCAL head indices — wrong per shard).
-                if (config.num_key_value_heads % self.tp_size != 0
-                        or config.pos_embedding == "alibi"):
+                # KV heads not divisible by tp pad up to the next multiple
+                # (reference sharding/attn.py handles uneven head splits;
+                # here padded heads carry zero K/V/q and their outputs are
+                # sliced off after the kernel). ALiBi stays on the kernel:
+                # global-head slopes are computed once and each shard gets
+                # its slice through the shard_map, so head identity
+                # survives the split.
+                rem = config.num_key_value_heads % self.tp_size
+                if rem:
+                    self._kv_pad = self.tp_size - rem
                     from ...utils.logging import logger
-                    logger.warning(
-                        "TP serving: paged kernel ineligible "
-                        f"(kv_heads={config.num_key_value_heads} % tp="
-                        f"{self.tp_size} or ALiBi) — using dense attention")
-                    attn_backend = "dense"
+                    logger.info(
+                        f"TP serving: kv_heads={config.num_key_value_heads} "
+                        f"pads to {config.num_key_value_heads + self._kv_pad} "
+                        f"for tp={self.tp_size} (paged kernel keeps running; "
+                        f"padded heads are dead weight, not a dense fallback)")
         self.attn_backend = attn_backend
         if self._mesh_ctx is not None:
             # place each leaf DIRECTLY into its TP sharding — a plain
@@ -200,9 +206,11 @@ class RaggedLlamaModel:
             self.params = jax.tree_util.tree_map(_place, params, shardings)
             # KV cache [L, 2, KV, slot, D] shards over the head dim — each
             # chip holds 1/tp of the cache, the memory point of TP serving.
-            # GQA with kv_heads % tp != 0 replicates (correct, larger).
+            # Paged backend: nondivisible KV pads to a tp multiple (above),
+            # so the head dim always shards. Dense backend with
+            # kv_heads % tp != 0 replicates (correct, larger).
             from jax.sharding import NamedSharding, PartitionSpec as P
-            n_kv = config.num_key_value_heads
+            n_kv = config.num_key_value_heads + self._kv_pad
             spec = (P(None, None, "model", None, None)
                     if n_kv % self.tp_size == 0 else P())
             self._cache_sharding = NamedSharding(self._mesh_ctx.mesh, spec)
@@ -263,7 +271,8 @@ class RaggedLlamaModel:
         cfg = self.config
         return KVCacheConfig(
             block_size=self.kv_block_size,
-            cache_shape=(cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim_),
+            cache_shape=(cfg.num_hidden_layers,
+                         cfg.num_key_value_heads + self._kv_pad, cfg.head_dim_),
             cache_dtype="bfloat16" if self.dtype == jnp.bfloat16 else "float32",
             cache_sharding=self._cache_sharding)
 
@@ -341,6 +350,7 @@ class RaggedLlamaModel:
                                  block_size=self.kv_block_size,
                                  attn_backend=self.attn_backend,
                                  tp_size=self.tp_size,
+                                 kv_pad=self._kv_pad,
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
@@ -352,7 +362,7 @@ class RaggedLlamaModel:
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
-                    tp_size: int = 1, mesh=None):
+                    tp_size: int = 1, kv_pad: int = 0, mesh=None):
     """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
     cfg = config
     T = batch.tokens.shape[0]
@@ -424,11 +434,17 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         # paged write: one scatter of the new tokens' K/V into flat slots
         # (cache is [layer, 2, KV, slot, D]; advanced indexing puts the
-        # token axis first, matching kv_new's [T, 2, KV, D])
+        # token axis first, matching kv_new's [T, 2, KV, D]). kv_pad > 0:
+        # nondivisible-GQA TP — the cache rides padded KV heads (zeros) so
+        # the head dim splits evenly over the model axis
         kv_new = jnp.stack([k, v], axis=1).astype(cache.dtype)
+        if kv_pad:
+            kv_new = jnp.pad(kv_new, ((0, 0), (0, 0), (0, kv_pad), (0, 0)))
         cache = cache.at[l, :, :, batch.token_slot, :].set(kv_new, mode="drop")
 
         q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd)  # grouped queries
+        if kv_pad:
+            q_s = jnp.pad(q_s, ((0, 0), (0, 0), (0, kv_pad), (0, 0), (0, 0)))
 
         if attn_backend == "paged":
             # Pallas blocked-flash: stream the block-table pages, online
@@ -438,31 +454,53 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             kernel_kw = dict(page_size=block_size,
                              window=_layer_window(cfg, l),
                              attn_scale=cfg.attn_scale,
-                             use_alibi=cfg.pos_embedding == "alibi",
                              softcap=cfg.attn_logit_softcapping,
                              interpret=not on_tpu())
+            has_alibi = cfg.pos_embedding == "alibi"
             if tp_size > 1:
                 # TP: kernel per LOCAL head block inside a partial-manual
                 # shard_map (heads are independent — no collectives); q and
                 # the cache shard on their head dims, metadata replicated.
                 # ``mesh`` is the model's OWN mesh, threaded in explicitly —
                 # a global lookup at retrace time could bind a newer
-                # engine's mesh and clash with this jit's pinned shardings
+                # engine's mesh and clash with this jit's pinned shardings.
+                # ALiBi: slopes are a GLOBAL-head table sharded alongside
+                # the heads, so each shard biases with its true head
+                # identity (reference sharding/attn.py).
                 from jax.sharding import PartitionSpec as P
                 hspec = P(None, None, "model", None, None)
                 rep = P()
+                if has_alibi:
+                    from ...models.llama import alibi_slopes
+                    slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)
+                    if kv_pad:
+                        slopes = jnp.pad(slopes, ((0, kv_pad), (0, 0)))
 
-                def _paged_local(q_l, cache_l, bt, seen, lens):
-                    return paged_attention(q_l, cache_l, l, bt, seen, lens,
-                                           **kernel_kw)
+                    def _paged_local(q_l, cache_l, bt, seen, lens, sl):
+                        return paged_attention(q_l, cache_l, l, bt, seen,
+                                               lens, slopes=sl, **kernel_kw)
 
-                ctx = _smap(
-                    _paged_local, mesh,
-                    (hspec, hspec, rep, rep, rep), hspec, {"model"},
-                )(q_s, cache, batch.block_table, batch.seq_seen, seq_lens)
+                    ctx = _smap(
+                        _paged_local, mesh,
+                        (hspec, hspec, rep, rep, rep, P("model", None)),
+                        hspec, {"model"},
+                    )(q_s, cache, batch.block_table, batch.seq_seen,
+                      seq_lens, slopes)
+                else:
+                    def _paged_local(q_l, cache_l, bt, seen, lens):
+                        return paged_attention(q_l, cache_l, l, bt, seen,
+                                               lens, **kernel_kw)
+
+                    ctx = _smap(
+                        _paged_local, mesh,
+                        (hspec, hspec, rep, rep, rep), hspec, {"model"},
+                    )(q_s, cache, batch.block_table, batch.seq_seen, seq_lens)
             else:
                 ctx = paged_attention(q_s, cache, l, batch.block_table,
-                                      batch.seq_seen, seq_lens, **kernel_kw)
+                                      batch.seq_seen, seq_lens,
+                                      use_alibi=has_alibi, **kernel_kw)
+            if kv_pad:
+                ctx = ctx[:, :, :nkv]  # drop the padded heads' outputs
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
             hist = cache[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]

@@ -236,10 +236,50 @@ def alibi_slopes(n_heads: int) -> np.ndarray:
     return slopes.astype(np.float32)
 
 
+def _use_cast(w, dtype):
+    """Use-site weight cast, hoist-proof (engine ``param_cast="model"``).
+
+    fp32 masters arrive stacked ``[L, ...]`` under ``nn.scan``; each scan
+    step must down-convert only ITS slice, or peak HBM grows by a whole
+    bf16 copy of the model. XLA undoes a naive in-body ``astype`` —
+    ``convert(slice(W))`` commutes to ``slice(convert(W))`` and LICM hoists
+    the now loop-invariant whole-tree convert right back out of the scan
+    loop (the round-4 OOM pattern, ``.perf/bench_fast_r4_0731T1228.out``).
+    The ``optimization_barrier`` between the slice and the cast makes that
+    reorder illegal, pinning the convert to chunk granularity. When params
+    already arrive at compute dtype (engine-side casting), this is a no-op.
+    """
+    if w.dtype == dtype:
+        return w
+    return jax.lax.optimization_barrier(w).astype(dtype)
+
+
+class _BarrierDense(nn.Module):
+    """nn.Dense with a hoist-proof use-site kernel cast (see _use_cast).
+    Same param names/shapes/partitioning as nn.Dense."""
+    features: int
+    dtype: Any
+    kernel_init: Any
+    bias_init: Any
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features))
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), _use_cast(kernel, self.dtype),
+            (((x.ndim - 1, ), (0, )), ((), ())))
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features, ))
+            y = y + _use_cast(bias, self.dtype)
+        return y
+
+
 def _dense(features, name, axes, dtype, use_bias=False):
-    return nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name,
-                    kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), axes),
-                    bias_init=nn.with_partitioning(nn.initializers.zeros, (axes[-1], )))
+    return _BarrierDense(features, use_bias=use_bias, dtype=dtype, name=name,
+                         kernel_init=nn.with_partitioning(nn.initializers.lecun_normal(), axes),
+                         bias_init=nn.with_partitioning(nn.initializers.zeros, (axes[-1], )))
 
 
 def _make_norm(cfg, name):
@@ -456,12 +496,12 @@ class LlamaMoEBlock(nn.Module):
         w = w.astype(cfg.dtype)
 
         init = nn.with_partitioning(nn.initializers.lecun_normal(), ("expert", EMBED, HIDDEN))
-        w1 = self.param("w1", init, (E, H, F), jnp.float32).astype(cfg.dtype)
-        w3 = self.param("w3", init, (E, H, F), jnp.float32).astype(cfg.dtype)
-        w2 = self.param("w2",
-                        nn.with_partitioning(nn.initializers.lecun_normal(),
-                                             ("expert", HIDDEN, EMBED)),
-                        (E, F, H), jnp.float32).astype(cfg.dtype)
+        w1 = _use_cast(self.param("w1", init, (E, H, F), jnp.float32), cfg.dtype)
+        w3 = _use_cast(self.param("w3", init, (E, H, F), jnp.float32), cfg.dtype)
+        w2 = _use_cast(self.param("w2",
+                                  nn.with_partitioning(nn.initializers.lecun_normal(),
+                                                       ("expert", HIDDEN, EMBED)),
+                                  (E, F, H), jnp.float32), cfg.dtype)
 
         lead = x.shape[:-1]
         xt = x.reshape(-1, H)

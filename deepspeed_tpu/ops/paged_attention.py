@@ -143,6 +143,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
                     window: Optional[int] = None,
                     attn_scale: Optional[float] = None,
                     use_alibi: bool = False,
+                    slopes=None,
                     softcap: Optional[float] = None):
     """Blocked-flash attention over a paged KV cache.
 
@@ -155,6 +156,10 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
       seq_lens: ``[S]`` seen + n_new (valid key region).
       window: sliding-window size (None = global); ``attn_scale`` overrides
       1/sqrt(D); ``use_alibi`` adds BLOOM-style slope bias per query head.
+      slopes: optional explicit ``[KV, G]`` ALiBi slopes (implies alibi) —
+      under TP the caller passes each shard its GLOBAL-head slice (reference
+      sharding/attn.py keeps head identity across shards); None derives them
+      from local head indices, correct only unsharded.
     Returns:
       ``[S, N, KV, G, D]`` in q.dtype.
     """
@@ -180,11 +185,13 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
         pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
     ]
     inputs = [q, cache]
-    if use_alibi:
-        from ..models.llama import alibi_slopes
-        slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
+    has_alibi = use_alibi or slopes is not None
+    if has_alibi:
+        if slopes is None:
+            from ..models.llama import alibi_slopes
+            slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
         in_specs.append(pl.BlockSpec((1, G), lambda s, k, b, *_: (k, 0)))
-        inputs.append(slopes)
+        inputs.append(slopes.astype(jnp.float32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -203,7 +210,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
                                groups=G, scale=scale, window=window,
                                softcap=softcap,
-                               has_alibi=use_alibi)
+                               has_alibi=has_alibi)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -217,6 +224,7 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
                               *, page_size: int, window: Optional[int] = None,
                               attn_scale: Optional[float] = None,
                               use_alibi: bool = False,
+                              slopes=None,
                               softcap: Optional[float] = None):
     """Dense-gather XLA reference (the round-1 path) for numerics tests."""
     S, N, KV, G, D = q.shape
@@ -238,12 +246,13 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     mask = (key_pos <= q_abs[:, :, None]) & (key_pos < seq_lens[:, None, None])
     if window is not None:
         mask &= key_pos > q_abs[:, :, None] - window
-    if use_alibi:
-        from ..models.llama import alibi_slopes
-        slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
+    if use_alibi or slopes is not None:
+        if slopes is None:
+            from ..models.llama import alibi_slopes
+            slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
         dist = (key_pos[:, :, None, None, :]
                 - q_abs[:, :, None, None, None]).astype(jnp.float32)
-        scores = scores + slopes[None, None, :, :, None] * dist
+        scores = scores + slopes[None, None, :, :, None].astype(jnp.float32) * dist
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     any_visible = mask.any(-1)[:, :, None, None, None]

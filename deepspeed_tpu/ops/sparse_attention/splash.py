@@ -16,8 +16,12 @@ table (scalar prefetch) so each grid step streams exactly one ACTIVE k/v
 block; trailing padded steps are skipped with ``pl.when``. Online softmax
 accumulators live in VMEM scratch across the active sweep.
 
-Backward currently routes through the dense masked path's VJP (correct, not
-sparse-fast); the fwd kernel is where serving/long-context wins live.
+Backward is sparse too (reference parity: the Triton SDD/DSD matmuls of
+``matmul.py:63`` are differentiable through the sparse path): a dq kernel
+sweeps the same block table as the forward, and a dk/dv kernel sweeps the
+TRANSPOSED table (for each k-block, the q-blocks that attend to it), both
+recomputing per-tile probabilities from the forward's saved logsumexp — so
+backward compute and HBM traffic also scale with active blocks, not S².
 """
 
 import functools
@@ -56,8 +60,12 @@ def build_block_table(layout: np.ndarray):
     return table, counts
 
 
-def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc, m_s, l_s, *, scale, num_active, nheads_layout):
+def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+                   scale, num_active, nheads_layout, with_lse=False):
+    if with_lse:
+        lse_ref, acc, m_s, l_s = rest
+    else:
+        acc, m_s, l_s = rest
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ai = pl.program_id(2)
@@ -96,9 +104,15 @@ def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref,
         l = l_s[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible block → 0
         o_ref[0] = (acc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        if with_lse:
+            # +BIG for empty rows so backward's exp(s - lse) underflows to
+            # exactly 0 (their grads must be 0, not NaN)
+            lse_ref[0] = jnp.where(l == 0.0, -NEG_INF,
+                                   m_s[:, 0] + jnp.log(safe_l))
 
 
-def _splash_fwd(q, k, v, table, counts, block, scale, interpret):
+def _splash_fwd(q, k, v, table, counts, block, scale, interpret,
+                with_lse=False):
     if not _HAS_PLTPU:
         raise RuntimeError("splash attention needs jax.experimental.pallas.tpu; "
                            "use sparse_attention(..., use_kernel=False)")
@@ -110,20 +124,23 @@ def _splash_fwd(q, k, v, table, counts, block, scale, interpret):
     vf = v.reshape(B * H, S, D)
 
     kernel = functools.partial(_splash_kernel, scale=scale, num_active=A,
-                               nheads_layout=table.shape[0])
+                               nheads_layout=table.shape[0],
+                               with_lse=with_lse)
+    q_spec = pl.BlockSpec((1, block, D), lambda b, qi, ai, tbl, cnt: (b, qi, 0))
+    kv_spec = pl.BlockSpec((1, block, D),
+                           lambda b, qi, ai, tbl, cnt:
+                           (b, tbl[jax.lax.rem(b, tbl.shape[0]), qi, ai], 0))
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct((B * H, S, D), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, block),
+                                      lambda b, qi, ai, tbl, cnt: (b, qi)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, S), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb, A),
-        in_specs=[
-            pl.BlockSpec((1, block, D), lambda b, qi, ai, tbl, cnt: (b, qi, 0)),
-            pl.BlockSpec((1, block, D),
-                         lambda b, qi, ai, tbl, cnt:
-                         (b, tbl[jax.lax.rem(b, tbl.shape[0]), qi, ai], 0)),
-            pl.BlockSpec((1, block, D),
-                         lambda b, qi, ai, tbl, cnt:
-                         (b, tbl[jax.lax.rem(b, tbl.shape[0]), qi, ai], 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block, D), lambda b, qi, ai, tbl, cnt: (b, qi, 0)),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=out_specs if with_lse else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((block, D), jnp.float32),
             pltpu.VMEM((block, 1), jnp.float32),
@@ -133,10 +150,164 @@ def _splash_fwd(q, k, v, table, counts, block, scale, interpret):
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=out_shape if with_lse else out_shape[0],
         interpret=interpret,
     )(jnp.asarray(table), jnp.asarray(counts), qf, kf, vf)
+    if with_lse:
+        o, lse = out
+        return o.reshape(B, H, S, D), lse
     return out.reshape(B, H, S, D)
+
+
+def _splash_dq_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, do_ref,
+                      lse_ref, delta_ref, dq_ref, acc, *,
+                      scale, num_active, nheads_layout):
+    """dQ sweep — same block table as forward: for each q-block, iterate its
+    active k-blocks; P is recomputed per tile from the saved logsumexp
+    (standard flash backward; reference matmul.py SDD backward)."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    ai = pl.program_id(2)
+    h = jax.lax.rem(bh, nheads_layout)
+
+    @pl.when(ai == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    @pl.when(ai < count_ref[h, qi])
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                      (((1, ), (0, )), ((), ())),
+                                      preferred_element_type=jnp.float32)
+
+    @pl.when(ai == num_active - 1)
+    def _finalize():
+        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+
+def _splash_dkv_kernel(tableT_ref, countT_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       scale, num_active, nheads_layout):
+    """dK/dV sweep — TRANSPOSED block table: for each k-block, iterate the
+    q-blocks that attend to it (reference matmul.py DSD backward's
+    transposed layout)."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    ai = pl.program_id(2)
+    h = jax.lax.rem(bh, nheads_layout)
+
+    @pl.when(ai == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(ai < countT_ref[h, ki])
+    def _compute():
+        q = q_ref[0]   # [block_q, D] — the ai-th active q-block for this k
+        k = k_ref[0]   # [block_k, D]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0][:, None])          # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale  # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ai == num_active - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _splash_bwd(q, k, v, o, lse, g, table, counts, tableT, countsT,
+                block, scale, interpret):
+    """Sparse backward: dq over the forward table, dk/dv over the transposed
+    table. delta = rowsum(dO ∘ O) (the flash-backward correction term) is a
+    cheap elementwise pass left to XLA."""
+    B, H, S, D = q.shape
+    BH = B * H
+    nb = S // block
+    qf, kf, vf = (t.reshape(BH, S, D) for t in (q, k, v))
+    dof = g.reshape(BH, S, D)
+    delta = (dof.astype(jnp.float32)
+             * o.reshape(BH, S, D).astype(jnp.float32)).sum(-1)  # [BH, S]
+
+    nheads_layout = table.shape[0]
+    q_at = lambda b, i, ai, tbl, cnt: (b, i, 0)
+    row_at = lambda b, i, ai, tbl, cnt: (b, i)
+    tbl_at = lambda b, i, ai, tbl, cnt: (
+        b, tbl[jax.lax.rem(b, tbl.shape[0]), i, ai], 0)
+    tbl_row_at = lambda b, i, ai, tbl, cnt: (
+        b, tbl[jax.lax.rem(b, tbl.shape[0]), i, ai])
+
+    # ---- dq: grid (BH, q_block, active-k) ----
+    A = table.shape[-1]
+    dq = pl.pallas_call(
+        functools.partial(_splash_dq_kernel, scale=scale, num_active=A,
+                          nheads_layout=nheads_layout),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nb, A),
+            in_specs=[
+                pl.BlockSpec((1, block, D), q_at),      # q
+                pl.BlockSpec((1, block, D), tbl_at),    # k
+                pl.BlockSpec((1, block, D), tbl_at),    # v
+                pl.BlockSpec((1, block, D), q_at),      # do
+                pl.BlockSpec((1, block), row_at),       # lse
+                pl.BlockSpec((1, block), row_at),       # delta
+            ],
+            out_specs=pl.BlockSpec((1, block, D), q_at),
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(table), jnp.asarray(counts), qf, kf, vf, dof, lse, delta)
+
+    # ---- dk/dv: grid (BH, k_block, active-q), transposed table ----
+    At = tableT.shape[-1]
+    dk, dv = pl.pallas_call(
+        functools.partial(_splash_dkv_kernel, scale=scale, num_active=At,
+                          nheads_layout=nheads_layout),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nb, At),
+            in_specs=[
+                pl.BlockSpec((1, block, D), tbl_at),    # q (active q-block)
+                pl.BlockSpec((1, block, D), q_at),      # k (this k-block)
+                pl.BlockSpec((1, block, D), q_at),      # v
+                pl.BlockSpec((1, block, D), tbl_at),    # do
+                pl.BlockSpec((1, block), tbl_row_at),   # lse (per q row)
+                pl.BlockSpec((1, block), tbl_row_at),   # delta
+            ],
+            out_specs=[pl.BlockSpec((1, block, D), q_at),
+                       pl.BlockSpec((1, block, D), q_at)],
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32),
+                            pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+        interpret=interpret,
+    )(jnp.asarray(tableT), jnp.asarray(countsT), qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D))
 
 
 @functools.lru_cache(maxsize=64)
@@ -147,21 +318,22 @@ def _cached_splash_fn(layout_bytes: bytes, layout_shape, block: int,
     rebuild them every call."""
     layout = np.frombuffer(layout_bytes, dtype=np.bool_).reshape(layout_shape)
     table, counts = build_block_table(layout)
+    # transposed layout: which q-blocks touch each k-block (dk/dv sweep)
+    tableT, countsT = build_block_table(layout.transpose(0, 2, 1))
 
     @jax.custom_vjp
     def _f(q, k, v):
         return _splash_fwd(q, k, v, table, counts, block, scale, interpret)
 
     def _f_fwd(q, k, v):
-        return _f(q, k, v), (q, k, v)
+        o, lse = _splash_fwd(q, k, v, table, counts, block, scale, interpret,
+                             with_lse=True)
+        return o, (q, k, v, o, lse)
 
     def _f_bwd(res, g):
-        from .sparse_self_attention import sparse_attention as _dense
-        q, k, v = res
-        _, vjp = jax.vjp(lambda q, k, v: _dense(q, k, v, layout, block, scale=scale,
-                                                use_kernel=False),
-                         q, k, v)
-        return vjp(g)
+        q, k, v, o, lse = res
+        return _splash_bwd(q, k, v, o, lse, g, table, counts, tableT, countsT,
+                           block, scale, interpret)
 
     _f.defvjp(_f_fwd, _f_bwd)
     return _f
@@ -170,8 +342,9 @@ def _cached_splash_fn(layout_bytes: bytes, layout_shape, block: int,
 def splash_sparse_attention(q, k, v, layout: np.ndarray, block: int,
                             scale: Optional[float] = None,
                             interpret: bool = False):
-    """Block-sparse attention via the splash kernel; differentiable (backward
-    uses the dense masked path's VJP).
+    """Block-sparse attention via the splash kernel; differentiable through
+    sparse Pallas backward kernels (dq over the forward block table, dk/dv
+    over the transposed table).
 
     q,k,v: [batch, heads, seq, head_dim]; layout: [heads or 1, nb, nb]
     static (a 1-head layout broadcasts over heads, dense-path parity).

@@ -2,10 +2,10 @@
 
 Reference: ``runtime/compiler.py`` + ``engine.py:3665 compile()`` — opt-in
 graph compilation of the wrapped module. Under this framework everything is
-ALREADY traced and XLA-compiled (the engine jits fwd_bwd/apply as whole
-programs), so ``compile()`` is a no-op that records the request and exposes
-the same introspection flags; ``is_compiled`` reports the truth: always,
-once the first step has built its programs."""
+ALREADY traced and XLA-compiled at first dispatch (the engine jits
+fwd_bwd/apply as whole programs), so ``compile()`` only records the request —
+but ``is_compiled`` keeps the reference's contract: False until ``compile()``
+has been called, True afterwards."""
 
 from typing import Any, Callable, Optional
 
@@ -28,7 +28,11 @@ class CompiledModuleWrapper:
 
     def __init__(self, module, compile_config=None):
         self.module = module
-        self._is_compiled = True  # XLA: compiled by construction
+        self._is_compiled = False
+
+    def compile(self, *a, **kw):
+        self._is_compiled = True
+        return self.module
 
     @property
     def is_compiled(self) -> bool:
@@ -36,13 +40,14 @@ class CompiledModuleWrapper:
 
 
 def attach_compile_api(engine) -> None:
-    """Give an engine the reference's compile()/is_compiled surface."""
+    """Give an engine the reference's compile()/is_compiled surface
+    (reference engine.py:3665: is_compiled is False until compile() runs)."""
+    engine.is_compiled = False
 
     def compile(backend: Optional[str] = None, compile_kwargs: Optional[dict] = None,
                 schedule: Any = None) -> None:
         logger.info("compile(): engine programs are XLA-compiled by construction; "
                     f"request recorded (backend={backend})")
-        engine._compiled = True
+        engine.is_compiled = True
 
     engine.compile = compile
-    engine.is_compiled = True

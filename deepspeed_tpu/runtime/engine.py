@@ -326,6 +326,10 @@ class DeepSpeedTpuEngine:
         # ---- compiled steps ----
         self._build_compiled_fns()
 
+        # ---- compile() / is_compiled surface (reference engine.py:3665) ----
+        from .compiler import attach_compile_api
+        attach_compile_api(self)
+
         # ---- timers / monitor ----
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
@@ -537,22 +541,40 @@ class DeepSpeedTpuEngine:
                 scaled = scaled * scale
             return scaled, loss
 
+        # param_cast="model": pass fp32 masters straight into apply and let
+        # the model's use-site casts (flax `dtype=` convention) down-convert
+        # each weight where it is consumed. Under nn.scan this is the
+        # structural fix for the whole-model-sized convert_element_type
+        # temps an engine-side tree cast creates: the stacked [L, ...] leaf
+        # is sliced per scan step and only that chunk is cast. Gradients
+        # come back fp32 (cotangent of the fp32 primal) — model-sized, same
+        # total as engine-cast's bf16 copy + bf16 grads, without the
+        # un-schedulable full-tree cast. qwZ keeps engine casts: its int8
+        # wire gather must be followed by an explicit up/down-cast.
+        cast_in_model = (self._config.param_cast == "model"
+                         and qwz_gather is None)
+
         def loss_of(params, args, kwargs, static_kv, scale):
             if qwz_gather is not None:
                 params = qwz_gather(params)
-            cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
-            return loss_from_cparams(cparams, args, kwargs, static_kv, scale)
+            if not cast_in_model:
+                params = jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype), params)
+            return loss_from_cparams(params, args, kwargs, static_kv, scale)
 
         def value_and_grads(params, args, kwargs, static_kv, scale):
-            """((scaled, loss), grads) for one microbatch. When possible,
-            differentiate wrt the COMPUTE-dtype cast of the params, not the
-            fp32 masters: bit-identical values (the cast's VJP is an exact
-            bf16->fp32 up-cast, so the fp32 cotangent holds the same
-            bf16-representable numbers), but the grad tree is STORED at
-            compute dtype — half the gradient HBM at the global-norm
-            barrier, where every grad is live at once, and the consumers'
-            up-casts fuse into each leaf's optimizer update / accumulate."""
-            if compute_dtype != jnp.float32 and qwz_gather is None:
+            """((scaled, loss), grads) for one microbatch. With engine-side
+            casting, differentiate wrt the COMPUTE-dtype cast of the params,
+            not the fp32 masters, when possible: bit-identical values (the
+            cast's VJP is an exact bf16->fp32 up-cast, so the fp32 cotangent
+            holds the same bf16-representable numbers), but the grad tree is
+            STORED at compute dtype — half the gradient HBM at the
+            global-norm barrier, where every grad is live at once, and the
+            consumers' up-casts fuse into each leaf's optimizer update /
+            accumulate. With param_cast="model" the masters go in as-is and
+            grads are fp32."""
+            if (compute_dtype != jnp.float32 and qwz_gather is None
+                    and not cast_in_model):
                 cparams = jax.tree_util.tree_map(
                     lambda x: x.astype(compute_dtype), params)
                 return jax.value_and_grad(loss_from_cparams, has_aux=True)(
@@ -576,8 +598,10 @@ class DeepSpeedTpuEngine:
         )
 
         def fwd_only(params, args, kwargs, static_kv):
-            cparams = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
-            return apply_fn(cparams, *args, **dict(kwargs, **dict(static_kv)))
+            if not cast_in_model:
+                params = jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype), params)
+            return apply_fn(params, *args, **dict(kwargs, **dict(static_kv)))
 
         self._fwd_only = jax.jit(fwd_only, static_argnums=(3, ))
 

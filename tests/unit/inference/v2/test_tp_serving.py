@@ -78,9 +78,7 @@ def test_tp_serving_decode_continues_sharded(tmp_path):
 def test_tp_paged_kernel_matches_dense():
     """The paged Pallas kernel runs per LOCAL head block inside a
     partial-manual shard_map under TP (heads are independent) — logits must
-    match the dense single-chip reference. ALiBi configs fall back to dense
-    (the kernel derives slopes from local head indices)."""
-    from deepspeed_tpu.inference.v2.model import RaggedLlamaModel
+    match the dense single-chip reference."""
     cfg = LlamaConfig.tiny(num_key_value_heads=4)
     _, params = init_llama(cfg, seed=5)
 
@@ -98,13 +96,63 @@ def test_tp_paged_kernel_matches_dense():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
 
-    # ALiBi: ineligible — model must downgrade itself to dense, not crash
+
+@pytest.mark.world_size(8)
+def test_tp_paged_alibi_stays_on_kernel():
+    """BLOOM-style ALiBi keeps the paged kernel under TP: slopes are a
+    global-head table sharded over the model axis with the heads, so each
+    shard biases with its true head identity (reference
+    ``inference/v2/model_implementations/sharding/attn.py``)."""
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, pos_embedding="alibi")
+    _, params = init_llama(cfg, seed=5)
+
     reset_mesh_context()
-    cfg_a = LlamaConfig.tiny(num_key_value_heads=4, pos_embedding="alibi")
-    _, params_a = init_llama(cfg_a, seed=5)
-    m2 = RaggedLlamaModel(cfg_a, params_a, dtype=jnp.float32,
-                          attn_backend="paged", tp_size=2)
-    assert m2.attn_backend == "dense"
+    ref_engine = build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                                    attn_backend="dense")
+    ref = _logits(ref_engine, [0, 1], PROMPTS[:2])
+
+    reset_mesh_context()
+    ec = RaggedInferenceEngineConfig(tensor_parallel={"tp_size": 2})
+    engine = build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                                engine_config=ec, attn_backend="paged")
+    model = engine.model()
+    assert model.attn_backend == "paged"  # no dense downgrade anymore
+    got = _logits(engine, [0, 1], PROMPTS[:2])
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+
+
+@pytest.mark.world_size(8)
+def test_tp_paged_gqa_nondivisible_pads():
+    """6 KV heads at tp=4: the paged path pads KV to 8 (2 per shard) and
+    keeps the kernel — no dense fallback, cache still head-sharded
+    (reference sharding/attn.py handles uneven head splits natively)."""
+    cfg = LlamaConfig.tiny(hidden_size=96, num_attention_heads=12,
+                           num_key_value_heads=6)
+    _, params = init_llama(cfg, seed=7)
+
+    reset_mesh_context()
+    ref_engine = build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                                    attn_backend="dense")
+    ref = _logits(ref_engine, [0, 1, 2], PROMPTS)
+
+    reset_mesh_context()
+    ec = RaggedInferenceEngineConfig(tensor_parallel={"tp_size": 4})
+    engine = build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                                engine_config=ec, attn_backend="paged")
+    model = engine.model()
+    assert model.attn_backend == "paged"
+    assert model._kv_pad == 2
+    kv = engine._state_manager.kv_cache
+    assert kv.cache.shape[2] == 8  # padded head dim
+    assert tuple(kv.cache.sharding.spec)[:3] == (None, None, "model")
+    got = _logits(engine, [0, 1, 2], PROMPTS)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+
+    # multi-step decode keeps working over the padded, sharded cache
+    out = engine.generate(PROMPTS[:2], max_new_tokens=3)
+    assert len(out) == 2 and all(len(o) == 3 for o in out)
 
 
 def test_tp_rejects_quantize_combo():

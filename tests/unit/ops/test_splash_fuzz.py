@@ -61,3 +61,60 @@ def test_splash_matches_masked_dense(case):
     ref = sparse_attention(q, k, v, layout, cfg.block, use_kernel=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+# one case per family is enough for the (slower) grad sweep; the layout
+# index math the bwd kernels add — the transposed table — is per-family
+GRAD_CASES = [c for i, c in enumerate(CASES) if i % 3 == 0]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES, ids=lambda c: (
+    f"{c['fam']}h{c['heads']}b{c['block']}n{c['blocks']}s{c['seed']}"))
+def test_splash_backward_matches_dense_vjp(case):
+    """The sparse Pallas backward (dq via forward table, dk/dv via the
+    transposed table) must match the dense masked path's VJP — the
+    differentiable-sparse-path parity bar of reference matmul.py:63."""
+    import jax
+    cfg = _config(case)
+    S = case["block"] * case["blocks"]
+    rng = np.random.default_rng(case["seed"] + 1)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, case["heads"], S, 16)),
+                           jnp.float32) for _ in range(3))
+    g = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+    layout = cfg.make_layout(S)
+
+    _, vjp_sparse = jax.vjp(
+        lambda q, k, v: splash_sparse_attention(q, k, v, layout, cfg.block,
+                                                interpret=True), q, k, v)
+    _, vjp_dense = jax.vjp(
+        lambda q, k, v: sparse_attention(q, k, v, layout, cfg.block,
+                                         use_kernel=False), q, k, v)
+    got = vjp_sparse(g)
+    ref = vjp_dense(g)
+    for a, b, name in zip(got, ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_splash_backward_empty_rows_zero_grads():
+    """A layout with an all-zero q row must yield ZERO grads there (not
+    NaN): the lse saved for empty rows is +BIG so exp underflows."""
+    import jax
+    block, nb, H = 64, 4, 2
+    S = block * nb
+    layout = np.zeros((H, nb, nb), np.bool_)
+    layout[:, 1:, :2] = True  # q-block 0 sees nothing; k-blocks 2,3 unused
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, H, S, 16)), jnp.float32)
+               for _ in range(3))
+    g = jnp.ones_like(q)
+    _, vjp = jax.vjp(
+        lambda q, k, v: splash_sparse_attention(q, k, v, layout, block,
+                                                interpret=True), q, k, v)
+    dq, dk, dv = vjp(g)
+    assert np.isfinite(np.asarray(dq)).all()
+    assert np.isfinite(np.asarray(dk)).all()
+    assert np.isfinite(np.asarray(dv)).all()
+    np.testing.assert_array_equal(np.asarray(dq[:, :, :block]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dk[:, :, 2 * block:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dv[:, :, 2 * block:]), 0.0)
