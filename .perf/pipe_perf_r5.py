@@ -1,0 +1,175 @@
+"""Round-5 pipeline-parallel performance evidence (VERDICT r4 #5).
+
+Measures, on the 8-device virtual CPU mesh:
+
+1. 1F1B bubble scaling: wall time of ``PipelineEngine.train_batch`` vs
+   microbatch count M at fixed micro size. The SPMD 1F1B executor runs
+   ``2*(M+S-1)`` lockstep ticks (spmd.py:232) — bubble ticks execute masked
+   compute, so wall ~ a + b*(M+S-1) and the analytic bubble fraction
+   ``(S-1)/(M+S-1)`` is directly observable from the fitted slope/intercept.
+2. 2-stage PP x 4-way DP vs pure 8-way DP(+ZeRO-1) at equal devices, equal
+   global batch, same model — the PP-vs-more-FSDP question.
+
+Run:
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PYTHONPATH=/root/repo python .perf/pipe_perf_r5.py
+
+Caveat (stated in PERF_NOTES too): the virtual mesh timeshares ONE host
+core, so wall time measures total executed compute volume + dispatch, not
+ICI latency. That is exactly the quantity the 1F1B bubble inflates (idle
+stages still burn a tick of masked compute in the lockstep executor), so
+bubble measurements are structurally valid here; collective-latency overlap
+is not measurable without real chips.
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm import MeshContext, set_mesh_context, reset_mesh_context
+from deepspeed_tpu.runtime.pipe import PipelineEngine
+
+D, FF, L, V, SEQ, MB = 128, 512, 8, 512, 64, 4  # micro batch MB fixed
+
+
+def toy(rng):
+    params = {
+        "embed": {"w": jnp.asarray(rng.normal(size=(V, D)) * 0.02, jnp.float32)},
+        "body": {"w1": jnp.asarray(rng.normal(size=(L, D, FF)) / np.sqrt(D), jnp.float32),
+                 "w2": jnp.asarray(rng.normal(size=(L, FF, D)) / np.sqrt(FF), jnp.float32)},
+        "head": {"w": jnp.asarray(rng.normal(size=(D, V)) / np.sqrt(D), jnp.float32)},
+    }
+
+    def embed(p, ids):
+        return p["w"][ids]
+
+    def layer(lp, h):
+        return h + jnp.tanh(h @ lp["w1"]) @ lp["w2"]
+
+    def head(p, h, labels):
+        logits = h @ p["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+    return params, embed, layer, head
+
+
+def time_engine(eng, ids, M, iters=6):
+    data = iter([(ids, ids)] * (M * (iters + 3)))
+    eng.train_batch(data)  # compile + warmup
+    eng.train_batch(data)
+    t0 = time.time()
+    for _ in range(iters):
+        eng.train_batch(data)
+    return (time.time() - t0) / iters
+
+
+def pp_engine(M, stages=2):
+    reset_mesh_context()
+    set_mesh_context(MeshContext.create(axis_sizes={"pipe": stages, "data": 8 // stages}))
+    rng = np.random.default_rng(0)
+    params, embed, layer, head = toy(rng)
+    B = MB * M * (8 // stages)  # global batch = micro * M * dp
+    eng = PipelineEngine(embed, layer, head, params,
+                         config={"train_batch_size": B,
+                                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                                 "zero_optimization": {"stage": 1},
+                                 "steps_per_print": 0},
+                         num_microbatches=M)
+    ids = jnp.asarray(rng.integers(0, V, size=(B, SEQ)), jnp.int32)
+    return eng, ids, B
+
+
+def dp_engine(global_batch, gas):
+    """Pure 8-way DP, ZeRO-1, same model expressed as a flat apply fn."""
+    import deepspeed_tpu
+    reset_mesh_context()
+    set_mesh_context(MeshContext.create(axis_sizes={"data": 8}))
+    rng = np.random.default_rng(0)
+    params, embed, layer, head = toy(rng)
+
+    def apply_fn(p, ids, labels):
+        h = embed(p["embed"], ids)
+        def body(c, lp):
+            return layer(lp, c), None
+        h, _ = jax.lax.scan(lambda c, lp: (layer(lp, c), None), h, p["body"])
+        return head(p["head"], h, labels)
+
+    eng, *_ = deepspeed_tpu.initialize(
+        model=apply_fn, model_parameters=params,
+        config={"train_batch_size": global_batch,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 0})
+    micro = global_batch // gas
+    ids = jnp.asarray(rng.integers(0, V, size=(micro, SEQ)), jnp.int32)
+    return eng, ids
+
+
+def time_dp(eng, ids, gas, iters=6):
+    def one_step():
+        if gas == 1:
+            eng.fused_train_step(ids, ids)
+        else:
+            it = iter([(ids, ids)] * gas)
+            eng.train_batch(it)
+    one_step(); one_step()
+    t0 = time.time()
+    for _ in range(iters):
+        one_step()
+    return (time.time() - t0) / iters
+
+
+def main():
+    out = {"config": dict(d=D, ff=FF, layers=L, vocab=V, seq=SEQ, micro_bs=MB)}
+
+    # ---- 1. bubble scaling at S=2: wall vs M ----
+    S = 2
+    scaling = {}
+    for M in (2, 4, 8, 16):
+        eng, ids, B = pp_engine(M, stages=S)
+        t = time_engine(eng, ids, M)
+        scaling[M] = {"sec_per_step": round(t, 4),
+                      "tokens_per_sec": round(B * SEQ / t, 1),
+                      "ticks": 2 * (M + S - 1),
+                      "analytic_bubble": round((S - 1) / (M + S - 1), 4)}
+    # fit t = a + b*(M+S-1): bubble_measured for M = b*(S-1)/t(M)
+    Ms = sorted(scaling)
+    xs = np.array([m + S - 1 for m in Ms], float)
+    ys = np.array([scaling[m]["sec_per_step"] for m in Ms])
+    b, a = np.polyfit(xs, ys, 1)
+    for m in Ms:
+        scaling[m]["measured_bubble"] = round(
+            float(b * (S - 1) / scaling[m]["sec_per_step"]), 4)
+    out["bubble_scaling_S2"] = scaling
+    out["tick_fit"] = {"sec_per_tickpair": round(float(b), 4),
+                       "fixed_overhead_sec": round(float(a), 4)}
+
+    # ---- 2. PP(2x4) vs pure DP(8), equal global batch ----
+    M = 8
+    eng, ids, B = pp_engine(M, stages=2)
+    t_pp = time_engine(eng, ids, M)
+    # same global batch; DP needs gas= B / (micro*8) to match per-device micro
+    gas = max(1, B // (MB * 8))
+    dpe, dpids = dp_engine(B, gas)
+    t_dp = time_dp(dpe, dpids, gas)
+    out["pp2x4_vs_dp8"] = {
+        "global_batch": B,
+        "pp_sec_per_step": round(t_pp, 4),
+        "dp_sec_per_step": round(t_dp, 4),
+        "pp_tokens_per_sec": round(B * SEQ / t_pp, 1),
+        "dp_tokens_per_sec": round(B * SEQ / t_dp, 1),
+        "dp_over_pp": round(t_pp / t_dp, 3),
+    }
+    print(json.dumps(out, indent=1))
+    with open(".perf/pipe_perf_r5.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
