@@ -94,8 +94,6 @@ def dp_engine(global_batch, gas):
 
     def apply_fn(p, ids, labels):
         h = embed(p["embed"], ids)
-        def body(c, lp):
-            return layer(lp, c), None
         h, _ = jax.lax.scan(lambda c, lp: (layer(lp, c), None), h, p["body"])
         return head(p["head"], h, labels)
 

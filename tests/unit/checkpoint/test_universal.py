@@ -411,3 +411,145 @@ class TestUniversalFromTPSave:
         e2.load_universal_checkpoint(str(tmp_path / "uni"))
         got = train_ids(e2, cfg, 2, seed=7)
         np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+class TestUniversalFromComposedSaves:
+    """VERDICT r4 #9: universal checkpoint from COMPOSED parallel saves
+    (reference ``checkpoint/ds_to_universal.py:469`` merges pp/tp/ep shard
+    sets). Two composed topologies cover the reachable space:
+
+    * TP x EP x DP (MoE llama, model+expert+data mesh) -> flat DP resume.
+    * PP x TP x DP (1F1B PipelineEngine, pipe+model+data mesh) -> pipe-less
+      resume.
+
+    A single pipe x model x expert save is not constructible here: the
+    SPMD pipeline hosts homogeneous dense bodies (spmd.py), and MoE blocks
+    live in the flat-engine path — documented design boundary, the same
+    split the dryrun matrix (MULTICHIP) validates."""
+
+    @pytest.mark.world_size(8)
+    def test_tp_ep_save_converts_and_resumes_flat(self, tmp_path):
+        import dataclasses
+        from deepspeed_tpu.models import LlamaConfig
+
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(num_hidden_layers=1, num_key_value_heads=4,
+                             attn_impl="xla"),
+            num_local_experts=4, num_experts_per_tok=2, dtype=jnp.float32)
+
+        def mk(mesh, tp):
+            """Like make_llama_engine, plus logical-axis metadata so the
+            expert dim shards over the expert mesh axis (LOGICAL_RULES maps
+            'expert' -> expert; the AutoTP name regexes know nothing about
+            MoE w1/w2/w3)."""
+            from deepspeed_tpu.models import init_llama
+            from deepspeed_tpu.models.llama import (LlamaForCausalLM,
+                                                    logical_axis_tree)
+            reset_mesh_context()
+            model, params = init_llama(cfg, seed=9)
+            logical = None
+            if tp:
+                variables = LlamaForCausalLM(cfg).init(
+                    jax.random.PRNGKey(9), jnp.ones((1, 8), jnp.int32))
+                logical = logical_axis_tree(variables["params"])
+            c = {"train_batch_size": 8,
+                 "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                 "zero_optimization": {"stage": 2},
+                 "mesh": mesh, "steps_per_print": 1000}
+            if tp:
+                c["tensor_parallel"] = {"enabled": True}
+            eng, *_ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params, config=c,
+                logical_axes=logical)
+            return eng
+
+        e1 = mk({"model": 2, "expert": 2, "data": 2}, tp=True)
+        # the save really is composed: attention TP-sharded on the model
+        # axis AND expert weights sharded on the expert axis
+        q = e1.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+        assert "model" in tuple(q.sharding.spec), q.sharding.spec
+        w1 = e1.params["model"]["layers_0"]["block_sparse_moe"]["w1"]
+        assert "expert" in tuple(w1.sharding.spec), w1.sharding.spec
+
+        train_llama_ids(e1, cfg, 3, seed=30)
+        e1.save_checkpoint(tmp_path / "ckpt", tag="tpep")
+        ds_to_universal(str(tmp_path / "ckpt" / "tpep"), str(tmp_path / "uni"))
+        ref = train_llama_ids(e1, cfg, 2, seed=31)
+
+        e2 = mk({"data": 8}, tp=False)
+        e2.load_universal_checkpoint(str(tmp_path / "uni"))
+        got = train_llama_ids(e2, cfg, 2, seed=31)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+    @pytest.mark.world_size(8)
+    def test_pp_tp_save_converts_and_resumes_pipeless(self, tmp_path):
+        from deepspeed_tpu.comm import MeshContext, set_mesh_context
+        from deepspeed_tpu.runtime.pipe import PipelineEngine
+
+        d, L, B, V = 16, 4, 8, 32
+
+        def toy(rng):
+            params = {
+                "embed": {"w": jnp.asarray(rng.normal(size=(V, d)), jnp.float32)},
+                "body": {"up_proj": {"kernel": jnp.asarray(
+                             rng.normal(size=(L, d, 4 * d)) / np.sqrt(d),
+                             jnp.float32)},
+                         "down_proj": {"kernel": jnp.asarray(
+                             rng.normal(size=(L, 4 * d, d)) / np.sqrt(4 * d),
+                             jnp.float32)}},
+                "head": {"w": jnp.asarray(rng.normal(size=(d, V)) / np.sqrt(d),
+                                          jnp.float32)},
+            }
+
+            def embed(p, tok):
+                return p["w"][tok]
+
+            def layer(lp, h):
+                return h + jnp.tanh(h @ lp["up_proj"]["kernel"]) \
+                    @ lp["down_proj"]["kernel"]
+
+            def head(p, h, labels):
+                logp = jax.nn.log_softmax(h @ p["w"])
+                return -jnp.take_along_axis(logp, labels[..., None],
+                                            axis=-1).mean()
+
+            return params, embed, layer, head
+
+        def mk(axis_sizes, tp):
+            reset_mesh_context()
+            set_mesh_context(MeshContext.create(axis_sizes=axis_sizes))
+            rng = np.random.default_rng(5)
+            params, embed, layer, head = toy(rng)
+            conf = {"train_batch_size": B,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                    "zero_optimization": {
+                        "stage": 2, "stage3_param_persistence_threshold": 0},
+                    "steps_per_print": 1000}
+            if tp:
+                conf["tensor_parallel"] = {"enabled": True}
+            return PipelineEngine(embed, layer, head, params, config=conf,
+                                  num_microbatches=4)
+
+        def step(eng, n, seed):
+            rng = np.random.default_rng(seed)
+            out = []
+            for _ in range(n):
+                ids = jnp.asarray(rng.integers(0, V, size=(B, 8)), jnp.int32)
+                out.append(float(eng.train_batch(iter([(ids, ids)] * 4))))
+            return out
+
+        e1 = mk({"pipe": 2, "model": 2, "data": 2}, tp=True)
+        up = e1.engine.params["body"]["up_proj"]["kernel"]
+        spec = tuple(up.sharding.spec)
+        assert spec[0] == "pipe" and "model" in spec, spec  # composed save
+        step(e1, 2, seed=40)
+        e1.save_checkpoint(tmp_path / "ppck", tag="pp")
+        ds_to_universal(str(tmp_path / "ppck" / "pp"), str(tmp_path / "uni"))
+        ref = step(e1, 2, seed=41)
+
+        # pipe-less resume: same embed/body/head structure, 1-stage pipeline
+        # over a pure-DP mesh (S=1 degenerates the 1F1B scan to fwd+bwd)
+        e2 = mk({"pipe": 1, "data": 8}, tp=False)
+        e2.load_universal_checkpoint(str(tmp_path / "uni"))
+        got = step(e2, 2, seed=41)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
