@@ -51,7 +51,10 @@ class AsyncPartitionedParameterSwapper:
         (reference: param.ds_tensor freed after write completes)."""
         host = np.ascontiguousarray(np.asarray(array))
         path = self._path(name)
-        self._meta[name] = {"shape": host.shape, "dtype": host.dtype.str, "path": path}
+        # the dtype OBJECT, not .str: extension dtypes (ml_dtypes bfloat16 —
+        # the ZeRO-Inference compute copies) stringify to raw-void '|V2',
+        # which round-trips to an un-JAX-able buffer
+        self._meta[name] = {"shape": host.shape, "dtype": host.dtype, "path": path}
         self._pending_writes[name] = self.aio.submit_write(path, host)
 
     def synchronize_writes(self) -> None:
@@ -70,7 +73,7 @@ class AsyncPartitionedParameterSwapper:
             if name in self._pending_writes:  # write-then-read hazard
                 self.aio.wait(self._pending_writes.pop(name))
             meta = self._meta[name]
-            buf = np.empty(meta["shape"], dtype=np.dtype(meta["dtype"]))
+            buf = np.empty(meta["shape"], dtype=meta["dtype"])
             self._pending_reads[name] = (self.aio.submit_read(meta["path"], buf), buf)
         if not async_op:
             for name in names:

@@ -1,0 +1,80 @@
+"""ZeroInferenceEngine: forward-only weight streaming (ZeRO-Inference,
+reference blogs/deepspeed-gds:74 — decode with weights living on NVMe).
+
+Parity bar: the streamed stack must produce the same activations as the
+same layers applied with fully-resident params, from both host-DRAM and
+NVMe stores, with device residency bounded by the prefetch window."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.models.llama import LlamaDecoderLayer, precompute_rope
+from deepspeed_tpu.runtime.zero_infinity import ZeroInferenceEngine
+
+
+@pytest.fixture(scope="module")
+def llama_stack():
+    cfg = LlamaConfig.tiny(attn_impl="xla", dtype=jnp.float32)
+    _, params = init_llama(cfg)
+    mp = params["model"]
+    cos, sin = precompute_rope(cfg.head_dim_, cfg.max_position_embeddings,
+                               cfg.rope_theta)
+    layer_params = [mp[f"layers_{i}"] for i in range(cfg.num_hidden_layers)]
+
+    def make_layer(i):
+        mod = LlamaDecoderLayer(cfg, i)
+
+        def fn(p, pack):
+            x, positions = pack
+            return (mod.apply({"params": p}, x, cos, sin, positions), positions)
+        return fn
+
+    layers = [make_layer(i) for i in range(cfg.num_hidden_layers)]
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 8, cfg.hidden_size)), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[None], (2, 8))
+    ref = (x, positions)
+    for fn, p in zip(layers, layer_params):
+        ref = fn(p, ref)
+    return layers, layer_params, (x, positions), ref[0]
+
+
+@pytest.mark.parametrize("device", ["cpu", "nvme"])
+def test_streamed_apply_matches_resident(llama_stack, tmp_path, device):
+    layers, layer_params, inp, ref = llama_stack
+    eng = ZeroInferenceEngine(layers, layer_params, device=device,
+                              nvme_path=str(tmp_path / "zi"),
+                              dtype=jnp.float32, prefetch=1)
+    out, _ = eng.streamed_apply(inp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # every layer streamed exactly once
+    assert eng.bytes_streamed == eng.total_param_bytes
+    # device residency bounded by the (1 + prefetch) window, not the model
+    assert eng.peak_param_bytes <= 2 * (eng.total_param_bytes // len(layers)) \
+        + eng.total_param_bytes // len(layers) // 2
+    # a second pass streams again (weights are NOT cached on device)
+    out2, _ = eng.streamed_apply(inp)
+    assert eng.bytes_streamed == 2 * eng.total_param_bytes
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_compute_copies_roundtrip_nvme(llama_stack, tmp_path):
+    """bf16 compute copies survive the NVMe write/read cycle (extension
+    dtypes used to stringify to void and break the read-back)."""
+    layers, layer_params, inp, ref = llama_stack
+    eng = ZeroInferenceEngine(layers, layer_params, device="nvme",
+                              nvme_path=str(tmp_path / "zib"),
+                              dtype=jnp.bfloat16, prefetch=0)
+    # the persisted compute copies really are bf16 on disk
+    key = eng._layer_keys[0][0]
+    assert eng._param_swapper._meta[key]["dtype"] == jnp.dtype(jnp.bfloat16)
+    x = (inp[0].astype(jnp.bfloat16), inp[1])
+    out, _ = eng.streamed_apply(x)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=0.1, atol=0.1)
