@@ -1,0 +1,193 @@
+"""DSElasticAgent analog: failure detection + elastic restart orchestration.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py:21 DSElasticAgent``
+(a ``torch.distributed.elastic`` LocalElasticAgent subclass): monitor the
+training workers, and on worker failure or a membership (scale) event,
+restart the job at the new world size — convergence-safe because the
+elastic config keeps the GLOBAL batch invariant across compatible worlds.
+
+TPU-native shape: under single-controller SPMD there is one training
+PROCESS per host, not one per device, so the agent is a host-side
+supervisor around that process:
+
+* **failure detection** — the child exiting nonzero (XLA abort, OOM,
+  preemption signal) is the failure signal; no rendezvous layer needed.
+* **scale events** — ``world_fn()`` reports the currently-available device
+  count (default: probe env ``DS_ELASTIC_WORLD_SIZE`` so tests/schedulers
+  can shrink the slice); when it changes mid-run the agent SIGTERMs the
+  child and relaunches at the new world.
+* **elastic relaunch** — each (re)launch recomputes
+  ``compute_elastic_config`` for the current world and exports the result
+  (``DS_ELASTIC_WORLD_SIZE`` / ``DS_ELASTIC_MICRO_BATCH`` /
+  ``DS_ELASTIC_GLOBAL_BATCH``) to the child, which resumes from its latest
+  checkpoint (universal any→any resume; the global batch is invariant by
+  construction — ``TestElasticResumeInvariant`` pins the math end-to-end).
+* **restart budget** — ``max_restarts`` failures (reference agent's
+  ``@record``-wrapped run loop raises after the budget).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .config import ElasticityIncompatibleWorldSize
+from .elasticity import compute_elastic_config
+
+
+_probed_world: Optional[int] = None
+
+
+def _default_world_fn() -> int:
+    """Available world: ``DS_ELASTIC_WORLD_SIZE`` if set, else ONE device
+    probe in a subprocess (importing jax here would initialize the TPU
+    backend inside the supervisor and lock it away from the very child it
+    launches). The probed value is cached — live membership changes need a
+    caller-supplied ``world_fn`` (a scheduler hook); a process's env cannot
+    change under it, so the default path cannot observe scale events."""
+    w = os.environ.get("DS_ELASTIC_WORLD_SIZE")
+    if w:
+        return int(w)
+    global _probed_world
+    if _probed_world is None:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.device_count())"],
+                capture_output=True, text=True, timeout=120)
+            _probed_world = int(out.stdout.strip().splitlines()[-1])
+        except Exception:  # noqa: BLE001 — no backend reachable
+            logger.warning(
+                "DSElasticAgent: could not probe device count (set "
+                "DS_ELASTIC_WORLD_SIZE or pass world_fn); assuming 1")
+            _probed_world = 1
+    return _probed_world
+
+
+class DSElasticAgent:
+    """Supervise one SPMD training process with elastic restarts."""
+
+    def __init__(self, cmd: Sequence[str], ds_config: dict,
+                 max_restarts: int = 3,
+                 monitor_interval: float = 1.0,
+                 world_fn: Optional[Callable[[], int]] = None,
+                 env: Optional[dict] = None,
+                 restart_backoff: float = 0.0):
+        self.cmd = list(cmd)
+        self.ds_config = ds_config
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = float(monitor_interval)
+        self.world_fn = world_fn or _default_world_fn
+        self.base_env = dict(env if env is not None else os.environ)
+        self.restart_backoff = float(restart_backoff)
+        self.restarts = 0          # failures consumed against the budget
+        self.scale_events = 0      # membership changes (don't count as failures)
+        self.history: List[dict] = []
+
+    # ------------------------------------------------------------------
+
+    def _resolve_world(self, want: int) -> int:
+        """Largest world ≤ want that the elastic config accepts (a shrunk
+        slice may not be in the compatible set — step down to one that is,
+        reference _get_compatible_gpus semantics)."""
+        for w in range(want, 0, -1):
+            try:
+                compute_elastic_config(self.ds_config, world_size=w)
+                return w
+            except ElasticityIncompatibleWorldSize:
+                continue
+        raise ElasticityIncompatibleWorldSize(
+            f"no world size in [1, {want}] satisfies the elastic config")
+
+    def _launch(self, world: int) -> subprocess.Popen:
+        batch, _, micro = compute_elastic_config(
+            self.ds_config, world_size=world, return_microbatch=True)
+        env = dict(self.base_env)
+        env["DS_ELASTIC_WORLD_SIZE"] = str(world)
+        env["DS_ELASTIC_MICRO_BATCH"] = str(micro)
+        env["DS_ELASTIC_GLOBAL_BATCH"] = str(batch)
+        env["DS_ELASTIC_RESTART_COUNT"] = str(self.restarts + self.scale_events)
+        self.history.append({"world": world, "micro": micro, "batch": batch,
+                             "t": time.time()})
+        logger.info(f"DSElasticAgent: launching world={world} micro={micro} "
+                    f"global_batch={batch} "
+                    f"(restart {self.restarts}/{self.max_restarts})")
+        return subprocess.Popen(self.cmd, env=env)
+
+    def run(self) -> int:
+        """Supervise until clean exit, budget exhaustion, or an
+        unsatisfiable world. Returns the final child returncode."""
+        world = self._resolve_world(self.world_fn())
+        proc = self._launch(world)
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        logger.info("DSElasticAgent: clean exit")
+                        return 0
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        logger.error(
+                            f"DSElasticAgent: restart budget exhausted "
+                            f"({self.max_restarts}); last rc={rc}")
+                        return rc
+                    logger.warning(
+                        f"DSElasticAgent: worker failed rc={rc} — elastic "
+                        f"restart {self.restarts}/{self.max_restarts}")
+                    if self.restart_backoff:
+                        time.sleep(self.restart_backoff)
+                    world = self._resolve_world(self.world_fn())
+                    proc = self._launch(world)
+                    continue
+                avail = self._resolve_world(self.world_fn())
+                if avail != world:
+                    # membership change: drain the child and relaunch at the
+                    # new world (reference agent's rendezvous-version bump)
+                    self.scale_events += 1
+                    logger.warning(
+                        f"DSElasticAgent: scale event {world} -> {avail}; "
+                        f"restarting workers")
+                    proc.send_signal(signal.SIGTERM)
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    world = avail
+                    proc = self._launch(world)
+                time.sleep(self.monitor_interval)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def main(argv=None):
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        description="Elastic training supervisor (DSElasticAgent analog)")
+    ap.add_argument("-c", "--config", required=True, help="DS config json")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--monitor-interval", type=float, default=1.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="training command (after --)")
+    args = ap.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":  # only the LEADING separator; the child may
+        cmd = cmd[1:]           # legitimately use "--" in its own argv
+    if not cmd:
+        ap.error("no training command given")
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    agent = DSElasticAgent(cmd, ds_config, max_restarts=args.max_restarts,
+                           monitor_interval=args.monitor_interval)
+    sys.exit(agent.run())
+
+
+if __name__ == "__main__":
+    main()

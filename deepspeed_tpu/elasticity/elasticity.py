@@ -17,10 +17,12 @@ awareness: valid world sizes are multiples of chips-per-node, and MP shrinks
 the effective data-parallel width per node.
 
 The reference's ``DSElasticAgent`` (torch-elastic subclass managing worker
-restarts) has no TPU analog — restart orchestration belongs to the cluster
-scheduler (GKE/xmanager); the scheduler calls ``compute_elastic_config`` to
-pick compatible slice sizes, and resume correctness comes from the
-universal checkpoint (any→any) path.
+restarts) has a host-level analog in :mod:`.agent` — a supervisor around
+the single SPMD training process that detects failures, watches for scale
+events, recomputes this module's elastic config for the new world and
+relaunches with resume (``bin/ds_elastic run``). Cluster schedulers
+(GKE/xmanager) can instead call ``compute_elastic_config`` directly; resume
+correctness comes from the universal checkpoint (any→any) path either way.
 """
 
 import math

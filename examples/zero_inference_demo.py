@@ -132,7 +132,9 @@ def main():
         "achieved_stream_gbps": round(eng.bytes_streamed / 1e9 / dt, 3),
         "decode_tokens_per_sec": round(args.tokens * args.batch / dt, 3),
         "peak_streamed_param_mb": round(eng.peak_param_bytes / 1e6, 2),
-        "resident_layers": 1 + args.prefetch,
+        # NVMe prefetch stages HOST read buffers; only the DRAM store holds
+        # (1 + prefetch) layers device-resident (see _LayerStreaming)
+        "resident_layers": 1 if args.device == "nvme" else 1 + args.prefetch,
         "new_tokens": args.tokens * args.batch,
     }
     print(json.dumps(report), flush=True)
