@@ -19,7 +19,7 @@ touch "$P/.session_start"  # mtime marker: snapshot only THIS session's files
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
   echo "== $name $(date -u +%T)" >> $LOG
-  timeout "$to" "$@" > "$P/${name}_r4_${SFX}.out" 2>&1
+  timeout "$to" "$@" > "$P/${name}_r5_${SFX}.out" 2>&1
   echo "$name rc=$?" >> $LOG
 }
 
@@ -77,6 +77,13 @@ run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
 # blocks — fewest grid steps, max MXU work per program.
 for B in "256,512" "512,512" "512,1024" "1024,1024"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B DS_BENCH_FAST=1 python bench.py
+done
+# 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a
+# scale where streaming matters on-chip, then the Twin-Flow partial-offload
+# ratio sweep (VERDICT r4 #8: journal the measured throughput curve)
+run zero_inference 1800 env PYTHONPATH=/root/repo:/root/.axon_site python examples/zero_inference_demo.py --hidden 2048 --layers 16 --device nvme --tokens 4
+for R in 0.25 0.5 0.75 1.0; do
+  run "twinflow_$R" 1500 python .perf/twinflow_probe.py $R
 done
 echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE

@@ -1,0 +1,77 @@
+"""Twin-Flow (Offload++) ratio sweep probe — one ratio per invocation.
+
+VERDICT r4 #8: the reference claims up to 6x/3x over full offload at
+partial ratios (blogs/deepspeed-offloadpp); measure OUR throughput at
+ratio R on the real chip and journal it. Usage: twinflow_probe.py <ratio>
+(1.0 = full host offload; 0.25 = quarter of elements step on host).
+
+Writes one JSON line; chip_session.sh runs the 0.25/0.5/0.75/1.0 sweep
+and PERF_NOTES collects the curve.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ratio = float(sys.argv[1]) if len(sys.argv) > 1 else 0.5
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+    sys.path.insert(0, "/root/repo")
+    from bench import bench_config
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
+                          num_hidden_layers=4, num_attention_heads=8,
+                          num_key_value_heads=8, max_position_embeddings=512)
+        batch, seq, iters = 2, 256, 2
+    else:
+        cfg = bench_config(False, scan_layers=True)
+        batch, seq, iters = 4, 1024, 6
+
+    model, params = init_llama(cfg)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": batch,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "param_cast": "model",
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_optimizer": {"device": "cpu", "ratio": ratio}},
+                "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32))
+
+    def step():
+        loss = engine.forward(ids, labels=ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    step(); step()
+    jax.block_until_ready(engine.params)
+    t0 = time.time()
+    for _ in range(iters):
+        step()
+    jax.block_until_ready(engine.params)
+    float(jax.tree_util.tree_leaves(engine.params)[0].ravel()[0])
+    dt = (time.time() - t0) / iters
+    print(json.dumps({
+        "metric": "twinflow_step_time",
+        "platform": platform,
+        "ratio": ratio,
+        "sec_per_step": round(dt, 4),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
