@@ -85,5 +85,8 @@ run zero_inference 1800 env PYTHONPATH=/root/repo:/root/.axon_site python exampl
 for R in 0.25 0.5 0.75 1.0; do
   run "twinflow_$R" 1500 python .perf/twinflow_probe.py $R
 done
+# 14. sparse-vs-dense block-sparse attention train probe (VERDICT r4 #4
+# "Done": sparse bwd beating dense bwd at long context)
+run sparse_attn 1800 python .perf/sparse_probe.py 2048 4096 8192
 echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE

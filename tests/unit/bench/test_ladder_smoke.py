@@ -84,3 +84,27 @@ def test_bench_engine_config_parses():
 # (ladder ORDERING invariants are pinned behaviorally by
 # tests/unit/bin/test_bench_ladder.py — this file guards the rung PROGRAM
 # classes compile+step, which that test stubs out)
+
+
+@pytest.mark.slow
+def test_bench_serving_cpu_sweep_survives(tmp_path):
+    """bench_serving.py must complete its CPU sweep end-to-end and write
+    well-formed JSON — the same don't-discover-breakage-in-a-relay-window
+    guard as the ladder rung smoke (chip_session runs it twice per window)."""
+    import json
+    import subprocess
+    out = tmp_path / "BS.json"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.join(os.path.dirname(__file__), "..", "..", "..")
+    r = subprocess.run([sys.executable,
+                        os.path.join(env["PYTHONPATH"], "bench_serving.py"),
+                        "--out", str(out)],
+                       capture_output=True, text=True, timeout=1500, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["results"], doc
+    assert not out.with_suffix(".json.partial").exists()
+    for row in doc["results"]:
+        assert np.isfinite(row.get("decode_tok_per_s", row.get("tok_per_s", 1.0)))
