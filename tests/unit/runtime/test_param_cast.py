@@ -114,3 +114,23 @@ def test_param_cast_model_eval_path():
     logits = eng(ids)
     assert logits.shape == (8, 32, cfg.vocab_size)
     assert bool(jnp.isfinite(logits).all())
+
+
+@pytest.mark.world_size(8)
+def test_param_cast_model_composes_with_zero3():
+    """Use-site casting must not disturb GSPMD: ZeRO-3 with
+    param_cast=model trains, and the barrier leaves shardings intact."""
+    cfg = tiny_cfg(remat=True)
+    model, params = init_llama(cfg, seed=0)
+    eng = make_engine(model, params, param_cast="model",
+                      zero_optimization={"stage": 3,
+                                         "stage3_param_persistence_threshold": 0})
+    ids = data(cfg, steps=2)
+    l0 = float(eng.fused_train_step(ids[0], labels=ids[0]))
+    l1 = float(eng.fused_train_step(ids[0], labels=ids[0]))
+    assert np.isfinite(l0) and l1 < l0
+    # params stayed ZeRO-sharded (over the mesh's dp axes) through the step
+    q = eng.params["model"]["layers"]["layer"]["self_attn"]["q_proj"]["kernel"]
+    axes = set(jax.tree_util.tree_leaves(
+        [e for e in tuple(q.sharding.spec) if e is not None]))
+    assert axes & {"data", "fsdp"}, q.sharding.spec
