@@ -94,5 +94,12 @@ class SparseSelfAttention:
                                 key_padding_mask_mode=self.key_padding_mask_mode)
 
 
-registry.register("sparse_attention", "xla", True,
-                  "mask-based; pallas splash kernel is the upgrade path")
+try:
+    from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+    _SPARSE_BACKEND = "pallas"
+except ImportError:  # pragma: no cover
+    _SPARSE_BACKEND = "xla"
+registry.register("sparse_attention", _SPARSE_BACKEND, True,
+                  "splash block-sparse kernel, sparse fwd AND bwd (dq via "
+                  "forward block table, dk/dv via transposed table); "
+                  "masked-dense XLA fallback via use_kernel=False")
