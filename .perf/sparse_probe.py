@@ -9,10 +9,13 @@ Usage: sparse_probe.py [seqs...]   (default 2048 4096 8192)
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
 def main():
