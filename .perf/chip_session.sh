@@ -88,5 +88,9 @@ done
 # 14. sparse-vs-dense block-sparse attention train probe (VERDICT r4 #4
 # "Done": sparse bwd beating dense bwd at long context)
 run sparse_attn 1800 python .perf/sparse_probe.py 2048 4096 8192
+# 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
+# vs bench_fast, the single-step number was relay-dispatch-bound and the
+# TRUE chip MFU is the K-step figure (compiles the same scanned body)
+run bench_multistep 1500 env DS_BENCH_MULTISTEP=8 DS_BENCH_FAST=1 python bench.py
 echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE

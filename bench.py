@@ -142,9 +142,23 @@ def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
     pool = [jax.device_put(jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, seq)),
                                        dtype=jnp.int32)) for _ in range(4)]
 
-    def step(i):
-        # ONE XLA program per step: fwd+bwd+optimizer fused (gas=1 fast path)
-        return engine.fused_train_step(pool[i % len(pool)], labels=pool[i % len(pool)])
+    # DS_BENCH_MULTISTEP=K: K optimizer steps per DISPATCH (one lax.scan
+    # program, engine.fused_train_steps) — isolates per-dispatch host/relay
+    # round-trip cost from on-chip step time. If tok/s rises with K, the
+    # single-step number was dispatch-bound, not compute-bound.
+    ksteps = int(os.environ.get("DS_BENCH_MULTISTEP", "0"))
+    if ksteps > 1:
+        stacked = jnp.stack([pool[i % len(pool)] for i in range(ksteps)])
+
+        def step(i):
+            return engine.fused_train_steps(stacked, labels=stacked)
+        n_dispatch = max(iters // ksteps, 2)
+        iters = n_dispatch * ksteps
+    else:
+        def step(i):
+            # ONE XLA program per step: fwd+bwd+optimizer fused (gas=1 fast path)
+            return engine.fused_train_step(pool[i % len(pool)], labels=pool[i % len(pool)])
+        n_dispatch = iters
 
     step(0)  # compile + warmup
     step(1)
@@ -152,7 +166,7 @@ def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
     float(jax.tree_util.tree_leaves(engine.params)[0].ravel()[0])
 
     t0 = time.time()
-    for i in range(iters):
+    for i in range(n_dispatch):
         step(i)
     # barrier on the full step (params carry the optimizer update), not just
     # the forward loss — XLA dispatch is async; the host read defeats any
@@ -184,7 +198,8 @@ def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
                 f"bs{batch}xseq{seq}"
                 f"{', remat=' + str(remat) if remat else ''}"
                 f"{scan_tag}"
-                f"{f', {heads}h x hd{cfg.head_dim_}' if heads else ''})")
+                f"{f', {heads}h x hd{cfg.head_dim_}' if heads else ''}"
+                f"{f', {ksteps}-step dispatch' if ksteps > 1 else ''})")
     out = {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),

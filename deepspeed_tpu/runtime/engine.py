@@ -634,6 +634,7 @@ class DeepSpeedTpuEngine:
             # apply program exists (its state would defeat the offload)
             self._apply_step = None
             self._train_step_fused = None
+            self._train_steps_fused = None
             self._train_batch_fused = None
             return
         self._apply_step = jax.jit(
@@ -674,6 +675,37 @@ class DeepSpeedTpuEngine:
         ) if gas == 1 and self._device_tx is None else None
         # (Twin-Flow needs the materialized grad buffer to snapshot the host
         # subset, so the one-program fused path is off under partial offload)
+
+        # Multi-step fusion: K OPTIMIZER STEPS in one XLA program — a
+        # lax.scan whose carry is (params, opt_state, scale_state) and whose
+        # xs are K stacked batches. One host dispatch per K steps amortizes
+        # the per-dispatch host/relay round trip to nothing; the schedule
+        # stays exact because optax's injected lr_fn reads the update count
+        # carried in opt_state. HLO size == one step's body (scan compiles
+        # the body once), so compile time does not grow with K. The torch
+        # reference cannot express this — its optimizer step is host-driven
+        # by construction; under XLA it is one more scan.
+        def train_steps(params, opt_state, scale_state, stacked_args,
+                        stacked_kwargs, static_kv):
+            def one(carry, batch):
+                p, o, s = carry
+                b_args, b_kwargs = batch
+                loss, p, o, s, overflow, gnorm = train_step(
+                    p, o, s, b_args, b_kwargs, static_kv)
+                return (p, o, s), (loss, overflow, gnorm)
+
+            (p, o, s), (losses, overflows, gnorms) = jax.lax.scan(
+                one, (params, opt_state, scale_state),
+                (stacked_args, stacked_kwargs))
+            return losses, p, o, s, overflows, gnorms
+
+        self._train_steps_fused = jax.jit(
+            train_steps,
+            donate_argnums=(0, 1),
+            static_argnums=(5, ),
+            out_shardings=(None, self.param_shardings, self.opt_state_shardings,
+                           scale_out, repl, repl),
+        ) if self._train_step_fused is not None else None
 
         # 1-bit compressed WIRE program (reference runtime/comm/nccl.py:16):
         # post-warmup steps exchange packed sign bits instead of fp32 grads.
@@ -1159,6 +1191,61 @@ class DeepSpeedTpuEngine:
         kwargs, static_kv = _split_static_kwargs(kwargs)
         return self._fwd_only(self.params, args, kwargs, static_kv)
 
+    def fused_train_steps(self, *args, **kwargs):
+        """K optimizer steps in ONE compiled program (one dispatch).
+
+        Every array argument carries a leading step axis ``[K, ...]``; step
+        ``i`` consumes slice ``i``. Semantics are identical to calling
+        ``fused_train_step`` K times (losses returned per step); requires
+        gradient_accumulation_steps == 1. The win is dispatch amortization:
+        host/relay round-trip cost is paid once per K steps instead of per
+        step — pure upside on remote-dispatch links."""
+        assert self._train_steps_fused is not None, \
+            "fused_train_steps requires gradient_accumulation_steps == 1"
+        if self._wire_step is not None:
+            # the 1-bit wire program swaps in per-step after freeze_step;
+            # a K-step scan would silently run uncompressed past the switch
+            raise RuntimeError(
+                "fused_train_steps does not compose with the 1-bit wire "
+                "program (onebit* + comm_backend_name) — use fused_train_step")
+        if (self.curriculum_scheduler_legacy is not None
+                or self.random_ltd_scheduler is not None):
+            # data-efficiency hooks transform each batch per step (seqlen
+            # truncation changes shapes) — incompatible with one stacked
+            # uniform-shape dispatch
+            raise RuntimeError(
+                "fused_train_steps does not compose with curriculum/"
+                "random-LTD batch routing — use fused_train_step")
+        kwargs, static_kv = _split_static_kwargs(kwargs)
+        K = jax.tree_util.tree_leaves(args + tuple(kwargs.values()))[0].shape[0]
+        args = jax.device_put(args, self.zero_plan.batch_sharding(args, stacked=True))
+        kwargs = jax.device_put(kwargs,
+                                self.zero_plan.batch_sharding(kwargs, stacked=True))
+        self.tput_timer.start()
+        (losses, self.params, self.opt_state, self.scale_state, overflows,
+         gnorms) = self._train_steps_fused(self.params, self.opt_state,
+                                           self.scale_state, args, kwargs,
+                                           static_kv)
+        self._last_grad_norm = gnorms[-1]
+        self.losses = losses[-1]
+        self.micro_steps += K
+        n_overflow = int(jnp.sum(overflows)) if self._use_loss_scaling else 0
+        self.skipped_steps += n_overflow
+        for _ in range(K - n_overflow):
+            self._advance_schedule()
+        self.global_steps += K
+        self.global_samples += K * self.train_batch_size()
+        # one dispatch = K real optimizer steps: the throughput timer and
+        # the monitor both see K events, not one
+        self.tput_timer.stop(global_step=True, steps=K)
+        if self.monitor is not None:
+            base = self.global_samples - (K - 1) * self.train_batch_size()
+            self.monitor.write_events(
+                [("Train/Samples/train_loss", float(l),
+                  base + i * self.train_batch_size())
+                 for i, l in enumerate(np.asarray(losses))])
+        return losses
+
     def module_forward(self, *args, **kwargs):
         kwargs, static_kv = _split_static_kwargs(kwargs)
         return self._fwd_only(self.params, args, kwargs, static_kv)
@@ -1244,6 +1331,7 @@ class DeepSpeedTpuEngine:
             setattr(self, attr, None)
         self._fwd_bwd = self._fwd_only = self._apply_step = None
         self._train_step_fused = self._train_batch_fused = None
+        self._train_steps_fused = None
 
     def get_global_grad_norm(self):
         return None if self._last_grad_norm is None else float(self._last_grad_norm)

@@ -205,13 +205,16 @@ class ThroughputTimer:
             _sync()
             self.start_time = time.time()
 
-    def stop(self, global_step=False, report_speed=True):
+    def stop(self, global_step=False, report_speed=True, steps: int = 1):
+        """``steps``: real optimizer steps covered by this start/stop window
+        (fused multi-step dispatch runs K steps per dispatch — counting one
+        would understate samples/sec K-fold)."""
         if not self.enabled or not self.started:
             return
         self.started = False
-        self.micro_step_count += 1
+        self.micro_step_count += steps
         if global_step:
-            self.global_step_count += 1
+            self.global_step_count += steps
         if self.start_time > 0:
             _sync()
             self.end_time = time.time()

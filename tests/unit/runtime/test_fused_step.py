@@ -230,3 +230,89 @@ def test_grad_accum_dtype_knob():
     # 65504 — no overflow check runs to skip the step, so it's rejected
     with pytest.raises(ValueError, match="fp16"):
         run("fp16")
+
+
+def test_multi_step_fused_matches_sequential():
+    """fused_train_steps(K stacked batches) ≡ K sequential fused steps:
+    same per-step losses, same final params — one dispatch instead of K."""
+    data = batches(6, seed=3)
+    e1 = make_engine()
+    ref = [float(e1.fused_train_step(x, y)) for x, y in data]
+
+    e2 = make_engine()
+    xs = jnp.stack([x for x, _ in data])
+    ys = jnp.stack([y for _, y in data])
+    losses = np.asarray(e2.fused_train_steps(xs, ys))
+    np.testing.assert_allclose(losses, ref, rtol=1e-6)
+    assert e2.global_steps == 6
+    for a, b in zip(jax.tree_util.tree_leaves(e1.params),
+                    jax.tree_util.tree_leaves(e2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_multi_step_fused_runs_lr_schedule_in_program():
+    """The injected optax schedule advances per step INSIDE the scan: the
+    final LR after one K-step dispatch equals K single-step dispatches."""
+    sched = {"scheduler": {"type": "WarmupLR",
+                           "params": {"warmup_min_lr": 0.0,
+                                      "warmup_max_lr": 1e-2,
+                                      "warmup_num_steps": 10}}}
+    data = batches(5, seed=4)
+    e1 = make_engine(**sched)
+    for x, y in data:
+        e1.fused_train_step(x, y)
+    e2 = make_engine(**sched)
+    e2.fused_train_steps(jnp.stack([x for x, _ in data]),
+                         jnp.stack([y for _, y in data]))
+    assert e2.get_lr()[0] == pytest.approx(e1.get_lr()[0], rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(e1.params),
+                    jax.tree_util.tree_leaves(e2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_multi_step_fused_fp16_overflow_bookkeeping():
+    """fp16 loss-scaling rides the scan carry; per-step overflow flags come
+    back and skipped_steps accounting matches the sequential path."""
+    data = batches(4, seed=5)
+    kw = dict(fp16={"enabled": True, "initial_scale_power": 4})
+    e1 = make_engine(**kw)
+    for x, y in data:
+        e1.fused_train_step(x, y)
+    e2 = make_engine(**kw)
+    e2.fused_train_steps(jnp.stack([x for x, _ in data]),
+                         jnp.stack([y for _, y in data]))
+    assert e2.skipped_steps == e1.skipped_steps
+    assert float(e2.scale_state.cur_scale) == float(e1.scale_state.cur_scale)
+
+
+def test_multi_step_fused_guards():
+    """Clean refusals where K-step semantics can't match fused_train_step:
+    full ZeRO-Offload (no device apply program) and data-efficiency batch
+    routing (per-step shape transforms)."""
+    data = batches(1, seed=6)
+    e = make_engine(zero_optimization={
+        "stage": 3, "offload_optimizer": {"device": "cpu"}})
+    with pytest.raises(AssertionError, match="gradient_accumulation"):
+        e.fused_train_steps(jnp.stack([data[0][0]]), jnp.stack([data[0][1]]))
+
+    e2 = make_engine(data_efficiency={
+        "enabled": True,
+        "data_routing": {"enabled": True,
+                         "random_ltd": {"enabled": True,
+                                        "total_layer_num": 2,
+                                        "random_ltd_layer_num": 1,
+                                        "random_ltd_layer_id": [0],
+                                        "model_mask_name": None,
+                                        "model_type": "decoder",
+                                        "hidden_state_order": "batch_seq_dim",
+                                        "random_ltd_schedule": {
+                                            "min_value": 8,
+                                            "max_value": 16,
+                                            "schedule_type": "fixed_linear",
+                                            "schedule_config": {
+                                                "require_steps": 10,
+                                                "seq_per_step": 8}}}}})
+    if e2.random_ltd_scheduler is not None:
+        with pytest.raises(RuntimeError, match="curriculum/random-LTD"):
+            e2.fused_train_steps(jnp.stack([data[0][0]]),
+                                 jnp.stack([data[0][1]]))
