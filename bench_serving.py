@@ -54,6 +54,10 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
 
     results = [] if results is None else results
     rng = np.random.default_rng(0)
+    # DS_BENCH_KV_INT8=1: measure with the int8 KV cache (half KV HBM;
+    # in-kernel dequant) — the int8-vs-bf16 decode delta is the evidence
+    # for the beyond-reference KV-quantization feature
+    kv_dtype = "int8" if env_flag("DS_BENCH_KV_INT8") else None
     for backend in backends:
         max_ctx = max(contexts) + decode_steps + kv_block
         chunk = 2048
@@ -69,7 +73,7 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                     (max_ctx // kv_block) + 8,
                     max(batch_sizes)
                     * ((contexts[0] + decode_steps) // kv_block + 2))),
-            kv_block_size=kv_block)
+            kv_block_size=kv_block, kv_cache_dtype=kv_dtype)
         model = eng.model()
         assert isinstance(model, RaggedLlamaModel)
         model.attn_backend = backend
@@ -103,7 +107,7 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             float(np.asarray(logits).ravel()[0])  # relay-proof barrier
             dt = time.perf_counter() - t0
             results.append({
-                "backend": backend, "context": ctx,
+                "backend": backend, "context": ctx, "kv_dtype": kv_dtype or "bf16",
                 "decode_tok_s": round(decode_steps / dt, 2),
                 "decode_step_ms": round(1e3 * dt / decode_steps, 2),
                 "prefill_tok_s": round(ctx / prefill_s, 1),
@@ -130,7 +134,8 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             float(np.asarray(out).ravel()[0])
             dt = time.perf_counter() - t0
             results.append({
-                "backend": backend, "context": ctx, "concurrent_seqs": nseq,
+                "backend": backend, "context": ctx, "kv_dtype": kv_dtype or "bf16",
+                "concurrent_seqs": nseq,
                 "batched_decode_tok_s": round(nseq * decode_steps / dt, 2),
                 # per-user token latency at this concurrency — the SLA side
                 # of FastGen's effective-throughput framing

@@ -387,6 +387,7 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                        dtype=None,
                        kv_block_size: int = 64,
                        quantize=None,
+                       kv_cache_dtype=None,
                        attn_backend: str = "auto") -> InferenceEngineV2:
     """Factory (reference ``engine_factory.py build_hf_engine``): build a
     ragged engine from a Llama config + trained params (random if None)."""
@@ -413,5 +414,6 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
                              kv_block_size=kv_block_size, quantize=quantize,
                              attn_backend=attn_backend,
+                             kv_cache_dtype=kv_cache_dtype,
                              tp_size=engine_config.tensor_parallel.tp_size)
     return InferenceEngineV2(model, engine_config)

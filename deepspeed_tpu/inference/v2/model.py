@@ -19,7 +19,7 @@ logits_gather). TPU design:
 
 import functools
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -122,7 +122,8 @@ class RaggedLlamaModel:
     """Paged-KV decode/prefill model over a Llama param tree."""
 
     def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64,
-                 attn_backend: str = "auto", quantize=None, tp_size: int = 1):
+                 attn_backend: str = "auto", quantize=None, tp_size: int = 1,
+                 kv_cache_dtype: Optional[str] = None):
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
@@ -130,6 +131,13 @@ class RaggedLlamaModel:
             raise ValueError("quantize must be None, 'int8', 'fp6' or 'int4', "
                              f"got {quantize!r}")
         self._quantize = quantize
+        if kv_cache_dtype not in (None, "int8", "bfloat16", "float32"):
+            raise ValueError("kv_cache_dtype must be None/int8/bfloat16/"
+                             f"float32, got {kv_cache_dtype!r}")
+        # int8: KV pages stored 1 byte/element + per-slot-vector fp32 scales
+        # (vLLM-class KV quantization — beyond the reference's FastGen);
+        # dequant happens in-kernel on the paged path
+        self._kv_cache_dtype = kv_cache_dtype
         self.tp_size = int(tp_size or 1)
         self._kv_pad = 0  # KV-head padding for nondivisible GQA under TP
         if self.tp_size > 1 and quantize is not None:
@@ -273,7 +281,9 @@ class RaggedLlamaModel:
             block_size=self.kv_block_size,
             cache_shape=(cfg.num_hidden_layers,
                          cfg.num_key_value_heads + self._kv_pad, cfg.head_dim_),
-            cache_dtype="bfloat16" if self.dtype == jnp.bfloat16 else "float32",
+            cache_dtype=(self._kv_cache_dtype
+                         or ("bfloat16" if self.dtype == jnp.bfloat16
+                             else "float32")),
             cache_sharding=self._cache_sharding)
 
     # ---- scheduling arithmetic (reference get_kv_requirements) ----
@@ -343,8 +353,10 @@ class RaggedLlamaModel:
         if fn is None:
             # under TP the cache's head sharding is pinned on the OUTPUT too:
             # the donated buffer must come back with the same layout or the
-            # next step pays a reshard and the donation is wasted
-            kw = ({"out_shardings": (None, self._cache_sharding)}
+            # next step pays a reshard and the donation is wasted (int8
+            # caches are a (data, scales) pytree — mirror its real layout)
+            kw = ({"out_shardings": (None, jax.tree_util.tree_map(
+                       lambda a: a.sharding, kv.cache))}
                   if self._mesh_ctx is not None else {})
             fn = jax.jit(partial(_ragged_forward, config=self.config,
                                  block_size=self.kv_block_size,
@@ -370,6 +382,15 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
     L = B * block_size  # history window bucket
     hd, nq, nkv = cfg.head_dim_, cfg.num_attention_heads, cfg.num_key_value_heads
     g = nq // nkv
+
+    # int8 KV: the cache arrives as a (data_i8, scales_f32) pytree — half
+    # the KV HBM per token; pages dequantize at read (in-kernel on the
+    # paged path)
+    kv_quant = isinstance(cache, tuple)
+    if kv_quant:
+        cache_data, cache_scales = cache
+    else:
+        cache_data, cache_scales = cache, None
 
     p = params["model"]
     x = p["embed_tokens"]["embedding"][batch.tokens]  # [T, E]
@@ -437,10 +458,23 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         # token axis first, matching kv_new's [T, 2, KV, D]). kv_pad > 0:
         # nondivisible-GQA TP — the cache rides padded KV heads (zeros) so
         # the head dim splits evenly over the model axis
-        kv_new = jnp.stack([k, v], axis=1).astype(cache.dtype)
+        kv_new = jnp.stack([k, v], axis=1)
         if kv_pad:
             kv_new = jnp.pad(kv_new, ((0, 0), (0, 0), (0, kv_pad), (0, 0)))
-        cache = cache.at[l, :, :, batch.token_slot, :].set(kv_new, mode="drop")
+        if kv_quant:
+            # int8 cache: per-slot-vector symmetric quant at write time —
+            # one scale per (k|v, head, token) over head_dim
+            kvf = kv_new.astype(jnp.float32)
+            sc = jnp.maximum(jnp.max(jnp.abs(kvf), axis=-1) / 127.0, 1e-8)
+            q_i8 = jnp.clip(jnp.round(kvf / sc[..., None]),
+                            -127, 127).astype(jnp.int8)
+            cache_data = cache_data.at[l, :, :, batch.token_slot, :].set(
+                q_i8, mode="drop")
+            cache_scales = cache_scales.at[l, :, :, batch.token_slot].set(
+                sc, mode="drop")
+        else:
+            cache_data = cache_data.at[l, :, :, batch.token_slot, :].set(
+                kv_new.astype(cache_data.dtype), mode="drop")
 
         q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd)  # grouped queries
         if kv_pad:
@@ -470,40 +504,51 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 from jax.sharding import PartitionSpec as P
                 hspec = P(None, None, "model", None, None)
                 rep = P()
+                # optional extra operands ride the shard_map with their own
+                # specs: int8 scales shard with the heads, slopes likewise
+                extra, extra_specs = [], []
+                if kv_quant:
+                    extra.append(cache_scales)
+                    extra_specs.append(P(None, None, "model", None))
                 if has_alibi:
                     from ...models.llama import alibi_slopes
                     slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)
                     if kv_pad:
                         slopes = jnp.pad(slopes, ((0, kv_pad), (0, 0)))
+                    extra.append(slopes)
+                    extra_specs.append(P("model", None))
 
-                    def _paged_local(q_l, cache_l, bt, seen, lens, sl):
-                        return paged_attention(q_l, cache_l, l, bt, seen,
-                                               lens, slopes=sl, **kernel_kw)
+                def _paged_local(q_l, cache_l, bt, seen, lens, *rest):
+                    rest = list(rest)
+                    kw = dict(kernel_kw)
+                    if kv_quant:
+                        kw["cache_scales"] = rest.pop(0)
+                    if has_alibi:
+                        kw["slopes"] = rest.pop(0)
+                    return paged_attention(q_l, cache_l, l, bt, seen,
+                                           lens, **kw)
 
-                    ctx = _smap(
-                        _paged_local, mesh,
-                        (hspec, hspec, rep, rep, rep, P("model", None)),
-                        hspec, {"model"},
-                    )(q_s, cache, batch.block_table, batch.seq_seen,
-                      seq_lens, slopes)
-                else:
-                    def _paged_local(q_l, cache_l, bt, seen, lens):
-                        return paged_attention(q_l, cache_l, l, bt, seen,
-                                               lens, **kernel_kw)
-
-                    ctx = _smap(
-                        _paged_local, mesh,
-                        (hspec, hspec, rep, rep, rep), hspec, {"model"},
-                    )(q_s, cache, batch.block_table, batch.seq_seen, seq_lens)
+                ctx = _smap(
+                    _paged_local, mesh,
+                    tuple([hspec, hspec, rep, rep, rep] + extra_specs),
+                    hspec, {"model"},
+                )(q_s, cache_data, batch.block_table, batch.seq_seen,
+                  seq_lens, *extra)
             else:
-                ctx = paged_attention(q_s, cache, l, batch.block_table,
+                ctx = paged_attention(q_s, cache_data, l, batch.block_table,
                                       batch.seq_seen, seq_lens,
-                                      use_alibi=has_alibi, **kernel_kw)
+                                      use_alibi=has_alibi,
+                                      cache_scales=cache_scales,
+                                      **kernel_kw)
             if kv_pad:
                 ctx = ctx[:, :, :nkv]  # drop the padded heads' outputs
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
-            hist = cache[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]
+            hist = cache_data[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]
+            if kv_quant:  # int8: dequant the gathered window
+                sc = cache_scales[l][:, :, slot_grid]       # [2, KV, S, L]
+                sc = jnp.moveaxis(sc, (0, 1), (2, 3))        # [S, L, 2, KV]
+                hist = hist.astype(jnp.float32) * sc[..., None]
             k_h = hist[:, :, 0].astype(jnp.float32)  # [S, L, KV, D]
             v_h = hist[:, :, 1].astype(x.dtype)
             qf = q_s.astype(jnp.float32)
@@ -593,4 +638,4 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
     if cfg.final_logit_softcapping is not None:  # Gemma-2
         cap = jnp.float32(cfg.final_logit_softcapping)
         logits = cap * jnp.tanh(logits / cap)
-    return logits, cache
+    return logits, ((cache_data, cache_scales) if kv_quant else cache_data)

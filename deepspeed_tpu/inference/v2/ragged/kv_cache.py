@@ -33,21 +33,41 @@ class BlockedKVCache:
         self.num_blocks = num_blocks
         self.block_size = config.block_size
         n_layers, n_kv, head_dim = config.cache_shape
-        self.dtype = resolve_dtype(config.cache_dtype, jnp.bfloat16)
-        self.shape = (n_layers, 2, n_kv, num_blocks * config.block_size, head_dim)
+        self.quantized = str(config.cache_dtype) == "int8"
+        self.dtype = (jnp.int8 if self.quantized
+                      else resolve_dtype(config.cache_dtype, jnp.bfloat16))
+        slots = num_blocks * config.block_size
+        self.shape = (n_layers, 2, n_kv, slots, head_dim)
         if config.cache_sharding is not None:
             # allocate DIRECTLY under the sharding (TP serving: head dim
             # over the model axis) — a default-placement zeros would OOM
             # exactly the tp-sized caches the sharding exists for
-            self.cache = jax.jit(lambda: jnp.zeros(self.shape, self.dtype),
-                                 out_shardings=config.cache_sharding)()
+            if self.quantized:
+                # scales [L, 2, KV, slots] shard like the cache minus the
+                # head_dim axis
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = tuple(config.cache_sharding.spec)[:4]
+                ssharding = NamedSharding(config.cache_sharding.mesh, P(*spec))
+                self.cache = (
+                    jax.jit(lambda: jnp.zeros(self.shape, jnp.int8),
+                            out_shardings=config.cache_sharding)(),
+                    jax.jit(lambda: jnp.zeros(self.shape[:4], jnp.float32),
+                            out_shardings=ssharding)())
+            else:
+                self.cache = jax.jit(lambda: jnp.zeros(self.shape, self.dtype),
+                                     out_shardings=config.cache_sharding)()
+        elif self.quantized:
+            # int8 data + per-slot-vector fp32 dequant scales: 1 +
+            # 4/head_dim bytes per element instead of 2 — half the KV HBM,
+            # double the schedulable batch at the same budget
+            self.cache = (jnp.zeros(self.shape, jnp.int8),
+                          jnp.zeros(self.shape[:4], jnp.float32))
         else:
             self.cache = jnp.zeros(self.shape, dtype=self.dtype)
 
     @property
     def per_token_bytes(self) -> int:
-        n_layers, n_kv, head_dim = self._config.cache_shape
-        return n_layers * 2 * n_kv * head_dim * jnp.dtype(self.dtype).itemsize
+        return per_token_kv_bytes(self._config)
 
     def update(self, new_cache: jax.Array) -> None:
         """Install the updated cache returned by a forward (donated swap)."""
@@ -58,10 +78,17 @@ class BlockedKVCache:
         return (tokens + block_size - 1) // block_size
 
 
+def per_token_kv_bytes(config: KVCacheConfig) -> int:
+    """One source of truth for KV bytes/token: int8 data + fp32 per-vector
+    scale, or the plain dtype itemsize."""
+    n_layers, n_kv, head_dim = config.cache_shape
+    if str(config.cache_dtype) == "int8":
+        return n_layers * 2 * n_kv * (head_dim * 1 + 4)  # int8 + scale
+    itemsize = jnp.dtype(resolve_dtype(config.cache_dtype, jnp.bfloat16)).itemsize
+    return n_layers * 2 * n_kv * head_dim * itemsize
+
+
 def estimate_kv_blocks(config: KVCacheConfig, hbm_bytes: int, fraction: float) -> int:
     """Size the cache from an HBM budget (reference memory_config 'reserve')."""
-    n_layers, n_kv, head_dim = config.cache_shape
-    per_block = (n_layers * 2 * n_kv * head_dim *
-                 jnp.dtype(resolve_dtype(config.cache_dtype, jnp.bfloat16)).itemsize *
-                 config.block_size)
+    per_block = per_token_kv_bytes(config) * config.block_size
     return max(1, int(hbm_bytes * fraction) // per_block)

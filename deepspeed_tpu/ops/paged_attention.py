@@ -50,11 +50,12 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
                        q_ref, kv_ref, *rest,
                        page_size: int, groups: int, scale: float,
                        window: Optional[int], has_alibi: bool,
-                       softcap: Optional[float] = None):
-    if has_alibi:
-        slopes_ref, o_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        o_ref, m_scr, l_scr, acc_scr = rest
+                       softcap: Optional[float] = None,
+                       has_scales: bool = False):
+    rest = list(rest)
+    scales_ref = rest.pop(0) if has_scales else None
+    slopes_ref = rest.pop(0) if has_alibi else None
+    o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     b = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -86,6 +87,14 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         q = q.reshape(ng, d)
         k = kv_ref[0, 0, 0]  # [page, D]
         v = kv_ref[0, 1, 0]
+        if has_scales:
+            # int8 KV: dequantize the page in-registers (per-slot-vector
+            # scales) before the MXU dots — the cache rides HBM at 1
+            # byte/element, the compute stays bf16
+            k = k.astype(jnp.bfloat16) * scales_ref[0, 0, 0].astype(
+                jnp.bfloat16)[:, None]
+            v = v.astype(jnp.bfloat16) * scales_ref[0, 1, 0].astype(
+                jnp.bfloat16)[:, None]
 
         scores = jax.lax.dot_general(
             q, k, (((1, ), (1, )), ((), ())),
@@ -144,6 +153,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
                     attn_scale: Optional[float] = None,
                     use_alibi: bool = False,
                     slopes=None,
+                    cache_scales=None,
                     softcap: Optional[float] = None):
     """Blocked-flash attention over a paged KV cache.
 
@@ -160,6 +170,8 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
       under TP the caller passes each shard its GLOBAL-head slice (reference
       sharding/attn.py keeps head identity across shards); None derives them
       from local head indices, correct only unsharded.
+      cache_scales: optional ``[L, 2, KV, num_slots]`` per-slot-vector
+      dequant scales for an int8 ``cache`` — pages dequantize in-kernel.
     Returns:
       ``[S, N, KV, G, D]`` in q.dtype.
     """
@@ -185,6 +197,17 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
         pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
     ]
     inputs = [q, cache]
+    has_scales = cache_scales is not None
+    if has_scales:
+        # scales page rides the same page lookup as its kv page (4-dim:
+        # [L, 2, KV, slots] — no head_dim axis)
+        def scales_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
+            needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
+            page = bt_r[s, jax.lax.min(b, needed - 1)]
+            return (layer_r[0], 0, k, page)
+
+        in_specs.append(pl.BlockSpec((1, 2, 1, page_size), scales_map))
+        inputs.append(cache_scales)
     has_alibi = use_alibi or slopes is not None
     if has_alibi:
         if slopes is None:
@@ -210,7 +233,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
                                groups=G, scale=scale, window=window,
                                softcap=softcap,
-                               has_alibi=has_alibi)
+                               has_alibi=has_alibi, has_scales=has_scales)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -225,6 +248,7 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
                               attn_scale: Optional[float] = None,
                               use_alibi: bool = False,
                               slopes=None,
+                              cache_scales=None,
                               softcap: Optional[float] = None):
     """Dense-gather XLA reference (the round-1 path) for numerics tests."""
     S, N, KV, G, D = q.shape
@@ -234,6 +258,9 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     j = jnp.arange(L, dtype=jnp.int32)
     slot_grid = block_table[:, j // page_size] * page_size + j % page_size
     hist = cache[layer][:, :, slot_grid, :]           # [2, KV, S, L, D]
+    if cache_scales is not None:  # int8 cache: dequant the gathered window
+        sc = cache_scales[layer][:, :, slot_grid]     # [2, KV, S, L]
+        hist = hist.astype(jnp.float32) * sc[..., None].astype(jnp.float32)
     k_h = jnp.moveaxis(hist[0], 1, 0).astype(jnp.float32)  # [S, KV, L, D]
     v_h = jnp.moveaxis(hist[1], 1, 0).astype(jnp.float32)
     qf = q.astype(jnp.float32)

@@ -1201,7 +1201,9 @@ class DeepSpeedTpuEngine:
         host/relay round-trip cost is paid once per K steps instead of per
         step — pure upside on remote-dispatch links."""
         assert self._train_steps_fused is not None, \
-            "fused_train_steps requires gradient_accumulation_steps == 1"
+            ("fused_train_steps requires gradient_accumulation_steps == 1, "
+             "no optimizer offload (full or Twin-Flow partial), and a "
+             "device apply program")
         if self._wire_step is not None:
             # the 1-bit wire program swaps in per-step after freeze_step;
             # a K-step scan would silently run uncompressed past the switch
@@ -1222,6 +1224,9 @@ class DeepSpeedTpuEngine:
         kwargs = jax.device_put(kwargs,
                                 self.zero_plan.batch_sharding(kwargs, stacked=True))
         self.tput_timer.start()
+        self._flops_profile_pre(self._train_steps_fused,
+                                (self.params, self.opt_state, self.scale_state,
+                                 args, kwargs, static_kv))
         (losses, self.params, self.opt_state, self.scale_state, overflows,
          gnorms) = self._train_steps_fused(self.params, self.opt_state,
                                            self.scale_state, args, kwargs,
@@ -1238,6 +1243,7 @@ class DeepSpeedTpuEngine:
         # one dispatch = K real optimizer steps: the throughput timer and
         # the monitor both see K events, not one
         self.tput_timer.stop(global_step=True, steps=K)
+        self._flops_profile_post()
         if self.monitor is not None:
             base = self.global_samples - (K - 1) * self.train_batch_size()
             self.monitor.write_events(

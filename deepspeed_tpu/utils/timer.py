@@ -221,15 +221,23 @@ class ThroughputTimer:
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
+            self._steps_in_window = getattr(self, "_steps_in_window", 0) + steps
             if global_step:
-                if report_speed and self.steps_per_output and \
-                        self.global_step_count % self.steps_per_output == 0:
+                # crossed-boundary cadence: a K-step dispatch advances the
+                # count by K, so == 0 would skip reports whenever K doesn't
+                # divide steps_per_output
+                crossed = (self.steps_per_output and
+                           (self.global_step_count // self.steps_per_output
+                            > (self.global_step_count - steps) // self.steps_per_output))
+                if report_speed and crossed:
+                    n = self._steps_in_window
                     self.logging(
                         f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                         f"global_step={self.global_step_count}, "
                         f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6f}, "
-                        f"CurrSamplesPerSec={self._steps_to_samples(1) / (self.step_elapsed_time + TIME_EPSILON):.6f}")
+                        f"CurrSamplesPerSec={self._steps_to_samples(n) / (self.step_elapsed_time + TIME_EPSILON):.6f}")
                 self.step_elapsed_time = 0
+                self._steps_in_window = 0
 
     def _steps_to_samples(self, steps):
         return steps * self.batch_size
