@@ -90,3 +90,9 @@ class RaggedInferenceEngineConfig(ConfigModel):
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).
     num_kv_blocks: Optional[int] = None
+
+    # Automatic prefix caching (beyond the reference — vLLM-class):
+    # content-addressed reuse of full prompt KV blocks across sequences.
+    # Disabled for sliding-window models (their trailing-window release
+    # would free shared blocks).
+    enable_prefix_caching: bool = False

@@ -33,8 +33,16 @@ class InferenceEngineV2:
         kv_config = model.kv_cache_config()
         self._batch = RaggedBatchWrapper(engine_config.state_manager,
                                          block_size=kv_config.block_size)
+        prefix_caching = engine_config.enable_prefix_caching
+        if prefix_caching and getattr(model.config, "sliding_window", None):
+            from ...utils.logging import logger
+            logger.warning("prefix caching disabled: sliding-window models "
+                           "release trailing KV blocks mid-sequence, which "
+                           "would free shared prefix blocks")
+            prefix_caching = False
         self._state_manager = DSStateManager(engine_config.state_manager, kv_config,
-                                             num_blocks=engine_config.num_kv_blocks)
+                                             num_blocks=engine_config.num_kv_blocks,
+                                             enable_prefix_caching=prefix_caching)
         self._model.set_state_manager(self._state_manager)
 
     # ---- properties (reference engine_v2.py:47-66) ----
@@ -64,9 +72,37 @@ class InferenceEngineV2:
             if schedule_check != SchedulingResult.Success:
                 raise SchedulingError(schedule_check)
 
+        pc = self._state_manager.prefix_cache
         self._batch.clear()
-        for uid, tokens in zip(batch_uids, batch_tokens):
-            host_seq_desc = self._state_manager.get_or_create_sequence(uid)
+        for i, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
+            host_seq_desc = self._state_manager.get_sequence(uid)
+            if (pc is not None and host_seq_desc is None
+                    and tokens.size > self._state_manager.block_size):
+                # NEW sequence: adopt the longest cached full-block prefix —
+                # its KV already exists, so only the suffix is fed/computed.
+                # At least one token must stay fed (logits come from it).
+                matched, chain_key = pc.match_with_key(tokens[:tokens.size - 1])
+                if matched:
+                    host_seq_desc = self._state_manager.get_or_create_sequence(uid)
+                    host_seq_desc.extend_kv_cache(matched)
+                    host_seq_desc.adopted_blocks = set(matched)
+                    host_seq_desc.chain_key = chain_key
+                    host_seq_desc.chain_blocks = len(matched)
+                    skip = len(matched) * self._state_manager.block_size
+                    host_seq_desc.pre_forward(skip)
+                    host_seq_desc.post_forward()  # history = cached prefix
+                    tokens = tokens[skip:]
+            if host_seq_desc is None:
+                host_seq_desc = self._state_manager.get_or_create_sequence(uid)
+            if pc is not None:
+                # stage fed tokens for block registration post-forward; only
+                # the sub-block tail is ever retained (O(block) per step,
+                # not O(history))
+                pend = getattr(host_seq_desc, "pending_tokens", None)
+                if pend is None:
+                    pend = np.zeros(0, np.int32)
+                host_seq_desc.pending_tokens = np.concatenate([pend, tokens])
+            batch_tokens[i] = tokens
             self._model.maybe_allocate_kv(host_seq_desc, tokens.size)
             host_seq_desc.pre_forward(tokens.size)
             self._batch.insert_sequence(host_seq_desc, tokens, do_checks=do_checks)
@@ -79,6 +115,20 @@ class InferenceEngineV2:
         for uid in batch_uids:
             seq = self._state_manager.get_sequence(uid)
             seq.post_forward()
+            if pc is not None:
+                # register newly completed full blocks (KV just written) as
+                # a chain continuation — each block hashed exactly once over
+                # the sequence's lifetime
+                bs = self._state_manager.block_size
+                full = len(seq.pending_tokens) // bs
+                if full:
+                    start = getattr(seq, "chain_blocks", 0)
+                    seq.chain_key, _ = pc.register_from(
+                        getattr(seq, "chain_key", None),
+                        seq.pending_tokens[:full * bs],
+                        seq.kv_blocks[start:start + full])
+                    seq.chain_blocks = start + full
+                    seq.pending_tokens = seq.pending_tokens[full * bs:]
             self._model.maybe_free_kv(seq)
         return logits
 

@@ -1,0 +1,199 @@
+"""Automatic prefix caching: content-addressed KV block reuse.
+
+Beyond the reference's FastGen (vLLM-class feature): FULL prompt blocks are
+keyed by the exact chain of their token contents; a later prompt sharing a
+block-aligned prefix ADOPTS the cached blocks read-only — prefill compute
+and KV writes are skipped for the matched region, and the engine feeds only
+the uncached suffix.
+
+Ownership model (host-side, no device traffic — block ids only):
+
+* while the sequence that computed a block is alive, the block belongs to
+  that sequence; the cache entry just points at it.
+* at sequence flush, ownership of registered blocks transfers to the cache
+  (they are NOT returned to the allocator); unregistered blocks free
+  normally.
+* adopters take a reference (``refs``); flushing an adopter drops it.
+* under allocator pressure the state manager evicts LRU leaf entries
+  (``refs == 0`` and no cached children) back to the allocator — a parent
+  is never evicted before its children, so every cached chain stays
+  matchable root-first.
+
+Safety: adopted blocks are never written (new tokens start at the
+block-aligned ``seen_tokens`` boundary, i.e. a fresh block), and prefix
+caching is disabled for sliding-window models whose mid-sequence
+trailing-window release would free shared blocks.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _Entry:
+    __slots__ = ("block", "refs", "children", "last_use", "parent", "owned")
+
+    def __init__(self, block: int, parent):
+        self.block = int(block)
+        self.refs = 0          # live sequences currently adopting this block
+        self.children = 0      # cached entries chained after this one
+        self.last_use = 0
+        self.parent = parent   # parent key or None
+        self.owned = False     # True once the computing sequence flushed
+
+
+class PrefixKVCache:
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._entries: Dict[tuple, _Entry] = {}
+        self._by_block: Dict[int, tuple] = {}
+        self._clock = 0
+
+    # ---- keys ----
+
+    def _keys_for(self, tokens: np.ndarray) -> List[tuple]:
+        bs = self.block_size
+        keys, parent = [], None
+        for i in range(len(tokens) // bs):
+            parent = (parent, tokens[i * bs:(i + 1) * bs].tobytes())
+            keys.append(parent)
+        return keys
+
+    # ---- lookup / adoption ----
+
+    def match(self, tokens: np.ndarray) -> List[int]:
+        """Block ids of the longest cached full-block prefix of ``tokens``
+        (all matched entries' refcounts are incremented — the caller's
+        sequence adopts them)."""
+        ids, _ = self.match_with_key(tokens)
+        return ids
+
+    def match_with_key(self, tokens: np.ndarray) -> Tuple[List[int], Optional[tuple]]:
+        """Like match(), also returning the LAST matched chain key so the
+        caller can continue registering the chain without re-hashing the
+        matched region."""
+        self._clock += 1
+        matched: List[_Entry] = []
+        last_key = None
+        for key in self._keys_for(np.asarray(tokens, np.int32)):
+            e = self._entries.get(key)
+            if e is None:
+                break
+            matched.append(e)
+            last_key = key
+        for e in matched:
+            e.refs += 1
+            e.last_use = self._clock
+        return [e.block for e in matched], last_key
+
+    def release(self, block_ids: Sequence[int]) -> None:
+        """An adopter flushed: drop its references."""
+        for b in block_ids:
+            key = self._by_block.get(int(b))
+            if key is not None:
+                self._entries[key].refs -= 1
+
+    # ---- registration / ownership ----
+
+    def register(self, tokens: np.ndarray, block_ids: Sequence[int]) -> List[int]:
+        """Associate ``tokens``' full blocks with ``block_ids`` (the
+        computing sequence's blocks, KV already written). Returns the ids
+        actually registered; blocks whose chain is already cached are NOT
+        re-registered (the duplicate computation keeps its own blocks,
+        freed normally at flush)."""
+        _, registered = self.register_from(None, tokens, block_ids)
+        return registered
+
+    def register_from(self, parent_key: Optional[tuple], tokens: np.ndarray,
+                      block_ids: Sequence[int]) -> Tuple[Optional[tuple], List[int]]:
+        """Chain-continuation registration: ``tokens`` (a multiple of
+        block_size) continue the chain ending at ``parent_key`` (None =
+        chain root). Lets a live sequence register each newly completed
+        block in O(block) instead of re-hashing its whole history. Returns
+        (new tail key, registered block ids)."""
+        self._clock += 1
+        registered = []
+        bs = self.block_size
+        tokens = np.asarray(tokens, np.int32)
+        key = parent_key
+        for i, b in zip(range(len(tokens) // bs), block_ids):
+            parent = key
+            key = (parent, tokens[i * bs:(i + 1) * bs].tobytes())
+            b = int(b)
+            e = self._entries.get(key)
+            if e is not None:
+                continue  # chain already cached by another sequence
+            if b in self._by_block:
+                continue  # block already backs another entry (shouldn't happen)
+            e = _Entry(b, parent)
+            e.last_use = self._clock
+            self._entries[key] = e
+            self._by_block[b] = key
+            if parent is not None and parent in self._entries:
+                self._entries[parent].children += 1
+            registered.append(b)
+        return key, registered
+
+    def owns(self, block_id: int) -> bool:
+        return int(block_id) in self._by_block
+
+    def take_ownership(self, block_ids: Sequence[int]) -> List[int]:
+        """The computing sequence flushed: registered blocks stay cached
+        (returned list = blocks the CACHE now owns, i.e. must not be freed
+        by the caller)."""
+        kept = []
+        for b in block_ids:
+            key = self._by_block.get(int(b))
+            if key is not None:
+                self._entries[key].owned = True
+                kept.append(int(b))
+        return kept
+
+    # ---- accounting / eviction ----
+
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Exactly what evict() could hand back right now: owned,
+        unreferenced entries whose ENTIRE cached subtree is also owned and
+        unreferenced (leaf-first eviction cannot pass a pinned or live
+        child — counting those would let the scheduler admit work the
+        allocator can never satisfy)."""
+        kids: Dict[Optional[tuple], List[tuple]] = {}
+        for key, e in self._entries.items():
+            kids.setdefault(e.parent, []).append(key)
+        memo: Dict[tuple, bool] = {}
+
+        def evictable(key) -> bool:
+            if key in memo:
+                return memo[key]
+            e = self._entries[key]
+            ok = (e.owned and e.refs <= 0
+                  and all(evictable(k) for k in kids.get(key, ())))
+            memo[key] = ok
+            return ok
+
+        return sum(1 for key in self._entries if evictable(key))
+
+    def evict(self, n_blocks: int) -> List[int]:
+        """Free up to ``n_blocks`` cache-owned LRU leaf blocks back to the
+        caller (leaf-first keeps every remaining chain matchable)."""
+        freed: List[int] = []
+        while len(freed) < n_blocks:
+            victims = [(e.last_use, key) for key, e in self._entries.items()
+                       if e.owned and e.refs <= 0 and e.children == 0]
+            if not victims:
+                break
+            victims.sort()
+            for _, key in victims:
+                if len(freed) >= n_blocks:
+                    break
+                e = self._entries.pop(key)
+                self._by_block.pop(e.block, None)
+                if e.parent is not None and e.parent in self._entries:
+                    self._entries[e.parent].children -= 1
+                freed.append(e.block)
+        return freed
+
+    def __len__(self):
+        return len(self._entries)

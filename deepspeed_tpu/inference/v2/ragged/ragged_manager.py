@@ -9,6 +9,7 @@ from typing import Dict, Optional
 from ..config_v2 import DSStateManagerConfig, KVCacheConfig
 from .blocked_allocator import BlockedAllocator
 from .kv_cache import BlockedKVCache
+from .prefix_cache import PrefixKVCache
 from .sequence_descriptor import DSSequenceDescriptor
 
 
@@ -17,7 +18,8 @@ class DSStateManager:
     def __init__(self,
                  config: DSStateManagerConfig,
                  kv_config: KVCacheConfig,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 enable_prefix_caching: bool = False):
         self._config = config
         self._kv_config = kv_config
         if num_blocks is None:
@@ -25,6 +27,8 @@ class DSStateManager:
         self._allocator = BlockedAllocator(num_blocks)
         self._kv_cache = BlockedKVCache(kv_config, num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        self.prefix_cache = (PrefixKVCache(kv_config.block_size)
+                             if enable_prefix_caching else None)
 
     @staticmethod
     def _size_from_memory_config(config: DSStateManagerConfig,
@@ -80,18 +84,33 @@ class DSStateManager:
         return seq
 
     def flush_sequence(self, uid: int) -> None:
-        """Free a sequence's KV blocks + tracking (reference :147)."""
+        """Free a sequence's KV blocks + tracking (reference :147). With
+        prefix caching on, adopted blocks drop their reference and
+        registered blocks transfer ownership to the cache instead of
+        returning to the allocator."""
         seq = self._seqs.pop(uid, None)
         if seq is None:
             return
-        if seq.kv_blocks:
-            self._allocator.free(seq.kv_blocks)
+        blocks = seq.kv_blocks
+        if self.prefix_cache is not None:
+            adopted = set(getattr(seq, "adopted_blocks", ()))
+            self.prefix_cache.release([b for b in blocks if b in adopted])
+            kept = set(self.prefix_cache.take_ownership(
+                [b for b in blocks if b not in adopted]))
+            blocks = [b for b in blocks if b not in adopted and b not in kept]
+        if blocks:
+            self._allocator.free(blocks)
 
     # ---- KV accounting ----
 
     @property
     def free_blocks(self) -> int:
-        return self._allocator.free_blocks
+        """Allocator-free plus what prefix-cache eviction could reclaim —
+        the scheduling view (allocate_blocks evicts on demand)."""
+        n = self._allocator.free_blocks
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.reclaimable_blocks
+        return n
 
     @property
     def kv_cache(self) -> BlockedKVCache:
@@ -102,6 +121,20 @@ class DSStateManager:
         return self._kv_config.block_size
 
     def allocate_blocks(self, n_blocks: int):
+        if (self.prefix_cache is not None
+                and n_blocks > self._allocator.free_blocks):
+            # evict LRU cached prefixes back to the allocator on demand
+            evicted = self.prefix_cache.evict(
+                n_blocks - self._allocator.free_blocks)
+            if evicted:
+                self._allocator.free(evicted)
+            if n_blocks > self._allocator.free_blocks:
+                # free_blocks promised space eviction couldn't deliver (or
+                # the scheduler was raced) — surface the catchable scheduling
+                # error, not the allocator's raw ValueError, so generate()'s
+                # evict-and-replay recovery can engage
+                from ..scheduling_utils import SchedulingError, SchedulingResult
+                raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
         return self._allocator.allocate(n_blocks)
 
     def release_blocks(self, blocks) -> None:

@@ -909,17 +909,22 @@ class DeepSpeedTpuEngine:
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps % self.gradient_accumulation_steps()) == 0
 
-    def _flops_profile_pre(self, step_fn=None, step_args=None):
+    def _flops_profile_pre(self, step_fn=None, step_args=None, steps: int = 1):
         """Reference engine.py flops-profiler hooks: the engine itself starts
         the profile when global_steps reaches ``profile_step`` — the config
         knob used to be accepted and silently ignored (a user enabling
         ``flops_profiler`` got no output without driving the profiler by
         hand). ``step_fn``/``step_args``: the fused one-program step, whose
         exact compiled cost is recorded (the split path's cost comes from
-        ``last_fwd_spec`` inside ``start_profile``)."""
+        ``last_fwd_spec`` inside ``start_profile``). ``steps``: how many
+        real optimizer steps the upcoming dispatch covers — a K-step fused
+        dispatch must trigger when profile_step falls anywhere inside
+        [global_steps, global_steps + K)."""
         fp = self.flops_profiler
         c = self._config.flops_profiler_config
-        if fp is None or fp.started or self.global_steps != c.profile_step:
+        if (fp is None or fp.started
+                or not (self.global_steps <= c.profile_step
+                        < self.global_steps + steps)):
             return
         # the fused program already contains fwd+bwd+step: accruing the
         # split-path _fwd_bwd cost too would double the reported flops
@@ -1226,7 +1231,7 @@ class DeepSpeedTpuEngine:
         self.tput_timer.start()
         self._flops_profile_pre(self._train_steps_fused,
                                 (self.params, self.opt_state, self.scale_state,
-                                 args, kwargs, static_kv))
+                                 args, kwargs, static_kv), steps=K)
         (losses, self.params, self.opt_state, self.scale_state, overflows,
          gnorms) = self._train_steps_fused(self.params, self.opt_state,
                                            self.scale_state, args, kwargs,
