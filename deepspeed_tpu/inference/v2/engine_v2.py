@@ -60,9 +60,19 @@ class InferenceEngineV2:
 
     # ---- serving (reference :107 put) ----
 
-    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True):
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True,
+            window_logits: bool = False, defer_register=frozenset()):
         """One ragged forward; returns logits [n_seqs_padded, vocab] — row i is
-        the next-token distribution for batch_uids[i]."""
+        the next-token distribution for batch_uids[i].
+
+        ``window_logits``: return [n_seqs_padded, N, vocab] logits at EVERY
+        fed token instead (speculative verification); trailing-window KV
+        frees are deferred to the caller (who frees after rollback, when
+        ``seen_tokens`` is truthful again). ``defer_register``: uids whose
+        feed contains draft tokens — their prefix-cache registration is
+        deferred until the caller has rolled back rejections (a rejected
+        chain must never enter the cache; its blocks are overwritten in
+        place)."""
         batch_uids = list(batch_uids)
         batch_tokens = [np.asarray(t, dtype=np.int32).reshape(-1) for t in batch_tokens]
 
@@ -110,27 +120,42 @@ class InferenceEngineV2:
         batch = self._batch.finalize(
             total_slots=self._state_manager.kv_cache.num_blocks *
             self._state_manager.kv_cache.block_size)
-        logits = self._model.forward(batch)
+        logits = self._model.forward(batch, window_logits=window_logits)
 
         for uid in batch_uids:
             seq = self._state_manager.get_sequence(uid)
             seq.post_forward()
-            if pc is not None:
-                # register newly completed full blocks (KV just written) as
-                # a chain continuation — each block hashed exactly once over
-                # the sequence's lifetime
-                bs = self._state_manager.block_size
-                full = len(seq.pending_tokens) // bs
-                if full:
-                    start = getattr(seq, "chain_blocks", 0)
-                    seq.chain_key, _ = pc.register_from(
-                        getattr(seq, "chain_key", None),
-                        seq.pending_tokens[:full * bs],
-                        seq.kv_blocks[start:start + full])
-                    seq.chain_blocks = start + full
-                    seq.pending_tokens = seq.pending_tokens[full * bs:]
-            self._model.maybe_free_kv(seq)
+            # sequences whose feed carried draft tokens defer registration:
+            # the caller rolls back rejections (history AND pending) and
+            # then calls _register_pending itself
+            if pc is not None and uid not in defer_register:
+                self._register_pending(seq)
+            if not window_logits:
+                # draft steps also defer the trailing-window KV free: seen
+                # is inflated by unverified drafts here, and a block freed
+                # against the inflated window could still be needed after
+                # rollback (free is irreversible — the caller frees once
+                # seen is truthful)
+                self._model.maybe_free_kv(seq)
         return logits
+
+    def _register_pending(self, seq) -> None:
+        """Register the sequence's newly completed full KV blocks with the
+        prefix cache as a chain continuation — each block is hashed exactly
+        once over the sequence's lifetime (O(block) per step)."""
+        pc = self._state_manager.prefix_cache
+        if pc is None:
+            return
+        bs = self._state_manager.block_size
+        full = len(seq.pending_tokens) // bs
+        if full:
+            start = getattr(seq, "chain_blocks", 0)
+            seq.chain_key, _ = pc.register_from(
+                getattr(seq, "chain_key", None),
+                seq.pending_tokens[:full * bs],
+                seq.kv_blocks[start:start + full])
+            seq.chain_blocks = start + full
+            seq.pending_tokens = seq.pending_tokens[full * bs:]
 
     # ---- scheduling feasibility (reference :158 query / :184 can_schedule) ----
 
@@ -177,18 +202,26 @@ class InferenceEngineV2:
             return 0
         return self._model.get_remaining_block_capacity(seq_desc)
 
-    def warmup(self, prefill_lens=(128, ), batch_sizes=(1, )) -> int:
+    def warmup(self, prefill_lens=(128, ), batch_sizes=(1, ),
+               draft_tokens: int = 0) -> int:
         """Precompile the bucketed forward programs serving will hit, so the
         first real request doesn't pay compile latency (the reference's
         CUDA-graph warmup analog). Runs scratch sequences through put() —
         prefill at each length, plus the decode (1-token) program at each
-        concurrent batch size — then flushes them. Returns the number of
-        compiled programs cached."""
+        concurrent batch size — then flushes them. ``draft_tokens``: also
+        warm the window-logits verify program speculative decoding uses
+        (1 + draft_tokens fed tokens). Returns the number of compiled
+        programs cached."""
         base = 1 << 28  # scratch uid space clear of real uids
         for n in prefill_lens:
             uid = base
             self.put([uid], [np.zeros(int(n), np.int32)], do_checks=False)
             self.put([uid], [[0]])  # decode continuation bucket
+            if draft_tokens:
+                self.put([uid], [[0] * (1 + draft_tokens)],
+                         window_logits=True, defer_register={uid})
+                seq = self._state_manager.get_sequence(uid)
+                seq.rollback(draft_tokens)
             self.flush(uid)
         for bs in batch_sizes:
             uids = list(range(base + 1, base + 1 + bs))
@@ -259,7 +292,10 @@ class InferenceEngineV2:
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
                  return_logprobs: bool = False,
-                 seed: int = 0):
+                 seed: int = 0,
+                 speculative: Optional[str] = None,
+                 num_draft_tokens: int = 4,
+                 draft_ngram: int = 2):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
         sequence in ONE ragged batch per step (the N=1 fast path), free KV on
@@ -272,7 +308,22 @@ class InferenceEngineV2:
         cannot run the allocator dry mid-generation. If it still does (e.g.
         admission fell back to best-effort), the newest live sequence is
         evicted and later replayed (prompt + tokens so far) instead of the
-        whole batch crashing."""
+        whole batch crashing.
+
+        ``speculative="prompt_lookup"`` (greedy only; beyond the reference):
+        each decode step drafts up to ``num_draft_tokens`` by matching the
+        trailing ``draft_ngram`` against earlier context (Saxena's
+        prompt-lookup decoding — no draft model) and verifies them in ONE
+        forward via window logits; accepted drafts land m+1 tokens per
+        dispatch, rejected ones roll back in place. Memory-bound decode is
+        where this pays: the verify pass re-reads the same weights a plain
+        step would."""
+        if speculative is not None:
+            if speculative != "prompt_lookup":
+                raise ValueError(f"unknown speculative mode {speculative!r}")
+            if temperature != 0.0 or return_logprobs:
+                raise ValueError("speculative decoding is greedy-only "
+                                 "(temperature=0, no logprobs)")
         rng = np.random.default_rng(seed)
         prompts = [list(map(int, np.asarray(p).reshape(-1))) for p in prompts]
         uids = list(range(len(prompts)))
@@ -392,12 +443,53 @@ class InferenceEngineV2:
                     self.flush(u)
             if not live:
                 continue
+
+            # total drafted tokens are bounded by the ragged-batch budget
+            # (each live seq is guaranteed its 1 real token first) and each
+            # sequence's room by its context AND output budgets
+            draft_budget = max(0, max_batch_tokens - len(live)) \
+                if speculative else 0
+
+            def _draft(u, budget):
+                """Prompt-lookup: propose the tokens that followed the most
+                recent earlier occurrence of the trailing n-gram."""
+                hist = prompts[u] + outputs[u]
+                if len(hist) <= draft_ngram:
+                    return []
+                pat = hist[-draft_ngram:]
+                seq = self._state_manager.get_sequence(u)
+                room = min(num_draft_tokens, budget,
+                           sm.max_context - seq.seen_tokens - 2,
+                           max_new_tokens - len(outputs[u]) - 1)
+                if room <= 0:
+                    return []
+                for s in range(len(hist) - draft_ngram - 1, -1, -1):
+                    if hist[s:s + draft_ngram] == pat:
+                        return [int(t) for t in
+                                hist[s + draft_ngram:s + draft_ngram + room]]
+                return []
+
+            drafts = {}
+            for u in live:
+                drafts[u] = _draft(u, draft_budget) if speculative else []
+                draft_budget -= len(drafts[u])
+            use_window = any(drafts[u] for u in live)
             while live:
                 try:
-                    logits = np.asarray(self.put(live,
-                                                 [[last_tok[u]] for u in live]))
+                    step_feed = [[last_tok[u]] + drafts[u] for u in live]
+                    logits = np.asarray(self.put(
+                        live, step_feed, window_logits=use_window,
+                        defer_register=(
+                            {u for u in live if drafts[u]}
+                            if use_window else frozenset())))
                     break
                 except SchedulingError:
+                    if use_window:
+                        # drafts don't justify evicting a healthy sequence:
+                        # retry the step draft-free before giving up KV
+                        drafts = {u: [] for u in live}
+                        use_window = False
+                        continue
                     u = live.pop()  # newest first: oldest finish soonest
                     self.flush(u)
                     if live:
@@ -407,12 +499,52 @@ class InferenceEngineV2:
                     # generation is truncated at the tokens produced so far
             if not live:
                 continue
-            for i, u in enumerate(live):
-                last_tok[u], lp = self._sample_with_logprob(
-                    logits[i], temperature, rng, top_k, top_p,
-                    want_lp=return_logprobs)
-                outputs[u].append(last_tok[u])
-                logprobs[u].append(lp)
+            if use_window:
+                # greedy verification: accept the longest draft prefix the
+                # model agrees with, emit the correction/bonus token, and
+                # roll the rejected tail back in place
+                for i, u in enumerate(live):
+                    k = len(drafts[u])
+                    row = logits[i]          # [N, vocab]; rows 0..k valid
+                    new_toks, m = [], 0
+                    for j in range(k + 1):
+                        t = int(row[j].argmax())
+                        if j < k and drafts[u][j] == t:
+                            new_toks.append(t)
+                            m += 1
+                            continue
+                        new_toks.append(t)
+                        break
+                    rejected = k - m
+                    seq = self._state_manager.get_sequence(u)
+                    if rejected:
+                        seq.rollback(rejected)
+                        if self._state_manager.prefix_cache is not None:
+                            seq.pending_tokens = \
+                                seq.pending_tokens[:len(seq.pending_tokens)
+                                                   - rejected]
+                    if drafts[u]:
+                        # deferred registration now that seen is truthful
+                        self._register_pending(seq)
+                    # window puts defer the trailing-window free for EVERY
+                    # sequence in the batch — resume it here
+                    self._model.maybe_free_kv(seq)
+                    outputs[u].extend(new_toks)
+                    logprobs[u].extend([None] * len(new_toks))
+                    if eos_token_id is not None and eos_token_id in new_toks:
+                        cut = len(outputs[u]) - len(new_toks) \
+                            + new_toks.index(eos_token_id) + 1
+                        outputs[u] = outputs[u][:cut]
+                    if len(outputs[u]) > max_new_tokens:
+                        outputs[u] = outputs[u][:max_new_tokens]
+                    last_tok[u] = outputs[u][-1]
+            else:
+                for i, u in enumerate(live):
+                    last_tok[u], lp = self._sample_with_logprob(
+                        logits[i], temperature, rng, top_k, top_p,
+                        want_lp=return_logprobs)
+                    outputs[u].append(last_tok[u])
+                    logprobs[u].append(lp)
         if return_logprobs:
             return [outputs[u] for u in uids], [logprobs[u] for u in uids]
         return [outputs[u] for u in uids]

@@ -346,9 +346,12 @@ class RaggedLlamaModel:
 
     # ---- forward ----
 
-    def forward(self, batch: RaggedBatch) -> jax.Array:
+    def forward(self, batch: RaggedBatch, window_logits: bool = False) -> jax.Array:
+        """``window_logits``: return [S, N, vocab] logits for every fed
+        token (the speculative verifier's one-pass need) instead of the
+        final-token [S, vocab] gather."""
         kv = self._state_manager.kv_cache
-        key = batch.bucket_key
+        key = (batch.bucket_key, window_logits)
         fn = self._fwd_cache.get(key)
         if fn is None:
             # under TP the cache's head sharding is pinned on the OUTPUT too:
@@ -363,6 +366,7 @@ class RaggedLlamaModel:
                                  attn_backend=self.attn_backend,
                                  tp_size=self.tp_size,
                                  kv_pad=self._kv_pad,
+                                 window_logits=window_logits,
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
@@ -374,7 +378,8 @@ class RaggedLlamaModel:
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
-                    tp_size: int = 1, kv_pad: int = 0, mesh=None):
+                    tp_size: int = 1, kv_pad: int = 0, mesh=None,
+                    window_logits: bool = False):
     """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
     cfg = config
     T = batch.tokens.shape[0]
@@ -626,7 +631,14 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         x = x + _ffn(_norm_tok(x, lp.get("post_attention_layernorm"), cfg))
 
     x = _norm_tok(x, p.get("norm"), cfg)
-    final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]
+    if window_logits:
+        # speculative verification: logits for EVERY fed token of each
+        # sequence ([S, N, E] via the q_tok_idx bucket) instead of the
+        # final-token gather — the verifier needs next-token distributions
+        # at all draft positions in ONE pass
+        final = x[q_tok_idx].astype(jnp.float32)     # [S, N, E]
+    else:
+        final = x[batch.last_token_idx].astype(jnp.float32)  # [S, E]
     if cfg.tie_word_embeddings:
         logits = final @ p["embed_tokens"]["embedding"].astype(jnp.float32).T
     else:

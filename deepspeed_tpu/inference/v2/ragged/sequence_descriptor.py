@@ -117,3 +117,12 @@ class DSSequenceDescriptor(BaseSequenceDescriptor):
         """Commit in-flight tokens to history."""
         self._seen_tokens += self._in_flight_tokens
         self._in_flight_tokens = 0
+
+    def rollback(self, n: int) -> None:
+        """Drop the last ``n`` tokens from history — speculative decode
+        rejected them. Their KV slots stay allocated and are overwritten
+        in place by the next accepted tokens at the same positions (slot =
+        f(position), so the scatter self-heals)."""
+        if not 0 <= n <= self._seen_tokens:
+            raise ValueError(f"rollback({n}) with seen={self._seen_tokens}")
+        self._seen_tokens -= n
