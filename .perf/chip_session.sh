@@ -92,6 +92,8 @@ run sparse_attn 1800 python .perf/sparse_probe.py 2048 4096 8192
 run bench_serving_int8 1200 env DS_BENCH_KV_INT8=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_INT8.json
 # 15b. prefix-caching prefill delta (shared-system-prompt workload)
 run bench_serving_prefix 1200 env DS_BENCH_PREFIX=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_PREFIX.json
+# 15c. speculative decode delta (prompt-lookup, repetitive workload)
+run bench_serving_spec 1200 env DS_BENCH_SPEC=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_SPEC.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -63,6 +63,10 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
     if env_flag("DS_BENCH_PREFIX"):
         results.extend(_measure_prefix_caching(cfg, contexts[0], kv_block,
                                                backends[0]))
+    # DS_BENCH_SPEC=1: prompt-lookup speculative decode on repetitive text
+    # (the regime it accelerates) vs plain greedy, same engine
+    if env_flag("DS_BENCH_SPEC"):
+        results.extend(_measure_speculative(cfg, kv_block, backends[0]))
     for backend in backends:
         max_ctx = max(contexts) + decode_steps + kv_block
         chunk = 2048
@@ -150,6 +154,37 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             for u in uids:
                 eng.flush(u)
     return results
+
+
+def _measure_speculative(cfg, kv_block, backend):
+    """Decode tok/s with and without prompt-lookup drafting on repetitive
+    text — memory-bound decode is where verify-K-in-one-pass pays."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    rng = np.random.default_rng(9)
+    motif = rng.integers(0, cfg.vocab_size, size=12).tolist()
+    prompt = (motif * 40)[:360]
+    new_tokens = 64
+    rows = []
+    eng = build_llama_engine(
+        cfg, engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=4 * ((len(prompt) + new_tokens) // kv_block + 4)),
+        kv_block_size=kv_block)
+    eng.model().attn_backend = backend
+    for spec in (None, "prompt_lookup"):
+        kw = dict(speculative=spec, num_draft_tokens=6) if spec else {}
+        eng.generate([prompt], max_new_tokens=8, **kw)   # warm compiles
+        t0 = time.perf_counter()
+        out = eng.generate([prompt], max_new_tokens=new_tokens, **kw)
+        dt = time.perf_counter() - t0
+        rows.append({"backend": backend, "speculative": bool(spec),
+                     "decode_tok_s": round(len(out[0]) / dt, 2)})
+    if rows[0]["decode_tok_s"] > 0:
+        rows[1]["speedup_vs_plain"] = round(
+            rows[1]["decode_tok_s"] / rows[0]["decode_tok_s"], 2)
+    return rows
 
 
 def _measure_prefix_caching(cfg, ctx, kv_block, backend):
