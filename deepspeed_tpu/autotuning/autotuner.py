@@ -91,6 +91,8 @@ def _build_exp_config(base_config: Dict[str, Any], cand: Dict[str, Any]
     cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
     if cand["remat"]:
         cfg["activation_checkpointing"] = {"remat_policy": "nothing_saveable"}
+    if cand.get("param_cast", "engine") != "engine":
+        cfg["param_cast"] = cand["param_cast"]
     return cfg
 
 
@@ -232,11 +234,20 @@ class Autotuner:
         return [0, 1, 2, 3]
 
     def experiment_space(self) -> List[Dict[str, Any]]:
+        # param_cast joins the space only when the model advertises use-site
+        # dtype handling (the flax convention): with "engine" excluded, old
+        # configs search the identical space as before
+        casts = (["engine", "model"]
+                 if self.cfg.tune_param_cast else [None])
         space = []
-        for mb, stage, remat in itertools.product(
-                self._micro_batch_candidates(), self._zero_candidates(), [False, True]):
-            space.append({"train_micro_batch_size_per_gpu": mb,
-                          "zero_stage": stage, "remat": remat})
+        for mb, stage, remat, cast in itertools.product(
+                self._micro_batch_candidates(), self._zero_candidates(),
+                [False, True], casts):
+            cand = {"train_micro_batch_size_per_gpu": mb,
+                    "zero_stage": stage, "remat": remat}
+            if cast is not None:
+                cand["param_cast"] = cast
+            space.append(cand)
         return space
 
     # ---- tuner orderings (reference tuner/{grid_search,random,model_based}) ----

@@ -24,6 +24,9 @@ class AutotuningConfig(ConfigModel):
     min_train_micro_batch_size_per_gpu: int = 1
     num_tuning_micro_batch_sizes: int = 3
     zero_stages: Optional[List[int]] = None  # restrict search space
+    # include engine param_cast ∈ {engine, model} in the search (only for
+    # models with use-site dtype handling — the flax `dtype=` convention)
+    tune_param_cast: bool = False
     # run each experiment in a spawned child process (reference
     # scheduler.py:32 isolates experiments so an OOM/abort of one candidate
     # cannot poison the rest of the search)

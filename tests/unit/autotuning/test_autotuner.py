@@ -242,3 +242,30 @@ def test_exp_isolation_kills_hung_child(tmp_path):
     assert _t.time() - t0 < 120  # 2 candidates x (spawn + 8s timeout + kill)
     assert best is None
     assert all(e.status == "error" and "exceeded" in e.error for e in at.exps)
+
+
+def test_param_cast_joins_search_space_when_enabled():
+    from deepspeed_tpu.autotuning.autotuner import Autotuner, _build_exp_config
+    from deepspeed_tpu.autotuning.config import AutotuningConfig
+
+    base = {"train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "autotuning": {"enabled": True, "tune_param_cast": True,
+                           "num_tuning_micro_batch_sizes": 1,
+                           "zero_stages": [0]}}
+    at = Autotuner(base)
+    space = at.experiment_space()
+    casts = {c.get("param_cast") for c in space}
+    assert casts == {"engine", "model"}
+    # candidate -> config mapping: "model" lands in the DS config, the
+    # default "engine" leaves the config untouched (no inert key)
+    model_cand = next(c for c in space if c["param_cast"] == "model")
+    eng_cand = next(c for c in space if c["param_cast"] == "engine")
+    assert _build_exp_config(base, model_cand)["param_cast"] == "model"
+    assert "param_cast" not in _build_exp_config(base, eng_cand)
+    # default config: space unchanged, no param_cast key anywhere
+    base2 = dict(base, autotuning={"enabled": True,
+                                   "num_tuning_micro_batch_sizes": 1,
+                                   "zero_stages": [0]})
+    at2 = Autotuner(base2)
+    assert all("param_cast" not in c for c in at2.experiment_space())
