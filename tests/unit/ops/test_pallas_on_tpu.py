@@ -107,3 +107,58 @@ def test_flash_attention_window_on_tpu():
     # atol covers TPU fp32 matmul default precision (bf16x3 passes): the XLA
     # reference and the kernel accumulate differently at ~1e-2 scale
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_splash_backward_kernels_compile_and_match():
+    """Round-5 sparse bwd: the dq and dk/dv Pallas kernels (forward +
+    transposed block tables, lse recompute) must Mosaic-lower and match
+    the dense VJP on silicon."""
+    from deepspeed_tpu.ops.sparse_attention import (splash_sparse_attention,
+                                                    sparse_attention,
+                                                    BigBirdSparsityConfig)
+    cfg = BigBirdSparsityConfig(num_heads=4, block=128, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 4, 1024, 64)), jnp.float32)
+               for _ in range(3))
+    lay = cfg.make_layout(1024)
+    g = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+    _, vjp_sparse = jax.vjp(
+        lambda q, k, v: splash_sparse_attention(q, k, v, lay, cfg.block),
+        q, k, v)
+    _, vjp_dense = jax.vjp(
+        lambda q, k, v: sparse_attention(q, k, v, lay, cfg.block,
+                                         use_kernel=False), q, k, v)
+    got = vjp_sparse(g)
+    ref = vjp_dense(g)
+    for a, b, name in zip(got, ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-3, err_msg=name)
+
+
+def test_paged_attention_int8_scales_compile_and_match():
+    """Round-5 int8 KV: the scales operand + in-kernel dequant must
+    Mosaic-lower; vs the fp reference on the same (dequantized) values."""
+    from deepspeed_tpu.ops.paged_attention import (paged_attention,
+                                                   paged_attention_reference)
+    rng = np.random.default_rng(6)
+    S, N, KV, G, D, page, nblocks = 2, 1, 4, 2, 64, 128, 6
+    q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.bfloat16)
+    kv_f = rng.normal(size=(1, 2, KV, nblocks * page, D)).astype(np.float32)
+    sc = np.maximum(np.abs(kv_f).max(-1) / 127.0, 1e-8)
+    kv_i8 = np.clip(np.round(kv_f / sc[..., None]), -127, 127).astype(np.int8)
+    cache = jnp.asarray(kv_i8)
+    scales = jnp.asarray(sc, jnp.float32)
+    bt = jnp.asarray(rng.permutation(nblocks)[None, :].repeat(S, 0), jnp.int32)
+    seen = jnp.asarray([300, 40], jnp.int32)
+    lens = seen + N
+    got = paged_attention(q, cache, 0, bt, seen, lens, page_size=page,
+                          cache_scales=scales)
+    ref = paged_attention_reference(
+        jnp.asarray(q, jnp.float32),
+        jnp.asarray(kv_i8.astype(np.float32) * sc[..., None]),
+        0, bt, seen, lens, page_size=page)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
