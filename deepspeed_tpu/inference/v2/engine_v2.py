@@ -10,7 +10,7 @@ bucket (see ragged_wrapper) and the KV cache is donated functional state.
 
 import os
 import pickle
-from typing import Iterable, List, Optional, Tuple
+from typing import Iterable, Optional, Tuple
 
 import numpy as np
 import jax
