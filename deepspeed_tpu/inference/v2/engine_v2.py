@@ -139,6 +139,36 @@ class InferenceEngineV2:
                 self._model.maybe_free_kv(seq)
         return logits
 
+    def score(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+              flush: bool = True):
+        """Teacher-forced log-probabilities (the MII/RLHF scoring surface):
+        for each NEW sequence, returns an array of length ``len(tokens)-1``
+        with ``log p(tokens[j+1] | tokens[:j+1])`` — one ragged forward via
+        window logits, no decode loop. ``flush=True`` releases the scoring
+        KV afterwards (set False to continue decoding from the scored
+        prefix with ``put``)."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, dtype=np.int32).reshape(-1)
+                        for t in batch_tokens]
+        for uid in batch_uids:
+            if self._state_manager.get_sequence(uid) is not None:
+                raise ValueError(
+                    f"score() expects NEW sequences (uid {uid} is live): "
+                    "the first fed token's score would need the previous "
+                    "step's logits")
+        logits = np.asarray(self.put(batch_uids, batch_tokens,
+                                     window_logits=True))
+        out = []
+        for i, toks in enumerate(batch_tokens):
+            rows = logits[i, :toks.size - 1].astype(np.float64)  # [T-1, V]
+            logz = np.log(np.exp(rows - rows.max(-1, keepdims=True))
+                          .sum(-1)) + rows.max(-1)
+            out.append(rows[np.arange(toks.size - 1), toks[1:]] - logz)
+        if flush:
+            for uid in batch_uids:
+                self.flush(uid)
+        return out
+
     def _register_pending(self, seq) -> None:
         """Register the sequence's newly completed full KV blocks with the
         prefix cache as a chain continuation — each block is hashed exactly

@@ -145,3 +145,40 @@ def test_triple_composition_int8_prefix_speculative():
     assert got == ref
     pc = combo._state_manager.prefix_cache
     assert len(pc) >= 3  # the shared prefix lives in the (quantized) cache
+
+
+def test_score_matches_teacher_forced_apply():
+    """engine.score() log-probs must equal the training model's full
+    teacher-forced forward (the exact oracle), and flush=False leaves the
+    prefix decodable."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, attn_impl="xla",
+                           dtype=jnp.float32)
+    model, params = init_llama(cfg, seed=51)
+    eng = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=64),
+        kv_block_size=16)
+    rng = np.random.default_rng(0)
+    toks = [rng.integers(0, 200, size=n).tolist() for n in (24, 17)]
+
+    got = eng.score([0, 1], toks, flush=False)
+
+    import jax
+    for i, t in enumerate(toks):
+        ids = jnp.asarray([t], jnp.int32)
+        logits = np.asarray(model.apply({"params": params}, ids),
+                            np.float64)[0]  # [T, V]
+        rows = logits[:-1]
+        logz = np.log(np.exp(rows - rows.max(-1, keepdims=True))
+                      .sum(-1)) + rows.max(-1)
+        ref = rows[np.arange(len(t) - 1), np.asarray(t[1:])] - logz
+        np.testing.assert_allclose(got[i], ref, rtol=1e-4, atol=1e-4)
+
+    # flush=False: the scored prefix keeps decoding
+    nxt = np.asarray(eng.put([0], [[toks[0][-1] % 200]]), np.float32)
+    assert np.isfinite(nxt).all()
+    eng.flush(0), eng.flush(1)
+    with pytest.raises(ValueError, match="NEW sequences"):
+        eng.put([5], [[1, 2, 3]])
+        eng.score([5], [[1, 2, 3]])
