@@ -195,5 +195,15 @@ class PrefixKVCache:
                 freed.append(e.block)
         return freed
 
+    def clear(self) -> List[int]:
+        """Drop every entry (weights changed — cached KV content is stale).
+        Returns the blocks the CACHE owned, for the caller to free; entries
+        whose computing sequence is still live are forgotten without
+        freeing (that sequence still owns its blocks)."""
+        owned = [e.block for e in self._entries.values() if e.owned]
+        self._entries.clear()
+        self._by_block.clear()
+        return owned
+
     def __len__(self):
         return len(self._entries)

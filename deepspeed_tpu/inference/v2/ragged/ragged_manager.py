@@ -120,6 +120,14 @@ class DSStateManager:
     def block_size(self) -> int:
         return self._kv_config.block_size
 
+    def reset_prefix_cache(self) -> None:
+        '''Invalidate all cached prefixes (the hybrid engine's weight swap:
+        KV content computed under old weights must never be adopted).'''
+        if self.prefix_cache is not None:
+            freed = self.prefix_cache.clear()
+            if freed:
+                self._allocator.free(freed)
+
     def allocate_blocks(self, n_blocks: int):
         if (self.prefix_cache is not None
                 and n_blocks > self._allocator.free_blocks):

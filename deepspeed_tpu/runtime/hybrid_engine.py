@@ -36,6 +36,11 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
         self._kv_block_size = hec.get("kv_block_size", 64)
         self._num_kv_blocks = hec.get("num_kv_blocks", 512)
         self._max_context = hec.get("max_out_tokens", 2048)
+        # RLHF rollouts re-prefill the same prompts many times per weight
+        # version (N samples per prompt): prefix caching pays the prompt
+        # prefill once. Cache entries are invalidated at every weight swap
+        # (stale-KV guard in _refresh_generation_engine).
+        self._he_prefix_caching = hec.get("prefix_caching", False)
 
     # ---- mode flips (reference eval()/train() container swaps) ----
 
@@ -77,11 +82,15 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
         if self._gen_engine is None:
             cfg = RaggedInferenceEngineConfig(
                 state_manager=DSStateManagerConfig(max_context=self._max_context),
-                num_kv_blocks=self._num_kv_blocks)
+                num_kv_blocks=self._num_kv_blocks,
+                enable_prefix_caching=self._he_prefix_caching)
             self._gen_engine = InferenceEngineV2(model, cfg)
         else:
             # keep the KV cache + state manager; swap the weights (this is
             # the in-place weight sharing the reference gets from containers)
+            # — but cached prefixes hold KV computed under the OLD weights:
+            # adopting them after a step would serve stale activations
+            self._gen_engine._state_manager.reset_prefix_cache()
             model.set_state_manager(self._gen_engine._state_manager)
             old = self._gen_engine._model
             if (old.attn_backend == model.attn_backend

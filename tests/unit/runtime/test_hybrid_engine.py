@@ -192,3 +192,58 @@ def test_hybrid_engine_other_families(family_cfg):
     out2 = eng.generate([[1, 5, 9]], max_new_tokens=4)
     assert len(out2[0]) == 3 + 4
     assert np.isfinite(float(loss))
+
+
+def test_hybrid_prefix_caching_reuses_and_invalidates():
+    """hybrid_engine.prefix_caching: repeated rollouts of the same prompt
+    within one weight version adopt cached prompt KV; a train step
+    invalidates the cache (stale-KV guard), and post-step greedy rollouts
+    match a cache-free hybrid engine exactly."""
+    reset_mesh_context()
+    model, params = init_llama(CFG, seed=0)
+    mk = lambda prefix: deepspeed_tpu.initialize(  # noqa: E731
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "hybrid_engine": {"enabled": True, "fp16": False,
+                                  "kv_block_size": 16, "num_kv_blocks": 64,
+                                  "max_out_tokens": 128,
+                                  "prefix_caching": prefix},
+                "steps_per_print": 1000},
+        llama_config=CFG)[0]
+    eng = mk(True)
+    prompt = list(range(1, 36))  # > 2 full blocks
+    eng.eval()
+    out1 = eng.generate([prompt], max_new_tokens=4)
+    pc = eng._gen_engine._state_manager.prefix_cache
+    assert pc is not None and len(pc) >= 2       # prompt blocks cached
+    out2 = eng.generate([prompt], max_new_tokens=4)
+    assert out2 == out1                          # adoption is exact
+
+    # train step -> weight swap must invalidate the cache
+    eng.train()
+    x, y = _batch(seed=9)
+    loss = eng.forward(x, labels=y)
+    eng.backward(loss)
+    eng.step()
+    eng.eval()
+    out3 = eng.generate([prompt], max_new_tokens=4)
+    assert len(pc) >= 2  # re-populated under the NEW weights
+
+    reset_mesh_context()
+    model2, params2 = init_llama(CFG, seed=0)
+    ref_eng = deepspeed_tpu.initialize(
+        model=model2, model_parameters=params2,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "hybrid_engine": {"enabled": True, "fp16": False,
+                                  "kv_block_size": 16, "num_kv_blocks": 64,
+                                  "max_out_tokens": 128},
+                "steps_per_print": 1000},
+        llama_config=CFG)[0]
+    loss2 = ref_eng.forward(x, labels=y)
+    ref_eng.backward(loss2)
+    ref_eng.step()
+    ref_eng.eval()
+    ref3 = ref_eng.generate([prompt], max_new_tokens=4)
+    assert out3 == ref3  # no stale-KV contamination after the swap
