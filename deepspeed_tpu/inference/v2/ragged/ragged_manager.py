@@ -121,12 +121,22 @@ class DSStateManager:
         return self._kv_config.block_size
 
     def reset_prefix_cache(self) -> None:
-        '''Invalidate all cached prefixes (the hybrid engine's weight swap:
-        KV content computed under old weights must never be adopted).'''
-        if self.prefix_cache is not None:
-            freed = self.prefix_cache.clear()
-            if freed:
-                self._allocator.free(freed)
+        """Invalidate all cached prefixes (the hybrid engine's weight swap:
+        KV content computed under old weights must never be adopted).
+
+        Live sequences are flushed FIRST: their entire KV history is
+        old-weight state too (continuing them post-swap would mix weights),
+        and flushing through the normal path settles every refcount and
+        chain bookkeeping — so clear() only ever frees blocks with no live
+        adopters, and no stale chain_key can re-register contaminated KV
+        into the fresh cache."""
+        if self.prefix_cache is None:
+            return
+        for uid in list(self._seqs):
+            self.flush_sequence(uid)
+        freed = self.prefix_cache.clear()
+        if freed:
+            self._allocator.free(freed)
 
     def allocate_blocks(self, n_blocks: int):
         if (self.prefix_cache is not None

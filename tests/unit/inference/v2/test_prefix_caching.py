@@ -221,3 +221,27 @@ def test_soak_block_conservation_under_churn():
     sm._allocator.free(freed)
     assert sm._allocator.free_blocks == total_blocks
     assert len(pc) == 0
+
+
+def test_reset_prefix_cache_flushes_live_adopters():
+    """Review repro: a sequence live across a weight swap (score(...,
+    flush=False), aborted generate) must not leave the allocator holding
+    freed-but-referenced blocks — reset flushes live sequences first, and
+    conservation holds."""
+    eng, cfg = _engine(prefix=True, num_blocks=32)
+    sm = eng._state_manager
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 200, size=2 * BS + 3).tolist()
+    eng.put([1], [prompt])
+    eng.flush(1)                      # blocks now cache-owned
+    eng.put([2], [prompt])            # live seq ADOPTS cached blocks
+    assert len(sm.get_sequence(2).adopted_blocks) == 2
+
+    sm.reset_prefix_cache()
+    assert sm.get_sequence(2) is None          # live adopter flushed
+    assert len(sm.prefix_cache) == 0
+    assert sm._allocator.free_blocks == 32     # full conservation, no leak
+    # fresh sequences re-register cleanly under the "new weights"
+    eng.put([3], [prompt])
+    eng.flush(3)
+    assert len(sm.prefix_cache) == 2
