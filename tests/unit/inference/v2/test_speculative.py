@@ -182,3 +182,29 @@ def test_score_matches_teacher_forced_apply():
     with pytest.raises(ValueError, match="NEW sequences"):
         eng.put([5], [[1, 2, 3]])
         eng.score([5], [[1, 2, 3]])
+
+
+def test_speculative_staggered_batch_matches_plain():
+    """8 prompts through a max_seqs-limited engine: admission waves,
+    retirements and batched draft/verify steps together must still be
+    greedy-exact vs the plain path."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=61)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    ec = RaggedInferenceEngineConfig(
+        num_kv_blocks=256,
+        state_manager=DSStateManagerConfig(max_ragged_sequence_count=3))
+    mk = lambda: build_llama_engine(cfg, params=params, dtype=jnp.float32,  # noqa: E731
+                                    engine_config=ec, kv_block_size=16)
+    rng = np.random.default_rng(6)
+    prompts = []
+    for i in range(8):
+        if i % 2 == 0:
+            prompts.append(_repetitive_prompt(rng, n=30 + i))
+        else:
+            prompts.append(rng.integers(0, 200, size=12 + i).tolist())
+    ref = mk().generate(prompts, max_new_tokens=7)
+    got = mk().generate(prompts, max_new_tokens=7,
+                        speculative="prompt_lookup", num_draft_tokens=3)
+    assert got == ref
