@@ -325,7 +325,8 @@ class InferenceEngineV2:
                  seed: int = 0,
                  speculative: Optional[str] = None,
                  num_draft_tokens: int = 4,
-                 draft_ngram: int = 2):
+                 draft_ngram: int = 2,
+                 num_return_sequences: int = 1):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
         sequence in ONE ragged batch per step (the N=1 fast path), free KV on
@@ -355,6 +356,29 @@ class InferenceEngineV2:
                 raise ValueError("speculative decoding is greedy-only "
                                  "(temperature=0, no logprobs)")
         rng = np.random.default_rng(seed)
+        if num_return_sequences > 1:
+            # parallel sampling (MII n-sampling): N samples per prompt,
+            # flattened [p0_s0, p0_s1, ..., p1_s0, ...]. With prefix caching
+            # on, each unique prompt's prefill is computed ONCE up front and
+            # every sample adopts the cached blocks.
+            pc0 = self._state_manager.prefix_cache
+            if pc0 is not None:
+                scratch = 1 << 27
+                seen_prompts = set()
+                for p in prompts:
+                    arr = np.asarray(p, np.int32).reshape(-1)
+                    key = arr.tobytes()
+                    if (key in seen_prompts
+                            or arr.size <= self._state_manager.block_size):
+                        continue
+                    seen_prompts.add(key)
+                    try:
+                        self.put([scratch], [arr], do_checks=False)
+                    except SchedulingError:
+                        break  # cache full; samples just recompute
+                    self.flush(scratch)  # blocks stay cached for adoption
+                    scratch += 1
+            prompts = [p for p in prompts for _ in range(num_return_sequences)]
         prompts = [list(map(int, np.asarray(p).reshape(-1))) for p in prompts]
         uids = list(range(len(prompts)))
         outputs = {u: [] for u in uids}

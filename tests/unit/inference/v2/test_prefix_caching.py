@@ -245,3 +245,31 @@ def test_reset_prefix_cache_flushes_live_adopters():
     eng.put([3], [prompt])
     eng.flush(3)
     assert len(sm.prefix_cache) == 2
+
+
+def test_num_return_sequences_parallel_sampling():
+    """N samples per prompt: flattened [p0_s0.., p1_s0..] order; with
+    prefix caching the prompt prefill is computed once and every sample
+    adopts it; deterministic by seed; greedy N>1 collapses to N copies."""
+    eng, cfg = _engine(prefix=True, num_blocks=128)
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 200, size=2 * BS + 4).tolist(),
+               rng.integers(0, 200, size=BS + 7).tolist()]
+
+    outs = eng.generate(prompts, max_new_tokens=5, temperature=1.0,
+                        num_return_sequences=3, seed=4)
+    assert len(outs) == 6 and all(len(o) == 5 for o in outs)
+    # prompt 0's prefill was cached once; samples adopted (cache populated)
+    pc = eng._state_manager.prefix_cache
+    assert len(pc) >= 2
+    # sampling actually diversifies (3 samples of prompt 0 not all equal)
+    assert len({tuple(o) for o in outs[:3]}) > 1
+    # deterministic by seed
+    outs2 = eng.generate(prompts, max_new_tokens=5, temperature=1.0,
+                         num_return_sequences=3, seed=4)
+    assert outs2 == outs
+    # greedy N>1: N identical samples, equal to N=1 greedy
+    g1 = eng.generate([prompts[0]], max_new_tokens=4)
+    g3 = eng.generate([prompts[0]], max_new_tokens=4,
+                      num_return_sequences=3)
+    assert g3 == [g1[0]] * 3
