@@ -61,7 +61,8 @@ class InferenceEngineV2:
     # ---- serving (reference :107 put) ----
 
     def put(self, batch_uids: Iterable[int], batch_tokens: Iterable, do_checks: bool = True,
-            window_logits: bool = False, defer_register=frozenset()):
+            window_logits: bool = False, defer_register=frozenset(),
+            adopt_prefix: bool = True):
         """One ragged forward; returns logits [n_seqs_padded, vocab] — row i is
         the next-token distribution for batch_uids[i].
 
@@ -86,7 +87,7 @@ class InferenceEngineV2:
         self._batch.clear()
         for i, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
             host_seq_desc = self._state_manager.get_sequence(uid)
-            if (pc is not None and host_seq_desc is None
+            if (pc is not None and adopt_prefix and host_seq_desc is None
                     and tokens.size > self._state_manager.block_size):
                 # NEW sequence: adopt the longest cached full-block prefix —
                 # its KV already exists, so only the suffix is fed/computed.
@@ -156,8 +157,10 @@ class InferenceEngineV2:
                     f"score() expects NEW sequences (uid {uid} is live): "
                     "the first fed token's score would need the previous "
                     "step's logits")
+        # adoption would skip prefill for cached prefixes — but scoring
+        # needs logits at EVERY position, so every token must be fed
         logits = np.asarray(self.put(batch_uids, batch_tokens,
-                                     window_logits=True))
+                                     window_logits=True, adopt_prefix=False))
         out = []
         for i, toks in enumerate(batch_tokens):
             rows = logits[i, :toks.size - 1].astype(np.float64)  # [T-1, V]

@@ -208,3 +208,25 @@ def test_speculative_staggered_batch_matches_plain():
     got = mk().generate(prompts, max_new_tokens=7,
                         speculative="prompt_lookup", num_draft_tokens=3)
     assert got == ref
+
+
+def test_score_with_prefix_caching_enabled():
+    """Regression (found by the serving demo): score() must feed EVERY
+    token even when the prompt's prefix is cached — adoption would leave
+    window logits covering only the suffix."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, dtype=jnp.float32)
+    _, params = init_llama(cfg, seed=71)
+    eng = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32,
+        engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=64, enable_prefix_caching=True),
+        kv_block_size=16)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 200, size=33).tolist()
+    ref = eng.score([0], [prompt])[0]        # cold: nothing cached yet
+    eng.put([1], [prompt])
+    eng.flush(1)                             # prompt now cached
+    got = eng.score([2], [prompt])[0]        # must NOT adopt
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert len(got) == 32
