@@ -248,8 +248,13 @@ class InferenceEngineV2:
         base = 1 << 28  # scratch uid space clear of real uids
         for n in prefill_lens:
             uid = base
-            self.put([uid], [np.zeros(int(n), np.int32)], do_checks=False)
-            self.put([uid], [[0]])  # decode continuation bucket
+            # adopt_prefix=False + defer_register: warmup must neither adopt
+            # cached blocks (an earlier warmup prefill would shrink this
+            # bucket's fed-token count, leaving the real bucket uncompiled)
+            # nor pollute the prefix cache with zero-token entries
+            self.put([uid], [np.zeros(int(n), np.int32)], do_checks=False,
+                     adopt_prefix=False, defer_register={uid})
+            self.put([uid], [[0]], defer_register={uid})  # decode bucket
             if draft_tokens:
                 self.put([uid], [[0] * (1 + draft_tokens)],
                          window_logits=True, defer_register={uid})
@@ -258,9 +263,12 @@ class InferenceEngineV2:
             self.flush(uid)
         for bs in batch_sizes:
             uids = list(range(base + 1, base + 1 + bs))
+            scratch = frozenset(uids)
             for u in uids:
-                self.put([u], [[0]])
-            self.put(uids, [[0]] * bs)  # batched decode bucket
+                self.put([u], [[0]], adopt_prefix=False,
+                         defer_register=scratch)
+            self.put(uids, [[0]] * bs,  # batched decode bucket
+                     defer_register=scratch)
             for u in uids:
                 self.flush(u)
         return len(self._model._fwd_cache)

@@ -90,11 +90,11 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         if has_scales:
             # int8 KV: dequantize the page in-registers (per-slot-vector
             # scales) before the MXU dots — the cache rides HBM at 1
-            # byte/element, the compute stays bf16
-            k = k.astype(jnp.bfloat16) * scales_ref[0, 0, 0].astype(
-                jnp.bfloat16)[:, None]
-            v = v.astype(jnp.bfloat16) * scales_ref[0, 1, 0].astype(
-                jnp.bfloat16)[:, None]
+            # byte/element, the compute stays bf16. Scale blocks are
+            # [page, 1] (trailing singleton keeps the spec Mosaic-legal)
+            # and broadcast over head_dim.
+            k = k.astype(jnp.bfloat16) * scales_ref[0, 0, 0].astype(jnp.bfloat16)
+            v = v.astype(jnp.bfloat16) * scales_ref[0, 1, 0].astype(jnp.bfloat16)
 
         scores = jax.lax.dot_general(
             q, k, (((1, ), (1, )), ((), ())),
@@ -118,7 +118,7 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
             s3 = scores.reshape(n, g, page_size)
             kp3 = b * page_size + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 2)
             qa3 = seen + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
-            bias = slopes_ref[0][None, :, None] * (kp3 - qa3).astype(jnp.float32)
+            bias = slopes_ref[0, 0][None, :, None] * (kp3 - qa3).astype(jnp.float32)
             scores = (s3 + bias).reshape(ng, page_size)
 
         m_prev = m_scr[...]
@@ -199,22 +199,27 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     inputs = [q, cache]
     has_scales = cache_scales is not None
     if has_scales:
-        # scales page rides the same page lookup as its kv page (4-dim:
-        # [L, 2, KV, slots] — no head_dim axis)
+        # scales page rides the same page lookup as its kv page. The caller
+        # passes [L, 2, KV, slots]; a trailing singleton is added so the
+        # block's last two dims (page_size, 1) are Mosaic-lowerable
+        # (sublane mult-of-8 / lane equal-to-array-dim).
         def scales_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
             needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
             page = bt_r[s, jax.lax.min(b, needed - 1)]
-            return (layer_r[0], 0, k, page)
+            return (layer_r[0], 0, k, page, 0)
 
-        in_specs.append(pl.BlockSpec((1, 2, 1, page_size), scales_map))
-        inputs.append(cache_scales)
+        in_specs.append(pl.BlockSpec((1, 2, 1, page_size, 1), scales_map))
+        inputs.append(cache_scales[..., None])
     has_alibi = use_alibi or slopes is not None
     if has_alibi:
         if slopes is None:
             from ..models.llama import alibi_slopes
             slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
-        in_specs.append(pl.BlockSpec((1, G), lambda s, k, b, *_: (k, 0)))
-        inputs.append(slopes.astype(jnp.float32))
+        # [KV, 1, G] with block (1, 1, G): last two block dims equal the
+        # array dims, which Mosaic lowers for any G (a 2-D (1, G) spec over
+        # [KV, G] has an illegal sublane-1 block when KV > 1)
+        in_specs.append(pl.BlockSpec((1, 1, G), lambda s, k, b, *_: (k, 0, 0)))
+        inputs.append(slopes.astype(jnp.float32).reshape(KV, 1, G))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,

@@ -106,9 +106,12 @@ def _splash_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, o_ref, *rest,
         o_ref[0] = (acc[:] / safe_l[:, None]).astype(o_ref.dtype)
         if with_lse:
             # +BIG for empty rows so backward's exp(s - lse) underflows to
-            # exactly 0 (their grads must be 0, not NaN)
+            # exactly 0 (their grads must be 0, not NaN). lse rides a
+            # [BH, S, 1] array: Mosaic requires the last two block dims be
+            # (mult-of-8, mult-of-128) or equal to the array dims — a 2-D
+            # (1, block) spec over [BH, S] is unlowerable.
             lse_ref[0] = jnp.where(l == 0.0, -NEG_INF,
-                                   m_s[:, 0] + jnp.log(safe_l))
+                                   m_s[:, 0] + jnp.log(safe_l))[:, None]
 
 
 def _splash_fwd(q, k, v, table, counts, block, scale, interpret,
@@ -133,9 +136,9 @@ def _splash_fwd(q, k, v, table, counts, block, scale, interpret,
     out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B * H, S, D), q.dtype)]
     if with_lse:
-        out_specs.append(pl.BlockSpec((1, block),
-                                      lambda b, qi, ai, tbl, cnt: (b, qi)))
-        out_shape.append(jax.ShapeDtypeStruct((B * H, S), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block, 1),
+                                      lambda b, qi, ai, tbl, cnt: (b, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * H, nb, A),
@@ -182,10 +185,10 @@ def _splash_dq_kernel(table_ref, count_ref, q_ref, k_ref, v_ref, do_ref,
         do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0])           # lse block is [block, 1]
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale  # delta block is [block, 1]
         acc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
                                       (((1, ), (0, )), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -219,13 +222,13 @@ def _splash_dkv_kernel(tableT_ref, countT_ref, q_ref, k_ref, v_ref, do_ref,
         do = do_ref[0]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse_ref[0][:, None])          # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]; lse [bq, 1]
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
                                          (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale  # [bq, bk]
+        ds = p * (dp - delta_ref[0]) * scale           # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
                                          (((0, ), (0, )), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -246,16 +249,17 @@ def _splash_bwd(q, k, v, o, lse, g, table, counts, tableT, countsT,
     nb = S // block
     qf, kf, vf = (t.reshape(BH, S, D) for t in (q, k, v))
     dof = g.reshape(BH, S, D)
+    # [BH, S, 1]: row-wise scalars ride a trailing singleton so their block
+    # spec's last two dims (block, 1) are Mosaic-legal
     delta = (dof.astype(jnp.float32)
-             * o.reshape(BH, S, D).astype(jnp.float32)).sum(-1)  # [BH, S]
+             * o.reshape(BH, S, D).astype(jnp.float32)).sum(-1, keepdims=True)
 
     nheads_layout = table.shape[0]
     q_at = lambda b, i, ai, tbl, cnt: (b, i, 0)
-    row_at = lambda b, i, ai, tbl, cnt: (b, i)
+    row_at = q_at
     tbl_at = lambda b, i, ai, tbl, cnt: (
         b, tbl[jax.lax.rem(b, tbl.shape[0]), i, ai], 0)
-    tbl_row_at = lambda b, i, ai, tbl, cnt: (
-        b, tbl[jax.lax.rem(b, tbl.shape[0]), i, ai])
+    tbl_row_at = tbl_at
 
     # ---- dq: grid (BH, q_block, active-k) ----
     A = table.shape[-1]
@@ -270,8 +274,8 @@ def _splash_bwd(q, k, v, o, lse, g, table, counts, tableT, countsT,
                 pl.BlockSpec((1, block, D), tbl_at),    # k
                 pl.BlockSpec((1, block, D), tbl_at),    # v
                 pl.BlockSpec((1, block, D), q_at),      # do
-                pl.BlockSpec((1, block), row_at),       # lse
-                pl.BlockSpec((1, block), row_at),       # delta
+                pl.BlockSpec((1, block, 1), row_at),    # lse
+                pl.BlockSpec((1, block, 1), row_at),    # delta
             ],
             out_specs=pl.BlockSpec((1, block, D), q_at),
             scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
@@ -293,8 +297,8 @@ def _splash_bwd(q, k, v, o, lse, g, table, counts, tableT, countsT,
                 pl.BlockSpec((1, block, D), q_at),      # k (this k-block)
                 pl.BlockSpec((1, block, D), q_at),      # v
                 pl.BlockSpec((1, block, D), tbl_at),    # do
-                pl.BlockSpec((1, block), tbl_row_at),   # lse (per q row)
-                pl.BlockSpec((1, block), tbl_row_at),   # delta
+                pl.BlockSpec((1, block, 1), tbl_row_at),  # lse (per q row)
+                pl.BlockSpec((1, block, 1), tbl_row_at),  # delta
             ],
             out_specs=[pl.BlockSpec((1, block, D), q_at),
                        pl.BlockSpec((1, block, D), q_at)],

@@ -273,3 +273,18 @@ def test_num_return_sequences_parallel_sampling():
     g3 = eng.generate([prompts[0]], max_new_tokens=4,
                       num_return_sequences=3)
     assert g3 == [g1[0]] * 3
+
+
+def test_warmup_bypasses_prefix_cache():
+    """warmup() must neither adopt cached blocks (a later warmup prefill
+    would shrink to an already-compiled bucket, leaving the real bucket
+    uncompiled) nor register its zero-token scratch blocks in the cache."""
+    eng, _ = _engine(prefix=True, num_blocks=128)
+    compiled = eng.warmup(prefill_lens=(BS, 2 * BS + 4))
+    pc = eng._state_manager.prefix_cache
+    assert len(pc) == 0, "warmup polluted the prefix cache"
+    # both prefill buckets really compiled: a second warmup adds nothing
+    assert eng.warmup(prefill_lens=(BS, 2 * BS + 4)) == compiled
+    # and had warmup adopted, the fed counts would have collapsed: the
+    # distinct-bucket count must cover both prefill lengths + decode
+    assert compiled >= 3
