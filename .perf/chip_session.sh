@@ -18,6 +18,18 @@ touch "$P/.session_start"  # mtime marker: snapshot only THIS session's files
 
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
+  # fast relay guard: the tunnel port closing mid-suite means every later
+  # step would hang to its full timeout on a dead relay (8/1 window: 3
+  # probe hangs burned 24 min after a 6-min window). Abort the suite —
+  # the watcher loops and reruns everything on the next window.
+  # DS_SESSION_NO_RELAY_GUARD=1 skips the check (the dry-run harness test
+  # has no relay to be up).
+  if [ -z "$DS_SESSION_NO_RELAY_GUARD" ] \
+     && ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
+    echo "RELAY DOWN before $name — aborting session $(date -u +%T)" >> $LOG
+    snapshot
+    exit 3
+  fi
   echo "== $name $(date -u +%T)" >> $LOG
   timeout "$to" "$@" > "$P/${name}_r5_${SFX}.out" 2>&1
   echo "$name rc=$?" >> $LOG

@@ -106,6 +106,13 @@ def init_inference(model=None, config=None, params=None, **kwargs):
     return InferenceEngine(model, config=config, params=params)
 
 
+def pipeline(model_dir, **kwargs):
+    """Text-generation pipeline from a HF checkpoint dir (the MII
+    ``mii.pipeline`` surface; see ``inference.v2.pipeline``)."""
+    from .inference.v2.pipeline import pipeline as _pipeline
+    return _pipeline(model_dir, **kwargs)
+
+
 def add_config_arguments(parser):
     """Reference ``deepspeed/__init__.py:268`` argparse passthrough."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -7,4 +7,6 @@ Dynamic SplitFuse scheduling semantics (``can_schedule``/``query``).
 
 from .config_v2 import RaggedInferenceEngineConfig, DSStateManagerConfig, KVCacheConfig
 from .scheduling_utils import SchedulingResult, SchedulingError
-from .engine_v2 import InferenceEngineV2, build_llama_engine
+from .engine_v2 import InferenceEngineV2, build_llama_engine, load_engine
+from .server import ServingScheduler, RequestHandle, serve
+from .pipeline import InferencePipeline, pipeline

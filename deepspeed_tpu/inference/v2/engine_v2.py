@@ -627,6 +627,18 @@ class InferenceEngineV2:
             pickle.dump({"treedef": treedef, "config": self._model.config}, f)
 
 
+def load_engine(save_path: str, **engine_kwargs) -> "InferenceEngineV2":
+    """Rebuild a serving engine from an ``InferenceEngineV2.serialize`` dir
+    (params.npz + metadata.pkl). ``engine_kwargs`` forward to
+    :func:`build_llama_engine` (engine_config, kv_cache_dtype, ...)."""
+    with open(os.path.join(save_path, "metadata.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    with np.load(os.path.join(save_path, "params.npz")) as z:
+        flat = [z[str(i)] for i in range(len(z.files))]
+    params = jax.tree_util.tree_unflatten(meta["treedef"], flat)
+    return build_llama_engine(meta["config"], params=params, **engine_kwargs)
+
+
 def build_llama_engine(config: Optional[LlamaConfig] = None,
                        params=None,
                        engine_config: Optional[RaggedInferenceEngineConfig] = None,

@@ -1,0 +1,105 @@
+"""``pipeline()`` — text-in/text-out convenience over the v2 engine.
+
+The MII surface the reference ecosystem deploys FastGen through
+(``mii.pipeline("model-name")`` → callable): here it composes the in-tree
+pieces — ``module_inject.convert_hf_safetensors`` (streaming HF checkpoint
+conversion, arch auto-detected from ``config.json``'s ``model_type``),
+``build_llama_engine`` (ragged serving engine; reference
+``engine_factory.py build_hf_engine``), and an optional HF tokenizer — into
+one call. Token-id prompts work without a tokenizer; text prompts need one.
+"""
+
+import json
+import os
+from typing import List, Optional, Sequence, Union
+
+from .config_v2 import RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2, build_llama_engine
+
+
+class InferencePipeline:
+    """Callable bundle of a serving engine + (optional) tokenizer."""
+
+    def __init__(self, engine: InferenceEngineV2, tokenizer=None):
+        self.engine = engine
+        self.tokenizer = tokenizer
+
+    def __call__(self, prompts: Union[str, Sequence],
+                 max_new_tokens: int = 64, **gen_kwargs):
+        """Generate for one prompt or a batch. Strings are tokenized (and
+        the outputs detokenized); token-id lists pass through as ids."""
+        import numpy as np
+        single = isinstance(prompts, str) or (
+            len(prompts) > 0 and isinstance(prompts[0], (int, np.integer)))
+        batch = [prompts] if single else list(prompts)
+        text_in = any(isinstance(p, str) for p in batch)
+        if text_in:
+            if self.tokenizer is None:
+                raise ValueError("text prompts need a tokenizer; pass "
+                                 "tokenizer= to pipeline() or use token ids")
+            batch = [self.tokenizer.encode(p) if isinstance(p, str) else p
+                     for p in batch]
+        if self.tokenizer is not None and gen_kwargs.get(
+                "eos_token_id", None) is None:
+            eos = getattr(self.tokenizer, "eos_token_id", None)
+            if eos is not None:
+                gen_kwargs["eos_token_id"] = eos
+        outs = self.engine.generate(batch, max_new_tokens=max_new_tokens,
+                                    **gen_kwargs)
+        if text_in:
+            outs = [self.tokenizer.decode(o) for o in outs]
+        return outs[0] if single else outs
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8000,
+              block: bool = True):
+        """Lift this pipeline into the HTTP serving daemon (mii.serve)."""
+        from .server import serve
+        return serve(self.engine, host, port, self.tokenizer, block=block)
+
+
+def pipeline(model_dir: str,
+             arch: Optional[str] = None,
+             engine_config: Optional[RaggedInferenceEngineConfig] = None,
+             dtype=None,
+             tokenizer: Union[None, str, object] = "auto",
+             **engine_kwargs) -> InferencePipeline:
+    """Build a text-generation pipeline from a HF checkpoint directory.
+
+    Args:
+      model_dir: directory with ``config.json`` + ``*.safetensors`` shards.
+      arch: injection-policy name; default = ``config.json``'s
+        ``model_type`` (reference replace_policy auto-selection).
+      tokenizer: "auto" loads from model_dir via transformers when
+        available (silently none if not), None disables, or pass a
+        ready tokenizer object / name.
+      engine_kwargs: forwarded to ``build_llama_engine`` (quantize,
+        kv_cache_dtype, kv_block_size, ...).
+    """
+    import jax.numpy as jnp
+
+    from ...module_inject import convert_hf_safetensors
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf_config = json.load(f)
+    arch = arch or hf_config.get("model_type")
+    if not arch:
+        raise ValueError("config.json has no model_type; pass arch=")
+    cfg, params = convert_hf_safetensors(arch, model_dir, hf_config,
+                                         dtype=dtype or jnp.bfloat16)
+    engine = build_llama_engine(cfg, params=params,
+                                engine_config=engine_config,
+                                dtype=dtype, **engine_kwargs)
+
+    tok = None
+    if tokenizer == "auto":
+        try:
+            from transformers import AutoTokenizer
+            tok = AutoTokenizer.from_pretrained(model_dir)
+        except Exception:  # noqa: BLE001 — tokenizer files optional
+            tok = None
+    elif isinstance(tokenizer, str):
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(tokenizer)
+    else:
+        tok = tokenizer
+    return InferencePipeline(engine, tok)

@@ -1,0 +1,500 @@
+"""Serving front end over ``InferenceEngineV2`` — the MII surface.
+
+The reference ships FastGen behind DeepSpeed-MII (``mii.serve`` spawns a
+persistent server whose scheduler drives ``engine_v2.put()`` continuously;
+reference ``inference/v2/engine_v2.py:107`` is the documented integration
+point for exactly this loop). This module is that missing deployment layer,
+TPU-native and stdlib-only:
+
+- :class:`ServingScheduler` — a background thread running TRUE continuous
+  batching: requests arrive and retire asynchronously, every iteration runs
+  (at most) one ragged prefill ``put`` for newly admitted prompts and one
+  ragged decode ``put`` for all live sequences, tokens stream to each
+  caller the moment they are sampled. Admission reserves full decode
+  headroom (prompt + max_new_tokens blocks) exactly like
+  ``InferenceEngineV2.generate`` so a decode step cannot run the allocator
+  dry; if it still does (best-effort admission), the newest sequence is
+  evicted and replayed.
+- :class:`RequestHandle` — caller's side of one request: ``stream()``
+  yields token ids as they land, ``result()`` blocks for the full output,
+  ``cancel()`` retires the sequence at the next scheduler tick.
+- :func:`create_http_server` / ``bin/ds_serve`` — a ThreadingHTTPServer
+  exposing ``POST /generate`` (optionally chunk-streamed) and
+  ``GET /health``. Token-id native; pass a HF tokenizer name to accept
+  ``{"text": ...}`` bodies.
+
+Single-threaded device access: ONLY the scheduler thread touches the
+engine. ``submit``/``cancel`` just enqueue under a lock and set an event,
+so arbitrarily many HTTP threads are safe.
+"""
+
+import itertools
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from .engine_v2 import InferenceEngineV2
+from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
+from .scheduling_utils import SchedulingError, SchedulingResult
+
+_END = object()  # stream sentinel
+
+
+@dataclass
+class _Request:
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+    # scheduler state
+    outputs: List[int] = field(default_factory=list)
+    stream_q: "queue.Queue" = field(default_factory=queue.Queue)
+    done: "threading.Event" = field(default_factory=threading.Event)
+    cancelled: bool = False
+    error: Optional[BaseException] = None
+    rng: Optional[np.random.Generator] = None
+
+    @property
+    def feed(self) -> List[int]:
+        """Tokens to prefill on (re)admission: prompt, or prompt + generated
+        so far after an eviction replay."""
+        return self.prompt + self.outputs
+
+
+class RequestHandle:
+    """Caller's view of one in-flight generation."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    @property
+    def uid(self) -> int:
+        return self._req.uid
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token ids as the scheduler produces them."""
+        while True:
+            tok = self._req.stream_q.get(timeout=timeout)
+            if tok is _END:
+                if self._req.error is not None:
+                    raise self._req.error
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until generation finishes; returns all generated tokens."""
+        if not self._req.done.wait(timeout):
+            raise TimeoutError(f"request {self._req.uid} still running")
+        if self._req.error is not None:
+            raise self._req.error
+        return list(self._req.outputs)
+
+    def cancel(self) -> None:
+        self._req.cancelled = True
+
+    @property
+    def finished(self) -> bool:
+        return self._req.done.is_set()
+
+
+class ServingScheduler:
+    """Continuous-batching serving loop over one ``InferenceEngineV2``."""
+
+    def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05):
+        self._engine = engine
+        self._idle_wait = idle_wait
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._inbox: List[_Request] = []
+        self._waiting: List[_Request] = []
+        self._live: List[_Request] = []
+        self._uid_iter = itertools.count(1)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        sm = engine._config.state_manager
+        self._max_batch_tokens = sm.max_ragged_batch_size
+        self._max_seqs = min(sm.max_ragged_sequence_count,
+                             self._max_batch_tokens)
+        self._max_context = sm.max_context
+
+    # ---- client surface (any thread) ----
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token_id: Optional[int] = None,
+               seed: int = 0) -> RequestHandle:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self._max_context:
+            raise SchedulingError(SchedulingResult.SequenceTokenLimitExceeded)
+        req = _Request(uid=next(self._uid_iter), prompt=prompt,
+                       max_new_tokens=int(max_new_tokens),
+                       temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p), eos_token_id=eos_token_id,
+                       seed=int(seed))
+        req.rng = np.random.default_rng(req.seed)
+        with self._lock:
+            # the lock orders this against stop()'s drain: a submit that
+            # loses the race lands AFTER _stopping is visible and is
+            # rejected here rather than queued for a loop that never runs
+            if self._stopping:
+                raise RuntimeError("scheduler is stopped")
+            self._inbox.append(req)
+        self._wake.set()
+        return RequestHandle(req)
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            inbox = len(self._inbox)
+        return {"waiting": len(self._waiting) + inbox,
+                "live": len(self._live),
+                "free_blocks": self._engine.free_blocks,
+                "stopped": self._stopping}
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ServingScheduler":
+        assert self._thread is None, "scheduler already started"
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run, name="ds-serve",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stopping = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        crash: Optional[BaseException] = None
+        try:
+            while not self._stopping:
+                progressed = self.step()
+                if not progressed:
+                    self._wake.wait(self._idle_wait)
+                    self._wake.clear()
+        except BaseException as e:  # noqa: BLE001 — loop death must not
+            crash = e               # silently hang every blocked caller
+        finally:
+            self._stopping = True
+            # drain UNDER the lock: submit() rejects once _stopping is
+            # visible, so nothing can land in the inbox after this snapshot
+            with self._lock:
+                pending = self._live + self._waiting + self._inbox
+                self._live, self._waiting, self._inbox = [], [], []
+            for req in pending:
+                if not req.done.is_set():
+                    try:
+                        self._engine.flush(req.uid)
+                    except Exception:  # noqa: BLE001 — uid may be unknown
+                        pass
+                    req.error = crash or RuntimeError("server stopped")
+                    self._finish(req, flush=False)
+        if crash is not None:
+            raise crash
+
+    # ---- scheduler iteration (scheduler thread only) ----
+
+    def step(self) -> bool:
+        """One continuous-batching iteration: admit + prefill newly feasible
+        prompts, advance every live sequence one decode token. Returns
+        whether any work happened (False = fully idle)."""
+        with self._lock:
+            if self._inbox:
+                self._waiting.extend(self._inbox)
+                self._inbox = []
+
+        for req in [r for r in self._live if r.cancelled]:
+            self._live.remove(req)
+            self._finish(req)
+        for req in [r for r in self._waiting if r.cancelled]:
+            self._waiting.remove(req)
+            self._finish(req, flush=False)
+
+        admitted = self._admit()
+        decoded = self._decode_tick()
+        return bool(admitted or decoded)
+
+    # Admission MIRRORS InferenceEngineV2.generate (engine_v2.py, the
+    # admission loop): reserve blocks for the full decode budget of every
+    # admitted AND live sequence so the decode put cannot exhaust the
+    # allocator mid-flight. KEEP IN LOCKSTEP: an admission-edge fix in
+    # either place applies to both (test_scheduler_matches_generate_greedy
+    # pins the happy path; the edges are mirrored by hand). One deliberate
+    # difference: max_context is enforced at submit() (and replay feeds
+    # stay bounded because sequences retire at seen+1 > max_context), so
+    # generate()'s in-loop max_context raise has no counterpart here.
+    def _future_blocks(self, seq_desc, extra: int) -> int:
+        _, req = self._engine._model.get_kv_requirements(seq_desc, extra,
+                                                         1 << 30)
+        return req
+
+    def _live_reserve(self) -> int:
+        return sum(
+            self._future_blocks(
+                self._engine._state_manager.get_sequence(r.uid),
+                max(0, r.max_new_tokens - len(r.outputs)))
+            for r in self._live)
+
+    def _admit(self) -> List[_Request]:
+        free = self._engine.free_blocks - self._live_reserve()
+        admit: List[_Request] = []
+        admit_blocks = 0
+        for req in list(self._waiting):
+            if len(self._live) + len(admit) >= self._max_seqs:
+                break
+            need = self._future_blocks(
+                PlaceholderSequenceDescriptor(),
+                len(req.feed) + max(0, req.max_new_tokens - len(req.outputs)))
+            if len(req.feed) > self._max_batch_tokens:
+                # long prompt: solo chunked prefill (Dynamic SplitFuse)
+                if admit or need > free or self._live:
+                    break
+                self._waiting.remove(req)
+                self._prefill_chunked(req)
+                return [req]
+            trial = admit + [req]
+            if self._engine.can_schedule(
+                    [r.uid for r in trial],
+                    [len(r.feed) for r in trial]) != SchedulingResult.Success:
+                break
+            if admit_blocks + need > free:
+                break
+            admit.append(req)
+            admit_blocks += need
+            self._waiting.remove(req)
+        if not admit and not self._live and self._waiting:
+            # nothing can reserve full headroom: admit ONE on prefill
+            # feasibility alone rather than deadlocking (eviction replays it
+            # if the cache truly runs out)
+            req = self._waiting[0]
+            if len(req.feed) > self._max_batch_tokens:
+                if self._future_blocks(PlaceholderSequenceDescriptor(),
+                                       len(req.feed)) \
+                        <= self._engine._state_manager.free_blocks:
+                    self._waiting.remove(req)
+                    self._prefill_chunked(req)
+                    return [req]
+                req.error = SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+                self._waiting.remove(req)
+                self._finish(req, flush=False)
+                return []
+            check = self._engine.can_schedule([req.uid], [len(req.feed)])
+            if check == SchedulingResult.Success:
+                admit = [self._waiting.pop(0)]
+            else:
+                # nothing is live, so nothing will ever free up: this
+                # request can never run (generate() raises here too)
+                req.error = SchedulingError(check)
+                self._waiting.remove(req)
+                self._finish(req, flush=False)
+                return []
+        if not admit:
+            return []
+        try:
+            logits = np.asarray(self._engine.put(
+                [r.uid for r in admit], [r.feed for r in admit],
+                do_checks=False))
+        except SchedulingError:
+            # shouldn't happen given the reservation math; replay everything
+            for r in admit:
+                self._engine.flush(r.uid)
+            self._waiting = admit + self._waiting
+            return []
+        except BaseException:
+            # unexpected failure: put the admits back where the crash drain
+            # can see them (they are in neither waiting nor live right now)
+            self._waiting = admit + self._waiting
+            raise
+        for i, req in enumerate(admit):
+            self._emit(req, logits[i])
+            self._live.append(req)
+        self._retire_finished()
+        return admit
+
+    def _prefill_chunked(self, req: _Request) -> None:
+        try:
+            logits = None
+            for ofs in range(0, len(req.feed), self._max_batch_tokens):
+                logits = np.asarray(self._engine.put(
+                    [req.uid], [req.feed[ofs:ofs + self._max_batch_tokens]],
+                    do_checks=False))[0]
+        except BaseException:
+            self._waiting.insert(0, req)  # visible to the crash drain
+            raise
+        self._emit(req, logits)
+        self._live.append(req)
+        self._retire_finished()
+
+    def _decode_tick(self) -> bool:
+        if not self._live:
+            return False
+        uids = [r.uid for r in self._live]
+        try:
+            logits = np.asarray(self._engine.put(
+                uids, [[r.outputs[-1]] for r in self._live]))
+        except SchedulingError:
+            # KV exhausted mid-decode: evict the NEWEST live sequence
+            # (generate()'s recovery). A lone sequence held the WHOLE cache
+            # when it died, so its replay could never prefill — finish it
+            # truncated (generate()'s lone-sequence semantics) instead of
+            # requeueing it into a guaranteed admission error that would
+            # discard the tokens already streamed.
+            victim = self._live.pop()
+            if self._live:
+                self._engine.flush(victim.uid)
+                self._waiting.insert(0, victim)
+            else:
+                self._finish(victim)
+            return True
+        for i, req in enumerate(self._live):
+            self._emit(req, logits[i])
+        self._retire_finished()
+        return True
+
+    def _emit(self, req: _Request, logits_row) -> None:
+        tok = self._engine._sample(logits_row, req.temperature, req.rng,
+                                   req.top_k, req.top_p)
+        req.outputs.append(int(tok))
+        req.stream_q.put(int(tok))
+
+    def _retire_finished(self) -> None:
+        for req in list(self._live):
+            seq = self._engine._state_manager.get_sequence(req.uid)
+            if (len(req.outputs) >= req.max_new_tokens
+                    or (req.eos_token_id is not None
+                        and req.outputs[-1] == req.eos_token_id)
+                    or seq.seen_tokens + 1 > self._max_context):
+                self._live.remove(req)
+                self._finish(req)
+
+    def _finish(self, req: _Request, flush: bool = True) -> None:
+        if flush:
+            self._engine.flush(req.uid)
+        req.done.set()
+        req.stream_q.put(_END)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
+                       port: int = 8000, tokenizer=None) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer over a running scheduler.
+
+    POST /generate body (JSON):
+      {"prompt": [ids]} or {"text": "..."} (requires tokenizer),
+      optional max_new_tokens / temperature / top_k / top_p / eos_token_id /
+      seed / stream. ``stream: true`` answers chunked, one JSON line per
+      token; otherwise one JSON object with the full output.
+    GET /health: scheduler stats.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        # chunked Transfer-Encoding is an HTTP/1.1 construct; the default
+        # HTTP/1.0 status line would make real clients mis-parse streams
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet by default
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                stats = scheduler.stats
+                status = "stopped" if stats["stopped"] else "ok"
+                self._json(200 if status == "ok" else 503,
+                           {"status": status, **stats})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path not in ("/generate", "/v1/completions"):
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                prompt = body.get("prompt")
+                if prompt is None and "text" in body:
+                    if tokenizer is None:
+                        raise ValueError("text input needs a tokenizer; "
+                                         "pass token ids as 'prompt'")
+                    prompt = tokenizer.encode(body["text"])
+                if not prompt:
+                    raise ValueError("missing 'prompt' (token ids) or 'text'")
+                handle = scheduler.submit(
+                    prompt,
+                    max_new_tokens=int(body.get("max_new_tokens", 32)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    eos_token_id=body.get("eos_token_id"),
+                    seed=int(body.get("seed", 0)))
+            except (ValueError, SchedulingError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for tok in handle.stream():
+                        line = json.dumps({"token": tok}).encode() + b"\n"
+                        self.wfile.write(hex(len(line))[2:].encode()
+                                         + b"\r\n" + line + b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    handle.cancel()
+                return
+            try:
+                tokens = handle.result()
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                self._json(500, {"error": str(e)})
+                return
+            out = {"tokens": tokens}
+            if tokenizer is not None:
+                out["text"] = tokenizer.decode(tokens)
+            self._json(200, out)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve(engine: InferenceEngineV2, host: str = "127.0.0.1", port: int = 8000,
+          tokenizer=None, block: bool = True):
+    """One-call deployment: start the scheduler + HTTP server (mii.serve)."""
+    sched = ServingScheduler(engine).start()
+    httpd = create_http_server(sched, host, port, tokenizer)
+    if not block:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return sched, httpd
+    try:
+        httpd.serve_forever()
+    finally:
+        sched.stop()
+    return sched, httpd

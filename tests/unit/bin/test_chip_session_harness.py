@@ -36,7 +36,8 @@ def test_chip_session_dry_executes_every_step(tmp_path):
     (sandbox / "bin" / "ds_report").write_text("")
     (sandbox / "bin" / "ds_nvme_bench").write_text("")
 
-    env = dict(os.environ, PATH=f"{stub}:{os.environ['PATH']}")
+    env = dict(os.environ, PATH=f"{stub}:{os.environ['PATH']}",
+               DS_SESSION_NO_RELAY_GUARD="1")  # no relay in the sandbox
     r = subprocess.run(["bash", str(sandbox / ".perf" / "chip_session.sh")],
                        env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-1000:]

@@ -1,0 +1,251 @@
+"""Serving front end (server.py): continuous-batching scheduler + HTTP.
+
+The scheduler must produce EXACTLY what ``InferenceEngineV2.generate``
+produces (same admission math, same sampling helpers) while requests
+arrive/retire asynchronously — greedy outputs are compared token-for-token.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine, load_engine
+from deepspeed_tpu.inference.v2.scheduling_utils import SchedulingError
+from deepspeed_tpu.inference.v2.server import (ServingScheduler,
+                                               create_http_server)
+from deepspeed_tpu.models import LlamaConfig, init_llama
+
+BS = 16
+
+
+def _engine(num_blocks=96, **kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    return build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=num_blocks),
+        **kw), cfg, params
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def test_scheduler_matches_generate_greedy():
+    """Async submissions produce the same greedy tokens as the synchronous
+    generate() batch path on the same weights."""
+    engine, cfg, params = _engine()
+    prompts = _prompts(5)
+    ref = engine.generate(prompts, max_new_tokens=8)
+
+    reset_mesh_context()
+    engine2, _, _ = _engine()  # same init seed -> identical weights
+    sched = ServingScheduler(engine2)
+    handles = [sched.submit(p, max_new_tokens=8) for p in prompts]
+    while not all(h.finished for h in handles):
+        sched.step()
+    outs = [h.result() for h in handles]
+    assert outs == ref
+
+
+def test_streaming_and_background_thread():
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    try:
+        h = sched.submit(_prompts(1)[0], max_new_tokens=6)
+        streamed = list(h.stream(timeout=60))
+        assert len(streamed) == 6
+        assert h.result(timeout=1) == streamed
+        # late-arriving request on the running loop also completes
+        h2 = sched.submit(_prompts(1, seed=3)[0], max_new_tokens=4)
+        assert len(h2.result(timeout=60)) == 4
+    finally:
+        sched.stop()
+
+
+def test_concurrent_submitters_all_complete():
+    """Many client threads submitting while the loop runs: every request
+    completes, and per-prompt outputs equal a solo run (greedy decode has
+    no cross-request dependence)."""
+    engine, *_ = _engine(num_blocks=128)
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    prompts = _prompts(8, seed=11)
+    solo = {}
+    for i, p in enumerate(prompts):
+        solo[i] = engine.generate([p], max_new_tokens=5)[0]
+    results = {}
+
+    def client(i):
+        results[i] = sched.submit(prompts[i], max_new_tokens=5).result(120)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i, ))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert results == solo
+    finally:
+        sched.stop()
+
+
+def test_kv_pressure_queues_and_completes():
+    """More concurrent requests than the KV cache can hold at once: the
+    scheduler queues the overflow and still finishes everything, with full
+    block conservation after."""
+    engine, *_ = _engine(num_blocks=24)  # tiny cache
+    total = engine._state_manager._allocator.free_blocks
+    sched = ServingScheduler(engine)
+    handles = [sched.submit(p, max_new_tokens=6)
+               for p in _prompts(6, lo=BS, hi=2 * BS, seed=7)]
+    for _ in range(4000):
+        if all(h.finished for h in handles):
+            break
+        sched.step()
+    assert all(h.finished for h in handles)
+    assert all(len(h.result()) == 6 for h in handles)
+    assert engine._state_manager._allocator.free_blocks == total
+
+
+def test_cancel_and_oversize_rejection():
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=1000)
+    sched.step()
+    h.cancel()
+    sched.step()
+    assert h.finished and 0 < len(h.result()) < 1000
+    # a prompt over max_context is rejected at submit time
+    with pytest.raises(SchedulingError):
+        sched.submit(list(range(100000)), max_new_tokens=1)
+    # a prompt that can never fit the cache errors its handle, not the loop
+    big = ServingScheduler(_engine(num_blocks=4)[0])
+    hbig = big.submit(list(range(40 * BS)), max_new_tokens=4)
+    for _ in range(20):
+        big.step()
+    assert hbig.finished
+    with pytest.raises(SchedulingError):
+        hbig.result()
+
+
+def test_long_prompt_chunked_prefill():
+    """A prompt longer than max_ragged_batch_size takes the chunked-prefill
+    path and matches generate()."""
+    engine, cfg, _ = _engine(num_blocks=256)
+    max_tok = engine._config.state_manager.max_ragged_batch_size
+    prompt = (np.arange(max_tok + 37) % 200).tolist()
+    ref = engine.generate([prompt], max_new_tokens=4)[0]
+    sched = ServingScheduler(engine)
+    h = sched.submit(prompt, max_new_tokens=4)
+    while not h.finished:
+        sched.step()
+    assert h.result() == ref
+
+
+def test_http_server_roundtrip(tmp_path):
+    """serialize -> load_engine -> HTTP: /health, blocking /generate, and
+    chunk-streamed /generate against a live ThreadingHTTPServer."""
+    engine, *_ = _engine()
+    engine.serialize(str(tmp_path / "model"))
+    reset_mesh_context()
+    engine2 = load_engine(
+        str(tmp_path / "model"), dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    prompt = _prompts(1, seed=2)[0]
+    ref = engine2.generate([prompt], max_new_tokens=5)[0]
+
+    sched = ServingScheduler(engine2, idle_wait=0.005).start()
+    httpd = create_http_server(sched, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("GET", "/health")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "ok"
+
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 5})
+        conn.request("POST", "/generate", body,
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert out["tokens"] == ref
+
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt, "max_new_tokens": 5,
+                                 "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        streamed = [json.loads(line)["token"]
+                    for line in resp.read().splitlines() if line.strip()]
+        assert streamed == ref
+
+        conn.request("POST", "/generate", json.dumps({}),
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+    finally:
+        httpd.shutdown()
+        sched.stop()
+
+
+def test_lone_sequence_exhaustion_truncates():
+    """A single live request that eats the whole cache finishes with its
+    partial output (generate()'s lone-sequence truncation), not an error."""
+    engine, *_ = _engine(num_blocks=6)  # 96 slots total
+    sched = ServingScheduler(engine)
+    h = sched.submit([1, 2, 3], max_new_tokens=500)
+    for _ in range(500):
+        if h.finished:
+            break
+        sched.step()
+    out = h.result()  # must NOT raise
+    assert 0 < len(out) < 500
+    # everything freed after truncation
+    total = engine._state_manager._allocator.free_blocks \
+        + engine._state_manager.prefix_cache.reclaimable_blocks \
+        if engine._state_manager.prefix_cache else \
+        engine._state_manager._allocator.free_blocks
+    assert total == 6
+
+
+def test_stop_rejects_new_and_fails_pending():
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    h = sched.submit(_prompts(1)[0], max_new_tokens=200)
+    time.sleep(0.2)  # let it go live
+    sched.stop()
+    with pytest.raises(RuntimeError):
+        sched.submit([1, 2, 3])
+    assert h.finished  # pending request was failed, not leaked
+    with pytest.raises(RuntimeError):
+        h.result()
+
+
+def test_scheduler_crash_fails_blocked_callers():
+    """An unexpected engine error must unblock every waiting caller with
+    the error rather than hanging them on a dead thread."""
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005)
+
+    def boom(*a, **k):
+        raise ValueError("injected device failure")
+
+    engine.put = boom
+    sched.start()
+    h = sched.submit(_prompts(1)[0], max_new_tokens=4)
+    with pytest.raises(ValueError, match="injected"):
+        h.result(timeout=30)
+    assert sched.stats["stopped"]
+    with pytest.raises(RuntimeError):
+        sched.submit([1, 2, 3])
