@@ -62,6 +62,7 @@ def pipeline(model_dir: str,
              engine_config: Optional[RaggedInferenceEngineConfig] = None,
              dtype=None,
              tokenizer: Union[None, str, object] = "auto",
+             lora: Optional[str] = None,
              **engine_kwargs) -> InferencePipeline:
     """Build a text-generation pipeline from a HF checkpoint directory.
 
@@ -72,12 +73,15 @@ def pipeline(model_dir: str,
       tokenizer: "auto" loads from model_dir via transformers when
         available (silently none if not), None disables, or pass a
         ready tokenizer object / name.
+      lora: PEFT adapter directory (adapter_config.json +
+        adapter_model.safetensors) merged into the base weights before
+        the engine is built.
       engine_kwargs: forwarded to ``build_llama_engine`` (quantize,
         kv_cache_dtype, kv_block_size, ...).
     """
     import jax.numpy as jnp
 
-    from ...module_inject import convert_hf_safetensors
+    from ...module_inject import convert_hf_safetensors, merge_peft_adapter
 
     with open(os.path.join(model_dir, "config.json")) as f:
         hf_config = json.load(f)
@@ -86,6 +90,8 @@ def pipeline(model_dir: str,
         raise ValueError("config.json has no model_type; pass arch=")
     cfg, params = convert_hf_safetensors(arch, model_dir, hf_config,
                                          dtype=dtype or jnp.bfloat16)
+    if lora is not None:
+        params = merge_peft_adapter(arch, cfg, params, adapter_dir=lora)
     engine = build_llama_engine(cfg, params=params,
                                 engine_config=engine_config,
                                 dtype=dtype, **engine_kwargs)

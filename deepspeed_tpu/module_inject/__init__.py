@@ -2,4 +2,5 @@ from .replace_policy import (HFCheckpointPolicy, LlamaPolicy, MistralPolicy, Qwe
                              Gemma2Policy, OPTPolicy, PhiPolicy, FalconPolicy,
                              policy_for, SUPPORTED_ARCHS)
 from .replace_module import (convert_hf_checkpoint, convert_hf_safetensors,
-                             export_hf_checkpoint, replace_transformer_layer)
+                             export_hf_checkpoint, merge_peft_adapter,
+                             replace_transformer_layer)

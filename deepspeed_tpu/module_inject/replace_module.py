@@ -238,3 +238,125 @@ def replace_transformer_layer(arch_or_model_type: str,
         model, config={"dtype": "bfloat16" if dtype == jnp.bfloat16 else "float32",
                        "tensor_parallel": {"tp_size": tp_size}},
         params=params)
+
+
+def merge_peft_adapter(arch: str,
+                       config: LlamaConfig,
+                       params: Dict,
+                       adapter_dir: Optional[str] = None,
+                       adapter_state: Optional[Dict[str, Any]] = None,
+                       adapter_config: Optional[Dict] = None) -> Dict:
+    """Merge a PEFT LoRA adapter into converted flax params, in place.
+
+    The serving-side counterpart of ``linear/optimized_linear.py``'s LoRA
+    training (reference deploys adapters by merging before inference):
+    every ``...<module>.lora_A.weight`` / ``lora_B.weight`` pair becomes
+    ``W += (B @ A) * scaling`` on the matching base weight, located through
+    the same policy name maps the checkpoint conversion used — so any
+    supported arch accepts adapters with zero per-arch code.
+
+    ``scaling`` follows PEFT: ``lora_alpha / r`` (``lora_alpha / sqrt(r)``
+    when ``use_rslora``). Pass either ``adapter_dir`` (reads
+    ``adapter_config.json`` + ``adapter_model.safetensors``) or
+    ``adapter_state`` (+ ``adapter_config``).
+    """
+    if adapter_dir is not None:
+        import json
+        import os
+        with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+            adapter_config = json.load(f)
+        from safetensors import safe_open
+        adapter_state = {}
+        with safe_open(os.path.join(adapter_dir, "adapter_model.safetensors"),
+                       framework="numpy") as f:
+            for k in f.keys():
+                adapter_state[k] = f.get_tensor(k)
+    if adapter_state is None:
+        raise ValueError("pass adapter_dir or adapter_state")
+    adapter_config = adapter_config or {}
+    r = int(adapter_config.get("r", 8))
+    alpha = float(adapter_config.get("lora_alpha", r))
+    alpha_pattern = adapter_config.get("alpha_pattern") or {}
+    if adapter_config.get("fan_in_fan_out"):
+        raise ValueError("fan_in_fan_out adapters are not supported")
+    if adapter_config.get("use_dora"):
+        raise ValueError("DoRA adapters (use_dora) need magnitude "
+                         "renormalization; plain merge would be silently "
+                         "wrong — merge with PEFT first")
+
+    def _scaling(module: str, r_m: int) -> float:
+        # per-module alpha (PEFT alpha_pattern: suffix-matched keys);
+        # per-module rank comes from the tensor itself (rank_pattern-safe)
+        a = alpha
+        for key, val in alpha_pattern.items():
+            if module == key or module.endswith("." + key):
+                a = float(val)
+                break
+        return a / (r_m ** 0.5 if adapter_config.get("use_rslora") else r_m)
+
+    policy = policy_for(arch)
+    name_map: Dict[str, Tuple[str, bool]] = dict(
+        policy.global_map(config.tie_word_embeddings))
+    for layer in range(config.num_hidden_layers):
+        name_map.update(policy.weight_map(layer,
+                                          attention_bias=config.attention_bias))
+
+    # pair up PEFT names: base_model.model.<module>.lora_A[.default].weight
+    pairs: Dict[str, Dict[str, np.ndarray]] = {}
+    unmatched = []
+    for name, w in adapter_state.items():
+        for part in ("lora_A", "lora_B"):
+            tag = f".{part}."
+            if tag in name:
+                module = name.split(tag)[0]
+                for prefix in ("base_model.model.", "base_model.", ""):
+                    if module.startswith(prefix):
+                        module = module[len(prefix):]
+                        break
+                pairs.setdefault(module, {})[part] = _to_numpy(w)
+                break
+        else:
+            unmatched.append(name)
+    if unmatched:
+        # lora_embedding_A/B, trained biases (bias='lora_only'/'all'),
+        # modules_to_save full weights, DoRA magnitudes — dropping any of
+        # these would serve silently-wrong logits
+        raise ValueError(
+            "adapter contains tensors a plain lora_A/lora_B merge cannot "
+            f"represent: {unmatched[:6]}{'...' if len(unmatched) > 6 else ''}")
+
+    root = getattr(policy, "root", "model")
+    tree = params[root] if root else params
+    merged = []
+    for module, ab in sorted(pairs.items()):
+        if set(ab) != {"lora_A", "lora_B"}:
+            raise ValueError(f"adapter module '{module}' missing "
+                             f"lora_{'B' if 'lora_A' in ab else 'A'}")
+        hf_name = module + ".weight"
+        if hf_name not in name_map:
+            raise ValueError(
+                f"adapter targets '{module}', which has no plain weight "
+                f"mapping for arch={arch} (fused/special tensors can't "
+                "take merged adapters)")
+        flax_path, transpose = name_map[hf_name]
+        r_m = ab["lora_A"].shape[0]  # tensor-derived rank (rank_pattern)
+        delta = (ab["lora_B"].astype(np.float32)
+                 @ ab["lora_A"].astype(np.float32)) * _scaling(module, r_m)
+        if transpose:
+            delta = delta.T  # flax kernel orientation [in, out]
+        node = tree
+        parts = flax_path.split("/")
+        for p in parts[:-1]:
+            node = node[p]
+        leaf = node[parts[-1]]
+        if tuple(delta.shape) != tuple(leaf.shape):
+            raise ValueError(f"adapter delta {delta.shape} != base "
+                             f"{tuple(leaf.shape)} for '{module}'")
+        node[parts[-1]] = (np.asarray(leaf, np.float32) + delta).astype(
+            np.asarray(leaf).dtype)
+        merged.append(module)
+    if not merged:
+        raise ValueError("no lora_A/lora_B tensors found in the adapter")
+    logger.info(f"merged LoRA adapter into {len(merged)} modules "
+                f"(r={r}, alpha={alpha})")
+    return params
