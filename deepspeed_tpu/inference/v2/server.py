@@ -63,6 +63,10 @@ class _Request:
     cancelled: bool = False
     error: Optional[BaseException] = None
     rng: Optional[np.random.Generator] = None
+    # metrics timeline (time.monotonic)
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
 
     @property
     def feed(self) -> List[int]:
@@ -121,6 +125,9 @@ class ServingScheduler:
         self._uid_iter = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        # last-256 completed requests for the metrics aggregates
+        from collections import deque
+        self._completed: "deque" = deque(maxlen=256)
         sm = engine._config.state_manager
         self._max_batch_tokens = sm.max_ragged_batch_size
         self._max_seqs = min(sm.max_ragged_sequence_count,
@@ -144,6 +151,7 @@ class ServingScheduler:
                        top_p=float(top_p), eos_token_id=eos_token_id,
                        seed=int(seed))
         req.rng = np.random.default_rng(req.seed)
+        req.t_submit = time.monotonic()
         with self._lock:
             # the lock orders this against stop()'s drain: a submit that
             # loses the race lands AFTER _stopping is visible and is
@@ -158,10 +166,23 @@ class ServingScheduler:
     def stats(self) -> dict:
         with self._lock:
             inbox = len(self._inbox)
-        return {"waiting": len(self._waiting) + inbox,
-                "live": len(self._live),
-                "free_blocks": self._engine.free_blocks,
-                "stopped": self._stopping}
+            done = list(self._completed)  # (t_submit, t_first, t_done, n)
+        out = {"waiting": len(self._waiting) + inbox,
+               "live": len(self._live),
+               "free_blocks": self._engine.free_blocks,
+               "stopped": self._stopping,
+               "completed": len(done)}
+        done = [d for d in done if d[3] > 0]
+        if done:
+            # MII-style serving metrics over the recent completions:
+            # time-to-first-token and per-request decode rate
+            out["ttft_mean_s"] = round(
+                sum(t1 - t0 for t0, t1, _, _ in done) / len(done), 4)
+            rates = [(n - 1) / max(t2 - t1, 1e-9)
+                     for _, t1, t2, n in done if n > 1]
+            if rates:
+                out["decode_tok_s_mean"] = round(sum(rates) / len(rates), 2)
+        return out
 
     # ---- lifecycle ----
 
@@ -370,6 +391,8 @@ class ServingScheduler:
     def _emit(self, req: _Request, logits_row) -> None:
         tok = self._engine._sample(logits_row, req.temperature, req.rng,
                                    req.top_k, req.top_p)
+        if not req.outputs:
+            req.t_first = time.monotonic()
         req.outputs.append(int(tok))
         req.stream_q.put(int(tok))
 
@@ -386,6 +409,12 @@ class ServingScheduler:
     def _finish(self, req: _Request, flush: bool = True) -> None:
         if flush:
             self._engine.flush(req.uid)
+        req.t_done = time.monotonic()
+        if req.error is None and not req.cancelled:
+            with self._lock:  # stats() snapshots under the same lock
+                self._completed.append(
+                    (req.t_submit, req.t_first, req.t_done,
+                     len(req.outputs)))
         req.done.set()
         req.stream_q.put(_END)
 

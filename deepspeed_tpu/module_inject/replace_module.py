@@ -285,11 +285,13 @@ def merge_peft_adapter(arch: str,
                          "wrong — merge with PEFT first")
 
     def _scaling(module: str, r_m: int) -> float:
-        # per-module alpha (PEFT alpha_pattern: suffix-matched keys);
-        # per-module rank comes from the tensor itself (rank_pattern-safe)
+        # per-module alpha — PEFT's own pattern rule (get_pattern_key):
+        # keys are names OR regexes matched as (^|.*\.)key$ ; per-module
+        # rank comes from the tensor itself (rank_pattern-safe)
+        import re
         a = alpha
         for key, val in alpha_pattern.items():
-            if module == key or module.endswith("." + key):
+            if re.match(rf"(^|.*\.){key}$", module):
                 a = float(val)
                 break
         return a / (r_m ** 0.5 if adapter_config.get("use_rslora") else r_m)

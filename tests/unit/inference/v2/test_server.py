@@ -249,3 +249,43 @@ def test_scheduler_crash_fails_blocked_callers():
     assert sched.stats["stopped"]
     with pytest.raises(RuntimeError):
         sched.submit([1, 2, 3])
+
+
+@pytest.mark.world_size(8)
+def test_scheduler_over_tp_engine():
+    """The serving daemon composes with TP sharding: greedy outputs over a
+    tp=2 engine equal the single-chip scheduler's."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    ref_engine = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    prompts = _prompts(3, seed=21)
+    sched = ServingScheduler(ref_engine)
+    hs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+    while not all(h.finished for h in hs):
+        sched.step()
+    ref = [h.result() for h in hs]
+
+    reset_mesh_context()
+    tp_engine = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=96, tensor_parallel={"tp_size": 2}))
+    sched_tp = ServingScheduler(tp_engine)
+    hs = [sched_tp.submit(p, max_new_tokens=6) for p in prompts]
+    while not all(h.finished for h in hs):
+        sched_tp.step()
+    assert [h.result() for h in hs] == ref
+
+
+def test_metrics_in_stats():
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine)
+    hs = [sched.submit(p, max_new_tokens=4) for p in _prompts(2, seed=31)]
+    while not all(h.finished for h in hs):
+        sched.step()
+    s = sched.stats
+    assert s["completed"] == 2
+    assert s["ttft_mean_s"] >= 0 and s["decode_tok_s_mean"] > 0

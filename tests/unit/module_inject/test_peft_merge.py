@@ -177,3 +177,26 @@ def test_variant_adapters_guarded_and_rank_pattern():
         ["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"])
     want = base + (b8 @ a8 * (acfg["lora_alpha"] / 8)).T
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_alpha_pattern_regex_keys():
+    """PEFT alpha_pattern keys may be regexes, matched as (^|.*\\.)key$."""
+    hf, hf_cfg = _tiny_llama()
+    rng = np.random.default_rng(9)
+    adapter, acfg = _fake_adapter(hf, rng, targets=("q_proj", ))
+    acfg2 = {**acfg,
+             "alpha_pattern": {r"layers\.[0-1]\.self_attn\.q_proj": 32.0}}
+    merged = merge_peft_adapter(
+        "llama", *convert_hf_checkpoint("llama", hf.state_dict(),
+                                        hf_cfg.to_dict()),
+        adapter_state=adapter, adapter_config=acfg2)
+    base = convert_hf_checkpoint("llama", hf.state_dict(),
+                                 hf_cfg.to_dict())[1]
+    a = adapter["base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"]
+    b = adapter["base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"]
+    want = np.asarray(
+        base["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]) \
+        + (b @ a * (32.0 / acfg["r"])).T
+    np.testing.assert_allclose(
+        np.asarray(merged["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]),
+        want, atol=1e-5)
