@@ -329,6 +329,47 @@ class InferenceEngineV2:
         return cls._sample_with_logprob(row, temperature, rng, top_k, top_p,
                                         want_lp=False)[0]
 
+    @staticmethod
+    def process_logits(row, history, *, repetition_penalty: float = 1.0,
+                       eos_token_id=None, block_eos: bool = False,
+                       logits_processor=None):
+        """Pre-sampling logit controls (HF-generate parity for serving):
+        CTRL-style repetition penalty over the full history, eos masking
+        until ``min_new_tokens``, then an arbitrary user processor.
+        Returns ``row`` itself when every control is off."""
+        if (repetition_penalty == 1.0 and not block_eos
+                and logits_processor is None):
+            return row
+        row = np.array(row, np.float32, copy=True)
+        if repetition_penalty != 1.0:
+            idx = np.unique(np.asarray(history, np.int64))
+            vals = row[idx]
+            row[idx] = np.where(vals > 0, vals / repetition_penalty,
+                                vals * repetition_penalty)
+        if block_eos and eos_token_id is not None:
+            row[int(eos_token_id)] = -np.inf  # filtered tokens never win
+        if logits_processor is not None:
+            row = np.asarray(logits_processor(history, row), np.float32)
+        return row
+
+    @staticmethod
+    def normalize_stop(stop):
+        """``stop`` → list of token-id sequences (one flat list = one
+        sequence; None/empty = no stop sequences)."""
+        if not stop:
+            return []
+        if all(isinstance(t, (int, np.integer)) for t in stop):
+            stop = [stop]
+        out = [[int(t) for t in s] for s in stop]
+        if any(not s for s in out):
+            raise ValueError("empty stop sequence")
+        return out
+
+    @staticmethod
+    def hit_stop(outputs, stop_seqs) -> bool:
+        return any(len(outputs) >= len(s) and outputs[-len(s):] == s
+                   for s in stop_seqs)
+
     def generate(self, prompts, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
@@ -337,7 +378,11 @@ class InferenceEngineV2:
                  speculative: Optional[str] = None,
                  num_draft_tokens: int = 4,
                  draft_ngram: int = 2,
-                 num_return_sequences: int = 1):
+                 num_return_sequences: int = 1,
+                 stop=None,
+                 min_new_tokens: int = 0,
+                 repetition_penalty: float = 1.0,
+                 logits_processor=None):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
         sequence in ONE ragged batch per step (the N=1 fast path), free KV on
@@ -352,6 +397,13 @@ class InferenceEngineV2:
         evicted and later replayed (prompt + tokens so far) instead of the
         whole batch crashing.
 
+        Sampling controls (HF-generate parity): ``stop`` — token-id
+        sequence(s) that end generation when the output tail matches (the
+        matched tokens are included); ``min_new_tokens`` masks eos until
+        reached; ``repetition_penalty`` is the CTRL rule over
+        prompt+output history; ``logits_processor(history, row) -> row``
+        runs last, before sampling.
+
         ``speculative="prompt_lookup"`` (greedy only; beyond the reference):
         each decode step drafts up to ``num_draft_tokens`` by matching the
         trailing ``draft_ngram`` against earlier context (Saxena's
@@ -360,12 +412,35 @@ class InferenceEngineV2:
         dispatch, rejected ones roll back in place. Memory-bound decode is
         where this pays: the verify pass re-reads the same weights a plain
         step would."""
+        stop = self.normalize_stop(stop)
         if speculative is not None:
             if speculative != "prompt_lookup":
                 raise ValueError(f"unknown speculative mode {speculative!r}")
             if temperature != 0.0 or return_logprobs:
                 raise ValueError("speculative decoding is greedy-only "
                                  "(temperature=0, no logprobs)")
+            if (stop or min_new_tokens or repetition_penalty != 1.0
+                    or logits_processor is not None):
+                # the one-pass window verify compares raw argmax per
+                # position; history-dependent logit edits would make the
+                # verified distribution position-dependent in ways the
+                # single forward can't reproduce
+                raise ValueError("speculative decoding does not compose "
+                                 "with stop/min_new_tokens/"
+                                 "repetition_penalty/logits_processor")
+
+        def _controls(row, u):
+            block_eos = len(outputs[u]) < min_new_tokens
+            if (repetition_penalty == 1.0 and not block_eos
+                    and logits_processor is None):
+                return row  # controls off: skip the O(context) history copy
+            return self.process_logits(
+                row, prompts[u] + outputs[u],
+                repetition_penalty=repetition_penalty,
+                eos_token_id=eos_token_id,
+                block_eos=block_eos,
+                logits_processor=logits_processor)
+
         rng = np.random.default_rng(seed)
         if num_return_sequences > 1:
             # parallel sampling (MII n-sampling): N samples per prompt,
@@ -426,7 +501,7 @@ class InferenceEngineV2:
                     [u], [feed[u][ofs:ofs + max_batch_tokens]],
                     do_checks=False))[0]
             last_tok[u], lp = self._sample_with_logprob(
-                logits, temperature, rng, top_k, top_p,
+                _controls(logits, u), temperature, rng, top_k, top_p,
                 want_lp=return_logprobs)
             outputs[u].append(last_tok[u])
             logprobs[u].append(lp)
@@ -491,8 +566,8 @@ class InferenceEngineV2:
                                              do_checks=False))
                 for i, u in enumerate(admit):
                     last_tok[u], lp = self._sample_with_logprob(
-                        logits[i], temperature, rng, top_k, top_p,
-                        want_lp=return_logprobs)
+                        _controls(logits[i], u), temperature, rng, top_k,
+                        top_p, want_lp=return_logprobs)
                     outputs[u].append(last_tok[u])
                     logprobs[u].append(lp)
                     live.append(u)
@@ -501,6 +576,7 @@ class InferenceEngineV2:
                 if (len(outputs[u]) >= max_new_tokens
                         or (eos_token_id is not None
                             and outputs[u][-1] == eos_token_id)
+                        or (stop and self.hit_stop(outputs[u], stop))
                         # context ceiling: retire BEFORE the decode put would
                         # raise SequenceTokenLimitExceeded for the whole batch
                         or seq.seen_tokens + 1 > sm.max_context):
@@ -606,8 +682,8 @@ class InferenceEngineV2:
             else:
                 for i, u in enumerate(live):
                     last_tok[u], lp = self._sample_with_logprob(
-                        logits[i], temperature, rng, top_k, top_p,
-                        want_lp=return_logprobs)
+                        _controls(logits[i], u), temperature, rng, top_k,
+                        top_p, want_lp=return_logprobs)
                     outputs[u].append(last_tok[u])
                     logprobs[u].append(lp)
         if return_logprobs:

@@ -17,6 +17,16 @@ from .config_v2 import RaggedInferenceEngineConfig
 from .engine_v2 import InferenceEngineV2, build_llama_engine
 
 
+def _encode_stop(tokenizer, s: str):
+    """Tokenize a stop string WITHOUT special tokens: a BOS prepended by
+    the default encode() can never appear in an output tail, so the stop
+    sequence would silently never fire."""
+    try:
+        return tokenizer.encode(s, add_special_tokens=False)
+    except TypeError:  # tokenizer without the kwarg
+        return tokenizer.encode(s)
+
+
 class InferencePipeline:
     """Callable bundle of a serving engine + (optional) tokenizer."""
 
@@ -44,6 +54,13 @@ class InferencePipeline:
             eos = getattr(self.tokenizer, "eos_token_id", None)
             if eos is not None:
                 gen_kwargs["eos_token_id"] = eos
+        stop = gen_kwargs.get("stop")
+        if stop is not None and self.tokenizer is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            gen_kwargs["stop"] = [
+                _encode_stop(self.tokenizer, s) if isinstance(s, str) else s
+                for s in stop]
         outs = self.engine.generate(batch, max_new_tokens=max_new_tokens,
                                     **gen_kwargs)
         if text_in:

@@ -56,6 +56,10 @@ class _Request:
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
+    stop: list = field(default_factory=list)  # normalized token-id seqs
+    min_new_tokens: int = 0
+    repetition_penalty: float = 1.0
+    logits_processor: Optional[object] = None
     # scheduler state
     outputs: List[int] = field(default_factory=list)
     stream_q: "queue.Queue" = field(default_factory=queue.Queue)
@@ -139,7 +143,11 @@ class ServingScheduler:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None,
-               seed: int = 0) -> RequestHandle:
+               seed: int = 0,
+               stop=None,
+               min_new_tokens: int = 0,
+               repetition_penalty: float = 1.0,
+               logits_processor=None) -> RequestHandle:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -149,7 +157,11 @@ class ServingScheduler:
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
                        top_p=float(top_p), eos_token_id=eos_token_id,
-                       seed=int(seed))
+                       seed=int(seed),
+                       stop=InferenceEngineV2.normalize_stop(stop),
+                       min_new_tokens=int(min_new_tokens),
+                       repetition_penalty=float(repetition_penalty),
+                       logits_processor=logits_processor)
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         with self._lock:
@@ -389,6 +401,15 @@ class ServingScheduler:
         return True
 
     def _emit(self, req: _Request, logits_row) -> None:
+        block_eos = len(req.outputs) < req.min_new_tokens
+        if (req.repetition_penalty != 1.0 or block_eos
+                or req.logits_processor is not None):
+            logits_row = self._engine.process_logits(
+                logits_row, req.prompt + req.outputs,
+                repetition_penalty=req.repetition_penalty,
+                eos_token_id=req.eos_token_id,
+                block_eos=block_eos,
+                logits_processor=req.logits_processor)
         tok = self._engine._sample(logits_row, req.temperature, req.rng,
                                    req.top_k, req.top_p)
         if not req.outputs:
@@ -402,6 +423,8 @@ class ServingScheduler:
             if (len(req.outputs) >= req.max_new_tokens
                     or (req.eos_token_id is not None
                         and req.outputs[-1] == req.eos_token_id)
+                    or (req.stop
+                        and self._engine.hit_stop(req.outputs, req.stop))
                     or seq.seen_tokens + 1 > self._max_context):
                 self._live.remove(req)
                 self._finish(req)
@@ -476,6 +499,16 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     prompt = tokenizer.encode(body["text"])
                 if not prompt:
                     raise ValueError("missing 'prompt' (token ids) or 'text'")
+                stop = body.get("stop")
+                if isinstance(stop, str):
+                    stop = [stop]
+                if stop and any(isinstance(s, str) for s in stop):
+                    if tokenizer is None:
+                        raise ValueError("string stop sequences need a "
+                                         "tokenizer; pass token ids")
+                    from .pipeline import _encode_stop
+                    stop = [_encode_stop(tokenizer, s)
+                            if isinstance(s, str) else s for s in stop]
                 handle = scheduler.submit(
                     prompt,
                     max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -483,7 +516,11 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     top_k=int(body.get("top_k", 0)),
                     top_p=float(body.get("top_p", 1.0)),
                     eos_token_id=body.get("eos_token_id"),
-                    seed=int(body.get("seed", 0)))
+                    seed=int(body.get("seed", 0)),
+                    stop=stop,
+                    min_new_tokens=int(body.get("min_new_tokens", 0)),
+                    repetition_penalty=float(
+                        body.get("repetition_penalty", 1.0)))
             except (ValueError, SchedulingError) as e:
                 self._json(400, {"error": str(e)})
                 return
