@@ -488,9 +488,16 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
             if self.path not in ("/generate", "/v1/completions"):
                 self._json(404, {"error": "not found"})
                 return
+            openai = self.path == "/v1/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
+                if openai:
+                    # OpenAI completions field names -> native ones
+                    if "max_tokens" in body:
+                        body.setdefault("max_new_tokens", body["max_tokens"])
+                    if isinstance(body.get("prompt"), str):
+                        body.setdefault("text", body.pop("prompt"))
                 prompt = body.get("prompt")
                 if prompt is None and "text" in body:
                     if tokenizer is None:
@@ -543,9 +550,22 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 self._json(500, {"error": str(e)})
                 return
+            text = tokenizer.decode(tokens) if tokenizer is not None else None
+            if openai:
+                # OpenAI completions response shape
+                finish = ("length" if len(tokens)
+                          >= int(body.get("max_new_tokens", 32)) else "stop")
+                self._json(200, {
+                    "object": "text_completion",
+                    "choices": [{"index": 0,
+                                 "text": text if text is not None else "",
+                                 "tokens": tokens,
+                                 "finish_reason": finish}],
+                    "usage": {"completion_tokens": len(tokens)}})
+                return
             out = {"tokens": tokens}
-            if tokenizer is not None:
-                out["text"] = tokenizer.decode(tokens)
+            if text is not None:
+                out["text"] = text
             self._json(200, out)
 
     return ThreadingHTTPServer((host, port), Handler)

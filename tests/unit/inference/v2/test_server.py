@@ -289,3 +289,38 @@ def test_metrics_in_stats():
     s = sched.stats
     assert s["completed"] == 2
     assert s["ttft_mean_s"] >= 0 and s["decode_tok_s_mean"] > 0
+
+
+def test_openai_completions_endpoint():
+    """/v1/completions accepts OpenAI field names (max_tokens, string
+    prompt via tokenizer) and answers the completions response shape."""
+    class CharTok:
+        eos_token_id = None
+
+        def encode(self, s, add_special_tokens=True):
+            return [(ord(c) % 100) + 3 for c in s]
+
+        def decode(self, ids):
+            return "".join(chr((int(i) % 26) + 97) for i in ids)
+
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    httpd = create_http_server(sched, "127.0.0.1", 0, tokenizer=CharTok())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          httpd.server_address[1],
+                                          timeout=120)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": "hello world", "max_tokens": 5}),
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert out["object"] == "text_completion"
+        choice = out["choices"][0]
+        assert len(choice["tokens"]) == 5
+        assert choice["finish_reason"] == "length"
+        assert out["usage"]["completion_tokens"] == 5
+        assert isinstance(choice["text"], str) and choice["text"]
+    finally:
+        httpd.shutdown()
+        sched.stop()
