@@ -106,6 +106,9 @@ run bench_serving_int8 1200 env DS_BENCH_KV_INT8=1 DS_BENCH_FAST=1 python bench_
 run bench_serving_prefix 1200 env DS_BENCH_PREFIX=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_PREFIX.json
 # 15c. speculative decode delta (prompt-lookup, repetitive workload)
 run bench_serving_spec 1200 env DS_BENCH_SPEC=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_SPEC.json
+# 15d. serving-daemon end-to-end throughput (MII layer: scheduler thread,
+# admission, streaming — not raw engine puts)
+run bench_serving_daemon 1200 env DS_BENCH_DAEMON=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_DAEMON.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -67,6 +67,14 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
     # (the regime it accelerates) vs plain greedy, same engine
     if env_flag("DS_BENCH_SPEC"):
         results.extend(_measure_speculative(cfg, kv_block, backends[0]))
+    # DS_BENCH_DAEMON=1: end-to-end ServingScheduler throughput — requests
+    # arriving asynchronously through the MII deployment layer (scheduler
+    # thread + admission + streaming), not raw engine puts
+    if env_flag("DS_BENCH_DAEMON"):
+        results.extend(_measure_daemon(cfg, kv_block, backends[0],
+                                       n_requests=16 if on_tpu else 6,
+                                       ctx=contexts[0] // 2,
+                                       new_tokens=decode_steps))
     for backend in backends:
         max_ctx = max(contexts) + decode_steps + kv_block
         chunk = 2048
@@ -185,6 +193,55 @@ def _measure_speculative(cfg, kv_block, backend):
         rows[1]["speedup_vs_plain"] = round(
             rows[1]["decode_tok_s"] / rows[0]["decode_tok_s"], 2)
     return rows
+
+
+def _measure_daemon(cfg, kv_block, backend, n_requests, ctx, new_tokens):
+    """Aggregate daemon throughput: N requests submitted from client
+    threads against the running ServingScheduler, wall-clocked end to end
+    (includes admission, batching, sampling, streaming overheads)."""
+    import threading
+    import jax
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (ServingScheduler,
+                                            build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, size=ctx).tolist()
+               for _ in range(n_requests)]
+    eng = build_llama_engine(
+        cfg, engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=(n_requests + 2)
+            * ((ctx + new_tokens) // kv_block + 2)),
+        kv_block_size=kv_block)
+    eng.model().attn_backend = backend
+    # warm the prefill + single/batched decode programs outside the timing
+    eng.generate([prompts[0], prompts[1]], max_new_tokens=2)
+    sched = ServingScheduler(eng, idle_wait=0.001).start()
+    results = [None] * n_requests
+
+    def client(i):
+        results[i] = sched.submit(prompts[i],
+                                  max_new_tokens=new_tokens).result(600)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i, ))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    dt = time.perf_counter() - t0
+    stats = sched.stats
+    sched.stop()
+    total = sum(len(r) for r in results if r)
+    return [{
+        "backend": backend, "context": ctx, "daemon": True,
+        "requests": n_requests, "new_tokens_per_req": new_tokens,
+        "wall_s": round(dt, 2),
+        "aggregate_tok_s": round(total / dt, 2),
+        "ttft_mean_s": stats.get("ttft_mean_s"),
+        "decode_tok_s_mean": stats.get("decode_tok_s_mean"),
+    }]
 
 
 def _measure_prefix_caching(cfg, ctx, kv_block, backend):
