@@ -324,3 +324,40 @@ def test_openai_completions_endpoint():
     finally:
         httpd.shutdown()
         sched.stop()
+
+
+def test_daemon_soak_random_churn():
+    """Randomized arrivals, lengths, sampling params, cancels and stops
+    against the stepped scheduler: every request terminates, and the
+    allocator ends with full block conservation (no KV leak through any
+    admission/eviction/cancel/stop path)."""
+    engine, *_ = _engine(num_blocks=32)
+    total = engine._state_manager._allocator.free_blocks
+    sched = ServingScheduler(engine)
+    rng = np.random.default_rng(42)
+    handles = []
+    for round_ in range(6):
+        for _ in range(rng.integers(1, 4)):
+            n = int(rng.integers(2, 3 * BS))
+            kw = {}
+            if rng.random() < 0.3:
+                kw["temperature"] = 0.8
+            if rng.random() < 0.3:
+                kw["stop"] = [int(rng.integers(0, 200))]
+            if rng.random() < 0.3:
+                kw["repetition_penalty"] = 1.2
+            handles.append(sched.submit(
+                rng.integers(0, 200, size=n).tolist(),
+                max_new_tokens=int(rng.integers(1, 8)), **kw))
+        for _ in range(int(rng.integers(1, 6))):
+            sched.step()
+        if handles and rng.random() < 0.5:
+            rng.choice(handles).cancel()
+    for _ in range(3000):
+        if all(h.finished for h in handles):
+            break
+        sched.step()
+    assert all(h.finished for h in handles)
+    for h in handles:
+        h.result()  # none may raise
+    assert engine._state_manager._allocator.free_blocks == total
