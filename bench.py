@@ -646,9 +646,13 @@ def measure():
                     (1, 32768, 6, "dots_saveable", True),
                     (1, 16384, 8, True, True)]
     if env_flag("DS_BENCH_FAST"):
-        # short relay window: scanned-only ladder, fewer iters
+        # short relay window: scanned-only ladder, fewer iters. bs16/dots
+        # comes right after the first landing rung: the 8/1 triage proved
+        # it FITS and its compile is already in the persistent cache, so
+        # the bigger MXU footprint costs a short window almost nothing
         attempts = [(8, 1024, 12, False, True),
                     (8, 1024, 12, "dots_saveable", True),
+                    (16, 1024, 12, "dots_saveable", True),
                     (4, 1024, 12, False, True),
                     (4, 1024, 10, True, True)]
     best = None
