@@ -62,6 +62,7 @@ class _Request:
     logits_processor: Optional[object] = None
     # scheduler state
     outputs: List[int] = field(default_factory=list)
+    fed: int = 0                   # tokens of prompt+outputs already in KV
     stream_q: "queue.Queue" = field(default_factory=queue.Queue)
     done: "threading.Event" = field(default_factory=threading.Event)
     cancelled: bool = False
@@ -74,9 +75,25 @@ class _Request:
 
     @property
     def feed(self) -> List[int]:
-        """Tokens to prefill on (re)admission: prompt, or prompt + generated
-        so far after an eviction replay."""
+        """Everything that must be in the KV cache: prompt, plus generated
+        tokens (relevant after an eviction replay resets ``fed``)."""
         return self.prompt + self.outputs
+
+    @property
+    def pending(self) -> int:
+        """Tokens of ``feed`` not yet in the KV cache. 1 ⇔ a pure decode
+        step (the last sampled token); >1 ⇔ (re)prefilling."""
+        return len(self.prompt) + len(self.outputs) - self.fed
+
+    def feed_slice(self, take: int) -> List[int]:
+        """Next ``take`` unfed tokens, without concatenating the history."""
+        start, lp = self.fed, len(self.prompt)
+        if start >= lp:
+            return self.outputs[start - lp:start - lp + take]
+        head = self.prompt[start:start + take]
+        if len(head) < take:
+            head = head + self.outputs[:take - len(head)]
+        return head
 
 
 class RequestHandle:
@@ -116,9 +133,19 @@ class RequestHandle:
 
 
 class ServingScheduler:
-    """Continuous-batching serving loop over one ``InferenceEngineV2``."""
+    """Continuous-batching serving loop over one ``InferenceEngineV2``.
 
-    def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05):
+    Scheduling is Dynamic SplitFuse (the reference's FastGen algorithm,
+    ``blogs/deepspeed-fastgen``): every tick runs ONE ragged forward of at
+    most ``token_budget`` tokens — each decoding sequence is guaranteed its
+    1 token first (the decode-latency SLA), then prefilling sequences fill
+    the remainder in chunks. Long prompts therefore spread across ticks
+    instead of stalling live decodes behind one huge forward, and short
+    prompts pack into the same forward as the decodes.
+    """
+
+    def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05,
+                 token_budget: Optional[int] = None):
         self._engine = engine
         self._idle_wait = idle_wait
         self._lock = threading.Lock()
@@ -134,8 +161,10 @@ class ServingScheduler:
         self._completed: "deque" = deque(maxlen=256)
         sm = engine._config.state_manager
         self._max_batch_tokens = sm.max_ragged_batch_size
+        self._token_budget = min(token_budget or self._max_batch_tokens,
+                                 self._max_batch_tokens)
         self._max_seqs = min(sm.max_ragged_sequence_count,
-                             self._max_batch_tokens)
+                             self._token_budget)
         self._max_context = sm.max_context
 
     # ---- client surface (any thread) ----
@@ -260,143 +289,124 @@ class ServingScheduler:
             self._finish(req, flush=False)
 
         admitted = self._admit()
-        decoded = self._decode_tick()
-        return bool(admitted or decoded)
+        advanced = self._advance_tick()
+        return bool(admitted or advanced)
 
-    # Admission MIRRORS InferenceEngineV2.generate (engine_v2.py, the
-    # admission loop): reserve blocks for the full decode budget of every
-    # admitted AND live sequence so the decode put cannot exhaust the
-    # allocator mid-flight. KEEP IN LOCKSTEP: an admission-edge fix in
-    # either place applies to both (test_scheduler_matches_generate_greedy
-    # pins the happy path; the edges are mirrored by hand). One deliberate
-    # difference: max_context is enforced at submit() (and replay feeds
-    # stay bounded because sequences retire at seen+1 > max_context), so
-    # generate()'s in-loop max_context raise has no counterpart here.
+    # Admission reservation MIRRORS InferenceEngineV2.generate: blocks for
+    # the full feed + decode budget of every admitted AND live sequence,
+    # so a tick's put cannot exhaust the allocator mid-flight (the shared
+    # arithmetic is the model's own get_kv_requirements). Differences from
+    # generate(), both deliberate: max_context is enforced at submit()
+    # (sequences retire at seen+1 > max_context, so replay feeds stay
+    # bounded), and prefill happens chunkwise inside _advance_tick's
+    # SplitFuse budget instead of one whole-feed put per admission.
     def _future_blocks(self, seq_desc, extra: int) -> int:
         _, req = self._engine._model.get_kv_requirements(seq_desc, extra,
                                                          1 << 30)
         return req
 
     def _live_reserve(self) -> int:
-        return sum(
-            self._future_blocks(
-                self._engine._state_manager.get_sequence(r.uid),
-                max(0, r.max_new_tokens - len(r.outputs)))
-            for r in self._live)
+        total = 0
+        for r in self._live:
+            seq = self._engine._state_manager.get_sequence(r.uid)
+            if seq is None:  # admitted this tick, nothing fed yet
+                seq = PlaceholderSequenceDescriptor()
+            total += self._future_blocks(
+                seq, r.pending + max(0, r.max_new_tokens - len(r.outputs)))
+        return total
 
     def _admit(self) -> List[_Request]:
+        """Move waiting requests into the live set (no forward happens
+        here — _advance_tick feeds them chunkwise). A request admits when
+        blocks for its ENTIRE feed + decode budget fit after the projected
+        growth of everything already live."""
         free = self._engine.free_blocks - self._live_reserve()
-        admit: List[_Request] = []
-        admit_blocks = 0
+        admitted: List[_Request] = []
         for req in list(self._waiting):
-            if len(self._live) + len(admit) >= self._max_seqs:
+            if len(self._live) >= self._max_seqs:
                 break
             need = self._future_blocks(
                 PlaceholderSequenceDescriptor(),
                 len(req.feed) + max(0, req.max_new_tokens - len(req.outputs)))
-            if len(req.feed) > self._max_batch_tokens:
-                # long prompt: solo chunked prefill (Dynamic SplitFuse)
-                if admit or need > free or self._live:
-                    break
-                self._waiting.remove(req)
-                self._prefill_chunked(req)
-                return [req]
-            trial = admit + [req]
-            if self._engine.can_schedule(
-                    [r.uid for r in trial],
-                    [len(r.feed) for r in trial]) != SchedulingResult.Success:
+            if need > free:
                 break
-            if admit_blocks + need > free:
-                break
-            admit.append(req)
-            admit_blocks += need
+            free -= need
             self._waiting.remove(req)
-        if not admit and not self._live and self._waiting:
-            # nothing can reserve full headroom: admit ONE on prefill
-            # feasibility alone rather than deadlocking (eviction replays it
-            # if the cache truly runs out)
+            req.fed = 0
+            self._live.append(req)
+            admitted.append(req)
+        if not admitted and not self._live and self._waiting:
+            # nothing can reserve full headroom: admit ONE on feed
+            # feasibility alone rather than deadlocking (eviction truncates
+            # it if the cache truly runs out)
             req = self._waiting[0]
-            if len(req.feed) > self._max_batch_tokens:
-                if self._future_blocks(PlaceholderSequenceDescriptor(),
-                                       len(req.feed)) \
-                        <= self._engine._state_manager.free_blocks:
-                    self._waiting.remove(req)
-                    self._prefill_chunked(req)
-                    return [req]
-                req.error = SchedulingError(SchedulingResult.KVCacheLimitExceeded)
-                self._waiting.remove(req)
-                self._finish(req, flush=False)
-                return []
-            check = self._engine.can_schedule([req.uid], [len(req.feed)])
-            if check == SchedulingResult.Success:
-                admit = [self._waiting.pop(0)]
+            feed_need = self._future_blocks(PlaceholderSequenceDescriptor(),
+                                            len(req.feed))
+            if feed_need <= self._engine._state_manager.free_blocks:
+                self._waiting.pop(0)
+                req.fed = 0
+                self._live.append(req)
+                admitted.append(req)
             else:
                 # nothing is live, so nothing will ever free up: this
                 # request can never run (generate() raises here too)
-                req.error = SchedulingError(check)
+                req.error = SchedulingError(
+                    SchedulingResult.KVCacheLimitExceeded)
                 self._waiting.remove(req)
                 self._finish(req, flush=False)
-                return []
-        if not admit:
-            return []
-        try:
-            logits = np.asarray(self._engine.put(
-                [r.uid for r in admit], [r.feed for r in admit],
-                do_checks=False))
-        except SchedulingError:
-            # shouldn't happen given the reservation math; replay everything
-            for r in admit:
-                self._engine.flush(r.uid)
-            self._waiting = admit + self._waiting
-            return []
-        except BaseException:
-            # unexpected failure: put the admits back where the crash drain
-            # can see them (they are in neither waiting nor live right now)
-            self._waiting = admit + self._waiting
-            raise
-        for i, req in enumerate(admit):
-            self._emit(req, logits[i])
-            self._live.append(req)
-        self._retire_finished()
-        return admit
+        return admitted
 
-    def _prefill_chunked(self, req: _Request) -> None:
-        try:
-            logits = None
-            for ofs in range(0, len(req.feed), self._max_batch_tokens):
-                logits = np.asarray(self._engine.put(
-                    [req.uid], [req.feed[ofs:ofs + self._max_batch_tokens]],
-                    do_checks=False))[0]
-        except BaseException:
-            self._waiting.insert(0, req)  # visible to the crash drain
-            raise
-        self._emit(req, logits)
-        self._live.append(req)
-        self._retire_finished()
-
-    def _decode_tick(self) -> bool:
+    def _advance_tick(self) -> bool:
+        """ONE ragged forward of ≤ token_budget tokens (Dynamic SplitFuse):
+        decoding sequences (pending == 1) are guaranteed their token first,
+        prefilling sequences chunk into the remaining budget. A sequence
+        samples only on the tick its feed completes."""
         if not self._live:
             return False
-        uids = [r.uid for r in self._live]
+        budget = self._token_budget
+        reqs, chunks = [], []
+        for req in self._live:               # decode SLA pass
+            if req.pending == 1 and budget >= 1:
+                reqs.append(req)
+                chunks.append(req.feed_slice(1))
+                budget -= 1
+        for req in self._live:               # prefill chunks
+            if req.pending > 1 and budget > 0:
+                take = min(req.pending, budget)
+                reqs.append(req)
+                chunks.append(req.feed_slice(take))
+                budget -= take
+        if not reqs:
+            return False
         try:
+            # do_checks stays ON: chunks always fit the ragged limits under
+            # the SplitFuse budget, and the feasibility check is what turns
+            # KV exhaustion into a catchable SchedulingError
             logits = np.asarray(self._engine.put(
-                uids, [[r.outputs[-1]] for r in self._live]))
+                [r.uid for r in reqs], chunks))
         except SchedulingError:
-            # KV exhausted mid-decode: evict the NEWEST live sequence
+            # KV exhausted mid-tick: evict the NEWEST live sequence
             # (generate()'s recovery). A lone sequence held the WHOLE cache
             # when it died, so its replay could never prefill — finish it
             # truncated (generate()'s lone-sequence semantics) instead of
             # requeueing it into a guaranteed admission error that would
             # discard the tokens already streamed.
             victim = self._live.pop()
+            self._engine.flush(victim.uid)
+            victim.fed = 0
             if self._live:
-                self._engine.flush(victim.uid)
                 self._waiting.insert(0, victim)
+            elif victim.outputs:
+                self._finish(victim, flush=False)
             else:
-                self._finish(victim)
+                victim.error = SchedulingError(
+                    SchedulingResult.KVCacheLimitExceeded)
+                self._finish(victim, flush=False)
             return True
-        for i, req in enumerate(self._live):
-            self._emit(req, logits[i])
+        for req, chunk, row in zip(reqs, chunks, logits):
+            req.fed += len(chunk)
+            if req.pending == 0:  # feed complete: this row is the next token
+                self._emit(req, row)
         self._retire_finished()
         return True
 
@@ -419,7 +429,11 @@ class ServingScheduler:
 
     def _retire_finished(self) -> None:
         for req in list(self._live):
+            if not req.outputs or req.pending > 1:
+                continue  # still (re)prefilling — nothing sampled to judge
             seq = self._engine._state_manager.get_sequence(req.uid)
+            if seq is None:
+                continue  # admitted this tick, nothing fed yet
             if (len(req.outputs) >= req.max_new_tokens
                     or (req.eos_token_id is not None
                         and req.outputs[-1] == req.eos_token_id)
