@@ -156,6 +156,10 @@ class ServingScheduler:
         self._uid_iter = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        self._draining = False
+        # submit()..._finish() span, maintained under _lock: queue-membership
+        # checks can race the loop's unlocked transfers, this count cannot
+        self._active = 0
         # last-256 completed requests for the metrics aggregates
         from collections import deque
         self._completed: "deque" = deque(maxlen=256)
@@ -197,9 +201,10 @@ class ServingScheduler:
             # the lock orders this against stop()'s drain: a submit that
             # loses the race lands AFTER _stopping is visible and is
             # rejected here rather than queued for a loop that never runs
-            if self._stopping:
+            if self._stopping or self._draining:
                 raise RuntimeError("scheduler is stopped")
             self._inbox.append(req)
+            self._active += 1
         self._wake.set()
         return RequestHandle(req)
 
@@ -230,16 +235,32 @@ class ServingScheduler:
     def start(self) -> "ServingScheduler":
         assert self._thread is None, "scheduler already started"
         self._stopping = False
+        self._draining = False
         self._thread = threading.Thread(target=self._run, name="ds-serve",
                                         daemon=True)
         self._thread.start()
         return self
 
-    def stop(self, timeout: float = 30.0) -> None:
+    def stop(self, timeout: float = 30.0, drain: bool = False) -> None:
+        """Stop the loop. ``drain=True`` first refuses new submissions and
+        lets in-flight requests run to completion; the WHOLE shutdown
+        (drain poll + thread join) is bounded by ``timeout``. Without
+        drain, pending requests are error-finished immediately."""
+        deadline = time.monotonic() + timeout
+        if drain and self._thread is not None:
+            with self._lock:
+                self._draining = True  # submit() rejects, loop keeps going
+            while time.monotonic() < deadline:
+                with self._lock:
+                    idle = self._active == 0  # submit().._finish() span —
+                    # immune to the loop's unlocked queue transfers
+                if idle:
+                    break
+                time.sleep(self._idle_wait)
         self._stopping = True
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout)
+            self._thread.join(max(0.0, deadline - time.monotonic()) or 0.01)
             self._thread = None
 
     def _run(self) -> None:
@@ -447,8 +468,9 @@ class ServingScheduler:
         if flush:
             self._engine.flush(req.uid)
         req.t_done = time.monotonic()
-        if req.error is None and not req.cancelled:
-            with self._lock:  # stats() snapshots under the same lock
+        with self._lock:  # stats()/drain read under the same lock
+            self._active -= 1
+            if req.error is None and not req.cancelled:
                 self._completed.append(
                     (req.t_submit, req.t_first, req.t_done,
                      len(req.outputs)))
@@ -499,10 +521,12 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path not in ("/generate", "/v1/completions"):
+            if self.path not in ("/generate", "/v1/completions",
+                                 "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})
                 return
-            openai = self.path == "/v1/completions"
+            chat = self.path == "/v1/chat/completions"
+            openai = chat or self.path == "/v1/completions"
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
@@ -512,6 +536,23 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                         body.setdefault("max_new_tokens", body["max_tokens"])
                     if isinstance(body.get("prompt"), str):
                         body.setdefault("text", body.pop("prompt"))
+                if chat:
+                    if body.get("stream"):
+                        raise ValueError("streaming chat completions are "
+                                         "not supported; use /generate "
+                                         "with stream for token streaming")
+                    msgs = body.get("messages")
+                    if not msgs:
+                        raise ValueError("chat completions need 'messages'")
+                    if tokenizer is None or not hasattr(
+                            tokenizer, "apply_chat_template"):
+                        raise ValueError("chat completions need a tokenizer "
+                                         "with a chat template")
+                    try:
+                        body["prompt"] = tokenizer.apply_chat_template(
+                            msgs, add_generation_prompt=True)
+                    except Exception as e:  # noqa: BLE001 — template errors
+                        raise ValueError(f"malformed messages: {e}") from e
                 prompt = body.get("prompt")
                 if prompt is None and "text" in body:
                     if tokenizer is None:
@@ -566,15 +607,20 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 return
             text = tokenizer.decode(tokens) if tokenizer is not None else None
             if openai:
-                # OpenAI completions response shape
+                # OpenAI completions / chat-completions response shapes
                 finish = ("length" if len(tokens)
                           >= int(body.get("max_new_tokens", 32)) else "stop")
+                choice = {"index": 0, "tokens": tokens,
+                          "finish_reason": finish}
+                if chat:
+                    choice["message"] = {"role": "assistant",
+                                         "content": text or ""}
+                else:
+                    choice["text"] = text if text is not None else ""
                 self._json(200, {
-                    "object": "text_completion",
-                    "choices": [{"index": 0,
-                                 "text": text if text is not None else "",
-                                 "tokens": tokens,
-                                 "finish_reason": finish}],
+                    "object": ("chat.completion" if chat
+                               else "text_completion"),
+                    "choices": [choice],
                     "usage": {"completion_tokens": len(tokens)}})
                 return
             out = {"tokens": tokens}

@@ -403,3 +403,61 @@ def test_splitfuse_midprefill_with_eos_and_starved_admits():
     while not (h1.finished and h2.finished):
         sched.step()
     assert 1 <= len(h1.result()) <= 3 and 1 <= len(h2.result()) <= 3
+
+
+def test_chat_completions_and_graceful_drain():
+    class ChatTok:
+        eos_token_id = None
+
+        def encode(self, s, add_special_tokens=True):
+            return [(ord(c) % 100) + 3 for c in s]
+
+        def decode(self, ids):
+            return "".join(chr((int(i) % 26) + 97) for i in ids)
+
+        def apply_chat_template(self, messages, add_generation_prompt=True):
+            ids = []
+            for m in messages:
+                ids += self.encode(m["role"]) + self.encode(m["content"])
+            return ids + ([99] if add_generation_prompt else [])
+
+    engine, *_ = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    httpd = create_http_server(sched, "127.0.0.1", 0, tokenizer=ChatTok())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          httpd.server_address[1],
+                                          timeout=120)
+        conn.request("POST", "/v1/chat/completions",
+                     json.dumps({"messages": [
+                         {"role": "user", "content": "hi"}],
+                         "max_tokens": 4}),
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert out["object"] == "chat.completion"
+        msg = out["choices"][0]["message"]
+        assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+        assert len(out["choices"][0]["tokens"]) == 4
+        def post_status(body):
+            conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()  # drain: the connection is reused
+            return r.status
+
+        assert post_status({}) == 400                     # no messages
+        # malformed messages -> 400 (template errors wrapped), not a
+        # dropped connection
+        assert post_status({"messages": [{"role": "user"}]}) == 400
+        # chat + stream -> clean 400 (no OpenAI stream shape support)
+        assert post_status({"messages": [{"role": "user", "content": "x"}],
+                            "stream": True}) == 400
+    finally:
+        httpd.shutdown()
+    # graceful drain: in-flight finishes cleanly, new submits rejected
+    h = sched.submit([1, 2, 3, 4], max_new_tokens=30)
+    sched.stop(drain=True, timeout=120)
+    assert h.result() == h.result() and len(h.result()) == 30
+    with pytest.raises(RuntimeError):
+        sched.submit([5, 6])
