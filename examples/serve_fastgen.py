@@ -39,6 +39,16 @@ def main():
     for p, o in zip(prompts, outs):
         print(f"prompt {p} -> {o}")
 
+    # the same engine behind the asynchronous daemon (Dynamic SplitFuse
+    # scheduling, token streaming) — what `bin/ds_serve` wraps in HTTP
+    from deepspeed_tpu.inference.v2 import ServingScheduler
+    sched = ServingScheduler(eng).start()
+    handle = sched.submit(prompts[0], max_new_tokens=args.max_new_tokens)
+    streamed = list(handle.stream(timeout=300))
+    sched.stop(drain=True)
+    assert streamed == outs[0], "daemon must match generate() greedily"
+    print(f"daemon streamed {len(streamed)} tokens (== generate output)")
+
 
 if __name__ == "__main__":
     main()
