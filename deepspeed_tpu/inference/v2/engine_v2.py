@@ -419,14 +419,15 @@ class InferenceEngineV2:
             if temperature != 0.0 or return_logprobs:
                 raise ValueError("speculative decoding is greedy-only "
                                  "(temperature=0, no logprobs)")
-            if (stop or min_new_tokens or repetition_penalty != 1.0
+            if (min_new_tokens or repetition_penalty != 1.0
                     or logits_processor is not None):
                 # the one-pass window verify compares raw argmax per
-                # position; history-dependent logit edits would make the
+                # position; history-dependent LOGIT edits would make the
                 # verified distribution position-dependent in ways the
-                # single forward can't reproduce
+                # single forward can't reproduce. (``stop`` composes: it
+                # only truncates outputs at retirement, like eos.)
                 raise ValueError("speculative decoding does not compose "
-                                 "with stop/min_new_tokens/"
+                                 "with min_new_tokens/"
                                  "repetition_penalty/logits_processor")
 
         def _controls(row, u):
@@ -676,6 +677,16 @@ class InferenceEngineV2:
                         cut = len(outputs[u]) - len(new_toks) \
                             + new_toks.index(eos_token_id) + 1
                         outputs[u] = outputs[u][:cut]
+                    if stop:
+                        # earliest stop-sequence END inside the appended
+                        # window; like the eos cut, the overshot KV needs no
+                        # rollback — the sequence retires and flushes
+                        out = outputs[u]
+                        first = len(out) - len(new_toks) + 1
+                        for end in range(max(first, 1), len(out) + 1):
+                            if self.hit_stop(out[:end], stop):
+                                outputs[u] = out[:end]
+                                break
                     if len(outputs[u]) > max_new_tokens:
                         outputs[u] = outputs[u][:max_new_tokens]
                     last_tok[u] = outputs[u][-1]

@@ -88,11 +88,25 @@ def test_logits_processor_hook():
     assert banned not in out
 
 
-def test_speculative_rejects_controls():
+def test_speculative_rejects_logit_controls_but_composes_stop():
     engine = _engine()
     with pytest.raises(ValueError, match="does not compose"):
         engine.generate([PROMPT], speculative="prompt_lookup",
                         repetition_penalty=1.5)
+    with pytest.raises(ValueError, match="does not compose"):
+        engine.generate([PROMPT], speculative="prompt_lookup",
+                        min_new_tokens=2)
+    # stop only truncates at retirement (like eos) -> composes, and the
+    # truncation point is token-identical to the plain greedy path
+    base = engine.generate([PROMPT], max_new_tokens=16)[0]
+    stop = [[base[5], base[6]]]
+    plain = engine.generate([PROMPT], max_new_tokens=16, stop=stop)[0]
+    assert plain == base[:7]
+    engine2 = _engine()
+    spec = engine2.generate([PROMPT], max_new_tokens=16, stop=stop,
+                            speculative="prompt_lookup",
+                            num_draft_tokens=4)[0]
+    assert spec == plain
 
 
 def test_daemon_matches_generate_with_controls():
