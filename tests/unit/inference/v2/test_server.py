@@ -461,3 +461,35 @@ def test_chat_completions_and_graceful_drain():
     assert h.result() == h.result() and len(h.result()) == 30
     with pytest.raises(RuntimeError):
         sched.submit([5, 6])
+
+
+def test_daemon_with_prefix_caching():
+    """Daemon over a prefix-caching engine: a second request sharing the
+    system prompt adopts cached blocks (fewer new allocations) and outputs
+    stay greedy-exact."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    engine = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=96, enable_prefix_caching=True))
+    shared = (np.arange(4 * BS) % 199).tolist()
+    sched = ServingScheduler(engine)
+    h1 = sched.submit(shared + [7, 8], max_new_tokens=4)
+    while not h1.finished:
+        sched.step()
+    pc = engine._state_manager.prefix_cache
+    assert len(pc) >= 4  # shared blocks registered on flush
+    h2 = sched.submit(shared + [9, 1], max_new_tokens=4)
+    while not h2.finished:
+        sched.step()
+    seqless = engine._state_manager.get_sequence(h2.uid)
+    assert seqless is None  # flushed
+    # exactness vs a no-cache engine
+    reset_mesh_context()
+    plain = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    assert plain.generate([shared + [9, 1]], max_new_tokens=4)[0] \
+        == h2.result()
