@@ -353,6 +353,50 @@ class InferenceEngineV2:
         return row
 
     @staticmethod
+    def prompt_lookup_draft(history, *, draft_ngram: int, max_tokens: int):
+        """Prompt-lookup drafting (Saxena): propose the tokens that
+        followed the most recent earlier occurrence of the trailing
+        n-gram. No draft model — the history IS the drafter."""
+        if max_tokens <= 0 or len(history) <= draft_ngram:
+            return []
+        pat = history[-draft_ngram:]
+        for s in range(len(history) - draft_ngram - 1, -1, -1):
+            if history[s:s + draft_ngram] == pat:
+                return [int(t) for t in
+                        history[s + draft_ngram:s + draft_ngram + max_tokens]]
+        return []
+
+    def accept_drafts(self, uid: int, draft, window_row):
+        """Greedy draft verification against one sequence's window logits
+        (``[N, vocab]``, rows 0..len(draft) valid): accept the longest
+        agreeing prefix plus the correction/bonus token, roll the rejected
+        tail back in place (KV + prefix-cache pending tokens), and resume
+        the deferred chain registration. Returns (new_tokens, n_accepted).
+        Shared by ``generate()`` and the serving daemon — ONE copy of the
+        rollback protocol."""
+        k = len(draft)
+        new_toks, m = [], 0
+        for j in range(k + 1):
+            t = int(window_row[j].argmax())
+            if j < k and draft[j] == t:
+                new_toks.append(t)
+                m += 1
+                continue
+            new_toks.append(t)
+            break
+        seq = self._state_manager.get_sequence(uid)
+        rejected = k - m
+        if rejected:
+            seq.rollback(rejected)
+            if self._state_manager.prefix_cache is not None:
+                seq.pending_tokens = \
+                    seq.pending_tokens[:len(seq.pending_tokens) - rejected]
+        if k:
+            # deferred registration now that seen is truthful
+            self._register_pending(seq)
+        return new_toks, m
+
+    @staticmethod
     def normalize_stop(stop):
         """``stop`` → list of token-id sequences (one flat list = one
         sequence; None/empty = no stop sequences)."""
@@ -593,23 +637,13 @@ class InferenceEngineV2:
                 if speculative else 0
 
             def _draft(u, budget):
-                """Prompt-lookup: propose the tokens that followed the most
-                recent earlier occurrence of the trailing n-gram."""
-                hist = prompts[u] + outputs[u]
-                if len(hist) <= draft_ngram:
-                    return []
-                pat = hist[-draft_ngram:]
                 seq = self._state_manager.get_sequence(u)
                 room = min(num_draft_tokens, budget,
                            sm.max_context - seq.seen_tokens - 2,
                            max_new_tokens - len(outputs[u]) - 1)
-                if room <= 0:
-                    return []
-                for s in range(len(hist) - draft_ngram - 1, -1, -1):
-                    if hist[s:s + draft_ngram] == pat:
-                        return [int(t) for t in
-                                hist[s + draft_ngram:s + draft_ngram + room]]
-                return []
+                return self.prompt_lookup_draft(prompts[u] + outputs[u],
+                                                draft_ngram=draft_ngram,
+                                                max_tokens=room)
 
             drafts = {}
             for u in live:
@@ -644,30 +678,11 @@ class InferenceEngineV2:
             if use_window:
                 # greedy verification: accept the longest draft prefix the
                 # model agrees with, emit the correction/bonus token, and
-                # roll the rejected tail back in place
+                # roll the rejected tail back in place (accept_drafts —
+                # shared with the serving daemon)
                 for i, u in enumerate(live):
-                    k = len(drafts[u])
-                    row = logits[i]          # [N, vocab]; rows 0..k valid
-                    new_toks, m = [], 0
-                    for j in range(k + 1):
-                        t = int(row[j].argmax())
-                        if j < k and drafts[u][j] == t:
-                            new_toks.append(t)
-                            m += 1
-                            continue
-                        new_toks.append(t)
-                        break
-                    rejected = k - m
+                    new_toks, _ = self.accept_drafts(u, drafts[u], logits[i])
                     seq = self._state_manager.get_sequence(u)
-                    if rejected:
-                        seq.rollback(rejected)
-                        if self._state_manager.prefix_cache is not None:
-                            seq.pending_tokens = \
-                                seq.pending_tokens[:len(seq.pending_tokens)
-                                                   - rejected]
-                    if drafts[u]:
-                        # deferred registration now that seen is truthful
-                        self._register_pending(seq)
                     # window puts defer the trailing-window free for EVERY
                     # sequence in the batch — resume it here
                     self._model.maybe_free_kv(seq)

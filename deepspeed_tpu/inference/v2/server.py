@@ -60,6 +60,9 @@ class _Request:
     min_new_tokens: int = 0
     repetition_penalty: float = 1.0
     logits_processor: Optional[object] = None
+    speculative: Optional[str] = None
+    num_draft_tokens: int = 4
+    draft_ngram: int = 2
     # scheduler state
     outputs: List[int] = field(default_factory=list)
     fed: int = 0                   # tokens of prompt+outputs already in KV
@@ -180,12 +183,24 @@ class ServingScheduler:
                stop=None,
                min_new_tokens: int = 0,
                repetition_penalty: float = 1.0,
-               logits_processor=None) -> RequestHandle:
+               logits_processor=None,
+               speculative: Optional[str] = None,
+               num_draft_tokens: int = 4,
+               draft_ngram: int = 2) -> RequestHandle:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if len(prompt) > self._max_context:
             raise SchedulingError(SchedulingResult.SequenceTokenLimitExceeded)
+        if speculative is not None:
+            if speculative != "prompt_lookup":
+                raise ValueError(f"unknown speculative mode {speculative!r}")
+            if (temperature != 0.0 or min_new_tokens
+                    or repetition_penalty != 1.0
+                    or logits_processor is not None):
+                raise ValueError("speculative decoding is greedy-only and "
+                                 "does not compose with min_new_tokens/"
+                                 "repetition_penalty/logits_processor")
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
@@ -194,7 +209,10 @@ class ServingScheduler:
                        stop=InferenceEngineV2.normalize_stop(stop),
                        min_new_tokens=int(min_new_tokens),
                        repetition_penalty=float(repetition_penalty),
-                       logits_processor=logits_processor)
+                       logits_processor=logits_processor,
+                       speculative=speculative,
+                       num_draft_tokens=int(num_draft_tokens),
+                       draft_ngram=int(draft_ngram))
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         with self._lock:
@@ -385,50 +403,114 @@ class ServingScheduler:
         if not self._live:
             return False
         budget = self._token_budget
-        reqs, chunks = [], []
-        for req in self._live:               # decode SLA pass
-            if req.pending == 1 and budget >= 1:
-                reqs.append(req)
-                chunks.append(req.feed_slice(1))
-                budget -= 1
-        for req in self._live:               # prefill chunks
-            if req.pending > 1 and budget > 0:
-                take = min(req.pending, budget)
-                reqs.append(req)
-                chunks.append(req.feed_slice(take))
-                budget -= take
-        if not reqs:
+        decodes = [r for r in self._live if r.pending == 1]
+        prefills = [r for r in self._live if r.pending > 1]
+        # decode SLA: every decoding sequence's 1 token is RESERVED before
+        # drafts or prefill chunks may spend anything (generate() reserves
+        # identically: draft_budget = max_batch - len(live))
+        reserve = min(len(decodes), budget)
+        spare = budget - reserve
+        d_reqs, d_chunks, drafted = [], [], {}
+        for req in decodes[:reserve]:
+            chunk = req.feed_slice(1)
+            if req.speculative and spare > 0 and req.outputs:
+                seq = self._engine._state_manager.get_sequence(req.uid)
+                room = min(req.num_draft_tokens, spare,
+                           self._max_context - seq.seen_tokens - 2,
+                           req.max_new_tokens - len(req.outputs) - 1)
+                d = InferenceEngineV2.prompt_lookup_draft(
+                    req.prompt + req.outputs,
+                    draft_ngram=req.draft_ngram, max_tokens=room)
+                if d:
+                    drafted[req.uid] = d
+                    chunk = chunk + d
+                    spare -= len(d)
+            d_reqs.append(req)
+            d_chunks.append(chunk)
+        p_reqs, p_chunks = [], []
+        for req in prefills:
+            if spare <= 0:
+                break
+            take = min(req.pending, spare)
+            p_reqs.append(req)
+            p_chunks.append(req.feed_slice(take))
+            spare -= take
+        if not d_reqs and not p_reqs:
             return False
-        try:
-            # do_checks stays ON: chunks always fit the ragged limits under
-            # the SplitFuse budget, and the feasibility check is what turns
-            # KV exhaustion into a catchable SchedulingError
-            logits = np.asarray(self._engine.put(
-                [r.uid for r in reqs], chunks))
-        except SchedulingError:
-            # KV exhausted mid-tick: evict the NEWEST live sequence
-            # (generate()'s recovery). A lone sequence held the WHOLE cache
-            # when it died, so its replay could never prefill — finish it
-            # truncated (generate()'s lone-sequence semantics) instead of
-            # requeueing it into a guaranteed admission error that would
-            # discard the tokens already streamed.
-            victim = self._live.pop()
-            self._engine.flush(victim.uid)
-            victim.fed = 0
-            if self._live:
-                self._waiting.insert(0, victim)
-            elif victim.outputs:
-                self._finish(victim, flush=False)
-            else:
-                victim.error = SchedulingError(
-                    SchedulingResult.KVCacheLimitExceeded)
-                self._finish(victim, flush=False)
-            return True
-        for req, chunk, row in zip(reqs, chunks, logits):
-            req.fed += len(chunk)
-            if req.pending == 0:  # feed complete: this row is the next token
-                self._emit(req, row)
+        if drafted and p_reqs:
+            # a prefill chunk inside a window-logits put would materialize
+            # [S, chunk, vocab] logits — issue the windowed decode put and
+            # the plain prefill put separately (generate() likewise keeps
+            # its admit put apart from its windowed decode put)
+            if self._tick_put(d_reqs, d_chunks, drafted) is None:
+                return True  # eviction ended the tick; next tick rebuilds
+            self._tick_put(p_reqs, p_chunks, {})
+        elif drafted:
+            self._tick_put(d_reqs, d_chunks, drafted)
+        else:
+            self._tick_put(d_reqs + p_reqs, d_chunks + p_chunks, {})
         self._retire_finished()
+        return True
+
+    def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:
+        """One ragged put + row processing. Returns None if KV exhaustion
+        evicted a sequence (the tick must end: the eviction may have
+        invalidated any other pending put group)."""
+        use_window = bool(drafted)
+        while True:
+            try:
+                # do_checks stays ON: chunks always fit the ragged limits
+                # under the SplitFuse budget, and the feasibility check is
+                # what turns KV exhaustion into a catchable SchedulingError
+                logits = np.asarray(self._engine.put(
+                    [r.uid for r in reqs], chunks,
+                    window_logits=use_window,
+                    defer_register=(frozenset(drafted)
+                                    if use_window else frozenset())))
+                break
+            except SchedulingError:
+                if use_window:
+                    # drafts don't justify evicting a healthy sequence:
+                    # retry the put draft-free (generate()'s rule)
+                    chunks = [c[:1] if r.uid in drafted else c
+                              for r, c in zip(reqs, chunks)]
+                    drafted, use_window = {}, False
+                    continue
+                # KV exhausted mid-tick: evict the NEWEST live sequence
+                # (generate()'s recovery). A lone sequence held the WHOLE
+                # cache when it died, so its replay could never prefill —
+                # finish it truncated (generate()'s lone-sequence
+                # semantics) instead of requeueing it into a guaranteed
+                # admission error discarding the tokens already streamed.
+                victim = self._live.pop()
+                self._engine.flush(victim.uid)
+                victim.fed = 0
+                if self._live:
+                    self._waiting.insert(0, victim)
+                elif victim.outputs:
+                    self._finish(victim, flush=False)
+                else:
+                    victim.error = SchedulingError(
+                        SchedulingResult.KVCacheLimitExceeded)
+                    self._finish(victim, flush=False)
+                return None
+        for req, chunk, row in zip(reqs, chunks, logits):
+            d = drafted.get(req.uid, [])
+            if d:
+                new_toks, m = self._engine.accept_drafts(req.uid, d, row)
+                req.fed += 1 + m
+                self._emit_many(req, new_toks)
+            else:
+                req.fed += len(chunk)
+                if req.pending == 0:  # feed complete: row is the next token
+                    self._emit(req, row[len(chunk) - 1]
+                               if use_window else row)
+            if use_window:
+                # window puts defer the trailing-window KV free for EVERY
+                # sequence in the batch — resume it here
+                seq = self._engine._state_manager.get_sequence(req.uid)
+                if seq is not None:
+                    self._engine._model.maybe_free_kv(seq)
         return True
 
     def _emit(self, req: _Request, logits_row) -> None:
@@ -447,6 +529,23 @@ class ServingScheduler:
             req.t_first = time.monotonic()
         req.outputs.append(int(tok))
         req.stream_q.put(int(tok))
+
+    def _emit_many(self, req: _Request, toks) -> None:
+        """Stream a verified draft run, applying the eos/stop/max cuts so
+        tokens past a cut never surface (generate()'s truncation rules;
+        the overshot KV needs no rollback — the request retires and
+        flushes)."""
+        for t in toks:
+            if len(req.outputs) >= req.max_new_tokens:
+                break
+            if not req.outputs:
+                req.t_first = time.monotonic()
+            req.outputs.append(int(t))
+            req.stream_q.put(int(t))
+            if req.eos_token_id is not None and int(t) == req.eos_token_id:
+                break
+            if req.stop and self._engine.hit_stop(req.outputs, req.stop):
+                break
 
     def _retire_finished(self) -> None:
         for req in list(self._live):
@@ -582,7 +681,10 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     stop=stop,
                     min_new_tokens=int(body.get("min_new_tokens", 0)),
                     repetition_penalty=float(
-                        body.get("repetition_penalty", 1.0)))
+                        body.get("repetition_penalty", 1.0)),
+                    speculative=body.get("speculative"),
+                    num_draft_tokens=int(body.get("num_draft_tokens", 4)),
+                    draft_ngram=int(body.get("draft_ngram", 2)))
             except (ValueError, SchedulingError) as e:
                 self._json(400, {"error": str(e)})
                 return

@@ -493,3 +493,109 @@ def test_daemon_with_prefix_caching():
         engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
     assert plain.generate([shared + [9, 1]], max_new_tokens=4)[0] \
         == h2.result()
+
+
+def test_daemon_speculative_greedy_exact():
+    """Daemon speculative decoding: token-identical to plain greedy (the
+    drafted path's defining property), with drafts actually accepted on
+    repetitive text, composed with stop, under mixed traffic."""
+    rng = np.random.default_rng(17)
+    motif = rng.integers(0, 200, size=10).tolist()
+    rep_prompt = (motif * 12)[:100]
+    plain_prompt = _prompts(1, seed=19)[0]
+
+    engine, *_ = _engine(num_blocks=256)
+    ref_rep = engine.generate([rep_prompt], max_new_tokens=24)[0]
+    ref_plain = engine.generate([plain_prompt], max_new_tokens=24)[0]
+
+    engine2, *_ = _engine(num_blocks=256)
+    sched = ServingScheduler(engine2)
+    h_rep = sched.submit(rep_prompt, max_new_tokens=24,
+                         speculative="prompt_lookup", num_draft_tokens=6)
+    h_plain = sched.submit(plain_prompt, max_new_tokens=24)
+    ticks = rep_done_tick = 0
+    while not (h_rep.finished and h_plain.finished):
+        sched.step()
+        ticks += 1
+        if h_rep.finished and not rep_done_tick:
+            rep_done_tick = ticks
+        assert ticks < 500
+    assert h_rep.result() == ref_rep
+    assert h_plain.result() == ref_plain
+    # drafting actually accelerated the repetitive request: it finished
+    # its 24 tokens in fewer ticks than one-token-per-tick would need
+    assert 0 < rep_done_tick < 24, \
+        f"drafts never accepted (done at tick {rep_done_tick})"
+
+    # composes with stop (truncation point identical to plain greedy)
+    stop = [[ref_rep[7], ref_rep[8]]]
+    engine3, *_ = _engine(num_blocks=256)
+    cut_ref = engine3.generate([rep_prompt], max_new_tokens=24, stop=stop)[0]
+    engine4, *_ = _engine(num_blocks=256)
+    sched4 = ServingScheduler(engine4)
+    h = sched4.submit(rep_prompt, max_new_tokens=24, stop=stop,
+                      speculative="prompt_lookup", num_draft_tokens=6)
+    while not h.finished:
+        sched4.step()
+    assert h.result() == cut_ref
+
+    # invalid compositions rejected at submit
+    with pytest.raises(ValueError, match="does not compose"):
+        sched4.submit([1, 2, 3], speculative="prompt_lookup",
+                      repetition_penalty=1.4)
+
+
+def test_speculative_decode_sla_and_prefill_coexistence():
+    """Drafts spend only SPARE budget (every decoding sequence keeps its
+    guaranteed token), and speculation keeps drafting while another
+    request prefills across ticks (two puts per tick)."""
+    rng = np.random.default_rng(23)
+    motif = rng.integers(0, 200, size=10).tolist()
+    rep_prompt = (motif * 12)[:100]
+
+    # budget 4: 3 plain decodes reserve 3, drafter gets only 1 spare draft
+    engine, *_ = _engine(num_blocks=256)
+    sched = ServingScheduler(engine, token_budget=4)
+    plains = [sched.submit(p, max_new_tokens=6)
+              for p in _prompts(3, lo=4, hi=8, seed=29)]
+    # let plains prefill first (they're tiny)
+    sched.step()
+    spec = sched.submit(rep_prompt, max_new_tokens=6,
+                        speculative="prompt_lookup", num_draft_tokens=6)
+    counts = {id(p): len(p._req.outputs) for p in plains}
+    for _ in range(400):
+        if all(h.finished for h in plains + [spec]):
+            break
+        live_before = {id(p) for p in plains if not p.finished}
+        sched.step()
+        for p in plains:
+            if id(p) in live_before and not p.finished:
+                # every live plain decode advanced ≥... at least not starved
+                assert len(p._req.outputs) >= counts[id(p)]
+                counts[id(p)] = len(p._req.outputs)
+    assert all(h.finished for h in plains + [spec])
+
+    # drafting while a long prompt prefills across ticks
+    engine2, *_ = _engine(num_blocks=256)
+    ref = engine2.generate([rep_prompt], max_new_tokens=20)[0]
+    engine3, *_ = _engine(num_blocks=256)
+    sched3 = ServingScheduler(engine3, token_budget=32)
+    h_spec = sched3.submit(rep_prompt, max_new_tokens=20,
+                           speculative="prompt_lookup", num_draft_tokens=6)
+    # prefill the speculative request fully first
+    for _ in range(20):
+        sched3.step()
+        if h_spec._req.outputs:
+            break
+    long_prompt = (np.arange(300) % 199).tolist()
+    h_long = sched3.submit(long_prompt, max_new_tokens=3)
+    done_tick = 0
+    for t in range(400):
+        sched3.step()
+        if h_spec.finished and not done_tick:
+            done_tick = t + 1
+        if h_spec.finished and h_long.finished:
+            break
+    assert h_spec.result() == ref
+    # accelerated despite the concurrent multi-tick prefill
+    assert done_tick < 19, f"drafting stalled under prefill ({done_tick})"
