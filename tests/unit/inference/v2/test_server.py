@@ -599,3 +599,27 @@ def test_speculative_decode_sla_and_prefill_coexistence():
     assert h_spec.result() == ref
     # accelerated despite the concurrent multi-tick prefill
     assert done_tick < 19, f"drafting stalled under prefill ({done_tick})"
+
+
+def test_daemon_over_moe_engine():
+    """Mixtral-style MoE model through the daemon: greedy outputs equal
+    generate() (the last daemon x model-family composition)."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, num_local_experts=4,
+                           num_experts_per_tok=2)
+    _, params = init_llama(cfg, seed=13)
+    engine = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    prompts = _prompts(3, seed=37)
+    ref = engine.generate(prompts, max_new_tokens=5)
+
+    reset_mesh_context()
+    engine2 = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    sched = ServingScheduler(engine2)
+    hs = [sched.submit(p, max_new_tokens=5) for p in prompts]
+    while not all(h.finished for h in hs):
+        sched.step()
+    assert [h.result() for h in hs] == ref
