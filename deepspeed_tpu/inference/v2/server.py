@@ -63,6 +63,8 @@ class _Request:
     speculative: Optional[str] = None
     num_draft_tokens: int = 4
     draft_ngram: int = 2
+    return_logprobs: bool = False
+    logprobs: list = field(default_factory=list)
     # scheduler state
     outputs: List[int] = field(default_factory=list)
     fed: int = 0                   # tokens of prompt+outputs already in KV
@@ -127,6 +129,12 @@ class RequestHandle:
             raise self._req.error
         return list(self._req.outputs)
 
+    def result_with_logprobs(self, timeout: Optional[float] = None):
+        """(tokens, per-token logprobs) — requires submit(...,
+        return_logprobs=True)."""
+        toks = self.result(timeout)
+        return toks, list(self._req.logprobs[:len(toks)])
+
     def cancel(self) -> None:
         self._req.cancelled = True
 
@@ -186,7 +194,8 @@ class ServingScheduler:
                logits_processor=None,
                speculative: Optional[str] = None,
                num_draft_tokens: int = 4,
-               draft_ngram: int = 2) -> RequestHandle:
+               draft_ngram: int = 2,
+               return_logprobs: bool = False) -> RequestHandle:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -197,10 +206,11 @@ class ServingScheduler:
                 raise ValueError(f"unknown speculative mode {speculative!r}")
             if (temperature != 0.0 or min_new_tokens
                     or repetition_penalty != 1.0
-                    or logits_processor is not None):
+                    or logits_processor is not None or return_logprobs):
                 raise ValueError("speculative decoding is greedy-only and "
                                  "does not compose with min_new_tokens/"
-                                 "repetition_penalty/logits_processor")
+                                 "repetition_penalty/logits_processor/"
+                                 "logprobs")
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
@@ -212,7 +222,8 @@ class ServingScheduler:
                        logits_processor=logits_processor,
                        speculative=speculative,
                        num_draft_tokens=int(num_draft_tokens),
-                       draft_ngram=int(draft_ngram))
+                       draft_ngram=int(draft_ngram),
+                       return_logprobs=bool(return_logprobs))
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         with self._lock:
@@ -523,8 +534,11 @@ class ServingScheduler:
                 eos_token_id=req.eos_token_id,
                 block_eos=block_eos,
                 logits_processor=req.logits_processor)
-        tok = self._engine._sample(logits_row, req.temperature, req.rng,
-                                   req.top_k, req.top_p)
+        tok, lp = self._engine._sample_with_logprob(
+            logits_row, req.temperature, req.rng, req.top_k, req.top_p,
+            want_lp=req.return_logprobs)
+        if req.return_logprobs:
+            req.logprobs.append(lp)
         if not req.outputs:
             req.t_first = time.monotonic()
         req.outputs.append(int(tok))
@@ -684,7 +698,8 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                         body.get("repetition_penalty", 1.0)),
                     speculative=body.get("speculative"),
                     num_draft_tokens=int(body.get("num_draft_tokens", 4)),
-                    draft_ngram=int(body.get("draft_ngram", 2)))
+                    draft_ngram=int(body.get("draft_ngram", 2)),
+                    return_logprobs=bool(body.get("logprobs")))
             except (ValueError, SchedulingError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -726,6 +741,8 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     "usage": {"completion_tokens": len(tokens)}})
                 return
             out = {"tokens": tokens}
+            if body.get("logprobs"):
+                out["logprobs"] = handle.result_with_logprobs()[1]
             if text is not None:
                 out["text"] = text
             self._json(200, out)

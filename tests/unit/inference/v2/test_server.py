@@ -623,3 +623,23 @@ def test_daemon_over_moe_engine():
     while not all(h.finished for h in hs):
         sched.step()
     assert [h.result() for h in hs] == ref
+
+
+def test_daemon_logprobs_match_generate():
+    engine, *_ = _engine()
+    prompts = _prompts(2, seed=41)
+    ref_t, ref_lp = engine.generate(prompts, max_new_tokens=5,
+                                    return_logprobs=True)
+    engine2, *_ = _engine()
+    sched = ServingScheduler(engine2)
+    hs = [sched.submit(p, max_new_tokens=5, return_logprobs=True)
+          for p in prompts]
+    while not all(h.finished for h in hs):
+        sched.step()
+    for h, t, lp in zip(hs, ref_t, ref_lp):
+        toks, lps = h.result_with_logprobs()
+        assert toks == t
+        assert np.allclose(lps, lp, atol=1e-5)
+    with pytest.raises(ValueError, match="does not compose"):
+        sched.submit([1, 2], speculative="prompt_lookup",
+                     return_logprobs=True)
