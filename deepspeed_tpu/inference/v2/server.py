@@ -7,21 +7,26 @@ point for exactly this loop). This module is that missing deployment layer,
 TPU-native and stdlib-only:
 
 - :class:`ServingScheduler` — a background thread running TRUE continuous
-  batching: requests arrive and retire asynchronously, every iteration runs
-  (at most) one ragged prefill ``put`` for newly admitted prompts and one
-  ragged decode ``put`` for all live sequences, tokens stream to each
-  caller the moment they are sampled. Admission reserves full decode
-  headroom (prompt + max_new_tokens blocks) exactly like
-  ``InferenceEngineV2.generate`` so a decode step cannot run the allocator
-  dry; if it still does (best-effort admission), the newest sequence is
-  evicted and replayed.
+  batching with Dynamic SplitFuse scheduling (the FastGen algorithm):
+  requests arrive and retire asynchronously; every tick is one ragged
+  forward of at most ``token_budget`` tokens where decoding sequences are
+  guaranteed their token first and prefills chunk into the remainder
+  (a drafted tick adds a separate windowed put — speculative decoding
+  rides the same loop). Per-request sampling controls, logprobs, token
+  streaming. Admission reserves full decode headroom (prompt +
+  max_new_tokens blocks) exactly like ``InferenceEngineV2.generate`` so
+  a tick cannot run the allocator dry; if it still does (best-effort
+  admission), the newest sequence is evicted and replayed.
 - :class:`RequestHandle` — caller's side of one request: ``stream()``
-  yields token ids as they land, ``result()`` blocks for the full output,
-  ``cancel()`` retires the sequence at the next scheduler tick.
+  yields token ids as they land, ``result()`` /
+  ``result_with_logprobs()`` block for the full output, ``cancel()``
+  retires the sequence at the next scheduler tick.
 - :func:`create_http_server` / ``bin/ds_serve`` — a ThreadingHTTPServer
-  exposing ``POST /generate`` (optionally chunk-streamed) and
-  ``GET /health``. Token-id native; pass a HF tokenizer name to accept
-  ``{"text": ...}`` bodies.
+  exposing ``POST /generate`` (optionally chunk-streamed), the OpenAI
+  ``/v1/completions`` and ``/v1/chat/completions`` shapes, and
+  ``GET /health`` (queue depths + TTFT/decode-rate aggregates).
+  Token-id native; pass a HF tokenizer name to accept ``{"text": ...}``
+  bodies, string stops, and chat messages.
 
 Single-threaded device access: ONLY the scheduler thread touches the
 engine. ``submit``/``cancel`` just enqueue under a lock and set an event,
