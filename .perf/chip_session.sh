@@ -25,7 +25,7 @@ run() { # name timeout cmd...
   # DS_SESSION_NO_RELAY_GUARD=1 skips the check (the dry-run harness test
   # has no relay to be up).
   if [ -z "$DS_SESSION_NO_RELAY_GUARD" ] \
-     && ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
+     && ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8082 || exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
     echo "RELAY DOWN before $name — aborting session $(date -u +%T)" >> $LOG
     snapshot
     exit 3

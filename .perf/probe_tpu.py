@@ -1,0 +1,10 @@
+"""TPU readiness probe for the watcher: device visible AND a compiled
+matmul runs end-to-end through the relay. Exit 0 = fire the session."""
+import jax, jax.numpy as jnp
+
+d = jax.devices()[0]
+print("probe device:", d)
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = jax.jit(lambda a: (a @ a).sum())(x)
+jax.block_until_ready(y)
+print("probe matmul ok:", float(y))

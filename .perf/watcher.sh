@@ -1,18 +1,19 @@
 #!/bin/bash
-# TPU relay watcher r4.4: the relay is a LOCAL tunnel (PALLAS_AXON_POOL_IPS
-# = 127.0.0.1, port 8471); when it's down the port is closed, so a TCP
+# TPU relay watcher r5: the relay is a LOCAL tunnel (PALLAS_AXON_POOL_IPS
+# = 127.0.0.1; /root/.relay.py listens on 8082+ as of 8/1, older relays
+# used 8471 — we probe both); when it's down the port is closed, so a TCP
 # check fails INSTANTLY where the jax probe hangs ~2.5 min to its timeout.
 # Cycle: fast port check every ~75s; only on an open port run the real jax
 # probe (compile+matmul readiness) and then the full chip session. KEEP
 # watching after a session completes (more windows -> more sweep coverage).
 cd /root/repo
-PROBE=/tmp/probe_tpu.py
+PROBE=/root/repo/.perf/probe_tpu.py
 LOG=/root/repo/.perf/watcher.log
 echo "watcher v4.4 start $(date -u +%FT%TZ)" >> $LOG
 N=0
 while true; do
   N=$((N+1))
-  if ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
+  if ! timeout 5 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8082 || exec 3<>/dev/tcp/127.0.0.1/8471' 2>/dev/null; then
     [ $((N % 8)) -eq 1 ] && echo "port closed #$N $(date -u +%FT%TZ)" >> $LOG
     sleep 75
     continue
