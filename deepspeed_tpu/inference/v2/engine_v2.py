@@ -109,10 +109,7 @@ class InferenceEngineV2:
                 # stage fed tokens for block registration post-forward; only
                 # the sub-block tail is ever retained (O(block) per step,
                 # not O(history))
-                pend = getattr(host_seq_desc, "pending_tokens", None)
-                if pend is None:
-                    pend = np.zeros(0, np.int32)
-                host_seq_desc.pending_tokens = np.concatenate([pend, tokens])
+                self._append_pending(host_seq_desc, tokens)
             batch_tokens[i] = tokens
             self._model.maybe_allocate_kv(host_seq_desc, tokens.size)
             host_seq_desc.pre_forward(tokens.size)
@@ -171,6 +168,16 @@ class InferenceEngineV2:
             for uid in batch_uids:
                 self.flush(uid)
         return out
+
+    @staticmethod
+    def _append_pending(seq, tokens) -> None:
+        """Stage fed tokens on the descriptor for prefix-cache registration
+        (shared by put() and fused_decode_steps)."""
+        pend = getattr(seq, "pending_tokens", None)
+        if pend is None:
+            pend = np.zeros(0, np.int32)
+        seq.pending_tokens = np.concatenate(
+            [pend, np.asarray(tokens, np.int32)])
 
     def _register_pending(self, seq) -> None:
         """Register the sequence's newly completed full KV blocks with the
@@ -396,6 +403,75 @@ class InferenceEngineV2:
             self._register_pending(seq)
         return new_toks, m
 
+    def fused_decode_steps(self, batch_uids, last_tokens, n_steps: int):
+        """``n_steps`` greedy decode steps for live sequences in ONE device
+        dispatch (model.fused_decode: lax.scan over the single-token forward
+        — the TPU analog of the reference v1 engine's CUDA-graph decode
+        replay, ``inference/engine.py:527``). Amortizes the per-step host
+        round-trip: on a relay-attached TPU a single decode dispatch costs
+        ~100ms+ of pure latency, so K fused steps decode up to K× faster.
+
+        Host contract: every uid is LIVE (has prefilled history), every
+        sequence has room for ``n_steps`` more tokens (context ceiling is the
+        caller's check), and KV blocks for all ``n_steps`` are allocated up
+        front here — raises SchedulingError(KVCacheLimitExceeded) without
+        side effects if they don't fit. Like the speculative window path,
+        prefix-cache registration and trailing-window frees are DEFERRED:
+        the caller trims to eos/stop and then runs ``_register_pending`` /
+        ``maybe_free_kv`` for sequences that stay live (retiring sequences
+        just flush). Returns int32 [n_seqs, n_steps] generated tokens."""
+        batch_uids = list(batch_uids)
+        seqs = []
+        for uid in batch_uids:
+            seq = self._state_manager.get_sequence(uid)
+            if seq is None or seq.seen_tokens == 0:
+                raise ValueError(f"fused_decode_steps: uid {uid} is not a "
+                                 "live prefilled sequence")
+            seqs.append(seq)
+        if len(seqs) > self._config.state_manager.max_ragged_sequence_count:
+            raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+        sm = self._config.state_manager
+        # feasibility before ANY allocation: the whole wave must fit —
+        # get_kv_requirements is the allocator's own arithmetic
+        free = self._state_manager.free_blocks
+        for seq in seqs:
+            if seq.seen_tokens + n_steps > sm.max_context:
+                raise SchedulingError(SchedulingResult.SequenceTokenLimitExceeded)
+            n_fit, req = self._model.get_kv_requirements(seq, n_steps, free)
+            if n_fit != n_steps:
+                raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+            free -= req
+        for seq in seqs:
+            self._model.maybe_allocate_kv(seq, n_steps)
+
+        from .ragged.ragged_wrapper import _bucket
+        S = _bucket(len(seqs), floor=1)
+        B = _bucket(max(s.cur_allocated_blocks for s in seqs), floor=1)
+        tokens = np.zeros(S, np.int32)
+        seq_lens = np.zeros(S, np.int32)
+        liv = np.zeros(S, np.int32)
+        block_table = np.zeros((S, B), np.int32)
+        for i, (seq, t) in enumerate(zip(seqs, last_tokens)):
+            tokens[i] = int(t)
+            seq_lens[i] = seq.seen_tokens
+            liv[i] = 1
+            block_table[i] = seq.block_table(B)
+        out = self._model.fused_decode(tokens, seq_lens, liv, block_table,
+                                       n_steps)  # [K, S]
+        out = out[:, :len(seqs)].T  # [n_seqs, K]
+
+        pc = self._state_manager.prefix_cache
+        for i, seq in enumerate(seqs):
+            seq.pre_forward(n_steps)
+            seq.post_forward()
+            if pc is not None:
+                # fed tokens this dispatch = the input token plus every
+                # generated token except the last (it is fed by the NEXT
+                # dispatch) — mirrors one put() append per step
+                self._append_pending(
+                    seq, np.concatenate([[tokens[i]], out[i, :-1]]))
+        return out
+
     @staticmethod
     def normalize_stop(stop):
         """``stop`` → list of token-id sequences (one flat list = one
@@ -426,7 +502,8 @@ class InferenceEngineV2:
                  stop=None,
                  min_new_tokens: int = 0,
                  repetition_penalty: float = 1.0,
-                 logits_processor=None):
+                 logits_processor=None,
+                 fused_decode_window: Optional[int] = None):
         """Continuous-batching decode: admit prompts in scheduler-feasible
         waves (Dynamic SplitFuse ``can_schedule`` gating), decode every live
         sequence in ONE ragged batch per step (the N=1 fast path), free KV on
@@ -455,8 +532,19 @@ class InferenceEngineV2:
         forward via window logits; accepted drafts land m+1 tokens per
         dispatch, rejected ones roll back in place. Memory-bound decode is
         where this pays: the verify pass re-reads the same weights a plain
-        step would."""
+        step would.
+
+        ``fused_decode_window``: cap on greedy multi-step fused decode (K
+        steps per dispatch, ``fused_decode_steps``). Default: 16 on TPU
+        (per-dispatch latency dominates single-token steps there), 1 (off)
+        on CPU. Applies only to plain greedy generation — any sampling
+        control, logprobs, or speculative mode uses the per-step path."""
         stop = self.normalize_stop(stop)
+        if fused_decode_window is None:
+            from ...ops.registry import on_tpu
+            fused_steps_cap = 16 if on_tpu() else 1
+        else:
+            fused_steps_cap = int(fused_decode_window)
         if speculative is not None:
             if speculative != "prompt_lookup":
                 raise ValueError(f"unknown speculative mode {speculative!r}")
@@ -630,6 +718,78 @@ class InferenceEngineV2:
             if not live:
                 continue
 
+            def _absorb_new_tokens(u, new_toks):
+                """Shared trim protocol for multi-token waves (fused decode
+                and speculative verification): append, cut at the earliest
+                eos, then at the earliest stop-sequence END inside the
+                appended window, cap at the output budget. Overshot KV needs
+                no rollback — a trimmed sequence retires and flushes."""
+                outputs[u].extend(new_toks)
+                logprobs[u].extend([None] * len(new_toks))
+                if eos_token_id is not None and eos_token_id in new_toks:
+                    cut = len(outputs[u]) - len(new_toks) \
+                        + new_toks.index(eos_token_id) + 1
+                    outputs[u] = outputs[u][:cut]
+                if stop:
+                    out = outputs[u]
+                    first = len(out) - len(new_toks) + 1
+                    for end in range(max(first, 1), len(out) + 1):
+                        if self.hit_stop(out[:end], stop):
+                            outputs[u] = out[:end]
+                            break
+                if len(outputs[u]) > max_new_tokens:
+                    outputs[u] = outputs[u][:max_new_tokens]
+                last_tok[u] = outputs[u][-1]
+
+            # fused multi-step fast path: plain greedy decode (no sampling
+            # controls, no logprobs, no drafts) runs K steps per dispatch —
+            # the CUDA-graph-replay analog (see fused_decode_steps). eos and
+            # ``stop`` compose by trim-and-retire: overshoot tokens belong to
+            # sequences that retire this wave, so their KV needs no rollback
+            # (same argument as the speculative window-overshoot path below).
+            fused_ok = (speculative is None and temperature == 0.0
+                        and not return_logprobs and min_new_tokens == 0
+                        and repetition_penalty == 1.0
+                        and logits_processor is None
+                        and fused_steps_cap > 1)
+            if fused_ok:
+                K = min(fused_steps_cap,
+                        min(max_new_tokens - len(outputs[u]) for u in live),
+                        min(sm.max_context
+                            - self._state_manager.get_sequence(u).seen_tokens
+                            for u in live))
+                # snap K down to a power of two: every distinct n_steps is a
+                # separate XLA program, so an arbitrary tail K (max_new=100 →
+                # 16,16,...,4,3?) would compile once per distinct value; the
+                # {2,4,8,16,...} ladder bounds compiles at O(log cap) per
+                # (S, B) bucket and the sub-2 tail uses the per-step path
+                while K >= 2 and K & (K - 1):
+                    K &= K - 1
+                toks = None
+                if K >= 2:
+                    try:
+                        toks = self.fused_decode_steps(
+                            live, [last_tok[u] for u in live], K)
+                    except SchedulingError:
+                        pass  # KV pressure: the single-step path below owns
+                        # the evict-and-replay protocol
+                if toks is not None:
+                    for i, u in enumerate(live):
+                        _absorb_new_tokens(u, list(map(int, toks[i])))
+                        seq = self._state_manager.get_sequence(u)
+                        done = (len(outputs[u]) >= max_new_tokens
+                                or (eos_token_id is not None
+                                    and outputs[u][-1] == eos_token_id)
+                                or (stop and self.hit_stop(outputs[u], stop))
+                                or seq.seen_tokens + 1 > sm.max_context)
+                        if not done:
+                            # deferred bookkeeping for sequences that decode
+                            # on; retiring ones just flush at the top of the
+                            # loop (pending garbage past eos never registers)
+                            self._register_pending(seq)
+                            self._model.maybe_free_kv(seq)
+                    continue
+
             # total drafted tokens are bounded by the ragged-batch budget
             # (each live seq is guaranteed its 1 real token first) and each
             # sequence's room by its context AND output budgets
@@ -686,25 +846,7 @@ class InferenceEngineV2:
                     # window puts defer the trailing-window free for EVERY
                     # sequence in the batch — resume it here
                     self._model.maybe_free_kv(seq)
-                    outputs[u].extend(new_toks)
-                    logprobs[u].extend([None] * len(new_toks))
-                    if eos_token_id is not None and eos_token_id in new_toks:
-                        cut = len(outputs[u]) - len(new_toks) \
-                            + new_toks.index(eos_token_id) + 1
-                        outputs[u] = outputs[u][:cut]
-                    if stop:
-                        # earliest stop-sequence END inside the appended
-                        # window; like the eos cut, the overshot KV needs no
-                        # rollback — the sequence retires and flushes
-                        out = outputs[u]
-                        first = len(out) - len(new_toks) + 1
-                        for end in range(max(first, 1), len(out) + 1):
-                            if self.hit_stop(out[:end], stop):
-                                outputs[u] = out[:end]
-                                break
-                    if len(outputs[u]) > max_new_tokens:
-                        outputs[u] = outputs[u][:max_new_tokens]
-                    last_tok[u] = outputs[u][-1]
+                    _absorb_new_tokens(u, new_toks)
             else:
                 for i, u in enumerate(live):
                     last_tok[u], lp = self._sample_with_logprob(

@@ -375,6 +375,48 @@ class RaggedLlamaModel:
         kv.update(new_cache)
         return logits
 
+    def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int):
+        """``n_steps`` greedy decode steps in ONE XLA program (lax.scan over
+        the single-token ragged forward). The TPU-native answer to the
+        reference v1 engine's CUDA-graph decode capture
+        (``inference/engine.py:527 _create_cuda_graph``): where CUDA graphs
+        amortize kernel-launch overhead by replaying a recorded decode step,
+        this amortizes the per-dispatch host/relay round-trip by scanning K
+        steps inside the compiled program — sampling (argmax), KV append and
+        position advance all stay on device.
+
+        Host contract: every live row's block table already covers
+        ``seq_lens + n_steps`` tokens (the engine pre-allocates); ``live`` is
+        0/1 per row (bucket padding rows are 0 — their KV writes drop to the
+        OOB slot and their position never advances, exactly like padding in
+        the per-step path). Returns int32 [n_steps, S] generated tokens
+        (rows of dead sequences repeat their input token).
+        """
+        kv = self._state_manager.kv_cache
+        total_slots = kv.num_blocks * kv.block_size
+        key = ("fused", tokens.shape[0], block_table.shape[1], n_steps)
+        fn = self._fwd_cache.get(key)
+        if fn is None:
+            kw = ({"out_shardings": (None, jax.tree_util.tree_map(
+                       lambda a: a.sharding, kv.cache))}
+                  if self._mesh_ctx is not None else {})
+            fn = jax.jit(partial(_fused_decode_loop, config=self.config,
+                                 block_size=self.kv_block_size,
+                                 attn_backend=self.attn_backend,
+                                 tp_size=self.tp_size,
+                                 kv_pad=self._kv_pad,
+                                 total_slots=total_slots,
+                                 n_steps=n_steps,
+                                 mesh=(self._mesh_ctx.mesh
+                                       if self._mesh_ctx is not None else None)),
+                         donate_argnums=(1, ), **kw)
+            self._fwd_cache[key] = fn
+        out, new_cache = fn(self.params, kv.cache, jnp.asarray(tokens),
+                            jnp.asarray(seq_lens), jnp.asarray(live),
+                            jnp.asarray(block_table))
+        kv.update(new_cache)
+        return np.asarray(out)
+
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
@@ -651,3 +693,41 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         cap = jnp.float32(cfg.final_logit_softcapping)
         logits = cap * jnp.tanh(logits / cap)
     return logits, ((cache_data, cache_scales) if kv_quant else cache_data)
+
+
+def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table, *,
+                       config, block_size, attn_backend, tp_size, kv_pad,
+                       total_slots, n_steps, mesh):
+    """K single-token ragged steps under one lax.scan: each iteration builds
+    the pure-decode RaggedBatch **in-trace** (for one new token per sequence
+    every field is a function of (block_table, seq_lens, tokens) — compare
+    the host fast path in ``ragged_wrapper.py finalize``) and reuses
+    ``_ragged_forward`` unchanged, so every model feature (GQA/ALiBi/windows/
+    MoE/int8-KV/TP) composes by construction. Greedy sampling in-program;
+    dead (padding) rows write to the OOB drop slot and never advance —
+    identical to how ``finalize`` pads short batches."""
+    S, B = block_table.shape
+    ar = jnp.arange(S, dtype=jnp.int32)
+    live_i = live.astype(jnp.int32)
+
+    def body(carry, _):
+        cache, toks, lens = carry
+        slot = block_table[ar, lens // block_size] * block_size + lens % block_size
+        slot = jnp.where(live_i > 0, slot, total_slots)  # padding → scatter drop
+        batch = RaggedBatch(
+            tokens=toks, token_seq=ar, token_pos=lens, token_slot=slot,
+            seq_start=ar, seq_n_new=live_i, seq_seen=lens,
+            block_table=block_table, last_token_idx=ar,
+            q_tok_idx=ar[:, None])
+        logits, cache = _ragged_forward(
+            params, cache, batch, config=config, block_size=block_size,
+            attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
+            mesh=mesh)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(live_i > 0, nxt, toks)
+        lens = lens + live_i
+        return (cache, nxt, lens), nxt
+
+    (cache, _, _), out = jax.lax.scan(body, (cache, tokens, seq_lens),
+                                      None, length=n_steps)
+    return out, cache

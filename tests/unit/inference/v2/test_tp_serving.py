@@ -72,6 +72,16 @@ def test_tp_serving_decode_continues_sharded(tmp_path):
     assert len(out) == 2 and all(len(o) == 4 for o in out)
     kv = engine._state_manager.kv_cache
     assert tuple(kv.cache.sharding.spec)[:3] == (None, None, "model")
+    # fused multi-step decode composes with TP: same tokens, cache stays
+    # head-sharded through the scanned program's donated carry
+    reset_mesh_context()
+    engine2 = build_llama_engine(cfg, seed=1, dtype=jnp.float32,
+                                 engine_config=ec)
+    out2 = engine2.generate(PROMPTS[:2], max_new_tokens=4,
+                            fused_decode_window=4)
+    assert out2 == out
+    kv2 = engine2._state_manager.kv_cache
+    assert tuple(kv2.cache.sharding.spec)[:3] == (None, None, "model")
 
 
 @pytest.mark.world_size(8)
