@@ -212,14 +212,16 @@ class RaggedLlamaModel:
                 return jax.device_put(np.asarray(x).astype(dtype), s)
 
             self.params = jax.tree_util.tree_map(_place, params, shardings)
-            # KV cache [L, 2, KV, slot, D] shards over the head dim — each
-            # chip holds 1/tp of the cache, the memory point of TP serving.
-            # Paged backend: nondivisible KV pads to a tp multiple (above),
-            # so the head dim always shards. Dense backend with
-            # kv_heads % tp != 0 replicates (correct, larger).
+            # KV cache [2L, slot, KV*D] shards over the folded head dim —
+            # each chip holds 1/tp of the cache, the memory point of TP
+            # serving (heads are contiguous D-wide strips, so the model-axis
+            # split lands on head boundaries). Paged backend: nondivisible
+            # KV pads to a tp multiple (above), so the head dim always
+            # shards. Dense backend with kv_heads % tp != 0 replicates
+            # (correct, larger).
             from jax.sharding import NamedSharding, PartitionSpec as P
             n_kv = config.num_key_value_heads + self._kv_pad
-            spec = (P(None, None, "model", None, None)
+            spec = (P(None, None, "model")
                     if n_kv % self.tp_size == 0 else P())
             self._cache_sharding = NamedSharding(self._mesh_ctx.mesh, spec)
         else:
@@ -500,28 +502,35 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             k = _rope_tok(k, cos, sin, batch.token_pos, cfg.rotary_dim,
                           cfg.rope_interleaved)
 
-        # paged write: one scatter of the new tokens' K/V into flat slots
-        # (cache is [layer, 2, KV, slot, D]; advanced indexing puts the
-        # token axis first, matching kv_new's [T, 2, KV, D]). kv_pad > 0:
+        # paged write: the cache is [2L, slot, KV*D] (k row 2l, v row 2l+1 —
+        # see kv_cache.py: the slot-major fold makes this scatter IN-PLACE
+        # on the donated buffer; the old head-major layout forced two
+        # whole-cache transposed copies per forward). kv_pad > 0:
         # nondivisible-GQA TP — the cache rides padded KV heads (zeros) so
         # the head dim splits evenly over the model axis
-        kv_new = jnp.stack([k, v], axis=1)
         if kv_pad:
-            kv_new = jnp.pad(kv_new, ((0, 0), (0, 0), (0, kv_pad), (0, 0)))
+            k_w = jnp.pad(k, ((0, 0), (0, kv_pad), (0, 0)))  # [T, KV+p, D]
+            v_w = jnp.pad(v, ((0, 0), (0, kv_pad), (0, 0)))
+        else:
+            k_w, v_w = k, v
+        KVt = nkv + kv_pad
         if kv_quant:
             # int8 cache: per-slot-vector symmetric quant at write time —
             # one scale per (k|v, head, token) over head_dim
-            kvf = kv_new.astype(jnp.float32)
-            sc = jnp.maximum(jnp.max(jnp.abs(kvf), axis=-1) / 127.0, 1e-8)
-            q_i8 = jnp.clip(jnp.round(kvf / sc[..., None]),
-                            -127, 127).astype(jnp.int8)
-            cache_data = cache_data.at[l, :, :, batch.token_slot, :].set(
-                q_i8, mode="drop")
-            cache_scales = cache_scales.at[l, :, :, batch.token_slot].set(
-                sc, mode="drop")
+            for row, w in ((2 * l, k_w), (2 * l + 1, v_w)):
+                wf = w.astype(jnp.float32)
+                sc = jnp.maximum(jnp.max(jnp.abs(wf), axis=-1) / 127.0, 1e-8)
+                w_i8 = jnp.clip(jnp.round(wf / sc[..., None]),
+                                -127, 127).astype(jnp.int8)
+                cache_data = cache_data.at[row, batch.token_slot, :].set(
+                    w_i8.reshape(T, KVt * hd), mode="drop")
+                cache_scales = cache_scales.at[row, :, batch.token_slot].set(
+                    sc, mode="drop")
         else:
-            cache_data = cache_data.at[l, :, :, batch.token_slot, :].set(
-                kv_new.astype(cache_data.dtype), mode="drop")
+            cache_data = cache_data.at[2 * l, batch.token_slot, :].set(
+                k_w.reshape(T, KVt * hd).astype(cache_data.dtype), mode="drop")
+            cache_data = cache_data.at[2 * l + 1, batch.token_slot, :].set(
+                v_w.reshape(T, KVt * hd).astype(cache_data.dtype), mode="drop")
 
         q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd)  # grouped queries
         if kv_pad:
@@ -550,13 +559,14 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 # identity (reference sharding/attn.py).
                 from jax.sharding import PartitionSpec as P
                 hspec = P(None, None, "model", None, None)
+                cspec = P(None, None, "model")  # [2L, slot, KV*D] head fold
                 rep = P()
                 # optional extra operands ride the shard_map with their own
                 # specs: int8 scales shard with the heads, slopes likewise
                 extra, extra_specs = [], []
                 if kv_quant:
                     extra.append(cache_scales)
-                    extra_specs.append(P(None, None, "model", None))
+                    extra_specs.append(P(None, "model", None))
                 if has_alibi:
                     from ...models.llama import alibi_slopes
                     slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)
@@ -577,7 +587,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
                 ctx = _smap(
                     _paged_local, mesh,
-                    tuple([hspec, hspec, rep, rep, rep] + extra_specs),
+                    tuple([hspec, cspec, rep, rep, rep] + extra_specs),
                     hspec, {"model"},
                 )(q_s, cache_data, batch.block_table, batch.seq_seen,
                   seq_lens, *extra)
@@ -591,13 +601,17 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 ctx = ctx[:, :, :nkv]  # drop the padded heads' outputs
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
-            hist = cache_data[l, :, :, slot_grid, :]  # [S, L, 2, KV, D]
+            # dense backend never pads KV heads (kv_pad is paged-only)
+            k_h = cache_data[2 * l][slot_grid].reshape(S, L, nkv, hd)
+            v_h = cache_data[2 * l + 1][slot_grid].reshape(S, L, nkv, hd)
             if kv_quant:  # int8: dequant the gathered window
-                sc = cache_scales[l][:, :, slot_grid]       # [2, KV, S, L]
-                sc = jnp.moveaxis(sc, (0, 1), (2, 3))        # [S, L, 2, KV]
-                hist = hist.astype(jnp.float32) * sc[..., None]
-            k_h = hist[:, :, 0].astype(jnp.float32)  # [S, L, KV, D]
-            v_h = hist[:, :, 1].astype(x.dtype)
+                k_sc = jnp.moveaxis(cache_scales[2 * l][:, slot_grid], 0, -1)
+                v_sc = jnp.moveaxis(
+                    cache_scales[2 * l + 1][:, slot_grid], 0, -1)  # [S, L, KV]
+                k_h = k_h.astype(jnp.float32) * k_sc[..., None]
+                v_h = v_h.astype(jnp.float32) * v_sc[..., None]
+            k_h = k_h.astype(jnp.float32)  # [S, L, KV, D]
+            v_h = v_h.astype(x.dtype)
             qf = q_s.astype(jnp.float32)
             scale = (cfg.attn_scale if cfg.attn_scale is not None
                      else 1.0 / float(np.sqrt(hd)))

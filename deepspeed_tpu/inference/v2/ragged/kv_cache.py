@@ -2,14 +2,22 @@
 
 Reference: ``deepspeed/inference/v2/ragged/kv_cache.py`` (BlockedKVCache).
 TPU design: ONE device array per allocation group shaped
-``[num_layers, 2, num_kv_heads, num_blocks * block_size, head_dim]`` — flat
-slot addressing means the model writes new K/V with a single scatter of
-per-token flat indices (``block_table[pos // bs] * bs + pos % bs``). The
-(layer, k/v, head)-major layout makes one KV page a contiguous
-``[block_size, head_dim]`` strip: exactly the DMA unit of the Pallas
-blocked-flash kernel (``ops/paged_attention.py``), which scalar-prefetches
-the block table and streams pages without ever materializing a gathered
-history window.
+``[2 * num_layers, num_blocks * block_size, num_kv_heads * head_dim]`` —
+k at row ``2l``, v at row ``2l+1``, flat slot addressing
+(``block_table[pos // bs] * bs + pos % bs``). This slot-major folded layout
+is SCATTER-NATIVE: the model appends new K/V with a single in-place donated
+scatter along the slot dim, with zero HLO temps — the earlier
+(layer, k/v, head)-major layout forced XLA to materialize two transposed
+copies of the entire cache per forward (2 GB of temps on a 1 GB cache;
+the 32k-context serving sweep OOMed on it, 8/1 window). The minor dim
+``KV*D`` is 128-lane aligned for typical shapes, so there is no tiling
+padding either. The Pallas blocked-flash kernel
+(``ops/paged_attention.py``) views it as ``[2L, pages, page_size, KV*D]``
+(a free reshape) and DMAs ``[2, page_size, head_dim]`` k+v page blocks.
+
+Int8 scales are ``[2L, num_kv_heads, slots]`` (slots minor — the scatter
+writes one f32 per (plane, head, token); the array is 1/64th the data size,
+so its layout is chosen for kernel reads, not scatter perfection).
 
 The cache is functional state: the jitted forward takes it as a donated
 argument and returns the updated array (no in-place mutation semantics to
@@ -37,21 +45,25 @@ class BlockedKVCache:
         self.dtype = (jnp.int8 if self.quantized
                       else resolve_dtype(config.cache_dtype, jnp.bfloat16))
         slots = num_blocks * config.block_size
-        self.shape = (n_layers, 2, n_kv, slots, head_dim)
+        self.shape = (2 * n_layers, slots, n_kv * head_dim)
+        self.scales_shape = (2 * n_layers, n_kv, slots)
         if config.cache_sharding is not None:
-            # allocate DIRECTLY under the sharding (TP serving: head dim
-            # over the model axis) — a default-placement zeros would OOM
-            # exactly the tp-sized caches the sharding exists for
+            # allocate DIRECTLY under the sharding (TP serving: the folded
+            # head dim over the model axis) — a default-placement zeros
+            # would OOM exactly the tp-sized caches the sharding exists for
             if self.quantized:
-                # scales [L, 2, KV, slots] shard like the cache minus the
-                # head_dim axis
+                # scales [2L, KV, slots] shard on the head dim like the data
+                # (a replicated data spec — the dense nondivisible-GQA
+                # fallback — replicates the scales too)
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                spec = tuple(config.cache_sharding.spec)[:4]
-                ssharding = NamedSharding(config.cache_sharding.mesh, P(*spec))
+                spec = tuple(config.cache_sharding.spec)
+                head_axis = spec[2] if len(spec) > 2 else None
+                ssharding = NamedSharding(config.cache_sharding.mesh,
+                                          P(None, head_axis, None))
                 self.cache = (
                     jax.jit(lambda: jnp.zeros(self.shape, jnp.int8),
                             out_shardings=config.cache_sharding)(),
-                    jax.jit(lambda: jnp.zeros(self.shape[:4], jnp.float32),
+                    jax.jit(lambda: jnp.zeros(self.scales_shape, jnp.float32),
                             out_shardings=ssharding)())
             else:
                 self.cache = jax.jit(lambda: jnp.zeros(self.shape, self.dtype),
@@ -61,7 +73,7 @@ class BlockedKVCache:
             # 4/head_dim bytes per element instead of 2 — half the KV HBM,
             # double the schedulable batch at the same budget
             self.cache = (jnp.zeros(self.shape, jnp.int8),
-                          jnp.zeros(self.shape[:4], jnp.float32))
+                          jnp.zeros(self.scales_shape, jnp.float32))
         else:
             self.cache = jnp.zeros(self.shape, dtype=self.dtype)
 

@@ -24,9 +24,17 @@ KV cache). TPU design, rather than a port of the CUDA atom machinery:
   to the scores in the ``[N, G, page]`` view (no gathers); ``attn_scale``
   overrides 1/sqrt(D) (GPT-Neo uses 1.0).
 
-Cache layout: ``[layers, 2(k/v), kv_heads, num_slots, head_dim]`` with
-``num_slots = num_pages * page_size`` — one (layer, plane, head, page) block
-is a contiguous ``[page_size, head_dim]`` strip, the unit of DMA.
+Cache layout: ``[2*layers, num_slots, kv_heads*head_dim]`` with k at row
+``2l``, v at row ``2l+1`` and ``num_slots = num_pages * page_size``. This is
+the SCATTER-NATIVE layout: the model's per-token KV append is a single
+in-place donated scatter along the slot dim (the earlier
+``[L, 2, KV, slots, D]`` layout made XLA materialize TWO transposed copies
+of the entire cache per forward — 2.01 GB of HLO temps on a 1 GB cache,
+measured 8/1; the 32k-context serving sweep OOMed on exactly that copy).
+The kernel views it as ``[2L, num_pages, page_size, KV*D]`` (a free
+middle-dim reshape) and DMAs one ``(2, page_size, head_dim)`` block per
+(layer, head, page) — k and v pages arrive in one ref; minor block dims
+``(page_size, D)`` are unchanged from the proven-on-silicon spec.
 """
 
 import functools
@@ -85,8 +93,8 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
         n, g, d = q.shape[1], q.shape[3], q.shape[4]
         ng = n * g
         q = q.reshape(ng, d)
-        k = kv_ref[0, 0, 0]  # [page, D]
-        v = kv_ref[0, 1, 0]
+        k = kv_ref[0, 0]  # [page, D] (block rows 2l / 2l+1 of the cache)
+        v = kv_ref[1, 0]
         if has_scales:
             # int8 KV: dequantize the page in-registers (per-slot-vector
             # scales) before the MXU dots — the cache rides HBM at 1
@@ -94,7 +102,7 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
             # [page, 1] (trailing singleton keeps the spec Mosaic-legal)
             # and broadcast over head_dim.
             k = k.astype(jnp.bfloat16) * scales_ref[0, 0, 0].astype(jnp.bfloat16)
-            v = v.astype(jnp.bfloat16) * scales_ref[0, 1, 0].astype(jnp.bfloat16)
+            v = v.astype(jnp.bfloat16) * scales_ref[1, 0, 0].astype(jnp.bfloat16)
 
         scores = jax.lax.dot_general(
             q, k, (((1, ), (1, )), ((), ())),
@@ -159,7 +167,8 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
 
     Args:
       q: ``[S, N, KV, G, D]`` grouped queries (N new tokens per sequence).
-      cache: ``[L, 2, KV, num_slots, D]`` full paged cache (never sliced).
+      cache: ``[2L, num_slots, KV*D]`` full paged cache (k row 2l, v row
+        2l+1; never sliced — see module docstring for why this layout).
       layer: scalar int — which layer's pages to read.
       block_table: ``[S, B]`` int32 page ids per sequence.
       seq_seen: ``[S]`` history length before this step.
@@ -170,7 +179,7 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
       under TP the caller passes each shard its GLOBAL-head slice (reference
       sharding/attn.py keeps head identity across shards); None derives them
       from local head indices, correct only unsharded.
-      cache_scales: optional ``[L, 2, KV, num_slots]`` per-slot-vector
+      cache_scales: optional ``[2L, KV, num_slots]`` per-slot-vector
       dequant scales for an int8 ``cache`` — pages dequantize in-kernel.
     Returns:
       ``[S, N, KV, G, D]`` in q.dtype.
@@ -178,6 +187,11 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     S, N, KV, G, D = q.shape
     B = block_table.shape[1]
     scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
+    L2, slots, KVD = cache.shape
+    n_pages = slots // page_size
+    # free reshape (middle-dim split): one (layer, head, page) DMA block is
+    # [2, page_size, D] — k and v pages arrive together
+    kv_pages = cache.reshape(L2, n_pages, page_size, KVD)
 
     def q_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
         return (s, 0, k, 0, 0)
@@ -187,29 +201,29 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
         # block indices skip the DMA re-fetch
         needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
         page = bt_r[s, jax.lax.min(b, needed - 1)]
-        return (layer_r[0], 0, k, page, 0)
+        return (layer_r[0], page, 0, k)
 
     def o_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
         return (s, 0, k, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, N, 1, G, D), q_map),
-        pl.BlockSpec((1, 2, 1, page_size, D), kv_map),
+        pl.BlockSpec((2, 1, page_size, D), kv_map),
     ]
-    inputs = [q, cache]
+    inputs = [q, kv_pages]
     has_scales = cache_scales is not None
     if has_scales:
         # scales page rides the same page lookup as its kv page. The caller
-        # passes [L, 2, KV, slots]; a trailing singleton is added so the
+        # passes [2L, KV, slots]; a trailing singleton is added so the
         # block's last two dims (page_size, 1) are Mosaic-lowerable
         # (sublane mult-of-8 / lane equal-to-array-dim).
         def scales_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
             needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
             page = bt_r[s, jax.lax.min(b, needed - 1)]
-            return (layer_r[0], 0, k, page, 0)
+            return (layer_r[0], k, page, 0, 0)
 
-        in_specs.append(pl.BlockSpec((1, 2, 1, page_size, 1), scales_map))
-        inputs.append(cache_scales[..., None])
+        in_specs.append(pl.BlockSpec((2, 1, 1, page_size, 1), scales_map))
+        inputs.append(cache_scales.reshape(L2, KV, n_pages, page_size, 1))
     has_alibi = use_alibi or slopes is not None
     if has_alibi:
         if slopes is None:
@@ -262,12 +276,16 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
     j = jnp.arange(L, dtype=jnp.int32)
     slot_grid = block_table[:, j // page_size] * page_size + j % page_size
-    hist = cache[layer][:, :, slot_grid, :]           # [2, KV, S, L, D]
+    # cache [2L, slots, KV*D]: gather the window rows, unfold the head dim
+    k_h = cache[2 * layer][slot_grid].reshape(S, L, KV, D)    # [S, L, KV, D]
+    v_h = cache[2 * layer + 1][slot_grid].reshape(S, L, KV, D)
     if cache_scales is not None:  # int8 cache: dequant the gathered window
-        sc = cache_scales[layer][:, :, slot_grid]     # [2, KV, S, L]
-        hist = hist.astype(jnp.float32) * sc[..., None].astype(jnp.float32)
-    k_h = jnp.moveaxis(hist[0], 1, 0).astype(jnp.float32)  # [S, KV, L, D]
-    v_h = jnp.moveaxis(hist[1], 1, 0).astype(jnp.float32)
+        k_sc = jnp.moveaxis(cache_scales[2 * layer][:, slot_grid], 0, -1)
+        v_sc = jnp.moveaxis(cache_scales[2 * layer + 1][:, slot_grid], 0, -1)
+        k_h = k_h.astype(jnp.float32) * k_sc[..., None].astype(jnp.float32)
+        v_h = v_h.astype(jnp.float32) * v_sc[..., None].astype(jnp.float32)
+    k_h = jnp.moveaxis(k_h, 2, 1).astype(jnp.float32)          # [S, KV, L, D]
+    v_h = jnp.moveaxis(v_h, 2, 1).astype(jnp.float32)
     qf = q.astype(jnp.float32)
     scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) * scale
     if softcap is not None:

@@ -30,8 +30,8 @@ def test_int8_cache_allocation_and_budget():
                         cache_dtype="int8")
     kv = BlockedKVCache(cfg, num_blocks=8)
     data, scales = kv.cache
-    assert data.dtype == jnp.int8 and data.shape == (2, 2, 4, 128, 64)
-    assert scales.dtype == jnp.float32 and scales.shape == (2, 2, 4, 128)
+    assert data.dtype == jnp.int8 and data.shape == (4, 128, 4 * 64)
+    assert scales.dtype == jnp.float32 and scales.shape == (4, 4, 128)
     # ~half the bytes of bf16 (int8 + fp32-scale/64-dim overhead)
     bf16 = BlockedKVCache(KVCacheConfig(block_size=16, cache_shape=(2, 4, 64),
                                         cache_dtype="bfloat16"), num_blocks=8)
@@ -94,8 +94,10 @@ def test_int8_cache_composes_with_tp():
             tensor_parallel={"tp_size": 2}))
     kv = engine._state_manager.kv_cache
     data, scales = kv.cache
-    assert tuple(data.sharding.spec)[:3] == (None, None, "model")
-    assert tuple(scales.sharding.spec)[:3] == (None, None, "model")
+    # folded layout: data [2L, slot, KV*D] shards the head fold; scales
+    # [2L, KV, slots] shard the head dim
+    assert tuple(data.sharding.spec) == (None, None, "model")
+    assert tuple(scales.sharding.spec) == (None, "model", None)
     got = _logits(engine, [0, 1], PROMPTS[:2])
     # TP's fp32 psum reassociation perturbs values near int8 rounding
     # boundaries, flipping single quant buckets (error ~scale/2 ≈ 1e-2);
@@ -104,3 +106,24 @@ def test_int8_cache_composes_with_tp():
     for a, b in zip(got, ref):
         cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
         assert cos > 0.999, cos
+
+
+@pytest.mark.world_size(8)
+def test_int8_dense_nondivisible_tp_replicates():
+    """Dense backend + kv_heads % tp != 0 + int8: the cache sharding is the
+    documented replicated fallback — allocation must not crash on the empty
+    PartitionSpec (regression: scales sharding indexed spec[2])."""
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(hidden_size=96, num_attention_heads=12,
+                           num_key_value_heads=6)
+    engine = build_llama_engine(
+        cfg, seed=2, dtype=jnp.float32, attn_backend="dense",
+        kv_cache_dtype="int8",
+        engine_config=RaggedInferenceEngineConfig(
+            tensor_parallel={"tp_size": 4}))
+    data, scales = engine._state_manager.kv_cache.cache
+    assert tuple(data.sharding.spec) in ((), (None, None, None))
+    out = engine.generate([PROMPTS[0]], max_new_tokens=3)
+    assert len(out[0]) == 3

@@ -154,8 +154,9 @@ def test_tp_paged_gqa_nondivisible_pads():
     assert model.attn_backend == "paged"
     assert model._kv_pad == 2
     kv = engine._state_manager.kv_cache
-    assert kv.cache.shape[2] == 8  # padded head dim
-    assert tuple(kv.cache.sharding.spec)[:3] == (None, None, "model")
+    # folded [2L, slot, KV*D]: 8 padded heads x head_dim 8
+    assert kv.cache.shape[2] == 8 * cfg.head_dim_
+    assert tuple(kv.cache.sharding.spec) == (None, None, "model")
     got = _logits(engine, [0, 1, 2], PROMPTS)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))

@@ -62,18 +62,17 @@ def test_paged_decode_bf16_matches_dense_fp32():
     qn = rng.standard_normal((S, N, KV, G, D))
     seen = np.asarray([ctx - N, ctx // 2], np.int32)
 
-    # paged layout: per-sequence pages laid out contiguously
-    cache = np.zeros((1, 2, KV, page * B * S, D), np.float32)
+    # paged layout [2L, slots, KV*D]: per-sequence pages laid out contiguously
+    cache = np.zeros((2, page * B * S, KV * D), np.float32)
     bt = np.zeros((S, B), np.int32)
     for s in range(S):
         for b in range(B):
             pid = s * B + b
             bt[s, b] = pid
             sl = slice(b * page, min((b + 1) * page, ctx))
-            cache[0, 0, :, pid * page:pid * page + sl.stop - sl.start] = \
-                kh[s, sl].transpose(1, 0, 2)
-            cache[0, 1, :, pid * page:pid * page + sl.stop - sl.start] = \
-                vh[s, sl].transpose(1, 0, 2)
+            n = sl.stop - sl.start
+            cache[0, pid * page:pid * page + n] = kh[s, sl].reshape(n, KV * D)
+            cache[1, pid * page:pid * page + n] = vh[s, sl].reshape(n, KV * D)
     # the new token's K/V live at position `seen[s]`
     out = paged_attention(
         jnp.asarray(qn, jnp.bfloat16),

@@ -14,7 +14,8 @@ INTERP = jax.default_backend() != "tpu"
 
 
 def _setup(rng, S, N, KV, G, D, ps, n_pages, B, seen, n_new, dtype=jnp.float32):
-    cache = jnp.asarray(rng.normal(size=(2, 2, KV, n_pages * ps, D)), dtype)
+    # cache layout [2L, slots, KV*D]: k row 2l, v row 2l+1 (kv_cache.py)
+    cache = jnp.asarray(rng.normal(size=(2 * 2, n_pages * ps, KV * D)), dtype)
     q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), dtype)
     bt = jnp.asarray(rng.permutation(n_pages)[:S * B].reshape(S, B), jnp.int32)
     seen = jnp.asarray(seen, jnp.int32)
@@ -87,7 +88,8 @@ def test_ragged_forward_paged_matches_dense():
     total = n_blocks * bs
     kvc = BlockedKVCache.__new__(BlockedKVCache)
     cache0 = jnp.asarray(np.random.default_rng(0).normal(
-        size=(cfg.num_hidden_layers, 2, cfg.num_key_value_heads, total, cfg.head_dim_)) * 0.1,
+        size=(2 * cfg.num_hidden_layers, total,
+              cfg.num_key_value_heads * cfg.head_dim_)) * 0.1,
         jnp.float32)
 
     # one seq: 5 seen tokens (pages 1,2), 2 new

@@ -42,7 +42,9 @@ def test_paged_attention_compiles_and_matches_dense():
     page, pages = 128, 4
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.bfloat16)
-    cache = jnp.asarray(rng.normal(size=(1, 2, KV, page * pages * S, D)), jnp.bfloat16)
+    # cache layout [2L, slots, KV*D] (kv_cache.py): k row 2l, v row 2l+1
+    cache = jnp.asarray(rng.normal(size=(2, page * pages * S, KV * D)),
+                        jnp.bfloat16)
     bt = jnp.asarray(np.arange(S * pages).reshape(S, pages), jnp.int32)
     seen = jnp.asarray([200, 77], jnp.int32)
     lens = seen + N
@@ -53,8 +55,10 @@ def test_paged_attention_compiles_and_matches_dense():
     outs = []
     for s in range(S):
         slots = (np.asarray(bt)[s, j // page] * page + j % page)
-        kk = np.asarray(cache, np.float32)[0, 0][:, slots]  # [KV, L, D]
-        vv = np.asarray(cache, np.float32)[0, 1][:, slots]
+        kk = np.asarray(cache, np.float32)[0][slots] \
+            .reshape(-1, KV, D).transpose(1, 0, 2)  # [KV, L, D]
+        vv = np.asarray(cache, np.float32)[1][slots] \
+            .reshape(-1, KV, D).transpose(1, 0, 2)
         qq = np.asarray(q, np.float32)[s, 0]  # [KV, G, D]
         mask = j < int(lens[s])
         sc = np.einsum("kgd,kld->kgl", qq, kk) / np.sqrt(D)
@@ -145,11 +149,13 @@ def test_paged_attention_int8_scales_compile_and_match():
     rng = np.random.default_rng(6)
     S, N, KV, G, D, page, nblocks = 2, 1, 4, 2, 64, 128, 6
     q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.bfloat16)
-    kv_f = rng.normal(size=(1, 2, KV, nblocks * page, D)).astype(np.float32)
-    sc = np.maximum(np.abs(kv_f).max(-1) / 127.0, 1e-8)
+    # [2L, slots, KV, D] staging view → folded [2L, slots, KV*D] data and
+    # [2L, KV, slots] scales (kv_cache.py layout)
+    kv_f = rng.normal(size=(2, nblocks * page, KV, D)).astype(np.float32)
+    sc = np.maximum(np.abs(kv_f).max(-1) / 127.0, 1e-8)  # [2, slots, KV]
     kv_i8 = np.clip(np.round(kv_f / sc[..., None]), -127, 127).astype(np.int8)
-    cache = jnp.asarray(kv_i8)
-    scales = jnp.asarray(sc, jnp.float32)
+    cache = jnp.asarray(kv_i8.reshape(2, nblocks * page, KV * D))
+    scales = jnp.asarray(sc.transpose(0, 2, 1), jnp.float32)  # [2L, KV, slots]
     bt = jnp.asarray(rng.permutation(nblocks)[None, :].repeat(S, 0), jnp.int32)
     seen = jnp.asarray([300, 40], jnp.int32)
     lens = seen + N
@@ -157,7 +163,8 @@ def test_paged_attention_int8_scales_compile_and_match():
                           cache_scales=scales)
     ref = paged_attention_reference(
         jnp.asarray(q, jnp.float32),
-        jnp.asarray(kv_i8.astype(np.float32) * sc[..., None]),
+        jnp.asarray((kv_i8.astype(np.float32) * sc[..., None])
+                    .reshape(2, nblocks * page, KV * D)),
         0, bt, seen, lens, page_size=page)
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(ref, np.float32),
