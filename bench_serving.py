@@ -130,6 +130,24 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                 "prefill_tok_s": round(ctx / prefill_s, 1),
             })
             checkpoint()  # relay windows die mid-run: persist each point
+
+            # fused multi-step decode (K steps per dispatch — the
+            # CUDA-graph-replay analog): same sequence, same budget,
+            # amortizes the per-dispatch host/relay round-trip
+            K = 16
+            out = eng.fused_decode_steps([uid], [tok], K)  # warm compile
+            t0 = time.perf_counter()
+            for _ in range(max(decode_steps // K, 2)):
+                out = eng.fused_decode_steps([uid], [int(out[0, -1])], K)
+            n_disp = max(decode_steps // K, 2)
+            dt = time.perf_counter() - t0
+            results.append({
+                "backend": backend, "context": ctx, "kv_dtype": kv_dtype or "bf16",
+                "fused_window": K,
+                "decode_tok_s": round(n_disp * K / dt, 2),
+                "decode_step_ms": round(1e3 * dt / (n_disp * K), 2),
+            })
+            checkpoint()
             eng.flush(uid)
 
         # continuous-batching throughput (the FastGen headline shape): N
@@ -157,6 +175,24 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                 # per-user token latency at this concurrency — the SLA side
                 # of FastGen's effective-throughput framing
                 "decode_step_ms": round(1e3 * dt / decode_steps, 2),
+            })
+            checkpoint()
+
+            # batched fused decode: N seqs x K steps per dispatch — the
+            # continuous-batching steady state with dispatch amortized
+            K = 16
+            toks_v = [toks[u] for u in uids]
+            out = eng.fused_decode_steps(uids, toks_v, K)  # warm
+            n_disp = max(decode_steps // K, 2)
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                out = eng.fused_decode_steps(uids, list(out[:, -1]), K)
+            dt = time.perf_counter() - t0
+            results.append({
+                "backend": backend, "context": ctx, "kv_dtype": kv_dtype or "bf16",
+                "concurrent_seqs": nseq, "fused_window": K,
+                "batched_decode_tok_s": round(nseq * n_disp * K / dt, 2),
+                "decode_step_ms": round(1e3 * dt / (n_disp * K), 2),
             })
             checkpoint()
             for u in uids:
@@ -214,8 +250,15 @@ def _measure_daemon(cfg, kv_block, backend, n_requests, ctx, new_tokens):
             * ((ctx + new_tokens) // kv_block + 2)),
         kv_block_size=kv_block)
     eng.model().attn_backend = backend
-    # warm the prefill + single/batched decode programs outside the timing
+    # warm prefill + per-bucket decode AND fused-tick programs outside the
+    # timing: the daemon's live count ramps 1->n_requests, so every power-
+    # of-two S bucket's fused (K=16) program must exist before the clock —
+    # at the PRODUCTION block-table bucket (decode_context=ctx), since the
+    # fused compile key includes the per-sequence block count
     eng.generate([prompts[0], prompts[1]], max_new_tokens=2)
+    bss = [b for b in (1, 2, 4, 8, 16, 32) if b <= n_requests]
+    eng.warmup(prefill_lens=(), batch_sizes=bss, fused_windows=(16, ),
+               decode_context=ctx)
     sched = ServingScheduler(eng, idle_wait=0.001).start()
     results = [None] * n_requests
 

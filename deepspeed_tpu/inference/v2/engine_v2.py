@@ -169,6 +169,34 @@ class InferenceEngineV2:
                 self.flush(uid)
         return out
 
+    def fused_window(self, uids, output_budgets, cap: int) -> int:
+        """Largest power-of-two K <= ``cap`` that EVERY sequence can absorb
+        (remaining output budget and context room); < 2 means the per-step
+        path should run. The power-of-two snap bounds fused-program
+        compiles at O(log cap) per bucket. Shared by generate() and the
+        serving daemon's fused tick."""
+        sm = self._config.state_manager
+        K = min(cap, min(output_budgets),
+                min(sm.max_context
+                    - self._state_manager.get_sequence(u).seen_tokens
+                    for u in uids))
+        while K >= 2 and K & (K - 1):
+            K &= K - 1
+        return K
+
+    def decode_finished(self, uid, outputs, max_new_tokens,
+                        eos_token_id, stop) -> bool:
+        """The ONE retire predicate: output budget spent, eos emitted, a
+        stop sequence hit, or the context ceiling reached (retiring before
+        the next decode put would raise for the whole batch). Shared by
+        generate()'s retirement scan, both fused paths, and the daemon."""
+        seq = self._state_manager.get_sequence(uid)
+        return (len(outputs) >= max_new_tokens
+                or (eos_token_id is not None and outputs
+                    and outputs[-1] == eos_token_id)
+                or (bool(stop) and self.hit_stop(outputs, stop))
+                or seq.seen_tokens + 1 > self._config.state_manager.max_context)
+
     @staticmethod
     def _append_pending(seq, tokens) -> None:
         """Stage fed tokens on the descriptor for prefix-cache registration
@@ -243,14 +271,22 @@ class InferenceEngineV2:
         return self._model.get_remaining_block_capacity(seq_desc)
 
     def warmup(self, prefill_lens=(128, ), batch_sizes=(1, ),
-               draft_tokens: int = 0) -> int:
+               draft_tokens: int = 0, fused_windows=(),
+               decode_context: int = 0) -> int:
         """Precompile the bucketed forward programs serving will hit, so the
         first real request doesn't pay compile latency (the reference's
         CUDA-graph warmup analog). Runs scratch sequences through put() —
         prefill at each length, plus the decode (1-token) program at each
         concurrent batch size — then flushes them. ``draft_tokens``: also
         warm the window-logits verify program speculative decoding uses
-        (1 + draft_tokens fed tokens). Returns the number of compiled
+        (1 + draft_tokens fed tokens). ``fused_windows``: K values whose
+        fused multi-step decode program (fused_decode_steps) should compile
+        per batch size — the serving daemon's steady-state tick.
+        ``decode_context``: prefill the batched scratch sequences to this
+        length first so the decode/fused programs compile at the production
+        BLOCK-TABLE bucket — the compile key includes the block bucket B,
+        and a 1-token scratch sequence (B=1) would warm a program the
+        ctx-length traffic never hits. Returns the number of compiled
         programs cached."""
         base = 1 << 28  # scratch uid space clear of real uids
         for n in prefill_lens:
@@ -272,10 +308,13 @@ class InferenceEngineV2:
             uids = list(range(base + 1, base + 1 + bs))
             scratch = frozenset(uids)
             for u in uids:
-                self.put([u], [[0]], adopt_prefix=False,
+                feed = np.zeros(max(1, int(decode_context)), np.int32)
+                self.put([u], [feed], do_checks=False, adopt_prefix=False,
                          defer_register=scratch)
             self.put(uids, [[0]] * bs,  # batched decode bucket
                      defer_register=scratch)
+            for K in fused_windows:
+                self.fused_decode_steps(uids, [0] * bs, int(K))
             for u in uids:
                 self.flush(u)
         return len(self._model._fwd_cache)
@@ -705,14 +744,8 @@ class InferenceEngineV2:
                     logprobs[u].append(lp)
                     live.append(u)
             for u in list(live):
-                seq = self._state_manager.get_sequence(u)
-                if (len(outputs[u]) >= max_new_tokens
-                        or (eos_token_id is not None
-                            and outputs[u][-1] == eos_token_id)
-                        or (stop and self.hit_stop(outputs[u], stop))
-                        # context ceiling: retire BEFORE the decode put would
-                        # raise SequenceTokenLimitExceeded for the whole batch
-                        or seq.seen_tokens + 1 > sm.max_context):
+                if self.decode_finished(u, outputs[u], max_new_tokens,
+                                        eos_token_id, stop):
                     live.remove(u)
                     self.flush(u)
             if not live:
@@ -753,18 +786,9 @@ class InferenceEngineV2:
                         and logits_processor is None
                         and fused_steps_cap > 1)
             if fused_ok:
-                K = min(fused_steps_cap,
-                        min(max_new_tokens - len(outputs[u]) for u in live),
-                        min(sm.max_context
-                            - self._state_manager.get_sequence(u).seen_tokens
-                            for u in live))
-                # snap K down to a power of two: every distinct n_steps is a
-                # separate XLA program, so an arbitrary tail K (max_new=100 →
-                # 16,16,...,4,3?) would compile once per distinct value; the
-                # {2,4,8,16,...} ladder bounds compiles at O(log cap) per
-                # (S, B) bucket and the sub-2 tail uses the per-step path
-                while K >= 2 and K & (K - 1):
-                    K &= K - 1
+                K = self.fused_window(
+                    live, [max_new_tokens - len(outputs[u]) for u in live],
+                    fused_steps_cap)
                 toks = None
                 if K >= 2:
                     try:
@@ -776,16 +800,13 @@ class InferenceEngineV2:
                 if toks is not None:
                     for i, u in enumerate(live):
                         _absorb_new_tokens(u, list(map(int, toks[i])))
-                        seq = self._state_manager.get_sequence(u)
-                        done = (len(outputs[u]) >= max_new_tokens
-                                or (eos_token_id is not None
-                                    and outputs[u][-1] == eos_token_id)
-                                or (stop and self.hit_stop(outputs[u], stop))
-                                or seq.seen_tokens + 1 > sm.max_context)
-                        if not done:
+                        if not self.decode_finished(u, outputs[u],
+                                                    max_new_tokens,
+                                                    eos_token_id, stop):
                             # deferred bookkeeping for sequences that decode
                             # on; retiring ones just flush at the top of the
                             # loop (pending garbage past eos never registers)
+                            seq = self._state_manager.get_sequence(u)
                             self._register_pending(seq)
                             self._model.maybe_free_kv(seq)
                     continue

@@ -161,9 +161,19 @@ class ServingScheduler:
     """
 
     def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05,
-                 token_budget: Optional[int] = None):
+                 token_budget: Optional[int] = None,
+                 fused_decode_window: Optional[int] = None):
         self._engine = engine
         self._idle_wait = idle_wait
+        if fused_decode_window is None:
+            from ...ops.registry import on_tpu
+            fused_decode_window = 16 if on_tpu() else 1
+        # steady-state fast path: when EVERY live request is a plain greedy
+        # decode and nothing waits to prefill, one tick runs K fused steps
+        # per dispatch (engine.fused_decode_steps — the CUDA-graph-replay
+        # analog); any sampling control or a pending prefill falls back to
+        # the per-token SplitFuse tick
+        self._fused_window = int(fused_decode_window)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._inbox: List[_Request] = []
@@ -421,6 +431,14 @@ class ServingScheduler:
         budget = self._token_budget
         decodes = [r for r in self._live if r.pending == 1]
         prefills = [r for r in self._live if r.pending > 1]
+        if (self._fused_window > 1 and decodes and not prefills
+                and not self._waiting and not self._inbox
+                and all(r.temperature == 0.0 and r.speculative is None
+                        and not r.return_logprobs and r.min_new_tokens == 0
+                        and r.repetition_penalty == 1.0
+                        and r.logits_processor is None for r in decodes)
+                and self._fused_tick(decodes)):
+            return True
         # decode SLA: every decoding sequence's 1 token is RESERVED before
         # drafts or prefill chunks may spend anything (generate() reserves
         # identically: draft_budget = max_batch - len(live))
@@ -465,6 +483,42 @@ class ServingScheduler:
             self._tick_put(d_reqs, d_chunks, drafted)
         else:
             self._tick_put(d_reqs + p_reqs, d_chunks + p_chunks, {})
+        self._retire_finished()
+        return True
+
+    def _fused_tick(self, decodes) -> bool:
+        """K greedy steps for every live decode in ONE dispatch. Returns
+        False (caller falls back to the per-token tick) when the window
+        can't reach 2 steps or KV pressure refuses the wave — the normal
+        tick owns eviction. Token accounting: the dispatch feeds each
+        request's pending token plus its K-1 first generations, so
+        ``fed += K`` restores the pending==1 decode invariant; requests
+        whose emit was cut short (eos/stop/max) retire this tick, exactly
+        the conditions _emit_many cut on."""
+        K = self._engine.fused_window(
+            [r.uid for r in decodes],
+            [r.max_new_tokens - len(r.outputs) for r in decodes],
+            self._fused_window)
+        if K < 2:
+            return False
+        try:
+            toks = self._engine.fused_decode_steps(
+                [r.uid for r in decodes],
+                [r.feed_slice(1)[0] for r in decodes], K)
+        except SchedulingError:
+            return False
+        for req, row in zip(decodes, toks):
+            req.fed += K
+            self._emit_many(req, [int(t) for t in row])
+            if not self._engine.decode_finished(
+                    req.uid, req.outputs, req.max_new_tokens,
+                    req.eos_token_id, req.stop):
+                # deferred bookkeeping for requests that decode on
+                # (fused_decode_steps defers like the speculative path);
+                # retiring ones flush in _retire_finished
+                seq = self._engine._state_manager.get_sequence(req.uid)
+                self._engine._register_pending(seq)
+                self._engine._model.maybe_free_kv(seq)
         self._retire_finished()
         return True
 
@@ -570,15 +624,11 @@ class ServingScheduler:
         for req in list(self._live):
             if not req.outputs or req.pending > 1:
                 continue  # still (re)prefilling — nothing sampled to judge
-            seq = self._engine._state_manager.get_sequence(req.uid)
-            if seq is None:
+            if self._engine._state_manager.get_sequence(req.uid) is None:
                 continue  # admitted this tick, nothing fed yet
-            if (len(req.outputs) >= req.max_new_tokens
-                    or (req.eos_token_id is not None
-                        and req.outputs[-1] == req.eos_token_id)
-                    or (req.stop
-                        and self._engine.hit_stop(req.outputs, req.stop))
-                    or seq.seen_tokens + 1 > self._max_context):
+            if self._engine.decode_finished(req.uid, req.outputs,
+                                            req.max_new_tokens,
+                                            req.eos_token_id, req.stop):
                 self._live.remove(req)
                 self._finish(req)
 

@@ -643,3 +643,72 @@ def test_daemon_logprobs_match_generate():
     with pytest.raises(ValueError, match="does not compose"):
         sched.submit([1, 2], speculative="prompt_lookup",
                      return_logprobs=True)
+
+
+def test_scheduler_fused_decode_matches_per_token():
+    """The steady-state fused tick (K greedy steps per dispatch) produces
+    token-identical results to the per-token SplitFuse tick, including
+    eos/stop cuts inside the window, and conserves KV blocks."""
+    engine, cfg, params = _engine()
+    prompts = _prompts(4, seed=3)
+    ref_sched = ServingScheduler(engine, fused_decode_window=1)
+    ref_h = [ref_sched.submit(p, max_new_tokens=12) for p in prompts]
+    while not all(h.finished for h in ref_h):
+        ref_sched.step()
+    ref = [h.result() for h in ref_h]
+
+    reset_mesh_context()
+    engine2, _, _ = _engine()
+    free0 = engine2._state_manager.free_blocks
+    sched = ServingScheduler(engine2, fused_decode_window=4)
+    handles = [sched.submit(p, max_new_tokens=12) for p in prompts]
+    while not all(h.finished for h in handles):
+        sched.step()
+    assert [h.result() for h in handles] == ref
+    assert engine2._state_manager.free_blocks == free0
+
+    # eos mid-stream: pick a token the reference emits mid-output
+    eos = next((t for o in ref for t in o[2:-2]), None)
+    if eos is not None:
+        reset_mesh_context()
+        ea, _, _ = _engine()
+        sa = ServingScheduler(ea, fused_decode_window=1)
+        ha = [sa.submit(p, max_new_tokens=12, eos_token_id=eos)
+              for p in prompts]
+        while not all(h.finished for h in ha):
+            sa.step()
+        reset_mesh_context()
+        eb, _, _ = _engine()
+        sb = ServingScheduler(eb, fused_decode_window=4)
+        hb = [sb.submit(p, max_new_tokens=12, eos_token_id=eos)
+              for p in prompts]
+        while not all(h.finished for h in hb):
+            sb.step()
+        assert [h.result() for h in hb] == [h.result() for h in ha]
+
+
+def test_scheduler_fused_falls_back_for_sampling_controls():
+    """One non-greedy request in the live set forces the per-token tick;
+    results for the greedy requests stay identical to an all-per-token
+    run (the fused path must never sample)."""
+    engine, cfg, params = _engine()
+    prompts = _prompts(3, seed=4)
+    ref_sched = ServingScheduler(engine, fused_decode_window=1)
+    rh = [ref_sched.submit(prompts[0], max_new_tokens=8),
+          ref_sched.submit(prompts[1], max_new_tokens=8,
+                           repetition_penalty=1.3),
+          ref_sched.submit(prompts[2], max_new_tokens=8)]
+    while not all(h.finished for h in rh):
+        ref_sched.step()
+    ref = [h.result() for h in rh]
+
+    reset_mesh_context()
+    engine2, _, _ = _engine()
+    sched = ServingScheduler(engine2, fused_decode_window=4)
+    hs = [sched.submit(prompts[0], max_new_tokens=8),
+          sched.submit(prompts[1], max_new_tokens=8,
+                       repetition_penalty=1.3),
+          sched.submit(prompts[2], max_new_tokens=8)]
+    while not all(h.finished for h in hs):
+        sched.step()
+    assert [h.result() for h in hs] == ref
