@@ -574,6 +574,39 @@ def breakdown(batch=8, seq=1024, iters=10):
     except Exception as e:  # noqa: BLE001
         report["flash_fwdbwd_ms"] = f"n/a ({str(e)[:80]})"
 
+    # XLA attention fwd+bwd at the same shape: the 0801T1906 trace showed
+    # the flash kernels at 70% of step time for ~6% of model FLOPs — if
+    # XLA's materialized-scores attention backward beats the Pallas pair
+    # at seq<=2k, the right per-shape dispatch is XLA, and this number
+    # decides it
+    try:
+        def xattn_loss(q):
+            return (_xla_attention(q, q, q, 1.0 / np.sqrt(hd), True)
+                    .astype(jnp.float32) ** 2).mean()
+        xb = jax.jit(jax.grad(xattn_loss))
+        t, _ = timed(lambda: xb(q), n=10)
+        report["xla_fwdbwd_ms"] = round(t * 1e3, 3)
+    except Exception as e:  # noqa: BLE001
+        report["xla_fwdbwd_ms"] = f"n/a ({str(e)[:80]})"
+
+    # isolated optimizer step: Adam over a model-sized flat param vector —
+    # bandwidth-bound floor ~13 ms at 0.4B params (26 B/param over ~800
+    # GB/s); a number far above that indicts the fused-optimizer kernel's
+    # blocking, not the model program
+    try:
+        from deepspeed_tpu.ops.fused_optimizer import fused_adam_step
+        nflat = int(n_params)
+        pf = jax.device_put(jnp.zeros((nflat, ), jnp.float32))
+        gf = jax.device_put(jnp.ones((nflat, ), jnp.float32) * 1e-3)
+        mf = jax.device_put(jnp.zeros((nflat, ), jnp.float32))
+        vf = jax.device_put(jnp.zeros((nflat, ), jnp.float32))
+        st = jax.jit(lambda p, g, m, v: fused_adam_step(
+            p, g, m, v, lr=1e-3, step=1))
+        t, _ = timed(lambda: st(pf, gf, mf, vf), n=10)
+        report["adam_step_ms"] = round(t * 1e3, 3)
+    except Exception as e:  # noqa: BLE001
+        report["adam_step_ms"] = f"n/a ({str(e)[:80]})"
+
     # exact compiled FLOPs of the fused step (XLA cost analysis)
     try:
         lowered = engine._train_step_fused.lower(
@@ -639,6 +672,8 @@ def measure():
                 (16, 1024, 20, "dots_saveable", True),  # bigger MXU footprint
                 (4, 1024, 10, True, True),              # full-remat floor
                 (8, 1024, 20, False, True, 8),          # hd128 head shape
+                (8, 1024, 20, "dots_saveable", True, 8),  # hd128 + dots: the
+                # no-remat hd128 OOMed in triage; dots freed 4.9G at hd64
                 (8, 1024, 20, False, 6),                # chunked scan (4 steps
                 # x 6 unrolled layers): most of unrolled's scheduling freedom
                 # at ~1/6 the HLO
@@ -659,6 +694,7 @@ def measure():
         attempts = [(8, 1024, 12, False, True),
                     (8, 1024, 12, "dots_saveable", True),
                     (8, 1024, 12, False, False),  # unrolled winner (cache-warm)
+                    (8, 1024, 12, "dots_saveable", True, 8),  # hd128 + dots
                     (16, 1024, 12, "dots_saveable", True),
                     (4, 1024, 12, False, True),
                     (4, 1024, 10, True, True)]

@@ -97,7 +97,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    def _compute():
+    def _compute(masked):
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
         # matmul operands stay in the INPUT dtype (bf16 on the training
         # path): the MXU's fast path is bf16 x bf16 with fp32 accumulation
@@ -111,7 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                                 preferred_element_type=jnp.float32) * scale
         if softcap is not None:  # Gemma-2: cap BEFORE masking
             s = softcap_scores(s, softcap)
-        if causal or window is not None:
+        if masked and (causal or window is not None):
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             if causal:
@@ -125,7 +125,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         m_safe = jnp.where(m_cur <= NEG_INF, 0.0, m_cur)
         p = jnp.exp(s - m_safe)
-        p = jnp.where(s <= NEG_INF, 0.0, p)
+        if masked:
+            # an INTERIOR block's scores are real numbers — only edge
+            # blocks can carry NEG_INF rows that must zero out
+            p = jnp.where(s <= NEG_INF, 0.0, p)
         corr = jnp.exp(jnp.where(m_prev <= NEG_INF, NEG_INF, m_prev - m_safe))
         l_cur = l_prev * corr + p.sum(axis=-1, keepdims=True)
         # p back to the input dtype for the MXU (standard flash practice —
@@ -141,12 +144,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         cond = ki * block_k <= qi * block_q + block_q - 1
     if window is not None:  # skip blocks entirely older than the window
         cond = cond & (ki * block_k + block_k - 1 >= qi * block_q - (window - 1))
-    if cond is True:
-        _compute()
+    if not causal and window is None:
+        if cond is True:
+            _compute(masked=False)
+        else:  # pragma: no cover — cond is always True without causal/window
+            @pl.when(cond)
+            def _():
+                _compute(masked=False)
     else:
-        @pl.when(cond)
+        # full/edge block specialization (splash-style): a block strictly
+        # inside the causal/window region skips the iota+select mask chain
+        # entirely — at seq >> block, most live blocks are interior, and
+        # the 0801T1906 trace showed this elementwise work dominating the
+        # kernel (70% of step time at ~6% of model FLOPs)
+        interior = True
+        if causal:
+            interior = ki * block_k + block_k - 1 <= qi * block_q
+        if window is not None:  # every (q, k) pair strictly inside window
+            interior = interior & (
+                qi * block_q + block_q - 1 - ki * block_k <= window - 1)
+
+        @pl.when(cond & interior)
         def _():
-            _compute()
+            _compute(masked=False)
+
+        @pl.when(cond & jnp.logical_not(interior))
+        def _():
+            _compute(masked=True)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
@@ -233,7 +257,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def _compute():
+    def _compute(masked):
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
         # operands stay in the input dtype for the MXU fast path (see
         # _fwd_kernel); fp32 only on accumulator outputs + softmax math
@@ -251,7 +275,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         if softcap is not None:
             t = jnp.tanh(s / softcap)
             s = softcap * t  # == softcap_scores; t reused for d/ds = 1 - t^2
-        if causal or window is not None:
+        if masked and (causal or window is not None):
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             if causal:
@@ -259,7 +283,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
             if window is not None:
                 s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
         p = jnp.exp(s - lse)
-        p = jnp.where(s <= NEG_INF, 0.0, p)
+        if masked:  # interior blocks never carry NEG_INF scores
+            p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -274,12 +299,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         cond = ki * block_k <= qi * block_q + block_q - 1
     if window is not None:
         cond = cond & (ki * block_k + block_k - 1 >= qi * block_q - (window - 1))
-    if cond is True:
-        _compute()
+    if not causal and window is None:
+        _compute(masked=False)
     else:
-        @pl.when(cond)
+        # full/edge specialization — see _fwd_kernel
+        interior = True
+        if causal:
+            interior = ki * block_k + block_k - 1 <= qi * block_q
+        if window is not None:
+            interior = interior & (
+                qi * block_q + block_q - 1 - ki * block_k <= window - 1)
+
+        @pl.when(cond & interior)
         def _():
-            _compute()
+            _compute(masked=False)
+
+        @pl.when(cond & jnp.logical_not(interior))
+        def _():
+            _compute(masked=True)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
@@ -299,7 +336,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def _compute():
+    def _compute(masked):
         g, bq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
         # operands stay in the input dtype for the MXU fast path (see
         # _fwd_kernel); fp32 only on accumulator outputs + softmax math
@@ -315,7 +352,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if softcap is not None:
             t = jnp.tanh(s / softcap)
             s = softcap * t  # == softcap_scores; t reused for d/ds = 1 - t^2
-        if causal or window is not None:
+        if masked and (causal or window is not None):
             q_pos = _row_pos(s.shape, block_q, qi * block_q)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             if causal:
@@ -323,7 +360,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window is not None:
                 s = jnp.where(q_pos - k_pos >= window, NEG_INF, s)
         p = jnp.exp(s - lse)
-        p = jnp.where(s <= NEG_INF, 0.0, p)
+        if masked:  # interior blocks never carry NEG_INF scores
+            p = jnp.where(s <= NEG_INF, 0.0, p)
         # dv += pᵀ @ do ; dk += dsᵀ @ q — over the folded G*BQ rows, which
         # also sums the G query heads sharing this KV head (GQA reduce)
         dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
@@ -344,12 +382,26 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         cond = qi * block_q + block_q - 1 >= ki * block_k
     if window is not None:  # ...and its first row is not past the window
         cond = cond & (qi * block_q <= ki * block_k + block_k - 1 + (window - 1))
-    if cond is True:
-        _compute()
+    if not causal and window is None:
+        _compute(masked=False)
     else:
-        @pl.when(cond)
+        # full/edge specialization — see _fwd_kernel. Interior here means
+        # every (q, k) pair in the tile is unmasked: the whole q block is
+        # at-or-after the kv block (causal) and inside the window
+        interior = True
+        if causal:
+            interior = ki * block_k + block_k - 1 <= qi * block_q
+        if window is not None:
+            interior = interior & (
+                qi * block_q + block_q - 1 - ki * block_k <= window - 1)
+
+        @pl.when(cond & interior)
         def _():
-            _compute()
+            _compute(masked=False)
+
+        @pl.when(cond & jnp.logical_not(interior))
+        def _():
+            _compute(masked=True)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -453,7 +505,10 @@ _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 # head_dim -> (block_q, block_k): smaller heads leave VMEM headroom for
 # bigger tiles (better MXU occupancy / fewer grid steps). Override for
 # on-chip tuning with DS_TPU_FLASH_BLOCKS="bq,bk".
-_BLOCK_TABLE = {64: (256, 256), 128: (128, 128)}
+# hd64 = (256, 512) measured on v5e 8/1: the same bench program ran 20%
+# faster than at (256, 256) — 28.7k vs 23.9k tok/s on the bs8 dots rung
+# (.perf/flash_256x512_r5_0801T1906.out).
+_BLOCK_TABLE = {64: (256, 512), 128: (128, 128)}
 
 
 def _default_blocks(head_dim: int):

@@ -3,8 +3,10 @@
 The anytime ladder is the round's perf-evidence instrument; these pin the
 invariants a relay window depends on:
 - every rung parses (5-tuple or 6-tuple with a head-count override);
-- reliably-landing rungs (scanned / full-remat floor) come before any
-  unrolled rung, whose cold compile is the >=25-min monster;
+- the ladder OPENS with scanned safety rungs (a short window lands a
+  number first), then the PROVEN-best unrolled bs8 program (8/1 window:
+  269 ms/step, its compile persists in the jax cache) — the remaining
+  big-HLO unrolled rung stays behind the full-remat floor;
 - the 8h x hd128 rung is the SAME model (param count) as 16h x hd64, so
   its MFU is apples-to-apples (bench.py ranks rungs by vs_baseline);
 - bench_engine_config is the single config source the triage scripts
@@ -36,24 +38,29 @@ def _ladder(monkeypatch, **env):
 
 def test_default_ladder_orders_reliable_rungs_first(monkeypatch):
     rungs = _ladder(monkeypatch)
-    scans = [r[3] for r in rungs]
-    # every scanned rung (incl. the full-remat floor) precedes every
-    # unrolled rung
-    first_unrolled = scans.index(False)
-    assert all(s is False for s in scans[first_unrolled:])
-    assert any(r[2] is True for r in rungs[:first_unrolled]), \
-        "full-remat floor must run before the unrolled cold compiles"
+    # the ladder OPENS with scanned safety rungs — a short window must land
+    # a number before any big-HLO program
+    assert rungs[0][3] is True and rungs[1][3] is True
+    # the proven-best unrolled bs8 program (8/1 breakdown: 269 ms/step =
+    # 0.68x bar) is promoted right after them; its compile is cache-warm
+    assert rungs[2] == (8, 1024, False, False, None)
+    # the full-remat floor still precedes the remaining unrolled monster
+    # (that one's compile has never been proven cheap)
+    monster = rungs.index((16, 1024, "dots_saveable", False, None))
+    assert rungs.index((4, 1024, True, True, None)) < monster
     # the hd128 head-shape rung is present and scanned
     assert (8, 1024, False, True, 8) in rungs
-    # the chunked-scan rung sits between the scanned rungs and the
-    # unrolled monsters (a fraction of their HLO, most of their freedom)
-    assert rungs.index((8, 1024, False, 6, None)) < first_unrolled
+    # the chunked-scan rung sits before the trailing unrolled monster
+    assert rungs.index((8, 1024, False, 6, None)) < monster
 
 
 def test_fast_ladder_is_scanned_with_fallbacks(monkeypatch):
     rungs = _ladder(monkeypatch, DS_BENCH_FAST="1")
     assert len(rungs) >= 3, "FAST mode must be a ladder, not a single rung"
-    assert all(r[3] for r in rungs), "FAST rungs must all be scanned"
+    # opens scanned; exactly ONE unrolled rung (the cache-warm winner) —
+    # fast mode must never queue a second cold big-HLO compile
+    assert rungs[0][3] is True and rungs[1][3] is True
+    assert sum(1 for r in rungs if r[3] is False) == 1
     assert rungs[-1][2] is True, "FAST ladder needs the full-remat floor"
 
 
