@@ -56,7 +56,7 @@ run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test
 # with measurement before any bench burns window time.
 # (1800s: the chunk6 probe added ~one multi-minute compile; with a warm
 # persistent cache the whole stage is seconds)
-run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4
+run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4 5
 # 3. fast train number: scanned mini-ladder (compiles cached by step 2)
 run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
 # 4. serving decode, fast (paged @1k ctx, 2-3 compiles) — the SECOND
@@ -84,10 +84,13 @@ run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g
 # 11. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
 # flash + selective remat)
 run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
-# 12. flash block sweep. VMEM math at hd=64/seq1024: even 1024-wide
-# blocks fit comfortably (<1MB/step scratch), so include whole-sequence
-# blocks — fewest grid steps, max MXU work per program.
-for B in "256,512" "512,512" "512,1024" "1024,1024"; do
+# 12. flash block sweep. The 0801T1906 xprof trace proved the flash
+# kernels are 70% of step time at ~6% of model FLOPs — per-grid-step
+# overhead over ~1100 tiny steps/layer (G=1 at 16 KV heads). Bigger
+# blocks = fewer steps: (256,512) already gave +20% whole-step. Sweep
+# LARGEST first (biggest expected win lands even in a short window);
+# VMEM at hd=64/seq1024 fits whole-sequence blocks comfortably.
+for B in "1024,1024" "512,1024" "512,512" "1024,512" "256,1024" "256,512"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B DS_BENCH_FAST=1 python bench.py
 done
 # 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a

@@ -1,0 +1,48 @@
+"""Compile-only probe of the REAL ragged forward at bench-serving sizing:
+after the [2L, slots, KV*D] refold, the decode program must have no
+whole-cache copy/transpose temps (the old layout cost 2 of them).
+
+AOT remote compile only — safe while a bench session owns the chip."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import LlamaConfig
+from deepspeed_tpu.models.llama import init_llama
+from deepspeed_tpu.inference.v2.model import _ragged_forward
+from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatch
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=24, num_attention_heads=16,
+                  num_key_value_heads=16, max_position_embeddings=40960)
+bs = 128
+SLOTS = 80 * bs  # fast-mode serving cache (10240 slots ~ 1 GB bf16)
+S, B = 8, 16     # decode bucket: 8 seqs
+D = cfg.head_dim_
+
+_, params = init_llama(cfg, seed=0)
+params = jax.tree_util.tree_map(
+    lambda x: jax.ShapeDtypeStruct(x.shape, jnp.bfloat16), params)
+cache = jax.ShapeDtypeStruct((2 * 24, SLOTS, 16 * D), jnp.bfloat16)
+ii = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+batch = RaggedBatch(tokens=ii(8), token_seq=ii(8), token_pos=ii(8),
+                    token_slot=ii(8), seq_start=ii(S), seq_n_new=ii(S),
+                    seq_seen=ii(S), block_table=ii(S, B),
+                    last_token_idx=ii(S), q_tok_idx=ii(S, 1))
+
+fn = jax.jit(functools.partial(_ragged_forward, config=cfg, block_size=bs,
+                               attn_backend="paged"), donate_argnums=(1,))
+c = fn.lower(params, cache, batch).compile()
+ma = c.memory_analysis()
+print("decode program: temps %.3f GB, args %.3f GB, alias %.3f GB"
+      % (ma.temp_size_in_bytes / 1e9, ma.argument_size_in_bytes / 1e9,
+         ma.alias_size_in_bytes / 1e9))
+hlo = c.as_text()
+big = [ln.strip()[:140] for ln in hlo.splitlines()
+       if (" copy(" in ln or " transpose(" in ln)
+       and ("bf16[48,10240" in ln or "10240,1024" in ln)]
+print(f"{len(big)} whole-cache copies/transposes")
+for ln in big[:5]:
+    print(" ", ln)

@@ -34,6 +34,9 @@ GRID = [
     # same params/FLOPs, MXU-friendlier head shape (bench ladder rung);
     # scanned, so it stays AHEAD of the >=25-min unrolled monsters
     ("scan/none/hd128", True, False, (8,), 8),
+    # hd128 with selective remat: no-remat hd128 proved OOM (0801T1906
+    # triage) but dots_saveable freed 4.9G at hd64 — probe the pairing
+    ("scan/dots/hd128", True, "dots_saveable", (8,), 8),
     # chunked scan (4 steps x 6 unrolled layers): unrolled-like scheduling
     # freedom at ~1/6 the HLO — the ladder probes it before the monsters
     ("chunk6/none", 6, False, (8,)),

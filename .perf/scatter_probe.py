@@ -1,0 +1,61 @@
+"""Compile-only probe: does the per-token KV scatter force XLA to
+materialize a transposed copy of the whole cache, and what does the
+persistent cache buffer really cost in HBM (tiling padding)?
+
+Runs AOT compile over the relay's compile helper — no chip execution, safe
+to run while a bench session owns the device."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+L, KV, SLOTS, D = 24, 16, 10240, 64
+T = 8  # decode-sized token burst
+
+
+def scatter_step(cache, kv_new, slots):
+    for l in range(2):  # two layers is enough to see the pattern
+        cache = cache.at[l, :, :, slots, :].set(kv_new, mode="drop")
+    return cache
+
+
+cache = jax.ShapeDtypeStruct((L, 2, KV, SLOTS, D), jnp.bfloat16)
+kv_new = jax.ShapeDtypeStruct((T, 2, KV, D), jnp.bfloat16)
+slots = jax.ShapeDtypeStruct((T,), jnp.int32)
+
+fn = jax.jit(scatter_step, donate_argnums=(0,))
+c = fn.lower(cache, kv_new, slots).compile()
+ma = c.memory_analysis()
+print("args", ma.argument_size_in_bytes / 1e9, "GB; temps",
+      ma.temp_size_in_bytes / 1e9, "GB; out", ma.output_size_in_bytes / 1e9,
+      "GB; alias", ma.alias_size_in_bytes / 1e9, "GB")
+hlo = c.as_text()
+big_copies = [ln.strip()[:160] for ln in hlo.splitlines()
+              if (" copy(" in ln or "transpose(" in ln) and "bf16[24," in ln]
+print(f"{len(big_copies)} full-cache copies/transposes:")
+for ln in big_copies[:6]:
+    print(" ", ln)
+
+# variant: slot-major folded layout [L, slots, 2*KV*D] — scatter-native,
+# lane-dim 2048 (no tiling padding)
+def scatter_folded(cache, kv_new, slots):
+    upd = kv_new.reshape(T, 2 * KV * D)
+    for l in range(2):
+        cache = cache.at[l, slots, :].set(upd, mode="drop")
+    return cache
+
+
+cache_f = jax.ShapeDtypeStruct((L, SLOTS, 2 * KV * D), jnp.bfloat16)
+c2 = jax.jit(scatter_folded, donate_argnums=(0,)).lower(
+    cache_f, kv_new, slots).compile()
+ma2 = c2.memory_analysis()
+print("folded: args", ma2.argument_size_in_bytes / 1e9, "GB; temps",
+      ma2.temp_size_in_bytes / 1e9, "GB")
+hlo2 = c2.as_text()
+big2 = [ln.strip()[:160] for ln in hlo2.splitlines()
+        if (" copy(" in ln or "transpose(" in ln) and "bf16[24," in ln]
+print(f"folded: {len(big2)} full-cache copies/transposes")
+for ln in big2[:4]:
+    print(" ", ln)
+sys.exit(0)
