@@ -516,7 +516,8 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         KVt = nkv + kv_pad
         if kv_quant:
             # int8 cache: per-slot-vector symmetric quant at write time —
-            # one scale per (k|v, head, token) over head_dim
+            # one scale per (k|v, head, token) over head_dim; scales are
+            # slot-major [2L, slots, KV] so this scatter is in-place too
             for row, w in ((2 * l, k_w), (2 * l + 1, v_w)):
                 wf = w.astype(jnp.float32)
                 sc = jnp.maximum(jnp.max(jnp.abs(wf), axis=-1) / 127.0, 1e-8)
@@ -524,7 +525,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                                 -127, 127).astype(jnp.int8)
                 cache_data = cache_data.at[row, batch.token_slot, :].set(
                     w_i8.reshape(T, KVt * hd), mode="drop")
-                cache_scales = cache_scales.at[row, :, batch.token_slot].set(
+                cache_scales = cache_scales.at[row, batch.token_slot, :].set(
                     sc, mode="drop")
         else:
             cache_data = cache_data.at[2 * l, batch.token_slot, :].set(
@@ -532,9 +533,11 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             cache_data = cache_data.at[2 * l + 1, batch.token_slot, :].set(
                 v_w.reshape(T, KVt * hd).astype(cache_data.dtype), mode="drop")
 
-        q_s = q[q_tok_idx].reshape(S, N, nkv, g, hd)  # grouped queries
+        # queries head-major [S, N, H, D] (H = KV*G kv-major = the natural
+        # q head order); padded KV heads append G zero q heads at the END
+        q_s = q[q_tok_idx]  # [S, N, nq, hd]
         if kv_pad:
-            q_s = jnp.pad(q_s, ((0, 0), (0, 0), (0, kv_pad), (0, 0), (0, 0)))
+            q_s = jnp.pad(q_s, ((0, 0), (0, 0), (0, kv_pad * g), (0, 0)))
 
         if attn_backend == "paged":
             # Pallas blocked-flash: stream the block-table pages, online
@@ -558,7 +561,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 # the heads, so each shard biases with its true head
                 # identity (reference sharding/attn.py).
                 from jax.sharding import PartitionSpec as P
-                hspec = P(None, None, "model", None, None)
+                hspec = P(None, None, "model", None)  # q/o [S, N, H, D]
                 cspec = P(None, None, "model")  # [2L, slot, KV*D] head fold
                 rep = P()
                 # optional extra operands ride the shard_map with their own
@@ -566,7 +569,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                 extra, extra_specs = [], []
                 if kv_quant:
                     extra.append(cache_scales)
-                    extra_specs.append(P(None, "model", None))
+                    extra_specs.append(P(None, None, "model"))
                 if has_alibi:
                     from ...models.llama import alibi_slopes
                     slopes = jnp.asarray(alibi_slopes(nq)).reshape(nkv, g)
@@ -598,21 +601,20 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                                       cache_scales=cache_scales,
                                       **kernel_kw)
             if kv_pad:
-                ctx = ctx[:, :, :nkv]  # drop the padded heads' outputs
+                ctx = ctx[:, :, :nq]  # drop the padded heads' outputs
             ctx = ctx.astype(x.dtype).reshape(S, N, nq * hd)
         else:
             # dense backend never pads KV heads (kv_pad is paged-only)
             k_h = cache_data[2 * l][slot_grid].reshape(S, L, nkv, hd)
             v_h = cache_data[2 * l + 1][slot_grid].reshape(S, L, nkv, hd)
             if kv_quant:  # int8: dequant the gathered window
-                k_sc = jnp.moveaxis(cache_scales[2 * l][:, slot_grid], 0, -1)
-                v_sc = jnp.moveaxis(
-                    cache_scales[2 * l + 1][:, slot_grid], 0, -1)  # [S, L, KV]
+                k_sc = cache_scales[2 * l][slot_grid]       # [S, L, KV]
+                v_sc = cache_scales[2 * l + 1][slot_grid]
                 k_h = k_h.astype(jnp.float32) * k_sc[..., None]
                 v_h = v_h.astype(jnp.float32) * v_sc[..., None]
             k_h = k_h.astype(jnp.float32)  # [S, L, KV, D]
             v_h = v_h.astype(x.dtype)
-            qf = q_s.astype(jnp.float32)
+            qf = q_s.reshape(S, N, nkv, g, hd).astype(jnp.float32)
             scale = (cfg.attn_scale if cfg.attn_scale is not None
                      else 1.0 / float(np.sqrt(hd)))
             scores = jnp.einsum("snkgd,slkd->snkgl", qf, k_h) * jnp.float32(scale)

@@ -13,11 +13,12 @@ the 32k-context serving sweep OOMed on it, 8/1 window). The minor dim
 ``KV*D`` is 128-lane aligned for typical shapes, so there is no tiling
 padding either. The Pallas blocked-flash kernel
 (``ops/paged_attention.py``) views it as ``[2L, pages, page_size, KV*D]``
-(a free reshape) and DMAs ``[2, page_size, head_dim]`` k+v page blocks.
+(a free reshape) and DMAs one ``[2, page_size, KV*head_dim]`` all-heads
+k+v page block per (layer, page) grid step.
 
-Int8 scales are ``[2L, num_kv_heads, slots]`` (slots minor — the scatter
-writes one f32 per (plane, head, token); the array is 1/64th the data size,
-so its layout is chosen for kernel reads, not scatter perfection).
+Int8 scales are ``[2L, slots, num_kv_heads]`` — slot-major like the data,
+so the per-token scale write is the same in-place scatter, and the kernel
+views them ``[2L, pages, page_size, KV]`` (legal block minor dims).
 
 The cache is functional state: the jitted forward takes it as a donated
 argument and returns the updated array (no in-place mutation semantics to
@@ -46,20 +47,21 @@ class BlockedKVCache:
                       else resolve_dtype(config.cache_dtype, jnp.bfloat16))
         slots = num_blocks * config.block_size
         self.shape = (2 * n_layers, slots, n_kv * head_dim)
-        self.scales_shape = (2 * n_layers, n_kv, slots)
+        self.scales_shape = (2 * n_layers, slots, n_kv)
         if config.cache_sharding is not None:
             # allocate DIRECTLY under the sharding (TP serving: the folded
             # head dim over the model axis) — a default-placement zeros
             # would OOM exactly the tp-sized caches the sharding exists for
             if self.quantized:
-                # scales [2L, KV, slots] shard on the head dim like the data
+                # scales [2L, slots, KV] shard on the head dim like the data
                 # (a replicated data spec — the dense nondivisible-GQA
-                # fallback — replicates the scales too)
+                # fallback — replicates the scales too, and P(None,)*3
+                # degrades to replicated for it)
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 spec = tuple(config.cache_sharding.spec)
                 head_axis = spec[2] if len(spec) > 2 else None
                 ssharding = NamedSharding(config.cache_sharding.mesh,
-                                          P(None, head_axis, None))
+                                          P(None, None, head_axis))
                 self.cache = (
                     jax.jit(lambda: jnp.zeros(self.shape, jnp.int8),
                             out_shardings=config.cache_sharding)(),

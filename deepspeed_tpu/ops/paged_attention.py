@@ -4,11 +4,13 @@ Reference capability: ``deepspeed/inference/v2/kernels/ragged_ops/
 blocked_flash/`` (attention_atom.h — per-atom block-table flash over a paged
 KV cache). TPU design, rather than a port of the CUDA atom machinery:
 
-- Grid ``(seqs, kv_heads, pages)``: the page loop is innermost so an online
-  softmax (running max / sum / accumulator in VMEM scratch) streams the
-  sequence's history one KV page at a time — no [S, L, ...] gather is ever
-  materialized (the round-1 dense path gathered the full history window per
-  layer).
+- Grid ``(seqs, pages)``: ONE grid step streams one whole KV page — ALL
+  heads — against every query head (static in-kernel head unroll). The
+  page loop is innermost so an online softmax (running max / sum /
+  accumulator in VMEM scratch) streams the sequence's history one page at
+  a time; no [S, L, ...] gather is ever materialized. The earlier design
+  put kv_heads in the grid: 16x the grid steps, 16x smaller DMAs, and the
+  8/1 xprof trace showed per-step overheads dominating exactly that shape.
 - The *block table is scalar-prefetched*: the BlockSpec index map reads
   ``block_table[s, page]`` to DMA exactly the pages the sequence owns,
   straight from the full cache in HBM — the layer index is prefetched too,
@@ -16,9 +18,9 @@ KV cache). TPU design, rather than a port of the CUDA atom machinery:
 - Pages past a sequence's length clamp to the previous page id: Pallas skips
   the re-fetch of an identical block, so short sequences don't pay the
   bucketed page count in bandwidth.
-- GQA is native: queries arrive grouped ``[S, N, KV, G, D]`` and each grid
-  step contracts the ``N*G`` query rows of one KV head against the page —
-  KV is never expanded to Q heads.
+- GQA is native: queries arrive ``[S, N, H, D]`` with H = KV*G in kv-major
+  order (the natural q head order) and each kv head's G query rows contract
+  against its page slice — KV is never expanded to Q heads.
 - Sliding-window (Mistral local attention) masks in-kernel and SKIPS pages
   entirely older than the window; ALiBi (BLOOM) adds the per-head slope bias
   to the scores in the ``[N, G, page]`` view (no gathers); ``attn_scale``
@@ -32,9 +34,10 @@ in-place donated scatter along the slot dim (the earlier
 of the entire cache per forward — 2.01 GB of HLO temps on a 1 GB cache,
 measured 8/1; the 32k-context serving sweep OOMed on exactly that copy).
 The kernel views it as ``[2L, num_pages, page_size, KV*D]`` (a free
-middle-dim reshape) and DMAs one ``(2, page_size, head_dim)`` block per
-(layer, head, page) — k and v pages arrive in one ref; minor block dims
-``(page_size, D)`` are unchanged from the proven-on-silicon spec.
+middle-dim reshape) and DMAs one ``(2, page_size, KV*D)`` k+v page block
+per (layer, page) — every block's minor dims are (sublane mult-of-8,
+lane == array dim), the Mosaic-legal pattern; per-head slices inside the
+kernel are STATIC lane offsets.
 """
 
 import functools
@@ -56,7 +59,7 @@ NEG_INF = -1e30
 
 def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
                        q_ref, kv_ref, *rest,
-                       page_size: int, groups: int, scale: float,
+                       page_size: int, num_kv: int, groups: int, scale: float,
                        window: Optional[int], has_alibi: bool,
                        softcap: Optional[float] = None,
                        has_scales: bool = False):
@@ -65,8 +68,11 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
     slopes_ref = rest.pop(0) if has_alibi else None
     o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
-    b = pl.program_id(2)
-    n_pages = pl.num_programs(2)
+    b = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    D = q_ref.shape[-1]
+    N = q_ref.shape[1]
+    ng = N * groups
 
     @pl.when(b == 0)
     def _init():
@@ -85,71 +91,73 @@ def _paged_attn_kernel(layer_ref, bt_ref, seen_ref, lens_ref,  # scalar prefetch
 
     @pl.when(live)
     def _accumulate():
-        # q: [1, N, 1, G, D] -> [N*G, D]; kv: [1, 2, 1, page, D].
-        # Operands stay in the cache dtype: the MXU fast path is
-        # bf16 x bf16 with fp32 accumulation (preferred_element_type);
-        # pre-casting to fp32 would run the dots several-fold slower.
-        q = q_ref[...]
-        n, g, d = q.shape[1], q.shape[3], q.shape[4]
-        ng = n * g
-        q = q.reshape(ng, d)
-        k = kv_ref[0, 0]  # [page, D] (block rows 2l / 2l+1 of the cache)
-        v = kv_ref[1, 0]
-        if has_scales:
-            # int8 KV: dequantize the page in-registers (per-slot-vector
-            # scales) before the MXU dots — the cache rides HBM at 1
-            # byte/element, the compute stays bf16. Scale blocks are
-            # [page, 1] (trailing singleton keeps the spec Mosaic-legal)
-            # and broadcast over head_dim.
-            k = k.astype(jnp.bfloat16) * scales_ref[0, 0, 0].astype(jnp.bfloat16)
-            v = v.astype(jnp.bfloat16) * scales_ref[1, 0, 0].astype(jnp.bfloat16)
-
-        scores = jax.lax.dot_general(
-            q, k, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [NG, page]
-        if softcap is not None:  # Gemma-2: cap BEFORE masks/bias
-            from .attention import softcap_scores
-            scores = softcap_scores(scores, softcap)
-
-        # causal + length mask in absolute positions: page b covers
-        # [b*page, (b+1)*page); query row r belongs to new-token n = r // G
-        # at absolute position seen + n
-        key_pos = b * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        q_abs = seen + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0) // groups
-        mask = (key_pos <= q_abs) & (key_pos < hist_len)
+        # q block: [1, N, H, D]; kv block: [2, 1, page, KV*D]. Operands
+        # stay in the cache dtype: the MXU fast path is bf16 x bf16 with
+        # fp32 accumulation (preferred_element_type); pre-casting to fp32
+        # would run the dots several-fold slower.
+        q_all = q_ref[0]  # [N, H, D]
+        # positional masks are shared by every head — build once per page
+        key_pos1 = b * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (ng, page_size), 1)
+        q_abs1 = seen + jax.lax.broadcasted_iota(
+            jnp.int32, (ng, page_size), 0) // groups
+        mask = (key_pos1 <= q_abs1) & (key_pos1 < hist_len)
         if window is not None:
-            mask &= key_pos > q_abs - window
-        if has_alibi:
-            # [N, G, page] view: slope varies over G, distance over (N, page)
-            s3 = scores.reshape(n, g, page_size)
-            kp3 = b * page_size + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 2)
-            qa3 = seen + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
-            bias = slopes_ref[0, 0][None, :, None] * (kp3 - qa3).astype(jnp.float32)
-            scores = (s3 + bias).reshape(ng, page_size)
+            mask &= key_pos1 > q_abs1 - window
+        for h in range(num_kv):  # static unroll: one page DMA, all heads
+            q = q_all[:, h * groups:(h + 1) * groups, :].reshape(ng, D)
+            k = kv_ref[0, 0, :, h * D:(h + 1) * D]  # [page, D] static slice
+            v = kv_ref[1, 0, :, h * D:(h + 1) * D]
+            if has_scales:
+                # int8 KV: dequantize the page in-registers (per-slot-
+                # vector scales, [page, 1] slice broadcast over head_dim)
+                k = k.astype(jnp.bfloat16) * \
+                    scales_ref[0, 0, :, h:h + 1].astype(jnp.bfloat16)
+                v = v.astype(jnp.bfloat16) * \
+                    scales_ref[1, 0, :, h:h + 1].astype(jnp.bfloat16)
 
-        m_prev = m_scr[...]
-        l_prev = l_scr[...]
-        masked = jnp.where(mask, scores, NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(masked, axis=-1, keepdims=True))
-        # keep the running max finite so exp() below never sees inf-inf
-        m_new = jnp.maximum(m_new, NEG_INF)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask, jnp.exp(masked - m_new), 0.0)  # [NG, page]
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[...] = m_new
-        l_scr[...] = l_new
+            scores = jax.lax.dot_general(
+                q, k, (((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [NG, page]
+            if softcap is not None:  # Gemma-2: cap BEFORE masks/bias
+                from .attention import softcap_scores
+                scores = softcap_scores(scores, softcap)
+            if has_alibi:
+                # [N, G, page] view: slope varies over G, distance (N, page)
+                s3 = scores.reshape(N, groups, page_size)
+                kp3 = b * page_size + jax.lax.broadcasted_iota(
+                    jnp.int32, s3.shape, 2)
+                qa3 = seen + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
+                bias = slopes_ref[0, h][None, :, None] * \
+                    (kp3 - qa3).astype(jnp.float32)
+                scores = (s3 + bias).reshape(ng, page_size)
+
+            r = slice(h * ng, (h + 1) * ng)  # this head's scratch rows
+            m_prev = m_scr[r]
+            l_prev = l_scr[r]
+            masked = jnp.where(mask, scores, NEG_INF)
+            m_new = jnp.maximum(m_prev, jnp.max(masked, axis=-1,
+                                                keepdims=True))
+            # keep the running max finite so exp() never sees inf-inf
+            m_new = jnp.maximum(m_new, NEG_INF)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.where(mask, jnp.exp(masked - m_new), 0.0)  # [NG, page]
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[r] = acc_scr[r] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[r] = m_new
+            l_scr[r] = l_new
 
     @pl.when(b == n_pages - 1)
     def _finalize():
-        l = l_scr[...]
-        out = jnp.where(l > 0, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
-        n, g, d = o_ref.shape[1], o_ref.shape[3], o_ref.shape[4]
-        o_ref[...] = out.reshape(1, n, 1, g, d).astype(o_ref.dtype)
+        for h in range(num_kv):
+            r = slice(h * ng, (h + 1) * ng)
+            l = l_scr[r]  # [NG, 1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            out = jnp.where(l > 0, acc_scr[r] / safe_l, 0.0)
+            o_ref[0, :, h * groups:(h + 1) * groups, :] = \
+                out.reshape(N, groups, D).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret", "window",
@@ -166,7 +174,8 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
     """Blocked-flash attention over a paged KV cache.
 
     Args:
-      q: ``[S, N, KV, G, D]`` grouped queries (N new tokens per sequence).
+      q: ``[S, N, H, D]`` queries (N new tokens per sequence; H = KV*G in
+        the natural kv-major head order).
       cache: ``[2L, num_slots, KV*D]`` full paged cache (k row 2l, v row
         2l+1; never sliced — see module docstring for why this layout).
       layer: scalar int — which layer's pages to read.
@@ -179,84 +188,79 @@ def paged_attention(q, cache, layer, block_table, seq_seen, seq_lens,
       under TP the caller passes each shard its GLOBAL-head slice (reference
       sharding/attn.py keeps head identity across shards); None derives them
       from local head indices, correct only unsharded.
-      cache_scales: optional ``[2L, KV, num_slots]`` per-slot-vector
+      cache_scales: optional ``[2L, num_slots, KV]`` per-slot-vector
       dequant scales for an int8 ``cache`` — pages dequantize in-kernel.
     Returns:
-      ``[S, N, KV, G, D]`` in q.dtype.
+      ``[S, N, H, D]`` in q.dtype.
     """
-    S, N, KV, G, D = q.shape
+    S, N, H, D = q.shape
     B = block_table.shape[1]
-    scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
     L2, slots, KVD = cache.shape
+    KV = KVD // D
+    G = H // KV
+    scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
     n_pages = slots // page_size
-    # free reshape (middle-dim split): one (layer, head, page) DMA block is
-    # [2, page_size, D] — k and v pages arrive together
+    # free reshape (middle-dim split): one (layer, page) DMA block is
+    # [2, page_size, KV*D] — k and v pages for every head arrive together
     kv_pages = cache.reshape(L2, n_pages, page_size, KVD)
 
-    def q_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
-        return (s, 0, k, 0, 0)
+    def q_map(s, b, layer_r, bt_r, seen_r, lens_r):
+        return (s, 0, 0, 0)
 
-    def kv_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
+    def kv_map(s, b, layer_r, bt_r, seen_r, lens_r):
         # clamp trailing pages to the last needed page: identical consecutive
         # block indices skip the DMA re-fetch
         needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
         page = bt_r[s, jax.lax.min(b, needed - 1)]
-        return (layer_r[0], page, 0, k)
+        return (layer_r[0], page, 0, 0)
 
-    def o_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
-        return (s, 0, k, 0, 0)
+    def o_map(s, b, layer_r, bt_r, seen_r, lens_r):
+        return (s, 0, 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, N, 1, G, D), q_map),
-        pl.BlockSpec((2, 1, page_size, D), kv_map),
+        pl.BlockSpec((1, N, H, D), q_map),
+        pl.BlockSpec((2, 1, page_size, KVD), kv_map),
     ]
     inputs = [q, kv_pages]
     has_scales = cache_scales is not None
     if has_scales:
-        # scales page rides the same page lookup as its kv page. The caller
-        # passes [2L, KV, slots]; a trailing singleton is added so the
-        # block's last two dims (page_size, 1) are Mosaic-lowerable
-        # (sublane mult-of-8 / lane equal-to-array-dim).
-        def scales_map(s, k, b, layer_r, bt_r, seen_r, lens_r):
-            needed = jax.lax.max((lens_r[s] + page_size - 1) // page_size, 1)
-            page = bt_r[s, jax.lax.min(b, needed - 1)]
-            return (layer_r[0], k, page, 0, 0)
-
-        in_specs.append(pl.BlockSpec((2, 1, 1, page_size, 1), scales_map))
-        inputs.append(cache_scales.reshape(L2, KV, n_pages, page_size, 1))
+        # scales ride the SAME page lookup as their kv page (kv_map, one
+        # copy of the clamp): [2L, slots, KV] viewed as [2L, n_pages, page,
+        # KV] — block minor dims (page, KV) are (mult-of-8 sublane,
+        # lane == array dim), Mosaic-legal
+        in_specs.append(pl.BlockSpec((2, 1, page_size, KV), kv_map))
+        inputs.append(cache_scales.reshape(L2, n_pages, page_size, KV))
     has_alibi = use_alibi or slopes is not None
     if has_alibi:
         if slopes is None:
             from ..models.llama import alibi_slopes
-            slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
-        # [KV, 1, G] with block (1, 1, G): last two block dims equal the
-        # array dims, which Mosaic lowers for any G (a 2-D (1, G) spec over
-        # [KV, G] has an illegal sublane-1 block when KV > 1)
-        in_specs.append(pl.BlockSpec((1, 1, G), lambda s, k, b, *_: (k, 0, 0)))
-        inputs.append(slopes.astype(jnp.float32).reshape(KV, 1, G))
+            slopes = jnp.asarray(alibi_slopes(H)).reshape(KV, G)
+        # [1, KV, G] with block (1, KV, G): the last two block dims equal
+        # the array dims, which Mosaic lowers for any KV/G
+        in_specs.append(pl.BlockSpec((1, KV, G), lambda s, b, *_: (0, 0, 0)))
+        inputs.append(slopes.astype(jnp.float32).reshape(1, KV, G))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
-        grid=(S, KV, B),
+        grid=(S, B),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, N, 1, G, D), o_map),
+        out_specs=pl.BlockSpec((1, N, H, D), o_map),
         scratch_shapes=[
-            # logically [NG, 1]; lane padding is the compiler's business —
-            # declaring 128 lanes forced a broadcast-write every page
-            pltpu.VMEM((N * G, 1), jnp.float32),  # running max
-            pltpu.VMEM((N * G, 1), jnp.float32),  # running sum
-            pltpu.VMEM((N * G, D), jnp.float32),  # accumulator
+            # rows grouped kv-head-major: head h owns [h*NG, (h+1)*NG)
+            pltpu.VMEM((N * H, 1), jnp.float32),  # running max
+            pltpu.VMEM((N * H, 1), jnp.float32),  # running sum
+            pltpu.VMEM((N * H, D), jnp.float32),  # accumulator
         ],
     )
 
     kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
-                               groups=G, scale=scale, window=window,
-                               softcap=softcap,
+                               num_kv=KV, groups=G, scale=scale,
+                               window=window, softcap=softcap,
                                has_alibi=has_alibi, has_scales=has_scales)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, N, KV, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, N, H, D), q.dtype),
         interpret=interpret,
     )(jnp.asarray([layer], jnp.int32), block_table.astype(jnp.int32),
       seq_seen.astype(jnp.int32), seq_lens.astype(jnp.int32), *inputs)
@@ -270,9 +274,11 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
                               cache_scales=None,
                               softcap: Optional[float] = None):
     """Dense-gather XLA reference (the round-1 path) for numerics tests."""
-    S, N, KV, G, D = q.shape
+    S, N, H, D = q.shape
     B = block_table.shape[1]
     L = B * page_size
+    KV = cache.shape[-1] // D
+    G = H // KV
     scale = attn_scale if attn_scale is not None else 1.0 / (D ** 0.5)
     j = jnp.arange(L, dtype=jnp.int32)
     slot_grid = block_table[:, j // page_size] * page_size + j % page_size
@@ -280,13 +286,13 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     k_h = cache[2 * layer][slot_grid].reshape(S, L, KV, D)    # [S, L, KV, D]
     v_h = cache[2 * layer + 1][slot_grid].reshape(S, L, KV, D)
     if cache_scales is not None:  # int8 cache: dequant the gathered window
-        k_sc = jnp.moveaxis(cache_scales[2 * layer][:, slot_grid], 0, -1)
-        v_sc = jnp.moveaxis(cache_scales[2 * layer + 1][:, slot_grid], 0, -1)
+        k_sc = cache_scales[2 * layer][slot_grid]             # [S, L, KV]
+        v_sc = cache_scales[2 * layer + 1][slot_grid]
         k_h = k_h.astype(jnp.float32) * k_sc[..., None].astype(jnp.float32)
         v_h = v_h.astype(jnp.float32) * v_sc[..., None].astype(jnp.float32)
     k_h = jnp.moveaxis(k_h, 2, 1).astype(jnp.float32)          # [S, KV, L, D]
     v_h = jnp.moveaxis(v_h, 2, 1).astype(jnp.float32)
-    qf = q.astype(jnp.float32)
+    qf = q.reshape(S, N, KV, G, D).astype(jnp.float32)
     scores = jnp.einsum("snkgd,skld->snkgl", qf, k_h) * scale
     if softcap is not None:
         from .attention import softcap_scores
@@ -299,7 +305,7 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     if use_alibi or slopes is not None:
         if slopes is None:
             from ..models.llama import alibi_slopes
-            slopes = jnp.asarray(alibi_slopes(KV * G)).reshape(KV, G)
+            slopes = jnp.asarray(alibi_slopes(H)).reshape(KV, G)
         dist = (key_pos[:, :, None, None, :]
                 - q_abs[:, :, None, None, None]).astype(jnp.float32)
         scores = scores + slopes[None, None, :, :, None].astype(jnp.float32) * dist
@@ -307,7 +313,7 @@ def paged_attention_reference(q, cache, layer, block_table, seq_seen, seq_lens,
     probs = jax.nn.softmax(scores, axis=-1)
     any_visible = mask.any(-1)[:, :, None, None, None]
     out = jnp.einsum("snkgl,skld->snkgd", probs, v_h)
-    return jnp.where(any_visible, out, 0.0).astype(q.dtype)
+    return jnp.where(any_visible, out, 0.0).reshape(S, N, H, D).astype(q.dtype)
 
 
 from .registry import registry  # noqa: E402

@@ -31,7 +31,7 @@ def test_int8_cache_allocation_and_budget():
     kv = BlockedKVCache(cfg, num_blocks=8)
     data, scales = kv.cache
     assert data.dtype == jnp.int8 and data.shape == (4, 128, 4 * 64)
-    assert scales.dtype == jnp.float32 and scales.shape == (4, 4, 128)
+    assert scales.dtype == jnp.float32 and scales.shape == (4, 128, 4)
     # ~half the bytes of bf16 (int8 + fp32-scale/64-dim overhead)
     bf16 = BlockedKVCache(KVCacheConfig(block_size=16, cache_shape=(2, 4, 64),
                                         cache_dtype="bfloat16"), num_blocks=8)
@@ -95,9 +95,9 @@ def test_int8_cache_composes_with_tp():
     kv = engine._state_manager.kv_cache
     data, scales = kv.cache
     # folded layout: data [2L, slot, KV*D] shards the head fold; scales
-    # [2L, KV, slots] shard the head dim
+    # [2L, slots, KV] shard the head dim
     assert tuple(data.sharding.spec) == (None, None, "model")
-    assert tuple(scales.sharding.spec) == (None, "model", None)
+    assert tuple(scales.sharding.spec) == (None, None, "model")
     got = _logits(engine, [0, 1], PROMPTS[:2])
     # TP's fp32 psum reassociation perturbs values near int8 rounding
     # boundaries, flipping single quant buckets (error ~scale/2 ≈ 1e-2);

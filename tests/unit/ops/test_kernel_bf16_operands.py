@@ -59,7 +59,7 @@ def test_paged_decode_bf16_matches_dense_fp32():
     ctx = page * B
     kh = rng.standard_normal((S, ctx, KV, D))
     vh = rng.standard_normal((S, ctx, KV, D))
-    qn = rng.standard_normal((S, N, KV, G, D))
+    qn = rng.standard_normal((S, N, KV, G, D))  # grouped view for the oracle
     seen = np.asarray([ctx - N, ctx // 2], np.int32)
 
     # paged layout [2L, slots, KV*D]: per-sequence pages laid out contiguously
@@ -75,7 +75,7 @@ def test_paged_decode_bf16_matches_dense_fp32():
             cache[1, pid * page:pid * page + n] = vh[s, sl].reshape(n, KV * D)
     # the new token's K/V live at position `seen[s]`
     out = paged_attention(
-        jnp.asarray(qn, jnp.bfloat16),
+        jnp.asarray(qn.reshape(S, N, KV * G, D), jnp.bfloat16),
         jnp.asarray(cache, jnp.bfloat16), 0,
         jnp.asarray(bt), jnp.asarray(seen), jnp.asarray(seen + N),
         page_size=page, interpret=True)
@@ -90,6 +90,6 @@ def test_paged_decode_bf16_matches_dense_fp32():
                 p = np.exp(logits - logits.max())
                 p /= p.sum()
                 want = p @ vh[s, :hist, kvh]
-                got = np.asarray(out[s, 0, kvh, g], np.float32)
+                got = np.asarray(out[s, 0, kvh * G + g], np.float32)
                 err = np.max(np.abs(got - want)) / (np.max(np.abs(want)) + 1e-6)
                 assert err < 5e-2, (s, kvh, g, err)

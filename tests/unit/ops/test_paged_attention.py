@@ -14,9 +14,10 @@ INTERP = jax.default_backend() != "tpu"
 
 
 def _setup(rng, S, N, KV, G, D, ps, n_pages, B, seen, n_new, dtype=jnp.float32):
-    # cache layout [2L, slots, KV*D]: k row 2l, v row 2l+1 (kv_cache.py)
+    # cache layout [2L, slots, KV*D]: k row 2l, v row 2l+1 (kv_cache.py);
+    # queries head-major [S, N, H=KV*G, D]
     cache = jnp.asarray(rng.normal(size=(2 * 2, n_pages * ps, KV * D)), dtype)
-    q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), dtype)
+    q = jnp.asarray(rng.normal(size=(S, N, KV * G, D)), dtype)
     bt = jnp.asarray(rng.permutation(n_pages)[:S * B].reshape(S, B), jnp.int32)
     seen = jnp.asarray(seen, jnp.int32)
     lens = seen + jnp.asarray(n_new, jnp.int32)

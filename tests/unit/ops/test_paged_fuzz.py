@@ -34,7 +34,7 @@ def test_paged_matches_dense_reference(case):
     S, N, KV, G, D = case["S"], case["N"], case["KV"], case["G"], case["D"]
     page, pages = case["page"], case["pages"]
     slots = page * pages * S
-    q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(S, N, KV * G, D)), jnp.float32)
     cache = jnp.asarray(rng.normal(size=(2 * 2, slots, KV * D)), jnp.float32)
     # random DISJOINT page assignment (pages are shuffled across sequences —
     # the whole point of the paged layout)

@@ -41,7 +41,7 @@ def test_paged_attention_compiles_and_matches_dense():
     S, N, KV, G, D = 2, 1, 2, 4, 64
     page, pages = 128, 4
     rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(S, N, KV * G, D)), jnp.bfloat16)
     # cache layout [2L, slots, KV*D] (kv_cache.py): k row 2l, v row 2l+1
     cache = jnp.asarray(rng.normal(size=(2, page * pages * S, KV * D)),
                         jnp.bfloat16)
@@ -59,13 +59,13 @@ def test_paged_attention_compiles_and_matches_dense():
             .reshape(-1, KV, D).transpose(1, 0, 2)  # [KV, L, D]
         vv = np.asarray(cache, np.float32)[1][slots] \
             .reshape(-1, KV, D).transpose(1, 0, 2)
-        qq = np.asarray(q, np.float32)[s, 0]  # [KV, G, D]
+        qq = np.asarray(q, np.float32)[s, 0].reshape(KV, G, D)
         mask = j < int(lens[s])
         sc = np.einsum("kgd,kld->kgl", qq, kk) / np.sqrt(D)
         sc[:, :, ~mask] = -1e30
         p = np.exp(sc - sc.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
-        outs.append(np.einsum("kgl,kld->kgd", p, vv))
+        outs.append(np.einsum("kgl,kld->kgd", p, vv).reshape(KV * G, D))
     ref = np.stack(outs)[:, None]
     np.testing.assert_allclose(np.asarray(out, np.float32), ref, atol=4e-2)
 
@@ -148,14 +148,14 @@ def test_paged_attention_int8_scales_compile_and_match():
                                                    paged_attention_reference)
     rng = np.random.default_rng(6)
     S, N, KV, G, D, page, nblocks = 2, 1, 4, 2, 64, 128, 6
-    q = jnp.asarray(rng.normal(size=(S, N, KV, G, D)), jnp.bfloat16)
+    q = jnp.asarray(rng.normal(size=(S, N, KV * G, D)), jnp.bfloat16)
     # [2L, slots, KV, D] staging view → folded [2L, slots, KV*D] data and
-    # [2L, KV, slots] scales (kv_cache.py layout)
+    # slot-major [2L, slots, KV] scales (kv_cache.py layout)
     kv_f = rng.normal(size=(2, nblocks * page, KV, D)).astype(np.float32)
     sc = np.maximum(np.abs(kv_f).max(-1) / 127.0, 1e-8)  # [2, slots, KV]
     kv_i8 = np.clip(np.round(kv_f / sc[..., None]), -127, 127).astype(np.int8)
     cache = jnp.asarray(kv_i8.reshape(2, nblocks * page, KV * D))
-    scales = jnp.asarray(sc.transpose(0, 2, 1), jnp.float32)  # [2L, KV, slots]
+    scales = jnp.asarray(sc, jnp.float32)  # [2L, slots, KV]
     bt = jnp.asarray(rng.permutation(nblocks)[None, :].repeat(S, 0), jnp.int32)
     seen = jnp.asarray([300, 40], jnp.int32)
     lens = seen + N
