@@ -65,6 +65,9 @@ run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
 snapshot  # serving evidence suffixed NOW — a session death during the
           # long steps must not leave it clobberable by the next window
+# 4b. serving decode xprof: attribute where decode time goes after the
+# layout/kernel fixes (fused vs per-step, counterpart of the train trace)
+run serving_trace 1200 python .perf/serving_trace.py $P/xprof_serving_$SFX
 # 5. where-the-time-goes, scanned program (matches bench_fast's program)
 run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
 # 6. headline train number (full anytime ladder: scanned rungs first,

@@ -1,0 +1,59 @@
+"""Serving decode xprof capture: warm a bench-sized engine, then trace a
+few per-step decodes AND a fused K=16 decode so the trace attributes
+where serving time goes after the layout/kernel fixes (counterpart of
+bench.py --breakdown's train trace).
+
+Usage: python .perf/serving_trace.py <outdir>
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+
+sys.path.insert(0, "/root/repo")
+
+from deepspeed_tpu.models import LlamaConfig
+from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+import os
+
+outdir = sys.argv[1] if len(sys.argv) > 1 else "/root/repo/.perf/xprof_serving"
+if os.environ.get("DS_TRACE_TINY"):  # CPU smoke of the script logic
+    cfg = LlamaConfig.tiny(max_position_embeddings=512)
+    ctx, kv_block = 64, 16
+else:
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=24,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=4096)
+    ctx, kv_block = 1024, 128
+eng = build_llama_engine(
+    cfg, engine_config=RaggedInferenceEngineConfig(
+        state_manager=DSStateManagerConfig(
+            max_context=2 * ctx, max_ragged_batch_size=2 * ctx,
+            max_ragged_sequence_count=min(2 * ctx, 512)),
+        num_kv_blocks=8 * (ctx // kv_block + 2) + 16),
+    kv_block_size=kv_block)
+rng = np.random.default_rng(0)
+uids = list(range(8))
+for u in uids:
+    eng.put([u], [rng.integers(0, cfg.vocab_size, size=ctx).tolist()])
+toks = [7] * 8
+# warm both programs
+out = eng.put(uids, [[t] for t in toks])
+jax.block_until_ready(out)
+fused = eng.fused_decode_steps(uids, toks, 16)
+print("warmed; tracing")
+
+with jax.profiler.trace(outdir):
+    for _ in range(4):
+        out = eng.put(uids, [[t] for t in toks])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    fused = eng.fused_decode_steps(uids, list(fused[:, -1]), 16)
+    dt = time.perf_counter() - t0
+print(f"fused 16-step x8-seq dispatch: {dt*1e3:.1f} ms "
+      f"({8*16/dt:.1f} tok/s batched)")

@@ -273,7 +273,9 @@ def test_scheduler_over_tp_engine():
         cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
         engine_config=RaggedInferenceEngineConfig(
             num_kv_blocks=96, tensor_parallel={"tp_size": 2}))
-    sched_tp = ServingScheduler(tp_engine)
+    # fused tick on the TP side: the K-step program must match the
+    # per-token single-chip daemon token-for-token
+    sched_tp = ServingScheduler(tp_engine, fused_decode_window=4)
     hs = [sched_tp.submit(p, max_new_tokens=6) for p in prompts]
     while not all(h.finished for h in hs):
         sched_tp.step()
