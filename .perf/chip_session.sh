@@ -96,6 +96,12 @@ run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
 for B in "1024,1024" "512,1024" "512,512" "1024,512" "256,1024" "256,512"; do
   run "flash_${B/,/x}" 1800 env DS_TPU_FLASH_BLOCKS=$B DS_BENCH_FAST=1 python bench.py
 done
+# 12b. head-folded flash A/B (DS_TPU_FLASH_FOLDED=1): all KV heads per
+# grid step — the restructure the 0801T1906 trace demands (70% of step
+# time was per-head kernel overhead). Flag-gated: this rung is the
+# silicon proof that decides whether it becomes the default.
+run flash_folded 1800 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_FAST=1 python bench.py
+run flash_folded_breakdown 1500 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_SCAN=1 python bench.py --breakdown
 # 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a
 # scale where streaming matters on-chip, then the Twin-Flow partial-offload
 # ratio sweep (VERDICT r4 #8: journal the measured throughput curve)

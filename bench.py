@@ -199,7 +199,8 @@ def _measure_config(batch, seq, iters, remat, scan=False, heads=None):
                 f"{', remat=' + str(remat) if remat else ''}"
                 f"{scan_tag}"
                 f"{f', {heads}h x hd{cfg.head_dim_}' if heads else ''}"
-                f"{f', {ksteps}-step dispatch' if ksteps > 1 else ''})")
+                f"{f', {ksteps}-step dispatch' if ksteps > 1 else ''}"
+                f"{', folded-attn' if env_flag('DS_TPU_FLASH_FOLDED') else ''})")
     out = {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),

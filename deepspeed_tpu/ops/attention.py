@@ -195,8 +195,19 @@ def _regroup(q, k, v):
     return qg, kt, vt
 
 
+def _use_folded() -> bool:
+    """DS_TPU_FLASH_FOLDED=1 selects the head-folded kernels
+    (attention_folded.py): all KV heads per grid step — the restructure the
+    8/1 trace asks for, kept flag-gated until proven on real Mosaic."""
+    return os.environ.get("DS_TPU_FLASH_FOLDED", "") not in ("", "0")
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
                softcap=None):
+    if _use_folded():
+        from .attention_folded import flash_fwd_folded
+        return flash_fwd_folded(q, k, v, scale, causal, block_q, block_k,
+                                interpret, window, softcap)
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
@@ -412,6 +423,14 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=None,
                softcap=None):
     q, k, v, o, lse = res
+    if _use_folded():
+        # fwd and bwd trace together, so the env choice is consistent; the
+        # assert guards the one way it couldn't be (residuals captured
+        # under a different flag value than the bwd trace)
+        assert lse.shape == (*q.shape[:3], 1), (lse.shape, q.shape)
+        from .attention_folded import flash_bwd_folded
+        return flash_bwd_folded(q, k, v, lse, o, g_out, scale, causal,
+                                block_q, block_k, interpret, window, softcap)
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     G = H // KV
