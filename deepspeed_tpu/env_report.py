@@ -35,7 +35,8 @@ def op_report():
     _aio.aio_available()
     from .ops import cpu_optim as _cpu_optim  # noqa: F401
     _cpu_optim.cpu_optim_available()
-    for mod in ("attention", "normalization", "quantizer", "fused_optimizer", "rope",
+    for mod in ("attention", "attention_folded", "normalization", "quantizer",
+                "fused_optimizer", "rope",
                 "evoformer_attn", "spatial", "cpu_optim", "paged_attention",
                 "grouped_matmul", "sparse_attention.sparse_self_attention"):
         try:

@@ -389,3 +389,10 @@ def flash_bwd_folded(q, k, v, lse, o, g_out, scale, causal, block_q, block_k,
         interpret=interpret,
     )(q, kf, vf, g_out, lse, delta)
     return dq, dk.reshape(B, Sk, KV, D), dv.reshape(B, Sk, KV, D)
+
+
+from .registry import registry  # noqa: E402
+
+registry.register("flash_attention_folded", "pallas" if _HAS_PLTPU else "xla",
+                  True, "head-folded flash variant (DS_TPU_FLASH_FOLDED=1): "
+                  "all KV heads per grid step, natural [B,S,H,D] layouts")
