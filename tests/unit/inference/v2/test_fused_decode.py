@@ -145,3 +145,34 @@ def test_fused_then_speculative_paths_coexist():
     c = eng.generate([[1, 2, 3, 1, 2]], max_new_tokens=8,
                      fused_decode_window=1)
     assert a == b == c
+
+
+def test_fused_sliding_window_parity():
+    """Mistral-style all-layer sliding window: fused decode defers the
+    trailing-window block frees to after the dispatch — tokens must match
+    the per-step path exactly and dead leading blocks still return to the
+    allocator while decoding."""
+    def mk():
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                                  sliding_window=16)
+        return build_llama_engine(
+            cfg, seed=9, dtype=jnp.float32, kv_block_size=8,
+            engine_config=RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(max_context=128),
+                num_kv_blocks=64))
+    prompt = list(range(1, 25))  # 3 full blocks; window 16 = 2 blocks
+    ref = mk().generate([prompt], max_new_tokens=24, fused_decode_window=1)
+    eng = mk()
+    free0 = eng._state_manager.free_blocks
+    got = eng.generate([prompt], max_new_tokens=24, fused_decode_window=4)
+    assert got == ref
+    assert eng._state_manager.free_blocks == free0
+    # the window must actually have freed leading blocks mid-decode: at 48
+    # tokens seen with window 16, a live sequence would hold <= 4 blocks
+    # (window + write head), never the full 6 — verify via a live sequence
+    eng.put([77], [prompt])
+    out = eng.fused_decode_steps([77], [1], 16)
+    assert out.shape == (1, 16)
+    seq = eng._state_manager.get_sequence(77)
+    eng._model.maybe_free_kv(seq)
+    assert len(seq.kv_blocks) < seq.cur_allocated_blocks
