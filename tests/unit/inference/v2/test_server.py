@@ -328,14 +328,17 @@ def test_openai_completions_endpoint():
         sched.stop()
 
 
-def test_daemon_soak_random_churn():
+@pytest.mark.parametrize("fused", [1, 4])
+def test_daemon_soak_random_churn(fused):
     """Randomized arrivals, lengths, sampling params, cancels and stops
     against the stepped scheduler: every request terminates, and the
     allocator ends with full block conservation (no KV leak through any
-    admission/eviction/cancel/stop path)."""
+    admission/eviction/cancel/stop path). fused=4 drives the mixed regime
+    where ticks flip between the fused greedy fast path and the per-token
+    path as sampled requests enter and leave the live set."""
     engine, *_ = _engine(num_blocks=32)
     total = engine._state_manager._allocator.free_blocks
-    sched = ServingScheduler(engine)
+    sched = ServingScheduler(engine, fused_decode_window=fused)
     rng = np.random.default_rng(42)
     handles = []
     for round_ in range(6):
