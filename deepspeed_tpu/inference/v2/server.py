@@ -168,11 +168,11 @@ class ServingScheduler:
         if fused_decode_window is None:
             from ...ops.registry import on_tpu
             fused_decode_window = 16 if on_tpu() else 1
-        # steady-state fast path: when EVERY live request is a plain greedy
-        # decode and nothing waits to prefill, one tick runs K fused steps
-        # per dispatch (engine.fused_decode_steps — the CUDA-graph-replay
-        # analog); any sampling control or a pending prefill falls back to
-        # the per-token SplitFuse tick
+        # steady-state fast path: when nothing waits to prefill, the
+        # plain-greedy subset of live decodes runs K fused steps per
+        # dispatch (engine.fused_decode_steps — the CUDA-graph-replay
+        # analog) while sampled/controlled requests keep their per-token
+        # SplitFuse tick in the same scheduler pass
         self._fused_window = int(fused_decode_window)
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -432,13 +432,33 @@ class ServingScheduler:
         decodes = [r for r in self._live if r.pending == 1]
         prefills = [r for r in self._live if r.pending > 1]
         if (self._fused_window > 1 and decodes and not prefills
-                and not self._waiting and not self._inbox
-                and all(r.temperature == 0.0 and r.speculative is None
-                        and not r.return_logprobs and r.min_new_tokens == 0
-                        and r.repetition_penalty == 1.0
-                        and r.logits_processor is None for r in decodes)
-                and self._fused_tick(decodes)):
-            return True
+                and not self._waiting and not self._inbox):
+            # steady state: fuse the PLAIN-GREEDY subset (K steps, one
+            # dispatch); sampled/controlled requests keep their per-token
+            # tick below — a mixed workload advances greedy users K tokens
+            # per tick without stalling anyone (each request's sampling
+            # depends only on its own context, so outputs are unchanged).
+            # A just-admitted 1-token-prompt request has pending==1 but NO
+            # engine sequence yet — it must take the per-token path, which
+            # owns prefill (fused_decode_steps requires prefilled history).
+            sm = self._engine._state_manager
+
+            def _prefilled(r):
+                seq = sm.get_sequence(r.uid)
+                return seq is not None and seq.seen_tokens > 0
+
+            greedy = [r for r in decodes
+                      if r.temperature == 0.0 and r.speculative is None
+                      and not r.return_logprobs and r.min_new_tokens == 0
+                      and r.repetition_penalty == 1.0
+                      and r.logits_processor is None and _prefilled(r)]
+            if greedy and self._fused_tick(greedy):
+                fused_ids = {id(r) for r in greedy}
+                decodes = [r for r in decodes
+                           if id(r) not in fused_ids and r in self._live]
+                if not decodes:
+                    return True
+                # fall through: per-token tick for the sampled remainder
         # decode SLA: every decoding sequence's 1 token is RESERVED before
         # drafts or prefill chunks may spend anything (generate() reserves
         # identically: draft_budget = max_batch - len(live))
@@ -487,7 +507,8 @@ class ServingScheduler:
         return True
 
     def _fused_tick(self, decodes) -> bool:
-        """K greedy steps for every live decode in ONE dispatch. Returns
+        """K greedy steps for the given (plain-greedy, prefilled) decodes
+        in ONE dispatch. Returns
         False (caller falls back to the per-token tick) when the window
         can't reach 2 steps or KV pressure refuses the wave — the normal
         tick owns eviction. Token accounting: the dispatch feeds each

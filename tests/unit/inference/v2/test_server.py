@@ -692,10 +692,11 @@ def test_scheduler_fused_decode_matches_per_token():
         assert [h.result() for h in hb] == [h.result() for h in ha]
 
 
-def test_scheduler_fused_falls_back_for_sampling_controls():
-    """One non-greedy request in the live set forces the per-token tick;
-    results for the greedy requests stay identical to an all-per-token
-    run (the fused path must never sample)."""
+def test_scheduler_fused_splits_mixed_workloads():
+    """A mixed live set SPLITS the tick: greedy requests ride the fused
+    fast path while the controlled request keeps its per-token tick —
+    and every output stays identical to an all-per-token run (each
+    request's sampling depends only on its own context)."""
     engine, cfg, params = _engine()
     prompts = _prompts(3, seed=4)
     ref_sched = ServingScheduler(engine, fused_decode_window=1)
@@ -717,3 +718,28 @@ def test_scheduler_fused_falls_back_for_sampling_controls():
     while not all(h.finished for h in hs):
         sched.step()
     assert [h.result() for h in hs] == ref
+
+
+def test_fused_tick_skips_unprefilled_one_token_prompt():
+    """Regression: a just-admitted 1-token-prompt greedy request has
+    pending==1 but no engine sequence — the fused subset must exclude it
+    (the per-token tick owns prefill) instead of crashing the loop."""
+    engine, *_ = _engine()
+    ref_engine, *_ = _engine()
+    prompts = [[5], _prompts(1, seed=6)[0]]
+    ref = ref_engine.generate(prompts, max_new_tokens=6)
+
+    reset_mesh_context()
+    engine2, *_ = _engine()
+    sched = ServingScheduler(engine2, fused_decode_window=4)
+    # one sampled request keeps the live set mixed, then the 1-token prompt
+    hs = sched.submit(prompts[1], max_new_tokens=6)
+    sched.step()
+    h1 = sched.submit(prompts[0], max_new_tokens=6)
+    for _ in range(200):
+        if h1.finished and hs.finished:
+            break
+        sched.step()
+    assert h1.finished and hs.finished
+    assert h1.result() == ref[0]
+    assert hs.result() == ref[1]
