@@ -827,9 +827,11 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
 
 
 def serve(engine: InferenceEngineV2, host: str = "127.0.0.1", port: int = 8000,
-          tokenizer=None, block: bool = True):
+          tokenizer=None, block: bool = True,
+          fused_decode_window: Optional[int] = None):
     """One-call deployment: start the scheduler + HTTP server (mii.serve)."""
-    sched = ServingScheduler(engine).start()
+    sched = ServingScheduler(
+        engine, fused_decode_window=fused_decode_window).start()
     httpd = create_http_server(sched, host, port, tokenizer)
     if not block:
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
