@@ -103,6 +103,9 @@ done
 run flash_folded 1800 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_FAST=1 python bench.py
 run flash_folded_breakdown 1500 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_SCAN=1 python bench.py --breakdown
 run flash_folded_longseq 2400 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_LONGSEQ=1 python bench.py
+# A/B verdict: if folded beat per-head on THIS silicon by >=2%, promote it
+# to the default for every env-less run (incl. the driver's final bench)
+run folded_promote 120 python .perf/promote_folded.py $SFX
 # 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a
 # scale where streaming matters on-chip, then the Twin-Flow partial-offload
 # ratio sweep (VERDICT r4 #8: journal the measured throughput curve)

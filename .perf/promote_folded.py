@@ -1,0 +1,62 @@
+"""Silicon A/B verdict for the head-folded flash kernels.
+
+Reads THIS session's bench_fast (per-head default) and flash_folded
+(DS_TPU_FLASH_FOLDED=1) outputs, compares their best tok/s, and
+creates/removes ``.perf/FOLDED_PROVEN`` — the sentinel that flips the
+folded kernels to default for every env-less run (see
+``ops/attention.py:_use_folded``). Promotion demands a >=2% win so noise
+can't flip the default back and forth across windows.
+
+Usage: python .perf/promote_folded.py <session_suffix>
+"""
+import json
+import os
+import sys
+
+P = os.path.dirname(os.path.abspath(__file__))
+SENTINEL = os.path.join(P, "FOLDED_PROVEN")
+
+
+def best_tok_s(path):
+    try:
+        lines = [ln for ln in open(path).read().splitlines()
+                 if ln.startswith("{")]
+    except OSError:
+        return None
+    best = None
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if rec.get("metric") != "train_tokens_per_sec_per_chip":
+            continue
+        if "DIAGNOSTIC" in rec.get("unit", ""):
+            continue
+        best = max(best or 0.0, float(rec["value"]))
+    return best
+
+
+def main():
+    sfx = sys.argv[1]
+    base = best_tok_s(os.path.join(P, f"bench_fast_r5_{sfx}.out"))
+    folded = best_tok_s(os.path.join(P, f"flash_folded_r5_{sfx}.out"))
+    print(f"A/B: per-head={base} folded={folded} tok/s")
+    if base is None or folded is None:
+        print("verdict: incomplete session — sentinel unchanged")
+        return 0
+    if folded >= 1.02 * base:
+        open(SENTINEL, "w").write(
+            f"session {sfx}: folded {folded:.1f} vs per-head {base:.1f} tok/s\n")
+        print(f"verdict: PROMOTED (sentinel written, +{100*(folded/base-1):.1f}%)")
+    else:
+        if os.path.exists(SENTINEL):
+            os.remove(SENTINEL)
+            print("verdict: demoted (sentinel removed)")
+        else:
+            print("verdict: not promoted")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -196,10 +196,19 @@ def _regroup(q, k, v):
 
 
 def _use_folded() -> bool:
-    """DS_TPU_FLASH_FOLDED=1 selects the head-folded kernels
+    """DS_TPU_FLASH_FOLDED selects the head-folded kernels
     (attention_folded.py): all KV heads per grid step — the restructure the
-    8/1 trace asks for, kept flag-gated until proven on real Mosaic."""
-    return os.environ.get("DS_TPU_FLASH_FOLDED", "") not in ("", "0")
+    8/1 trace asks for. With the env unset, the default comes from the
+    silicon A/B: a chip session that measured the folded kernels faster on
+    real hardware drops the ``.perf/FOLDED_PROVEN`` sentinel
+    (``.perf/promote_folded.py``), which promotes them for every later run
+    — including the driver's round-end bench, which sets no env."""
+    env = os.environ.get("DS_TPU_FLASH_FOLDED")
+    if env is not None:
+        return env not in ("", "0")
+    sentinel = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "..", ".perf", "FOLDED_PROVEN")
+    return os.path.exists(sentinel)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
