@@ -4,5 +4,10 @@ from .comm import (all_reduce, all_gather, all_gather_into_tensor, reduce_scatte
                    get_world_size, get_rank, get_local_rank, get_axis_index, ppermute, inference_all_reduce,
                    initialize_mesh_device, log_summary, configure, CommHandle,
                    mpi_discovery, parse_slurm_nodelist)
+from .bucketing import (Bucket, BucketLayout, BucketSlot, WIRE_TIERS, all_gather_bucket,
+                        allreduce_bucket, bucket_wire_bytes, bucketed_allreduce_tree,
+                        dequantize_block_int8, flatten_buckets, init_error_buckets,
+                        plan_buckets, quantize_block_int8, record_bucket_traffic,
+                        reduce_scatter_bucket, unflatten_buckets)
 from .mesh import MeshContext, get_mesh_context, set_mesh_context, reset_mesh_context, MESH_AXES
 from .reduce_op import ReduceOp

@@ -1,0 +1,435 @@
+"""Gradient comm planner: bucketed + quantized collectives.
+
+Reference: the hook-driven bucketed reduce of ``runtime/zero/stage_1_and_2.py``
+(``reduce_bucket_size`` / ``reduce_ipg_grads``) and the coalesced collectives
+of ``runtime/comm/coalesced_collectives.py`` — the wire wins by carrying a few
+LARGE flat arrays instead of one collective per parameter tensor.
+
+TPU shape (everything here runs inside ``shard_map`` with the data-parallel
+axes manual, like ``comm/compressed.py``):
+
+1. **Bucketing** — ``plan_buckets`` flattens a gradient pytree into
+   dtype-homogeneous flat buckets of at most ``bucket_size_mb`` each, with a
+   deterministic layout (leaves in ``tree_flatten`` order, greedy fill). The
+   ``BucketLayout`` records, per slot, the leaf index / offset / shape, so
+   ``unflatten_buckets`` restores the exact pytree. The wire then carries
+   ``ceil(total_bytes / bucket_size)`` collectives per dtype instead of one
+   per leaf.
+
+2. **Quantized wire tier** — EQuARX-style blockwise int8: each block of
+   ``block_size`` elements is affinely mapped to int8 with a per-block fp32
+   scale + zero-point. The arrays XLA actually moves over ICI are the int8
+   codes + the (tiny) per-block scales. Wire volume ~N bytes + 8N/block,
+   vs 4N fp32 — a ~4x cut with <1% blockwise quantization error, sitting
+   between fp32 and the 1-bit sign path (~32x) from ``compressed.py``.
+
+3. **Two-step exchange** — ``reduce_scatter_bucket`` + ``all_gather_bucket``
+   compose into a quantized allreduce (both halves independently quantizable,
+   as in EQuARX); ZeRO-1/2 consumers stop after the reduce-scatter, whose
+   output IS each worker's gradient shard.
+
+Error feedback (optional, matching the 1-bit path's residual): the
+quantization residual of THIS worker's outgoing codes is returned so callers
+can fold it into the next step's gradients.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .compressed import pack_signs, unpack_signs
+
+WIRE_TIERS = ("fp32", "int8", "onebit")
+
+DEFAULT_BLOCK_SIZE = 256
+
+
+# ---------------------------------------------------------------------------
+# bucket layout
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BucketSlot:
+    """One leaf's placement inside a flat bucket."""
+    leaf_index: int          # position in tree_flatten order
+    offset: int              # start element inside the bucket
+    size: int                # number of elements
+    shape: Tuple[int, ...]   # original leaf shape
+
+
+@dataclass(frozen=True)
+class Bucket:
+    dtype: Any               # numpy dtype of every slot in this bucket
+    size: int                # total elements (sum of slot sizes, pre-padding)
+    padded_size: int         # size rounded up so every worker/block divides
+    slots: Tuple[BucketSlot, ...] = ()
+
+
+@dataclass(frozen=True)
+class BucketLayout:
+    """Deterministic flat-bucket layout for one gradient pytree."""
+    buckets: Tuple[Bucket, ...]
+    treedef: Any
+    n_leaves: int
+
+    def buckets_for_dtype(self, dtype) -> List[int]:
+        dt = np.dtype(dtype)
+        return [i for i, b in enumerate(self.buckets) if np.dtype(b.dtype) == dt]
+
+    @property
+    def dtypes(self) -> Tuple[Any, ...]:
+        seen = []
+        for b in self.buckets:
+            if b.dtype not in seen:
+                seen.append(b.dtype)
+        return tuple(seen)
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return n + (-n) % multiple
+
+
+def plan_buckets(tree, bucket_size_mb: float = 25.0,
+                 pad_multiple: int = 1) -> BucketLayout:
+    """Plan dtype-homogeneous flat buckets over ``tree``'s leaves.
+
+    Deterministic: leaves are visited in ``tree_flatten`` order and packed
+    greedily per dtype; a bucket closes when adding the next leaf would
+    exceed ``bucket_size_mb`` (a single leaf larger than the budget gets its
+    own bucket — leaves are never split across buckets, so unflattening is a
+    pure slice + reshape). ``pad_multiple``: each bucket's wire length is
+    rounded up so reduce-scatter shards and quantization blocks divide.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    budget = int(bucket_size_mb * 1024 * 1024)
+    if budget <= 0:
+        raise ValueError(f"bucket_size_mb must be positive, got {bucket_size_mb}")
+    open_buckets: Dict[Any, Tuple[list, int]] = {}  # dtype -> (slots, fill)
+    done: List[Bucket] = []
+
+    def _close(dt):
+        slots, fill = open_buckets.pop(dt)
+        done.append(Bucket(dtype=dt, size=fill,
+                           padded_size=_pad_to(fill, pad_multiple),
+                           slots=tuple(slots)))
+
+    for i, leaf in enumerate(leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dt = np.dtype(getattr(leaf, "dtype", np.float32))
+        size = int(np.prod(shape)) if shape else 1
+        nbytes = size * dt.itemsize
+        if dt in open_buckets:
+            slots, fill = open_buckets[dt]
+            if (fill + size) * dt.itemsize > budget and fill > 0:
+                _close(dt)
+        if dt not in open_buckets:
+            open_buckets[dt] = ([], 0)
+        slots, fill = open_buckets[dt]
+        slots.append(BucketSlot(leaf_index=i, offset=fill, size=size, shape=shape))
+        open_buckets[dt] = (slots, fill + size)
+        if (fill + size) * dt.itemsize >= budget:
+            _close(dt)
+    for dt in list(open_buckets):
+        _close(dt)
+    # deterministic order: by first leaf index
+    done.sort(key=lambda b: b.slots[0].leaf_index)
+    return BucketLayout(buckets=tuple(done), treedef=treedef, n_leaves=len(leaves))
+
+
+def flatten_buckets(tree, layout: BucketLayout) -> List[jnp.ndarray]:
+    """Pytree -> list of flat 1-D bucket arrays (padded with zeros)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != layout.n_leaves:
+        raise ValueError(
+            f"tree has {len(leaves)} leaves but the bucket layout was planned "
+            f"for {layout.n_leaves} — replan with plan_buckets")
+    out = []
+    for b in layout.buckets:
+        parts = [leaves[s.leaf_index].reshape(-1) for s in b.slots]
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        if b.padded_size > b.size:
+            flat = jnp.pad(flat, (0, b.padded_size - b.size))
+        out.append(flat)
+    return out
+
+
+def unflatten_buckets(bucket_arrays: Sequence[jnp.ndarray],
+                      layout: BucketLayout, example_tree=None):
+    """Inverse of ``flatten_buckets``: slice each bucket back into leaves and
+    rebuild the pytree (dtypes restored from the bucket dtype; pass
+    ``example_tree`` to also restore leaf dtypes that differ)."""
+    if len(bucket_arrays) != len(layout.buckets):
+        raise ValueError(f"expected {len(layout.buckets)} buckets, "
+                         f"got {len(bucket_arrays)}")
+    example_leaves = (jax.tree_util.tree_leaves(example_tree)
+                      if example_tree is not None else None)
+    leaves: List[Optional[jnp.ndarray]] = [None] * layout.n_leaves
+    for arr, b in zip(bucket_arrays, layout.buckets):
+        for s in b.slots:
+            leaf = lax.dynamic_slice_in_dim(arr, s.offset, s.size).reshape(s.shape)
+            if example_leaves is not None:
+                leaf = leaf.astype(example_leaves[s.leaf_index].dtype)
+            else:
+                leaf = leaf.astype(b.dtype)
+            leaves[s.leaf_index] = leaf
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# blockwise int8 quantization (EQuARX-style scale + zero-point per block)
+# ---------------------------------------------------------------------------
+
+
+def quantize_block_int8(x, block_size: int = DEFAULT_BLOCK_SIZE):
+    """Flat [N] float -> (codes int8 [ceil(N/B), B], scale fp32 [nb],
+    zero fp32 [nb]). Affine per block: x ≈ (codes + 128) * scale + zero,
+    codes spanning [-128, 127] over the block's [min, max] range."""
+    n = x.shape[0]
+    pad = (-n) % block_size
+    xb = jnp.pad(x.astype(jnp.float32), (0, pad)).reshape(-1, block_size)
+    lo = jnp.min(xb, axis=1, keepdims=True)
+    hi = jnp.max(xb, axis=1, keepdims=True)
+    scale = (hi - lo) / 255.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    codes = jnp.clip(jnp.round((xb - lo) / safe), 0, 255) - 128
+    return codes.astype(jnp.int8), scale[:, 0], lo[:, 0]
+
+
+def dequantize_block_int8(codes, scale, zero, n: Optional[int] = None):
+    """Inverse of ``quantize_block_int8``; trims padding back to ``n``."""
+    x = (codes.astype(jnp.float32) + 128.0) * scale[..., :, None] \
+        + zero[..., :, None]
+    flat = x.reshape(*codes.shape[:-2], -1)
+    return flat if n is None else flat[..., :n]
+
+
+# ---------------------------------------------------------------------------
+# wire tiers: bucket-level collectives (in-trace, inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _axis_size(name) -> int:
+    # lax.axis_size is jax>=0.5; under 0.4 the trace-time axis env carries it
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(name))
+    import jax.core as _core
+    return int(_core.axis_frame(name))
+
+
+def _world(axis_names) -> int:
+    axes = (axis_names, ) if isinstance(axis_names, str) else tuple(axis_names)
+    w = 1
+    for a in axes:
+        w *= _axis_size(a)
+    return w
+
+
+def allreduce_bucket(x, axis_names, tier: str = "fp32",
+                     block_size: int = DEFAULT_BLOCK_SIZE, mean: bool = True):
+    """Average (or sum) a flat bucket over ``axis_names``, through the chosen
+    wire tier. Returns (result [N], residual [N]) — residual is this worker's
+    quantization error (zeros for fp32), for error feedback."""
+    if tier not in WIRE_TIERS:
+        raise ValueError(f"unknown wire tier {tier!r}; expected one of {WIRE_TIERS}")
+    w = _world(axis_names)
+    n = x.shape[0]
+    if tier == "fp32":
+        total = lax.psum(x, axis_names)
+        return (total / w if mean else total), jnp.zeros_like(x)
+    if tier == "int8":
+        codes, scale, zero = quantize_block_int8(x, block_size)
+        # THE wire: int8 codes + per-block fp32 scale/zero
+        all_codes = lax.all_gather(codes, axis_names)   # [W, nb, B] int8
+        all_scale = lax.all_gather(scale, axis_names)   # [W, nb]
+        all_zero = lax.all_gather(zero, axis_names)     # [W, nb]
+        vals = dequantize_block_int8(all_codes, all_scale, all_zero, n)  # [W, N]
+        agg = jnp.mean(vals, axis=0) if mean else jnp.sum(vals, axis=0)
+        mine = dequantize_block_int8(codes, scale, zero, n)
+        return agg, x - mine
+    # onebit: sign bits + one scale per worker (compressed.py wire)
+    packed, scale = pack_signs(x)
+    all_packed = lax.all_gather(packed, axis_names)
+    all_scales = lax.all_gather(scale, axis_names)
+    signs = unpack_signs(all_packed, n)
+    vals = signs * all_scales[:, None]
+    agg = jnp.mean(vals, axis=0) if mean else jnp.sum(vals, axis=0)
+    mine = unpack_signs(packed, n) * scale
+    return agg, x - mine
+
+
+def reduce_scatter_bucket(x, axis_names, tier: str = "fp32",
+                          block_size: int = DEFAULT_BLOCK_SIZE):
+    """Reduce-scatter a flat bucket: worker k returns (shard [N/W] holding the
+    SUM of every worker's k-th chunk, residual [N]). ``x`` length must divide
+    by the axis world (plan with ``pad_multiple=world*block_size``).
+
+    int8 tier: each worker quantizes its N/W-chunks and the exchange is an
+    all-to-all of int8 codes + per-block scales — the summation happens in
+    fp32 after dequantize, so scales never have to match across workers."""
+    if tier not in WIRE_TIERS:
+        raise ValueError(f"unknown wire tier {tier!r}; expected one of {WIRE_TIERS}")
+    w = _world(axis_names)
+    n = x.shape[0]
+    if n % w != 0:
+        raise ValueError(f"bucket length {n} must divide the dp world {w}; "
+                         f"plan_buckets(pad_multiple=world*block) pads for this")
+    if tier == "fp32":
+        return lax.psum_scatter(x, axis_names, scatter_dimension=0, tiled=True), \
+            jnp.zeros_like(x)
+    chunk = n // w
+    if tier == "int8":
+        codes, scale, zero = quantize_block_int8(x, block_size)
+        nb = codes.shape[0]
+        if nb % w != 0:
+            raise ValueError(f"{nb} quantization blocks must divide world {w}; "
+                             f"pad buckets to world*block_size")
+        # all-to-all: worker k receives every worker's k-th chunk of codes
+        ccodes = codes.reshape(w, nb // w, block_size)
+        cscale = scale.reshape(w, nb // w)
+        czero = zero.reshape(w, nb // w)
+        rcodes = lax.all_to_all(ccodes, axis_names, split_axis=0, concat_axis=0,
+                                tiled=False)
+        rscale = lax.all_to_all(cscale, axis_names, split_axis=0, concat_axis=0,
+                                tiled=False)
+        rzero = lax.all_to_all(czero, axis_names, split_axis=0, concat_axis=0,
+                               tiled=False)
+        vals = dequantize_block_int8(rcodes, rscale, rzero)  # [W, chunk]
+        shard = jnp.sum(vals, axis=0)
+        mine = dequantize_block_int8(codes, scale, zero, n)
+        return shard, x - mine
+    # onebit reduce-scatter: pack per-chunk signs with a per-chunk scale and
+    # all-to-all them (the 1-bit analog of the quantized exchange)
+    xc = x.reshape(w, chunk)
+    packs, scales = [], []
+    for k in range(w):  # static unroll: w is a trace-time constant
+        p, s = pack_signs(xc[k])
+        packs.append(p)
+        scales.append(s)
+    packed = jnp.stack(packs)                      # [W, chunk/8] uint8
+    scale = jnp.stack(scales)                      # [W]
+    rpacked = lax.all_to_all(packed, axis_names, split_axis=0, concat_axis=0,
+                             tiled=False)
+    rscale = lax.all_to_all(scale, axis_names, split_axis=0, concat_axis=0,
+                            tiled=False)
+    vals = unpack_signs(rpacked, chunk) * rscale[:, None]
+    shard = jnp.sum(vals, axis=0)
+    mine = (unpack_signs(packed, chunk) * scale[:, None]).reshape(-1)
+    return shard, x - mine
+
+
+def all_gather_bucket(shard, axis_names, tier: str = "fp32",
+                      block_size: int = DEFAULT_BLOCK_SIZE):
+    """Gather per-worker shards back into the full flat bucket (the second
+    half of a two-step allreduce). int8 tier gathers quantized shards —
+    deterministic dequantize, so every worker reconstructs identical values."""
+    if tier not in WIRE_TIERS:
+        raise ValueError(f"unknown wire tier {tier!r}; expected one of {WIRE_TIERS}")
+    if tier == "fp32":
+        return lax.all_gather(shard, axis_names, axis=0, tiled=True)
+    n = shard.shape[0]
+    if tier == "int8":
+        codes, scale, zero = quantize_block_int8(shard, block_size)
+        all_codes = lax.all_gather(codes, axis_names)
+        all_scale = lax.all_gather(scale, axis_names)
+        all_zero = lax.all_gather(zero, axis_names)
+        return dequantize_block_int8(all_codes, all_scale, all_zero, n).reshape(-1)
+    packed, scale = pack_signs(shard)
+    all_packed = lax.all_gather(packed, axis_names)
+    all_scales = lax.all_gather(scale, axis_names)
+    return (unpack_signs(all_packed, n) * all_scales[:, None]).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# tree-level entry point
+# ---------------------------------------------------------------------------
+
+
+def bucketed_allreduce_tree(tree, axis_names, layout: Optional[BucketLayout] = None,
+                            tier: str = "fp32",
+                            block_size: int = DEFAULT_BLOCK_SIZE,
+                            bucket_size_mb: float = 25.0,
+                            error_buckets: Optional[Sequence[jnp.ndarray]] = None,
+                            mean: bool = True):
+    """Average ``tree`` over ``axis_names`` via flat buckets: ~2-4 large
+    collectives instead of one per leaf. Must run inside ``shard_map`` with
+    the axes manual (same contract as ``compressed_allreduce_tree``).
+
+    ``error_buckets``: previous step's quantization residuals (bucket-shaped),
+    folded in before quantizing (error feedback). Returns
+    ``(averaged_tree, new_error_buckets)``.
+    """
+    if layout is None:
+        layout = plan_buckets(tree, bucket_size_mb, pad_multiple=block_size)
+    buckets = flatten_buckets(tree, layout)
+    if error_buckets is not None:
+        if len(error_buckets) != len(buckets):
+            raise ValueError(
+                f"error_buckets has {len(error_buckets)} entries for "
+                f"{len(buckets)} buckets — pass init_error_buckets(layout)")
+        buckets = [b + e for b, e in zip(buckets, error_buckets)]
+    outs, errs = [], []
+    for b in buckets:
+        avg, err = allreduce_bucket(b, axis_names, tier=tier,
+                                    block_size=block_size, mean=mean)
+        outs.append(avg)
+        errs.append(err)
+    return unflatten_buckets(outs, layout, example_tree=tree), errs
+
+
+def init_error_buckets(layout: BucketLayout) -> List[jnp.ndarray]:
+    """Zero residual buffers matching ``layout`` (fp32, padded length)."""
+    return [jnp.zeros((b.padded_size, ), jnp.float32) for b in layout.buckets]
+
+
+# ---------------------------------------------------------------------------
+# wire accounting
+# ---------------------------------------------------------------------------
+
+
+def bucket_wire_bytes(layout: BucketLayout, world: int, tier: str = "fp32",
+                      block_size: int = DEFAULT_BLOCK_SIZE) -> dict:
+    """Receive-side wire bytes per worker for one allreduce of the layout,
+    per tier (per-block scale/zero overhead included), plus collective counts.
+    """
+    from .compressed import wire_bytes as _leaf_wire_bytes
+    total_elems = sum(b.padded_size for b in layout.buckets)
+    per_tier = _leaf_wire_bytes(total_elems, world, block_size=block_size)
+    counts: Dict[str, int] = {}
+    for b in layout.buckets:
+        key = str(np.dtype(b.dtype))
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "n_buckets": len(layout.buckets),
+        "collectives_per_dtype": counts,
+        "elements": total_elems,
+        "fp32_bytes": per_tier["fp32_bytes"],
+        "int8_bytes": per_tier["int8_bytes"],
+        "onebit_bytes": per_tier["compressed_bytes"],
+        "wire_bytes": per_tier[{"fp32": "fp32_bytes", "int8": "int8_bytes",
+                                "onebit": "compressed_bytes"}[tier]],
+    }
+
+
+def record_bucket_traffic(layout: BucketLayout, world: int, tier: str,
+                          block_size: int = DEFAULT_BLOCK_SIZE,
+                          duration: float = 0.0, op: str = "all_reduce",
+                          record_name: str = "bucketed_grad_comm"):
+    """Register one step's bucketed wire volume with the CommsLogger (the
+    in-trace path can't time itself — byte counts flow through
+    ``calc_bw_log`` with the caller-measured ``duration``, see
+    comms_logging.py module docstring)."""
+    from .comms_logging import get_comms_logger
+    cl = get_comms_logger()
+    if not cl.enabled:
+        return None
+    stats = bucket_wire_bytes(layout, world, tier, block_size)
+    cl.append(op, f"{record_name}[{tier}]", duration, stats["wire_bytes"],
+              n_participants=world)
+    return stats

@@ -69,16 +69,41 @@ def compressed_allreduce_tree(tree, error_tree, axis_names):
         return avg.reshape(x.shape).astype(x.dtype), new_err.reshape(x.shape).astype(e.dtype)
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    err_leaves = jax.tree_util.tree_leaves(error_tree)
+    err_leaves, err_treedef = jax.tree_util.tree_flatten(error_tree)
+    # a silent zip of mismatched trees would pair wrong error buffers with
+    # wrong leaves (or drop trailing leaves entirely) — validate up front
+    if err_treedef != treedef:
+        raise ValueError(
+            "compressed_allreduce_tree: error_tree structure does not match "
+            f"tree (tree: {treedef}, error_tree: {err_treedef}); the "
+            "error-feedback buffers must be built from the same pytree")
+    for i, (x, e) in enumerate(zip(leaves, err_leaves)):
+        xs = tuple(getattr(x, "shape", ()))
+        es = tuple(getattr(e, "shape", ()))
+        if xs != es:
+            raise ValueError(
+                f"compressed_allreduce_tree: leaf {i} has shape {xs} but its "
+                f"error buffer has shape {es} — error buffers must mirror "
+                "the gradient leaves exactly")
     out = [one(x, e) for x, e in zip(leaves, err_leaves)]
     avg = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
     new_err = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
     return avg, new_err
 
 
-def wire_bytes(n_elements: int, world: int) -> dict:
-    """Accounting: packed wire vs fp32 gather (per worker, receive side)."""
+def wire_bytes(n_elements: int, world: int, block_size: int = 256) -> dict:
+    """Accounting: per-worker receive-side bytes for each wire tier.
+
+    - fp32: the uncompressed gather (4 bytes/element)
+    - int8: blockwise-quantized tier (1 byte/element + per-block fp32
+      scale + zero-point, 8 bytes per ``block_size`` elements)
+    - onebit (``compressed_bytes``): packed sign bits + one fp32 scale
+      per worker — the 1-bit Adam wire, ~32x
+    """
     packed = world * ((n_elements + 7) // 8 + 4)
+    n_blocks = (n_elements + block_size - 1) // block_size
+    int8 = world * (n_elements + 8 * n_blocks)
     fp32 = world * n_elements * 4
-    return {"compressed_bytes": packed, "fp32_bytes": fp32,
-            "reduction": fp32 / packed}
+    return {"compressed_bytes": packed, "int8_bytes": int8, "fp32_bytes": fp32,
+            "reduction": fp32 / packed,
+            "int8_reduction": fp32 / int8}

@@ -22,6 +22,7 @@ from .feature_configs import (
     DataTypesConfig,
     FlopsProfilerConfig,
     FP16Config,
+    GradientCommConfig,
     MeshConfig,
     MonitorConfig,
     TensorParallelConfig,
@@ -168,6 +169,7 @@ class DeepSpeedTpuConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}))
         self.comms_config = CommsLoggerConfig(**pd.get("comms_logger", {}))
+        self.gradient_comm_config = GradientCommConfig(**pd.get("gradient_comm", {}))
         self.flops_profiler_config = FlopsProfilerConfig(**pd.get("flops_profiler", {}))
         self.monitor_config = MonitorConfig(
             tensorboard=pd.get("tensorboard", {}),

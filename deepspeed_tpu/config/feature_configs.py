@@ -176,6 +176,61 @@ class ActivationCheckpointingConfig(ConfigModel):
     remat_policy: Optional[str] = None
 
 
+# -------------------- gradient comm planner (extension) --------------------
+
+
+class CommQuantizationEnum(str, Enum):
+    fp32 = "fp32"
+    int8 = "int8"
+    onebit = "onebit"
+
+
+class GradientCommConfig(ConfigModel):
+    """Bucketed + quantized gradient collectives (TPU extension; the analog
+    of the reference's ``reduce_bucket_size``/``overlap_comm`` knobs, which
+    are torch-mechanism-inert here — see ZeroConfig docstring — plus an
+    EQuARX-style int8 wire tier between fp32 and the 1-bit sign path).
+
+    - ``enabled``: build the bucketed gradient-comm program when supported
+      (implied by overlap_comm or a non-fp32 quantization tier).
+    - ``bucket_size_mb``: flat-bucket budget; gradients flow as
+      ``ceil(total_bytes / bucket_size)`` collectives per dtype instead of
+      one per pytree leaf.
+    - ``comm_quantization``: wire tier for the gradient reduce —
+      fp32 (exact), int8 (blockwise scale+zero-point, ~4x wire cut),
+      onebit (sign+scale, ~32x).
+    - ``quantization_block_size``: elements per int8 quantization block.
+    - ``error_feedback``: carry the quantization residual into the next
+      microbatch's gradients (quantized tiers only).
+    - ``overlap_comm``: reduce bucket i inside the microbatch scan while
+      microbatch i+1's backward runs (T3-style), carrying partially-reduced
+      bucket shards through the scan instead of reducing the whole
+      accumulated tree at the boundary.
+    - ``comm_quantization_per_dtype``: per-dtype tier override, e.g.
+      ``{"bfloat16": "int8"}`` — selects the tier per-bucket (buckets are
+      dtype-homogeneous).
+    """
+    enabled: bool = False
+    bucket_size_mb: float = Field(25.0, gt=0)
+    comm_quantization: CommQuantizationEnum = CommQuantizationEnum.fp32
+    quantization_block_size: int = Field(256, gt=0)
+    error_feedback: bool = True
+    overlap_comm: bool = False
+    comm_quantization_per_dtype: Dict[str, CommQuantizationEnum] = {}
+
+    @property
+    def active(self) -> bool:
+        return (self.enabled or self.overlap_comm
+                or self.comm_quantization != CommQuantizationEnum.fp32
+                or bool(self.comm_quantization_per_dtype))
+
+    def tier_for_dtype(self, dtype) -> str:
+        import numpy as _np
+        key = str(_np.dtype(dtype))
+        tier = self.comm_quantization_per_dtype.get(key, self.comm_quantization)
+        return tier.value if isinstance(tier, CommQuantizationEnum) else str(tier)
+
+
 # -------------------- comms logging --------------------
 
 

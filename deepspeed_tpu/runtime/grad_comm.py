@@ -1,0 +1,204 @@
+"""Bucketed / quantized gradient-comm train program with microbatch overlap.
+
+Reference: DeepSpeed's hook-driven bucketed reduce with ``overlap_comm``
+(``runtime/zero/stage_1_and_2.py:897 reduce_independent_p_g_buckets_and_remove_grads``
+/ ``:1364 reduce_ipg_grads``): as backward produces gradients, full buckets
+are reduced asynchronously while the rest of backward runs. T3 (PAPERS.md)
+makes the same point at a finer grain — the wall-clock win is collectives
+overlapping the remaining compute, not the collectives themselves.
+
+TPU shape: the engine's default gas>1 program accumulates the FULL gradient
+tree across the microbatch ``lax.scan`` and lets GSPMD emit one implicit
+reduce at the boundary. This module builds the alternative: a ``shard_map``
+program over the data-parallel axes where
+
+1. each microbatch computes LOCAL gradients (dp axes manual — no implicit
+   psum),
+2. the gradients are flattened into the comm planner's dtype-homogeneous
+   buckets (``comm/bucketing.py``) and each bucket is REDUCE-SCATTERED on
+   the spot (``overlap_comm``) — the scan carry holds the partially-reduced
+   bucket *shards* (1/W of the tree per worker), and XLA's latency-hiding
+   scheduler overlaps each bucket's collective with the remaining backward
+   work of the same iteration; with ``overlap_comm: false`` the carry holds
+   locally-accumulated full buckets and one bucketed exchange runs at the
+   boundary,
+3. at the boundary the reduced shards are all-gathered back (the second,
+   independently-quantizable half of the two-step allreduce); under
+   ZeRO-2 the gather is skipped — the scattered buckets exit the region
+   sharded over the ZeRO axes (``ZeroShardingPlan.bucket_shardings``), i.e.
+   the reduce-scatter lands directly on each worker's gradient shard.
+
+The wire tier per bucket (fp32 / int8 / onebit) comes from
+``gradient_comm.comm_quantization`` (+ per-dtype overrides). Error feedback
+for the quantized tiers carries the residual across microbatches WITHIN a
+step (the cross-step residual lives in the 1-bit optimizer's state for the
+``onebit*`` optimizers; this program is optimizer-agnostic, so its residual
+resets at each boundary — documented in docs/comm_compression.md).
+
+Constraints (checked by ``grad_comm_supported``): pure-DP mesh (model/seq/
+expert/pipe axes trivial), no fp16 loss scaling (the overflow check wants
+the exact fp32 reduce), ZeRO stage <= 2, device optimizer (no host offload).
+"""
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.bucketing import (BucketLayout, all_gather_bucket, flatten_buckets,
+                              init_error_buckets, plan_buckets,
+                              reduce_scatter_bucket, unflatten_buckets)
+from ..utils.logging import log_dist
+from .onebit_wire import _smap
+
+
+def grad_comm_supported(engine) -> bool:
+    cfg = engine._config
+    ctx = engine.mesh_ctx
+    dp = sum(ctx.axis_size(a) > 1 for a in ("data", "fsdp"))
+    return (cfg.zero_config.stage <= 2
+            and not cfg.fp16_enabled
+            and dp >= 1  # something to reduce over
+            and all(ctx.axis_size(a) == 1 for a in ("model", "seq", "expert", "pipe")))
+
+
+def build_grad_comm_step(engine, apply_step):
+    """Compile the bucketed-comm train-batch program for ``engine``.
+
+    ``apply_step``: the engine's untraced optimizer-apply body
+    ``(params, acc, opt_state, scale_state) -> (new_params, new_opt, zeroed,
+    new_scale_state, overflow, gnorm)`` — reused so the update math is
+    byte-for-byte the default path's.
+
+    Returns ``(step_fn, layout)`` where ``step_fn`` has the engine's fused
+    train-batch signature ``(params, opt_state, scale_state, stacked_args,
+    static_kv)``.
+    """
+    if not grad_comm_supported(engine):
+        raise ValueError(
+            "the bucketed gradient-comm program needs a pure data-parallel "
+            "mesh, ZeRO stage <= 2, bf16/fp32, and a device optimizer")
+    cfg = engine._config
+    gc = cfg.gradient_comm_config
+    ctx = engine.mesh_ctx
+    mesh = ctx.mesh
+    dp_axes = tuple(a for a in ("data", "fsdp") if ctx.axis_size(a) > 1)
+    ax = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+    w = ctx.axis_size(dp_axes)
+    gas = engine.gradient_accumulation_steps()
+    compute_dtype = engine.compute_dtype
+    apply_fn = engine.apply_fn
+    loss_fn = engine._loss_fn
+    block = int(gc.quantization_block_size)
+    overlap = bool(gc.overlap_comm)
+    feedback = bool(gc.error_feedback)
+
+    # pad every bucket so both the dp split and the quantization blocks
+    # divide; layout is planned once, against the param tree (grads mirror it)
+    layout = plan_buckets(engine.params, gc.bucket_size_mb,
+                          pad_multiple=w * block)
+    tiers = [gc.tier_for_dtype(b.dtype) for b in layout.buckets]
+    quantized = [t != "fp32" for t in tiers]
+    bucket_shardings = engine.zero_plan.bucket_shardings(layout)
+    # ZeRO-2: leave the reduced buckets scattered over the ZeRO axes — the
+    # reduce-scatter IS the gradient partitioning; stage 0/1 gathers back
+    # (replicated grads) inside the region
+    scatter_exit = engine.zero_plan.stage >= 2 and bool(engine.zero_plan.zero_axes)
+
+    from .engine import _extract_loss
+
+    def local_scaled_loss(params, margs):
+        cparams = jax.tree_util.tree_map(
+            lambda x: x.astype(compute_dtype), params)
+        out = apply_fn(cparams, *margs)
+        if loss_fn is not None:
+            loss = loss_fn(out)
+        else:
+            loss, _ = _extract_loss(out)
+        return loss.astype(jnp.float32) / gas, loss
+
+    def region(params, stacked_args):
+        """dp axes manual: params/full replicated, batch locally sharded."""
+
+        def micro(carry, margs):
+            shards, errs, loss_sum = carry
+            (_, loss), grads = jax.value_and_grad(
+                local_scaled_loss, has_aux=True)(params, margs)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            buckets = flatten_buckets(grads, layout)
+            if feedback:
+                buckets = [b + e for b, e in zip(buckets, errs)]
+            new_shards, new_errs = [], []
+            for b, s, e, tier, q in zip(buckets, shards, errs, tiers, quantized):
+                if overlap:
+                    # reduce THIS microbatch's bucket now; the collective
+                    # overlaps the rest of this iteration's backward
+                    red, resid = reduce_scatter_bucket(b, ax, tier, block)
+                    new_shards.append(s + red)
+                else:
+                    # boundary mode: accumulate locally, exchange once below
+                    new_shards.append(s + b)
+                    resid = jnp.zeros_like(e)
+                new_errs.append(resid if (feedback and q) else
+                                jnp.zeros_like(e))
+            return (new_shards, new_errs,
+                    loss_sum + loss.astype(jnp.float32)), None
+
+        shard_len = [b.padded_size // w if overlap else b.padded_size
+                     for b in layout.buckets]
+        init = ([jnp.zeros((n, ), jnp.float32) for n in shard_len],
+                init_error_buckets(layout),
+                jnp.float32(0.0))
+        (shards, _, loss_sum), _ = lax.scan(micro, init, stacked_args)
+        if not overlap:
+            shards = [reduce_scatter_bucket(b, ax, tier, block)[0]
+                      for b, tier in zip(shards, tiers)]
+        # psum_scatter summed over workers; the grad semantic is the mean
+        shards = [s / w for s in shards]
+        if scatter_exit:
+            out_buckets = shards  # exit sharded: P(ax) concatenates them
+        else:
+            out_buckets = [all_gather_bucket(s, ax, tier, block)
+                           for s, tier in zip(shards, tiers)]
+        # match train_batch_steps' reported loss: microbatch mean, dp mean
+        loss_mean = lax.pmean(loss_sum / gas, ax)
+        return loss_mean, out_buckets
+
+    def _arg_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        # dim 0 is the microbatch axis; the batch splits on dim 1 (same rule
+        # as ZeroShardingPlan.batch_sharding(stacked=True))
+        if len(shape) < 2 or shape[1] % w != 0:
+            return P()
+        return P(None, ax)
+
+    bucket_out_spec = [P(ax) if scatter_exit else P() for _ in layout.buckets]
+
+    def step(params, opt_state, scale_state, stacked_args, static_kv):
+        assert not static_kv, "bucketed grad-comm path takes positional batch arrays only"
+        in_specs = (P(), jax.tree_util.tree_map(_arg_spec, stacked_args))
+        fn = _smap(region, mesh, in_specs, (P(), bucket_out_spec), dp_axes)
+        loss, buckets = fn(params, stacked_args)
+        buckets = [lax.with_sharding_constraint(b, s)
+                   for b, s in zip(buckets, bucket_shardings)]
+        acc = unflatten_buckets(buckets, layout, example_tree=params)
+        new_params, new_opt, _, new_scale_state, overflow, gnorm = apply_step(
+            params, acc, opt_state, scale_state)
+        return loss, new_params, new_opt, new_scale_state, overflow, gnorm
+
+    from .loss_scaler import LossScaleState
+    repl = NamedSharding(mesh, P())
+    jitted = jax.jit(
+        step, donate_argnums=(0, 1), static_argnums=(4, ),
+        out_shardings=(None, engine.param_shardings, engine.opt_state_shardings,
+                       LossScaleState(*engine.scale_state_shardings), repl, repl))
+    log_dist(
+        f"bucketed grad-comm program built: {len(layout.buckets)} buckets "
+        f"(dtypes {[str(np.dtype(b.dtype)) for b in layout.buckets]}, tiers "
+        f"{tiers}), overlap={'per-microbatch reduce-scatter' if overlap else 'boundary'}, "
+        f"zero_scatter_exit={scatter_exit}, dp axes {dp_axes}", ranks=[0])
+    return jitted, layout

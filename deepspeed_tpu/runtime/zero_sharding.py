@@ -258,6 +258,26 @@ class ZeroShardingPlan:
             return tree_shardings(opt_state, self.ctx, self.zero_axes)
         return replicated_tree(opt_state, self.ctx)
 
+    def bucket_shardings(self, layout):
+        """Shardings for the FLAT gradient buckets of a comm plan
+        (``comm/bucketing.py BucketLayout``): stage>=2 shards each 1-D bucket
+        over the ZeRO axes — the bucketed reduce-scatter's output lands
+        directly on each worker's shard and stays there (XLA gathers per-leaf
+        on use, exactly where stage-2's allgather-on-use happens); stage<2
+        buckets are replicated (pure-DP allreduce semantics). Buckets are
+        planned with ``pad_multiple`` = dp world so the split always divides.
+        """
+        zaxes = self.zero_axes if self.stage >= 2 else ()
+        size = self.ctx.axis_size(zaxes) if zaxes else 1
+        out = []
+        for b in layout.buckets:
+            if size > 1 and b.padded_size % size == 0:
+                out.append(NamedSharding(
+                    self.ctx.mesh, P(zaxes if len(zaxes) > 1 else zaxes[0])))
+            else:
+                out.append(NamedSharding(self.ctx.mesh, P()))
+        return out
+
     def batch_sharding(self, batch, stacked: bool = False):
         """Batch is sharded over the full data-parallel world on dim 0
         (``stacked=True``: dim 0 is a microbatch axis; shard dim 1)."""

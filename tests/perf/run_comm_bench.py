@@ -1,0 +1,112 @@
+"""Gradient-comm micro-benchmark: bucketed vs per-leaf collectives, and the
+three wire tiers (fp32 / int8 / onebit) of ``comm/bucketing.py``.
+
+Not a pytest assertion — a measurement script. Runs anywhere:
+
+    JAX_PLATFORMS=cpu python tests/perf/run_comm_bench.py
+    python tests/perf/run_comm_bench.py --leaves 64 --elements 1048576
+
+(On CPU the 8 virtual devices share one host, so latencies measure the
+XLA program shape — dispatch count and copy volume — not ICI bandwidth;
+run on a real pod slice for wire numbers. The wire-bytes table is exact
+everywhere.)
+
+Prints one line per variant with ms/allreduce and the modeled per-worker
+wire bytes from ``bucket_wire_bytes``/``wire_bytes``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def bench(fn, args, iters):
+    out = fn(*args)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leaves", type=int, default=32,
+                    help="number of gradient leaves")
+    ap.add_argument("--elements", type=int, default=1 << 18,
+                    help="elements per leaf (fp32)")
+    ap.add_argument("--bucket-mb", type=float, default=4.0)
+    ap.add_argument("--block-size", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    from deepspeed_tpu.comm import MeshContext, set_mesh_context
+    from deepspeed_tpu.comm.bucketing import (bucket_wire_bytes,
+                                              bucketed_allreduce_tree,
+                                              plan_buckets)
+    from deepspeed_tpu.comm.compressed import wire_bytes
+    from deepspeed_tpu.runtime.onebit_wire import _smap
+
+    world = jax.device_count()
+    ctx = MeshContext.create(axis_sizes={"data": world})
+    set_mesh_context(ctx)
+    rng = np.random.default_rng(0)
+    tree = {f"leaf_{i:03d}": jnp.asarray(
+        rng.normal(size=(world, args.elements)), jnp.float32)
+        for i in range(args.leaves)}
+    one_worker = jax.tree_util.tree_map(lambda v: v[0], tree)
+    layout = plan_buckets(one_worker, args.bucket_mb,
+                          pad_multiple=world * args.block_size)
+    total = sum(l.size for l in jax.tree_util.tree_leaves(one_worker))
+    print(f"devices={world} leaves={args.leaves} x {args.elements} elems "
+          f"({total * 4 / 2**20:.1f} MiB fp32) -> {len(layout.buckets)} "
+          f"buckets @ {args.bucket_mb} MiB")
+
+    def run(region):
+        return jax.jit(_smap(region, ctx.mesh, (P("data"), ), P(), ("data", )))
+
+    def per_leaf(t):
+        mine = jax.tree_util.tree_map(lambda v: v[0], t)
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, "data"), mine)
+
+    rows = []
+    dt = bench(run(per_leaf), (tree, ), args.iters)
+    stats = wire_bytes(total, world, args.block_size)
+    rows.append(("per-leaf psum (fp32)", dt, stats["fp32_bytes"], args.leaves))
+
+    for tier in ("fp32", "int8", "onebit"):
+        def bucketed(t, _tier=tier):
+            mine = jax.tree_util.tree_map(lambda v: v[0], t)
+            out, _ = bucketed_allreduce_tree(mine, "data", layout=layout,
+                                             tier=_tier,
+                                             block_size=args.block_size)
+            return out
+
+        dt = bench(run(bucketed), (tree, ), args.iters)
+        bstats = bucket_wire_bytes(layout, world, tier, args.block_size)
+        rows.append((f"bucketed allreduce ({tier})", dt,
+                     bstats["wire_bytes"], bstats["n_buckets"]))
+
+    base = rows[0][2]
+    print(f"{'variant': <28}{'ms/allreduce': >14}{'collectives': >13}"
+          f"{'wire MiB/worker': >17}{'vs fp32': >9}")
+    for name, dt, wire, ncoll in rows:
+        print(f"{name: <28}{dt * 1e3: >14.2f}{ncoll: >13}"
+              f"{wire / 2**20: >17.2f}{base / wire: >8.1f}x")
+
+
+if __name__ == "__main__":
+    main()

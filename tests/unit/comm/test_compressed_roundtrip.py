@@ -1,0 +1,124 @@
+"""Compressed-wire roundtrip + error-feedback tests (satellites of the
+bucketed-comm PR): pack/unpack edge shapes, tree-structure validation, and a
+convergence smoke test showing error feedback recovers fp32-quality SGD."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.compressed import (compressed_allreduce_tree,
+                                           pack_signs, unpack_signs,
+                                           wire_bytes)
+
+
+class TestPackUnpackRoundtrip:
+
+    @pytest.mark.parametrize("n", [1, 7, 8, 9, 63, 100, 257])
+    def test_odd_lengths(self, n):
+        rng = np.random.default_rng(n)
+        x = jnp.asarray(rng.normal(size=(n, )), jnp.float32)
+        packed, scale = pack_signs(x)
+        assert packed.shape == ((n + 7) // 8, ) and packed.dtype == jnp.uint8
+        np.testing.assert_array_equal(
+            np.asarray(unpack_signs(packed, n)),
+            np.where(np.asarray(x) >= 0, 1.0, -1.0))
+        assert float(scale) == pytest.approx(float(jnp.mean(jnp.abs(x))))
+
+    def test_multi_dim_leaf_via_ravel(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(3, 5, 7)), jnp.float32)
+        packed, _ = pack_signs(x.ravel())
+        signs = unpack_signs(packed, x.size).reshape(x.shape)
+        np.testing.assert_array_equal(np.asarray(signs),
+                                      np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+    def test_bf16_input(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(33, )), jnp.bfloat16)
+        packed, scale = pack_signs(x)
+        signs = unpack_signs(packed, 33)
+        np.testing.assert_array_equal(
+            np.asarray(signs),
+            np.where(np.asarray(x, np.float32) >= 0, 1.0, -1.0))
+        assert signs.dtype == jnp.float32
+
+    def test_batched_unpack(self):
+        """unpack_signs broadcasts over leading (worker) axes — the gather
+        layout the wire actually decompresses."""
+        rng = np.random.default_rng(3)
+        xs = [jnp.asarray(rng.normal(size=(20, )), jnp.float32)
+              for _ in range(4)]
+        packed = jnp.stack([pack_signs(x)[0] for x in xs])
+        signs = unpack_signs(packed, 20)
+        assert signs.shape == (4, 20)
+        for i, x in enumerate(xs):
+            np.testing.assert_array_equal(
+                np.asarray(signs[i]), np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+class TestTreeValidation:
+
+    def test_structure_mismatch_raises(self):
+        tree = {"a": jnp.ones(4), "b": jnp.ones(4)}
+        bad = {"a": jnp.zeros(4)}  # missing leaf
+        with pytest.raises(ValueError, match="structure does not match"):
+            compressed_allreduce_tree(tree, bad, "data")
+
+    def test_shape_mismatch_raises_with_leaf_index(self):
+        tree = {"a": jnp.ones((4, )), "b": jnp.ones((2, 3))}
+        bad = {"a": jnp.zeros((4, )), "b": jnp.zeros((3, 2))}
+        with pytest.raises(ValueError, match=r"leaf 1 has shape \(2, 3\)"):
+            compressed_allreduce_tree(tree, bad, "data")
+
+
+class TestWireBytes:
+
+    def test_tiers_ordering_and_overhead(self):
+        stats = wire_bytes(n_elements=1 << 16, world=8, block_size=256)
+        assert stats["compressed_bytes"] < stats["int8_bytes"] < stats["fp32_bytes"]
+        assert stats["reduction"] > 30       # onebit ~32x
+        assert stats["int8_reduction"] > 3   # int8 ~4x incl. scale overhead
+        # int8 overhead = 8 bytes per 256-element block
+        n, w = 1 << 16, 8
+        assert stats["int8_bytes"] == w * (n + 8 * (n // 256))
+
+    def test_odd_block_boundary(self):
+        stats = wire_bytes(n_elements=300, world=2, block_size=256)
+        assert stats["int8_bytes"] == 2 * (300 + 8 * 2)  # 2 partial blocks
+        assert stats["compressed_bytes"] == 2 * ((300 + 7) // 8 + 4)
+
+
+class TestErrorFeedbackConvergence:
+
+    def test_compressed_sgd_on_quadratic_matches_fp32(self):
+        """Smoke test (single worker): 1-bit SGD with error feedback on a
+        quadratic reaches the fp32 optimum; without feedback it stalls at the
+        compression floor. The compression here is exactly the wire's
+        sign*scale (+ residual carry) — the mechanism 1-bit Adam relies on."""
+        rng = np.random.default_rng(4)
+        target = jnp.asarray(rng.normal(size=(64, )), jnp.float32)
+
+        def grad(w):
+            return w - target  # d/dw 0.5||w - target||^2
+
+        lr = 0.05
+        w_ref = jnp.zeros(64)
+        w_fb = jnp.zeros(64)
+        e = jnp.zeros(64)
+        w_nofb = jnp.zeros(64)
+        for _ in range(400):
+            w_ref = w_ref - lr * grad(w_ref)
+            c = grad(w_fb) + e
+            packed, scale = pack_signs(c)
+            g_c = unpack_signs(packed, 64).reshape(64) * scale
+            e = c - g_c
+            w_fb = w_fb - lr * g_c
+            packed2, scale2 = pack_signs(grad(w_nofb))
+            w_nofb = w_nofb - lr * (unpack_signs(packed2, 64).reshape(64) * scale2)
+        ref_err = float(jnp.linalg.norm(w_ref - target))
+        fb_err = float(jnp.linalg.norm(w_fb - target))
+        nofb_err = float(jnp.linalg.norm(w_nofb - target))
+        assert ref_err < 1e-3
+        assert fb_err < 5e-2, "error feedback should track fp32 SGD"
+        assert fb_err < nofb_err / 2, "feedback must beat the no-feedback floor"
