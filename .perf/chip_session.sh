@@ -57,8 +57,12 @@ run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test
 # (1800s: the chunk6 probe added ~one multi-minute compile; with a warm
 # persistent cache the whole stage is seconds)
 run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4 5
-# 3. fast train number: scanned mini-ladder (compiles cached by step 2)
-run bench_fast 1500 env DS_BENCH_FAST=1 python bench.py
+# 3. fast train number: scanned mini-ladder (compiles cached by step 2).
+# DS_TPU_FLASH_FOLDED=0 pins the PER-HEAD kernels: this rung is the A/B
+# baseline for folded_promote, and once a prior session drops the
+# FOLDED_PROVEN sentinel an env-less run would silently go folded —
+# turning the A/B into folded-vs-folded and ratcheting the promotion
+run bench_fast 1500 env DS_TPU_FLASH_FOLDED=0 DS_BENCH_FAST=1 python bench.py
 # 4. serving decode, fast (paged @1k ctx, 2-3 compiles) — the SECOND
 # headline metric comes before any diagnostic: a short window that dies
 # mid-breakdown must still have landed train + serving numbers

@@ -18,12 +18,15 @@ SENTINEL = os.path.join(P, "FOLDED_PROVEN")
 
 
 def best_tok_s(path):
+    """Best non-diagnostic tok/s in a session output, plus the unit tag of
+    that best record (the tag names the RESOLVED attention variant — see
+    bench.py:_folded_attn_resolved)."""
     try:
         lines = [ln for ln in open(path).read().splitlines()
                  if ln.startswith("{")]
     except OSError:
-        return None
-    best = None
+        return None, None
+    best, best_unit = None, None
     for ln in lines:
         try:
             rec = json.loads(ln)
@@ -33,17 +36,28 @@ def best_tok_s(path):
             continue
         if "DIAGNOSTIC" in rec.get("unit", ""):
             continue
-        best = max(best or 0.0, float(rec["value"]))
-    return best
+        v = float(rec["value"])
+        if best is None or v > best:
+            best, best_unit = v, rec.get("unit", "")
+    return best, best_unit
 
 
 def main():
     sfx = sys.argv[1]
-    base = best_tok_s(os.path.join(P, f"bench_fast_r5_{sfx}.out"))
-    folded = best_tok_s(os.path.join(P, f"flash_folded_r5_{sfx}.out"))
+    base, base_unit = best_tok_s(os.path.join(P, f"bench_fast_r5_{sfx}.out"))
+    folded, _ = best_tok_s(os.path.join(P, f"flash_folded_r5_{sfx}.out"))
     print(f"A/B: per-head={base} folded={folded} tok/s")
     if base is None or folded is None:
         print("verdict: incomplete session — sentinel unchanged")
+        return 0
+    if base_unit and "folded-attn" in base_unit:
+        # contaminated baseline: the sentinel was live (and the env unpinned)
+        # when bench_fast ran, so BOTH sides of this A/B executed the folded
+        # kernels. A folded-vs-folded margin says nothing about per-head —
+        # in particular a <2% "loss" here must NOT demote a promotion earned
+        # against a real per-head baseline. Leave the sentinel as-is.
+        print("verdict: baseline ran folded kernels (sentinel was live) — "
+              "A/B invalid, sentinel unchanged")
         return 0
     if folded >= 1.02 * base:
         open(SENTINEL, "w").write(

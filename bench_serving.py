@@ -17,6 +17,11 @@ import time
 
 import numpy as np
 
+# fused-decode dispatch window (K steps per dispatch) — one constant shared
+# by the measurement rungs AND the context-budget sizing above them, so the
+# budget can't silently fall out of step with what the rungs consume
+FUSED_K = 16
+
 
 def measure(platform: str, results=None, checkpoint=lambda: None):
     import jax
@@ -80,7 +85,13 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
         # at prefill — ~4 GB at 32k context; it is the comparison path,
         # not the headline, so cap its sweep where it fits
         ctxs = [c for c in contexts if backend == "paged" or c <= 8192]
-        max_ctx = max(ctxs) + decode_steps + kv_block
+        # context budget per sequence must cover BOTH decode phases: the
+        # per-step loop (warm + decode_steps) AND the fused-window rung that
+        # follows on the SAME sequence (warm dispatch of FUSED_K + at least
+        # two timed dispatches — n_disp = max(decode_steps//K, 2)). Sizing
+        # for only the first phase made the fused rung trip SchedulingError
+        # (context budget exhausted) exactly on short DS_BENCH_FAST sweeps
+        max_ctx = max(ctxs) + 2 * decode_steps + 3 * FUSED_K + kv_block
         chunk = 2048
         eng = build_llama_engine(
             cfg, engine_config=RaggedInferenceEngineConfig(
@@ -89,11 +100,13 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                     max_ragged_batch_size=chunk,  # prefill chunks must fit
                 ),
                 # enough blocks for the long single-sequence sweep AND the
-                # widest concurrent-decode measurement at contexts[0]
+                # widest concurrent-decode measurement at contexts[0] —
+                # including its trailing fused rung (same two-phase budget)
                 num_kv_blocks=max(
                     (max_ctx // kv_block) + 8,
                     max(batch_sizes)
-                    * ((contexts[0] + decode_steps) // kv_block + 2))),
+                    * ((contexts[0] + 2 * decode_steps + 3 * FUSED_K)
+                       // kv_block + 2))),
             kv_block_size=kv_block, kv_cache_dtype=kv_dtype)
         model = eng.model()
         assert isinstance(model, RaggedLlamaModel)
@@ -138,7 +151,7 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             # fused multi-step decode (K steps per dispatch — the
             # CUDA-graph-replay analog): same sequence, same budget,
             # amortizes the per-dispatch host/relay round-trip
-            K = 16
+            K = FUSED_K
             out = eng.fused_decode_steps([uid], [tok], K)  # warm compile
             t0 = time.perf_counter()
             for _ in range(max(decode_steps // K, 2)):
@@ -184,7 +197,7 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
 
             # batched fused decode: N seqs x K steps per dispatch — the
             # continuous-batching steady state with dispatch amortized
-            K = 16
+            K = FUSED_K
             toks_v = [toks[u] for u in uids]
             out = eng.fused_decode_steps(uids, toks_v, K)  # warm
             n_disp = max(decode_steps // K, 2)

@@ -15,6 +15,7 @@ from .config_utils import dict_raise_error_on_duplicate_keys
 from .feature_configs import (
     ActivationCheckpointingConfig,
     AioConfig,
+    AsyncPipelineConfig,
     BF16Config,
     CheckpointConfig,
     CommsLoggerConfig,
@@ -180,6 +181,8 @@ class DeepSpeedTpuConfig:
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
         self.compile_config = CompileConfig(**pd.get("compile", {}))
+        self.async_pipeline_config = AsyncPipelineConfig(
+            **pd.get("async_pipeline", {}))
         self.mesh_config = MeshConfig(**pd.get("mesh", {}))
         self.tensor_parallel_config = TensorParallelConfig(
             **pd.get("tensor_parallel", {}))

@@ -341,10 +341,46 @@ class CheckpointConfig(ConfigModel):
 
 class CompileConfig(ConfigModel):
     """Reference ``runtime/compiler.py`` surface; on TPU everything is always
-    compiled — these knobs control jit options (donation, persistent cache)."""
+    compiled — these knobs control jit options (donation, persistent cache).
+
+    - ``cache_dir``: persistent XLA compilation-cache directory (the
+      autotuner's ``_enable_compile_cache`` promoted into engine init).
+      Multi-restart runs skip recompiles; a pre-existing
+      ``JAX_COMPILATION_CACHE_DIR`` env/config always wins.
+    - ``cache_min_compile_secs``: only programs whose compile took at least
+      this long are persisted (JAX's
+      ``jax_persistent_cache_min_compile_time_secs``).
+    """
     enabled: bool = True
     backend: str = "xla"
     kwargs: Dict[str, Any] = {}
+    cache_dir: Optional[str] = None
+    cache_min_compile_secs: Optional[float] = Field(None, ge=0)
+
+
+class AsyncPipelineConfig(ConfigModel):
+    """TPU extension: fully asynchronous train-step pipeline — keep the
+    device's dispatch queue full by never blocking the host on a per-step
+    device→host round trip in steady state.
+
+    - ``enabled``: switch the engine's train paths to windowed host sync
+      (losses/overflow flags accumulate as device scalars and are fetched
+      in ONE batched transfer every ``sync_interval`` optimizer steps, or
+      on demand via ``engine.get_loss()``), and skip the per-step
+      ``effects_barrier`` in the throughput timer.
+    - ``prefetch_depth``: how many upcoming batches the device-side
+      prefetch iterator keeps in flight (``jax.device_put`` dispatched,
+      sharded per the mesh) while the current step runs; 0 disables the
+      prefetch wrap of ``engine.training_dataloader``.
+    - ``sync_interval``: optimizer steps per host sync window. Deferred
+      inside a window: loss fetch, overflow/skipped-step accounting, host
+      lr-scheduler advance (compiled-path lr is exact regardless — optax
+      reads the update count carried in opt_state), monitor events, and
+      steps_per_print logging.
+    """
+    enabled: bool = False
+    prefetch_depth: int = Field(2, ge=0)
+    sync_interval: int = Field(16, ge=1)
 
 
 # -------------------- TPU mesh (extension) --------------------

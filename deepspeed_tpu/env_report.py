@@ -63,6 +63,15 @@ def debug_report():
                      f"{v if v else NO}")
     lines.append(f"python version {'.' * 34} {sys.version.split()[0]}")
     try:
+        # RESOLVED variant (env override OR silicon-A/B sentinel promotion),
+        # not the raw env var: a FOLDED_PROVEN run with the env unset still
+        # executes the folded kernels and must report as such
+        from .ops.attention import resolved_attention_variant
+        lines.append(f"flash-attention variant {'.' * 25} "
+                     f"{resolved_attention_variant()}")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"flash-attention variant {'.' * 25} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

@@ -173,8 +173,9 @@ class InferenceEngineV2:
         """Largest power-of-two K <= ``cap`` that EVERY sequence can absorb
         (remaining output budget and context room); < 2 means the per-step
         path should run. The power-of-two snap bounds fused-program
-        compiles at O(log cap) per bucket. Shared by generate() and the
-        serving daemon's fused tick."""
+        compiles at O(log cap) per bucket. Whole-batch predicate — callers
+        that can split a mixed-progress wave use :meth:`fused_partition`
+        instead, so one near-budget request doesn't demote the rest."""
         sm = self._config.state_manager
         K = min(cap, min(output_budgets),
                 min(sm.max_context
@@ -183,6 +184,31 @@ class InferenceEngineV2:
         while K >= 2 and K & (K - 1):
             K &= K - 1
         return K
+
+    def fused_partition(self, uids, output_budgets, cap: int):
+        """Split a decode wave into ``(fusable, K, solo)`` so one
+        near-budget request can't demote the WHOLE batch off fused
+        dispatch: ``fusable`` keeps every sequence with >= 2 tokens of room
+        (output budget AND context), ``K`` is the largest power-of-two
+        window <= ``cap`` they can ALL absorb, and ``solo`` holds the
+        constrained sequences that must tick per-step — they are within a
+        token of retiring, so the caller advances them alone for the one
+        or two steps they have left. Shared by generate() and the serving
+        daemon's fused tick."""
+        sm = self._config.state_manager
+        room = {u: min(b, sm.max_context
+                       - self._state_manager.get_sequence(u).seen_tokens)
+                for u, b in zip(uids, output_budgets)}
+        fusable = [u for u in uids if room[u] >= 2]
+        solo = [u for u in uids if room[u] < 2]
+        if not fusable:
+            return [], 0, solo
+        K = min(cap, min(room[u] for u in fusable))
+        while K >= 2 and K & (K - 1):
+            K &= K - 1
+        if K < 2:  # cap itself forbids fusing — everything ticks per-step
+            return [], 0, uids
+        return fusable, K, solo
 
     def decode_finished(self, uid, outputs, max_new_tokens,
                         eos_token_id, stop) -> bool:
@@ -786,19 +812,25 @@ class InferenceEngineV2:
                         and logits_processor is None
                         and fused_steps_cap > 1)
             if fused_ok:
-                K = self.fused_window(
+                # mixed-progress waves SPLIT rather than demote: sequences
+                # with >= 2 tokens of room fuse at the largest window THEY
+                # support; a near-budget straggler (solo) ticks per-step in
+                # the SAME iteration — it is within a token or two of
+                # retiring, so the inline single put is bounded, and the
+                # fused subset keeps streaming K tokens per dispatch
+                fusable, K, solo = self.fused_partition(
                     live, [max_new_tokens - len(outputs[u]) for u in live],
                     fused_steps_cap)
                 toks = None
                 if K >= 2:
                     try:
                         toks = self.fused_decode_steps(
-                            live, [last_tok[u] for u in live], K)
+                            fusable, [last_tok[u] for u in fusable], K)
                     except SchedulingError:
                         pass  # KV pressure: the single-step path below owns
                         # the evict-and-replay protocol
                 if toks is not None:
-                    for i, u in enumerate(live):
+                    for i, u in enumerate(fusable):
                         _absorb_new_tokens(u, list(map(int, toks[i])))
                         if not self.decode_finished(u, outputs[u],
                                                     max_new_tokens,
@@ -809,6 +841,20 @@ class InferenceEngineV2:
                             seq = self._state_manager.get_sequence(u)
                             self._register_pending(seq)
                             self._model.maybe_free_kv(seq)
+                    for u in solo:
+                        try:
+                            logits_u = np.asarray(
+                                self.put([u], [[last_tok[u]]]))[0]
+                        except SchedulingError:
+                            continue  # replayed by the per-step path's
+                            # evict-and-replay protocol next iteration
+                        last_tok[u], lp = self._sample_with_logprob(
+                            _controls(logits_u, u), temperature, rng, top_k,
+                            top_p, want_lp=return_logprobs)
+                        outputs[u].append(last_tok[u])
+                        logprobs[u].append(lp)
+                    # retirement for both groups happens at the top of the
+                    # next loop iteration (the shared decode_finished scan)
                     continue
 
             # total drafted tokens are bounded by the ragged-batch budget

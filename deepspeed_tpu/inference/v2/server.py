@@ -452,8 +452,13 @@ class ServingScheduler:
                       and not r.return_logprobs and r.min_new_tokens == 0
                       and r.repetition_penalty == 1.0
                       and r.logits_processor is None and _prefilled(r)]
-            if greedy and self._fused_tick(greedy):
-                fused_ids = {id(r) for r in greedy}
+            fused = self._fused_tick(greedy) if greedy else []
+            if fused:
+                # exclude exactly the requests the fused dispatch advanced;
+                # near-budget greedy stragglers the partition left out stay
+                # in ``decodes`` and take this same tick's per-token path —
+                # one constrained request no longer demotes the whole wave
+                fused_ids = {id(r) for r in fused}
                 decodes = [r for r in decodes
                            if id(r) not in fused_ids and r in self._live]
                 if not decodes:
@@ -506,29 +511,33 @@ class ServingScheduler:
         self._retire_finished()
         return True
 
-    def _fused_tick(self, decodes) -> bool:
-        """K greedy steps for the given (plain-greedy, prefilled) decodes
-        in ONE dispatch. Returns
-        False (caller falls back to the per-token tick) when the window
-        can't reach 2 steps or KV pressure refuses the wave — the normal
-        tick owns eviction. Token accounting: the dispatch feeds each
-        request's pending token plus its K-1 first generations, so
+    def _fused_tick(self, decodes) -> list:
+        """K greedy steps for the fusable subset of the given (plain-greedy,
+        prefilled) decodes in ONE dispatch. Returns the list of requests the
+        fused dispatch actually advanced — empty when no subset can reach a
+        2-step window or KV pressure refuses the wave (the caller's
+        per-token tick owns eviction). The partition means a request within
+        one token of its budget rides the per-token path alone instead of
+        demoting the whole batch. Token accounting: the dispatch feeds each
+        fused request's pending token plus its K-1 first generations, so
         ``fed += K`` restores the pending==1 decode invariant; requests
         whose emit was cut short (eos/stop/max) retire this tick, exactly
         the conditions _emit_many cut on."""
-        K = self._engine.fused_window(
+        fusable_uids, K, _solo = self._engine.fused_partition(
             [r.uid for r in decodes],
             [r.max_new_tokens - len(r.outputs) for r in decodes],
             self._fused_window)
         if K < 2:
-            return False
+            return []
+        fusable_set = set(fusable_uids)
+        fused = [r for r in decodes if r.uid in fusable_set]
         try:
             toks = self._engine.fused_decode_steps(
-                [r.uid for r in decodes],
-                [r.feed_slice(1)[0] for r in decodes], K)
+                [r.uid for r in fused],
+                [r.feed_slice(1)[0] for r in fused], K)
         except SchedulingError:
-            return False
-        for req, row in zip(decodes, toks):
+            return []
+        for req, row in zip(fused, toks):
             req.fed += K
             self._emit_many(req, [int(t) for t in row])
             if not self._engine.decode_finished(
@@ -541,7 +550,7 @@ class ServingScheduler:
                 self._engine._register_pending(seq)
                 self._engine._model.maybe_free_kv(seq)
         self._retire_finished()
-        return True
+        return fused
 
     def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:
         """One ragged put + row processing. Returns None if KV exhaustion

@@ -6,6 +6,8 @@ import os
 import csv as _csv
 from typing import List, Tuple
 
+import numpy as np
+
 from ..utils.logging import logger
 
 
@@ -128,7 +130,39 @@ class MonitorMaster(Monitor):
         if monitor_config.comet.enabled:
             self.monitors.append(CometMonitor(monitor_config.comet))
         self.enabled = len(self.monitors) > 0
+        self._deferred = []  # async-pipeline queue of un-fetched events
 
     def write_events(self, event_list):
         for m in self.monitors:
             m.write_events(event_list)
+
+    def write_events_async(self, event_list):
+        """Queue events WITHOUT forcing a device→host sync (async-pipeline
+        variant): ``value`` may be a live device scalar — or a device vector
+        paired with a list of per-element steps (the K-step fused dispatch
+        shape). Nothing is fetched until :meth:`flush_events`."""
+        if self.enabled:
+            self._deferred.extend(event_list)
+
+    def flush_events(self, fetch=None):
+        """Resolve every queued event in ONE batched device→host transfer
+        and fan it out to the writers. ``fetch``: the transfer function
+        (defaults to ``jax.device_get``); the engine passes its own seam so
+        sync accounting stays observable."""
+        if not self._deferred:
+            return
+        deferred, self._deferred = self._deferred, []
+        if not self.enabled:
+            return
+        if fetch is None:
+            import jax
+            fetch = jax.device_get
+        values = fetch([v for (_, v, _) in deferred])
+        out = []
+        for (name, _, step), v in zip(deferred, values):
+            a = np.asarray(v)
+            if a.ndim:  # vector event: one value per fused sub-step
+                out.extend((name, float(x), int(s)) for x, s in zip(a, step))
+            else:
+                out.append((name, float(a), int(step)))
+        self.write_events(out)

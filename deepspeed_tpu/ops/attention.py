@@ -211,6 +211,15 @@ def _use_folded() -> bool:
     return os.path.exists(sentinel)
 
 
+def resolved_attention_variant() -> str:
+    """The flash-attention variant that will ACTUALLY run — env override OR
+    sentinel promotion resolved, not just the env var. Reporting surfaces
+    (env_report, bench run tags) must use this: a sentinel-promoted run with
+    the env unset is still a folded run, and labeling it per-head poisons
+    any A/B that keys off the tag."""
+    return "folded" if _use_folded() else "per-head"
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
                softcap=None):
     if _use_folded():

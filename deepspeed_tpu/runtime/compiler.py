@@ -7,6 +7,7 @@ fwd_bwd/apply as whole programs), so ``compile()`` only records the request —
 but ``is_compiled`` keeps the reference's contract: False until ``compile()``
 has been called, True afterwards."""
 
+import os
 from typing import Any, Callable, Optional
 
 from ..utils.logging import logger
@@ -14,6 +15,71 @@ from ..utils.logging import logger
 
 def is_compile_supported() -> bool:
     return True
+
+
+def _reset_cache_latch() -> None:
+    """jax's compilation-cache module latches a "disabled" state at the
+    first compile that runs with no cache dir configured (model.init, eager
+    ops before engine construction all count). After that latch, config
+    updates are silently ignored — entries log "cache is disabled/not
+    initialized". reset_cache() clears the latch so the NEXT compile
+    re-initializes against the directory just configured."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover — internals moved; cache is best-effort
+        pass
+
+
+def configure_compile_cache(compile_config) -> Callable[[], None]:
+    """Point JAX's persistent compilation cache at ``compile.cache_dir``
+    (the autotuner's ``_enable_compile_cache`` promoted into engine init):
+    multi-restart runs skip recompiles of the engine's step programs.
+
+    A pre-existing ``JAX_COMPILATION_CACHE_DIR`` env var or jax.config
+    setting always wins — the engine never redirects a cache the user (or a
+    supervisor process) already chose. The env var is also SET here so
+    spawned child processes inherit the cache. Returns an undo() restoring
+    prior state (no-op when nothing was applied)."""
+    path = getattr(compile_config, "cache_dir", None)
+    if not path:
+        return lambda: None
+    import jax
+    if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or getattr(jax.config, "jax_compilation_cache_dir", None)):
+        return lambda: None  # user's cache wins
+    path = str(path)
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    min_secs = getattr(compile_config, "cache_min_compile_secs", None)
+    prev_min = getattr(jax.config,
+                       "jax_persistent_cache_min_compile_time_secs", None)
+    applied = False
+    try:
+        os.makedirs(path, exist_ok=True)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+        jax.config.update("jax_compilation_cache_dir", path)
+        if min_secs is not None:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_secs))
+        _reset_cache_latch()
+        applied = True
+    except Exception as e:  # pragma: no cover — the cache is an optimization
+        logger.warning(f"persistent compile cache unavailable: {e}")
+
+    def undo() -> None:
+        if not applied:
+            return
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        try:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            if min_secs is not None:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", prev_min)
+            _reset_cache_latch()
+        except Exception:  # pragma: no cover
+            pass
+
+    return undo
 
 
 def disable(fn: Callable) -> Callable:

@@ -4,6 +4,7 @@ or jax arrays; collation stacks to numpy (host) and the engine shards to
 device via the batch sharding plan."""
 
 import math
+from collections import deque
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 import numpy as np
@@ -39,6 +40,77 @@ def default_collate(items):
     if isinstance(first, dict):
         return {k: default_collate([it[k] for it in items]) for k in first}
     return np.stack([np.asarray(it) for it in items])
+
+
+class DevicePrefetchIterator:
+    """Double-buffered device-side input prefetch (async_pipeline tentpole).
+
+    ``put_fn`` dispatches one host batch to device (typically
+    ``jax.device_put`` against the engine's batch sharding). XLA transfers
+    are ASYNC — the put returns immediately with arrays whose copies stream
+    in the background — so keeping ``depth`` batches in flight overlaps
+    host→device input movement with the current step's compute: by the time
+    the consumer needs batch i+1, its transfer raced the step running on
+    batch i.
+
+    Ordering is preserved exactly; exhaustion of the host iterator drains
+    the buffer and then raises StopIteration (an epoch boundary under a
+    per-epoch host loader — re-iterate the wrapping ``PrefetchingLoader``
+    for the next epoch)."""
+
+    def __init__(self, host_iter, put_fn: Callable, depth: int = 2):
+        self._iter = iter(host_iter)
+        self._put = put_fn
+        self.depth = max(1, int(depth))
+        self._buf = deque()
+        self._fill()
+
+    def _fill(self):
+        while len(self._buf) < self.depth:
+            try:
+                batch = next(self._iter)
+            except StopIteration:
+                return
+            self._buf.append(self._put(batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._buf:
+            raise StopIteration
+        batch = self._buf.popleft()
+        # top up BEFORE returning: the refill transfer dispatches while the
+        # caller consumes `batch`
+        self._fill()
+        return batch
+
+
+class PrefetchingLoader:
+    """Re-iterable prefetch wrap of a loader: each ``__iter__`` starts a
+    fresh :class:`DevicePrefetchIterator` over the inner loader's epoch.
+    Forwards ``len``/``set_epoch`` so it drops into training loops written
+    against ``DeepSpeedDataLoader``."""
+
+    def __init__(self, loader, put_fn: Callable, depth: int = 2):
+        self.loader = loader
+        self.put_fn = put_fn
+        self.depth = depth
+
+    def __len__(self):
+        return len(self.loader)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    @property
+    def dataset(self):
+        return getattr(self.loader, "dataset", None)
+
+    def __iter__(self):
+        return DevicePrefetchIterator(iter(self.loader), self.put_fn,
+                                      self.depth)
 
 
 class DeepSpeedDataLoader:

@@ -102,6 +102,52 @@ def _extract_loss(out):
     return out, None
 
 
+def host_fetch(x):
+    """The engine's ONE device→host fetch point. Every steady-state transfer
+    the engine itself initiates (window drains, offload scalars, get_loss)
+    routes through here, so the async-pipeline trace test can monkeypatch a
+    single seam to count/forbid host syncs — JAX's transfer guard does not
+    fire on implicit conversions under the CPU backend, so an
+    instrumentation seam is the portable way to prove "zero per-step
+    syncs"."""
+    return jax.device_get(x)
+
+
+class _AsyncStepWindow:
+    """Bounded in-flight window of un-fetched per-step device scalars
+    (async_pipeline tentpole: windowed host sync).
+
+    Each optimizer step pushes its (loss, overflow) as LIVE device values —
+    no conversion, no barrier — and every ``interval`` in-flight steps the
+    engine drains the window with one batched ``host_fetch`` and reconciles
+    the deferred host accounting (skipped-step counts, lr-scheduler
+    advance, monitor events, steps_per_print logging)."""
+
+    def __init__(self, interval: int):
+        self.interval = max(1, int(interval))
+        self.entries = []  # (steps, loss, overflow) — device values
+        self.comm_steps = 0  # bucketed grad-comm dispatches in this window
+        self.t_start = None
+
+    def push(self, steps, loss, overflow):
+        if self.t_start is None:
+            self.t_start = time.perf_counter()
+        self.entries.append((steps, loss, overflow))
+
+    @property
+    def in_flight(self) -> int:
+        return sum(e[0] for e in self.entries)
+
+    def take(self):
+        """Hand back (entries, wall_seconds, comm_steps) and reset."""
+        entries, self.entries = self.entries, []
+        duration = (time.perf_counter() - self.t_start
+                    if self.t_start is not None else 0.0)
+        comm_steps, self.comm_steps = self.comm_steps, 0
+        self.t_start = None
+        return entries, duration, comm_steps
+
+
 class DeepSpeedTpuEngine:
 
     @staticmethod
@@ -319,6 +365,16 @@ class DeepSpeedTpuEngine:
         self._host_param_names = set()
         self._device_tx = None
 
+        # ---- persistent compilation cache (async_pipeline tentpole 4:
+        # the autotuner-only jax_compilation_cache_dir wiring, promoted) ----
+        from .compiler import configure_compile_cache
+        configure_compile_cache(self._config.compile_config)
+
+        # ---- async step pipeline (windowed host sync) ----
+        apc = self._config.async_pipeline_config
+        self._async_window = (_AsyncStepWindow(apc.sync_interval)
+                              if apc.enabled else None)
+
         # ---- state init ----
         if model_parameters is None and _HAS_FLAX and isinstance(model, nn.Module):
             raise ValueError("model_parameters (the flax params pytree) is required")
@@ -336,7 +392,11 @@ class DeepSpeedTpuEngine:
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(
             self._config, batch_size=self.train_batch_size(),
-            steps_per_output=self._config.steps_per_print)
+            steps_per_output=self._config.steps_per_print,
+            # async pipeline: the per-step effects_barrier is the stall the
+            # windowed sync exists to remove; the boundary drain is the
+            # barrier that keeps multi-step averages honest
+            synchronize=self._async_window is None)
         self.monitor = None
         if any([self._config.monitor_config.tensorboard.enabled,
                 self._config.monitor_config.wandb.enabled,
@@ -392,6 +452,15 @@ class DeepSpeedTpuEngine:
                 batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
                 collate_fn=collate_fn,
                 sampler=self._build_curriculum_sampler(training_data))
+            if apc.enabled and apc.prefetch_depth > 0:
+                # device-side input prefetch (async_pipeline tentpole 1):
+                # the next N batches' host→device transfers dispatch while
+                # the current step runs; the train paths' device_put on an
+                # already-sharded batch is a no-op
+                from .dataloader import PrefetchingLoader
+                self.training_dataloader = PrefetchingLoader(
+                    self.training_dataloader, self._prefetch_put,
+                    apc.prefetch_depth)
 
         log_dist(
             f"DeepSpeedTpuEngine ready: zero_stage={zc.stage} dtype={self.compute_dtype.__name__} "
@@ -627,6 +696,42 @@ class DeepSpeedTpuEngine:
             new_scale_state = scaler_cfg.update(scale_state, overflow)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
             return new_params, new_opt, zeroed, new_scale_state, overflow, gnorm
+
+        # On-device grad-norm/clip for the offload paths (async_pipeline
+        # tentpole 2): the old host step pulled EVERY gradient leaf over
+        # PCIe just to compute the global norm with numpy. This compiled
+        # prep program unscales, norms and clips on device — the host sees
+        # the (already clipped) host-subset grads plus two scalars.
+        self._offload_prep = None
+        if self._host_optimizer is not None:
+            from .host_offload import flatten_tree
+            prep_subset = (frozenset(self._host_param_names)
+                           if self._device_tx is not None else None)
+
+            def offload_prep(acc, scale_state):
+                scale = (scale_state.cur_scale if use_scaling
+                         else jnp.float32(1.0))
+                flat = flatten_tree(acc)
+                grads = {k: v.astype(jnp.float32) / scale
+                         for k, v in flat.items()}
+                # left-fold of per-leaf fp32 sums in flat-key order: a
+                # deterministic reduction the parity test mirrors on host
+                sq = jnp.float32(0.0)
+                for k in grads:
+                    sq = sq + jnp.sum(jnp.square(grads[k]))
+                gnorm = jnp.sqrt(sq)
+                # non-finite sum ⇔ the old host path's overflow predicate
+                overflow = ~jnp.isfinite(sq)
+                if clip > 0:
+                    factor = jnp.where(
+                        overflow, jnp.float32(1.0),
+                        jnp.minimum(1.0, clip / (gnorm + 1e-6)))
+                    grads = {k: g * factor for k, g in grads.items()}
+                out = {k: g for k, g in grads.items()
+                       if prep_subset is None or k in prep_subset}
+                return out, overflow, gnorm
+
+            self._offload_prep = jax.jit(offload_prep)
 
         from .loss_scaler import LossScaleState
         scale_out = LossScaleState(*self.scale_state_shardings)
@@ -994,27 +1099,34 @@ class DeepSpeedTpuEngine:
                  gnorm) = self._apply_step(self.params, self.grad_acc, self.opt_state,
                                            self.scale_state)
             self._last_grad_norm = gnorm
-            if self._use_loss_scaling:
-                # host sync only for logging cadence; cheap scalar
-                if bool(overflow):
-                    self.skipped_steps += 1
-                    log_dist(f"[deepspeed] OVERFLOW! Skipping step. New loss scale: "
-                             f"{float(self.scale_state.cur_scale)}", ranks=[0])
-                else:
-                    self._advance_schedule()
-            else:
-                self._advance_schedule()
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             self.tput_timer.stop(global_step=True)
-            if self.monitor is not None and self.losses is not None:
-                self.monitor.write_events([("Train/Samples/train_loss", float(self.losses),
-                                            self.global_samples)])
-            if self._config.steps_per_print and self.global_steps % self._config.steps_per_print == 0:
-                log_dist(
-                    f"step={self.global_steps}, skipped={self.skipped_steps}, "
-                    f"lr={self.get_lr()}, loss={float(self.losses) if self.losses is not None else None}",
-                    ranks=[0])
+            if (self._async_window is not None
+                    and self._host_optimizer is None):
+                # windowed host sync: overflow stays a device scalar; every
+                # per-step host decision (skip accounting, schedule advance,
+                # monitor, print cadence) is reconciled at the drain
+                self._push_async_step(self.losses, overflow)
+            else:
+                if self._use_loss_scaling:
+                    # host sync only for logging cadence; cheap scalar
+                    if bool(overflow):
+                        self.skipped_steps += 1
+                        log_dist(f"[deepspeed] OVERFLOW! Skipping step. New loss scale: "
+                                 f"{float(self.scale_state.cur_scale)}", ranks=[0])
+                    else:
+                        self._advance_schedule()
+                else:
+                    self._advance_schedule()
+                if self.monitor is not None and self.losses is not None:
+                    self.monitor.write_events([("Train/Samples/train_loss", float(self.losses),
+                                                self.global_samples)])
+                if self._config.steps_per_print and self.global_steps % self._config.steps_per_print == 0:
+                    log_dist(
+                        f"step={self.global_steps}, skipped={self.skipped_steps}, "
+                        f"lr={self.get_lr()}, loss={float(self.losses) if self.losses is not None else None}",
+                        ranks=[0])
             self._flops_profile_post()
         self.timers(STEP_MICRO_TIMER).stop()
 
@@ -1022,40 +1134,30 @@ class DeepSpeedTpuEngine:
         """ZeRO-Offload step, pipelined (reference stage_1_and_2.py cpu-offload
         + cpu_adam + pipelined_optimizer_swapper.py overlap):
 
-        1. kick async device→host copies for EVERY grad leaf up front — the
-           per-leaf readbacks below then wait only for their own leaf while
-           the rest stream in the background;
-        2. one pass over leaves computes the global norm/overflow as
-           transfers complete;
+        1. the compiled prep program unscales, global-norms and clips ON
+           DEVICE (async_pipeline tentpole 2 — no grad leaf crosses PCIe
+           for the norm; only the overflow/gnorm scalars do);
+        2. async device→host copies for every (clipped) grad leaf kick off
+           up front — the per-leaf readbacks below then wait only for their
+           own leaf while the rest stream in the background;
         3. the Adam pass updates one leaf at a time and immediately kicks its
            async host→device upload — uploads overlap the remaining leaves'
            host math (double buffering without CUDA streams)."""
         from .host_offload import flatten_tree, unflatten_like
-        scale = float(self.scale_state.cur_scale) if self._use_loss_scaling else 1.0
-        flat_g = flatten_tree(self.grad_acc)
-        for v in flat_g.values():
+        clipped, overflow_d, gnorm_d = self._offload_prep(self.grad_acc,
+                                                          self.scale_state)
+        for v in clipped.values():
             if hasattr(v, "copy_to_host_async"):
                 v.copy_to_host_async()
-        grads, sq, overflow = {}, 0.0, False
-        for k, v in flat_g.items():
-            g = np.asarray(v, dtype=np.float32)
-            if scale != 1.0:
-                g = g / scale
-            grads[k] = g
-            s = float(np.sum(g.astype(np.float64)**2))
-            if not np.isfinite(s):
-                overflow = True
-            sq += s
-        gnorm = float(np.sqrt(sq)) if np.isfinite(sq) else float("inf")
+        overflow_h, gnorm_h = host_fetch((overflow_d, gnorm_d))
+        overflow, gnorm = bool(overflow_h), float(gnorm_h)
         if not overflow:
-            clip = float(self._config.gradient_clipping or 0.0)
-            factor = min(1.0, clip / (gnorm + 1e-6)) if clip > 0 else 1.0
             flat_s = flatten_tree(self.param_shardings)
-            names = list(grads.keys())
+            names = list(clipped.keys())
             self._host_optimizer.step_begin()
             new_flat = {}
             for i, k in enumerate(names):
-                g = grads[k] * factor if factor != 1.0 else grads[k]
+                g = np.asarray(clipped[k])
                 p_new = self._host_optimizer.step_param(
                     k, g, prefetch=names[i + 1] if i + 1 < len(names) else None)
                 # async dispatch: this upload flies while the next leaf steps
@@ -1075,30 +1177,32 @@ class DeepSpeedTpuEngine:
         device-subset program (async XLA dispatch), then run host Adam WHILE
         the device program executes — the overlap the reference gets from CUDA
         streams (blogs/deepspeed-offloadpp/README.md:10) falls out of XLA's
-        async dispatch. Finally merge host masters back into the param tree."""
+        async dispatch. Finally merge host masters back into the param tree.
+
+        Unscale + global-norm + clip happen ON DEVICE in the compiled prep
+        program (async_pipeline tentpole 2) BEFORE the apply program donates
+        grad_acc: the host subset arrives over PCIe already clipped, so —
+        unlike the old host-side clip — a gradient-clipping config no longer
+        forces a device/host serialization point; only fp16 loss scaling
+        still syncs one scalar (the host Adam must know whether to skip)."""
         from .host_offload import flatten_tree, unflatten_like
-        scale = float(self.scale_state.cur_scale) if self._use_loss_scaling else 1.0
-        flat_g = flatten_tree(self.grad_acc)
-        host_grads = {k: np.asarray(flat_g[k], np.float32) / scale
-                      for k in self._host_param_names}
+        clipped, overflow_d, _ = self._offload_prep(self.grad_acc,
+                                                    self.scale_state)
+        for v in clipped.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
         # device subset steps in its compiled program (donates grad_acc/opt);
         # host params pass through it unchanged (set_to_zero)
         (params, self.opt_state, self.grad_acc, self.scale_state, overflow,
          gnorm) = self._apply_step(self.params, self.grad_acc, self.opt_state,
                                    self.scale_state)
-        clip = float(self._config.gradient_clipping or 0.0)
-        overflow_b = False
-        if self._use_loss_scaling or clip > 0:
-            # clip/overflow need the program's global-grad results — this
-            # host sync serializes device_step then host_step. Without them
-            # the host Adam overlaps the still-executing device program.
-            overflow_b = bool(overflow) if self._use_loss_scaling else False
-            if not overflow_b and clip > 0:
-                factor = min(1.0, clip / (float(gnorm) + 1e-6))
-                for g in host_grads.values():
-                    g *= factor
+        overflow_b = (bool(host_fetch(overflow_d))
+                      if self._use_loss_scaling else False)
         if not overflow_b:
-            master = self._host_optimizer.step(host_grads)
+            # np.asarray blocks only on the host-subset leaves, whose async
+            # copies started before the device apply dispatched
+            master = self._host_optimizer.step(
+                {k: np.asarray(v) for k, v in clipped.items()})
             flat_p = flatten_tree(params)
             flat_s = flatten_tree(self.param_shardings)
             for k in self._host_param_names:
@@ -1110,6 +1214,104 @@ class DeepSpeedTpuEngine:
     def _advance_schedule(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
+
+    # ------------------------------------------------------------------
+    # async step pipeline (windowed host sync)
+    # ------------------------------------------------------------------
+
+    def _prefetch_put(self, batch):
+        """Dispatch one host batch to device, sharded per the mesh (the
+        prefetch iterator's put_fn). Transfers are async — this returns
+        immediately with arrays whose copies stream in the background."""
+        return jax.device_put(batch, self.zero_plan.batch_sharding(batch))
+
+    def prefetch(self, data_iter, depth=None):
+        """Wrap any batch iterator in the device-side prefetch
+        (async_pipeline tentpole 1): the next ``depth`` batches'
+        host→device transfers stay in flight while the current step runs.
+        Yields device-resident batches the train paths consume without a
+        further transfer."""
+        from .dataloader import DevicePrefetchIterator
+        if depth is None:
+            depth = self._config.async_pipeline_config.prefetch_depth or 2
+        return DevicePrefetchIterator(data_iter, self._prefetch_put, depth)
+
+    def _push_async_step(self, loss, overflow, steps=1, sample_base=None):
+        """Record one dispatch's un-fetched device scalars (``steps`` > 1 ⇔
+        a K-step fused dispatch pushing vectors) and queue its monitor
+        events; drain when the window fills."""
+        w = self._async_window
+        w.push(steps, loss, overflow)
+        if self.monitor is not None and loss is not None:
+            bs = self.train_batch_size()
+            if steps == 1:
+                self.monitor.write_events_async(
+                    [("Train/Samples/train_loss", loss, self.global_samples)])
+            else:
+                base = (self.global_samples - (steps - 1) * bs
+                        if sample_base is None else sample_base)
+                self.monitor.write_events_async(
+                    [("Train/Samples/train_loss", loss,
+                      [base + i * bs for i in range(steps)])])
+        if w.in_flight >= w.interval:
+            self._drain_async_window()
+
+    def _drain_async_window(self):
+        """Fetch every in-flight step's (loss, overflow) in ONE batched
+        device→host transfer and reconcile the deferred host accounting:
+        skipped-step counts, lr-scheduler advances (compiled-path lr is
+        exact regardless — optax reads the update count carried in
+        opt_state; only host-side ``get_lr()`` reporting lags mid-window),
+        bucketed-comm traffic banking, monitor flush, steps_per_print."""
+        w = self._async_window
+        if w is None or not w.entries:
+            return
+        entries, duration, comm_steps = w.take()
+        fetched = host_fetch([(loss, ovf) for (_, loss, ovf) in entries])
+        total_steps, n_overflow, last_loss = 0, 0, None
+        for (steps, _, _), (loss_h, ovf_h) in zip(entries, fetched):
+            total_steps += steps
+            if self._use_loss_scaling:
+                a = np.asarray(ovf_h)
+                n_overflow += int(a.sum()) if a.ndim else int(bool(a))
+            if loss_h is not None:
+                l = np.asarray(loss_h)
+                last_loss = float(l.ravel()[-1]) if l.ndim else float(l)
+        self.skipped_steps += n_overflow
+        for _ in range(total_steps - n_overflow):
+            self._advance_schedule()
+        if n_overflow:
+            log_dist(f"[deepspeed] OVERFLOW! {n_overflow} step(s) skipped "
+                     f"in the last sync window.", ranks=[0])
+        if comm_steps and self._grad_comm_layout is not None:
+            from .grad_comm import record_window_traffic
+            gcc = self._config.gradient_comm_config
+            tier = getattr(gcc.comm_quantization, "value",
+                           gcc.comm_quantization)
+            record_window_traffic(
+                self._grad_comm_layout, self.dp_world_size, str(tier),
+                gcc.quantization_block_size, duration, comm_steps,
+                op="reduce_scatter")
+        if self.monitor is not None:
+            self.monitor.flush_events(fetch=host_fetch)
+        spp = self._config.steps_per_print
+        if spp and (self.global_steps // spp
+                    > (self.global_steps - total_steps) // spp):
+            log_dist(
+                f"step={self.global_steps}, skipped={self.skipped_steps}, "
+                f"lr={self.get_lr()}, loss={last_loss}", ranks=[0])
+
+    def get_loss(self):
+        """Latest training loss as a host float. Async mode: drains the
+        in-flight sync window first (ONE batched fetch — this is the
+        documented on-demand sync point), so mid-window calls return the
+        newest step's loss, not a stale boundary value. Returns None before
+        the first step."""
+        self._drain_async_window()
+        if self.losses is None:
+            return None
+        l = np.asarray(host_fetch(self.losses))
+        return float(l.ravel()[-1]) if l.ndim else float(l)
 
     def train_batch(self, data_iter=None):
         """Pipeline-engine-style full batch step (reference pipe/engine.py:337):
@@ -1123,7 +1325,11 @@ class DeepSpeedTpuEngine:
             batch = next(data_iter)
             if not isinstance(batch, tuple):
                 batch = (batch, )
-            return float(self.fused_train_step(*batch))
+            loss = self.fused_train_step(*batch)
+            # async mode returns the LIVE device scalar — float() here would
+            # reinstate the very per-step barrier the window removes; callers
+            # wanting a host number use get_loss() (drains the window)
+            return loss if self._async_window is not None else float(loss)
         if self._train_batch_fused is not None:
             return self._run_fused_train_batch(data_iter)
         losses = []
@@ -1136,6 +1342,8 @@ class DeepSpeedTpuEngine:
             self.step()
             losses.append(loss)  # device scalars; convert after the loop so
             # micro-steps pipeline instead of syncing the host every iteration
+        if self._async_window is not None:
+            return sum(losses) / self.gradient_accumulation_steps()
         return float(sum(float(l) for l in losses)) / self.gradient_accumulation_steps()
 
     def _run_fused_train_batch(self, data_iter):
@@ -1164,13 +1372,22 @@ class DeepSpeedTpuEngine:
         self._last_grad_norm = gnorm
         self.losses = loss
         self.micro_steps += gas
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(global_step=True)
+        if self._async_window is not None:
+            # windowed sync: the loss stays a device scalar; comm traffic is
+            # banked at the drain against the whole window's wall clock
+            # (per-step host timing would itself be the sync we're removing)
+            if self._grad_comm_layout is not None:
+                self._async_window.comm_steps += 1
+            self._push_async_step(loss, overflow)
+            self._flops_profile_post()
+            return loss
         if self._use_loss_scaling and bool(overflow):
             self.skipped_steps += 1
         else:
             self._advance_schedule()
-        self.global_steps += 1
-        self.global_samples += self.train_batch_size()
-        self.tput_timer.stop(global_step=True)
         if self.monitor is not None:
             self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                         self.global_samples)])
@@ -1213,16 +1430,21 @@ class DeepSpeedTpuEngine:
         self._last_grad_norm = gnorm
         self.losses = loss
         self.micro_steps += 1
-        if self._use_loss_scaling and bool(overflow):
-            self.skipped_steps += 1
-        else:
-            self._advance_schedule()
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
-        if self.monitor is not None:
-            self.monitor.write_events([("Train/Samples/train_loss", float(loss),
-                                        self.global_samples)])
+        if self._async_window is not None:
+            # zero host syncs this step: loss/overflow stay device scalars
+            # until the window drains (ONE batched fetch per sync_interval)
+            self._push_async_step(loss, overflow)
+        else:
+            if self._use_loss_scaling and bool(overflow):
+                self.skipped_steps += 1
+            else:
+                self._advance_schedule()
+            if self.monitor is not None:
+                self.monitor.write_events([("Train/Samples/train_loss", float(loss),
+                                            self.global_samples)])
         self._flops_profile_post()
         return loss
 
@@ -1279,22 +1501,27 @@ class DeepSpeedTpuEngine:
         self._last_grad_norm = gnorms[-1]
         self.losses = losses[-1]
         self.micro_steps += K
-        n_overflow = int(jnp.sum(overflows)) if self._use_loss_scaling else 0
-        self.skipped_steps += n_overflow
-        for _ in range(K - n_overflow):
-            self._advance_schedule()
         self.global_steps += K
         self.global_samples += K * self.train_batch_size()
         # one dispatch = K real optimizer steps: the throughput timer and
         # the monitor both see K events, not one
         self.tput_timer.stop(global_step=True, steps=K)
+        if self._async_window is not None:
+            # push the whole K-step dispatch as ONE vector entry: the loss
+            # vector and per-step overflow mask drain together at the window
+            self._push_async_step(losses, overflows, steps=K)
+        else:
+            n_overflow = int(jnp.sum(overflows)) if self._use_loss_scaling else 0
+            self.skipped_steps += n_overflow
+            for _ in range(K - n_overflow):
+                self._advance_schedule()
+            if self.monitor is not None:
+                base = self.global_samples - (K - 1) * self.train_batch_size()
+                self.monitor.write_events(
+                    [("Train/Samples/train_loss", float(l),
+                      base + i * self.train_batch_size())
+                     for i, l in enumerate(np.asarray(losses))])
         self._flops_profile_post()
-        if self.monitor is not None:
-            base = self.global_samples - (K - 1) * self.train_batch_size()
-            self.monitor.write_events(
-                [("Train/Samples/train_loss", float(l),
-                  base + i * self.train_batch_size())
-                 for i, l in enumerate(np.asarray(losses))])
         return losses
 
     def module_forward(self, *args, **kwargs):
@@ -1378,6 +1605,7 @@ class DeepSpeedTpuEngine:
     def destroy(self):
         """Reference ``engine.destroy``: release engine state references so
         device memory can be reclaimed between engines in one process."""
+        self._drain_async_window()  # settle deferred host accounting first
         for attr in ("params", "opt_state", "scale_state", "_pending"):
             setattr(self, attr, None)
         self._fwd_bwd = self._fwd_only = self._apply_step = None
@@ -1471,6 +1699,9 @@ class DeepSpeedTpuEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
+        # settle the async window first: deferred skipped-step / scheduler
+        # accounting must land in the host state the checkpoint captures
+        self._drain_async_window()
         tag = tag or f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         self.checkpoint_engine.create(tag)

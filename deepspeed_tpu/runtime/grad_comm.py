@@ -55,6 +55,25 @@ from ..utils.logging import log_dist
 from .onebit_wire import _smap
 
 
+def record_window_traffic(layout, dp_world: int, tier: str, block_size: int,
+                          duration: float, steps: int,
+                          op: str = "reduce_scatter"):
+    """Window-amortized CommsLogger banking for the async pipeline: with
+    per-step host timing removed (no ``float(loss)`` barrier to measure
+    against), one host-timed sync window covers ``steps`` bucketed-comm
+    dispatches — each is banked at the window-mean duration so
+    ``calc_bw_log`` aggregates the same totals the per-step path reported."""
+    if steps <= 0:
+        return None
+    from ..comm.bucketing import record_bucket_traffic
+    per_step = duration / steps
+    stats = None
+    for _ in range(steps):
+        stats = record_bucket_traffic(layout, dp_world, tier, block_size,
+                                      duration=per_step, op=op)
+    return stats
+
+
 def grad_comm_supported(engine) -> bool:
     cfg = engine._config
     ctx = engine.mesh_ctx
