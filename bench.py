@@ -117,11 +117,10 @@ def bench_engine_config(batch):
             # the timed loop (host-side only: the compiled HLO is unchanged,
             # preserving the mem_triage byte-identity contract)
             "async_pipeline": {"enabled": True, "sync_interval": 16},
-            # persistent XLA compile cache; a pre-set
+            # persistent XLA compile cache: the engine's out-of-repo default
+            # (~/.cache/deepspeed_tpu/xla_cache) — a pre-set
             # JAX_COMPILATION_CACHE_DIR env (the supervisor's) takes precedence
-            "compile": {"cache_dir": os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                ".perf", "xla_cache")},
+            "compile": {},
             "steps_per_print": 0}
 
 

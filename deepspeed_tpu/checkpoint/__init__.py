@@ -1,4 +1,8 @@
-from .engine import CheckpointEngine, OrbaxCheckpointEngine, AsyncCheckpointEngine
+from .engine import (CheckpointEngine, OrbaxCheckpointEngine, AsyncCheckpointEngine,
+                     CheckpointCorruptionError, MANIFEST_FILE, COMMIT_MARKER_FILE,
+                     write_manifest, verify_checkpoint, scan_tags,
+                     find_latest_valid_checkpoint, quarantine_checkpoint,
+                     prune_checkpoints, read_latest_tag, write_latest_tag)
 from .universal import ds_to_universal, load_universal, load_universal_into
 from .zero_to_fp32 import (get_fp32_state_dict_from_zero_checkpoint,
                            convert_zero_checkpoint_to_fp32_state_dict)

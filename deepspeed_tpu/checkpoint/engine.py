@@ -6,16 +6,268 @@ sharded arrays are written/restored natively (each host writes its shards),
 which subsumes the reference's per-DP-rank ZeRO shard files
 (``engine.py:3528 _save_zero_checkpoint``) — orbax metadata records the
 sharding, and restore-with-different-topology covers elastic resume.
+
+Crash consistency (resilience tentpole): every committed checkpoint carries
+an integrity manifest (``ds_manifest.json``: per-entry byte sizes + CRC32)
+and a commit marker (``ds_commit``) written LAST. A directory without the
+marker is a torn write by definition; a directory whose entries disagree
+with the manifest is corrupt. ``verify_checkpoint`` checks both,
+``find_latest_valid_checkpoint`` scans a save dir newest-first and
+quarantines bad tags, and ``prune_checkpoints`` enforces a ``keep_last_n``
+retention policy — all storage mutations bounded by retry-with-backoff.
 """
 
 import json
 import os
-from typing import Any, Dict, Optional
+import re
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..utils.logging import logger
+from ..utils.retry import retry_with_backoff
+from ..utils.fault_injection import (get_fault_injector, tear_checkpoint_dir,
+                                     corrupt_file_in)
+
+MANIFEST_FILE = "ds_manifest.json"
+COMMIT_MARKER_FILE = "ds_commit"
+QUARANTINE_SUFFIX = ".quarantined"
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed manifest verification (torn or corrupt)."""
+
+
+# ---------------------------------------------------------------------------
+# integrity manifest
+# ---------------------------------------------------------------------------
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+def _manifest_entries(path: str) -> Dict[str, Dict[str, int]]:
+    entries = {}
+    for root, _, files in os.walk(path):
+        for f in files:
+            if f in (MANIFEST_FILE, COMMIT_MARKER_FILE):
+                continue
+            p = os.path.join(root, f)
+            rel = os.path.relpath(p, path)
+            entries[rel] = {"size": os.path.getsize(p), "crc32": _crc32_file(p)}
+    return entries
+
+
+def write_manifest(path: str, tag: Any) -> None:
+    """Write the integrity manifest, then the commit marker — in that order,
+    each atomically (tmp + rename): a crash at any point leaves either no
+    marker (torn, detectable) or a fully consistent checkpoint."""
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "tag": str(tag),
+        "entries": _manifest_entries(path),
+    }
+
+    def _write():
+        tmp = os.path.join(path, MANIFEST_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, MANIFEST_FILE))
+
+    retry_with_backoff(_write, desc=f"write manifest {path}")
+
+    def _mark():
+        tmp = os.path.join(path, COMMIT_MARKER_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(str(tag))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, COMMIT_MARKER_FILE))
+
+    retry_with_backoff(_mark, desc=f"write commit marker {path}")
+
+
+def verify_checkpoint(path: str, require_manifest: bool = True) -> Tuple[bool, str]:
+    """Integrity-check one checkpoint directory. Returns ``(ok, reason)``.
+
+    ``require_manifest=False`` grandfathers pre-manifest checkpoints: a dir
+    with NO manifest and NO marker passes (legacy), but a manifest that is
+    present must verify and a manifest without its marker is a torn write."""
+    if not os.path.isdir(path):
+        return False, "missing directory"
+    has_manifest = os.path.exists(os.path.join(path, MANIFEST_FILE))
+    has_marker = os.path.exists(os.path.join(path, COMMIT_MARKER_FILE))
+    if not has_manifest and not has_marker:
+        if require_manifest:
+            return False, "uncommitted (no manifest/commit marker)"
+        return True, "legacy checkpoint (no manifest); verification skipped"
+    if not has_marker:
+        return False, "torn write (manifest present but no commit marker)"
+    if not has_manifest:
+        return False, "commit marker without manifest"
+    try:
+        with open(os.path.join(path, MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+        entries = manifest["entries"]
+    except (json.JSONDecodeError, KeyError, OSError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, meta in entries.items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            return False, f"missing entry {rel}"
+        size = os.path.getsize(p)
+        if size != meta["size"]:
+            return False, f"size mismatch on {rel}: {size} != {meta['size']}"
+        if _crc32_file(p) != meta["crc32"]:
+            return False, f"checksum mismatch on {rel}"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
+# save-dir scanning / quarantine / retention
+# ---------------------------------------------------------------------------
+
+_STEP_RE = re.compile(r"(\d+)\s*$")
+
+
+def _tag_sort_key(load_dir: str, tag: str):
+    """Newest-first ordering: numeric step suffix (global_step<N>) wins,
+    falling back to directory mtime, then name."""
+    m = _STEP_RE.search(tag)
+    step = int(m.group(1)) if m else -1
+    try:
+        mtime = os.path.getmtime(os.path.join(load_dir, tag))
+    except OSError:
+        mtime = 0.0
+    return (step, mtime, tag)
+
+
+def scan_tags(load_dir: str) -> List[str]:
+    """Checkpoint tags under ``load_dir``, newest first (quarantined dirs
+    excluded)."""
+    if not os.path.isdir(load_dir):
+        return []
+    tags = [d for d in os.listdir(load_dir)
+            if os.path.isdir(os.path.join(load_dir, d))
+            and not d.endswith(QUARANTINE_SUFFIX)]
+    return sorted(tags, key=lambda t: _tag_sort_key(load_dir, t), reverse=True)
+
+
+def quarantine_checkpoint(load_dir: str, tag: str) -> Optional[str]:
+    """Move a bad checkpoint dir aside (``<tag>.quarantined[.N]``) so scans
+    never retry it; kept (not deleted) as evidence for postmortems."""
+    src = os.path.join(load_dir, tag)
+    dst = src + QUARANTINE_SUFFIX
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}{QUARANTINE_SUFFIX}.{n}"
+    try:
+        retry_with_backoff(lambda: os.replace(src, dst),
+                           desc=f"quarantine {src}")
+    except Exception as e:  # noqa: BLE001 — quarantine is best-effort
+        logger.warning(f"could not quarantine {src}: {e}")
+        return None
+    logger.warning(f"quarantined corrupt checkpoint {src} -> {dst}")
+    return dst
+
+
+def find_latest_valid_checkpoint(load_dir: str, quarantine: bool = True,
+                                 require_manifest: bool = True) -> Optional[str]:
+    """Newest tag under ``load_dir`` that passes manifest verification,
+    falling back through older tags.
+
+    Only *provably* bad dirs (a manifest or commit marker is present but
+    verification fails: torn or corrupt) are quarantined; dirs with neither
+    file are merely skipped when ``require_manifest`` — they could be a
+    legacy-format checkpoint or another process's in-flight save, and a
+    crash-time scan must not destroy either."""
+    for tag in scan_tags(load_dir):
+        path = os.path.join(load_dir, tag)
+        ok, reason = verify_checkpoint(path, require_manifest=require_manifest)
+        if ok:
+            return tag
+        provable = (os.path.exists(os.path.join(path, MANIFEST_FILE))
+                    or os.path.exists(os.path.join(path, COMMIT_MARKER_FILE)))
+        logger.warning(f"checkpoint {tag} failed verification ({reason}); "
+                       "falling back to an older tag")
+        if quarantine and provable:
+            quarantine_checkpoint(load_dir, tag)
+    return None
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    latest = os.path.join(load_dir, "latest")
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        tag = f.read().strip()
+    return tag or None
+
+
+def write_latest_tag(load_dir: str, tag: Any) -> None:
+    """Atomic ``latest`` pointer update (tmp + rename): readers never see a
+    half-written tag."""
+
+    def _write():
+        tmp = os.path.join(load_dir, "latest.tmp")
+        with open(tmp, "w") as f:
+            f.write(str(tag))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(load_dir, "latest"))
+
+    retry_with_backoff(_write, desc=f"write latest pointer in {load_dir}")
+
+
+def prune_checkpoints(save_dir: str, keep_last_n: int,
+                      protect: Tuple[str, ...] = ()) -> List[str]:
+    """Retention GC: keep the ``keep_last_n`` newest committed tags (plus
+    anything in ``protect`` and the current ``latest`` target), delete the
+    rest with bounded retry. Returns the deleted tags. ``keep_last_n <= 0``
+    keeps everything."""
+    if keep_last_n <= 0:
+        return []
+    keep = set(protect)
+    latest = read_latest_tag(save_dir)
+    if latest:
+        keep.add(latest)
+    tags = scan_tags(save_dir)  # newest first
+    committed = [t for t in tags
+                 if os.path.exists(os.path.join(save_dir, t, COMMIT_MARKER_FILE))]
+    keep.update(committed[:keep_last_n])
+    deleted = []
+    for tag in committed[keep_last_n:]:
+        if tag in keep:
+            continue
+        path = os.path.join(save_dir, tag)
+        try:
+            retry_with_backoff(lambda p=path: shutil.rmtree(p),
+                               desc=f"prune checkpoint {path}")
+            deleted.append(tag)
+        except Exception as e:  # noqa: BLE001 — GC failure must not kill training
+            logger.warning(f"retention GC could not delete {path}: {e}")
+    if deleted:
+        logger.info(f"retention (keep_last_n={keep_last_n}): pruned {deleted}")
+    return deleted
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
 
 
 class CheckpointEngine:
@@ -46,6 +298,12 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     The reference's torch engine writes one file per rank; here a single
     logical checkpoint directory holds OCDBT-sharded arrays + a JSON sidecar
     for host state (step counters, scheduler, rng, client state).
+
+    ``commit(tag)`` is the durability barrier AND the integrity seal: it
+    waits out any async write, persists pending host state, then writes the
+    manifest and (last) the commit marker. It returns False — and the caller
+    must NOT advance the ``latest`` pointer — when the checkpoint could not
+    be sealed.
     """
 
     HOST_STATE_FILE = "ds_host_state.pkl"
@@ -57,6 +315,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self._ocp = ocp
         self._ckptr = ocp.StandardCheckpointer()
         self._async = use_async
+        self._pending_path = None  # path of the save awaiting commit()
 
     def create(self, tag):
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is about to be saved!")
@@ -64,6 +323,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, state_dict: Dict[str, Any], path: str, host_state: Optional[Dict] = None):
         path = os.path.abspath(path)
         self._ckptr.save(path, state_dict, force=True)
+        self._pending_path = path
         if self._async:
             # orbax materializes the dir atomically (tmp → rename) when the
             # async write completes; host state must wait for commit()
@@ -81,10 +341,18 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             with open(os.path.join(path, self.HOST_STATE_FILE), "wb") as f:
                 pickle.dump(host_state, f)
 
-    def load(self, path: str, map_location=None, target=None):
+    def load(self, path: str, map_location=None, target=None, verify: bool = True):
         """Restore; `target` is an abstract pytree (jax.ShapeDtypeStruct with
-        shardings) directing placement — omit to restore as numpy."""
+        shardings) directing placement — omit to restore as numpy.
+
+        ``verify=True`` checks the integrity manifest first (legacy dirs
+        without one pass) and raises :class:`CheckpointCorruptionError`
+        instead of letting orbax deserialize torn data."""
         path = os.path.abspath(path)
+        if verify:
+            ok, reason = verify_checkpoint(path, require_manifest=False)
+            if not ok:
+                raise CheckpointCorruptionError(f"{path}: {reason}")
         if target is not None:
             restored = self._ckptr.restore(path, target)
         else:
@@ -101,13 +369,37 @@ class OrbaxCheckpointEngine(CheckpointEngine):
                 host_state = json.load(f)
         return restored, host_state
 
-    def commit(self, tag):
+    def commit(self, tag) -> bool:
         if self._async:
             self._ckptr.wait_until_finished()
             pending = getattr(self, "_pending_host_state", None)
             if pending is not None:
                 self._write_host_state(*pending)
                 self._pending_host_state = None
+        path = self._pending_path
+        self._pending_path = None
+        if path is not None and jax.process_index() == 0:
+            fi = get_fault_injector()
+            torn = fi.fire("checkpoint.torn_write", path=path, tag=tag)
+            if torn is not None:
+                # simulated crash mid-write: a truncated entry and no
+                # manifest/marker — the load path must detect and fall back
+                tear_checkpoint_dir(path,
+                                    truncate_to=int(torn.get("truncate_to", 16)))
+                logger.error(f"[OrbaxCheckpointEngine] commit of {tag} failed "
+                             "(torn write)")
+                return False
+            try:
+                write_manifest(path, tag)
+            except Exception as e:  # noqa: BLE001 — seal failure = no commit
+                logger.error(f"[OrbaxCheckpointEngine] could not seal {tag}: {e}")
+                return False
+            corrupt = fi.fire("checkpoint.corrupt", path=path, tag=tag)
+            if corrupt is not None:
+                # silent post-commit bit-rot: manifest verification at load
+                # time is the only thing standing between this and a bad
+                # resume — the marker stays, the data lies
+                corrupt_file_in(path, seed=fi.seed)
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is ready now!")
         return True
 

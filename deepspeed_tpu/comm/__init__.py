@@ -1,6 +1,7 @@
 from .comm import *  # noqa: F401,F403
 from .comm import (all_reduce, all_gather, all_gather_into_tensor, reduce_scatter, reduce_scatter_tensor,
                    all_to_all, all_to_all_single, broadcast, barrier, init_distributed, is_initialized,
+                   exchange_host_state,
                    get_world_size, get_rank, get_local_rank, get_axis_index, ppermute, inference_all_reduce,
                    initialize_mesh_device, log_summary, configure, CommHandle,
                    mpi_discovery, parse_slurm_nodelist)

@@ -233,6 +233,93 @@ def mpi_discovery(distributed_port: int = 29500, auto: bool = True):
     return coord, int(nproc or "1"), int(pid or "0")
 
 
+# rendezvous guard rails: a slow-to-arrive host should surface as bounded
+# retries + a clear error, never an indefinite hang (env-overridable so an
+# operator can widen the window for giant pods without a code change)
+DIST_INIT_TIMEOUT_SECS = float(os.environ.get("DS_DIST_INIT_TIMEOUT", 300))
+DIST_INIT_RETRIES = int(os.environ.get("DS_DIST_INIT_RETRIES", 3))
+DIST_INIT_BACKOFF_SECS = float(os.environ.get("DS_DIST_INIT_BACKOFF", 1.0))
+
+
+def _initialize_distributed_guarded(coord, nproc, pid, timeout=None):
+    """``jax.distributed.initialize`` with bounded retry + timeout.
+
+    The bare call blocks until every process reaches the coordinator — a
+    wedged peer hangs the whole pod forever. Here each attempt carries JAX's
+    ``initialization_timeout`` (when the installed version supports it) and
+    transient failures retry with backoff; exhaustion raises
+    ``RetriesExhausted`` so the scheduler can reschedule the job instead of
+    leaking a hung allocation."""
+    import inspect
+    from ..utils.retry import retry_with_backoff
+    from ..utils.fault_injection import get_fault_injector, InjectedFault
+
+    if timeout is None:
+        timeout = DIST_INIT_TIMEOUT_SECS
+    elif hasattr(timeout, "total_seconds"):  # torch-style timedelta
+        timeout = timeout.total_seconds()
+    kwargs = dict(coordinator_address=coord, num_processes=nproc, process_id=pid)
+    try:
+        sig = inspect.signature(jax.distributed.initialize)
+        if "initialization_timeout" in sig.parameters:
+            kwargs["initialization_timeout"] = int(timeout)
+    except (TypeError, ValueError):  # pragma: no cover — builtin/no signature
+        pass
+
+    def _attempt():
+        if get_fault_injector().fire("comm.init_timeout",
+                                     coordinator=coord) is not None:
+            raise InjectedFault(
+                f"comm.init_timeout: rendezvous with {coord} timed out")
+        jax.distributed.initialize(**kwargs)
+
+    retry_with_backoff(
+        _attempt, retries=DIST_INIT_RETRIES, base_delay=DIST_INIT_BACKOFF_SECS,
+        max_delay=30.0,
+        exceptions=(InjectedFault, RuntimeError, OSError, TimeoutError),
+        desc=f"jax.distributed.initialize({coord})")
+
+
+def exchange_host_state(payload, timeout: Optional[float] = None):
+    """All-gather a small pickleable host payload across processes, with a
+    timeout guard: one wedged peer raises ``TimeoutError`` here instead of
+    hanging the exchange forever. Returns ``[payload_0, ..., payload_{n-1}]``
+    (single-process: ``[payload]`` immediately)."""
+    if jax.process_count() == 1:
+        return [payload]
+    import pickle
+    import concurrent.futures
+    from jax.experimental import multihost_utils
+
+    if timeout is None:
+        timeout = DIST_INIT_TIMEOUT_SECS
+    blob = np.frombuffer(pickle.dumps(payload), np.uint8)
+
+    def _run():
+        # two rounds: sizes first (payloads differ per host), then the
+        # max-size padded byte buffers
+        sizes = np.asarray(multihost_utils.process_allgather(
+            np.asarray([blob.size], np.int64))).ravel()
+        padded = np.zeros(int(sizes.max()), np.uint8)
+        padded[:blob.size] = blob
+        out = np.asarray(multihost_utils.process_allgather(padded))
+        return [pickle.loads(bytes(out[i][:int(sizes[i])]))
+                for i in range(out.shape[0])]
+
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="ds-host-exchange")
+    try:
+        return ex.submit(_run).result(timeout=timeout)
+    except concurrent.futures.TimeoutError as e:
+        raise TimeoutError(
+            f"host-state exchange timed out after {timeout}s — a peer "
+            "process is unreachable or wedged") from e
+    finally:
+        # wait=False: on timeout the gather thread is stuck in a collective;
+        # joining it would reintroduce the very hang this guard removes
+        ex.shutdown(wait=False)
+
+
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
                      distributed_port: int = 29500,
@@ -267,7 +354,7 @@ def init_distributed(dist_backend: str = "xla",
     if coord and nproc > 1 and not _INITIALIZED:
         if verbose:
             logger.info(f"init_distributed: coordinator={coord} procs={nproc} id={pid}")
-        jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+        _initialize_distributed_guarded(coord, nproc, pid, timeout)
     if not mesh_is_initialized():
         set_mesh_context(MeshContext.create(axis_sizes=mesh_axes))
     _INITIALIZED = True

@@ -26,6 +26,7 @@ from .feature_configs import (
     GradientCommConfig,
     MeshConfig,
     MonitorConfig,
+    ResilienceConfig,
     TensorParallelConfig,
     ZeroConfig,
 )
@@ -183,6 +184,7 @@ class DeepSpeedTpuConfig:
         self.compile_config = CompileConfig(**pd.get("compile", {}))
         self.async_pipeline_config = AsyncPipelineConfig(
             **pd.get("async_pipeline", {}))
+        self.resilience_config = ResilienceConfig(**pd.get("resilience", {}))
         self.mesh_config = MeshConfig(**pd.get("mesh", {}))
         self.tensor_parallel_config = TensorParallelConfig(
             **pd.get("tensor_parallel", {}))

@@ -383,6 +383,59 @@ class AsyncPipelineConfig(ConfigModel):
     sync_interval: int = Field(16, ge=1)
 
 
+# -------------------- resilience (extension) --------------------
+
+
+class FaultInjectionConfig(ConfigModel):
+    """Deterministic fault plan (``deepspeed_tpu/utils/fault_injection.py``).
+    Each fault entry: ``{"site": <name>, "nth": 1, "times": 1, "args": {}}``
+    — the site fires on its ``nth`` visit for ``times`` visits. Sites:
+    checkpoint.torn_write, checkpoint.corrupt, train.sigterm,
+    train.nan_grads, comm.init_timeout. Inert unless ``enabled``."""
+    enabled: bool = False
+    seed: int = 0
+    faults: List[Dict[str, Any]] = []
+
+
+class ResilienceConfig(ConfigModel):
+    """Fault-tolerant training lifecycle (extension; reference analogue is
+    Nebula tiered checkpointing + the elastic agent). Three cooperating
+    pieces, all off by default:
+
+    - **Preemption autosave / auto-resume**: SIGTERM/SIGINT request a save
+      at the next step boundary (the async window is drained first so the
+      snapshot is exact); ``autosave_interval_steps`` adds periodic saves;
+      ``auto_resume`` scans ``save_dir`` at init for the newest checkpoint
+      that passes manifest verification and restores it.
+    - **Anomaly sentry**: watches overflow/loss-scaler signals plus a
+      windowed loss-spike detector (loss > ``loss_spike_factor`` x median of
+      the last ``loss_spike_window`` good losses, once
+      ``loss_spike_min_history`` good steps exist). After
+      ``max_consecutive_anomalies`` consecutive bad steps it rolls params /
+      opt-state back to the last good checkpoint while keeping the data
+      sampler's position — the offending data window is skipped, not
+      replayed.
+    - **Retention**: ``keep_last_n`` committed tags survive GC (0 keeps
+      all); storage writes retry with exponential backoff
+      (``save_retries`` attempts, ``retry_backoff_secs`` base delay).
+    """
+    enabled: bool = False
+    save_dir: Optional[str] = None
+    autosave_interval_steps: int = Field(0, ge=0)
+    keep_last_n: int = Field(3, ge=0)
+    auto_resume: bool = False
+    preempt_save: bool = True
+    preempt_signals: List[str] = ["SIGTERM", "SIGINT"]
+    max_consecutive_anomalies: int = Field(3, ge=1)
+    loss_spike_window: int = Field(20, ge=2)
+    loss_spike_factor: float = Field(3.0, gt=1.0)
+    loss_spike_min_history: int = Field(5, ge=1)
+    rollback: bool = True
+    save_retries: int = Field(3, ge=1)
+    retry_backoff_secs: float = Field(0.05, ge=0)
+    fault_injection: FaultInjectionConfig = {}
+
+
 # -------------------- TPU mesh (extension) --------------------
 
 

@@ -31,25 +31,55 @@ def _reset_cache_latch() -> None:
         pass
 
 
+# the path THIS function last applied (as opposed to the user/supervisor
+# exporting JAX_COMPILATION_CACHE_DIR before launch): a later explicit
+# ``compile.cache_dir`` may override a self-applied setting, but never a
+# genuinely user-chosen cache — even one exported after a self-apply
+_SELF_APPLIED_PATH = None
+
+
+def default_cache_dir() -> str:
+    """Default persistent-cache location, OUTSIDE any repo/working tree:
+    ``$DS_TPU_COMPILE_CACHE_DIR`` if set, else
+    ``$XDG_CACHE_HOME|~/.cache``/deepspeed_tpu/xla_cache. A cwd-relative
+    default would litter project checkouts with compiled-program blobs (and
+    tempt them into version control)."""
+    env = os.environ.get("DS_TPU_COMPILE_CACHE_DIR")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "deepspeed_tpu", "xla_cache")
+
+
 def configure_compile_cache(compile_config) -> Callable[[], None]:
     """Point JAX's persistent compilation cache at ``compile.cache_dir``
     (the autotuner's ``_enable_compile_cache`` promoted into engine init):
-    multi-restart runs skip recompiles of the engine's step programs.
+    multi-restart runs skip recompiles of the engine's step programs. An
+    unset ``cache_dir`` falls back to :func:`default_cache_dir` (per-user,
+    outside the repo tree).
 
     A pre-existing ``JAX_COMPILATION_CACHE_DIR`` env var or jax.config
     setting always wins — the engine never redirects a cache the user (or a
-    supervisor process) already chose. The env var is also SET here so
-    spawned child processes inherit the cache. Returns an undo() restoring
-    prior state (no-op when nothing was applied)."""
+    supervisor process) already chose. (A cache this module itself applied
+    earlier does not count as user-chosen: an explicit config may replace
+    it.) The env var is also SET here so spawned child processes inherit the
+    cache. Returns an undo() restoring prior state (no-op when nothing was
+    applied)."""
+    global _SELF_APPLIED_PATH
     path = getattr(compile_config, "cache_dir", None)
+    explicit = bool(path)
     if not path:
-        return lambda: None
+        path = default_cache_dir()
     import jax
-    if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
-            or getattr(jax.config, "jax_compilation_cache_dir", None)):
-        return lambda: None  # user's cache wins
+    preset = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+              or getattr(jax.config, "jax_compilation_cache_dir", None))
+    if preset and (preset != _SELF_APPLIED_PATH or not explicit):
+        return lambda: None  # user's cache wins / default already in effect
     path = str(path)
     prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    prev_env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    prev_self = _SELF_APPLIED_PATH
     min_secs = getattr(compile_config, "cache_min_compile_secs", None)
     prev_min = getattr(jax.config,
                        "jax_persistent_cache_min_compile_time_secs", None)
@@ -63,13 +93,19 @@ def configure_compile_cache(compile_config) -> Callable[[], None]:
                               float(min_secs))
         _reset_cache_latch()
         applied = True
+        _SELF_APPLIED_PATH = path
     except Exception as e:  # pragma: no cover — the cache is an optimization
         logger.warning(f"persistent compile cache unavailable: {e}")
 
     def undo() -> None:
+        global _SELF_APPLIED_PATH
         if not applied:
             return
-        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        if prev_env is None:
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        else:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = prev_env
+        _SELF_APPLIED_PATH = prev_self
         try:
             jax.config.update("jax_compilation_cache_dir", prev)
             if min_secs is not None:

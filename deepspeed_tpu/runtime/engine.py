@@ -34,7 +34,11 @@ import numpy as np
 import optax
 
 from .. import comm as dist
-from ..checkpoint.engine import OrbaxCheckpointEngine
+from ..checkpoint.engine import (OrbaxCheckpointEngine, CheckpointCorruptionError,
+                                 find_latest_valid_checkpoint, prune_checkpoints,
+                                 read_latest_tag, verify_checkpoint,
+                                 write_latest_tag)
+from ..utils.fault_injection import get_fault_injector
 from ..comm.mesh import get_mesh_context, mesh_is_initialized
 from ..config import DeepSpeedTpuConfig
 from ..utils.logging import logger, log_dist
@@ -461,6 +465,10 @@ class DeepSpeedTpuEngine:
                 self.training_dataloader = PrefetchingLoader(
                     self.training_dataloader, self._prefetch_put,
                     apc.prefetch_depth)
+
+        # ---- resilience: preemption autosave, anomaly sentry, auto-resume
+        # (after the dataloader so auto-resume can restore sampler state) ----
+        self._init_resilience()
 
         log_dist(
             f"DeepSpeedTpuEngine ready: zero_stage={zc.stage} dtype={self.compute_dtype.__name__} "
@@ -941,12 +949,193 @@ class DeepSpeedTpuEngine:
             shuffle=ds_cfg.get("shuffle", True),
             seed=ds_cfg.get("seed", 1234))
 
+    # ------------------------------------------------------------------
+    # resilience: preemption autosave, anomaly sentry + rollback
+    # ------------------------------------------------------------------
+
+    def _init_resilience(self):
+        rc = self._config.resilience_config
+        self._resilience = rc
+        self._sentry = None
+        self._preempted = False
+        self.preempt_count = 0
+        self._autosave_requested = False
+        self._last_good_tag = None
+        self._resilience_save_dir = rc.save_dir
+        self._signal_prev_handlers = {}
+        if not rc.enabled:
+            return
+        if rc.fault_injection.enabled:
+            get_fault_injector().configure(rc.fault_injection)
+        from .sentry import AnomalySentry
+        self._sentry = AnomalySentry(
+            max_consecutive=rc.max_consecutive_anomalies,
+            spike_window=rc.loss_spike_window,
+            spike_factor=rc.loss_spike_factor,
+            spike_min_history=rc.loss_spike_min_history,
+            monitor=self.monitor)
+        if rc.preempt_save:
+            self._install_preempt_handlers()
+        if rc.auto_resume and rc.save_dir:
+            # scan for the newest checkpoint that passes manifest
+            # verification (NOT blindly `latest`: after a crash the pointer
+            # may name a torn dir) and resume from it
+            path, _ = self.load_checkpoint(rc.save_dir)
+            if path is not None:
+                log_dist(f"[resilience] auto-resumed from {path} at step "
+                         f"{self.global_steps}", ranks=[0])
+
+    def _install_preempt_handlers(self):
+        import signal
+        for name in self._resilience.preempt_signals:
+            sig = getattr(signal, name, None)
+            if sig is None:
+                continue
+            try:
+                prev = signal.signal(sig, self._on_preempt_signal)
+            except (ValueError, OSError):  # not the main thread
+                continue
+            self._signal_prev_handlers[sig] = prev
+
+    def _remove_preempt_handlers(self):
+        import signal
+        for sig, prev in getattr(self, "_signal_prev_handlers", {}).items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._signal_prev_handlers = {}
+
+    def _on_preempt_signal(self, signum, frame):
+        # async-signal context: set flags only; the save happens at the next
+        # step boundary where the engine's state is consistent
+        self._preempted = True
+        self.preempt_count += 1
+        logger.warning(f"[resilience] signal {signum} received; checkpoint "
+                       "will be saved at the next step boundary")
+
+    @property
+    def preempted(self) -> bool:
+        return self.preempt_count > 0
+
+    def _resilience_step_boundary(self, loss=None, overflow=None,
+                                  losses_vec=None, overflows_vec=None):
+        """Per-optimizer-step resilience hook (all four train paths).
+
+        Sync mode feeds the sentry here; async mode feeds it at the window
+        drain (the fetched values already exist there — no extra sync).
+        Autosave/preemption saves always run here: ``save_checkpoint`` drains
+        the async window itself, so the snapshot is exact either way."""
+        rc = self._resilience
+        if not rc.enabled:
+            return
+        fi = get_fault_injector()
+        if fi.enabled and fi.fire("train.sigterm") is not None:
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._sentry is not None and self._async_window is None:
+            if losses_vec is not None:
+                lv = np.asarray(host_fetch(losses_vec)).ravel()
+                ov = (np.asarray(host_fetch(overflows_vec)).ravel()
+                      if overflows_vec is not None else np.zeros(len(lv)))
+                base = self.global_steps - len(lv)
+                obs = [(float(l), bool(o), base + i + 1)
+                       for i, (l, o) in enumerate(zip(lv, ov))]
+            else:
+                l = (None if loss is None
+                     else float(np.asarray(host_fetch(loss)).ravel()[-1]))
+                o = (bool(host_fetch(overflow))
+                     if overflow is not None and self._use_loss_scaling else False)
+                obs = [(l, o, self.global_steps)]
+            for l, o, s in obs:
+                self._sentry.observe(l, o, s)
+                if self._sentry.should_rollback:
+                    self._rollback_to_last_good()
+                    break
+        if (rc.autosave_interval_steps and self.global_steps > 0
+                and self.global_steps % rc.autosave_interval_steps == 0):
+            self._autosave_requested = True
+        if self._preempted and rc.preempt_save:
+            self._autosave_requested = True
+            self._preempted = False  # one save per preemption notice
+        if self._autosave_requested and self._resilience_save_dir:
+            self._autosave_requested = False
+            ok = self.save_checkpoint(self._resilience_save_dir)
+            log_dist(f"[resilience] autosave at step {self.global_steps}: "
+                     f"{'committed' if ok else 'FAILED'}", ranks=[0])
+
+    def _sentry_observe_window(self, entries, fetched):
+        """Async path: feed the sentry from the drain's already-fetched
+        (loss, overflow) window, newest-last; roll back at most once."""
+        base = self.global_steps
+        total = sum(steps for steps, _, _ in entries)
+        step = base - total
+        for (steps, _, _), (loss_h, ovf_h) in zip(entries, fetched):
+            lv = (np.asarray(loss_h).ravel() if loss_h is not None
+                  else np.asarray([np.nan] * steps))
+            ov = np.asarray(ovf_h).ravel() if ovf_h is not None else np.zeros(steps)
+            if len(lv) < steps:
+                lv = np.resize(lv, steps)
+            if len(ov) < steps:
+                ov = np.resize(ov, steps)
+            for i in range(steps):
+                step += 1
+                l = float(lv[i]) if loss_h is not None else None
+                self._sentry.observe(l, bool(ov[i]) and self._use_loss_scaling,
+                                     step)
+                if self._sentry.should_rollback:
+                    self._rollback_to_last_good()
+                    return
+
+    def _rollback_to_last_good(self) -> bool:
+        """Anomaly recovery: restore params/opt-state/counters from the last
+        good checkpoint, but KEEP the data sampler's current position — the
+        offending data window is skipped, not replayed (replaying it would
+        reproduce the same anomaly)."""
+        rc = self._resilience
+        self._sentry.reset()
+        if not rc.rollback or not self._resilience_save_dir:
+            logger.warning("[resilience] anomaly threshold hit but rollback "
+                           "is disabled or no save_dir is configured")
+            return False
+        sampler = getattr(self.training_dataloader, "sampler", None) \
+            if self.training_dataloader is not None else None
+        sampler_sd = sampler.state_dict() \
+            if sampler is not None and hasattr(sampler, "state_dict") else None
+        tag = self._last_good_tag or \
+            find_latest_valid_checkpoint(self._resilience_save_dir)
+        if tag is None:
+            logger.warning("[resilience] no valid checkpoint to roll back to")
+            return False
+        try:
+            path, _ = self.load_checkpoint(self._resilience_save_dir, tag=tag)
+        except CheckpointCorruptionError as e:
+            logger.error(f"[resilience] rollback target is corrupt: {e}")
+            return False
+        if path is None:
+            return False
+        if sampler_sd is not None:
+            # load_checkpoint rewound the sampler with everything else;
+            # restore its pre-rollback position to skip the bad window
+            sampler.load_state_dict(sampler_sd)
+        self._sentry.note_rollback(tag, self.global_steps)
+        return True
+
     def _apply_data_efficiency(self, args, kwargs):
         """Per-micro-batch data-efficiency hooks (reference engine.py:1877-1883):
         advance the curriculum and truncate the batch to the current seqlen
         difficulty; advance random-LTD and inject its keep-count. Seqlen
         truncation changes array shapes, so each difficulty level compiles
         once — ``difficulty_step`` bounds the number of distinct programs."""
+        fi = get_fault_injector()
+        if fi.enabled and fi.fire("train.nan_grads") is not None:
+            # poison the micro-batch's float inputs: forward produces a NaN
+            # loss, backward NaN grads — the sentry must catch the episode
+            def _poison(x):
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                    return jnp.full_like(x, jnp.nan)
+                return x
+            args = jax.tree_util.tree_map(_poison, args)
         if self.curriculum_scheduler_legacy is not None:
             self.curriculum_scheduler_legacy.update_difficulty(self.global_steps + 1)
             if self._config.curriculum_params_legacy.get("curriculum_type") == "seqlen":
@@ -1128,6 +1317,7 @@ class DeepSpeedTpuEngine:
                         f"lr={self.get_lr()}, loss={float(self.losses) if self.losses is not None else None}",
                         ranks=[0])
             self._flops_profile_post()
+            self._resilience_step_boundary(loss=self.losses, overflow=overflow)
         self.timers(STEP_MICRO_TIMER).stop()
 
     def _host_offload_step(self):
@@ -1294,6 +1484,10 @@ class DeepSpeedTpuEngine:
                 op="reduce_scatter")
         if self.monitor is not None:
             self.monitor.flush_events(fetch=host_fetch)
+        if getattr(self, "_sentry", None) is not None:
+            # async-mode sentry feed: the window's values were just fetched
+            # in the batched transfer above — zero additional syncs
+            self._sentry_observe_window(entries, fetched)
         spp = self._config.steps_per_print
         if spp and (self.global_steps // spp
                     > (self.global_steps - total_steps) // spp):
@@ -1383,6 +1577,7 @@ class DeepSpeedTpuEngine:
                 self._async_window.comm_steps += 1
             self._push_async_step(loss, overflow)
             self._flops_profile_post()
+            self._resilience_step_boundary(loss=loss, overflow=overflow)
             return loss
         if self._use_loss_scaling and bool(overflow):
             self.skipped_steps += 1
@@ -1404,6 +1599,7 @@ class DeepSpeedTpuEngine:
                 self._grad_comm_layout, self.dp_world_size,
                 str(tier), gcc.quantization_block_size,
                 duration=time.perf_counter() - step_t0, op="reduce_scatter")
+        self._resilience_step_boundary(loss=loss, overflow=overflow)
         return loss_val
 
     def fused_train_step(self, *args, **kwargs):
@@ -1446,6 +1642,7 @@ class DeepSpeedTpuEngine:
                 self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                             self.global_samples)])
         self._flops_profile_post()
+        self._resilience_step_boundary(loss=loss, overflow=overflow)
         return loss
 
     def eval_batch(self, *args, **kwargs):
@@ -1522,6 +1719,7 @@ class DeepSpeedTpuEngine:
                       base + i * self.train_batch_size())
                      for i, l in enumerate(np.asarray(losses))])
         self._flops_profile_post()
+        self._resilience_step_boundary(losses_vec=losses, overflows_vec=overflows)
         return losses
 
     def module_forward(self, *args, **kwargs):
@@ -1606,6 +1804,7 @@ class DeepSpeedTpuEngine:
         """Reference ``engine.destroy``: release engine state references so
         device memory can be reclaimed between engines in one process."""
         self._drain_async_window()  # settle deferred host accounting first
+        self._remove_preempt_handlers()
         for attr in ("params", "opt_state", "scale_state", "_pending"):
             setattr(self, attr, None)
         self._fwd_bwd = self._fwd_only = self._apply_step = None
@@ -1713,10 +1912,23 @@ class DeepSpeedTpuEngine:
             # (engine.py:3538): every checkpoint also carries consolidated
             # 16-bit weights a serving stack can load without the topology
             self.save_16bit_model(path)
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-        self.checkpoint_engine.commit(tag)
+        # commit BEFORE advancing `latest`: commit is the durability barrier
+        # (async write settled, host state flushed, manifest + marker
+        # sealed) — the old order left `latest` pointing at an uncommitted,
+        # possibly torn checkpoint if the process died in between
+        committed = self.checkpoint_engine.commit(tag) is not False
+        if not committed:
+            logger.error(f"checkpoint {tag} failed to commit; `latest` still "
+                         f"points at the previous checkpoint")
+            return False
+        self._last_good_tag = str(tag)
+        if jax.process_index() == 0:
+            if save_latest:
+                write_latest_tag(save_dir, tag)
+            rc = getattr(self, "_resilience", None)
+            if rc is not None and rc.enabled and rc.keep_last_n:
+                prune_checkpoints(save_dir, rc.keep_last_n,
+                                  protect=(str(tag), ))
         return True
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz",
@@ -1767,13 +1979,26 @@ class DeepSpeedTpuEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
         if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                logger.warning(f"Unable to find latest file at {latest}, "
-                               "if trying to load latest checkpoint please pass tag")
+            # `latest` is authoritative while it names a sealed, verified
+            # checkpoint. After a crash it may be missing, stale, or name a
+            # torn/corrupt dir — then fall back through older tags until one
+            # passes manifest verification (provably-bad dirs quarantined).
+            lt = read_latest_tag(load_dir)
+            if lt is not None and verify_checkpoint(
+                    os.path.join(load_dir, str(lt)), require_manifest=True)[0]:
+                tag = lt
+            if tag is None:
+                tag = find_latest_valid_checkpoint(load_dir)
+            if tag is None and lt is not None and verify_checkpoint(
+                    os.path.join(load_dir, str(lt)), require_manifest=False)[0]:
+                # pre-manifest (legacy) checkpoint: the pointer is the only
+                # trust anchor available — honor it
+                tag = lt
+            if tag is None:
+                logger.warning(f"Unable to find a valid checkpoint in "
+                               f"{load_dir}, if trying to load a specific "
+                               "checkpoint please pass tag")
                 return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
 
         # abstract target: restore straight into the live shardings
@@ -1812,4 +2037,5 @@ class DeepSpeedTpuEngine:
                 # resume consumed_samples + curriculum difficulty: training
                 # continues on the right difficulty band, no replayed data
                 sampler.load_state_dict(host_state["data_sampler"])
+        self._last_good_tag = str(tag)
         return path, client_state

@@ -1,2 +1,4 @@
 from .logging import logger, log_dist, print_rank_0, should_log_le, warn_once
 from .timer import SynchronizedWallClockTimer, NoopTimer, ThroughputTimer, trim_mean
+from .retry import retry_with_backoff, RetriesExhausted
+from .fault_injection import FaultInjector, InjectedFault, get_fault_injector

@@ -1,0 +1,47 @@
+"""Bounded retry with exponential backoff.
+
+Storage writes (checkpoint manifests, `latest` pointers, retention GC) and
+the distributed rendezvous both talk to systems that fail transiently —
+NFS/GCS hiccups, a coordinator that isn't up yet. Every resilience-layer
+caller routes through this one helper so the retry budget is bounded and
+uniform: no unbounded spin, no bare ``while True`` around IO.
+"""
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .logging import logger
+
+
+class RetriesExhausted(RuntimeError):
+    """All attempts failed; ``__cause__`` is the last underlying error."""
+
+
+def retry_with_backoff(fn: Callable,
+                       retries: int = 3,
+                       base_delay: float = 0.05,
+                       max_delay: float = 2.0,
+                       exceptions: Tuple[Type[BaseException], ...] = (OSError, ),
+                       desc: Optional[str] = None,
+                       sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` up to ``retries`` times, sleeping ``base_delay * 2**i``
+    (capped at ``max_delay``) between attempts. Non-matching exceptions
+    propagate immediately; exhausting the budget raises
+    :class:`RetriesExhausted` chained to the last error."""
+    retries = max(1, int(retries))
+    last = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except exceptions as e:  # noqa: PERF203 — the retry IS the point
+            last = e
+            if attempt + 1 < retries:
+                delay = min(max_delay, base_delay * (2 ** attempt))
+                logger.warning(
+                    f"{desc or getattr(fn, '__name__', 'op')}: attempt "
+                    f"{attempt + 1}/{retries} failed ({e}); retrying in "
+                    f"{delay:.2f}s")
+                sleep(delay)
+    raise RetriesExhausted(
+        f"{desc or getattr(fn, '__name__', 'op')} failed after {retries} "
+        f"attempts: {last}") from last

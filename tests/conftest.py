@@ -43,6 +43,12 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "world_size(n): devices required for this test")
     config.addinivalue_line("markers", "tpu: requires real TPU hardware")
     config.addinivalue_line("markers", "slow: long-running test")
+    # faults: fast, CPU-only fault-injection resilience tests (torn writes,
+    # SIGTERM autosave, NaN rollback). NOT excluded from the tier-1
+    # selection (`-m 'not slow'`) — they run in the standard verify pass;
+    # the marker exists so `-m faults` can run just the resilience suite.
+    config.addinivalue_line(
+        "markers", "faults: fault-injection resilience test (CPU-only, fast)")
 
 
 import jax  # noqa: E402
@@ -54,6 +60,15 @@ def _reset_global_mesh():
     yield
     from deepspeed_tpu.comm import reset_mesh_context
     reset_mesh_context()
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injector():
+    """The fault injector is process-global; a plan configured by one test
+    must never leak into the next."""
+    yield
+    from deepspeed_tpu.utils.fault_injection import get_fault_injector
+    get_fault_injector().reset()
 
 
 @pytest.fixture

@@ -1,0 +1,251 @@
+"""Crash-consistent checkpointing: manifest/commit-marker integrity,
+torn-write detection, fallback-through-older-tags, quarantine, retention GC,
+and async-engine commit ordering — driven by the deterministic
+fault-injection harness."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from simple_model import simple_model_and_params  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm.mesh import reset_mesh_context  # noqa: E402
+from deepspeed_tpu.checkpoint.engine import (  # noqa: E402
+    MANIFEST_FILE, COMMIT_MARKER_FILE, AsyncCheckpointEngine,
+    CheckpointCorruptionError, verify_checkpoint, write_manifest, scan_tags,
+    find_latest_valid_checkpoint, prune_checkpoints, read_latest_tag)
+from deepspeed_tpu.utils.fault_injection import get_fault_injector  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+
+def _engine(**over):
+    reset_mesh_context()
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000}
+    cfg.update(over)
+    model, params = simple_model_and_params(seed=0)
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=cfg)
+    return engine
+
+
+def _step(engine, x=None):
+    x = jnp.ones((8, 16)) if x is None else x
+    loss = engine.forward(x, jnp.zeros_like(x))
+    engine.backward(loss)
+    engine.step()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# manifest + verification primitives
+# ---------------------------------------------------------------------------
+
+
+def test_commit_writes_manifest_then_marker(tmp_path):
+    e = _engine()
+    _step(e)
+    assert e.save_checkpoint(tmp_path, tag="t") is True
+    ckpt = tmp_path / "t"
+    assert (ckpt / MANIFEST_FILE).exists()
+    assert (ckpt / COMMIT_MARKER_FILE).exists()
+    manifest = json.loads((ckpt / MANIFEST_FILE).read_text())
+    assert manifest["tag"] == "t"
+    # every data file is covered, with real sizes
+    for rel, meta in manifest["entries"].items():
+        assert os.path.getsize(ckpt / rel) == meta["size"]
+    assert verify_checkpoint(str(ckpt)) == (True, "ok")
+
+
+def test_verify_detects_size_and_checksum_mismatch(tmp_path):
+    d = tmp_path / "c"
+    d.mkdir()
+    (d / "data.bin").write_bytes(b"x" * 100)
+    write_manifest(str(d), "c")
+    assert verify_checkpoint(str(d))[0]
+    # same size, different bytes -> checksum catches it
+    (d / "data.bin").write_bytes(b"y" * 100)
+    ok, reason = verify_checkpoint(str(d))
+    assert not ok and "checksum" in reason
+    # different size
+    (d / "data.bin").write_bytes(b"x" * 50)
+    ok, reason = verify_checkpoint(str(d))
+    assert not ok and "size" in reason
+
+
+def test_verify_legacy_and_torn_semantics(tmp_path):
+    d = tmp_path / "legacy"
+    d.mkdir()
+    (d / "data.bin").write_bytes(b"z" * 10)
+    # no manifest, no marker: legacy checkpoints load via explicit tag...
+    assert verify_checkpoint(str(d), require_manifest=False)[0]
+    # ...but never win a newest-valid scan
+    assert not verify_checkpoint(str(d), require_manifest=True)[0]
+    # manifest without its marker = torn write, under BOTH modes
+    write_manifest(str(d), "legacy")
+    os.remove(d / COMMIT_MARKER_FILE)
+    for req in (True, False):
+        ok, reason = verify_checkpoint(str(d), require_manifest=req)
+        assert not ok and "torn" in reason
+
+
+def test_scan_orders_numeric_steps_not_lexicographic(tmp_path):
+    for tag in ("global_step9", "global_step10", "global_step2"):
+        d = tmp_path / tag
+        d.mkdir()
+        (d / "x").write_bytes(b"a")
+        write_manifest(str(d), tag)
+    assert scan_tags(str(tmp_path))[:2] == ["global_step10", "global_step9"]
+    assert find_latest_valid_checkpoint(str(tmp_path)) == "global_step10"
+
+
+# ---------------------------------------------------------------------------
+# torn/corrupt newest -> fallback (acceptance criterion a)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_write_fails_commit_and_latest_stays(tmp_path):
+    e = _engine()
+    _step(e)
+    assert e.save_checkpoint(tmp_path) is True  # global_step1
+    assert read_latest_tag(str(tmp_path)) == "global_step1"
+    _step(e)
+    get_fault_injector().configure(
+        {"faults": [{"site": "checkpoint.torn_write", "nth": 1}]})
+    # the torn save reports failure and does NOT advance `latest`
+    assert e.save_checkpoint(tmp_path) is False  # global_step2, torn
+    assert read_latest_tag(str(tmp_path)) == "global_step1"
+    torn = tmp_path / "global_step2"
+    assert torn.exists() and not (torn / COMMIT_MARKER_FILE).exists()
+
+    # a fresh engine resumes from the older committed tag, never the torn
+    # debris — both via the still-correct `latest` pointer...
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert e2.global_steps == 1
+
+    # ...and via a bare scan when even `latest` was lost in the crash (the
+    # unsealed dir is skipped, not picked as "newest")
+    os.remove(tmp_path / "latest")
+    e3 = _engine()
+    path, _ = e3.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert e3.global_steps == 1
+
+
+def test_corrupt_newest_falls_back_through_manifest(tmp_path):
+    e = _engine()
+    _step(e)
+    assert e.save_checkpoint(tmp_path) is True  # global_step1, clean
+    _step(e)
+    # commit succeeds (marker present, `latest` advanced), THEN silent
+    # bit-rot flips bytes in a manifest-covered entry
+    get_fault_injector().configure(
+        {"faults": [{"site": "checkpoint.corrupt", "nth": 1}]})
+    assert e.save_checkpoint(tmp_path) is True  # global_step2, corrupt
+    assert read_latest_tag(str(tmp_path)) == "global_step2"
+    ok, reason = verify_checkpoint(str(tmp_path / "global_step2"))
+    assert not ok and "checksum" in reason
+
+    # no-tag load: `latest` names the corrupt dir, verification rejects it,
+    # the scan quarantines it and falls back to global_step1
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert e2.global_steps == 1
+    assert not (tmp_path / "global_step2").exists()
+    assert (tmp_path / "global_step2.quarantined").exists()
+
+    # explicit-tag load of a quarantined/corrupt dir fails loudly instead
+    e3 = _engine()
+    with pytest.raises(CheckpointCorruptionError):
+        e3.load_checkpoint(str(tmp_path), tag="global_step2.quarantined")
+
+
+# ---------------------------------------------------------------------------
+# retention GC
+# ---------------------------------------------------------------------------
+
+
+def test_prune_keeps_last_n_and_latest(tmp_path):
+    e = _engine(resilience={"enabled": True, "keep_last_n": 2})
+    for _ in range(4):
+        _step(e)
+        assert e.save_checkpoint(tmp_path) is True
+    remaining = scan_tags(str(tmp_path))
+    assert remaining == ["global_step4", "global_step3"]
+    assert read_latest_tag(str(tmp_path)) == "global_step4"
+
+
+def test_prune_ignores_uncommitted_dirs(tmp_path):
+    for i in (1, 2, 3):
+        d = tmp_path / f"global_step{i}"
+        d.mkdir()
+        (d / "x").write_bytes(b"a")
+        write_manifest(str(d), f"global_step{i}")
+    staging = tmp_path / "global_step4"  # in-flight save: no marker yet
+    staging.mkdir()
+    (staging / "x").write_bytes(b"a")
+    deleted = prune_checkpoints(str(tmp_path), keep_last_n=2)
+    assert deleted == ["global_step1"]
+    assert staging.exists()  # never GC an uncommitted (in-flight) dir
+    assert prune_checkpoints(str(tmp_path), keep_last_n=0) == []  # keep all
+
+
+# ---------------------------------------------------------------------------
+# async engine commit ordering (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_async_engine_seals_only_at_commit(tmp_path):
+    eng = AsyncCheckpointEngine()
+    path = str(tmp_path / "ck")
+    state = {"w": np.arange(8, dtype=np.float32)}
+    eng.save(state, path, host_state={"global_steps": 7})
+    # pre-commit: the snapshot may exist (orbax finalizes in background) but
+    # it must NOT verify as committed — manifest/marker only appear at commit
+    assert not os.path.exists(os.path.join(path, COMMIT_MARKER_FILE))
+    assert not verify_checkpoint(path, require_manifest=True)[0]
+    assert eng.commit("ck") is True
+    assert os.path.exists(os.path.join(path, MANIFEST_FILE))
+    assert os.path.exists(os.path.join(path, COMMIT_MARKER_FILE))
+    assert verify_checkpoint(path) == (True, "ok")
+    restored, host = eng.load(path)
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert host["global_steps"] == 7  # host state deferred to commit()
+
+
+def test_async_engine_torn_commit_reports_failure(tmp_path):
+    get_fault_injector().configure(
+        {"faults": [{"site": "checkpoint.torn_write", "nth": 1}]})
+    eng = AsyncCheckpointEngine()
+    path = str(tmp_path / "ck")
+    eng.save({"w": np.ones(64, np.float32)}, path, host_state={})
+    assert eng.commit("ck") is False
+    assert not os.path.exists(os.path.join(path, COMMIT_MARKER_FILE))
+    # the torn dir never wins a newest-valid scan...
+    assert find_latest_valid_checkpoint(str(tmp_path)) is None
+    # ...and note orbax itself can restore FROM a torn shard without raising
+    # (OCDBT tolerates the truncation) — the commit marker/manifest is the
+    # ONLY thing standing between this dir and a silent bad resume
+    assert not verify_checkpoint(path, require_manifest=True)[0]
+
+
+def test_post_commit_corruption_fails_load(tmp_path):
+    get_fault_injector().configure(
+        {"faults": [{"site": "checkpoint.corrupt", "nth": 1}]})
+    eng = AsyncCheckpointEngine()
+    path = str(tmp_path / "ck")
+    eng.save({"w": np.ones(64, np.float32)}, path, host_state={})
+    assert eng.commit("ck") is True  # marker present, data silently rotted
+    with pytest.raises(CheckpointCorruptionError):
+        eng.load(path)  # checksum mismatch caught BEFORE deserialization

@@ -1,0 +1,165 @@
+"""Distributed-init guard rails: bounded retry + backoff around the
+rendezvous, the injected comm.init_timeout fault, the host-state exchange
+timeout guard, and the retry/fault-injection utilities themselves."""
+
+import pytest
+
+import deepspeed_tpu.comm.comm as comm_mod
+from deepspeed_tpu.comm import exchange_host_state
+from deepspeed_tpu.utils.retry import retry_with_backoff, RetriesExhausted
+from deepspeed_tpu.utils.fault_injection import (FaultInjector, InjectedFault,
+                                                 get_fault_injector)
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# retry_with_backoff
+# ---------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_with_backoff(flaky, retries=4, base_delay=0.5,
+                              sleep=delays.append) == "ok"
+    assert len(calls) == 3
+    assert delays == [0.5, 1.0]  # exponential
+
+
+def test_retry_exhaustion_chains_last_error():
+    with pytest.raises(RetriesExhausted) as ei:
+        retry_with_backoff(lambda: (_ for _ in ()).throw(OSError("disk")),
+                           retries=3, sleep=lambda _: None)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_does_not_swallow_unrelated_errors():
+    def bad():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_with_backoff(bad, retries=5, sleep=lambda _: None)
+
+
+def test_backoff_caps_at_max_delay():
+    delays = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(RetriesExhausted):
+        retry_with_backoff(always, retries=6, base_delay=1.0, max_delay=3.0,
+                           sleep=delays.append)
+    assert delays == [1.0, 2.0, 3.0, 3.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# fault injector semantics
+# ---------------------------------------------------------------------------
+
+
+def test_injector_occurrence_counting():
+    fi = FaultInjector()
+    fi.configure({"faults": [{"site": "train.nan_grads", "nth": 2,
+                              "times": 2}]})
+    hits = [fi.fire("train.nan_grads") is not None for _ in range(5)]
+    assert hits == [False, True, True, False, False]
+    assert fi.fired == ["train.nan_grads#2", "train.nan_grads#3"]
+
+
+def test_injector_env_syntax():
+    fi = FaultInjector()
+    fi.configure_env("checkpoint.torn_write@2;train.nan_grads@5*3")
+    assert not fi.fire("checkpoint.torn_write")
+    assert fi.fire("checkpoint.torn_write") is not None
+    for expect in (False, False, False, False, True, True, True, False):
+        assert (fi.fire("train.nan_grads") is not None) == expect
+
+
+def test_injector_rejects_unknown_site():
+    fi = FaultInjector()
+    with pytest.raises(ValueError):
+        fi.configure({"faults": [{"site": "not.a.site"}]})
+
+
+def test_injector_disabled_block_is_inert():
+    fi = FaultInjector()
+    fi.configure({"enabled": False,
+                  "faults": [{"site": "train.nan_grads", "nth": 1}]})
+    assert not fi.enabled
+    assert fi.fire("train.nan_grads") is None
+
+
+# ---------------------------------------------------------------------------
+# guarded rendezvous (comm.init_timeout fault)
+# ---------------------------------------------------------------------------
+
+
+def test_init_retries_through_injected_timeout(monkeypatch):
+    attempts = []
+    monkeypatch.setattr(comm_mod.jax.distributed, "initialize",
+                        lambda **kw: attempts.append(kw))
+    monkeypatch.setattr(comm_mod, "DIST_INIT_BACKOFF_SECS", 0.0)
+    get_fault_injector().configure(
+        {"faults": [{"site": "comm.init_timeout", "nth": 1}]})
+    comm_mod._initialize_distributed_guarded("host:1234", 2, 0)
+    # first attempt consumed by the injected timeout; the retry succeeded
+    assert len(attempts) == 1
+    assert attempts[0]["coordinator_address"] == "host:1234"
+    assert attempts[0]["num_processes"] == 2
+
+
+def test_init_exhaustion_raises_instead_of_hanging(monkeypatch):
+    monkeypatch.setattr(comm_mod.jax.distributed, "initialize",
+                        lambda **kw: None)
+    monkeypatch.setattr(comm_mod, "DIST_INIT_BACKOFF_SECS", 0.0)
+    monkeypatch.setattr(comm_mod, "DIST_INIT_RETRIES", 3)
+    get_fault_injector().configure(
+        {"faults": [{"site": "comm.init_timeout", "nth": 1, "times": 3}]})
+    with pytest.raises(RetriesExhausted) as ei:
+        comm_mod._initialize_distributed_guarded("host:1234", 2, 0)
+    assert isinstance(ei.value.__cause__, InjectedFault)
+
+
+def test_init_timeout_kwarg_forwarded_when_supported(monkeypatch):
+    seen = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None, initialization_timeout=None):
+        seen.update(initialization_timeout=initialization_timeout)
+
+    monkeypatch.setattr(comm_mod.jax.distributed, "initialize", fake_init)
+    comm_mod._initialize_distributed_guarded("host:1", 2, 0, timeout=77)
+    assert seen["initialization_timeout"] == 77
+
+
+# ---------------------------------------------------------------------------
+# host-state exchange guard
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_host_state_single_process_roundtrip():
+    payload = {"step": 11, "rng": [1, 2, 3]}
+    assert exchange_host_state(payload) == [payload]
+
+
+def test_exchange_host_state_timeout_guard(monkeypatch):
+    # multi-process path with a wedged peer: the gather never returns and
+    # the guard must surface TimeoutError instead of hanging the job
+    import threading
+    monkeypatch.setattr(comm_mod.jax, "process_count", lambda: 2)
+    release = threading.Event()
+    monkeypatch.setattr("jax.experimental.multihost_utils.process_allgather",
+                        lambda x: release.wait(30))  # no peer ever arrives
+    try:
+        with pytest.raises(TimeoutError):
+            exchange_host_state({"x": 1}, timeout=0.2)
+    finally:
+        release.set()  # unwedge the abandoned gather thread

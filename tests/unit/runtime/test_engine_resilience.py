@@ -1,0 +1,252 @@
+"""Preemption-aware autosave/auto-resume and the anomaly sentry with
+rollback — the runtime half of the resilience subsystem, driven end-to-end
+through the deterministic fault-injection harness (SIGTERM mid-step, NaN
+gradient episodes)."""
+
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from simple_model import simple_model_and_params  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.comm.mesh import reset_mesh_context  # noqa: E402
+from deepspeed_tpu.checkpoint.engine import (  # noqa: E402
+    COMMIT_MARKER_FILE, read_latest_tag, verify_checkpoint)
+from deepspeed_tpu.runtime.sentry import AnomalySentry  # noqa: E402
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+    DeepSpeedDataSampler  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+
+def _engine(resilience=None, **over):
+    reset_mesh_context()
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000}
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    cfg.update(over)
+    model, params = simple_model_and_params(seed=0)
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=cfg)
+    return engine
+
+
+def _step(engine, x=None):
+    x = jnp.ones((8, 16)) if x is None else x
+    loss = engine.forward(x, jnp.zeros_like(x))
+    engine.backward(loss)
+    engine.step()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# sentry unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_sentry_detects_each_anomaly_kind():
+    s = AnomalySentry(max_consecutive=2, spike_window=10, spike_factor=3.0,
+                      spike_min_history=3)
+    for i in range(4):
+        assert s.observe(1.0 + 0.01 * i, False, i) is None
+    assert s.observe(1.0, True, 4) == "overflow"
+    assert s.observe(float("nan"), False, 5) == "nonfinite_loss"
+    assert s.should_rollback  # 2 consecutive
+    s.reset()
+    for i in range(4):
+        s.observe(1.0, False, i)
+    assert s.observe(10.0, False, 4) == "loss_spike"
+    assert not s.should_rollback  # 1 of 2
+    assert s.observe(1.0, False, 5) is None  # good step resets the streak
+    assert s.consecutive == 0
+
+
+def test_sentry_needs_history_before_spike_detection():
+    s = AnomalySentry(max_consecutive=3, spike_window=10, spike_factor=3.0,
+                      spike_min_history=5)
+    # early noisy losses must not trip the detector before min_history
+    for i, l in enumerate((9.0, 1.0, 8.0, 0.5)):
+        assert s.observe(l, False, i) is None
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> committed checkpoint -> auto-resume (acceptance criterion b)
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_autosave_and_auto_resume(tmp_path):
+    save_dir = str(tmp_path)
+    rc = {"enabled": True, "save_dir": save_dir,
+          "fault_injection": {"enabled": True,
+                              "faults": [{"site": "train.sigterm", "nth": 2}]}}
+    e = _engine(resilience=rc)
+    try:
+        _step(e)
+        _step(e)  # boundary 2: injected SIGTERM -> flag -> autosave
+        assert e.preempted
+        tag = read_latest_tag(save_dir)
+        assert tag == "global_step2"
+        ckpt = os.path.join(save_dir, tag)
+        assert os.path.exists(os.path.join(ckpt, COMMIT_MARKER_FILE))
+        assert verify_checkpoint(ckpt) == (True, "ok")
+    finally:
+        e.destroy()  # restores the previous SIGTERM handler
+
+    # a replacement process auto-resumes from the preemption checkpoint and
+    # keeps training
+    e2 = _engine(resilience={"enabled": True, "save_dir": save_dir,
+                             "auto_resume": True})
+    try:
+        assert e2.global_steps == 2
+        _step(e2)
+        assert e2.global_steps == 3
+        assert np.isfinite(e2.get_loss())
+    finally:
+        e2.destroy()
+
+
+def test_sigterm_handler_restored_on_destroy(tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path)})
+    assert signal.getsignal(signal.SIGTERM) != prev
+    e.destroy()
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_autosave_interval_and_retention(tmp_path):
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path),
+                            "autosave_interval_steps": 2, "keep_last_n": 2})
+    for _ in range(6):
+        _step(e)
+    assert read_latest_tag(str(tmp_path)) == "global_step6"
+    present = sorted(d for d in os.listdir(tmp_path)
+                     if d.startswith("global_step"))
+    assert present == ["global_step4", "global_step6"]  # keep_last_n=2
+
+
+# ---------------------------------------------------------------------------
+# NaN episode -> rollback without crashing (acceptance criterion c)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_episode_triggers_rollback(tmp_path):
+    rc = {"enabled": True, "save_dir": str(tmp_path),
+          "autosave_interval_steps": 2, "max_consecutive_anomalies": 2,
+          "fault_injection": {"enabled": True,
+                              "faults": [{"site": "train.nan_grads",
+                                          "nth": 3, "times": 2}]}}
+    e = _engine(resilience=rc)
+    x = jnp.linspace(0.0, 1.0, 8 * 16).reshape(8, 16)
+    _step(e, x)
+    _step(e, x)  # autosave -> global_step2 is the last good checkpoint
+    assert read_latest_tag(str(tmp_path)) == "global_step2"
+    # steps 3 and 4 train on NaN-poisoned batches: NaN loss AND (fp32, no
+    # loss scaler to skip the update) NaN-poisoned params
+    _step(e, x)
+    _step(e, x)  # second consecutive anomaly -> rollback
+    assert e._sentry.rollbacks == 1
+    assert e.global_steps == 2  # counters restored with the params
+    # training continues on clean data and is healthy again
+    _step(e, x)
+    loss = e.get_loss()
+    assert loss is not None and np.isfinite(loss)
+    import jax
+    assert all(np.isfinite(np.asarray(p)).all()
+               for p in jax.tree_util.tree_leaves(e.params))
+
+
+def test_rollback_keeps_sampler_position(tmp_path):
+    """The data sampler must NOT rewind on rollback: the offending data
+    window is skipped, not replayed (replaying would reproduce the same
+    anomaly)."""
+    rc = {"enabled": True, "save_dir": str(tmp_path),
+          "autosave_interval_steps": 2, "max_consecutive_anomalies": 2,
+          "fault_injection": {"enabled": True,
+                              "faults": [{"site": "train.nan_grads",
+                                          "nth": 3, "times": 2}]}}
+    e = _engine(resilience=rc)
+    sampler = DeepSpeedDataSampler(total_samples=4096, micro_batch_size=8)
+
+    class _Loader:
+        pass
+
+    loader = _Loader()
+    loader.sampler = sampler
+    e.training_dataloader = loader
+
+    x = jnp.linspace(0.0, 1.0, 8 * 16).reshape(8, 16)
+    for _ in range(2):
+        sampler.consumed_samples += 8
+        _step(e, x)
+    assert sampler.consumed_samples == 16  # captured in global_step2
+    for _ in range(2):  # the poisoned window
+        sampler.consumed_samples += 8
+        _step(e, x)
+    assert e._sentry.rollbacks == 1
+    assert e.global_steps == 2  # params/opt-state/counters rolled back...
+    assert sampler.consumed_samples == 32  # ...but the data position kept
+
+
+def test_rollback_without_checkpoint_does_not_crash(tmp_path):
+    # anomalies before any checkpoint exists: the sentry logs, resets, and
+    # training carries on — no crash, no rollback
+    rc = {"enabled": True, "save_dir": str(tmp_path),
+          "max_consecutive_anomalies": 2,
+          "fault_injection": {"enabled": True,
+                              "faults": [{"site": "train.nan_grads",
+                                          "nth": 1, "times": 2}]}}
+    e = _engine(resilience=rc)
+    _step(e)
+    _step(e)  # threshold hit, nothing to roll back to
+    assert e._sentry.rollbacks == 0
+    assert e.global_steps == 2
+    _step(e)  # still alive
+
+
+# ---------------------------------------------------------------------------
+# async pipeline composition
+# ---------------------------------------------------------------------------
+
+
+def test_async_window_autosave_drains_first(tmp_path):
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path),
+                            "autosave_interval_steps": 3},
+                async_pipeline={"enabled": True, "sync_interval": 16,
+                                "prefetch_depth": 0})
+    for _ in range(3):
+        _step(e)
+    # the autosave drained the 16-step window early: the checkpoint's host
+    # state carries exact step counts, and the save committed
+    tag = read_latest_tag(str(tmp_path))
+    assert tag == "global_step3"
+    assert verify_checkpoint(os.path.join(str(tmp_path), tag)) == (True, "ok")
+    reset_mesh_context()
+    e2 = _engine()
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 3
+
+
+def test_async_window_sentry_feeds_at_drain(tmp_path):
+    rc = {"enabled": True, "save_dir": str(tmp_path),
+          "autosave_interval_steps": 2, "max_consecutive_anomalies": 2,
+          "fault_injection": {"enabled": True,
+                              "faults": [{"site": "train.nan_grads",
+                                          "nth": 3, "times": 2}]}}
+    e = _engine(resilience=rc,
+                async_pipeline={"enabled": True, "sync_interval": 4,
+                                "prefetch_depth": 0})
+    x = jnp.linspace(0.0, 1.0, 8 * 16).reshape(8, 16)
+    for _ in range(4):  # steps 3,4 poisoned; window drains at 4... but the
+        _step(e, x)     # step-2 autosave drains early with 2 good steps
+    # by the time the poisoned steps drain, rollback has fired exactly once
+    e.get_loss()  # force a drain of anything still in flight
+    assert e._sentry.rollbacks == 1
+    assert e.global_steps == 2
