@@ -12,6 +12,10 @@ LOG=$P/watcher.log
 # reused from disk in every later one (bench.py sets the same default)
 export JAX_COMPILATION_CACHE_DIR=$P/jax_cache
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=5
+# persistent attention dispatch table: ds_kernel_tune measurements from ANY
+# window steer every later env-less bench (same survival story as the
+# compile cache)
+export DS_TPU_ATTN_CACHE_DIR=$P/attn_cache
 SFX=$(date -u +%m%dT%H%M)
 echo "CHIP SESSION $SFX start $(date -u +%FT%TZ)" >> $LOG
 touch "$P/.session_start"  # mtime marker: snapshot only THIS session's files
@@ -58,11 +62,18 @@ run pallas_tpu 900 env DS_TPU_TEST_ON_TPU=1 python -m pytest tests/unit/ops/test
 # persistent cache the whole stage is seconds)
 run mem_triage 1800 python -u .perf/mem_triage.py 0 1 2 3 4 5
 # 3. fast train number: scanned mini-ladder (compiles cached by step 2).
-# DS_TPU_FLASH_FOLDED=0 pins the PER-HEAD kernels: this rung is the A/B
-# baseline for folded_promote, and once a prior session drops the
-# FOLDED_PROVEN sentinel an env-less run would silently go folded —
-# turning the A/B into folded-vs-folded and ratcheting the promotion
+# DS_TPU_FLASH_FOLDED=0 pins the per-head VARIANT for Pallas legs (fwd may
+# still resolve to XLA — that IS the dispatch default under test): this
+# rung is the A/B baseline for folded_promote, and without the pin a live
+# folded promotion in the attn cache would turn the A/B into
+# folded-vs-folded and ratchet itself
 run bench_fast 1500 env DS_TPU_FLASH_FOLDED=0 DS_BENCH_FAST=1 python bench.py
+# 3b. per-leg kernel sweep on real silicon: times fwd/bwd × {xla, per-head,
+# folded} × block grid at the bench shape and commits one measured winner
+# per leg to $DS_TPU_ATTN_CACHE_DIR — every later env-less rung (and the
+# driver's final bench) dispatches from it. Cheap relative to the step-12
+# whole-bench sweep: one attention call per candidate, not a full ladder.
+run kernel_tune 1800 python bin/ds_kernel_tune --batch 8 --seq 1024 --heads 16 --head-dim 64 --iters 20
 # 4. serving decode, fast (paged @1k ctx, 2-3 compiles) — the SECOND
 # headline metric comes before any diagnostic: a short window that dies
 # mid-breakdown must still have landed train + serving numbers
@@ -91,7 +102,9 @@ run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g
 # 11. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
 # flash + selective remat)
 run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
-# 12. flash block sweep. The 0801T1906 xprof trace proved the flash
+# 12. flash block sweep — whole-bench cross-check of step 3b's per-op
+# verdicts (DS_TPU_FLASH_BLOCKS overrides the measured cache, so each rung
+# really runs its blocks). The 0801T1906 xprof trace proved the flash
 # kernels are 70% of step time at ~6% of model FLOPs — per-grid-step
 # overhead over ~1100 tiny steps/layer (G=1 at 16 KV heads). Bigger
 # blocks = fewer steps: (256,512) already gave +20% whole-step. Sweep
@@ -107,9 +120,11 @@ done
 run flash_folded 1800 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_FAST=1 python bench.py
 run flash_folded_breakdown 1500 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_SCAN=1 python bench.py --breakdown
 run flash_folded_longseq 2400 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_LONGSEQ=1 python bench.py
-# A/B verdict: if folded beat per-head on THIS silicon by >=2%, promote it
-# to the default for every env-less run (incl. the driver's final bench)
-run folded_promote 120 python .perf/promote_folded.py $SFX
+# A/B verdict: if folded beat the dispatch default on THIS silicon by
+# >=2%, commit measured folded entries to the attn cache (the default for
+# every env-less run, incl. the driver's final bench); a loss withdraws a
+# stale promotion. Also removes the deprecated FOLDED_PROVEN sentinel.
+run folded_promote 300 python .perf/promote_folded.py $SFX
 # 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a
 # scale where streaming matters on-chip, then the Twin-Flow partial-offload
 # ratio sweep (VERDICT r4 #8: journal the measured throughput curve)

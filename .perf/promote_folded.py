@@ -1,11 +1,15 @@
 """Silicon A/B verdict for the head-folded flash kernels.
 
-Reads THIS session's bench_fast (per-head default) and flash_folded
-(DS_TPU_FLASH_FOLDED=1) outputs, compares their best tok/s, and
-creates/removes ``.perf/FOLDED_PROVEN`` — the sentinel that flips the
-folded kernels to default for every env-less run (see
-``ops/attention.py:_use_folded``). Promotion demands a >=2% win so noise
-can't flip the default back and forth across windows.
+Reads THIS session's bench_fast (dispatch default, per-head variant pinned)
+and flash_folded (DS_TPU_FLASH_FOLDED=1) outputs and compares their best
+tok/s.  A >=2% folded win is committed as **measured entries in the
+attention autotune cache** (``ops/autotune_cache.py``) at the bench shape
+for this device kind — the tracked replacement for the deprecated
+``.perf/FOLDED_PROVEN`` sentinel, which this script now only ever REMOVES
+(migration: an old promotion is either re-earned into the cache by this
+session's A/B or dropped).  A loss withdraws any entries a previous
+promotion committed.  The 2% margin keeps noise from flipping the default
+back and forth across windows.
 
 Usage: python .perf/promote_folded.py <session_suffix>
 """
@@ -14,13 +18,19 @@ import os
 import sys
 
 P = os.path.dirname(os.path.abspath(__file__))
-SENTINEL = os.path.join(P, "FOLDED_PROVEN")
+SENTINEL = os.path.join(P, "FOLDED_PROVEN")  # legacy — removed on sight
+NOTE_PREFIX = "promote_folded"
+
+sys.path.insert(0, os.path.dirname(P))
+from deepspeed_tpu.ops import kernel_dispatch as kd  # noqa: E402
+from deepspeed_tpu.ops.autotune_cache import (  # noqa: E402
+    CACHE_VERSION, get_cache, _load_table)
 
 
 def best_tok_s(path):
     """Best non-diagnostic tok/s in a session output, plus the unit tag of
-    that best record (the tag names the RESOLVED attention variant — see
-    bench.py:_folded_attn_resolved)."""
+    that best record (the tag embeds the resolved dispatch note — see
+    bench.py:_attn_dispatch_note)."""
     try:
         lines = [ln for ln in open(path).read().splitlines()
                  if ln.startswith("{")]
@@ -42,33 +52,72 @@ def best_tok_s(path):
     return best, best_unit
 
 
+def _bench_signatures(kind):
+    """(leg, cache signature) pairs for THE bench shape on this device."""
+    sig = kd.make_sig((8, 1024, 16, 64), 16, 1024, "bfloat16", True,
+                      None, None)
+    return sig, [(leg, kd.signature(leg, sig, kind)) for leg in ("fwd", "bwd")]
+
+
+def _withdraw(cache):
+    """Drop cache entries a previous promotion committed (note-tagged).
+    Direct table rewrite with the same tmp+fsync+rename commit idiom."""
+    path = cache.path
+    entries = _load_table(path)
+    keep = {k: v for k, v in entries.items()
+            if not str(v.get("note", "")).startswith(NOTE_PREFIX)}
+    if len(keep) == len(entries):
+        return 0
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": CACHE_VERSION, "entries": keep}, f,
+                  indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(entries) - len(keep)
+
+
 def main():
     sfx = sys.argv[1]
     base, base_unit = best_tok_s(os.path.join(P, f"bench_fast_r5_{sfx}.out"))
     folded, _ = best_tok_s(os.path.join(P, f"flash_folded_r5_{sfx}.out"))
-    print(f"A/B: per-head={base} folded={folded} tok/s")
+    print(f"A/B: baseline={base} folded={folded} tok/s")
+    if os.path.exists(SENTINEL):
+        os.remove(SENTINEL)
+        print("legacy FOLDED_PROVEN sentinel removed (deprecated — verdicts "
+              "now live in the autotune cache)")
     if base is None or folded is None:
-        print("verdict: incomplete session — sentinel unchanged")
+        print("verdict: incomplete session — cache unchanged")
         return 0
-    if base_unit and "folded-attn" in base_unit:
-        # contaminated baseline: the sentinel was live (and the env unpinned)
-        # when bench_fast ran, so BOTH sides of this A/B executed the folded
-        # kernels. A folded-vs-folded margin says nothing about per-head —
-        # in particular a <2% "loss" here must NOT demote a promotion earned
-        # against a real per-head baseline. Leave the sentinel as-is.
-        print("verdict: baseline ran folded kernels (sentinel was live) — "
-              "A/B invalid, sentinel unchanged")
+    if base_unit and "folded" in base_unit:
+        # contaminated baseline: the dispatch note in the winning record's
+        # unit tag says a folded kernel ran on the BASELINE side (the env
+        # pin failed or a measured folded entry was live), so both sides of
+        # this A/B executed folded kernels. A folded-vs-folded margin says
+        # nothing about the per-head/XLA default — in particular a <2%
+        # "loss" here must NOT withdraw a promotion earned against a real
+        # baseline. Leave the cache as-is.
+        print("verdict: baseline ran folded kernels — A/B invalid, "
+              "cache unchanged")
         return 0
+    cache = get_cache()
+    kind = kd.device_kind()
+    sig, legs = _bench_signatures(kind)
     if folded >= 1.02 * base:
-        open(SENTINEL, "w").write(
-            f"session {sfx}: folded {folded:.1f} vs per-head {base:.1f} tok/s\n")
-        print(f"verdict: PROMOTED (sentinel written, +{100*(folded/base-1):.1f}%)")
+        bq, bk = kd.default_blocks(sig.head_dim)
+        for leg, signature in legs:
+            cache.commit(signature, {
+                "impl": kd.IMPL_FOLDED, "block_q": bq, "block_k": bk,
+                "note": (f"{NOTE_PREFIX} {sfx}: folded {folded:.1f} vs "
+                         f"baseline {base:.1f} tok/s whole-bench A/B")})
+        print(f"verdict: PROMOTED (+{100 * (folded / base - 1):.1f}%, "
+              f"folded entries committed for {kind} at the bench shape -> "
+              f"{cache.path})")
     else:
-        if os.path.exists(SENTINEL):
-            os.remove(SENTINEL)
-            print("verdict: demoted (sentinel removed)")
-        else:
-            print("verdict: not promoted")
+        n = _withdraw(cache)
+        print(f"verdict: not promoted ({n} stale promotion entries removed)"
+              if n else "verdict: not promoted")
     return 0
 
 

@@ -349,6 +349,28 @@ def _measure_prefix_caching(cfg, ctx, kv_block, backend):
     return rows
 
 
+def _vs_baseline(results):
+    """NUMERIC paged-vs-dense ratio scored against the FastGen 2.3x bar, so
+    a serving regression is machine-checkable round-over-round instead of a
+    prose "bar" string. Basis: the best batched (continuous-batching)
+    throughput per backend — the FastGen headline shape — falling back to
+    single-sequence decode when only one shape ran (CPU diagnostic)."""
+    BAR = 2.3
+
+    def best(backend, key):
+        vals = [r[key] for r in results
+                if r.get("backend") == backend and key in r]
+        return max(vals) if vals else None
+
+    for key in ("batched_decode_tok_s", "decode_tok_s"):
+        paged, dense = best("paged", key), best("dense", key)
+        if paged and dense:
+            return {"paged_vs_dense": round(paged / dense, 4),
+                    "vs_baseline": round(paged / dense / BAR, 4),
+                    "vs_baseline_basis": key}
+    return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_SERVING.json")
@@ -373,9 +395,15 @@ def main():
         # .partial side file immediately; the root artifact (possibly a
         # COMPLETE doc from an earlier session) is only replaced on success
         doc["partial"] = True
+        summary = _vs_baseline(doc["results"])
+        if summary:
+            doc.update(summary)
         write_atomic(args.out + ".partial")
     measure(platform, results=doc["results"], checkpoint=persist)
     doc.pop("partial", None)
+    summary = _vs_baseline(doc["results"])
+    if summary:
+        doc.update(summary)
     write_atomic(args.out)
     try:
         os.remove(args.out + ".partial")

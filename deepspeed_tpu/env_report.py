@@ -72,6 +72,17 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"flash-attention variant {'.' * 25} {NO} ({e})")
     try:
+        # per-leg kernel dispatch: where the table comes from (measured
+        # autotune cache vs built-in heuristics) and what the bench shape
+        # resolves to right now — so every saved report pins the kernels
+        from .ops import kernel_dispatch
+        lines.append(f"attn dispatch table {'.' * 29} "
+                     f"{kernel_dispatch.table_source()}")
+        lines.append(f"attn dispatch @ bench shape {'.' * 21} "
+                     f"{kernel_dispatch.resolved_note()}")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"attn dispatch table {'.' * 29} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

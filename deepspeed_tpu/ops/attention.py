@@ -196,36 +196,30 @@ def _regroup(q, k, v):
 
 
 def _use_folded() -> bool:
-    """DS_TPU_FLASH_FOLDED selects the head-folded kernels
-    (attention_folded.py): all KV heads per grid step — the restructure the
-    8/1 trace asks for. With the env unset, the default comes from the
-    silicon A/B: a chip session that measured the folded kernels faster on
-    real hardware drops the ``.perf/FOLDED_PROVEN`` sentinel
-    (``.perf/promote_folded.py``), which promotes them for every later run
-    — including the driver's round-end bench, which sets no env."""
-    env = os.environ.get("DS_TPU_FLASH_FOLDED")
-    if env is not None:
-        return env not in ("", "0")
-    sentinel = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "..", "..", ".perf", "FOLDED_PROVEN")
-    return os.path.exists(sentinel)
+    """Legacy probe (kept for bench.py's journal tagging): whether the
+    folded-variant *preference* is active — ``DS_TPU_FLASH_FOLDED`` env, or
+    the deprecated ``.perf/FOLDED_PROVEN`` sentinel. Per-shape dispatch
+    (ops/kernel_dispatch.py) now owns the actual folded-vs-per-head choice;
+    this only reports the variant a Pallas leg falls back to when no
+    measurement decides it."""
+    from .kernel_dispatch import IMPL_FOLDED, _variant_preference
+    return _variant_preference() == IMPL_FOLDED
 
 
 def resolved_attention_variant() -> str:
-    """The flash-attention variant that will ACTUALLY run — env override OR
-    sentinel promotion resolved, not just the env var. Reporting surfaces
-    (env_report, bench run tags) must use this: a sentinel-promoted run with
-    the env unset is still a folded run, and labeling it per-head poisons
-    any A/B that keys off the tag."""
+    """The flash-attention variant that will ACTUALLY run on a Pallas leg —
+    env override OR sentinel promotion resolved, not just the env var.
+    Reporting surfaces (env_report, bench run tags) must use this: a
+    sentinel-promoted run with the env unset is still a folded run, and
+    labeling it per-head poisons any A/B that keys off the tag. For the
+    full per-leg (fwd/bwd × impl × blocks) resolution use
+    ``kernel_dispatch.resolved_note``."""
     return "folded" if _use_folded() else "per-head"
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
                softcap=None):
-    if _use_folded():
-        from .attention_folded import flash_fwd_folded
-        return flash_fwd_folded(q, k, v, scale, causal, block_q, block_k,
-                                interpret, window, softcap)
+    """Per-head Pallas forward → (o, lse[B*KV, G, Sq, 1])."""
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
@@ -440,15 +434,9 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=None,
                softcap=None):
+    """Per-head Pallas backward; ``res`` carries lse in the per-head
+    [B*KV, G, Sq, 1] layout."""
     q, k, v, o, lse = res
-    if _use_folded():
-        # fwd and bwd trace together, so the env choice is consistent; the
-        # assert guards the one way it couldn't be (residuals captured
-        # under a different flag value than the bwd trace)
-        assert lse.shape == (*q.shape[:3], 1), (lse.shape, q.shape)
-        from .attention_folded import flash_bwd_folded
-        return flash_bwd_folded(q, k, v, lse, o, g_out, scale, causal,
-                                block_q, block_k, interpret, window, softcap)
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     G = H // KV
@@ -511,49 +499,156 @@ def _flash_bwd(res, g_out, scale, causal, block_q, block_k, interpret, window=No
 
 
 # ---------------------------------------------------------------------------
-# dispatch
+# shape-aware dispatch (ops/kernel_dispatch.py decides; this wires the legs)
 # ---------------------------------------------------------------------------
 
 
+def _xla_attention_lse(q, k, v, scale, causal, window=None, softcap=None):
+    """XLA forward that ALSO returns the log-sum-exp residual, so a Pallas
+    backward can pair with an XLA forward (the 42.7 ms < 62.9 ms dispatch
+    at hd64/seq1024). Scores accumulate in fp32 (preferred_element_type)
+    so the LSE matches what the Pallas bwd kernels recompute in-kernel;
+    lse comes back in the NATURAL [B, Sq, H, 1] layout."""
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, Sq, KV, G, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    if softcap is not None:  # Gemma-2: cap BEFORE masking
+        s = softcap_scores(s, softcap)
+    if causal or window is not None:
+        n, m = q.shape[1], k.shape[1]
+        mask = jnp.ones((n, m), bool)
+        if causal:
+            mask &= jnp.tril(mask, k=m - n)
+        if window is not None:
+            qpos = jnp.arange(n)[:, None] + (m - n)
+            mask &= qpos - jnp.arange(m)[None, :] < window
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    live = s > NEG_INF
+    m_row = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(m_row <= NEG_INF, 0.0, m_row)
+    p = jnp.where(live, jnp.exp(s - m_safe), 0.0)
+    l_row = p.sum(axis=-1, keepdims=True)
+    safe_l = jnp.where(l_row == 0.0, 1.0, l_row)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", (p / safe_l).astype(v.dtype), v)
+    lse = jnp.where(l_row == 0.0, LSE_MASKED, m_safe + jnp.log(safe_l))
+    # [B, KV, G, Sq, 1] -> natural [B, Sq, H, 1]
+    lse = lse.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, 1)
+    return out.reshape(B, Sq, H, D), lse
+
+
+def _lse_natural_to_perhead(lse, B, Sq, KV, G):
+    """[B, Sq, H, 1] -> [B*KV, G, Sq, 1] (the per-head kernels' layout)."""
+    return (lse.reshape(B, Sq, KV, G, 1).transpose(0, 2, 3, 1, 4)
+            .reshape(B * KV, G, Sq, 1))
+
+
+def _lse_perhead_to_natural(lse, B, Sq, KV, G):
+    """[B*KV, G, Sq, 1] -> [B, Sq, H, 1]."""
+    return (lse.reshape(B, KV, G, Sq, 1).transpose(0, 3, 1, 2, 4)
+            .reshape(B, Sq, KV * G, 1))
+
+
+def _fit_blocks(dec, Sq, Sk):
+    """Clamp a Decision's blocks to divide the actual sequence lengths.
+
+    Fit = largest power-of-two divisor of S that is <= the requested block
+    (every eligible s % 128 == 0 shape reaches 128; an odd override can't
+    silently degrade to block 1 — a degenerate fit keeps the requested
+    block so the kernels' divisibility assert fails LOUDLY instead of
+    silently running 1-wide blocks)."""
+
+    def _fit(S, b):
+        b = min(b, S)
+        if S % b == 0:
+            return b
+        p = 1
+        while p * 2 <= b and S % (p * 2) == 0:
+            p *= 2
+        return p if p >= 32 else b
+
+    return dec._replace(block_q=_fit(Sq, dec.block_q),
+                        block_k=_fit(Sk, dec.block_k))
+
+
+def _run_fwd(q, k, v, scale, causal, window, softcap, interpret, dec,
+             lse_layout):
+    """Execute one forward leg per its Decision; returns (o, lse) with lse
+    in ``lse_layout`` ("perhead" | "natural"), or lse=None when the paired
+    backward doesn't need it (lse_layout=None)."""
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    if dec.impl == "xla":
+        if lse_layout is None:
+            return _xla_attention(q, k, v, scale, causal, window, softcap), None
+        o, lse = _xla_attention_lse(q, k, v, scale, causal, window, softcap)
+    elif dec.impl == "folded":
+        from .attention_folded import flash_fwd_folded
+        o, lse = flash_fwd_folded(q, k, v, scale, causal, dec.block_q,
+                                  dec.block_k, interpret, window, softcap)
+        # folded lse is already natural [B, Sq, H, 1]
+    else:
+        o, lse_ph = _flash_fwd(q, k, v, scale, causal, dec.block_q,
+                               dec.block_k, interpret, window, softcap)
+        if lse_layout == "perhead":
+            return o, lse_ph
+        lse = (None if lse_layout is None
+               else _lse_perhead_to_natural(lse_ph, B, Sq, KV, G))
+        return o, lse
+    if lse_layout is None:
+        return o, None
+    if lse_layout == "perhead":
+        lse = _lse_natural_to_perhead(lse, B, Sq, KV, G)
+    return o, lse
+
+
+def _bwd_lse_layout(bwd_dec):
+    """Which lse layout the bwd leg consumes (None: no residual needed)."""
+    return {"xla": None, "folded": "natural", "pallas": "perhead"}[bwd_dec.impl]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,
-                     window=None, softcap=None):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                      window, softcap)
+def _dispatched_attention(q, k, v, scale, causal, window, softcap, interpret,
+                          fwd_dec, bwd_dec):
+    """Attention with INDEPENDENT per-leg kernel selection: ``fwd_dec`` and
+    ``bwd_dec`` are hashable ``kernel_dispatch.Decision`` tuples resolved
+    at trace time from the measured autotune cache / heuristic table —
+    e.g. XLA fused fwd + Pallas flash bwd where XLA wins the forward."""
+    o, _ = _run_fwd(q, k, v, scale, causal, window, softcap, interpret,
+                    fwd_dec, None)
     return o
 
 
-def _fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret, window=None,
-              softcap=None):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                        window, softcap)
+def _fwd_rule(q, k, v, scale, causal, window, softcap, interpret, fwd_dec,
+              bwd_dec):
+    o, lse = _run_fwd(q, k, v, scale, causal, window, softcap, interpret,
+                      fwd_dec, _bwd_lse_layout(bwd_dec))
     return o, (q, k, v, o, lse)
 
 
-def _bwd_rule(scale, causal, block_q, block_k, interpret, window, softcap,
+def _bwd_rule(scale, causal, window, softcap, interpret, fwd_dec, bwd_dec,
               res, g):
-    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret,
-                      window, softcap)
+    q, k, v, o, lse = res
+    if bwd_dec.impl == "xla":
+        # standard recompute: differentiate the XLA reference directly (no
+        # LSE residual needed); used where the materialized-scores bwd wins
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_attention(q_, k_, v_, scale, causal,
+                                              window, softcap), q, k, v)
+        return vjp(g)
+    if bwd_dec.impl == "folded":
+        from .attention_folded import flash_bwd_folded
+        return flash_bwd_folded(q, k, v, lse, o, g, scale, causal,
+                                bwd_dec.block_q, bwd_dec.block_k, interpret,
+                                window, softcap)
+    return _flash_bwd((q, k, v, o, lse), g, scale, causal, bwd_dec.block_q,
+                      bwd_dec.block_k, interpret, window, softcap)
 
 
-_flash_attention.defvjp(_fwd_rule, _bwd_rule)
-
-
-# head_dim -> (block_q, block_k): smaller heads leave VMEM headroom for
-# bigger tiles (better MXU occupancy / fewer grid steps). Override for
-# on-chip tuning with DS_TPU_FLASH_BLOCKS="bq,bk".
-# hd64 = (256, 512) measured on v5e 8/1: the same bench program ran 20%
-# faster than at (256, 256) — 28.7k vs 23.9k tok/s on the bs8 dots rung
-# (.perf/flash_256x512_r5_0801T1906.out).
-_BLOCK_TABLE = {64: (256, 512), 128: (128, 128)}
-
-
-def _default_blocks(head_dim: int):
-    env = os.environ.get("DS_TPU_FLASH_BLOCKS")
-    if env:
-        bq, bk = (int(x) for x in env.split(","))
-        return bq, bk
-    return _BLOCK_TABLE.get(head_dim, (128, 128))
+_dispatched_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
 def flash_attention(q,
@@ -566,39 +661,39 @@ def flash_attention(q,
                     window: Optional[int] = None,
                     softcap: Optional[float] = None,
                     force_pallas: Optional[bool] = None,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    impl_fwd: Optional[str] = None,
+                    impl_bwd: Optional[str] = None):
     """Blocked attention; q [B, S, H, D], k/v [B, S, KV, D] (GQA native).
 
-    Dispatches to the Pallas kernels on TPU (or with interpret=True anywhere)
-    for BOTH forward and backward; falls back to the fused XLA
-    softmax-attention path otherwise. ``block_q/block_k`` default per
-    head_dim (env ``DS_TPU_FLASH_BLOCKS`` overrides for tuning).
+    On TPU (or with interpret=True anywhere) the forward and backward
+    implementations are selected INDEPENDENTLY per shape by
+    ``ops/kernel_dispatch.py``: measured autotune-cache entries win, then
+    the built-in heuristic table (XLA fused fwd + Pallas flash bwd at
+    hd64/seq>=1024 — the round-5 chip measurement). ``impl_fwd``/
+    ``impl_bwd`` ("xla" | "pallas" | "folded") pin a leg explicitly (tests,
+    the sweep tool); ``block_q``/``block_k`` pin the Pallas tile sizes.
+    Off-TPU without interpret, the pure-XLA fused path runs both legs.
     """
-    dq, dk = _default_blocks(q.shape[-1])
-    block_q = block_q if block_q is not None else dq
-    block_k = block_k if block_k is not None else dk
-    # blocks must DIVIDE the sequence: the dispatch gate admits any
-    # s % 128 == 0, but the default 256 blocks would reject s=384/640/...
-    # Fit = largest power-of-two divisor of S that is <= the requested
-    # block (every eligible s reaches 128; an odd override can't silently
-    # degrade to block 1 — the kernels' divisibility assert still guards)
-    def _fit(S, b):
-        if S <= b or S % b == 0:
-            return b
-        p = 1
-        while p * 2 <= b and S % (p * 2) == 0:
-            p *= 2
-        # a degenerate fit (odd S, or an override with no usable divisor)
-        # keeps the requested block so the kernels' divisibility assert
-        # fails LOUDLY instead of silently running 1-wide blocks
-        return p if p >= 32 else b
-    block_q = _fit(q.shape[1], block_q)
-    block_k = _fit(k.shape[1], block_k)
+    from . import kernel_dispatch as kd
+
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    if use_pallas(force_pallas) or interpret:
-        return _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret,
-                                window, softcap)
-    return _xla_attention(q, k, v, scale, causal, window, softcap)
+    if not (use_pallas(force_pallas) or interpret):
+        return _xla_attention(q, k, v, scale, causal, window, softcap)
+    sig = kd.make_sig(q.shape, k.shape[2], k.shape[1], q.dtype, causal,
+                      window, softcap)
+    blocks = ((block_q, block_k)
+              if block_q is not None and block_k is not None else None)
+    fwd_dec, bwd_dec = kd.resolve(
+        sig, "interpret" if interpret and not use_pallas(force_pallas)
+        else None,
+        impl_fwd=impl_fwd, impl_bwd=impl_bwd, blocks=blocks,
+        pallas_only=bool(force_pallas) and impl_fwd is None
+        and impl_bwd is None)
+    fwd_dec = _fit_blocks(fwd_dec, q.shape[1], k.shape[1])
+    bwd_dec = _fit_blocks(bwd_dec, q.shape[1], k.shape[1])
+    return _dispatched_attention(q, k, v, scale, causal, window, softcap,
+                                 interpret, fwd_dec, bwd_dec)
 
 
 registry.register("flash_attention", "pallas" if _HAS_PLTPU else "xla", True)

@@ -1,0 +1,280 @@
+"""Shape-aware attention kernel dispatch.
+
+The round-5 chip breakdown proved the static kernel choice wrong at the
+bench shape: the Pallas flash *forward* lost to XLA's fused attention
+(62.9 ms vs 42.7 ms at hd64/seq1024) while the flash *backward* is the leg
+the Pallas pair actually wins (no [S, S] score materialization in the
+recompute).  DeepCompile (arXiv:2504.09983) argues exactly this: profile-
+guided, per-shape kernel selection should replace static choices in
+distributed training stacks.
+
+This module picks the forward and backward implementations *independently*
+per (shape, dtype, causal/window/softcap flags, device kind).  Precedence
+per leg, strongest first:
+
+1. explicit ``impl_fwd``/``impl_bwd`` kwargs on ``flash_attention`` (tests,
+   the sweep tool);
+2. ``DS_TPU_ATTN_FWD`` / ``DS_TPU_ATTN_BWD`` env (``xla|pallas|folded``);
+3. legacy ``DS_TPU_FLASH_FOLDED``: nonzero forces the folded Pallas pair on
+   BOTH legs (existing A/B scripts and tests depend on that); ``0`` pins
+   the per-head variant for any leg that resolves to Pallas;
+4. a *measured* entry in the persistent autotune cache
+   (``autotune_cache.py``, written by ``bin/ds_kernel_tune``);
+5. the built-in heuristic table below (which encodes the measured
+   42.7 < 62.9 ms fwd result: XLA fused forward at hd64 / seq >= 1024,
+   Pallas backward always);
+6. the deprecated ``.perf/FOLDED_PROVEN`` sentinel — still honored as a
+   folded-variant preference so an existing promotion isn't silently
+   dropped, but it logs a deprecation warning pointing at the cache.
+
+Blocks follow the same idea: explicit args > ``DS_TPU_FLASH_BLOCKS`` env >
+measured cache blocks > per-head_dim defaults (the round-5 sweep result
+(256, 512) at hd64).
+"""
+
+import functools
+import os
+from typing import NamedTuple, Optional
+
+from .autotune_cache import get_cache
+from ..utils.logging import logger
+
+IMPL_XLA = "xla"
+IMPL_PALLAS = "pallas"  # per-head kernels (ops/attention.py)
+IMPL_FOLDED = "folded"  # head-folded kernels (ops/attention_folded.py)
+_IMPLS = (IMPL_XLA, IMPL_PALLAS, IMPL_FOLDED)
+
+# head_dim -> default (block_q, block_k).  hd64 = (256, 512) measured on
+# v5e 8/1: +20% over (256, 256) on the identical bench program
+# (.perf/flash_256x512_r5_0801T1906.out).
+BLOCK_TABLE = {64: (256, 512), 128: (128, 128)}
+DEFAULT_BLOCKS = (128, 128)
+
+# candidate (block_q, block_k) grid the offline sweep times, beyond the
+# defaults — the round-5 sweep died at the window edge before reaching them
+SWEEP_BLOCKS = ((256, 512), (512, 512), (512, 1024), (1024, 1024),
+                (128, 128), (256, 256))
+
+
+class ShapeSig(NamedTuple):
+    """Static trace-time facts a dispatch decision may depend on."""
+    batch: int
+    seq_q: int
+    seq_k: int
+    heads: int
+    kv_heads: int
+    head_dim: int
+    dtype: str
+    causal: bool
+    windowed: bool
+    softcapped: bool
+
+
+class Decision(NamedTuple):
+    """One leg's resolved choice. ``source`` records provenance for the
+    artifacts: explicit | env | legacy-env | measured | heuristic."""
+    impl: str
+    block_q: int
+    block_k: int
+    source: str
+
+
+def make_sig(q_shape, kv_heads: int, seq_k: int, dtype, causal: bool,
+             window, softcap) -> ShapeSig:
+    b, sq, h, d = q_shape
+    return ShapeSig(batch=int(b), seq_q=int(sq), seq_k=int(seq_k),
+                    heads=int(h), kv_heads=int(kv_heads), head_dim=int(d),
+                    dtype=str(dtype), causal=bool(causal),
+                    windowed=window is not None,
+                    softcapped=softcap is not None)
+
+
+def signature(leg: str, sig: ShapeSig, device_kind: str) -> str:
+    """Cache key: leg + device kind + the full shape signature.  Versioned
+    at the file level (autotune_cache.CACHE_VERSION), so this string only
+    needs to be collision-free, not forward-compatible."""
+    return (f"{leg}|{device_kind}|b{sig.batch}|sq{sig.seq_q}|sk{sig.seq_k}"
+            f"|h{sig.heads}|kv{sig.kv_heads}|d{sig.head_dim}|{sig.dtype}"
+            f"|c{int(sig.causal)}|w{int(sig.windowed)}"
+            f"|sc{int(sig.softcapped)}")
+
+
+def device_kind() -> str:
+    """Device kind string for cache keys ("TPU v5e", "cpu", ...).  Interpret
+    mode keys as "interpret" so CPU sweep results never masquerade as chip
+    measurements."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        return getattr(d, "device_kind", None) or d.platform
+    except Exception:  # noqa: BLE001 — no backend yet
+        return "unknown"
+
+
+@functools.cache
+def _sentinel_folded() -> bool:
+    """Deprecated ``.perf/FOLDED_PROVEN`` sentinel (pre-dispatch silicon A/B
+    promotion).  Still read as a variant preference so an earned promotion
+    survives the transition, but the tracked autotune cache is the
+    replacement — warn once."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..", ".perf", "FOLDED_PROVEN")
+    if os.path.exists(path):
+        logger.warning(
+            ".perf/FOLDED_PROVEN is deprecated: commit a measured entry to "
+            "the attention autotune cache instead (bin/ds_kernel_tune); the "
+            "sentinel is honored only as a folded-variant preference when "
+            "no measurement exists")
+        return True
+    return False
+
+
+def _env_impl(name: str) -> Optional[str]:
+    val = os.environ.get(name, "").strip().lower()
+    if not val:
+        return None
+    if val not in _IMPLS:
+        logger.warning(f"{name}={val!r} ignored (want one of {_IMPLS})")
+        return None
+    return val
+
+
+def _variant_preference() -> Optional[str]:
+    """Which Pallas variant (per-head vs folded) a Pallas leg should use
+    when nothing shape-specific decided it: legacy env wins, then the
+    deprecated sentinel."""
+    env = os.environ.get("DS_TPU_FLASH_FOLDED")
+    if env is not None:
+        return IMPL_FOLDED if env not in ("", "0") else IMPL_PALLAS
+    if _sentinel_folded():
+        return IMPL_FOLDED
+    return None
+
+
+def _env_blocks() -> Optional[tuple]:
+    env = os.environ.get("DS_TPU_FLASH_BLOCKS")
+    if not env:
+        return None
+    try:
+        bq, bk = (int(x) for x in env.split(","))
+        return bq, bk
+    except ValueError:
+        logger.warning(f"DS_TPU_FLASH_BLOCKS={env!r} ignored (want 'bq,bk')")
+        return None
+
+
+def default_blocks(head_dim: int) -> tuple:
+    return BLOCK_TABLE.get(head_dim, DEFAULT_BLOCKS)
+
+
+def _heuristic_impl(leg: str, sig: ShapeSig) -> str:
+    """Built-in table when no measurement exists.
+
+    Forward: XLA's fused softmax-attention beat the Pallas flash forward at
+    the bench shape (42.7 vs 62.9 ms, hd64/seq1024 — docs/PERF_NOTES.md);
+    the regime is "scores fit comfortably and XLA fuses the whole chain",
+    which holds for hd64 at seq >= 1024 on sequences that are not
+    window-limited.  Windowed shapes keep the Pallas forward: it skips
+    out-of-window blocks entirely, XLA still materializes [S, S].
+
+    Backward: Pallas flash always — the two-pass recompute never
+    materializes scores, which is where the memory and time win lives
+    (the same breakdown measured the pallas pair ahead on fwd+bwd).
+    """
+    if leg == "fwd":
+        if (sig.head_dim <= 64 and sig.seq_k >= 1024 and not sig.windowed):
+            return IMPL_XLA
+        return IMPL_PALLAS
+    return IMPL_PALLAS
+
+
+def resolve_leg(leg: str, sig: ShapeSig, kind: Optional[str] = None, *,
+                explicit_impl: Optional[str] = None,
+                explicit_blocks: Optional[tuple] = None,
+                pallas_only: bool = False) -> Decision:
+    """Resolve one leg ("fwd" | "bwd") to a Decision.  ``pallas_only``
+    (force_pallas=True callers: kernel-math tests) restricts the choice to
+    the Pallas variants — an XLA pick degrades to the per-head kernel."""
+    kind = kind if kind is not None else device_kind()
+    variant = _variant_preference()
+
+    impl = None
+    source = None
+    if explicit_impl is not None:
+        assert explicit_impl in _IMPLS, explicit_impl
+        impl, source = explicit_impl, "explicit"
+    if impl is None:
+        env = _env_impl("DS_TPU_ATTN_FWD" if leg == "fwd" else "DS_TPU_ATTN_BWD")
+        if env is not None:
+            impl, source = env, "env"
+    if impl is None and os.environ.get("DS_TPU_FLASH_FOLDED") not in (None, "", "0"):
+        # legacy env: the folded kernels run end to end (both legs)
+        impl, source = IMPL_FOLDED, "legacy-env"
+
+    measured = None
+    if impl is None:
+        measured = get_cache().lookup(signature(leg, sig, kind))
+        if measured and measured.get("impl") in _IMPLS:
+            impl, source = measured["impl"], "measured"
+        else:
+            measured = None
+    if impl is None:
+        impl, source = _heuristic_impl(leg, sig), "heuristic"
+        if impl == IMPL_PALLAS and variant == IMPL_FOLDED:
+            impl = IMPL_FOLDED
+
+    if pallas_only and impl == IMPL_XLA:
+        impl = variant or IMPL_PALLAS
+        source += "+pallas-forced"
+
+    # blocks: explicit > env > measured > head_dim default
+    blocks = explicit_blocks or _env_blocks()
+    if blocks is None and measured is not None:
+        try:
+            blocks = (int(measured["block_q"]), int(measured["block_k"]))
+        except (KeyError, TypeError, ValueError):
+            blocks = None
+    if blocks is None:
+        blocks = default_blocks(sig.head_dim)
+    return Decision(impl=impl, block_q=int(blocks[0]), block_k=int(blocks[1]),
+                    source=source)
+
+
+def resolve(sig: ShapeSig, kind: Optional[str] = None, *,
+            impl_fwd: Optional[str] = None, impl_bwd: Optional[str] = None,
+            blocks: Optional[tuple] = None, pallas_only: bool = False):
+    """(fwd Decision, bwd Decision) for one attention call site."""
+    fwd = resolve_leg("fwd", sig, kind, explicit_impl=impl_fwd,
+                      explicit_blocks=blocks, pallas_only=pallas_only)
+    bwd = resolve_leg("bwd", sig, kind, explicit_impl=impl_bwd,
+                      explicit_blocks=blocks, pallas_only=pallas_only)
+    return fwd, bwd
+
+
+def describe(fwd: Decision, bwd: Decision) -> str:
+    """Compact per-leg note for bench unit tags / artifacts, e.g.
+    ``attn[fwd=xla:heuristic,bwd=pallas@256x512:measured]``."""
+
+    def leg(d: Decision) -> str:
+        blocks = ("" if d.impl == IMPL_XLA
+                  else f"@{d.block_q}x{d.block_k}")
+        return f"{d.impl}{blocks}:{d.source}"
+
+    return f"attn[fwd={leg(fwd)},bwd={leg(bwd)}]"
+
+
+def table_source() -> str:
+    """One line for ds_report: where dispatch decisions come from."""
+    return get_cache().source_description()
+
+
+def resolved_note(batch=8, seq=1024, heads=16, kv_heads=None, head_dim=64,
+                  dtype="bfloat16", causal=True,
+                  kind: Optional[str] = None) -> str:
+    """The per-leg dispatch note at a given (default: THE bench) shape —
+    reporting surfaces call this so every banked artifact records which
+    kernels actually ran."""
+    sig = make_sig((batch, seq, heads, head_dim),
+                   kv_heads if kv_heads is not None else heads, seq, dtype,
+                   causal, None, None)
+    return describe(*resolve(sig, kind))

@@ -171,6 +171,12 @@ def ulysses_flash(q, k, v, *, window: Optional[int] = None,
 
     spec = P(None, sequence_axis if sp > 1 else None,
              model_axis if mp > 1 else None, None)
+    if not hasattr(jax, "shard_map"):
+        # partial-manual shard_map (axis_names=) needs the stable jax API;
+        # the older experimental ``auto=`` spelling aborts under the Pallas
+        # interpret body — signal ineligible and let the caller take the
+        # GSPMD Ulysses formulation instead
+        return None
     return jax.shard_map(body, mesh=ctx.mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names=frozenset(manual),
                          check_vma=False)(q, k, v)

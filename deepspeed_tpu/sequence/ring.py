@@ -71,12 +71,16 @@ def ring_attention(q,
 
     # accumulators are seq-varying from birth (shard_map axis-variance
     # tracking: the cond skip-branch and the fori_loop carry both require
-    # the branches'/iterations' types to agree)
-    o = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis_name, to="varying")
-    m = lax.pcast(jnp.full((b, h, s_local), NEG_INF, jnp.float32),
-                  axis_name, to="varying")
-    l = lax.pcast(jnp.zeros((b, h, s_local), jnp.float32),
-                  axis_name, to="varying")
+    # the branches'/iterations' types to agree). Older jax has no
+    # axis-variance tracking (and no lax.pcast) — there the plain arrays
+    # are already correct under check_rep=False.
+    def _varying(x):
+        return (lax.pcast(x, axis_name, to="varying")
+                if hasattr(lax, "pcast") else x)
+
+    o = _varying(jnp.zeros(q.shape, jnp.float32))
+    m = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
+    l = _varying(jnp.zeros((b, h, s_local), jnp.float32))
 
     perm = [(i, (i + 1) % p) for i in range(p)]
 

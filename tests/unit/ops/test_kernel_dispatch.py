@@ -1,0 +1,393 @@
+"""Shape-aware attention dispatch: correctness of every (fwd, bwd) route
+combination vs the XLA oracle, decision precedence (explicit > env > legacy
+env > measured cache > heuristic), the persistent autotune cache's
+durability contract, and the offline sweep tool end-to-end on CPU.
+
+All kernel execution is Pallas interpret mode (CPU); the conftest
+``_hermetic_attn_cache`` fixture points ``DS_TPU_ATTN_CACHE_DIR`` at a
+per-test temp dir, so nothing here ever sees a developer's measured table.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops import kernel_dispatch as kd
+from deepspeed_tpu.ops.attention import flash_attention, _xla_attention
+from deepspeed_tpu.ops.autotune_cache import (AutotuneCache, CACHE_VERSION,
+                                              cache_path, get_cache)
+
+IMPLS = (kd.IMPL_XLA, kd.IMPL_PALLAS, kd.IMPL_FOLDED)
+
+
+def _qkv(b=2, s=128, h=4, kv=2, d=32, seed=7, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, s, kv, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, s, kv, d)), dtype)
+    return q, k, v
+
+
+def _ref(q, k, v, causal=True, window=None, softcap=None):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss(q, k, v):
+        out = _xla_attention(q, k, v, scale, causal, window, softcap)
+        return (out.astype(jnp.float32) ** 2).mean(), out
+
+    (_, o), g = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                   has_aux=True)(q, k, v)
+    return o, g
+
+
+def _route(q, k, v, fwd, bwd, causal=True, window=None, softcap=None):
+    # 64x64 blocks pin every Pallas leg to a multi-block grid even at the
+    # small parity shapes, so the online-softmax accumulation across
+    # k-blocks stays covered without paying interpret-mode cost for big
+    # sequences
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, window=window,
+                              softcap=softcap, interpret=True,
+                              block_q=64, block_k=64,
+                              impl_fwd=fwd, impl_bwd=bwd)
+        return (out.astype(jnp.float32) ** 2).mean(), out
+
+    (_, o), g = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                   has_aux=True)(q, k, v)
+    return o, g
+
+
+# ---------------------------------------------------------------------------
+# route parity: every fwd x bwd combination vs the XLA oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fwd", IMPLS)
+@pytest.mark.parametrize("bwd", IMPLS)
+def test_route_parity_causal(fwd, bwd):
+    """The custom_vjp must produce oracle values AND oracle grads for all 9
+    per-leg combinations — mixed routes cross LSE layouts (natural vs
+    per-head) and residual provenance (XLA-computed lse consumed by a
+    Pallas bwd), which is exactly where a wiring bug would hide."""
+    q, k, v = _qkv()
+    o_ref, g_ref = _ref(q, k, v)
+    o, g = _route(q, k, v, fwd, bwd)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+    for got, ref in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("fwd,bwd", [("xla", "pallas"), ("pallas", "xla"),
+                                     ("folded", "pallas")])
+@pytest.mark.parametrize("window,softcap", [(64, None), (None, 20.0),
+                                            (64, 20.0)])
+def test_route_parity_window_softcap(fwd, bwd, window, softcap):
+    """Mask variants through the mixed routes: sliding window and Gemma-2
+    softcap change both the forward math and the lse the bwd consumes."""
+    q, k, v = _qkv(s=128, d=32)
+    o_ref, g_ref = _ref(q, k, v, window=window, softcap=softcap)
+    o, g = _route(q, k, v, fwd, bwd, window=window, softcap=softcap)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+    for got, ref in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_route_parity_gqa_mixed():
+    """GQA head grouping survives the per-head<->natural lse conversion in
+    the xla-fwd + pallas-bwd route (the conversion reshapes over [KV, G])."""
+    q, k, v = _qkv(h=8, kv=2, d=32, s=128)
+    o_ref, g_ref = _ref(q, k, v)
+    o, g = _route(q, k, v, "xla", "pallas")
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+    for got, ref in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_bfloat16_route_parity():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    o_ref, g_ref = _ref(q, k, v)
+    o, g = _route(q, k, v, "xla", "pallas")
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(o_ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+    for got, ref in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=3e-2, rtol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch table itself
+# ---------------------------------------------------------------------------
+
+
+def _bench_sig(**over):
+    base = dict(q_shape=(8, 1024, 16, 64), kv_heads=16, seq_k=1024,
+                dtype="bfloat16", causal=True, window=None, softcap=None)
+    base.update(over)
+    return kd.make_sig(base["q_shape"], base["kv_heads"], base["seq_k"],
+                       base["dtype"], base["causal"], base["window"],
+                       base["softcap"])
+
+
+def test_bench_shape_routes_xla_fwd_pallas_bwd():
+    """THE acceptance table entry: at hd64/seq1024 the heuristic must pick
+    the XLA fused forward (measured 42.7 ms < 62.9 ms Pallas) and keep the
+    Pallas flash backward."""
+    fwd, bwd = kd.resolve(_bench_sig(), "TPU v5e")
+    assert fwd.impl == kd.IMPL_XLA and fwd.source == "heuristic"
+    assert bwd.impl == kd.IMPL_PALLAS and bwd.source == "heuristic"
+    assert (bwd.block_q, bwd.block_k) == kd.default_blocks(64)
+
+
+def test_heuristic_boundaries():
+    # short sequences keep the Pallas forward
+    fwd, _ = kd.resolve(_bench_sig(q_shape=(8, 512, 16, 64), seq_k=512))
+    assert fwd.impl == kd.IMPL_PALLAS
+    # big heads keep the Pallas forward
+    fwd, _ = kd.resolve(_bench_sig(q_shape=(8, 1024, 8, 128)))
+    assert fwd.impl == kd.IMPL_PALLAS
+    # windowed shapes keep the Pallas forward (it skips out-of-window
+    # blocks; XLA still materializes [S, S])
+    fwd, _ = kd.resolve(_bench_sig(window=256))
+    assert fwd.impl == kd.IMPL_PALLAS
+
+
+def test_measured_entry_beats_heuristic(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sig = _bench_sig()
+    # heuristic first (cache empty)
+    fwd, _ = kd.resolve(sig, "TPU v5e")
+    assert fwd.source == "heuristic"
+    get_cache().commit(kd.signature("fwd", sig, "TPU v5e"),
+                       {"impl": "folded", "block_q": 512, "block_k": 1024,
+                        "ms": 33.3})
+    fwd, bwd = kd.resolve(sig, "TPU v5e")
+    assert (fwd.impl, fwd.source) == ("folded", "measured")
+    assert (fwd.block_q, fwd.block_k) == (512, 1024)
+    # the OTHER leg has no measurement: stays heuristic
+    assert bwd.source == "heuristic"
+    # a different device kind does not see this measurement
+    fwd_cpu, _ = kd.resolve(sig, "TPU v4")
+    assert fwd_cpu.source == "heuristic"
+
+
+def test_env_overrides_beat_measured(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sig = _bench_sig()
+    get_cache().commit(kd.signature("fwd", sig, "x"),
+                       {"impl": "folded", "block_q": 256, "block_k": 256})
+    monkeypatch.setenv("DS_TPU_ATTN_FWD", "pallas")
+    monkeypatch.setenv("DS_TPU_ATTN_BWD", "xla")
+    fwd, bwd = kd.resolve(sig, "x")
+    assert (fwd.impl, fwd.source) == ("pallas", "env")
+    assert (bwd.impl, bwd.source) == ("xla", "env")
+    # explicit kwargs beat even the env
+    fwd, bwd = kd.resolve(sig, "x", impl_fwd="xla", impl_bwd="folded")
+    assert (fwd.impl, fwd.source) == ("xla", "explicit")
+    assert (bwd.impl, bwd.source) == ("folded", "explicit")
+
+
+def test_legacy_folded_env_forces_both_legs(monkeypatch):
+    monkeypatch.setenv("DS_TPU_FLASH_FOLDED", "1")
+    fwd, bwd = kd.resolve(_bench_sig())
+    assert fwd.impl == bwd.impl == kd.IMPL_FOLDED
+    assert fwd.source == bwd.source == "legacy-env"
+    # "0" only pins the per-head VARIANT; the fwd=XLA heuristic still wins
+    monkeypatch.setenv("DS_TPU_FLASH_FOLDED", "0")
+    fwd, bwd = kd.resolve(_bench_sig(), "TPU v5e")
+    assert fwd.impl == kd.IMPL_XLA
+    assert bwd.impl == kd.IMPL_PALLAS
+
+
+def test_pallas_only_restriction(monkeypatch):
+    """force_pallas=True callers (kernel-math tests) must never silently get
+    the XLA path back — an XLA pick degrades to the per-head kernel."""
+    fwd, bwd = kd.resolve(_bench_sig(), "TPU v5e", pallas_only=True)
+    assert fwd.impl == kd.IMPL_PALLAS and "pallas-forced" in fwd.source
+    assert bwd.impl == kd.IMPL_PALLAS
+    monkeypatch.setenv("DS_TPU_FLASH_FOLDED", "1")
+    fwd, _ = kd.resolve(_bench_sig(), "TPU v5e", pallas_only=True)
+    assert fwd.impl == kd.IMPL_FOLDED
+
+
+def test_describe_and_resolved_note():
+    note = kd.resolved_note(kind="TPU v5e")
+    assert note.startswith("attn[fwd=xla:heuristic,bwd=pallas@")
+    fwd, bwd = kd.resolve(_bench_sig(), "TPU v5e")
+    d = kd.describe(fwd, bwd)
+    assert "fwd=xla" in d and "bwd=pallas@" in d
+
+
+# ---------------------------------------------------------------------------
+# persistent cache durability
+# ---------------------------------------------------------------------------
+
+
+def test_cache_round_trip(tmp_path):
+    c = AutotuneCache(str(tmp_path / "t.json"))
+    assert c.lookup("k") is None
+    c.commit("k", {"impl": "xla", "block_q": 128, "block_k": 128, "ms": 1.0})
+    got = c.lookup("k")
+    assert got["impl"] == "xla" and "utc" in got
+    # a second commit merges, never clobbers other keys
+    c.commit("k2", {"impl": "pallas", "block_q": 256, "block_k": 512})
+    assert c.lookup("k")["impl"] == "xla"
+    assert c.lookup("k2")["impl"] == "pallas"
+
+
+def test_cache_tolerates_torn_and_wrong_version(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text('{"version": 1, "entries": {"k": {"impl": "fol')  # torn
+    c = AutotuneCache(str(p))
+    assert c.lookup("k") is None
+    assert "heuristic" in c.source_description()
+    p.write_text(json.dumps({"version": CACHE_VERSION + 1,
+                             "entries": {"k": {"impl": "xla"}}}))
+    c2 = AutotuneCache(str(p))
+    assert c2.lookup("k") is None
+    # committing over garbage produces a clean valid table
+    c.commit("k", {"impl": "xla", "block_q": 128, "block_k": 128})
+    doc = json.loads(p.read_text())
+    assert doc["version"] == CACHE_VERSION and "k" in doc["entries"]
+
+
+def test_cache_bad_impl_entry_falls_back(monkeypatch, tmp_path):
+    """A table entry naming an impl this build doesn't know (forward compat)
+    must fall through to the heuristic, not crash or dispatch garbage."""
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sig = _bench_sig()
+    get_cache().commit(kd.signature("fwd", sig, "z"),
+                       {"impl": "cuda-graphs", "block_q": 1, "block_k": 1})
+    fwd, _ = kd.resolve(sig, "z")
+    assert fwd.source == "heuristic"
+
+
+def test_env_dir_precedence(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path / "a"))
+    assert cache_path() == str(tmp_path / "a" / "attn_dispatch.json")
+    monkeypatch.delenv("DS_TPU_ATTN_CACHE_DIR")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    assert cache_path() == str(tmp_path / "xdg" / "deepspeed_tpu"
+                               / "attn_dispatch.json")
+
+
+def test_cache_hit_changes_dispatched_kernels(monkeypatch, tmp_path):
+    """End-to-end: a committed measurement changes which kernels the NEXT
+    flash_attention call traces — and the answer stays oracle-correct."""
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    q, k, v = _qkv(s=128, d=32)
+    sig = kd.make_sig(q.shape, k.shape[2], k.shape[1], q.dtype, True,
+                      None, None)
+    kind = kd.device_kind()
+    get_cache().commit(kd.signature("fwd", sig, kind),
+                       {"impl": "folded", "block_q": 128, "block_k": 128})
+    fwd, _ = kd.resolve(sig, kind)
+    assert (fwd.impl, fwd.source) == ("folded", "measured")
+    o_ref, _ = _ref(q, k, v)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# block handling
+# ---------------------------------------------------------------------------
+
+
+def test_explicit_blocks_pin_pallas_tiles(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sig = _bench_sig()
+    get_cache().commit(kd.signature("bwd", sig, "y"),
+                       {"impl": "pallas", "block_q": 512, "block_k": 1024})
+    _, bwd = kd.resolve(sig, "y", blocks=(128, 128))
+    assert (bwd.block_q, bwd.block_k) == (128, 128)  # explicit beats measured
+    monkeypatch.setenv("DS_TPU_FLASH_BLOCKS", "256,256")
+    _, bwd = kd.resolve(sig, "y")
+    assert (bwd.block_q, bwd.block_k) == (256, 256)  # env beats measured
+    monkeypatch.delenv("DS_TPU_FLASH_BLOCKS")
+    _, bwd = kd.resolve(sig, "y")
+    assert (bwd.block_q, bwd.block_k) == (512, 1024)  # measured beats default
+
+
+def test_blocks_fit_short_sequences():
+    """The default hd64 blocks (256, 512) exceed s=128 — execution must
+    clamp them to divide the sequence instead of tripping the kernels'
+    divisibility assert."""
+    q, k, v = _qkv(s=128, d=64)
+    o_ref, _ = _ref(q, k, v)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          impl_fwd="pallas", impl_bwd="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# the offline sweep tool, end to end on CPU
+# ---------------------------------------------------------------------------
+
+
+def _load_sweep_module():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "..", "perf", "run_attn_sweep.py")
+    spec = importlib.util.spec_from_file_location("run_attn_sweep", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_writes_cache_consumed_by_dispatch(monkeypatch, tmp_path):
+    """Acceptance: the sweep runs end-to-end on CPU (interpret mode), writes
+    a valid version-stamped cache, and the next resolve() consumes it as
+    'measured' for BOTH legs."""
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sweep = _load_sweep_module()
+    results = sweep.sweep_shape(1, 128, 2, 2, 32, "float32", True,
+                                iters=1, interpret=True, quick=True)
+    assert set(results) == {"fwd", "bwd"}
+    doc = json.loads((tmp_path / "attn_dispatch.json").read_text())
+    assert doc["version"] == CACHE_VERSION and len(doc["entries"]) == 2
+    sig = kd.make_sig((1, 128, 2, 32), 2, 128, "float32", True, None, None)
+    fwd, bwd = kd.resolve(sig, "interpret")
+    assert fwd.source == "measured" and bwd.source == "measured"
+    assert fwd.impl in IMPLS and bwd.impl in IMPLS
+
+
+def test_sweep_dry_run_commits_nothing(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    sweep = _load_sweep_module()
+    sweep.sweep_shape(1, 128, 2, 2, 32, "float32", True, iters=1,
+                      interpret=True, quick=True, commit=False,
+                      impls=(kd.IMPL_XLA, kd.IMPL_PALLAS))
+    assert not (tmp_path / "attn_dispatch.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# reporting surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_env_report_includes_dispatch_lines():
+    from deepspeed_tpu.env_report import debug_report
+    rep = debug_report()
+    assert "attn dispatch table" in rep
+    assert "attn dispatch @ bench shape" in rep
+    assert "attn[fwd=" in rep
+
+
+def test_table_source_reflects_cache_state(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path))
+    assert "heuristic" in kd.table_source()
+    get_cache().commit("sig", {"impl": "xla", "block_q": 1, "block_k": 1})
+    assert kd.table_source().startswith("measured")
