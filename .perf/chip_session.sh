@@ -80,28 +80,41 @@ run kernel_tune 1800 python bin/ds_kernel_tune --batch 8 --seq 1024 --heads 16 -
 run bench_serving_fast 1200 env DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FAST.json
 snapshot  # serving evidence suffixed NOW — a session death during the
           # long steps must not leave it clobberable by the next window
-# 4b. serving decode xprof: attribute where decode time goes after the
+# 5. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
+# flash + selective remat). MOVED EARLY (VERDICT r5 #3): as step 11 it
+# starved on every short window — it is headline evidence, not a
+# diagnostic, so it runs right after the two fast headline numbers.
+run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
+# 6. Twin-Flow partial-offload ratio sweep (VERDICT r5 #5: the sweep was
+# armed for two rounds but windows died before reaching step 13 — it now
+# precedes every diagnostic; VERDICT r4 #8 wants the measured curve
+# journaled). Largest-leverage ratios first so a mid-sweep death still
+# lands the comparison pair.
+for R in 1.0 0.25 0.5 0.75; do
+  run "twinflow_$R" 1500 python .perf/twinflow_probe.py $R
+done
+# 7. serving decode xprof: attribute where decode time goes after the
 # layout/kernel fixes (fused vs per-step, counterpart of the train trace)
 run serving_trace 1200 python .perf/serving_trace.py $P/xprof_serving_$SFX
-# 5. where-the-time-goes, scanned program (matches bench_fast's program)
+# 8. where-the-time-goes, scanned program (matches bench_fast's program)
 run bench_breakdown_scan 1500 env DS_BENCH_SCAN=1 python bench.py --breakdown
-# 6. headline train number (full anytime ladder: scanned rungs first,
+# 9. headline train number (full anytime ladder: scanned rungs first,
 # then the unrolled programs — their cold compile only pays off once the
 # persistent cache carries it across windows)
 run bench 2400 python bench.py
-# 7. where-the-time-goes, unrolled + xprof capture of 3 fused steps
+# 10. where-the-time-goes, unrolled + xprof capture of 3 fused steps
 run bench_breakdown 1800 env DS_BENCH_TRACE=$P/xprof_$SFX python bench.py --breakdown
-# 8. serving full sweep (writes BENCH_SERVING.json at repo root, incrementally)
+# 11. serving full sweep (writes BENCH_SERVING.json at repo root, incrementally)
 run bench_serving 2400 python bench_serving.py
 snapshot
-# 9. NVMe bandwidth (GDS-analog evidence)
+# 11b. NVMe bandwidth (GDS-analog evidence) + the tmpfs loader ceiling
+# (VERDICT r5 #6: pool+pinned-buffer throughput measurable independent of
+# the virtio disk)
 run nvme 1200 python bin/ds_nvme_bench --o_direct
-# 10. driver-entry compile check on the real chip (the driver only runs it
+run nvme_tmpfs 1200 python bin/ds_nvme_bench --source tmpfs --size_gb 0.5
+# 11c. driver-entry compile check on the real chip (the driver only runs it
 # single-chip; prove it here while we have silicon)
 run entry_compile 1200 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('entry() compiled+ran on', jax.devices()[0])"
-# 11. long-sequence training (the Ulysses 54%-bar regime: 16k/32k tokens,
-# flash + selective remat)
-run bench_longseq 2400 env DS_BENCH_LONGSEQ=1 python bench.py
 # 12. flash block sweep — whole-bench cross-check of step 3b's per-op
 # verdicts (DS_TPU_FLASH_BLOCKS overrides the measured cache, so each rung
 # really runs its blocks). The 0801T1906 xprof trace proved the flash
@@ -125,13 +138,10 @@ run flash_folded_longseq 2400 env DS_TPU_FLASH_FOLDED=1 DS_BENCH_LONGSEQ=1 pytho
 # every env-less run, incl. the driver's final bench); a loss withdraws a
 # stale promotion. Also removes the deprecated FOLDED_PROVEN sentinel.
 run folded_promote 300 python .perf/promote_folded.py $SFX
-# 13. round-5 additions: ZeRO-Inference NVMe->HBM streamed decode at a
-# scale where streaming matters on-chip, then the Twin-Flow partial-offload
-# ratio sweep (VERDICT r4 #8: journal the measured throughput curve)
+# 13. ZeRO-Inference NVMe->HBM streamed decode at a scale where streaming
+# matters on-chip (the twinflow ratio sweep moved to step 6 — headline
+# before diagnostics)
 run zero_inference 1800 env PYTHONPATH=/root/repo:/root/.axon_site python examples/zero_inference_demo.py --hidden 2048 --layers 16 --device nvme --tokens 4
-for R in 0.25 0.5 0.75 1.0; do
-  run "twinflow_$R" 1500 python .perf/twinflow_probe.py $R
-done
 # 14. sparse-vs-dense block-sparse attention train probe (VERDICT r4 #4
 # "Done": sparse bwd beating dense bwd at long context)
 run sparse_attn 1800 python .perf/sparse_probe.py 2048 4096 8192
@@ -144,6 +154,13 @@ run bench_serving_spec 1200 env DS_BENCH_SPEC=1 DS_BENCH_FAST=1 python bench_ser
 # 15d. serving-daemon end-to-end throughput (MII layer: scheduler thread,
 # admission, streaming — not raw engine puts)
 run bench_serving_daemon 1200 env DS_BENCH_DAEMON=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_DAEMON.json
+# 15e. MoE expert-parallel decode (VERDICT r5 #9: grouped_matmul through
+# the v2 engine, tok/s + decode_step_ms like the dense rungs)
+run bench_serving_moe 1500 env DS_BENCH_MOE=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_MOE.json
+# 15f. on-device sampled decode: per-token vs fused-K for a fully
+# non-greedy batch — the dispatch-amortization evidence for the workload
+# the fused path newly covers
+run bench_serving_sampled 1500 env DS_BENCH_SAMPLED=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_SAMPLED.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -80,6 +80,21 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                                        n_requests=16 if on_tpu else 6,
                                        ctx=contexts[0] // 2,
                                        new_tokens=decode_steps))
+    # DS_BENCH_MOE=1: Mixtral-style expert-parallel decode through the v2
+    # engine (ops/grouped_matmul in the ragged forward) — tok/s +
+    # decode_step_ms like the dense rungs, so MoE serving regressions are
+    # visible next to them
+    if env_flag("DS_BENCH_MOE"):
+        results.extend(_measure_moe(cfg, contexts[0] if on_tpu else 256,
+                                    kv_block, backends[0], decode_steps,
+                                    batch_sizes[0]))
+    # DS_BENCH_SAMPLED=1: on-device sampled decode — per-token vs fused-K
+    # dispatch for a fully non-greedy batch (the subset the fused path
+    # newly covers; the delta is the dispatch amortization win)
+    if env_flag("DS_BENCH_SAMPLED"):
+        results.extend(_measure_sampled(cfg, contexts[0] if on_tpu else 256,
+                                        kv_block, backends[0], decode_steps,
+                                        batch_sizes[0]))
     for backend in backends:
         # the dense (gather) fallback materializes [N_chunk, KV, L] scores
         # at prefill — ~4 GB at 32k context; it is the comparison path,
@@ -215,6 +230,128 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
             for u in uids:
                 eng.flush(u)
     return results
+
+
+def _measure_moe(cfg, ctx, kv_block, backend, decode_steps, nseq):
+    """Expert-parallel decode rung: same shape as the dense batched rungs
+    but over a Mixtral-style MoE variant of the bench config, so the
+    grouped-matmul expert dispatch (ops/grouped_matmul) is exercised
+    through the v2 engine's ragged forward, not in isolation."""
+    import dataclasses
+    import jax
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    mcfg = dataclasses.replace(cfg, num_local_experts=4,
+                               num_experts_per_tok=2)
+    rng = np.random.default_rng(21)
+    chunk = 512
+    eng = build_llama_engine(
+        mcfg, engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_context=ctx + 2 * decode_steps + 3 * FUSED_K + kv_block,
+                max_ragged_batch_size=max(chunk, nseq)),
+            num_kv_blocks=(nseq + 1)
+            * ((ctx + 2 * decode_steps + 3 * FUSED_K) // kv_block + 2)),
+        kv_block_size=kv_block)
+    eng.model().attn_backend = backend
+    uids = list(range(nseq))
+    for u in uids:
+        for off in range(0, ctx, chunk):
+            eng.put([u], [rng.integers(0, mcfg.vocab_size,
+                                       size=min(chunk, ctx - off)).tolist()])
+    rows = []
+    out = eng.put(uids, [[7]] * nseq)  # warm batched MoE decode
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(decode_steps):
+        out = eng.put(uids, [[7]] * nseq)
+    jax.block_until_ready(out)
+    float(np.asarray(out).ravel()[0])
+    dt = time.perf_counter() - t0
+    rows.append({
+        "backend": backend, "context": ctx, "moe_experts": 4,
+        "concurrent_seqs": nseq,
+        "batched_decode_tok_s": round(nseq * decode_steps / dt, 2),
+        "decode_step_ms": round(1e3 * dt / decode_steps, 2)})
+    # fused MoE decode: grouped matmul inside the K-step scan
+    K = FUSED_K
+    out = eng.fused_decode_steps(uids, [7] * nseq, K)  # warm
+    n_disp = max(decode_steps // K, 2)
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        out = eng.fused_decode_steps(uids, list(out[:, -1]), K)
+    dt = time.perf_counter() - t0
+    rows.append({
+        "backend": backend, "context": ctx, "moe_experts": 4,
+        "concurrent_seqs": nseq, "fused_window": K,
+        "batched_decode_tok_s": round(nseq * n_disp * K / dt, 2),
+        "decode_step_ms": round(1e3 * dt / (n_disp * K), 2)})
+    for u in uids:
+        eng.flush(u)
+    return rows
+
+
+def _measure_sampled(cfg, ctx, kv_block, backend, decode_steps, nseq):
+    """Sampled-decode rung: a fully non-greedy batch (temperature/top-k/
+    top-p on every sequence) per-token vs fused-K. Before on-device
+    sampling this workload was locked out of the fused path entirely; the
+    per-token/fused delta here is the dispatch-amortization evidence."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (SampleSpec, build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    rng = np.random.default_rng(23)
+    chunk = 512
+    eng = build_llama_engine(
+        cfg, engine_config=RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_context=ctx + 2 * decode_steps + 3 * FUSED_K + kv_block,
+                max_ragged_batch_size=max(chunk, nseq)),
+            num_kv_blocks=(nseq + 1)
+            * ((ctx + 2 * decode_steps + 3 * FUSED_K) // kv_block + 2)),
+        kv_block_size=kv_block)
+    eng.model().attn_backend = backend
+    uids = list(range(nseq))
+    for u in uids:
+        for off in range(0, ctx, chunk):
+            eng.put([u], [rng.integers(0, cfg.vocab_size,
+                                       size=min(chunk, ctx - off)).tolist()])
+    specs = [SampleSpec(temperature=0.8, top_k=40, top_p=0.95, seed=u)
+             for u in uids]
+    rows = []
+    # per-token: one ragged put + one batched sample dispatch per token
+    logits = np.asarray(eng.put(uids, [[7]] * nseq))
+    toks, _ = eng.sample_rows(uids, list(logits), specs)  # warm
+    t0 = time.perf_counter()
+    for _ in range(decode_steps):
+        logits = np.asarray(eng.put(uids, [[t] for t in toks]))
+        toks, _ = eng.sample_rows(uids, list(logits), specs)
+    dt = time.perf_counter() - t0
+    rows.append({
+        "backend": backend, "context": ctx, "sampled": True,
+        "concurrent_seqs": nseq,
+        "batched_decode_tok_s": round(nseq * decode_steps / dt, 2),
+        "decode_step_ms": round(1e3 * dt / decode_steps, 2)})
+    # fused-K: forward + sample + feed-back inside one scan program
+    K = FUSED_K
+    out, _ = eng.fused_decode_steps(uids, toks, K, specs=specs)  # warm
+    n_disp = max(decode_steps // K, 2)
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        out, _ = eng.fused_decode_steps(uids, list(out[:, -1]), K,
+                                        specs=specs)
+    dt = time.perf_counter() - t0
+    rows.append({
+        "backend": backend, "context": ctx, "sampled": True,
+        "concurrent_seqs": nseq, "fused_window": K,
+        "batched_decode_tok_s": round(nseq * n_disp * K / dt, 2),
+        "decode_step_ms": round(1e3 * dt / (n_disp * K), 2)})
+    for u in uids:
+        eng.flush(u)
+    return rows
 
 
 def _measure_speculative(cfg, kv_block, backend):

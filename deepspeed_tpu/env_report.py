@@ -38,7 +38,8 @@ def op_report():
     for mod in ("attention", "attention_folded", "normalization", "quantizer",
                 "fused_optimizer", "rope",
                 "evoformer_attn", "spatial", "cpu_optim", "paged_attention",
-                "grouped_matmul", "sparse_attention.sparse_self_attention"):
+                "grouped_matmul", "sampling",
+                "sparse_attention.sparse_self_attention"):
         try:
             importlib.import_module(f".ops.{mod}", package=__package__)
         except ImportError:

@@ -5,8 +5,10 @@ dense int32 block tables, bucketed compile cache instead of dynamic shapes,
 Dynamic SplitFuse scheduling semantics (``can_schedule``/``query``).
 """
 
-from .config_v2 import RaggedInferenceEngineConfig, DSStateManagerConfig, KVCacheConfig
+from .config_v2 import (RaggedInferenceEngineConfig, DSStateManagerConfig,
+                        KVCacheConfig, SamplingConfig)
 from .scheduling_utils import SchedulingResult, SchedulingError
-from .engine_v2 import InferenceEngineV2, build_llama_engine, load_engine
+from .engine_v2 import (InferenceEngineV2, SampleSpec, build_llama_engine,
+                        load_engine)
 from .server import ServingScheduler, RequestHandle, serve
 from .pipeline import InferencePipeline, pipeline

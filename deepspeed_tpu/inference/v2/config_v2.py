@@ -73,6 +73,22 @@ class DSStateManagerConfig(ConfigModel):
         return self
 
 
+class SamplingConfig(ConfigModel):
+    """On-device sampling / fused-decode knobs (TPU-specific, beyond the
+    reference: the numpy sampler costs one host round-trip per token, so
+    sampled requests would otherwise never see the fused K-step path)."""
+
+    device_sampling: bool = True
+    """Run temperature/top-k/top-p sampling + logit controls on device
+    (ops/sampling) for requests without a host ``logits_processor``.
+    False restores the per-token numpy sampler everywhere."""
+
+    fused_sampled_decode: bool = True
+    """Let device-sampled requests ride the fused K-step decode program
+    (sampling inside the lax.scan). Requires ``device_sampling``. False
+    keeps fused dispatch greedy-only (pre-sampling behavior)."""
+
+
 class QuantizationConfig(ConfigModel):
     quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
 
@@ -86,6 +102,7 @@ class RaggedInferenceEngineConfig(ConfigModel):
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig)
     quantization: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    sampling: SamplingConfig = Field(default_factory=SamplingConfig)
 
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).

@@ -10,7 +10,8 @@ bucket (see ragged_wrapper) and the KV cache is donated functional state.
 
 import os
 import pickle
-from typing import Iterable, Optional, Tuple
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -22,6 +23,29 @@ from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import RaggedBatchWrapper
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from .scheduling_utils import SchedulingError, SchedulingResult
+
+
+@dataclass
+class SampleSpec:
+    """Per-sequence sampling parameters for the ON-DEVICE sampler
+    (ops/sampling) — the host-side description one row of a batched
+    ``sample_rows`` dispatch or one lane of a sampled fused-decode scan is
+    built from. ``history`` (prompt + outputs) is only consulted when
+    ``repetition_penalty != 1`` (it becomes the [vocab] presence mask);
+    ``block_eos`` is the per-token path's precomputed min_new gate, while
+    the fused path derives it in-trace from ``n_out``/``min_new`` per scan
+    step. ``seed`` initializes the sequence's PRNG key on first use."""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: Optional[int] = None
+    block_eos: bool = False
+    history: Optional[List[int]] = None
+    seed: int = 0
+    want_logprobs: bool = False
+    n_out: int = 0
+    min_new: int = 0
 
 
 class InferenceEngineV2:
@@ -44,6 +68,12 @@ class InferenceEngineV2:
                                              num_blocks=engine_config.num_kv_blocks,
                                              enable_prefix_caching=prefix_caching)
         self._model.set_state_manager(self._state_manager)
+        # per-sequence PRNG key state for the on-device sampler — lives
+        # next to the KV cache in lifecycle terms (seeded lazily at first
+        # sample, advanced one split per generated token, dropped on
+        # flush). Kept as host uint32[2] rows; each dispatch carries the
+        # batch's keys in and the advanced keys out.
+        self._sample_keys = {}
 
     # ---- properties (reference engine_v2.py:47-66) ----
 
@@ -298,6 +328,7 @@ class InferenceEngineV2:
 
     def warmup(self, prefill_lens=(128, ), batch_sizes=(1, ),
                draft_tokens: int = 0, fused_windows=(),
+               fused_sampled_windows=(),
                decode_context: int = 0) -> int:
         """Precompile the bucketed forward programs serving will hit, so the
         first real request doesn't pay compile latency (the reference's
@@ -341,6 +372,13 @@ class InferenceEngineV2:
                      defer_register=scratch)
             for K in fused_windows:
                 self.fused_decode_steps(uids, [0] * bs, int(K))
+            for K in fused_sampled_windows:
+                # warm the SAMPLED scan program (logprobs on — the superset
+                # compile the serving daemon's mixed waves hit)
+                self.fused_decode_steps(
+                    uids, [0] * bs, int(K),
+                    specs=[SampleSpec(temperature=1.0, want_logprobs=True)
+                           for _ in uids])
             for u in uids:
                 self.flush(u)
         return len(self._model._fwd_cache)
@@ -400,6 +438,89 @@ class InferenceEngineV2:
                 top_k: int = 0, top_p: float = 1.0) -> int:
         return cls._sample_with_logprob(row, temperature, rng, top_k, top_p,
                                         want_lp=False)[0]
+
+    # ---- on-device sampling (ops/sampling; numpy above stays the oracle) ----
+
+    def seed_sampler(self, uid: int, seed: int = 0, key=None) -> None:
+        """(Re)initialize a sequence's device PRNG key. The key stream is a
+        pure function of the initial key, so the per-token and fused paths
+        replay identical streams from the same seed."""
+        if key is None:
+            key = jax.random.PRNGKey(int(seed))
+        self._sample_keys[uid] = np.asarray(key, np.uint32)
+
+    def _sampler_key(self, uid: int, seed: int) -> np.ndarray:
+        k = self._sample_keys.get(uid)
+        if k is None:
+            self.seed_sampler(uid, seed)
+            k = self._sample_keys[uid]
+        return k
+
+    @staticmethod
+    def _spec_statics(specs):
+        """Static compile flags a batch of SampleSpecs resolves to — part
+        of the jit cache key, so an all-plain wave never pays for controls
+        it doesn't use."""
+        use_pen = any(s.repetition_penalty != 1.0 for s in specs)
+        use_eos = any(s.eos_token_id is not None
+                      and (s.block_eos or s.min_new > s.n_out)
+                      for s in specs)
+        want_lp = any(s.want_logprobs for s in specs)
+        return use_pen, use_eos, want_lp
+
+    def _spec_arrays(self, batch_uids, specs, S, V, use_pen):
+        """Bucketed per-row control arrays shared by ``sample_rows`` and
+        the sampled fused path. Padding rows are inert (temperature 0,
+        penalty 1, no eos)."""
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        pens = np.ones(S, np.float32)
+        eos = np.full(S, -1, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        mask = np.zeros((S, V), bool) if use_pen else None
+        for i, (u, s) in enumerate(zip(batch_uids, specs)):
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+            pens[i] = s.repetition_penalty
+            if s.eos_token_id is not None:
+                eos[i] = int(s.eos_token_id)
+            keys[i] = self._sampler_key(u, s.seed)
+            if use_pen and s.repetition_penalty != 1.0 and s.history:
+                mask[i, np.asarray(s.history, np.int64)] = True
+        return temps, top_ks, top_ps, pens, eos, keys, mask
+
+    def sample_rows(self, batch_uids, rows, specs):
+        """ONE batched on-device sampling dispatch for logits rows fetched
+        by a per-token tick: logit controls → temperature/top-k/top-p
+        Gumbel-max → selected-token logprob, identical op-for-op to the
+        fused scan's in-trace sampler, so a request keeps a bit-identical
+        token stream when the scheduler moves it between paths. Advances
+        each sequence's PRNG key by one split. Returns ``(tokens, logprobs)``
+        lists of length ``len(batch_uids)``."""
+        from ...ops import sampling as dsamp
+        from .ragged.ragged_wrapper import _bucket
+        batch_uids = list(batch_uids)
+        rows = [np.asarray(r, np.float32).reshape(-1) for r in rows]
+        n, V = len(batch_uids), rows[0].size
+        S = _bucket(n, floor=1)
+        use_pen, use_eos, want_lp = self._spec_statics(specs)
+        temps, top_ks, top_ps, pens, eos, keys, mask = self._spec_arrays(
+            batch_uids, specs, S, V, use_pen)
+        blk = np.zeros(S, bool)
+        logits = np.zeros((S, V), np.float32)
+        for i, (row, s) in enumerate(zip(rows, specs)):
+            logits[i] = row
+            blk[i] = s.block_eos
+        toks, lps, new_keys = dsamp.sample_step(
+            logits, keys, temps, top_ks, top_ps, mask, pens, eos, blk,
+            want_logprobs=want_lp, use_penalty=use_pen,
+            use_eos_mask=use_eos)
+        toks, lps, new_keys = jax.device_get((toks, lps, new_keys))
+        for i, u in enumerate(batch_uids):
+            self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
+        return ([int(t) for t in toks[:n]], [float(l) for l in lps[:n]])
 
     @staticmethod
     def process_logits(row, history, *, repetition_penalty: float = 1.0,
@@ -468,8 +589,9 @@ class InferenceEngineV2:
             self._register_pending(seq)
         return new_toks, m
 
-    def fused_decode_steps(self, batch_uids, last_tokens, n_steps: int):
-        """``n_steps`` greedy decode steps for live sequences in ONE device
+    def fused_decode_steps(self, batch_uids, last_tokens, n_steps: int,
+                           specs=None):
+        """``n_steps`` decode steps for live sequences in ONE device
         dispatch (model.fused_decode: lax.scan over the single-token forward
         — the TPU analog of the reference v1 engine's CUDA-graph decode
         replay, ``inference/engine.py:527``). Amortizes the per-step host
@@ -484,7 +606,16 @@ class InferenceEngineV2:
         prefix-cache registration and trailing-window frees are DEFERRED:
         the caller trims to eos/stop and then runs ``_register_pending`` /
         ``maybe_free_kv`` for sequences that stay live (retiring sequences
-        just flush). Returns int32 [n_seqs, n_steps] generated tokens."""
+        just flush).
+
+        ``specs=None`` runs the original greedy program and returns int32
+        [n_seqs, n_steps] generated tokens. With one :class:`SampleSpec`
+        per uid, sampling (and logit controls) run ON DEVICE inside the
+        scan — temperature/top-k/top-p/repetition-penalty/eos-mask
+        requests advance K tokens per dispatch too — and the call returns
+        ``(tokens [n_seqs, n_steps], logprobs [n_seqs, n_steps])``, with
+        each sequence's PRNG key advanced by exactly ``n_steps`` splits
+        (the same count the per-token path would burn)."""
         batch_uids = list(batch_uids)
         seqs = []
         for uid in batch_uids:
@@ -521,8 +652,30 @@ class InferenceEngineV2:
             seq_lens[i] = seq.seen_tokens
             liv[i] = 1
             block_table[i] = seq.block_table(B)
-        out = self._model.fused_decode(tokens, seq_lens, liv, block_table,
-                                       n_steps)  # [K, S]
+        lps = None
+        if specs is None:
+            out = self._model.fused_decode(tokens, seq_lens, liv, block_table,
+                                           n_steps)  # [K, S]
+        else:
+            V = int(self._model.config.vocab_size)
+            use_pen, use_eos, want_lp = self._spec_statics(specs)
+            temps, top_ks, top_ps, pens, eos, keys, mask = self._spec_arrays(
+                batch_uids, specs, S, V, use_pen)
+            n_out = np.zeros(S, np.int32)
+            min_new = np.zeros(S, np.int32)
+            for i, s in enumerate(specs):
+                n_out[i] = s.n_out
+                min_new[i] = s.min_new
+            out, lps, new_keys = self._model.fused_decode(
+                tokens, seq_lens, liv, block_table, n_steps,
+                sampling=dict(keys=keys, temps=temps, top_ks=top_ks,
+                              top_ps=top_ps, penalties=pens, eos_ids=eos,
+                              n_out=n_out, min_new=min_new, seen_mask=mask,
+                              want_logprobs=want_lp, use_penalty=use_pen,
+                              use_eos_mask=use_eos))
+            for i, u in enumerate(batch_uids):
+                self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
+            lps = lps[:, :len(seqs)].T  # [n_seqs, K]
         out = out[:, :len(seqs)].T  # [n_seqs, K]
 
         pc = self._state_manager.prefix_cache
@@ -535,6 +688,8 @@ class InferenceEngineV2:
                 # dispatch) — mirrors one put() append per step
                 self._append_pending(
                     seq, np.concatenate([[tokens[i]], out[i, :-1]]))
+        if specs is not None:
+            return out, lps
         return out
 
     @staticmethod
@@ -640,6 +795,49 @@ class InferenceEngineV2:
                 logits_processor=logits_processor)
 
         rng = np.random.default_rng(seed)
+        # on-device sampling (ops/sampling): any request the host-only
+        # logits_processor doesn't claim runs controls + sampling in ONE
+        # batched device dispatch per step — and becomes eligible for the
+        # fused K-step program below. Plain greedy without logprobs keeps
+        # the zero-dispatch host argmax.
+        scfg = getattr(self._config, "sampling", None)
+        device_sampled = (scfg is not None and scfg.device_sampling
+                          and logits_processor is None
+                          and (temperature != 0.0 or return_logprobs
+                               or repetition_penalty != 1.0
+                               or min_new_tokens > 0))
+        base_key = jax.random.PRNGKey(int(seed)) if device_sampled else None
+
+        def _spec(u):
+            return SampleSpec(
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                repetition_penalty=repetition_penalty,
+                eos_token_id=eos_token_id,
+                block_eos=len(outputs[u]) < min_new_tokens,
+                history=(prompts[u] + outputs[u])
+                if repetition_penalty != 1.0 else None,
+                want_logprobs=return_logprobs,
+                n_out=len(outputs[u]), min_new=min_new_tokens)
+
+        def _ensure_keys(us):
+            # per-sequence streams derived from the one generate() seed —
+            # decorrelated across sequences, reproducible per (seed, u)
+            for u in us:
+                if u not in self._sample_keys:
+                    self.seed_sampler(u, key=jax.random.fold_in(base_key, u))
+
+        def _sample_wave(us, rows):
+            """(token, logprob) per row: one batched device dispatch for
+            eligible configs, the numpy oracle otherwise."""
+            if device_sampled:
+                _ensure_keys(us)
+                toks, lps = self.sample_rows(us, rows,
+                                             [_spec(u) for u in us])
+                return list(zip(toks, lps))
+            return [self._sample_with_logprob(
+                _controls(rows[i], u), temperature, rng, top_k, top_p,
+                want_lp=return_logprobs) for i, u in enumerate(us)]
+
         if num_return_sequences > 1:
             # parallel sampling (MII n-sampling): N samples per prompt,
             # flattened [p0_s0, p0_s1, ..., p1_s0, ...]. With prefix caching
@@ -698,9 +896,7 @@ class InferenceEngineV2:
                 logits = np.asarray(self.put(
                     [u], [feed[u][ofs:ofs + max_batch_tokens]],
                     do_checks=False))[0]
-            last_tok[u], lp = self._sample_with_logprob(
-                _controls(logits, u), temperature, rng, top_k, top_p,
-                want_lp=return_logprobs)
+            (last_tok[u], lp), = _sample_wave([u], [logits])
             outputs[u].append(last_tok[u])
             logprobs[u].append(lp)
             live.append(u)
@@ -762,10 +958,10 @@ class InferenceEngineV2:
             if admit:
                 logits = np.asarray(self.put(admit, [feed[u] for u in admit],
                                              do_checks=False))
+                picks = _sample_wave(admit, [logits[i]
+                                             for i in range(len(admit))])
                 for i, u in enumerate(admit):
-                    last_tok[u], lp = self._sample_with_logprob(
-                        _controls(logits[i], u), temperature, rng, top_k,
-                        top_p, want_lp=return_logprobs)
+                    last_tok[u], lp = picks[i]
                     outputs[u].append(last_tok[u])
                     logprobs[u].append(lp)
                     live.append(u)
@@ -777,14 +973,15 @@ class InferenceEngineV2:
             if not live:
                 continue
 
-            def _absorb_new_tokens(u, new_toks):
+            def _absorb_new_tokens(u, new_toks, new_lps=None):
                 """Shared trim protocol for multi-token waves (fused decode
                 and speculative verification): append, cut at the earliest
                 eos, then at the earliest stop-sequence END inside the
                 appended window, cap at the output budget. Overshot KV needs
                 no rollback — a trimmed sequence retires and flushes."""
                 outputs[u].extend(new_toks)
-                logprobs[u].extend([None] * len(new_toks))
+                logprobs[u].extend(new_lps if new_lps is not None
+                                   else [None] * len(new_toks))
                 if eos_token_id is not None and eos_token_id in new_toks:
                     cut = len(outputs[u]) - len(new_toks) \
                         + new_toks.index(eos_token_id) + 1
@@ -798,19 +995,27 @@ class InferenceEngineV2:
                             break
                 if len(outputs[u]) > max_new_tokens:
                     outputs[u] = outputs[u][:max_new_tokens]
+                if len(logprobs[u]) > len(outputs[u]):
+                    logprobs[u] = logprobs[u][:len(outputs[u])]
                 last_tok[u] = outputs[u][-1]
 
-            # fused multi-step fast path: plain greedy decode (no sampling
-            # controls, no logprobs, no drafts) runs K steps per dispatch —
-            # the CUDA-graph-replay analog (see fused_decode_steps). eos and
-            # ``stop`` compose by trim-and-retire: overshoot tokens belong to
-            # sequences that retire this wave, so their KV needs no rollback
-            # (same argument as the speculative window-overshoot path below).
-            fused_ok = (speculative is None and temperature == 0.0
-                        and not return_logprobs and min_new_tokens == 0
-                        and repetition_penalty == 1.0
-                        and logits_processor is None
-                        and fused_steps_cap > 1)
+            # fused multi-step fast path runs K steps per dispatch — the
+            # CUDA-graph-replay analog (see fused_decode_steps). Plain
+            # greedy uses the original argmax program; device-sampled
+            # requests (temperature/top-k/top-p/logprobs/penalty/min_new)
+            # ride the sampled scan program — only host-only
+            # logits_processor callbacks and speculative drafting stay
+            # per-token. eos and ``stop`` compose by trim-and-retire:
+            # overshoot tokens belong to sequences that retire this wave,
+            # so their KV needs no rollback (same argument as the
+            # speculative window-overshoot path below).
+            fused_plain = (speculative is None and temperature == 0.0
+                           and not return_logprobs and min_new_tokens == 0
+                           and repetition_penalty == 1.0
+                           and logits_processor is None)
+            fused_ok = fused_steps_cap > 1 and (
+                fused_plain or (device_sampled and speculative is None
+                                and scfg.fused_sampled_decode))
             if fused_ok:
                 # mixed-progress waves SPLIT rather than demote: sequences
                 # with >= 2 tokens of room fuse at the largest window THEY
@@ -821,17 +1026,26 @@ class InferenceEngineV2:
                 fusable, K, solo = self.fused_partition(
                     live, [max_new_tokens - len(outputs[u]) for u in live],
                     fused_steps_cap)
-                toks = None
+                toks = lps_wave = None
                 if K >= 2:
                     try:
-                        toks = self.fused_decode_steps(
-                            fusable, [last_tok[u] for u in fusable], K)
+                        if fused_plain:
+                            toks = self.fused_decode_steps(
+                                fusable, [last_tok[u] for u in fusable], K)
+                        else:
+                            _ensure_keys(fusable)
+                            toks, lps_wave = self.fused_decode_steps(
+                                fusable, [last_tok[u] for u in fusable], K,
+                                specs=[_spec(u) for u in fusable])
                     except SchedulingError:
                         pass  # KV pressure: the single-step path below owns
                         # the evict-and-replay protocol
                 if toks is not None:
                     for i, u in enumerate(fusable):
-                        _absorb_new_tokens(u, list(map(int, toks[i])))
+                        _absorb_new_tokens(
+                            u, list(map(int, toks[i])),
+                            list(map(float, lps_wave[i]))
+                            if lps_wave is not None else None)
                         if not self.decode_finished(u, outputs[u],
                                                     max_new_tokens,
                                                     eos_token_id, stop):
@@ -848,9 +1062,7 @@ class InferenceEngineV2:
                         except SchedulingError:
                             continue  # replayed by the per-step path's
                             # evict-and-replay protocol next iteration
-                        last_tok[u], lp = self._sample_with_logprob(
-                            _controls(logits_u, u), temperature, rng, top_k,
-                            top_p, want_lp=return_logprobs)
+                        (last_tok[u], lp), = _sample_wave([u], [logits_u])
                         outputs[u].append(last_tok[u])
                         logprobs[u].append(lp)
                     # retirement for both groups happens at the top of the
@@ -915,10 +1127,10 @@ class InferenceEngineV2:
                     self._model.maybe_free_kv(seq)
                     _absorb_new_tokens(u, new_toks)
             else:
+                picks = _sample_wave(live, [logits[i]
+                                            for i in range(len(live))])
                 for i, u in enumerate(live):
-                    last_tok[u], lp = self._sample_with_logprob(
-                        _controls(logits[i], u), temperature, rng, top_k,
-                        top_p, want_lp=return_logprobs)
+                    last_tok[u], lp = picks[i]
                     outputs[u].append(last_tok[u])
                     logprobs[u].append(lp)
         if return_logprobs:
@@ -927,6 +1139,7 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
+        self._sample_keys.pop(uid, None)
 
     def serialize(self, save_path: str) -> None:
         """Flat param snapshot (reference :251 → flat_model_helpers)."""

@@ -377,31 +377,56 @@ class RaggedLlamaModel:
         kv.update(new_cache)
         return logits
 
-    def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int):
-        """``n_steps`` greedy decode steps in ONE XLA program (lax.scan over
-        the single-token ragged forward). The TPU-native answer to the
+    def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int,
+                     sampling: Optional[dict] = None):
+        """``n_steps`` decode steps in ONE XLA program (lax.scan over the
+        single-token ragged forward). The TPU-native answer to the
         reference v1 engine's CUDA-graph decode capture
         (``inference/engine.py:527 _create_cuda_graph``): where CUDA graphs
         amortize kernel-launch overhead by replaying a recorded decode step,
         this amortizes the per-dispatch host/relay round-trip by scanning K
-        steps inside the compiled program — sampling (argmax), KV append and
+        steps inside the compiled program — sampling, KV append and
         position advance all stay on device.
 
         Host contract: every live row's block table already covers
         ``seq_lens + n_steps`` tokens (the engine pre-allocates); ``live`` is
         0/1 per row (bucket padding rows are 0 — their KV writes drop to the
         OOB slot and their position never advances, exactly like padding in
-        the per-step path). Returns int32 [n_steps, S] generated tokens
-        (rows of dead sequences repeat their input token).
-        """
+        the per-step path).
+
+        ``sampling=None`` keeps the original greedy program (argmax
+        in-trace, byte-identical compile key) and returns int32
+        [n_steps, S] generated tokens (rows of dead sequences repeat their
+        input token). With ``sampling`` (a dict of per-row arrays —
+        ``keys`` [S, 2] uint32, ``temps``/``top_ps``/``penalties`` [S] f32,
+        ``top_ks``/``eos_ids``/``n_out``/``min_new`` [S] int32, optional
+        ``seen_mask`` [S, V] bool, and static flags ``want_logprobs``/
+        ``use_penalty``/``use_eos_mask``), each scan step runs logit
+        controls → ops/sampling.sample_core → feed-back, and the call
+        returns ``(toks [n_steps, S], logprobs [n_steps, S], new_keys
+        [S, 2])`` in one host transfer."""
         kv = self._state_manager.kv_cache
         total_slots = kv.num_blocks * kv.block_size
-        key = ("fused", tokens.shape[0], block_table.shape[1], n_steps)
+        S, B = tokens.shape[0], block_table.shape[1]
+        if sampling is None:
+            key = ("fused", S, B, n_steps)
+            statics = {}
+        else:
+            statics = {"want_logprobs": bool(sampling["want_logprobs"]),
+                       "use_penalty": bool(sampling["use_penalty"]),
+                       "use_eos_mask": bool(sampling["use_eos_mask"])}
+            key = ("fused_sampled", S, B, n_steps,
+                   tuple(sorted(statics.items())))
         fn = self._fwd_cache.get(key)
         if fn is None:
-            kw = ({"out_shardings": (None, jax.tree_util.tree_map(
-                       lambda a: a.sharding, kv.cache))}
-                  if self._mesh_ctx is not None else {})
+            if self._mesh_ctx is not None:
+                cache_sh = jax.tree_util.tree_map(lambda a: a.sharding,
+                                                  kv.cache)
+                out_sh = ((None, cache_sh) if sampling is None
+                          else (None, None, None, cache_sh))
+                kw = {"out_shardings": out_sh}
+            else:
+                kw = {}
             fn = jax.jit(partial(_fused_decode_loop, config=self.config,
                                  block_size=self.kv_block_size,
                                  attn_backend=self.attn_backend,
@@ -409,15 +434,26 @@ class RaggedLlamaModel:
                                  kv_pad=self._kv_pad,
                                  total_slots=total_slots,
                                  n_steps=n_steps,
+                                 sample=sampling is not None,
+                                 **statics,
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
             self._fwd_cache[key] = fn
-        out, new_cache = fn(self.params, kv.cache, jnp.asarray(tokens),
-                            jnp.asarray(seq_lens), jnp.asarray(live),
-                            jnp.asarray(block_table))
+        args = (self.params, kv.cache, jnp.asarray(tokens),
+                jnp.asarray(seq_lens), jnp.asarray(live),
+                jnp.asarray(block_table))
+        if sampling is None:
+            out, new_cache = fn(*args)
+            kv.update(new_cache)
+            return np.asarray(out)
+        sargs = {k: (jnp.asarray(v) if v is not None else None)
+                 for k, v in sampling.items()
+                 if k not in ("want_logprobs", "use_penalty", "use_eos_mask")}
+        out, lps, new_keys, new_cache = fn(*args, **sargs)
         kv.update(new_cache)
-        return np.asarray(out)
+        out, lps, new_keys = jax.device_get((out, lps, new_keys))
+        return np.asarray(out), np.asarray(lps), np.asarray(new_keys)
 
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
@@ -711,23 +747,40 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
     return logits, ((cache_data, cache_scales) if kv_quant else cache_data)
 
 
-def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table, *,
+def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
+                       keys=None, temps=None, top_ks=None, top_ps=None,
+                       penalties=None, eos_ids=None, n_out=None, min_new=None,
+                       seen_mask=None, *,
                        config, block_size, attn_backend, tp_size, kv_pad,
-                       total_slots, n_steps, mesh):
+                       total_slots, n_steps, mesh, sample=False,
+                       want_logprobs=False, use_penalty=False,
+                       use_eos_mask=False):
     """K single-token ragged steps under one lax.scan: each iteration builds
     the pure-decode RaggedBatch **in-trace** (for one new token per sequence
     every field is a function of (block_table, seq_lens, tokens) — compare
     the host fast path in ``ragged_wrapper.py finalize``) and reuses
     ``_ragged_forward`` unchanged, so every model feature (GQA/ALiBi/windows/
-    MoE/int8-KV/TP) composes by construction. Greedy sampling in-program;
-    dead (padding) rows write to the OOB drop slot and never advance —
-    identical to how ``finalize`` pads short batches."""
+    MoE/int8-KV/TP) composes by construction. Dead (padding) rows write to
+    the OOB drop slot and never advance — identical to how ``finalize`` pads
+    short batches.
+
+    ``sample=False`` is the original greedy program (argmax in-program).
+    ``sample=True`` runs the on-device sampler per step (ops/sampling):
+    logit controls (repetition penalty over a carried [S, V] presence mask,
+    eos masking while ``n_out + step < min_new``) then
+    temperature/top-k/top-p Gumbel-max with one key split per row per step
+    — the identical op chain the batched per-token dispatch runs, so token
+    streams match the per-token path bit-for-bit under the same keys."""
     S, B = block_table.shape
     ar = jnp.arange(S, dtype=jnp.int32)
     live_i = live.astype(jnp.int32)
+    if sample:
+        from ...ops import sampling as dsamp
+        if not use_penalty:
+            seen_mask = jnp.zeros((S, 1), bool)  # dead carry, shape-stable
 
-    def body(carry, _):
-        cache, toks, lens = carry
+    def body(carry, step):
+        cache, toks, lens, keys, seen = carry
         slot = block_table[ar, lens // block_size] * block_size + lens % block_size
         slot = jnp.where(live_i > 0, slot, total_slots)  # padding → scatter drop
         batch = RaggedBatch(
@@ -739,11 +792,35 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table, *,
             params, cache, batch, config=config, block_size=block_size,
             attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
             mesh=mesh)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not sample:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lps = jnp.zeros(S, jnp.float32)
+        else:
+            ctrl = dsamp.apply_logit_controls(
+                logits,
+                seen_mask=seen if use_penalty else None,
+                penalties=penalties if use_penalty else None,
+                eos_ids=eos_ids if use_eos_mask else None,
+                block_eos=((n_out + step) < min_new) if use_eos_mask
+                else None)
+            nxt, lps, keys = dsamp.sample_core(
+                ctrl, keys, temps, top_ks, top_ps,
+                want_logprobs=want_logprobs)
         nxt = jnp.where(live_i > 0, nxt, toks)
+        if sample and use_penalty:
+            # the sampled token joins each row's history set before the
+            # next step — exactly the host-side mask rebuild the per-token
+            # path performs between dispatches
+            seen = seen.at[ar, nxt].set(True)
         lens = lens + live_i
-        return (cache, nxt, lens), nxt
+        return (cache, nxt, lens, keys, seen), (nxt, lps)
 
-    (cache, _, _), out = jax.lax.scan(body, (cache, tokens, seq_lens),
-                                      None, length=n_steps)
-    return out, cache
+    if not sample:
+        keys = jnp.zeros((S, 2), jnp.uint32)
+    carry0 = (cache, tokens, seq_lens, keys, seen_mask if sample
+              else jnp.zeros((S, 1), bool))
+    (cache, _, _, keys, _), (out, lps) = jax.lax.scan(
+        body, carry0, jnp.arange(n_steps, dtype=jnp.int32))
+    if not sample:
+        return out, cache
+    return out, lps, keys, cache

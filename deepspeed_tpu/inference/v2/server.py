@@ -44,7 +44,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .engine_v2 import InferenceEngineV2
+from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from .scheduling_utils import SchedulingError, SchedulingResult
 
@@ -174,6 +174,15 @@ class ServingScheduler:
         # analog) while sampled/controlled requests keep their per-token
         # SplitFuse tick in the same scheduler pass
         self._fused_window = int(fused_decode_window)
+        scfg = getattr(engine._config, "sampling", None)
+        # on-device sampling: eligible requests (no host logits_processor)
+        # sample in one batched device dispatch per tick, and — with
+        # fused_sampled_decode — ride the fused K-step program next to the
+        # greedy ones, so the fused partition is by FEASIBILITY
+        # (prefilled, pending==1, >= 2 tokens of room), not by greediness
+        self._device_sampling = bool(scfg and scfg.device_sampling)
+        self._fused_sampled = bool(self._device_sampling
+                                   and scfg.fused_sampled_decode)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._inbox: List[_Request] = []
@@ -219,11 +228,16 @@ class ServingScheduler:
         if speculative is not None:
             if speculative != "prompt_lookup":
                 raise ValueError(f"unknown speculative mode {speculative!r}")
-            if (temperature != 0.0 or min_new_tokens
-                    or repetition_penalty != 1.0
+            if (temperature != 0.0 or top_k or top_p != 1.0
+                    or min_new_tokens or repetition_penalty != 1.0
                     or logits_processor is not None or return_logprobs):
-                raise ValueError("speculative decoding is greedy-only and "
-                                 "does not compose with min_new_tokens/"
+                # ValueError → the HTTP handler's 400 (not a dead request):
+                # top_k/top_p are rejected here too — the greedy window
+                # verify compares raw argmax per position and cannot
+                # reproduce a filtered sampling distribution
+                raise ValueError("speculative decoding is greedy-only "
+                                 "(temperature=0, no top_k/top_p) and does "
+                                 "not compose with min_new_tokens/"
                                  "repetition_penalty/logits_processor/"
                                  "logprobs")
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
@@ -433,12 +447,15 @@ class ServingScheduler:
         prefills = [r for r in self._live if r.pending > 1]
         if (self._fused_window > 1 and decodes and not prefills
                 and not self._waiting and not self._inbox):
-            # steady state: fuse the PLAIN-GREEDY subset (K steps, one
-            # dispatch); sampled/controlled requests keep their per-token
-            # tick below — a mixed workload advances greedy users K tokens
-            # per tick without stalling anyone (each request's sampling
-            # depends only on its own context, so outputs are unchanged).
-            # A just-admitted 1-token-prompt request has pending==1 but NO
+            # steady state: fuse EVERY feasible decode (K steps, one
+            # dispatch) — plain-greedy requests and (when on-device
+            # sampling is enabled) sampled/controlled ones together; the
+            # partition is by feasibility, not greediness. Requests the
+            # device cannot own — speculative drafting and host
+            # logits_processor callbacks — keep their per-token tick below
+            # (each request's sampling depends only on its own context, so
+            # outputs are unchanged by who shares the dispatch). A
+            # just-admitted 1-token-prompt request has pending==1 but NO
             # engine sequence yet — it must take the per-token path, which
             # owns prefill (fused_decode_steps requires prefilled history).
             sm = self._engine._state_manager
@@ -447,12 +464,15 @@ class ServingScheduler:
                 seq = sm.get_sequence(r.uid)
                 return seq is not None and seq.seen_tokens > 0
 
-            greedy = [r for r in decodes
-                      if r.temperature == 0.0 and r.speculative is None
-                      and not r.return_logprobs and r.min_new_tokens == 0
-                      and r.repetition_penalty == 1.0
-                      and r.logits_processor is None and _prefilled(r)]
-            fused = self._fused_tick(greedy) if greedy else []
+            def _fusable(r):
+                if r.speculative is not None or not _prefilled(r):
+                    return False
+                if self._plain_greedy(r):
+                    return True
+                return self._fused_sampled and self._device_eligible(r)
+
+            eligible = [r for r in decodes if _fusable(r)]
+            fused = self._fused_tick(eligible) if eligible else []
             if fused:
                 # exclude exactly the requests the fused dispatch advanced;
                 # near-budget greedy stragglers the partition left out stay
@@ -511,18 +531,49 @@ class ServingScheduler:
         self._retire_finished()
         return True
 
+    @staticmethod
+    def _plain_greedy(r: _Request) -> bool:
+        """No sampling, no controls, no logprobs — the original argmax-only
+        fused program (and the zero-dispatch host argmax per-token path)."""
+        return (r.temperature == 0.0 and not r.return_logprobs
+                and r.min_new_tokens == 0 and r.repetition_penalty == 1.0
+                and r.logits_processor is None)
+
+    def _device_eligible(self, r: _Request) -> bool:
+        """Requests whose sampling/controls run on device (ops/sampling):
+        anything except a host ``logits_processor`` callback (host-only by
+        construction) or plain greedy (host argmax is already free)."""
+        return (self._device_sampling and r.logits_processor is None
+                and not self._plain_greedy(r))
+
+    @staticmethod
+    def _spec_for(r: _Request) -> "SampleSpec":
+        return SampleSpec(
+            temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+            repetition_penalty=r.repetition_penalty,
+            eos_token_id=r.eos_token_id,
+            block_eos=len(r.outputs) < r.min_new_tokens,
+            history=(r.prompt + r.outputs)
+            if r.repetition_penalty != 1.0 else None,
+            seed=r.seed, want_logprobs=r.return_logprobs,
+            n_out=len(r.outputs), min_new=r.min_new_tokens)
+
     def _fused_tick(self, decodes) -> list:
-        """K greedy steps for the fusable subset of the given (plain-greedy,
-        prefilled) decodes in ONE dispatch. Returns the list of requests the
-        fused dispatch actually advanced — empty when no subset can reach a
-        2-step window or KV pressure refuses the wave (the caller's
-        per-token tick owns eviction). The partition means a request within
-        one token of its budget rides the per-token path alone instead of
-        demoting the whole batch. Token accounting: the dispatch feeds each
-        fused request's pending token plus its K-1 first generations, so
-        ``fed += K`` restores the pending==1 decode invariant; requests
-        whose emit was cut short (eos/stop/max) retire this tick, exactly
-        the conditions _emit_many cut on."""
+        """K decode steps for the fusable subset of the given (prefilled,
+        device-ownable) decodes in ONE dispatch. An all-greedy wave runs
+        the original argmax program; a wave with any sampled/controlled
+        request runs the sampled scan program (greedy members are
+        temperature-0 rows of the same dispatch — argmax over identical
+        logits, so their streams don't change). Returns the list of
+        requests the fused dispatch actually advanced — empty when no
+        subset can reach a 2-step window or KV pressure refuses the wave
+        (the caller's per-token tick owns eviction). The partition means a
+        request within one token of its budget rides the per-token path
+        alone instead of demoting the whole batch. Token accounting: the
+        dispatch feeds each fused request's pending token plus its K-1
+        first generations, so ``fed += K`` restores the pending==1 decode
+        invariant; requests whose emit was cut short (eos/stop/max) retire
+        this tick, exactly the conditions _emit_many cut on."""
         fusable_uids, K, _solo = self._engine.fused_partition(
             [r.uid for r in decodes],
             [r.max_new_tokens - len(r.outputs) for r in decodes],
@@ -531,15 +582,25 @@ class ServingScheduler:
             return []
         fusable_set = set(fusable_uids)
         fused = [r for r in decodes if r.uid in fusable_set]
+        all_greedy = all(self._plain_greedy(r) for r in fused)
+        lps = None
         try:
-            toks = self._engine.fused_decode_steps(
-                [r.uid for r in fused],
-                [r.feed_slice(1)[0] for r in fused], K)
+            if all_greedy:
+                toks = self._engine.fused_decode_steps(
+                    [r.uid for r in fused],
+                    [r.feed_slice(1)[0] for r in fused], K)
+            else:
+                toks, lps = self._engine.fused_decode_steps(
+                    [r.uid for r in fused],
+                    [r.feed_slice(1)[0] for r in fused], K,
+                    specs=[self._spec_for(r) for r in fused])
         except SchedulingError:
             return []
-        for req, row in zip(fused, toks):
+        for i, (req, row) in enumerate(zip(fused, toks)):
             req.fed += K
-            self._emit_many(req, [int(t) for t in row])
+            self._emit_many(req, [int(t) for t in row],
+                            lps=[float(l) for l in lps[i]]
+                            if lps is not None else None)
             if not self._engine.decode_finished(
                     req.uid, req.outputs, req.max_new_tokens,
                     req.eos_token_id, req.stop):
@@ -594,6 +655,7 @@ class ServingScheduler:
                         SchedulingResult.KVCacheLimitExceeded)
                     self._finish(victim, flush=False)
                 return None
+        device_wave = []  # (req, logits_row) — one batched sample dispatch
         for req, chunk, row in zip(reqs, chunks, logits):
             d = drafted.get(req.uid, [])
             if d:
@@ -603,15 +665,35 @@ class ServingScheduler:
             else:
                 req.fed += len(chunk)
                 if req.pending == 0:  # feed complete: row is the next token
-                    self._emit(req, row[len(chunk) - 1]
-                               if use_window else row)
+                    last = row[len(chunk) - 1] if use_window else row
+                    if self._device_eligible(req):
+                        device_wave.append((req, last))
+                    else:
+                        self._emit(req, last)
             if use_window:
                 # window puts defer the trailing-window KV free for EVERY
                 # sequence in the batch — resume it here
                 seq = self._engine._state_manager.get_sequence(req.uid)
                 if seq is not None:
                     self._engine._model.maybe_free_kv(seq)
+        if device_wave:
+            self._emit_device(device_wave)
         return True
+
+    def _emit_device(self, wave) -> None:
+        """ONE batched on-device sampling dispatch for every device-eligible
+        row of a per-token tick (engine.sample_rows) — the N sampled
+        decodes of a tick cost one host round-trip, not N."""
+        toks, lps = self._engine.sample_rows(
+            [r.uid for r, _ in wave], [row for _, row in wave],
+            [self._spec_for(r) for r, _ in wave])
+        for (req, _), tok, lp in zip(wave, toks, lps):
+            if req.return_logprobs:
+                req.logprobs.append(float(lp))
+            if not req.outputs:
+                req.t_first = time.monotonic()
+            req.outputs.append(int(tok))
+            req.stream_q.put(int(tok))
 
     def _emit(self, req: _Request, logits_row) -> None:
         block_eos = len(req.outputs) < req.min_new_tokens
@@ -633,16 +715,19 @@ class ServingScheduler:
         req.outputs.append(int(tok))
         req.stream_q.put(int(tok))
 
-    def _emit_many(self, req: _Request, toks) -> None:
-        """Stream a verified draft run, applying the eos/stop/max cuts so
-        tokens past a cut never surface (generate()'s truncation rules;
-        the overshot KV needs no rollback — the request retires and
-        flushes)."""
-        for t in toks:
+    def _emit_many(self, req: _Request, toks, lps=None) -> None:
+        """Stream a verified draft run or fused window, applying the
+        eos/stop/max cuts so tokens past a cut never surface (generate()'s
+        truncation rules; the overshot KV needs no rollback — the request
+        retires and flushes)."""
+        for i, t in enumerate(toks):
             if len(req.outputs) >= req.max_new_tokens:
                 break
             if not req.outputs:
                 req.t_first = time.monotonic()
+            if req.return_logprobs:
+                req.logprobs.append(float(lps[i]) if lps is not None
+                                    else None)
             req.outputs.append(int(t))
             req.stream_q.put(int(t))
             if req.eos_token_id is not None and int(t) == req.eos_token_id:

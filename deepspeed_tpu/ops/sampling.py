@@ -1,0 +1,188 @@
+"""On-device token sampling for the v2 serving engine.
+
+The numpy sampler (``engine_v2.InferenceEngineV2._sample_with_logprob`` /
+``process_logits``) costs one host round-trip per generated token — on a
+relay-attached TPU that is ~100ms+ of pure dispatch latency per token, so
+any request with temperature/top-k/top-p/logprobs/repetition-penalty was
+excluded from the fused K-step decode path. This module is the same
+sampler expressed as jit-friendly jax ops, batched over the ragged row
+layout [S, vocab], so sampling runs inside the fused ``lax.scan`` decode
+program (and, for per-token ticks, as one batched dispatch per tick).
+
+Semantics mirror the numpy oracle EXACTLY (the oracle stays in engine_v2
+as the parity reference and the fallback for host-only
+``logits_processor`` callbacks):
+
+- ``temperature <= 0``: greedy over the RAW logits; logprob from the raw
+  softmax.
+- ``top_k``: kth-largest VALUE threshold (``np.partition`` semantics —
+  ties at the kth value survive); ``top_k <= 0`` or ``>= vocab`` disables.
+- ``top_p``: nucleus over the temperature-scaled, top-k-filtered logits;
+  ``cumsum(p) - p < top_p`` keep rule (the argmax always survives);
+  ``top_p <= 0`` degenerates to greedy over the filtered logits;
+  ``top_p >= 1`` disables.
+- sampling is Gumbel-max: ``argmax(logits + G)`` — filtered ``-inf``
+  entries can never win.
+- the selected-token logprob is computed on the FILTERED (renormalized)
+  distribution, like the oracle's ``lp_at``.
+- repetition penalty is the CTRL rule over the history SET (divide
+  positive logits by p, multiply negative ones), applied before
+  temperature — history arrives as a boolean presence mask [S, vocab] so
+  the in-scan update is one scatter per step.
+- eos masking (``min_new_tokens``) sets the eos column to ``-inf`` before
+  sampling, per row.
+
+Per-sequence determinism: each row carries its own ``jax.random`` key and
+every sample performs ``key, sub = split(key)`` then draws with ``sub`` —
+the threefry stream is a pure function of the initial key, so the
+per-token path and the fused K-step path produce bit-identical token
+streams under the same seed (the parity contract the scheduler relies on
+when it moves a request between paths).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import registry
+
+_NEG_INF = float("-inf")
+
+
+def apply_repetition_penalty(logits, seen_mask, penalties):
+    """CTRL repetition penalty, batched: where ``seen_mask`` is True,
+    positive logits divide by the row's penalty and negative ones multiply
+    (``process_logits`` parity). ``penalties == 1`` rows pass through
+    unchanged by construction. logits [S, V] f32, seen_mask [S, V] bool,
+    penalties [S] f32."""
+    p = penalties[:, None]
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(seen_mask, penalized, logits)
+
+
+def mask_eos(logits, eos_ids, block):
+    """Set the eos column to -inf per row where ``block`` is True
+    (min_new_tokens gating). ``eos_ids`` [S] int32 (< 0 = no eos id);
+    block [S] bool."""
+    cols = jnp.arange(logits.shape[-1], dtype=jnp.int32)[None, :]
+    hit = (cols == eos_ids[:, None]) & block[:, None] & (eos_ids >= 0)[:, None]
+    return jnp.where(hit, _NEG_INF, logits)
+
+
+def filter_top_k(logits, top_ks):
+    """kth-largest VALUE threshold per row (oracle ``np.partition``
+    semantics: ties at the kth value are kept). ``top_ks`` [S] int32;
+    ``<= 0`` or ``>= vocab`` disables the row's filter."""
+    V = logits.shape[-1]
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    kk = jnp.clip(top_ks, 1, V)
+    kth = jnp.take_along_axis(srt, (kk - 1)[:, None], axis=-1)  # [S, 1]
+    on = ((top_ks > 0) & (top_ks < V))[:, None]
+    return jnp.where(on & (logits < kth), _NEG_INF, logits)
+
+
+def filter_top_p(logits, top_ps):
+    """Nucleus filter per row: keep the smallest set of tokens whose
+    softmax mass reaches ``top_p`` (``cumsum(p) - p < top_p`` — the
+    highest-prob token always survives). Mirrors the oracle's tie order
+    exactly: stable ascending argsort, reversed. ``top_ps`` [S] f32;
+    rows with ``top_p <= 0`` or ``>= 1`` pass through (the degenerate
+    ``top_p <= 0`` greedy case is the caller's branch, as in the
+    oracle)."""
+    S, V = logits.shape
+    order = jnp.argsort(logits, axis=-1)[:, ::-1]  # oracle: argsort()[::-1]
+    srt = jnp.take_along_axis(logits, order, axis=-1)
+    p = jnp.exp(srt - srt[:, :1])  # srt[:,0] is the row max
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    keep_sorted = (jnp.cumsum(p, axis=-1) - p) < top_ps[:, None]
+    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
+    keep = jnp.zeros((S, V), bool).at[rows, order].set(keep_sorted)
+    on = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
+    return jnp.where(on & ~keep, _NEG_INF, logits)
+
+
+def selected_logprob(logits, toks):
+    """log p(tok) under softmax(logits), per row — correct on filtered
+    (-inf) logits: ``exp(-inf - m)`` is 0, so the mass renormalizes over
+    the surviving set (oracle ``lp_at``)."""
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    picked = jnp.take_along_axis(logits, toks[:, None], axis=-1)[:, 0]
+    return picked - lse
+
+
+def sample_core(logits, keys, temps, top_ks, top_ps, *, want_logprobs):
+    """One sampling step over a batch of rows — the shared core of the
+    per-token dispatch and the fused decode scan.
+
+    logits [S, V] (any float dtype; promoted to f32), keys [S, 2] uint32
+    (one legacy PRNG key per row), temps/top_ps [S] f32, top_ks [S] int32.
+    Returns ``(toks [S] int32, logprobs [S] f32, new_keys [S, 2])`` —
+    logprobs are zeros when ``want_logprobs`` is False (statically skips
+    the extra logsumexp). Every row advances its key by exactly one
+    ``split`` whether it samples or not — key-chain parity between paths
+    does not depend on which rows happened to be greedy."""
+    raw = logits.astype(jnp.float32)
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [S, 2, 2]
+    new_keys, subs = split[:, 0], split[:, 1]
+
+    temps_safe = jnp.where(temps > 0, temps, 1.0)
+    scaled = raw / temps_safe[:, None]
+    filt = filter_top_p(filter_top_k(scaled, top_ks), top_ps)
+
+    g = jax.vmap(
+        lambda k: jax.random.gumbel(k, (raw.shape[-1],), jnp.float32))(subs)
+    tok_sampled = jnp.argmax(filt + g, axis=-1).astype(jnp.int32)
+    tok_greedy = jnp.argmax(raw, axis=-1).astype(jnp.int32)
+    # oracle: top_p <= 0 is degenerate nucleus = greedy over the
+    # scaled+top-k-filtered logits
+    tok_degenerate = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+
+    greedy = temps <= 0
+    degenerate = (~greedy) & (top_ps <= 0.0)
+    toks = jnp.where(greedy, tok_greedy,
+                     jnp.where(degenerate, tok_degenerate, tok_sampled))
+    if want_logprobs:
+        lp_src = jnp.where(greedy[:, None], raw, filt)
+        lps = selected_logprob(lp_src, toks)
+    else:
+        lps = jnp.zeros(raw.shape[0], jnp.float32)
+    return toks, lps, new_keys
+
+
+def apply_logit_controls(logits, *, seen_mask=None, penalties=None,
+                         eos_ids=None, block_eos=None):
+    """Pre-sampling logit controls (``process_logits`` parity): repetition
+    penalty over the history presence mask, then eos masking. Pass None to
+    statically skip a control."""
+    logits = logits.astype(jnp.float32)
+    if seen_mask is not None:
+        logits = apply_repetition_penalty(logits, seen_mask, penalties)
+    if block_eos is not None:
+        logits = mask_eos(logits, eos_ids, block_eos)
+    return logits
+
+
+@functools.partial(jax.jit, static_argnames=("want_logprobs", "use_penalty",
+                                             "use_eos_mask"))
+def sample_step(logits, keys, temps, top_ks, top_ps, seen_mask, penalties,
+                eos_ids, block_eos, *, want_logprobs, use_penalty,
+                use_eos_mask):
+    """Jitted controls + sample for one batched per-token dispatch. Unused
+    control operands may be passed as None (they are statically elided by
+    the flags, which are part of the compile key)."""
+    ctrl = apply_logit_controls(
+        logits,
+        seen_mask=seen_mask if use_penalty else None,
+        penalties=penalties if use_penalty else None,
+        eos_ids=eos_ids if use_eos_mask else None,
+        block_eos=block_eos if use_eos_mask else None)
+    return sample_core(ctrl, keys, temps, top_ks, top_ps,
+                       want_logprobs=want_logprobs)
+
+
+registry.register("sampling", "xla", True,
+                  "on-device temperature/top-k/top-p sampling + logit "
+                  "controls (fused-decode resident; numpy oracle retained "
+                  "for logits_processor callbacks)")
