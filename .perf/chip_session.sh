@@ -161,6 +161,10 @@ run bench_serving_moe 1500 env DS_BENCH_MOE=1 DS_BENCH_FAST=1 python bench_servi
 # non-greedy batch — the dispatch-amortization evidence for the workload
 # the fused path newly covers
 run bench_serving_sampled 1500 env DS_BENCH_SAMPLED=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_SAMPLED.json
+# 15g. overload shedding A/B: 2x admission capacity with the shed policy
+# off vs on — goodput, shed rate, p99 TTFT (the resilience layer's
+# keep-latency-under-saturation evidence)
+run bench_serving_overload 1200 env DS_BENCH_OVERLOAD=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_OVERLOAD.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

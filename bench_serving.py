@@ -80,6 +80,16 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                                        n_requests=16 if on_tpu else 6,
                                        ctx=contexts[0] // 2,
                                        new_tokens=decode_steps))
+    # DS_BENCH_OVERLOAD=1: 2x the daemon's admission capacity with the
+    # load-shed policy off vs on — goodput, shed rate and p99 TTFT are the
+    # evidence that shedding the excess (HTTP 429) keeps the served
+    # subset's latency instead of letting the queue absorb everything
+    if env_flag("DS_BENCH_OVERLOAD"):
+        results.extend(_measure_overload(cfg, kv_block, backends[0],
+                                         n_capacity=8 if on_tpu else 3,
+                                         ctx=contexts[0] // 2
+                                         if on_tpu else 64,
+                                         new_tokens=decode_steps))
     # DS_BENCH_MOE=1: Mixtral-style expert-parallel decode through the v2
     # engine (ops/grouped_matmul in the ragged forward) — tok/s +
     # decode_step_ms like the dense rungs, so MoE serving regressions are
@@ -439,6 +449,82 @@ def _measure_daemon(cfg, kv_block, backend, n_requests, ctx, new_tokens):
         "ttft_mean_s": stats.get("ttft_mean_s"),
         "decode_tok_s_mean": stats.get("decode_tok_s_mean"),
     }]
+
+
+def _measure_overload(cfg, kv_block, backend, n_capacity, ctx, new_tokens):
+    """Overload behavior: 2x ``n_capacity`` requests hit a scheduler whose
+    KV cache fits ~``n_capacity`` concurrent sequences, with the shed
+    policy off (every request queues — pre-resilience behavior) vs on
+    (excess rejected at submit with SchedulerOverloaded / HTTP 429).
+    Reports goodput (completed tokens per wall second), shed rate, and
+    p99 TTFT over the requests that were actually served."""
+    import threading
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (ServingScheduler,
+                                            SchedulerOverloaded,
+                                            build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    rng = np.random.default_rng(31)
+    n_total = 2 * n_capacity
+    prompts = [rng.integers(0, cfg.vocab_size, size=ctx).tolist()
+               for _ in range(n_total)]
+    rows = []
+    for shed in (False, True):
+        eng = build_llama_engine(
+            cfg, engine_config=RaggedInferenceEngineConfig(
+                num_kv_blocks=(n_capacity + 1)
+                * ((ctx + new_tokens) // kv_block + 2),
+                serving_resilience={
+                    # the backlog bound is HALF capacity so the 2x wave
+                    # actually sheds instead of just queueing deeper
+                    "max_queued": max(1, n_capacity // 2) if shed else 0,
+                    "retry_after_s": 1.0}),
+            kv_block_size=kv_block)
+        eng.model().attn_backend = backend
+        eng.generate([prompts[0], prompts[1]], max_new_tokens=2)
+        bss = [b for b in (1, 2, 4, 8, 16, 32) if b <= n_capacity]
+        eng.warmup(prefill_lens=(), batch_sizes=bss, fused_windows=(16, ),
+                   decode_context=ctx)
+        sched = ServingScheduler(eng, idle_wait=0.001).start()
+        done, lock, shed_n = [], threading.Lock(), [0]
+
+        def client(i):
+            try:
+                h = sched.submit(prompts[i], max_new_tokens=new_tokens)
+            except SchedulerOverloaded:
+                with lock:
+                    shed_n[0] += 1
+                return
+            try:
+                h.result(600)
+            except Exception:
+                return
+            with lock:
+                done.append(h)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i, ))
+                   for i in range(n_total)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        dt = time.perf_counter() - t0
+        sched.stop()
+        ttfts = sorted(h._req.t_first - h._req.t_submit
+                       for h in done if h._req.t_first)
+        p99 = (ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+               if ttfts else None)
+        rows.append({
+            "backend": backend, "context": ctx, "overload": True,
+            "shedding": shed, "requests": n_total,
+            "completed": len(done),
+            "shed_rate": round(shed_n[0] / n_total, 3),
+            "goodput_tok_s": round(
+                sum(len(h._req.outputs) for h in done) / dt, 2),
+            "p99_ttft_s": round(p99, 3) if p99 is not None else None,
+            "wall_s": round(dt, 2)})
+    return rows
 
 
 def _measure_prefix_caching(cfg, ctx, kv_block, backend):

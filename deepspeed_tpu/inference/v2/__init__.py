@@ -6,8 +6,10 @@ Dynamic SplitFuse scheduling semantics (``can_schedule``/``query``).
 """
 
 from .config_v2 import (RaggedInferenceEngineConfig, DSStateManagerConfig,
-                        KVCacheConfig, SamplingConfig)
-from .scheduling_utils import SchedulingResult, SchedulingError
+                        KVCacheConfig, SamplingConfig,
+                        ServingResilienceConfig)
+from .scheduling_utils import (SchedulingResult, SchedulingError,
+                               DeadlineExceeded, SchedulerOverloaded)
 from .engine_v2 import (InferenceEngineV2, SampleSpec, build_llama_engine,
                         load_engine)
 from .server import ServingScheduler, RequestHandle, serve

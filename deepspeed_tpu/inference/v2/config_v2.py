@@ -89,6 +89,72 @@ class SamplingConfig(ConfigModel):
     keeps fused dispatch greedy-only (pre-sampling behavior)."""
 
 
+class ServingResilienceConfig(ConfigModel):
+    """Serving-side fault tolerance (the MII front end's analog of the
+    training ``resilience`` block): request deadlines, overload shedding,
+    and scheduler crash isolation. Defaults are safe — the fault boundary
+    (tick retry + request quarantine) is on, every policy that can refuse
+    or expire a request is off until sized for a deployment."""
+
+    enabled: bool = True
+    """Master gate. False restores the pre-resilience scheduler exactly:
+    no deadlines, no shedding, no tick retry/quarantine, no watchdog."""
+
+    default_deadline_s: Optional[float] = None
+    """End-to-end deadline applied to requests that don't pass their own
+    ``deadline_s``. Expired requests (queued OR mid-decode) finish with
+    ``DeadlineExceeded`` (HTTP 504) and release their KV. None = no
+    default deadline."""
+
+    default_queue_ttl_s: Optional[float] = None
+    """Max time a request may wait UNADMITTED before it expires with
+    ``DeadlineExceeded`` — bounds queue staleness under backlog without
+    capping decode time. None = queued requests wait indefinitely."""
+
+    max_queued: int = 0
+    """Load shedding: reject ``submit()`` with ``SchedulerOverloaded``
+    (HTTP 429 + Retry-After) once this many requests sit unadmitted.
+    0 = unbounded queue (pre-resilience behavior)."""
+
+    max_queued_tokens: int = 0
+    """Shed on total queued PROMPT tokens instead of / in addition to
+    request count (a few huge prompts can be as heavy as many small
+    ones). A request never sheds against an empty queue, so one
+    over-sized prompt still gets its admission attempt. 0 = off."""
+
+    retry_after_s: float = 1.0
+    """Client back-off hint carried by ``SchedulerOverloaded`` and the
+    HTTP 429 ``Retry-After`` header."""
+
+    max_stream_backlog: int = 256
+    """Bound on each STREAMING request's undelivered-token queue: a
+    consumer that stops draining (disconnected client) gets the request
+    cancelled once this many tokens pile up, instead of growing host
+    memory without bound. Non-streaming submits are exempt (nothing
+    drains their queue by design). 0 = unbounded."""
+
+    tick_retries: int = 2
+    """Transient-fault budget of the per-tick boundary: a failing
+    scheduler tick is retried this many times (with backoff) before the
+    fault is treated as reproducible and bisected to the poisoning
+    request."""
+
+    tick_retry_backoff_s: float = 0.05
+    """Base delay of the tick retry backoff (doubles per attempt)."""
+
+    watchdog_s: float = 0.0
+    """Stuck-tick detector: with work in flight and no scheduler progress
+    for this long, ``/health`` flips to ``degraded`` (503) carrying the
+    last-progress age; it recovers automatically when ticks resume.
+    0 = watchdog off."""
+
+    http_timeout_s: float = 600.0
+    """Cap on how long a blocking HTTP thread waits on one request (the
+    non-streaming ``result()`` and per-token stream gaps). A hung
+    scheduler then returns 504 instead of pinning HTTP threads forever;
+    requests with a deadline use the tighter of the two."""
+
+
 class QuantizationConfig(ConfigModel):
     quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
 
@@ -103,6 +169,8 @@ class RaggedInferenceEngineConfig(ConfigModel):
     state_manager: DSStateManagerConfig = Field(default_factory=DSStateManagerConfig)
     quantization: QuantizationConfig = Field(default_factory=QuantizationConfig)
     sampling: SamplingConfig = Field(default_factory=SamplingConfig)
+    serving_resilience: ServingResilienceConfig = Field(
+        default_factory=ServingResilienceConfig)
 
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).

@@ -17,6 +17,7 @@ import numpy as np
 import jax
 
 from ...models.llama import LlamaConfig, init_llama
+from ...utils.fault_injection import InjectedFault, get_fault_injector
 from .config_v2 import RaggedInferenceEngineConfig
 from .model import RaggedLlamaModel
 from .ragged.ragged_manager import DSStateManager
@@ -46,6 +47,24 @@ class SampleSpec:
     want_logprobs: bool = False
     n_out: int = 0
     min_new: int = 0
+
+
+def _fire_request_poison(uids) -> None:
+    """``serve.request_poison`` fault site: a configured request uid makes
+    ANY device dispatch whose batch contains it raise — per-token put,
+    windowed verify, and fused scan alike — the deterministic stand-in for
+    "this request's shape/content wedges the engine". The raise happens
+    before any engine state mutates, so co-batched sequences stay intact.
+    Inert (not even visit-counted) unless a fault plan is installed."""
+    inj = get_fault_injector()
+    if not inj.enabled:
+        return
+    uids = list(uids)
+    args = inj.fire("serve.request_poison", uids=uids)
+    if args is not None:
+        uid = args.get("uid")
+        if uid is None or uid in uids:
+            raise InjectedFault(f"injected poison in request {uid}")
 
 
 class InferenceEngineV2:
@@ -105,6 +124,7 @@ class InferenceEngineV2:
         chain must never enter the cache; its blocks are overwritten in
         place)."""
         batch_uids = list(batch_uids)
+        _fire_request_poison(batch_uids)
         batch_tokens = [np.asarray(t, dtype=np.int32).reshape(-1) for t in batch_tokens]
 
         if do_checks:
@@ -617,6 +637,7 @@ class InferenceEngineV2:
         each sequence's PRNG key advanced by exactly ``n_steps`` splits
         (the same count the per-token path would burn)."""
         batch_uids = list(batch_uids)
+        _fire_request_poison(batch_uids)
         seqs = []
         for uid in batch_uids:
             seq = self._state_manager.get_sequence(uid)

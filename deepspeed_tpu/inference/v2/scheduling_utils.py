@@ -17,3 +17,19 @@ class SchedulingError(RuntimeError):
     def __init__(self, result: SchedulingResult):
         self.status = result
         super().__init__(f"Scheduling failed: {result}")
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request ran past its ``deadline_s`` / ``queue_ttl_s``: the
+    scheduler error-finishes it and releases its KV reservation. The HTTP
+    front end maps this to 504."""
+
+
+class SchedulerOverloaded(RuntimeError):
+    """Admission refused by the load-shed policy: the queue sits at
+    ``max_queued`` / ``max_queued_tokens``. The HTTP front end maps this
+    to 429 with a ``Retry-After`` header of ``retry_after_s``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(msg)

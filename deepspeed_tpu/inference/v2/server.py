@@ -31,6 +31,16 @@ TPU-native and stdlib-only:
 Single-threaded device access: ONLY the scheduler thread touches the
 engine. ``submit``/``cancel`` just enqueue under a lock and set an event,
 so arbitrarily many HTTP threads are safe.
+
+Resilience (``serving_resilience`` config block, see config_v2.py):
+per-request deadlines/TTL expire with a typed :class:`DeadlineExceeded`
+(HTTP 504) and release their KV; bounded queues shed at ``submit()``
+with :class:`SchedulerOverloaded` (HTTP 429 + Retry-After); a per-tick
+fault boundary retries transient engine errors and bisects a
+reproducible fault down to the one poisoning request (error-finishing
+only it — the loop survives); a watchdog flips ``/health`` to
+``degraded`` when ticks stall. All deterministic-testable through the
+``serve.*`` sites of ``utils/fault_injection.py``.
 """
 
 import itertools
@@ -44,9 +54,14 @@ from typing import List, Optional
 
 import numpy as np
 
+from ...utils.fault_injection import InjectedFault, get_fault_injector
+from ...utils.logging import logger
+from ...utils.retry import RetriesExhausted, retry_with_backoff
+from .config_v2 import ServingResilienceConfig
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
-from .scheduling_utils import SchedulingError, SchedulingResult
+from .scheduling_utils import (DeadlineExceeded, SchedulerOverloaded,
+                               SchedulingError, SchedulingResult)
 
 _END = object()  # stream sentinel
 
@@ -78,6 +93,11 @@ class _Request:
     cancelled: bool = False
     error: Optional[BaseException] = None
     rng: Optional[np.random.Generator] = None
+    # resilience state
+    t_deadline: Optional[float] = None        # monotonic; queue + decode
+    t_queue_deadline: Optional[float] = None  # monotonic; unadmitted only
+    wake: Optional[threading.Event] = None    # cancel() nudges the loop
+    queued: bool = False  # counted in the shed-policy accounting
     # metrics timeline (time.monotonic)
     t_submit: float = 0.0
     t_first: float = 0.0
@@ -142,6 +162,10 @@ class RequestHandle:
 
     def cancel(self) -> None:
         self._req.cancelled = True
+        if self._req.wake is not None:
+            # wake an idle loop NOW: the sweep frees this request's KV
+            # before the next admission pass instead of after idle_wait
+            self._req.wake.set()
 
     @property
     def finished(self) -> bool:
@@ -195,6 +219,23 @@ class ServingScheduler:
         # submit()..._finish() span, maintained under _lock: queue-membership
         # checks can race the loop's unlocked transfers, this count cannot
         self._active = 0
+        rcfg = getattr(engine._config, "serving_resilience", None)
+        self._res: ServingResilienceConfig = (
+            rcfg if rcfg is not None else ServingResilienceConfig())
+        # shed-policy accounting: unadmitted requests / their prompt tokens,
+        # maintained under _lock so submit() can refuse without touching the
+        # scheduler thread's queues
+        self._queued_n = 0
+        self._queued_tokens = 0
+        self._degraded = False
+        self._last_progress = time.monotonic()
+        self._watchdog: Optional[threading.Thread] = None
+        # resilience event counters (mutations: scheduler thread, except
+        # "shed" which submit() bumps under _lock; stats/trace snapshot
+        # under the same lock)
+        self._trace = {"shed": 0, "expired_queue": 0, "expired_live": 0,
+                       "tick_errors": 0, "quarantined": [],
+                       "watchdog_trips": 0, "slow_consumer_cancels": 0}
         # last-256 completed requests for the metrics aggregates
         from collections import deque
         self._completed: "deque" = deque(maxlen=256)
@@ -219,7 +260,16 @@ class ServingScheduler:
                speculative: Optional[str] = None,
                num_draft_tokens: int = 4,
                draft_ngram: int = 2,
-               return_logprobs: bool = False) -> RequestHandle:
+               return_logprobs: bool = False,
+               deadline_s: Optional[float] = None,
+               queue_ttl_s: Optional[float] = None,
+               stream: bool = False) -> RequestHandle:
+        """``deadline_s``: end-to-end budget (queue + decode) after which
+        the request finishes with :class:`DeadlineExceeded`; ``queue_ttl_s``
+        bounds only the unadmitted wait. Both default from the
+        ``serving_resilience`` config. ``stream=True`` marks the caller as
+        a ``stream()`` consumer: its token queue is bounded by
+        ``max_stream_backlog`` and stops the request if never drained."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -255,14 +305,41 @@ class ServingScheduler:
                        return_logprobs=bool(return_logprobs))
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
+        req.wake = self._wake
+        res = self._res
+        if res.enabled:
+            if deadline_s is None:
+                deadline_s = res.default_deadline_s
+            if queue_ttl_s is None:
+                queue_ttl_s = res.default_queue_ttl_s
+            if stream and res.max_stream_backlog > 0:
+                req.stream_q = queue.Queue(maxsize=int(res.max_stream_backlog))
+        if deadline_s is not None:
+            req.t_deadline = req.t_submit + float(deadline_s)
+        if queue_ttl_s is not None:
+            req.t_queue_deadline = req.t_submit + float(queue_ttl_s)
         with self._lock:
             # the lock orders this against stop()'s drain: a submit that
             # loses the race lands AFTER _stopping is visible and is
             # rejected here rather than queued for a loop that never runs
             if self._stopping or self._draining:
                 raise RuntimeError("scheduler is stopped")
+            if res.enabled and (
+                    (res.max_queued
+                     and self._queued_n >= res.max_queued)
+                    or (res.max_queued_tokens and self._queued_n
+                        and (self._queued_tokens + len(prompt)
+                             > res.max_queued_tokens))):
+                self._trace["shed"] += 1
+                raise SchedulerOverloaded(
+                    f"queue full ({self._queued_n} requests, "
+                    f"{self._queued_tokens} prompt tokens queued)",
+                    retry_after_s=res.retry_after_s)
             self._inbox.append(req)
             self._active += 1
+            req.queued = True
+            self._queued_n += 1
+            self._queued_tokens += len(prompt)
         self._wake.set()
         return RequestHandle(req)
 
@@ -271,10 +348,24 @@ class ServingScheduler:
         with self._lock:
             inbox = len(self._inbox)
             done = list(self._completed)  # (t_submit, t_first, t_done, n)
+            queued_tokens = self._queued_tokens
+            tr = self._trace
+            shed, quarantined = tr["shed"], len(tr["quarantined"])
+            expired = tr["expired_queue"] + tr["expired_live"]
+            watchdog_trips = tr["watchdog_trips"]
         out = {"waiting": len(self._waiting) + inbox,
                "live": len(self._live),
                "free_blocks": self._engine.free_blocks,
                "stopped": self._stopping,
+               "draining": self._draining,
+               "degraded": self._degraded,
+               "last_progress_age_s": round(
+                   time.monotonic() - self._last_progress, 3),
+               "queued_tokens": queued_tokens,
+               "shed": shed,
+               "expired": expired,
+               "quarantined": quarantined,
+               "watchdog_trips": watchdog_trips,
                "completed": len(done)}
         done = [d for d in done if d[3] > 0]
         if done:
@@ -288,15 +379,46 @@ class ServingScheduler:
                 out["decode_tok_s_mean"] = round(sum(rates) / len(rates), 2)
         return out
 
+    @property
+    def trace(self) -> dict:
+        """Resilience event counters (tests assert on these): ``shed``,
+        ``expired_queue``/``expired_live``, ``tick_errors``, the ordered
+        ``quarantined`` uid list, ``watchdog_trips``,
+        ``slow_consumer_cancels``."""
+        with self._lock:
+            return {k: (list(v) if isinstance(v, list) else v)
+                    for k, v in self._trace.items()}
+
+    def wait_timeout(self, handle: RequestHandle) -> Optional[float]:
+        """Bound for a blocking wait on one request (the HTTP threads'
+        ``result()`` / per-token stream gap): the remaining deadline when
+        the request has one (plus slack for the expiry sweep to run), else
+        the ``http_timeout_s`` cap. None only with resilience disabled —
+        the legacy unbounded wait."""
+        res = self._res
+        cap = res.http_timeout_s if res.enabled else None
+        t_deadline = handle._req.t_deadline
+        if t_deadline is not None:
+            remaining = max(0.05, t_deadline - time.monotonic()
+                            + 4 * self._idle_wait + 1.0)
+            return min(remaining, cap) if cap is not None else remaining
+        return cap
+
     # ---- lifecycle ----
 
     def start(self) -> "ServingScheduler":
         assert self._thread is None, "scheduler already started"
         self._stopping = False
         self._draining = False
+        self._degraded = False
+        self._last_progress = time.monotonic()
         self._thread = threading.Thread(target=self._run, name="ds-serve",
                                         daemon=True)
         self._thread.start()
+        if self._res.enabled and self._res.watchdog_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="ds-serve-watchdog", daemon=True)
+            self._watchdog.start()
         return self
 
     def stop(self, timeout: float = 30.0, drain: bool = False) -> None:
@@ -320,12 +442,18 @@ class ServingScheduler:
         if self._thread is not None:
             self._thread.join(max(0.0, deadline - time.monotonic()) or 0.01)
             self._thread = None
+        if self._watchdog is not None:
+            # joined so a later start() can't race a stale watchdog seeing
+            # the reset _stopping flag and living on as a duplicate
+            self._watchdog.join(1.5)
+            self._watchdog = None
 
     def _run(self) -> None:
         crash: Optional[BaseException] = None
         try:
             while not self._stopping:
-                progressed = self.step()
+                progressed = self._safe_step()
+                self._last_progress = time.monotonic()
                 if not progressed:
                     self._wake.wait(self._idle_wait)
                     self._wake.clear()
@@ -355,11 +483,29 @@ class ServingScheduler:
         """One continuous-batching iteration: admit + prefill newly feasible
         prompts, advance every live sequence one decode token. Returns
         whether any work happened (False = fully idle)."""
+        inj = get_fault_injector()
+        if inj.enabled:
+            args = inj.fire("serve.tick_hang")
+            if args is not None:
+                time.sleep(float(args.get("seconds", 0.5)))
+            if inj.fire("serve.tick_error") is not None:
+                raise InjectedFault("injected serving tick error")
         with self._lock:
             if self._inbox:
                 self._waiting.extend(self._inbox)
                 self._inbox = []
 
+        # cancelled LIVE rows free their engine state HERE, before this
+        # tick's admission — a cancel storm's blocks are available to
+        # _admit in the same step instead of one tick later
+        self._sweep_cancelled()
+        self._expire_deadlines()
+
+        admitted = self._admit()
+        advanced = self._advance_tick()
+        return bool(admitted or advanced)
+
+    def _sweep_cancelled(self) -> None:
         for req in [r for r in self._live if r.cancelled]:
             self._live.remove(req)
             self._finish(req)
@@ -367,9 +513,122 @@ class ServingScheduler:
             self._waiting.remove(req)
             self._finish(req, flush=False)
 
-        admitted = self._admit()
-        advanced = self._advance_tick()
-        return bool(admitted or advanced)
+    def _expire_deadlines(self) -> None:
+        """Finish requests past their deadline/TTL with a typed
+        ``DeadlineExceeded``. Queued requests expire on either bound
+        without ever touching the engine; live ones expire on the
+        end-to-end deadline and flush, releasing their KV reservation."""
+        if not self._res.enabled:
+            return
+        now = time.monotonic()
+
+        def _past(t: Optional[float]) -> bool:
+            return t is not None and now > t
+
+        for req in [r for r in self._waiting
+                    if _past(r.t_queue_deadline) or _past(r.t_deadline)]:
+            self._waiting.remove(req)
+            req.error = DeadlineExceeded(
+                f"request {req.uid} expired unadmitted after "
+                f"{now - req.t_submit:.3f}s")
+            self._trace["expired_queue"] += 1
+            self._finish(req, flush=False)
+        for req in [r for r in self._live if _past(r.t_deadline)]:
+            self._live.remove(req)
+            req.error = DeadlineExceeded(
+                f"request {req.uid} exceeded its deadline after "
+                f"{now - req.t_submit:.3f}s ({len(req.outputs)} tokens)")
+            self._trace["expired_live"] += 1
+            self._finish(req)  # flush=True: KV reservation released
+
+    def _safe_step(self) -> bool:
+        """One tick behind the fault boundary. Transient engine errors are
+        retried with backoff; a fault that survives the retry budget is
+        reproducible and gets bisected to the one poisoning request, which
+        alone is error-finished — the loop survives. Only a fault that
+        reproduces with NO live requests (engine-global breakage with
+        nothing to quarantine) still propagates to _run, whose drain
+        error-finishes every blocked caller."""
+        res = self._res
+        if not res.enabled:
+            return self.step()
+
+        def _tick():
+            try:
+                return self.step()
+            except Exception:
+                self._trace["tick_errors"] += 1
+                raise
+
+        try:
+            return retry_with_backoff(
+                _tick, retries=1 + max(0, res.tick_retries),
+                base_delay=res.tick_retry_backoff_s,
+                exceptions=(Exception, ), desc="serving tick")
+        except RetriesExhausted as e:
+            self._quarantine(e.__cause__ if e.__cause__ is not None else e)
+            return True
+
+    def _quarantine(self, exc: BaseException) -> None:
+        """Isolate the request that poisons the tick. The fault outlived
+        its retry budget, so it is reproducible: bisect the live wave —
+        tick one half with the other parked, keep whichever half still
+        reproduces the fault — until one request remains, and error-finish
+        only it. A probe IS a regular tick over a subset, so healthy
+        requests advance their (deterministic) decode during the search;
+        at most O(log n) extra probe ticks run."""
+        suspects = list(self._live)
+        if not suspects:
+            raise exc
+        while len(suspects) > 1:
+            test = suspects[:len(suspects) // 2]
+            rest = suspects[len(suspects) // 2:]
+            parked = [r for r in self._live if r not in test]
+            self._live = [r for r in self._live if r in test]
+            try:
+                self._advance_tick()
+                nxt = rest  # test half ticked clean: culprit is elsewhere
+            except Exception:  # noqa: BLE001 — any repro narrows the hunt
+                nxt = test
+            finally:
+                self._live.extend(parked)
+            # a probe tick may have retired suspects (eos/eviction): keep
+            # only the ones still live — an empty set means the fault
+            # dissolved and the next regular tick proceeds normally
+            suspects = [r for r in nxt if r in self._live]
+            if not suspects:
+                return
+        culprit = suspects[0]
+        if culprit in self._live:
+            self._live.remove(culprit)
+        culprit.error = exc
+        self._trace["quarantined"].append(culprit.uid)
+        logger.warning(f"[serving] quarantined request {culprit.uid} after "
+                       f"reproducible tick fault: {exc!r}")
+        self._finish(culprit)  # flush=True: its KV reservation is released
+
+    def _watch(self) -> None:
+        """Watchdog thread: with work in flight and no tick progress for
+        ``watchdog_s``, flip /health to degraded (carrying the
+        last-progress age); clear it when the loop moves again."""
+        period = max(0.02, min(self._res.watchdog_s / 4, 0.5))
+        while not self._stopping:
+            time.sleep(period)
+            with self._lock:
+                busy = self._active > 0
+            age = time.monotonic() - self._last_progress
+            if busy and age > self._res.watchdog_s:
+                if not self._degraded:
+                    self._degraded = True
+                    with self._lock:
+                        self._trace["watchdog_trips"] += 1
+                    logger.warning(f"[serving-watchdog] no scheduler "
+                                   f"progress for {age:.2f}s with work in "
+                                   "flight; /health degraded")
+            elif self._degraded:
+                self._degraded = False
+                logger.warning("[serving-watchdog] scheduler progressing "
+                               "again; /health restored")
 
     # Admission reservation MIRRORS InferenceEngineV2.generate: blocks for
     # the full feed + decode budget of every admitted AND live sequence,
@@ -413,6 +672,7 @@ class ServingScheduler:
             self._waiting.remove(req)
             req.fed = 0
             self._live.append(req)
+            self._queue_drop(req)
             admitted.append(req)
         if not admitted and not self._live and self._waiting:
             # nothing can reserve full headroom: admit ONE on feed
@@ -425,6 +685,7 @@ class ServingScheduler:
                 self._waiting.pop(0)
                 req.fed = 0
                 self._live.append(req)
+                self._queue_drop(req)
                 admitted.append(req)
             else:
                 # nothing is live, so nothing will ever free up: this
@@ -434,6 +695,23 @@ class ServingScheduler:
                 self._waiting.remove(req)
                 self._finish(req, flush=False)
         return admitted
+
+    def _queue_drop(self, req: _Request) -> None:
+        """Request left the unadmitted set (admitted; finishes drop inside
+        _finish's own lock section)."""
+        with self._lock:
+            if req.queued:
+                req.queued = False
+                self._queued_n -= 1
+                self._queued_tokens -= len(req.prompt)
+
+    def _queue_readd(self, req: _Request) -> None:
+        """Eviction sent a live request back to the waiting queue."""
+        with self._lock:
+            if not req.queued:
+                req.queued = True
+                self._queued_n += 1
+                self._queued_tokens += len(req.prompt)
 
     def _advance_tick(self) -> bool:
         """ONE ragged forward of ≤ token_budget tokens (Dynamic SplitFuse):
@@ -648,6 +926,7 @@ class ServingScheduler:
                 victim.fed = 0
                 if self._live:
                     self._waiting.insert(0, victim)
+                    self._queue_readd(victim)
                 elif victim.outputs:
                     self._finish(victim, flush=False)
                 else:
@@ -680,6 +959,27 @@ class ServingScheduler:
             self._emit_device(device_wave)
         return True
 
+    def _stream_put(self, req: _Request, tok: int) -> None:
+        """Token delivery through the (possibly bounded) stream queue. A
+        full queue means the consumer stopped draining — a disconnected or
+        wedged client — so the request is cancelled instead of buffering
+        its remaining decode without bound. The token is still appended to
+        ``outputs`` by the caller; only stream delivery is dropped."""
+        inj = get_fault_injector()
+        if inj.enabled and inj.fire("serve.slow_consumer",
+                                    uid=req.uid) is not None:
+            req.cancelled = True
+            self._trace["slow_consumer_cancels"] += 1
+            return
+        try:
+            req.stream_q.put_nowait(tok)
+        except queue.Full:
+            req.cancelled = True
+            self._trace["slow_consumer_cancels"] += 1
+            logger.warning(f"[serving] request {req.uid} cancelled: stream "
+                           f"consumer stopped draining "
+                           f"({req.stream_q.maxsize} tokens undelivered)")
+
     def _emit_device(self, wave) -> None:
         """ONE batched on-device sampling dispatch for every device-eligible
         row of a per-token tick (engine.sample_rows) — the N sampled
@@ -693,7 +993,7 @@ class ServingScheduler:
             if not req.outputs:
                 req.t_first = time.monotonic()
             req.outputs.append(int(tok))
-            req.stream_q.put(int(tok))
+            self._stream_put(req, int(tok))
 
     def _emit(self, req: _Request, logits_row) -> None:
         block_eos = len(req.outputs) < req.min_new_tokens
@@ -713,7 +1013,7 @@ class ServingScheduler:
         if not req.outputs:
             req.t_first = time.monotonic()
         req.outputs.append(int(tok))
-        req.stream_q.put(int(tok))
+        self._stream_put(req, int(tok))
 
     def _emit_many(self, req: _Request, toks, lps=None) -> None:
         """Stream a verified draft run or fused window, applying the
@@ -729,7 +1029,7 @@ class ServingScheduler:
                 req.logprobs.append(float(lps[i]) if lps is not None
                                     else None)
             req.outputs.append(int(t))
-            req.stream_q.put(int(t))
+            self._stream_put(req, int(t))
             if req.eos_token_id is not None and int(t) == req.eos_token_id:
                 break
             if req.stop and self._engine.hit_stop(req.outputs, req.stop):
@@ -753,12 +1053,26 @@ class ServingScheduler:
         req.t_done = time.monotonic()
         with self._lock:  # stats()/drain read under the same lock
             self._active -= 1
+            if req.queued:  # finished straight out of the waiting queue
+                req.queued = False
+                self._queued_n -= 1
+                self._queued_tokens -= len(req.prompt)
             if req.error is None and not req.cancelled:
                 self._completed.append(
                     (req.t_submit, req.t_first, req.t_done,
                      len(req.outputs)))
         req.done.set()
-        req.stream_q.put(_END)
+        while True:
+            try:
+                req.stream_q.put_nowait(_END)
+                break
+            except queue.Full:
+                # bounded stream of a dead consumer: drop its oldest
+                # undelivered token so the sentinel always lands
+                try:
+                    req.stream_q.get_nowait()
+                except queue.Empty:
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -786,18 +1100,31 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
         def log_message(self, *a):  # quiet by default
             pass
 
-        def _json(self, code: int, obj) -> None:
+        def _json(self, code: int, obj, headers=()) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
             if self.path == "/health":
+                # readiness vs liveness: "draining" (stop(drain=True) in
+                # progress) and "degraded" (watchdog saw a stuck tick)
+                # answer 503 so load balancers stop routing here, while
+                # the payload still carries the full stats for operators
                 stats = scheduler.stats
-                status = "stopped" if stats["stopped"] else "ok"
+                if stats["stopped"]:
+                    status = "stopped"
+                elif stats["draining"]:
+                    status = "draining"
+                elif stats["degraded"]:
+                    status = "degraded"
+                else:
+                    status = "ok"
                 self._json(200 if status == "ok" else 503,
                            {"status": status, **stats})
             else:
@@ -869,7 +1196,16 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     speculative=body.get("speculative"),
                     num_draft_tokens=int(body.get("num_draft_tokens", 4)),
                     draft_ngram=int(body.get("draft_ngram", 2)),
-                    return_logprobs=bool(body.get("logprobs")))
+                    return_logprobs=bool(body.get("logprobs")),
+                    deadline_s=body.get("deadline_s"),
+                    queue_ttl_s=body.get("queue_ttl_s"),
+                    stream=bool(body.get("stream")))
+            except SchedulerOverloaded as e:
+                self._json(429, {"error": str(e),
+                                 "retry_after_s": e.retry_after_s},
+                           headers=(("Retry-After",
+                                     str(max(1, round(e.retry_after_s)))), ))
+                return
             except (ValueError, SchedulingError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -879,16 +1215,38 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
-                    for tok in handle.stream():
+                    for tok in handle.stream(
+                            timeout=scheduler.wait_timeout(handle)):
                         line = json.dumps({"token": tok}).encode() + b"\n"
                         self.wfile.write(hex(len(line))[2:].encode()
                                          + b"\r\n" + line + b"\r\n")
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     handle.cancel()
+                except (DeadlineExceeded, queue.Empty):
+                    # deadline hit mid-stream / scheduler wedged: the
+                    # tokens already streamed stand — end the chunk stream
+                    # cleanly so the client sees a complete HTTP response
+                    handle.cancel()
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
                 return
             try:
-                tokens = handle.result()
+                # pinned to the request deadline (or http_timeout_s): a
+                # hung scheduler answers 504 instead of pinning this HTTP
+                # thread forever
+                tokens = handle.result(
+                    timeout=scheduler.wait_timeout(handle))
+            except DeadlineExceeded as e:
+                self._json(504, {"error": str(e)})
+                return
+            except TimeoutError:
+                handle.cancel()
+                self._json(504, {"error": f"request {handle.uid} did not "
+                                          "complete in time"})
+                return
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 self._json(500, {"error": str(e)})
                 return

@@ -23,6 +23,15 @@ Registered sites (the code that hosts them decides the fault's meaning):
   backward produces non-finite gradients: a NaN episode.
 - ``comm.init_timeout``       — the distributed rendezvous attempt raises
   TimeoutError: a slow-to-arrive host.
+- ``serve.tick_error``        — one serving-scheduler tick raises: a
+  transient device/dispatch failure the tick boundary must retry.
+- ``serve.tick_hang``         — one serving-scheduler tick stalls for
+  ``args["seconds"]``: a wedged dispatch the watchdog must surface.
+- ``serve.request_poison``    — any engine dispatch whose batch contains
+  ``args["uid"]`` raises: a request whose shape/content reliably breaks
+  the forward, which quarantine must isolate from the wave.
+- ``serve.slow_consumer``     — a streamed token delivery behaves as if
+  the consumer stopped draining: the bounded stream queue must cancel.
 
 Env syntax: ``DS_FAULT_INJECT="site[@nth][*times][;site2...]"`` e.g.
 ``DS_FAULT_INJECT="checkpoint.torn_write@2;train.nan_grads@5*3"``.
@@ -41,6 +50,10 @@ KNOWN_SITES = (
     "train.sigterm",
     "train.nan_grads",
     "comm.init_timeout",
+    "serve.tick_error",
+    "serve.tick_hang",
+    "serve.request_poison",
+    "serve.slow_consumer",
 )
 
 

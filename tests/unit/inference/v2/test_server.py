@@ -234,7 +234,11 @@ def test_stop_rejects_new_and_fails_pending():
 
 def test_scheduler_crash_fails_blocked_callers():
     """An unexpected engine error must unblock every waiting caller with
-    the error rather than hanging them on a dead thread."""
+    the error rather than hanging them on a dead thread. Since the
+    resilience layer the loop itself SURVIVES: after retries exhaust, the
+    failing request is quarantined with the error and the scheduler keeps
+    serving (a broken engine then fails each request loudly, one by one,
+    instead of killing the daemon)."""
     engine, *_ = _engine()
     sched = ServingScheduler(engine, idle_wait=0.005)
 
@@ -246,6 +250,12 @@ def test_scheduler_crash_fails_blocked_callers():
     h = sched.submit(_prompts(1)[0], max_new_tokens=4)
     with pytest.raises(ValueError, match="injected"):
         h.result(timeout=30)
+    assert not sched.stats["stopped"]  # quarantine kept the loop alive
+    assert sched.trace["quarantined"] == [h.uid]
+    h2 = sched.submit(_prompts(1)[0], max_new_tokens=4)  # still accepting
+    with pytest.raises(ValueError, match="injected"):
+        h2.result(timeout=30)
+    sched.stop()
     assert sched.stats["stopped"]
     with pytest.raises(RuntimeError):
         sched.submit([1, 2, 3])

@@ -1,0 +1,374 @@
+"""Serving resilience layer (server.py + serve.* fault sites).
+
+Every failure path the layer claims to own is driven deterministically
+through ``utils/fault_injection``: a poisoned request is quarantined while
+its wave-mates finish byte-exact, transient tick errors retry invisibly,
+deadlines expire queued and mid-decode with their KV released, the shed
+policy answers 429, the watchdog flips /health on a wedged tick, and a
+bounded stream queue stops a never-drained request. The autouse
+``_reset_fault_injector`` fixture (conftest) clears the injector between
+tests.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine
+from deepspeed_tpu.inference.v2.scheduling_utils import (DeadlineExceeded,
+                                                         SchedulerOverloaded)
+from deepspeed_tpu.inference.v2.server import (ServingScheduler,
+                                               create_http_server)
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import (InjectedFault,
+                                                 get_fault_injector)
+
+pytestmark = pytest.mark.faults
+
+BS = 16
+
+
+def _engine(num_blocks=96, resilience=None):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    eng_cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=num_blocks,
+        serving_resilience=resilience if resilience is not None else {})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=eng_cfg)
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _http(sched):
+    httpd = create_http_server(sched, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def _post(port, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/generate", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.status, dict(resp.getheaders()), json.loads(resp.read())
+    conn.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# crash isolation: quarantine + transient retry
+# ---------------------------------------------------------------------------
+
+
+def test_request_poison_quarantines_exactly_the_culprit():
+    """The acceptance scenario: serve.request_poison in a mixed batch. The
+    poisoned request alone errors; every other in-flight request completes
+    with its exact greedy tokens; the loop survives and keeps serving."""
+    prompts = _prompts(3, seed=11)
+    ref_engine = _engine()
+    ref = [ref_engine.generate([p], max_new_tokens=6)[0] for p in prompts]
+
+    # uids are assigned 1.. in submit order -> poison the middle request.
+    # Large `times`: every engine dispatch counts a visit (retries, bisect
+    # probes), and the poison must stay reproducible through all of them.
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.request_poison", "nth": 1, "times": 100000,
+        "args": {"uid": 2}}]})
+    engine = _engine(resilience={"tick_retries": 1,
+                                 "tick_retry_backoff_s": 0.01})
+    total = engine.free_blocks
+    sched = ServingScheduler(engine, idle_wait=0.005,
+                             fused_decode_window=1).start()
+    try:
+        hs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+        with pytest.raises(InjectedFault):
+            hs[1].result(timeout=120)
+        assert hs[0].result(timeout=120) == ref[0]
+        assert hs[2].result(timeout=120) == ref[2]
+        assert sched.trace["quarantined"] == [2]
+        assert not sched.stats["stopped"]
+        # the daemon still serves fresh traffic after the quarantine
+        h4 = sched.submit(prompts[0], max_new_tokens=6)
+        assert h4.result(timeout=120) == ref[0]
+        assert engine.free_blocks == total  # quarantined KV was released
+    finally:
+        sched.stop()
+
+
+def test_transient_tick_error_is_retried_invisibly():
+    """A tick_error that fires once is absorbed by the retry budget: every
+    request completes, nothing is quarantined."""
+    engine = _engine(resilience={"tick_retry_backoff_s": 0.01})
+    sched = ServingScheduler(engine, idle_wait=0.005)
+    hs = [sched.submit(p, max_new_tokens=5) for p in _prompts(2, seed=3)]
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.tick_error", "nth": 1, "times": 1}]})
+    sched.start()
+    try:
+        for h in hs:
+            assert len(h.result(timeout=120)) == 5
+        tr = sched.trace
+        assert tr["tick_errors"] >= 1
+        assert tr["quarantined"] == []
+        assert "serve.tick_error#1" in get_fault_injector().fired
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadlines / TTL
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_mid_decode_and_releases_kv():
+    engine = _engine()
+    total = engine.free_blocks
+    sched = ServingScheduler(engine)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=500, deadline_s=0.15)
+    sched.step()  # admit (+ first prefill chunk)
+    while not h._req.outputs:
+        sched.step()
+    time.sleep(0.2)
+    sched.step()  # expiry sweep runs before admission
+    assert h.finished
+    with pytest.raises(DeadlineExceeded):
+        h.result()
+    assert engine.free_blocks == total  # KV reservation released
+    assert sched.trace["expired_live"] == 1
+
+
+def test_queue_ttl_expires_unadmitted_request():
+    """A hog holds the whole cache; the queued request expires on its TTL
+    without ever touching the engine while the hog decodes on."""
+    engine = _engine(num_blocks=8)
+    sched = ServingScheduler(engine)
+    hog = sched.submit(_prompts(1, seed=7)[0], max_new_tokens=80)
+    sched.step()
+    assert len(sched._live) == 1
+    h = sched.submit(_prompts(1, seed=8)[0], max_new_tokens=80,
+                     queue_ttl_s=0.05)
+    sched.step()
+    assert not h.finished  # waiting: no KV headroom
+    time.sleep(0.1)
+    sched.step()
+    assert h.finished
+    with pytest.raises(DeadlineExceeded):
+        h.result()
+    assert sched.trace["expired_queue"] == 1
+    assert not hog.finished  # the live request was untouched
+    hog.cancel()
+    sched.step()
+
+
+def test_http_deadline_returns_504():
+    engine = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    httpd, port = _http(sched)
+    try:
+        status, _, body = _post(port, {"prompt": _prompts(1)[0],
+                                       "max_new_tokens": 5000,
+                                       "deadline_s": 0.3})
+        assert status == 504
+        assert "deadline" in body["error"]
+    finally:
+        httpd.shutdown()
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# overload shedding
+# ---------------------------------------------------------------------------
+
+
+def test_shed_raises_typed_overload_error():
+    engine = _engine(resilience={"max_queued": 2, "retry_after_s": 2.0})
+    sched = ServingScheduler(engine)  # stepped: nothing drains the queue
+    sched.submit(_prompts(1, seed=1)[0], max_new_tokens=4)
+    sched.submit(_prompts(1, seed=2)[0], max_new_tokens=4)
+    with pytest.raises(SchedulerOverloaded) as ei:
+        sched.submit(_prompts(1, seed=3)[0], max_new_tokens=4)
+    assert ei.value.retry_after_s == 2.0
+    assert sched.trace["shed"] == 1
+    assert sched.stats["waiting"] == 2  # queue never grew past the bound
+    sched.step()  # both admit -> queue empties -> admission reopens
+    h = sched.submit(_prompts(1, seed=4)[0], max_new_tokens=4)
+    while not h.finished:
+        sched.step()
+    assert len(h.result()) == 4
+
+
+def test_shed_answers_http_429_with_retry_after():
+    engine = _engine(resilience={"max_queued": 1, "retry_after_s": 2.0})
+    sched = ServingScheduler(engine)  # never stepped: the queue stays full
+    httpd, port = _http(sched)
+    try:
+        sched.submit(_prompts(1)[0], max_new_tokens=4)
+        status, headers, body = _post(port, {"prompt": _prompts(1)[0],
+                                             "max_new_tokens": 4})
+        assert status == 429
+        assert headers.get("Retry-After") == "2"
+        assert body["retry_after_s"] == 2.0
+    finally:
+        httpd.shutdown()
+
+
+def test_max_queued_tokens_sheds_but_never_empty_queue():
+    engine = _engine(resilience={"max_queued_tokens": 10})
+    sched = ServingScheduler(engine)
+    big = list(range(40))
+    h = sched.submit(big, max_new_tokens=4)  # over the bound, queue empty
+    assert h is not None
+    with pytest.raises(SchedulerOverloaded):
+        sched.submit([1, 2, 3], max_new_tokens=4)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_trips_on_injected_hang_and_recovers():
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.tick_hang", "nth": 1, "times": 1,
+        "args": {"seconds": 1.0}}]})
+    engine = _engine(resilience={"watchdog_s": 0.2})
+    sched = ServingScheduler(engine, idle_wait=0.005)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=3)
+    sched.start()
+    try:
+        tripped = False
+        for _ in range(200):
+            if sched.stats["degraded"]:
+                tripped = True
+                break
+            time.sleep(0.01)
+        assert tripped, "watchdog never flipped /health during the hang"
+        assert len(h.result(timeout=60)) == 3  # hang ends, request finishes
+        for _ in range(200):
+            if not sched.stats["degraded"]:
+                break
+            time.sleep(0.01)
+        assert not sched.stats["degraded"]  # recovered with progress
+        assert sched.trace["watchdog_trips"] >= 1
+        assert sched.stats["last_progress_age_s"] < 1.0
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow consumers / bounded stream_q
+# ---------------------------------------------------------------------------
+
+
+def test_slow_consumer_bounded_stream_cancels_request():
+    engine = _engine(resilience={"max_stream_backlog": 4})
+    total = engine.free_blocks
+    sched = ServingScheduler(engine)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=200, stream=True)
+    for _ in range(400):
+        if h.finished:
+            break
+        sched.step()
+    assert h.finished
+    assert h._req.cancelled
+    assert 0 < len(h._req.outputs) < 200  # stopped well short of the budget
+    assert sched.trace["slow_consumer_cancels"] >= 1
+    assert engine.free_blocks == total
+    # a late consumer still sees a terminated stream (END survived the
+    # full queue), not a hang
+    toks = list(h.stream(timeout=1))
+    assert len(toks) <= 4
+
+
+def test_non_streaming_request_exempt_from_backlog_bound():
+    """result() callers never drain stream_q; the bound must not apply."""
+    engine = _engine(resilience={"max_stream_backlog": 4})
+    sched = ServingScheduler(engine)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=20)  # stream=False
+    while not h.finished:
+        sched.step()
+    assert len(h.result()) == 20
+    assert sched.trace["slow_consumer_cancels"] == 0
+
+
+def test_injected_slow_consumer_site():
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.slow_consumer", "nth": 3, "times": 1}]})
+    engine = _engine()
+    sched = ServingScheduler(engine)
+    h = sched.submit(_prompts(1)[0], max_new_tokens=50, stream=True)
+    for _ in range(200):
+        if h.finished:
+            break
+        sched.step()
+    assert h.finished and h._req.cancelled
+    assert sched.trace["slow_consumer_cancels"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: health readiness, cancel-before-admission
+# ---------------------------------------------------------------------------
+
+
+def test_health_reports_draining_and_new_fields():
+    engine = _engine()
+    sched = ServingScheduler(engine, idle_wait=0.005).start()
+    httpd, port = _http(sched)
+
+    def _health():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        out = resp.status, json.loads(resp.read())
+        conn.close()
+        return out
+
+    try:
+        status, body = _health()
+        assert status == 200 and body["status"] == "ok"
+        for key in ("waiting", "live", "draining", "degraded",
+                    "last_progress_age_s", "queued_tokens", "shed",
+                    "expired", "quarantined", "watchdog_trips"):
+            assert key in body
+        sched._draining = True  # what stop(drain=True) sets while polling
+        status, body = _health()
+        assert status == 503 and body["status"] == "draining"
+        sched._draining = False
+    finally:
+        httpd.shutdown()
+        sched.stop()
+
+
+def test_cancel_frees_kv_before_same_step_admission():
+    """A cancelled live request's blocks must be reusable by _admit within
+    the SAME step — a cancel storm cannot starve admission for a tick."""
+    engine = _engine(num_blocks=8)
+    sched = ServingScheduler(engine)
+    h1 = sched.submit(_prompts(1, seed=5)[0], max_new_tokens=80)
+    sched.step()
+    assert [r.uid for r in sched._live] == [h1.uid]
+    h2 = sched.submit(_prompts(1, seed=6)[0], max_new_tokens=80)
+    sched.step()
+    assert [r.uid for r in sched._live] == [h1.uid]  # h2 waits: no headroom
+    sched._wake.clear()  # so the next assert sees cancel()'s set, not submit()'s
+    h1.cancel()
+    assert sched._wake.is_set()  # cancel nudges an idle loop immediately
+    sched.step()
+    assert h1.finished
+    # h2 admitted in the same step the cancel freed the blocks
+    assert [r.uid for r in sched._live] == [h2.uid]
+    h2.cancel()
+    sched.step()
