@@ -149,7 +149,8 @@ run sparse_attn 1800 python .perf/sparse_probe.py 2048 4096 8192
 run bench_serving_int8 1200 env DS_BENCH_KV_INT8=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_INT8.json
 # 15b. prefix-caching prefill delta (shared-system-prompt workload)
 run bench_serving_prefix 1200 env DS_BENCH_PREFIX=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_PREFIX.json
-# 15c. speculative decode delta (prompt-lookup, repetitive workload)
+# 15c. speculative decode delta (prompt-lookup, repetitive workload):
+#      per-token vs fused draft/verify at d=2/4/8 with accept rate
 run bench_serving_spec 1200 env DS_BENCH_SPEC=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_SPEC.json
 # 15d. serving-daemon end-to-end throughput (MII layer: scheduler thread,
 # admission, streaming — not raw engine puts)

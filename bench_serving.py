@@ -69,7 +69,8 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
         results.extend(_measure_prefix_caching(cfg, contexts[0], kv_block,
                                                backends[0]))
     # DS_BENCH_SPEC=1: prompt-lookup speculative decode on repetitive text
-    # (the regime it accelerates) vs plain greedy, same engine
+    # (the regime it accelerates): per-token vs fused draft/verify at
+    # several draft lengths, with measured accept rate, vs plain greedy
     if env_flag("DS_BENCH_SPEC"):
         results.extend(_measure_speculative(cfg, kv_block, backends[0]))
     # DS_BENCH_DAEMON=1: end-to-end ServingScheduler throughput — requests
@@ -365,8 +366,12 @@ def _measure_sampled(cfg, ctx, kv_block, backend, decode_steps, nseq):
 
 
 def _measure_speculative(cfg, kv_block, backend):
-    """Decode tok/s with and without prompt-lookup drafting on repetitive
-    text — memory-bound decode is where verify-K-in-one-pass pays."""
+    """Speculative decode rung: per-token (host draft/verify, one round-trip
+    per window) vs FUSED speculative (draft + verify + accept inside the
+    K-window scan, one dispatch + one fetch per K windows) on repetitive
+    text, at several draft lengths, with the measured accept rate — the
+    amortization only pays when drafts actually land, so the rate is part
+    of the evidence."""
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import (build_llama_engine,
@@ -378,20 +383,50 @@ def _measure_speculative(cfg, kv_block, backend):
     rows = []
     eng = build_llama_engine(
         cfg, engine_config=RaggedInferenceEngineConfig(
-            num_kv_blocks=4 * ((len(prompt) + new_tokens) // kv_block + 4)),
+            num_kv_blocks=6 * ((len(prompt) + 3 * new_tokens) // kv_block
+                               + 4)),
         kv_block_size=kv_block)
     eng.model().attn_backend = backend
-    for spec in (None, "prompt_lookup"):
-        kw = dict(speculative=spec, num_draft_tokens=6) if spec else {}
-        eng.generate([prompt], max_new_tokens=8, **kw)   # warm compiles
-        t0 = time.perf_counter()
-        out = eng.generate([prompt], max_new_tokens=new_tokens, **kw)
-        dt = time.perf_counter() - t0
-        rows.append({"backend": backend, "speculative": bool(spec),
-                     "decode_tok_s": round(len(out[0]) / dt, 2)})
-    if rows[0]["decode_tok_s"] > 0:
-        rows[1]["speedup_vs_plain"] = round(
-            rows[1]["decode_tok_s"] / rows[0]["decode_tok_s"], 2)
+    scfg = eng._config.sampling
+
+    def timed(mode, fused, **kw):
+        prev = scfg.fused_speculative_decode
+        scfg.fused_speculative_decode = fused
+        try:
+            eng.generate([prompt], max_new_tokens=8, **kw)   # warm compiles
+            t0 = time.perf_counter()
+            out = eng.generate([prompt], max_new_tokens=new_tokens, **kw)
+            dt = time.perf_counter() - t0
+        finally:
+            scfg.fused_speculative_decode = prev
+        row = {"backend": backend, "mode": mode,
+               "speculative": bool(kw.get("speculative")),
+               "decode_tok_s": round(len(out[0]) / dt, 2),
+               "ms_per_token": round(1e3 * dt / max(1, len(out[0])), 3)}
+        st = getattr(eng, "last_spec_stats", None)
+        if kw.get("speculative") and st is not None:
+            row["drafted"] = st["drafted"]
+            row["accepted"] = st["accepted"]
+            if st["drafted"]:
+                row["accept_rate"] = round(st["accepted"] / st["drafted"], 4)
+        return row
+
+    base = timed("plain_greedy", False, fused_decode_window=FUSED_K)
+    rows.append(base)
+    for d in (2, 4, 8):
+        kw = dict(speculative="prompt_lookup", num_draft_tokens=d,
+                  fused_decode_window=FUSED_K)
+        pt = timed(f"spec_per_token_d{d}", False, **kw)
+        fu = timed(f"spec_fused_d{d}", True, **kw)
+        for r in (pt, fu):
+            r["num_draft_tokens"] = d
+            if base["decode_tok_s"] > 0:
+                r["speedup_vs_plain"] = round(
+                    r["decode_tok_s"] / base["decode_tok_s"], 2)
+        if pt["decode_tok_s"] > 0:
+            fu["fused_vs_per_token"] = round(
+                fu["decode_tok_s"] / pt["decode_tok_s"], 2)
+        rows.extend([pt, fu])
     return rows
 
 

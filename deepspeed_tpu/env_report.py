@@ -84,6 +84,17 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"attn dispatch table {'.' * 29} {NO} ({e})")
     try:
+        # speculative decoding: where drafts come from under the current
+        # config — the fused program's on-device ring buffer, or the host
+        # prompt-lookup fallback (gate off / per-token oracle path)
+        from .inference.v2.config_v2 import SamplingConfig
+        scfg = SamplingConfig()
+        src = ("device ring-buffer (fused)" if scfg.fused_speculative_decode
+               else "host prompt-lookup (per-token fallback)")
+        lines.append(f"speculative draft source {'.' * 24} {src}")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"speculative draft source {'.' * 24} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

@@ -88,6 +88,26 @@ class SamplingConfig(ConfigModel):
     (sampling inside the lax.scan). Requires ``device_sampling``. False
     keeps fused dispatch greedy-only (pre-sampling behavior)."""
 
+    fused_speculative_decode: bool = True
+    """Run speculative requests through the fused draft/verify program:
+    on-device prompt-lookup drafting from a per-sequence token-history
+    ring buffer, window verification, and rejection sampling inside one
+    ``lax.scan`` over K windows (one dispatch + one host fetch per K
+    windows). False keeps the per-token host draft/verify path — the
+    parity oracle — for every speculative request."""
+
+    spec_history_window: int = 128
+    """Token-history window for prompt-lookup drafting: the device ring
+    buffer holds this many trailing tokens per sequence, and the host
+    fallback bounds its backward n-gram scan to the same window (the
+    unbounded scan was O(history × draft) per token). Must exceed
+    ``num_draft_tokens + draft_ngram`` for drafting to ever match."""
+
+    spec_max_ngram: int = 8
+    """Largest ``draft_ngram`` the fused matcher supports (the vectorized
+    comparison is masked over this static width). Requests with a larger
+    ngram fall back to the per-token host path."""
+
 
 class ServingResilienceConfig(ConfigModel):
     """Serving-side fault tolerance (the MII front end's analog of the

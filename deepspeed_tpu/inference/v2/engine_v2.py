@@ -260,6 +260,34 @@ class InferenceEngineV2:
             return [], 0, uids
         return fusable, K, solo
 
+    def fused_spec_partition(self, uids, output_budgets, draft_tokens: int,
+                             cap: int):
+        """Speculative analog of :meth:`fused_partition`: each fused window
+        can write up to ``1 + draft_tokens`` KV positions (worst case all
+        drafts accepted), so a row's window room is its CONTEXT headroom
+        divided by the window width, while the output-budget bound stays
+        per-window (each window emits at least one token; overshoot past
+        the budget is trimmed at retirement like the plain fused path).
+        Returns ``(fusable, K, solo)`` with K the largest power-of-two
+        window count every fusable row can absorb."""
+        sm = self._config.state_manager
+        w = 1 + max(1, int(draft_tokens))
+        room = {}
+        for u, b in zip(uids, output_budgets):
+            ctx = sm.max_context \
+                - self._state_manager.get_sequence(u).seen_tokens
+            room[u] = min(b, ctx // w)
+        fusable = [u for u in uids if room[u] >= 2]
+        solo = [u for u in uids if room[u] < 2]
+        if not fusable:
+            return [], 0, solo
+        K = min(cap, min(room[u] for u in fusable))
+        while K >= 2 and K & (K - 1):
+            K &= K - 1
+        if K < 2:
+            return [], 0, uids
+        return fusable, K, solo
+
     def decode_finished(self, uid, outputs, max_new_tokens,
                         eos_token_id, stop) -> bool:
         """The ONE retire predicate: output budget spent, eos emitted, a
@@ -348,7 +376,8 @@ class InferenceEngineV2:
 
     def warmup(self, prefill_lens=(128, ), batch_sizes=(1, ),
                draft_tokens: int = 0, fused_windows=(),
-               fused_sampled_windows=(),
+               fused_sampled_windows=(), fused_spec_windows=(),
+               spec_draft_tokens: int = 4, spec_draft_ngram: int = 2,
                decode_context: int = 0) -> int:
         """Precompile the bucketed forward programs serving will hit, so the
         first real request doesn't pay compile latency (the reference's
@@ -399,6 +428,22 @@ class InferenceEngineV2:
                     uids, [0] * bs, int(K),
                     specs=[SampleSpec(temperature=1.0, want_logprobs=True)
                            for _ in uids])
+            for K in fused_spec_windows:
+                # warm the fused speculative programs (greedy + sampled):
+                # the scratch sequences' zero-token histories draft real
+                # windows (every ngram matches), so the compiled shapes are
+                # exactly the production ones
+                hists = [[0] * (self._state_manager.get_sequence(u)
+                                .seen_tokens + 1) for u in uids]
+                self.fused_spec_decode_steps(
+                    uids, hists, int(K),
+                    num_draft_tokens=spec_draft_tokens,
+                    draft_ngram=spec_draft_ngram)
+                self.fused_spec_decode_steps(
+                    uids, hists, int(K),
+                    num_draft_tokens=spec_draft_tokens,
+                    draft_ngram=spec_draft_ngram,
+                    specs=[SampleSpec(temperature=1.0) for _ in uids])
             for u in uids:
                 self.flush(u)
         return len(self._model._fwd_cache)
@@ -475,6 +520,18 @@ class InferenceEngineV2:
             self.seed_sampler(uid, seed)
             k = self._sample_keys[uid]
         return k
+
+    def spec_ring_window(self, num_draft_tokens: int) -> int:
+        """Effective token-history window for prompt-lookup drafting. The
+        device ring must hold at least one full speculative window plus a
+        matchable pattern, so tiny ``spec_history_window`` configs get
+        widened — the host fallback scan uses the SAME bound so both sides
+        see (and miss) exactly the same matches."""
+        scfg = getattr(self._config, "sampling", None)
+        d = max(1, int(num_draft_tokens))
+        max_ngram = int(scfg.spec_max_ngram) if scfg is not None else 8
+        base = int(scfg.spec_history_window) if scfg is not None else 128
+        return max(base, 2 * (1 + d) + max_ngram)
 
     @staticmethod
     def _spec_statics(specs):
@@ -566,15 +623,36 @@ class InferenceEngineV2:
         return row
 
     @staticmethod
-    def prompt_lookup_draft(history, *, draft_ngram: int, max_tokens: int):
+    def prompt_lookup_draft(history, *, draft_ngram: int, max_tokens: int,
+                            match_window: int = 0, match_cache=None):
         """Prompt-lookup drafting (Saxena): propose the tokens that
         followed the most recent earlier occurrence of the trailing
-        n-gram. No draft model — the history IS the drafter."""
+        n-gram. No draft model — the history IS the drafter.
+
+        The backward scan is bounded two ways (it used to rescan the FULL
+        history every generated token — O(history × draft) per step):
+        ``match_window`` > 0 restricts candidates to the trailing window
+        (the device ring buffer's twin — same window, same drafts), and
+        ``match_cache`` (a per-request dict) remembers the last match
+        position: the most recent occurrence can only move FORWARD, so a
+        still-valid cached match floors the scan and the per-token cost
+        drops to O(new_tokens_since_last_match × ngram)."""
         if max_tokens <= 0 or len(history) <= draft_ngram:
             return []
         pat = history[-draft_ngram:]
-        for s in range(len(history) - draft_ngram - 1, -1, -1):
+        # the window bound matches the device ring's retention exactly
+        # (candidate start within the trailing W tokens), so host and
+        # fused drafting agree token-for-token inside the window
+        lo = max(0, len(history) - match_window) if match_window > 0 else 0
+        if match_cache is not None:
+            p = match_cache.get("pos")
+            if (p is not None and lo <= p <= len(history) - draft_ngram - 1
+                    and history[p:p + draft_ngram] == pat):
+                lo = p  # a match exists here; nothing older can win
+        for s in range(len(history) - draft_ngram - 1, lo - 1, -1):
             if history[s:s + draft_ngram] == pat:
+                if match_cache is not None:
+                    match_cache["pos"] = s
                 return [int(t) for t in
                         history[s + draft_ngram:s + draft_ngram + max_tokens]]
         return []
@@ -606,6 +684,53 @@ class InferenceEngineV2:
                     seq.pending_tokens[:len(seq.pending_tokens) - rejected]
         if k:
             # deferred registration now that seen is truthful
+            self._register_pending(seq)
+        return new_toks, m
+
+    def accept_drafts_sampled(self, uid: int, draft, window_rows, spec,
+                              d_static: int):
+        """Rejection-sampling draft verification for SAMPLED speculative
+        requests — the host twin (and parity oracle) of one window of the
+        fused speculative program. Runs the exact same op chain
+        (``ops/sampling.spec_verify_window``) on this one row: accept each
+        point-mass draft with the target probability of its token under
+        the temperature/top-k/top-p distribution, sample the correction
+        from the residual (or the bonus from the full distribution), and
+        advance the sequence's PRNG key by exactly one split. ``d_static``
+        must be the request's ``num_draft_tokens`` — the window's
+        randomness is derived via a fixed ``split(sub, d_static + 1)``
+        regardless of how many drafts were actually found, so the key
+        stream stays in lockstep with the fused program (which always
+        runs at the static width). Rollback bookkeeping matches
+        ``accept_drafts``. Returns (new_tokens, n_accepted)."""
+        from ...ops import sampling as dsamp
+        d = max(1, int(d_static))
+        k = len(draft)
+        rows = np.asarray(window_rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        wl = np.zeros((1, 1 + d, rows.shape[-1]), np.float32)
+        wl[0, :k + 1] = rows[:k + 1]
+        drafts = np.zeros((1, d), np.int32)
+        drafts[0, :k] = draft
+        key = self._sampler_key(uid, spec.seed)
+        out, n_emit, new_key = dsamp.spec_verify_window(
+            wl, drafts, np.asarray([k], np.int32), key[None],
+            np.asarray([spec.temperature], np.float32),
+            np.asarray([spec.top_k], np.int32),
+            np.asarray([spec.top_p], np.float32), d=d)
+        out, n_emit, new_key = jax.device_get((out, n_emit, new_key))
+        self._sample_keys[uid] = np.asarray(new_key[0], np.uint32)
+        m = int(n_emit[0]) - 1
+        new_toks = [int(t) for t in out[0, :m + 1]]
+        seq = self._state_manager.get_sequence(uid)
+        rejected = k - m
+        if rejected:
+            seq.rollback(rejected)
+            if self._state_manager.prefix_cache is not None:
+                seq.pending_tokens = \
+                    seq.pending_tokens[:len(seq.pending_tokens) - rejected]
+        if k:
             self._register_pending(seq)
         return new_toks, m
 
@@ -713,6 +838,131 @@ class InferenceEngineV2:
             return out, lps
         return out
 
+    def fused_spec_decode_steps(self, batch_uids, histories, n_steps: int, *,
+                                num_draft_tokens: int, draft_ngram: int,
+                                specs=None):
+        """``n_steps`` speculative draft/verify windows in ONE device
+        dispatch with ONE host fetch — the speculative sibling of
+        :meth:`fused_decode_steps` (model.fused_spec_decode). Drafting
+        (ring-buffer prompt lookup), window verification, acceptance, and
+        rejection-sampling all run inside the scan; host sync drops from
+        one round-trip per window to one per K windows, i.e.
+        O(new_tokens / (K × mean_accepted)) for the request.
+
+        ``histories[i]`` is uid i's full prompt+output token list, whose
+        LAST element is the next token to feed (the per-token path's
+        ``last_tok``); the trailing ``spec_history_window`` tokens seed the
+        device ring. KV for the worst case ``n_steps * (1 + d)`` tokens is
+        reserved up front (feasibility checked before any allocation, like
+        the plain fused path); rejected tails cost nothing — their slots
+        are overwritten in place by the next window.
+
+        ``specs=None`` verifies greedily (byte-identical to the per-token
+        ``accept_drafts`` stream). With one :class:`SampleSpec` per uid,
+        verification is rejection sampling against the point-mass drafts
+        (``ops/sampling.spec_verify_window``) and each sequence's PRNG key
+        advances by exactly ``n_steps`` splits — one per window, the same
+        count the host ``accept_drafts_sampled`` fallback burns.
+
+        Returns ``(tokens, drafted, accepted)``: per-uid emitted token
+        lists (variable length — between ``n_steps`` and
+        ``n_steps * (1 + d)``), and per-uid totals of drafted / accepted
+        tokens across the K windows (the accept-rate observability feed)."""
+        batch_uids = list(batch_uids)
+        _fire_request_poison(batch_uids)
+        d = max(1, int(num_draft_tokens))
+        scfg = getattr(self._config, "sampling", None)
+        max_ngram = int(scfg.spec_max_ngram) if scfg is not None else 8
+        if draft_ngram > max_ngram:
+            raise ValueError(f"draft_ngram {draft_ngram} exceeds "
+                             f"spec_max_ngram {max_ngram}")
+        W = self.spec_ring_window(d)
+        seqs = []
+        for uid in batch_uids:
+            seq = self._state_manager.get_sequence(uid)
+            if seq is None or seq.seen_tokens == 0:
+                raise ValueError(f"fused_spec_decode_steps: uid {uid} is "
+                                 "not a live prefilled sequence")
+            seqs.append(seq)
+        if len(seqs) > self._config.state_manager.max_ragged_sequence_count:
+            raise SchedulingError(SchedulingResult.BatchSequenceLimitExceeded)
+        sm = self._config.state_manager
+        worst = n_steps * (1 + d)
+        free = self._state_manager.free_blocks
+        for seq in seqs:
+            if seq.seen_tokens + worst > sm.max_context:
+                raise SchedulingError(
+                    SchedulingResult.SequenceTokenLimitExceeded)
+            n_fit, req = self._model.get_kv_requirements(seq, worst, free)
+            if n_fit != worst:
+                raise SchedulingError(SchedulingResult.KVCacheLimitExceeded)
+            free -= req
+        for seq in seqs:
+            self._model.maybe_allocate_kv(seq, worst)
+
+        from .ragged.ragged_wrapper import _bucket
+        S = _bucket(len(seqs), floor=1)
+        B = _bucket(max(s.cur_allocated_blocks for s in seqs), floor=1)
+        tokens = np.zeros(S, np.int32)
+        seq_lens = np.zeros(S, np.int32)
+        liv = np.zeros(S, np.int32)
+        block_table = np.zeros((S, B), np.int32)
+        hist = np.zeros((S, W), np.int32)
+        hist_len = np.zeros(S, np.int32)
+        ngrams = np.zeros(S, np.int32)
+        max_d = np.zeros(S, np.int32)
+        for i, (seq, h) in enumerate(zip(seqs, histories)):
+            tokens[i] = int(h[-1])
+            seq_lens[i] = seq.seen_tokens
+            liv[i] = 1
+            block_table[i] = seq.block_table(B)
+            L = len(h)
+            tail = np.asarray(h[max(0, L - W):], np.int32)
+            p = np.arange(L - tail.size, L)
+            hist[i, p % W] = tail  # logical position p lives in slot p % W
+            hist_len[i] = L
+            ngrams[i] = int(draft_ngram)
+            max_d[i] = d
+        sampling = None
+        if specs is not None:
+            temps = np.zeros(S, np.float32)
+            top_ks = np.zeros(S, np.int32)
+            top_ps = np.ones(S, np.float32)
+            keys = np.zeros((S, 2), np.uint32)
+            for i, (u, s) in enumerate(zip(batch_uids, specs)):
+                temps[i] = s.temperature
+                top_ks[i] = s.top_k
+                top_ps[i] = s.top_p
+                keys[i] = self._sampler_key(u, s.seed)
+            sampling = dict(keys=keys, temps=temps, top_ks=top_ks,
+                            top_ps=top_ps)
+        out, n_emit, dlen, new_keys = self._model.fused_spec_decode(
+            tokens, seq_lens, liv, block_table, hist, hist_len, ngrams,
+            max_d, n_steps, d, max_ngram, sampling=sampling)
+        if new_keys is not None:
+            for i, u in enumerate(batch_uids):
+                self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
+
+        pc = self._state_manager.prefix_cache
+        toks_lists, drafted, accepted = [], [], []
+        for i, seq in enumerate(seqs):
+            emitted = []
+            for w in range(n_steps):
+                emitted.extend(int(t) for t in out[w, i, :n_emit[w, i]])
+            # seen advances by exactly what the device's lens did — the
+            # accepted tokens; worst-case blocks stay allocated for the
+            # next window (or free at flush)
+            seq.pre_forward(len(emitted))
+            seq.post_forward()
+            if pc is not None:
+                self._append_pending(
+                    seq, np.asarray([int(tokens[i])] + emitted[:-1],
+                                    np.int32))
+            toks_lists.append(emitted)
+            drafted.append(int(dlen[:, i].sum()))
+            accepted.append(len(emitted) - n_steps)
+        return toks_lists, drafted, accepted
+
     @staticmethod
     def normalize_stop(stop):
         """``stop`` → list of token-id sequences (one flat list = one
@@ -789,16 +1039,21 @@ class InferenceEngineV2:
         if speculative is not None:
             if speculative != "prompt_lookup":
                 raise ValueError(f"unknown speculative mode {speculative!r}")
-            if temperature != 0.0 or return_logprobs:
-                raise ValueError("speculative decoding is greedy-only "
-                                 "(temperature=0, no logprobs)")
+            if return_logprobs:
+                # the rejection-sampled token's "logprob" under the target
+                # distribution is not the probability it was emitted with
+                # — refuse rather than report a misleading number
+                raise ValueError("speculative decoding does not return "
+                                 "logprobs")
             if (min_new_tokens or repetition_penalty != 1.0
                     or logits_processor is not None):
-                # the one-pass window verify compares raw argmax per
-                # position; history-dependent LOGIT edits would make the
-                # verified distribution position-dependent in ways the
-                # single forward can't reproduce. (``stop`` composes: it
-                # only truncates outputs at retirement, like eos.)
+                # temperature/top-k/top-p COMPOSE (rejection sampling
+                # against the point-mass drafts — see
+                # ops/sampling.spec_verify_window), but history-dependent
+                # LOGIT edits would make the verified distribution
+                # position-dependent in ways the single window forward
+                # can't reproduce. (``stop`` composes: it only truncates
+                # outputs at retirement, like eos.)
                 raise ValueError("speculative decoding does not compose "
                                  "with min_new_tokens/"
                                  "repetition_penalty/logits_processor")
@@ -828,6 +1083,19 @@ class InferenceEngineV2:
                                or repetition_penalty != 1.0
                                or min_new_tokens > 0))
         base_key = jax.random.PRNGKey(int(seed)) if device_sampled else None
+        spec_sampled = speculative is not None and temperature != 0.0
+        if spec_sampled and not device_sampled:
+            # the rejection-sampling verify draws from the per-sequence
+            # jax key chains; without them there is no reproducible (or
+            # fused-parity) stream to offer
+            raise ValueError("speculative sampling requires "
+                             "sampling.device_sampling")
+        # accept-rate observability for the convenience loop (the serving
+        # daemon keeps its own per-request counters)
+        self.last_spec_stats = {"drafted": 0, "accepted": 0}
+        spec_match_window = (self.spec_ring_window(num_draft_tokens)
+                             if speculative is not None else 0)
+        spec_match_cache = {}
 
         def _spec(u):
             return SampleSpec(
@@ -1090,6 +1358,61 @@ class InferenceEngineV2:
                     # next loop iteration (the shared decode_finished scan)
                     continue
 
+            # fused SPECULATIVE fast path: drafting, verification, and
+            # (for sampled requests) rejection sampling all run inside one
+            # K-window scan — one dispatch and one host fetch per
+            # K × (accepted+1) tokens (fused_spec_decode_steps). Gate-off
+            # (fused_speculative_decode=False) keeps the per-token window
+            # path below as the parity oracle.
+            fused_spec_ok = (speculative is not None and fused_steps_cap > 1
+                             and scfg is not None
+                             and scfg.fused_speculative_decode
+                             and logits_processor is None
+                             and draft_ngram <= scfg.spec_max_ngram)
+            if fused_spec_ok:
+                fusable, K, solo = self.fused_spec_partition(
+                    live, [max_new_tokens - len(outputs[u]) for u in live],
+                    num_draft_tokens, fused_steps_cap)
+                res = None
+                if K >= 2:
+                    try:
+                        sp = None
+                        if spec_sampled:
+                            _ensure_keys(fusable)
+                            sp = [_spec(u) for u in fusable]
+                        res = self.fused_spec_decode_steps(
+                            fusable,
+                            [prompts[u] + outputs[u] for u in fusable], K,
+                            num_draft_tokens=num_draft_tokens,
+                            draft_ngram=draft_ngram, specs=sp)
+                    except SchedulingError:
+                        pass  # KV pressure: the per-token path below owns
+                        # the evict-and-replay protocol
+                if res is not None:
+                    toks_lists, drafted_n, accepted_n = res
+                    for i, u in enumerate(fusable):
+                        self.last_spec_stats["drafted"] += drafted_n[i]
+                        self.last_spec_stats["accepted"] += accepted_n[i]
+                        _absorb_new_tokens(u, toks_lists[i])
+                        if not self.decode_finished(u, outputs[u],
+                                                    max_new_tokens,
+                                                    eos_token_id, stop):
+                            seq = self._state_manager.get_sequence(u)
+                            self._register_pending(seq)
+                            self._model.maybe_free_kv(seq)
+                    for u in solo:
+                        # near-retirement rows tick per-step draft-free —
+                        # they have at most a token or two left
+                        try:
+                            logits_u = np.asarray(
+                                self.put([u], [[last_tok[u]]]))[0]
+                        except SchedulingError:
+                            continue
+                        (last_tok[u], lp), = _sample_wave([u], [logits_u])
+                        outputs[u].append(last_tok[u])
+                        logprobs[u].append(lp)
+                    continue
+
             # total drafted tokens are bounded by the ragged-batch budget
             # (each live seq is guaranteed its 1 real token first) and each
             # sequence's room by its context AND output budgets
@@ -1101,9 +1424,10 @@ class InferenceEngineV2:
                 room = min(num_draft_tokens, budget,
                            sm.max_context - seq.seen_tokens - 2,
                            max_new_tokens - len(outputs[u]) - 1)
-                return self.prompt_lookup_draft(prompts[u] + outputs[u],
-                                                draft_ngram=draft_ngram,
-                                                max_tokens=room)
+                return self.prompt_lookup_draft(
+                    prompts[u] + outputs[u], draft_ngram=draft_ngram,
+                    max_tokens=room, match_window=spec_match_window,
+                    match_cache=spec_match_cache.setdefault(u, {}))
 
             drafts = {}
             for u in live:
@@ -1136,16 +1460,38 @@ class InferenceEngineV2:
             if not live:
                 continue
             if use_window:
-                # greedy verification: accept the longest draft prefix the
-                # model agrees with, emit the correction/bonus token, and
-                # roll the rejected tail back in place (accept_drafts —
-                # shared with the serving daemon)
+                # draft verification: greedy rows accept the longest
+                # argmax-agreeing prefix (accept_drafts — shared with the
+                # serving daemon); sampled rows run the rejection-sampling
+                # verify (accept_drafts_sampled — the fused program's host
+                # twin). Both emit the correction/bonus token and roll the
+                # rejected tail back in place.
+                if spec_sampled:
+                    _ensure_keys(live)
                 for i, u in enumerate(live):
-                    new_toks, _ = self.accept_drafts(u, drafts[u], logits[i])
+                    if spec_sampled:
+                        new_toks, m = self.accept_drafts_sampled(
+                            u, drafts[u], logits[i], _spec(u),
+                            num_draft_tokens)
+                    else:
+                        new_toks, m = self.accept_drafts(u, drafts[u],
+                                                         logits[i])
+                    self.last_spec_stats["drafted"] += len(drafts[u])
+                    self.last_spec_stats["accepted"] += m
                     seq = self._state_manager.get_sequence(u)
                     # window puts defer the trailing-window free for EVERY
                     # sequence in the batch — resume it here
                     self._model.maybe_free_kv(seq)
+                    _absorb_new_tokens(u, new_toks)
+            elif spec_sampled:
+                # a draft-free step of a SAMPLED speculative request still
+                # verifies through the window math (with zero drafts): the
+                # per-window key discipline must match the fused program's,
+                # which burns one split per window regardless of drafts
+                _ensure_keys(live)
+                for i, u in enumerate(live):
+                    new_toks, _ = self.accept_drafts_sampled(
+                        u, [], logits[i], _spec(u), num_draft_tokens)
                     _absorb_new_tokens(u, new_toks)
             else:
                 picks = _sample_wave(live, [logits[i]

@@ -455,6 +455,84 @@ class RaggedLlamaModel:
         out, lps, new_keys = jax.device_get((out, lps, new_keys))
         return np.asarray(out), np.asarray(lps), np.asarray(new_keys)
 
+    def fused_spec_decode(self, tokens, seq_lens, live, block_table, hist,
+                          hist_len, ngrams, max_drafts, n_steps: int,
+                          draft_width: int, max_ngram: int,
+                          sampling: Optional[dict] = None):
+        """``n_steps`` speculative draft/verify windows in ONE XLA program
+        — the speculative sibling of ``fused_decode``. Each scan iteration
+        drafts up to ``draft_width`` tokens per row from a carried
+        token-history ring buffer (``ops/sampling.ngram_draft_ring``),
+        feeds ``1 + draft_width`` tokens through the multi-token ragged
+        forward with ``window_logits=True``, verifies the drafts on device
+        (argmax match for greedy rows, point-mass rejection sampling for
+        sampled rows) and advances each row by its accepted length + 1.
+
+        Rollback never leaves the device: KV slots are a pure function of
+        position, so a rejected tail's writes are simply overwritten by
+        the next window's feed (which always starts at the accepted
+        position and spans at least as far) — the host-side
+        ``seq.rollback()`` of the per-token path has no fused equivalent
+        to pay for.
+
+        Host contract: every live row's block table covers
+        ``seq_lens + n_steps * (1 + draft_width)`` tokens (worst case all
+        drafts accepted), and the history ring is laid out with the token
+        for logical position p at ``hist[:, p % W]``.
+
+        Returns one host fetch: ``(out [n_steps, S, 1+draft_width] int32,
+        n_emit [n_steps, S] int32, dlen [n_steps, S] int32, new_keys)``
+        where window w of row i emitted ``out[w, i, :n_emit[w, i]]``
+        tokens after drafting ``dlen[w, i]`` (accepted = n_emit - 1), and
+        ``new_keys`` is None for the greedy program."""
+        kv = self._state_manager.kv_cache
+        total_slots = kv.num_blocks * kv.block_size
+        S, B = tokens.shape[0], block_table.shape[1]
+        W = hist.shape[1]
+        key = ("fused_spec", S, B, W, n_steps, draft_width, max_ngram,
+               sampling is not None)
+        fn = self._fwd_cache.get(key)
+        if fn is None:
+            if self._mesh_ctx is not None:
+                cache_sh = jax.tree_util.tree_map(lambda a: a.sharding,
+                                                  kv.cache)
+                out_sh = ((None, None, None, cache_sh) if sampling is None
+                          else (None, None, None, None, cache_sh))
+                kw = {"out_shardings": out_sh}
+            else:
+                kw = {}
+            fn = jax.jit(partial(_fused_spec_decode_loop, config=self.config,
+                                 block_size=self.kv_block_size,
+                                 attn_backend=self.attn_backend,
+                                 tp_size=self.tp_size,
+                                 kv_pad=self._kv_pad,
+                                 total_slots=total_slots,
+                                 n_steps=n_steps,
+                                 d=draft_width,
+                                 max_ngram=max_ngram,
+                                 sample=sampling is not None,
+                                 mesh=(self._mesh_ctx.mesh
+                                       if self._mesh_ctx is not None else None)),
+                         donate_argnums=(1, ), **kw)
+            self._fwd_cache[key] = fn
+        args = (self.params, kv.cache, jnp.asarray(tokens),
+                jnp.asarray(seq_lens), jnp.asarray(live),
+                jnp.asarray(block_table), jnp.asarray(hist),
+                jnp.asarray(hist_len), jnp.asarray(ngrams),
+                jnp.asarray(max_drafts))
+        if sampling is None:
+            out, n_emit, dlen, new_cache = fn(*args)
+            kv.update(new_cache)
+            out, n_emit, dlen = jax.device_get((out, n_emit, dlen))
+            return np.asarray(out), np.asarray(n_emit), np.asarray(dlen), None
+        sargs = {k: jnp.asarray(v) for k, v in sampling.items()}
+        out, n_emit, dlen, new_keys, new_cache = fn(*args, **sargs)
+        kv.update(new_cache)
+        out, n_emit, dlen, new_keys = jax.device_get(
+            (out, n_emit, dlen, new_keys))
+        return (np.asarray(out), np.asarray(n_emit), np.asarray(dlen),
+                np.asarray(new_keys))
+
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
@@ -824,3 +902,84 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
     if not sample:
         return out, cache
     return out, lps, keys, cache
+
+
+def _fused_spec_decode_loop(params, cache, tokens, seq_lens, live, block_table,
+                            hist, hist_len, ngrams, max_drafts,
+                            keys=None, temps=None, top_ks=None, top_ps=None, *,
+                            config, block_size, attn_backend, tp_size, kv_pad,
+                            total_slots, n_steps, d, max_ngram, mesh,
+                            sample=False):
+    """K speculative windows under one lax.scan — the speculative sibling
+    of ``_fused_decode_loop``. Each iteration: draft from the carried
+    history ring, build the multi-token RaggedBatch **in-trace** (1+d
+    tokens per row; token-major fields of length S*(1+d); per-position KV
+    slots from the carried ``lens`` — writes past the accepted length are
+    overwritten by the next window, which is the whole on-device rollback
+    story), run ``_ragged_forward`` with ``window_logits=True``, verify on
+    device, append the emitted tokens to the ring, and advance ``lens`` by
+    the per-row emit count. Dead (padding) rows scatter to the OOB drop
+    slot, emit nothing, and never advance.
+
+    ``sample=False`` verifies by exact argmax match — byte-identical to
+    the host ``accept_drafts`` — with no sort/filter/PRNG work in the
+    trace. ``sample=True`` runs ``ops/sampling.spec_verify_window``
+    (rejection sampling against the point-mass drafts, one key split per
+    row per WINDOW), the same function the host fallback applies
+    row-at-a-time, so streams agree bit-for-bit under the same keys."""
+    from ...ops import sampling as dsamp
+    S, B = block_table.shape
+    Np1 = 1 + d
+    ar = jnp.arange(S, dtype=jnp.int32)
+    jw = jnp.arange(Np1, dtype=jnp.int32)
+    live_i = live.astype(jnp.int32)
+
+    def body(carry, _):
+        cache, toks, lens, hist, hlen, keys = carry
+        drafts, dlen = dsamp.ngram_draft_ring(
+            hist, hlen, ngrams, max_drafts, max_ngram=max_ngram, d=d)
+        dlen = jnp.where(live_i > 0, dlen, 0)
+        feed = jnp.concatenate([toks[:, None], drafts], axis=1)   # [S, 1+d]
+        pos = lens[:, None] + jw[None, :]
+        slot = (block_table[ar[:, None], pos // block_size] * block_size
+                + pos % block_size)
+        slot = jnp.where(live_i[:, None] > 0, slot, total_slots)
+        batch = RaggedBatch(
+            tokens=feed.reshape(-1), token_seq=jnp.repeat(ar, Np1),
+            token_pos=pos.reshape(-1), token_slot=slot.reshape(-1),
+            seq_start=ar * Np1, seq_n_new=live_i * Np1, seq_seen=lens,
+            block_table=block_table, last_token_idx=ar * Np1,
+            q_tok_idx=(ar * Np1)[:, None] + jw[None, :])
+        logits, cache = _ragged_forward(
+            params, cache, batch, config=config, block_size=block_size,
+            attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
+            mesh=mesh, window_logits=True)               # [S, 1+d, V]
+        if sample:
+            out, n_emit, keys = dsamp.spec_verify_window(
+                logits, drafts, dlen, keys, temps, top_ks, top_ps, d=d)
+        else:
+            g_tok = jnp.argmax(logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)         # [S, 1+d]
+            acc = (drafts == g_tok[:, :d]) & (jw[None, :d] < dlen[:, None])
+            m = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                        axis=1).astype(jnp.int32)
+            corr = g_tok[ar, m]
+            drafts_pad = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)
+            out = jnp.where(jw[None, :] < m[:, None], drafts_pad,
+                            corr[:, None])
+            n_emit = m + 1
+        n_emit = jnp.where(live_i > 0, n_emit, 0)
+        last = out[ar, jnp.maximum(n_emit - 1, 0)]
+        toks = jnp.where(live_i > 0, last, toks)
+        hist, hlen = dsamp.ring_append(hist, hlen, out, n_emit)
+        lens = lens + n_emit
+        return (cache, toks, lens, hist, hlen, keys), (out, n_emit, dlen)
+
+    if not sample:
+        keys = jnp.zeros((S, 2), jnp.uint32)
+    carry0 = (cache, tokens, seq_lens, hist, hist_len, keys)
+    (cache, _, _, _, _, keys), (out, n_emit, dlen) = jax.lax.scan(
+        body, carry0, jnp.arange(n_steps, dtype=jnp.int32))
+    if not sample:
+        return out, n_emit, dlen, cache
+    return out, n_emit, dlen, keys, cache

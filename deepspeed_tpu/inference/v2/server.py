@@ -12,8 +12,9 @@ TPU-native and stdlib-only:
   forward of at most ``token_budget`` tokens where decoding sequences are
   guaranteed their token first and prefills chunk into the remainder
   (a drafted tick adds a separate windowed put — speculative decoding
-  rides the same loop). Per-request sampling controls, logprobs, token
-  streaming. Admission reserves full decode headroom (prompt +
+  rides the same loop, and in steady state eligible speculative rows run
+  their draft/verify/accept entirely on device inside the fused K-window
+  scan). Per-request sampling controls, logprobs, token streaming. Admission reserves full decode headroom (prompt +
   max_new_tokens blocks) exactly like ``InferenceEngineV2.generate`` so
   a tick cannot run the allocator dry; if it still does (best-effort
   admission), the newest sequence is evicted and replayed.
@@ -85,6 +86,12 @@ class _Request:
     draft_ngram: int = 2
     return_logprobs: bool = False
     logprobs: list = field(default_factory=list)
+    # speculative accept-rate accounting (drafted tokens offered / accepted)
+    drafted: int = 0
+    accepted: int = 0
+    # host prompt-lookup fallback: cached last-match position so the
+    # bounded backward scan usually starts where it last succeeded
+    match_cache: dict = field(default_factory=dict)
     # scheduler state
     outputs: List[int] = field(default_factory=list)
     fed: int = 0                   # tokens of prompt+outputs already in KV
@@ -160,6 +167,21 @@ class RequestHandle:
         toks = self.result(timeout)
         return toks, list(self._req.logprobs[:len(toks)])
 
+    @property
+    def stats(self) -> dict:
+        """Per-request accounting. For speculative requests this carries
+        the accept-rate counters (``drafted`` tokens offered for
+        verification, ``accepted`` of them kept), available live and after
+        ``result()``."""
+        r = self._req
+        out = {"tokens": len(r.outputs)}
+        if r.speculative is not None:
+            out["drafted"] = r.drafted
+            out["accepted"] = r.accepted
+            out["accept_rate"] = (round(r.accepted / r.drafted, 4)
+                                  if r.drafted else None)
+        return out
+
     def cancel(self) -> None:
         self._req.cancelled = True
         if self._req.wake is not None:
@@ -207,6 +229,13 @@ class ServingScheduler:
         self._device_sampling = bool(scfg and scfg.device_sampling)
         self._fused_sampled = bool(self._device_sampling
                                    and scfg.fused_sampled_decode)
+        # fused speculative: eligible speculative rows (no host callbacks,
+        # device-matchable ngram) run draft+verify+accept inside the K-step
+        # scan — one dispatch + one fetch per window instead of one host
+        # round-trip per token. Gate-off keeps the per-token host path (the
+        # parity oracle) for everything.
+        self._fused_spec = bool(scfg and scfg.fused_speculative_decode)
+        self._spec_max_ngram = int(scfg.spec_max_ngram) if scfg else 8
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._inbox: List[_Request] = []
@@ -235,7 +264,8 @@ class ServingScheduler:
         # under the same lock)
         self._trace = {"shed": 0, "expired_queue": 0, "expired_live": 0,
                        "tick_errors": 0, "quarantined": [],
-                       "watchdog_trips": 0, "slow_consumer_cancels": 0}
+                       "watchdog_trips": 0, "slow_consumer_cancels": 0,
+                       "spec_drafted": 0, "spec_accepted": 0}
         # last-256 completed requests for the metrics aggregates
         from collections import deque
         self._completed: "deque" = deque(maxlen=256)
@@ -278,18 +308,21 @@ class ServingScheduler:
         if speculative is not None:
             if speculative != "prompt_lookup":
                 raise ValueError(f"unknown speculative mode {speculative!r}")
-            if (temperature != 0.0 or top_k or top_p != 1.0
-                    or min_new_tokens or repetition_penalty != 1.0
+            if (min_new_tokens or repetition_penalty != 1.0
                     or logits_processor is not None or return_logprobs):
-                # ValueError → the HTTP handler's 400 (not a dead request):
-                # top_k/top_p are rejected here too — the greedy window
-                # verify compares raw argmax per position and cannot
-                # reproduce a filtered sampling distribution
-                raise ValueError("speculative decoding is greedy-only "
-                                 "(temperature=0, no top_k/top_p) and does "
-                                 "not compose with min_new_tokens/"
-                                 "repetition_penalty/logits_processor/"
-                                 "logprobs")
+                # ValueError → the HTTP handler's 400 (not a dead request).
+                # temperature/top_k/top_p are FINE now: the window verify
+                # rejection-samples against the draft point masses on the
+                # per-sequence key chains. The leftovers here mutate the
+                # distribution per emitted token (penalty/min_new) or need
+                # host callbacks/per-token logprobs a multi-token accept
+                # cannot honor.
+                raise ValueError("speculative decoding does not compose "
+                                 "with min_new_tokens/repetition_penalty/"
+                                 "logits_processor/logprobs")
+            if temperature != 0.0 and not self._device_sampling:
+                raise ValueError("speculative sampling requires "
+                                 "sampling.device_sampling")
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
@@ -353,6 +386,8 @@ class ServingScheduler:
             shed, quarantined = tr["shed"], len(tr["quarantined"])
             expired = tr["expired_queue"] + tr["expired_live"]
             watchdog_trips = tr["watchdog_trips"]
+            spec_drafted = tr["spec_drafted"]
+            spec_accepted = tr["spec_accepted"]
         out = {"waiting": len(self._waiting) + inbox,
                "live": len(self._live),
                "free_blocks": self._engine.free_blocks,
@@ -366,6 +401,10 @@ class ServingScheduler:
                "expired": expired,
                "quarantined": quarantined,
                "watchdog_trips": watchdog_trips,
+               "spec_drafted": spec_drafted,
+               "spec_accepted": spec_accepted,
+               "spec_accept_rate": (round(spec_accepted / spec_drafted, 4)
+                                    if spec_drafted else None),
                "completed": len(done)}
         done = [d for d in done if d[3] > 0]
         if done:
@@ -751,6 +790,14 @@ class ServingScheduler:
 
             eligible = [r for r in decodes if _fusable(r)]
             fused = self._fused_tick(eligible) if eligible else []
+            # speculative rows run their OWN fused wave (the draft/verify
+            # scan feeds 1+d tokens per window — a different program from
+            # the 1-token fused decode), grouped so one dispatch still
+            # serves everything with the same feed geometry
+            spec_rows = [r for r in decodes
+                         if r in self._live and _prefilled(r)
+                         and self._spec_fusable(r)]
+            fused += self._fused_spec_tick(spec_rows) if spec_rows else []
             if fused:
                 # exclude exactly the requests the fused dispatch advanced;
                 # near-budget greedy stragglers the partition left out stay
@@ -777,7 +824,10 @@ class ServingScheduler:
                            req.max_new_tokens - len(req.outputs) - 1)
                 d = InferenceEngineV2.prompt_lookup_draft(
                     req.prompt + req.outputs,
-                    draft_ngram=req.draft_ngram, max_tokens=room)
+                    draft_ngram=req.draft_ngram, max_tokens=room,
+                    match_window=self._engine.spec_ring_window(
+                        req.num_draft_tokens),
+                    match_cache=req.match_cache)
                 if d:
                     drafted[req.uid] = d
                     chunk = chunk + d
@@ -891,6 +941,72 @@ class ServingScheduler:
         self._retire_finished()
         return fused
 
+    def _spec_fusable(self, r: _Request) -> bool:
+        """Speculative rows the device can own end-to-end: drafting from
+        the ring buffer, verification, and (for sampled requests) the
+        rejection-sampling accept all run inside the fused scan. Host
+        ``logits_processor`` callbacks are rejected at submit; a gate-off
+        or an over-wide ngram keeps the per-token host path — the parity
+        oracle."""
+        if r.speculative is None or not self._fused_spec:
+            return False
+        if r.draft_ngram > self._spec_max_ngram:
+            return False
+        return r.temperature == 0.0 or self._device_sampling
+
+    def _fused_spec_tick(self, decodes) -> list:
+        """K speculative draft/verify windows for the given rows in one
+        dispatch per (draft width, ngram) group — the feed geometry
+        ``1 + num_draft_tokens`` is a static of the compiled program, so
+        heterogeneous widths run as separate waves (one dispatch each;
+        workloads are typically homogeneous). Token accounting: the device
+        emits between K and K*(1+d) tokens per row; ``fed`` advances by
+        the emitted count so the pending==1 decode invariant holds, and
+        the accept counters feed the per-request + /health observability."""
+        groups = {}
+        for r in decodes:
+            groups.setdefault((r.num_draft_tokens, r.draft_ngram),
+                              []).append(r)
+        advanced = []
+        for (d, ng), rows in groups.items():
+            fusable_uids, K, _solo = self._engine.fused_spec_partition(
+                [r.uid for r in rows],
+                [r.max_new_tokens - len(r.outputs) for r in rows],
+                d, self._fused_window)
+            if K < 2:
+                continue
+            fusable_set = set(fusable_uids)
+            fused = [r for r in rows if r.uid in fusable_set]
+            all_greedy = all(r.temperature == 0.0 for r in fused)
+            try:
+                toks_lists, drafted, accepted = \
+                    self._engine.fused_spec_decode_steps(
+                        [r.uid for r in fused], [r.feed for r in fused], K,
+                        num_draft_tokens=d, draft_ngram=ng,
+                        specs=None if all_greedy
+                        else [self._spec_for(r) for r in fused])
+            except SchedulingError:
+                continue  # KV pressure: the per-token tick owns eviction
+            for req, row, dr, ac in zip(fused, toks_lists, drafted,
+                                        accepted):
+                req.fed += len(row)
+                req.drafted += dr
+                req.accepted += ac
+                self._trace["spec_drafted"] += dr
+                self._trace["spec_accepted"] += ac
+                self._emit_many(req, row)
+                if not self._engine.decode_finished(
+                        req.uid, req.outputs, req.max_new_tokens,
+                        req.eos_token_id, req.stop):
+                    # deferred bookkeeping exactly like _fused_tick:
+                    # retiring rows flush in _retire_finished instead
+                    seq = self._engine._state_manager.get_sequence(req.uid)
+                    self._engine._register_pending(seq)
+                    self._engine._model.maybe_free_kv(seq)
+            advanced.extend(fused)
+        self._retire_finished()
+        return advanced
+
     def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:
         """One ragged put + row processing. Returns None if KV exhaustion
         evicted a sequence (the tick must end: the eviction may have
@@ -936,16 +1052,36 @@ class ServingScheduler:
                 return None
         device_wave = []  # (req, logits_row) — one batched sample dispatch
         for req, chunk, row in zip(reqs, chunks, logits):
+            spec_sampled = (req.speculative is not None
+                            and req.temperature != 0.0)
             d = drafted.get(req.uid, [])
             if d:
-                new_toks, m = self._engine.accept_drafts(req.uid, d, row)
+                if spec_sampled:
+                    new_toks, m = self._engine.accept_drafts_sampled(
+                        req.uid, d, row, self._spec_for(req),
+                        req.num_draft_tokens)
+                else:
+                    new_toks, m = self._engine.accept_drafts(req.uid, d, row)
                 req.fed += 1 + m
+                req.drafted += len(d)
+                req.accepted += m
+                self._trace["spec_drafted"] += len(d)
+                self._trace["spec_accepted"] += m
                 self._emit_many(req, new_toks)
             else:
                 req.fed += len(chunk)
                 if req.pending == 0:  # feed complete: row is the next token
                     last = row[len(chunk) - 1] if use_window else row
-                    if self._device_eligible(req):
+                    if spec_sampled:
+                        # a draft-free step of a sampled speculative request
+                        # still burns its per-WINDOW key (accept with an
+                        # empty draft) so the key chain advances once per
+                        # step on every path, fused or not
+                        new_toks, _ = self._engine.accept_drafts_sampled(
+                            req.uid, [], last, self._spec_for(req),
+                            req.num_draft_tokens)
+                        self._emit_many(req, new_toks)
+                    elif self._device_eligible(req):
                         device_wave.append((req, last))
                     else:
                         self._emit(req, last)
@@ -1269,6 +1405,8 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     "usage": {"completion_tokens": len(tokens)}})
                 return
             out = {"tokens": tokens}
+            if body.get("speculative"):
+                out["spec"] = handle.stats  # drafted/accepted/accept_rate
             if body.get("logprobs"):
                 out["logprobs"] = handle.result_with_logprobs()[1]
             if text is not None:

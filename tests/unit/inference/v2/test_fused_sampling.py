@@ -130,10 +130,13 @@ def test_one_dispatch_per_k_tokens_fully_sampled():
     assert c1["fused"] == 0
 
 
-def test_http_speculative_with_sampling_is_400():
-    """A speculative request that also sets sampling knobs must surface as
-    HTTP 400 with the composability message — not a 500 or a dead
-    request."""
+def test_http_speculative_submit_matrix():
+    """Speculative + sampling is now a 200 (on-device rejection sampling
+    verifies drafts against the per-sequence key chains); only the combos a
+    multi-token accept genuinely cannot honor — per-emitted-token
+    distribution mutation (min_new_tokens, repetition_penalty), host
+    callbacks, per-token logprobs — remain 400 with the composability
+    message, and they surface as 400, not a 500 or a dead request."""
     eng = _engine()
     sched = ServingScheduler(eng, idle_wait=0.005).start()
     httpd = create_http_server(sched, "127.0.0.1", 0)
@@ -141,8 +144,8 @@ def test_http_speculative_with_sampling_is_400():
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     try:
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
-        for knobs in ({"temperature": 0.7}, {"top_k": 5}, {"top_p": 0.9},
-                      {"repetition_penalty": 1.2}, {"logprobs": True}):
+        for knobs in ({"min_new_tokens": 2}, {"repetition_penalty": 1.2},
+                      {"logprobs": True}):
             body = {"prompt": [1, 2, 3], "max_new_tokens": 4,
                     "speculative": "prompt_lookup", **knobs}
             conn.request("POST", "/generate", json.dumps(body),
@@ -150,15 +153,23 @@ def test_http_speculative_with_sampling_is_400():
             resp = conn.getresponse()
             payload = json.loads(resp.read())
             assert resp.status == 400, knobs
-            assert "greedy-only" in payload["error"], knobs
-        # plain speculative still accepted
-        conn.request("POST", "/generate",
-                     json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 3,
-                                 "speculative": "prompt_lookup"}),
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        assert resp.status == 200
-        assert len(json.loads(resp.read())["tokens"]) == 3
+            assert "does not compose" in payload["error"], knobs
+        # plain speculative, and speculative + sampling, both accepted;
+        # the response carries the accept-rate stats
+        for knobs in ({}, {"temperature": 0.7},
+                      {"temperature": 0.8, "top_k": 5, "top_p": 0.9,
+                       "seed": 7}):
+            conn.request("POST", "/generate",
+                         json.dumps({"prompt": [1, 2, 3, 1, 2, 3, 1, 2],
+                                     "max_new_tokens": 3,
+                                     "speculative": "prompt_lookup",
+                                     **knobs}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            assert resp.status == 200, (knobs, payload)
+            assert len(payload["tokens"]) == 3
+            assert {"drafted", "accepted"} <= set(payload["spec"]), knobs
     finally:
         httpd.shutdown()
         sched.stop()

@@ -80,9 +80,18 @@ def test_speculative_eos_and_validation():
     got = eng_b.generate([prompt], max_new_tokens=12, eos_token_id=5,
                          speculative="prompt_lookup", num_draft_tokens=4)
     assert got == ref
-    with pytest.raises(ValueError, match="greedy-only"):
+    # speculative + sampling is ACCEPTED now (on-device rejection
+    # sampling); only per-emitted-token mutations and logprobs remain out
+    sampled = eng_b.generate([prompt], max_new_tokens=4,
+                             speculative="prompt_lookup", temperature=0.7,
+                             seed=3)
+    assert len(sampled[0]) == 4
+    with pytest.raises(ValueError, match="does not return logprobs"):
         eng_b.generate([prompt], max_new_tokens=2,
-                       speculative="prompt_lookup", temperature=0.7)
+                       speculative="prompt_lookup", return_logprobs=True)
+    with pytest.raises(ValueError, match="does not compose"):
+        eng_b.generate([prompt], max_new_tokens=2,
+                       speculative="prompt_lookup", repetition_penalty=1.2)
     with pytest.raises(ValueError, match="unknown speculative"):
         eng_b.generate([prompt], max_new_tokens=2, speculative="medusa")
 
