@@ -166,6 +166,10 @@ run bench_serving_sampled 1500 env DS_BENCH_SAMPLED=1 DS_BENCH_FAST=1 python ben
 # off vs on — goodput, shed rate, p99 TTFT (the resilience layer's
 # keep-latency-under-saturation evidence)
 run bench_serving_overload 1200 env DS_BENCH_OVERLOAD=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_OVERLOAD.json
+# 15h. durable-serving recovery: kill mid-decode, warm-restart over the
+# journal — rebuild/replay time, time-to-first-resumed-token, and the
+# bit_identical flag (the durability layer's correctness + cost evidence)
+run bench_serving_restart 1200 env DS_BENCH_RESTART=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_RESTART.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

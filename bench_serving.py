@@ -91,6 +91,17 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                                          ctx=contexts[0] // 2
                                          if on_tpu else 64,
                                          new_tokens=decode_steps))
+    # DS_BENCH_RESTART=1: durable-serving recovery — kill the scheduler
+    # loop mid-decode (serve.crash), warm-restart over the same journal,
+    # and measure recovery time + time-to-first-resumed-token, with a
+    # bit-identical check of every resumed stream against an
+    # uninterrupted run
+    if env_flag("DS_BENCH_RESTART"):
+        results.extend(_measure_restart(cfg, kv_block, backends[0],
+                                        n_requests=8 if on_tpu else 3,
+                                        ctx=contexts[0] // 2
+                                        if on_tpu else 64,
+                                        new_tokens=decode_steps))
     # DS_BENCH_MOE=1: Mixtral-style expert-parallel decode through the v2
     # engine (ops/grouped_matmul in the ragged forward) — tok/s +
     # decode_step_ms like the dense rungs, so MoE serving regressions are
@@ -560,6 +571,108 @@ def _measure_overload(cfg, kv_block, backend, n_capacity, ctx, new_tokens):
             "p99_ttft_s": round(p99, 3) if p99 is not None else None,
             "wall_s": round(dt, 2)})
     return rows
+
+
+def _measure_restart(cfg, kv_block, backend, n_requests, ctx, new_tokens):
+    """Durable-serving recovery rung: N fixed-seed sampled requests are
+    decoding when the scheduler loop is killed (``serve.crash``); a fresh
+    engine + scheduler over the same journal then replays them. Reports
+    engine rebuild time, journal-replay (scheduler boot) time, time from
+    the new boot to the first RESUMED token, and whether every
+    concatenated pre-crash + post-restart stream is bit-identical to an
+    uninterrupted run."""
+    import os
+    import tempfile
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (ServingScheduler,
+                                            build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+    rng = np.random.default_rng(47)
+    prompts = [rng.integers(0, cfg.vocab_size, size=ctx).tolist()
+               for _ in range(n_requests)]
+    submits = [dict(prompt=p, max_new_tokens=new_tokens, temperature=0.8,
+                    top_k=20, seed=100 + i) for i, p in enumerate(prompts)]
+    jdir = tempfile.mkdtemp(prefix="ds_bench_journal_")
+    old_jdir = os.environ.get("DS_TPU_JOURNAL_DIR")
+    os.environ["DS_TPU_JOURNAL_DIR"] = jdir
+
+    def _build(durable):
+        eng = build_llama_engine(
+            cfg, engine_config=RaggedInferenceEngineConfig(
+                num_kv_blocks=(n_requests + 2)
+                * ((ctx + new_tokens) // kv_block + 2),
+                durable_serving={"enabled": durable}),
+            kv_block_size=kv_block)
+        eng.model().attn_backend = backend
+        eng.generate([prompts[0], prompts[1]], max_new_tokens=2)
+        bss = [b for b in (1, 2, 4, 8, 16, 32) if b <= n_requests]
+        eng.warmup(prefill_lens=(), batch_sizes=bss, fused_windows=(16, ),
+                   decode_context=ctx)
+        return eng
+
+    try:
+        # uninterrupted reference (durable off: pristine journal for run 2)
+        sched = ServingScheduler(_build(False), idle_wait=0.001).start()
+        hs = [sched.submit(**kw) for kw in submits]
+        ref = [h.result(600) for h in hs]
+        sched.stop()
+
+        # crash mid-decode
+        get_fault_injector().configure({"faults": [{
+            "site": "serve.crash", "nth": 6}]})
+        s1 = ServingScheduler(_build(True), idle_wait=0.001).start()
+        h1 = [s1.submit(**kw) for kw in submits]
+        t_wait = time.perf_counter()
+        while not s1.stats["stopped"]:
+            time.sleep(0.005)
+            if time.perf_counter() - t_wait > 600:
+                raise TimeoutError("injected crash never fired")
+        get_fault_injector().reset()
+        pre = [list(h._req.outputs) for h in h1]
+        t_crash = time.perf_counter()
+
+        # warm restart: rebuild + replay, then time the first resumed token
+        eng2 = _build(True)
+        t_built = time.perf_counter()
+        s2 = ServingScheduler(eng2, idle_wait=0.001).start()
+        t_replayed = time.perf_counter()
+        marks = [len(p) for p in pre]
+        ttfrt = None
+        while time.perf_counter() - t_replayed < 600:
+            handles = [s2.lookup(uid) for uid in range(1, n_requests + 1)]
+            if any(h is not None and len(h._req.outputs) > m
+                   for h, m in zip(handles, marks)):
+                ttfrt = time.perf_counter() - t_replayed
+                break
+            time.sleep(0.001)
+        outs = [s2.lookup(uid).result(600)
+                for uid in range(1, n_requests + 1)]
+        replayed = s2.stats["replayed_requests"]
+        s2.stop()
+        bit_identical = all(
+            o == r and o[:len(p)] == p
+            for o, r, p in zip(outs, ref, pre))
+        return [{
+            "backend": backend, "context": ctx, "restart": True,
+            "requests": n_requests, "new_tokens_per_req": new_tokens,
+            "replayed": replayed,
+            "pre_crash_tokens": sum(marks),
+            "rebuild_s": round(t_built - t_crash, 3),
+            "replay_s": round(t_replayed - t_built, 3),
+            "first_resumed_token_s": (round(ttfrt, 3)
+                                      if ttfrt is not None else None),
+            "recovery_total_s": round(
+                t_replayed - t_crash + (ttfrt or 0), 3),
+            "bit_identical": bit_identical,
+        }]
+    finally:
+        get_fault_injector().reset()
+        if old_jdir is None:
+            os.environ.pop("DS_TPU_JOURNAL_DIR", None)
+        else:
+            os.environ["DS_TPU_JOURNAL_DIR"] = old_jdir
 
 
 def _measure_prefix_caching(cfg, ctx, kv_block, backend):

@@ -41,29 +41,36 @@ from .elasticity import compute_elastic_config
 _probed_world: Optional[int] = None
 
 
-def _default_world_fn() -> int:
-    """Available world: ``DS_ELASTIC_WORLD_SIZE`` if set, else ONE device
-    probe in a subprocess (importing jax here would initialize the TPU
-    backend inside the supervisor and lock it away from the very child it
-    launches). The probed value is cached — live membership changes need a
-    caller-supplied ``world_fn`` (a scheduler hook); a process's env cannot
-    change under it, so the default path cannot observe scale events."""
+def _probe_world() -> int:
+    """One device-count probe in a subprocess (importing jax here would
+    initialize the TPU backend inside the supervisor and lock it away from
+    the very child it launches)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.device_count())"],
+            capture_output=True, text=True, timeout=120)
+        return int(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 — no backend reachable
+        logger.warning(
+            "DSElasticAgent: could not probe device count (set "
+            "DS_ELASTIC_WORLD_SIZE or pass world_fn); assuming 1")
+        return 1
+
+
+def _default_world_fn(refresh: bool = False) -> int:
+    """Available world: ``DS_ELASTIC_WORLD_SIZE`` if set, else a cached
+    subprocess device probe. The cache keeps the steady-state monitor poll
+    cheap, but it is NOT authoritative across a relaunch: the agent passes
+    ``refresh=True`` on its restart paths so a membership change that
+    crashed the child is observed instead of shadowed by the stale cached
+    value (which previously won for the whole process lifetime)."""
     w = os.environ.get("DS_ELASTIC_WORLD_SIZE")
     if w:
         return int(w)
     global _probed_world
-    if _probed_world is None:
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.device_count())"],
-                capture_output=True, text=True, timeout=120)
-            _probed_world = int(out.stdout.strip().splitlines()[-1])
-        except Exception:  # noqa: BLE001 — no backend reachable
-            logger.warning(
-                "DSElasticAgent: could not probe device count (set "
-                "DS_ELASTIC_WORLD_SIZE or pass world_fn); assuming 1")
-            _probed_world = 1
+    if _probed_world is None or refresh:
+        _probed_world = _probe_world()
     return _probed_world
 
 
@@ -88,6 +95,16 @@ class DSElasticAgent:
         self.history: List[dict] = []
 
     # ------------------------------------------------------------------
+
+    def _world(self, refresh: bool = False) -> int:
+        """Currently-available world. A caller-supplied ``world_fn`` is
+        always authoritative — it is invoked directly and its result is
+        never shadowed by the module's cached device probe. Only the
+        default probe honors ``refresh`` (relaunch paths force it so a
+        membership change across a crash is actually observed)."""
+        if self.world_fn is not _default_world_fn:
+            return self.world_fn()
+        return _default_world_fn(refresh=refresh)
 
     def _resolve_world(self, want: int) -> int:
         """Largest world ≤ want that the elastic config accepts (a shrunk
@@ -120,7 +137,7 @@ class DSElasticAgent:
     def run(self) -> int:
         """Supervise until clean exit, budget exhaustion, or an
         unsatisfiable world. Returns the final child returncode."""
-        world = self._resolve_world(self.world_fn())
+        world = self._resolve_world(self._world())
         proc = self._launch(world)
         try:
             while True:
@@ -140,10 +157,13 @@ class DSElasticAgent:
                         f"restart {self.restarts}/{self.max_restarts}")
                     if self.restart_backoff:
                         time.sleep(self.restart_backoff)
-                    world = self._resolve_world(self.world_fn())
+                    # the crash may itself be the membership change (device
+                    # loss) — re-probe instead of trusting the launch-time
+                    # cached world
+                    world = self._resolve_world(self._world(refresh=True))
                     proc = self._launch(world)
                     continue
-                avail = self._resolve_world(self.world_fn())
+                avail = self._resolve_world(self._world())
                 if avail != world:
                     # membership change: drain the child and relaunch at the
                     # new world (reference agent's rendezvous-version bump)

@@ -7,6 +7,7 @@ the jax stack, device inventory, mesh capability, and the op registry
 """
 
 import importlib
+import os
 import sys
 
 
@@ -94,6 +95,18 @@ def debug_report():
         lines.append(f"speculative draft source {'.' * 24} {src}")
     except Exception as e:  # pragma: no cover
         lines.append(f"speculative draft source {'.' * 24} {NO} ({e})")
+    try:
+        # durable serving: where the write-ahead request journal would land
+        # (env/XDG resolution) and whether that directory is writable — the
+        # first thing to check when warm restart isn't replaying anything
+        from .inference.v2.journal import journal_dir
+        jd = journal_dir()
+        writable = os.access(jd if os.path.isdir(jd)
+                             else os.path.dirname(jd) or ".", os.W_OK)
+        lines.append(f"serving journal dir {'.' * 29} "
+                     f"{jd} [{'writable' if writable else 'NOT writable'}]")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"serving journal dir {'.' * 29} {NO} ({e})")
     try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")

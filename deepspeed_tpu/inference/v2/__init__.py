@@ -7,10 +7,13 @@ Dynamic SplitFuse scheduling semantics (``can_schedule``/``query``).
 
 from .config_v2 import (RaggedInferenceEngineConfig, DSStateManagerConfig,
                         KVCacheConfig, SamplingConfig,
-                        ServingResilienceConfig)
+                        ServingResilienceConfig, DurableServingConfig)
 from .scheduling_utils import (SchedulingResult, SchedulingError,
                                DeadlineExceeded, SchedulerOverloaded)
 from .engine_v2 import (InferenceEngineV2, SampleSpec, build_llama_engine,
                         load_engine)
-from .server import ServingScheduler, RequestHandle, serve
+from .journal import RequestJournal, JournalEntry, ServingCrash, journal_dir
+from .server import (ServingScheduler, RequestHandle, serve,
+                     install_sigterm_handoff)
+from .supervisor import ServingSupervisor
 from .pipeline import InferencePipeline, pipeline

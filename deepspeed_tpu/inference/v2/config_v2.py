@@ -175,6 +175,40 @@ class ServingResilienceConfig(ConfigModel):
     requests with a deadline use the tighter of the two."""
 
 
+class DurableServingConfig(ConfigModel):
+    """Crash durability for the serving daemon: a write-ahead request
+    journal plus warm-restart replay. With the journal on, a daemon crash
+    (or SIGTERM handoff) loses no admitted request — the next boot re-admits
+    every unfinished request with its original uid and deadline, force-feeds
+    the already-emitted tokens as prefix, and fast-forwards the sampling key
+    chain by the journaled burn count, so resumed greedy AND sampled streams
+    continue byte-identically to an uninterrupted run."""
+
+    enabled: bool = False
+    """Master gate. False (default) keeps serving journal-free: no WAL
+    writes, no replay on start — exactly the pre-durability scheduler."""
+
+    journal_dir: Optional[str] = None
+    """Journal directory. None resolves ``$DS_TPU_JOURNAL_DIR`` →
+    ``$XDG_CACHE_HOME/deepspeed_tpu/journal`` → ``~/.cache/...`` (never a
+    repo-relative path). Point daemon generations that should hand off to
+    each other at the same directory."""
+
+    fsync_policy: str = "admit"
+    """``admit``: fsync admit/finish records (the durability boundary),
+    flush-only progress records — losing a progress tail only costs
+    deterministic regeneration. ``always``: fsync every record.
+    ``never``: flush only (tests / throwaway deployments)."""
+
+    compact_every: int = 64
+    """Rewrite the segment (dropping finished requests) every this many
+    finish records. Compaction also runs once on every recovery."""
+
+    replay_on_start: bool = True
+    """Re-admit journaled unfinished requests when the scheduler starts.
+    False boots with a clean slate but keeps journaling new requests."""
+
+
 class QuantizationConfig(ConfigModel):
     quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
 
@@ -191,6 +225,8 @@ class RaggedInferenceEngineConfig(ConfigModel):
     sampling: SamplingConfig = Field(default_factory=SamplingConfig)
     serving_resilience: ServingResilienceConfig = Field(
         default_factory=ServingResilienceConfig)
+    durable_serving: DurableServingConfig = Field(
+        default_factory=DurableServingConfig)
 
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).

@@ -49,6 +49,21 @@ class SampleSpec:
     min_new: int = 0
 
 
+_FF_KEY = None
+
+
+def _fast_forward_key(key, n: int):
+    """Advance a PRNG key by ``n`` chain burns: iterated ``split(key, 2)[0]``
+    — the exact per-dispatch advance of ``sample_core`` and
+    ``spec_verify_window``. Jitted once (dynamic trip count) so replaying a
+    long stream costs one dispatch, not ``n``."""
+    global _FF_KEY
+    if _FF_KEY is None:
+        _FF_KEY = jax.jit(lambda k, m: jax.lax.fori_loop(
+            0, m, lambda i, kk: jax.random.split(kk, 2)[0], k))
+    return _FF_KEY(key, np.int32(n))
+
+
 def _fire_request_poison(uids) -> None:
     """``serve.request_poison`` fault site: a configured request uid makes
     ANY device dispatch whose batch contains it raise — per-token put,
@@ -520,6 +535,21 @@ class InferenceEngineV2:
             self.seed_sampler(uid, seed)
             k = self._sample_keys[uid]
         return k
+
+    def fast_forward_sampler(self, uid: int, seed: int, burns: int) -> None:
+        """Recreate a sequence's device PRNG key at chain position ``burns``:
+        the state after that many counted key burns (one per sampled
+        per-token dispatch, one per verified speculative window, one per
+        fused scan step). Every sampling path advances keys the same way —
+        ``split(key, 2)[0]`` — so iterating that split from ``PRNGKey(seed)``
+        lands exactly where an uninterrupted run would be, and a replayed
+        request's stream continues bit-identically (journal warm restart,
+        eviction re-admission)."""
+        key = jax.random.PRNGKey(int(seed))
+        n = int(burns)
+        if n > 0:
+            key = _fast_forward_key(key, n)
+        self.seed_sampler(uid, key=key)
 
     def spec_ring_window(self, num_draft_tokens: int) -> int:
         """Effective token-history window for prompt-lookup drafting. The

@@ -1,0 +1,362 @@
+"""Crash-consistent write-ahead request journal for the serving daemon.
+
+Durability contract: every admitted request is journaled (prompt, sampling
+params, seed, deadlines) before the caller's submit() returns, and the
+emitted-token high-water mark + PRNG key-burn count are appended as the
+request progresses.  After a daemon crash the journal is scanned, unfinished
+requests are re-admitted with their original uids, and — because the
+per-request key chains are deterministic (one counted burn per sampled
+token/window, batch-composition independent) — the resumed streams continue
+byte-identically to an uninterrupted run.
+
+On-disk format: a single append-only segment of CRC-framed records::
+
+    MAGIC(4 = b"DSJ1") | u32 payload_len | u32 crc32(payload) | JSON payload
+
+Three ops: ``admit`` (full request spec), ``progress`` (token delta +
+cumulative key burns, optionally logprobs), ``finish`` (request left the
+scheduler: done/cancelled/errored/expired).  Admit and finish records are
+fsync'd; progress records are flushed (fsync'd too under
+``fsync_policy="always"``) — losing the tail of the progress chain only
+means re-generating a few tokens deterministically, never corrupting state.
+
+Recovery is per-record: a CRC mismatch quarantines that record alone.  If
+the frame boundary is still trustworthy (the next bytes are a frame MAGIC,
+or EOF) the scan resumes at the next record; a torn frame (bad length /
+truncated payload) resyncs by scanning forward for the next MAGIC.  A
+quarantined progress record freezes that request's high-water mark at the
+last consistent prefix — deterministic replay regenerates the lost suffix,
+and reconnecting clients dedupe by ``from_token`` offset, so nothing
+double-emits.
+
+Compaction rewrites the live (unfinished) state through the same
+torn-write-safe tmp + fsync + ``os.replace`` idiom as
+``checkpoint/engine.py``, triggered every ``compact_every`` finish records
+and once on recovery (healing torn tails).
+
+The journal directory is never inside the repo tree: ``$DS_TPU_JOURNAL_DIR``
+else ``$XDG_CACHE_HOME/deepspeed_tpu/journal`` else
+``~/.cache/deepspeed_tpu/journal`` — the same precedence chain as the
+compile/attn caches.
+"""
+
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...utils.fault_injection import get_fault_injector
+from ...utils.logging import logger
+
+MAGIC = b"DSJ1"
+_HEADER = struct.Struct("<II")  # payload_len, crc32
+# a single record is a request spec or a token delta — anything beyond this
+# is a corrupt length field, not a real record; resync instead of allocating
+_MAX_RECORD = 1 << 26
+SEGMENT_NAME = "requests.wal"
+
+
+def journal_dir() -> str:
+    """Resolved journal directory (not created). Env override first, then
+    XDG, then ``~/.cache`` — never a repo-relative default."""
+    env = os.environ.get("DS_TPU_JOURNAL_DIR")
+    if env:
+        return os.path.expanduser(env)
+    xdg = os.environ.get("XDG_CACHE_HOME")
+    base = os.path.expanduser(xdg) if xdg else os.path.expanduser("~/.cache")
+    return os.path.join(base, "deepspeed_tpu", "journal")
+
+
+@dataclass
+class JournalEntry:
+    """One unfinished request recovered from the journal."""
+    uid: int
+    prompt: List[int]
+    params: Dict
+    tokens: List[int] = field(default_factory=list)
+    logprobs: List[float] = field(default_factory=list)
+    key_burns: int = 0
+    deadline_wall: Optional[float] = None
+    queue_deadline_wall: Optional[float] = None
+
+
+def _frame(payload: bytes) -> bytes:
+    return MAGIC + _HEADER.pack(len(payload), zlib.crc32(payload) & 0xffffffff) + payload
+
+
+def _encode(rec: dict) -> bytes:
+    return _frame(json.dumps(rec, separators=(",", ":")).encode("utf-8"))
+
+
+def _apply(state: Dict[int, dict], order: List[int], rec: dict) -> None:
+    """Fold one valid record into the per-uid recovery state."""
+    op, uid = rec.get("op"), rec.get("uid")
+    if op == "admit" and isinstance(uid, int):
+        state[uid] = {"admit": rec, "tokens": [], "lps": [], "burns": 0,
+                      "frozen": False}
+        if uid not in order:
+            order.append(uid)
+    elif op == "progress":
+        st = state.get(uid)
+        if st is None or st["frozen"]:
+            return
+        toks = rec.get("tokens", [])
+        if rec.get("n_out") != len(st["tokens"]) + len(toks):
+            # a progress record in the chain was lost/quarantined: freeze the
+            # high-water mark at the last consistent prefix — deterministic
+            # replay regenerates the suffix, so this only costs recompute
+            st["frozen"] = True
+            return
+        st["tokens"].extend(toks)
+        st["burns"] = int(rec.get("burns", st["burns"]))
+        if "lps" in rec:
+            st["lps"].extend(rec["lps"])
+    elif op == "finish":
+        state.pop(uid, None)
+
+
+def _scan(buf: bytes) -> Tuple[Dict[int, dict], List[int], int]:
+    """Decode a segment, quarantining bad records individually.
+
+    Returns ``(state_by_uid, admit_order, quarantined_count)``."""
+    state: Dict[int, dict] = {}
+    order: List[int] = []
+    bad = 0
+    i, n = 0, len(buf)
+    while i < n:
+        if buf[i:i + 4] != MAGIC:
+            bad += 1
+            j = buf.find(MAGIC, i + 1)
+            if j < 0:
+                break
+            i = j
+            continue
+        if i + 12 > n:
+            bad += 1
+            break
+        length, crc = _HEADER.unpack_from(buf, i + 4)
+        end = i + 12 + length
+        if length > _MAX_RECORD or end > n:
+            # torn frame: the length field overruns the segment (or is
+            # garbage) — resync on the next frame magic
+            bad += 1
+            j = buf.find(MAGIC, i + 4)
+            if j < 0:
+                break
+            i = j
+            continue
+        payload = buf[i + 12:end]
+        if zlib.crc32(payload) & 0xffffffff != crc:
+            bad += 1
+            # in-place corruption with an intact frame boundary (next bytes
+            # are a frame start, or EOF): quarantine this record only
+            if end == n or buf[end:end + 4] == MAGIC:
+                i = end
+                continue
+            j = buf.find(MAGIC, i + 4)
+            if j < 0:
+                break
+            i = j
+            continue
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            bad += 1
+            i = end
+            continue
+        _apply(state, order, rec)
+        i = end
+    return state, order, bad
+
+
+class RequestJournal:
+    """Append-only WAL over one segment file, with in-memory mirror.
+
+    Thread-safe: ``submit()`` appends admit records from HTTP threads while
+    the scheduler thread appends progress/finish records."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 fsync_policy: str = "admit", compact_every: int = 64):
+        if fsync_policy not in ("admit", "always", "never"):
+            raise ValueError(f"fsync_policy must be admit|always|never, "
+                             f"got {fsync_policy!r}")
+        self.dir = os.path.expanduser(directory) if directory else journal_dir()
+        self.path = os.path.join(self.dir, SEGMENT_NAME)
+        self.fsync_policy = fsync_policy
+        self.compact_every = max(1, int(compact_every))
+        self.quarantined_records = 0
+        self._lock = threading.Lock()
+        self._fh = None
+        self._finished_since_compact = 0
+        # mirror of the unfinished on-disk state, by uid — drives depth and
+        # compaction without re-scanning the segment
+        self._state: Dict[int, dict] = {}
+        self._order: List[int] = []
+
+    # ------------------------------------------------------------------ io
+
+    def _open(self):
+        if self._fh is None:
+            os.makedirs(self.dir, exist_ok=True)
+            self._fh = open(self.path, "ab")
+        return self._fh
+
+    def _sync(self, force: bool):
+        fh = self._fh
+        if fh is None:
+            return
+        fh.flush()
+        if force or self.fsync_policy == "always":
+            os.fsync(fh.fileno())
+
+    def _append(self, rec: dict, sync: bool):
+        frame = _encode(rec)
+        fh = self._open()
+        inj = get_fault_injector()
+        if inj.enabled:
+            if inj.fire("journal.torn_write", path=self.path) is not None:
+                # simulate a crash mid-write: only half the frame lands
+                fh.write(frame[:max(5, len(frame) // 2)])
+                self._sync(sync and self.fsync_policy != "never")
+                return
+            if inj.fire("journal.corrupt_record", path=self.path) is not None:
+                # flip a payload byte; the CRC header stays stale so the
+                # scanner quarantines exactly this record
+                mut = bytearray(frame)
+                mut[12 + (len(frame) - 12) // 2] ^= 0xFF
+                frame = bytes(mut)
+        fh.write(frame)
+        self._sync(sync and self.fsync_policy != "never")
+
+    # ------------------------------------------------------------- records
+
+    def record_admit(self, uid: int, prompt: List[int], params: dict,
+                     deadline_wall: Optional[float] = None,
+                     queue_deadline_wall: Optional[float] = None):
+        rec = {"op": "admit", "uid": int(uid), "prompt": list(prompt),
+               "params": params, "dl": deadline_wall,
+               "qdl": queue_deadline_wall}
+        with self._lock:
+            self._append(rec, sync=True)
+            _apply(self._state, self._order, rec)
+
+    def record_progress(self, uid: int, new_tokens: List[int], n_out: int,
+                        key_burns: int, logprobs: Optional[List[float]] = None):
+        rec = {"op": "progress", "uid": int(uid),
+               "tokens": [int(t) for t in new_tokens], "n_out": int(n_out),
+               "burns": int(key_burns)}
+        if logprobs is not None:
+            rec["lps"] = [float(x) for x in logprobs]
+        with self._lock:
+            self._append(rec, sync=False)
+            _apply(self._state, self._order, rec)
+
+    def record_finish(self, uid: int):
+        rec = {"op": "finish", "uid": int(uid)}
+        with self._lock:
+            self._append(rec, sync=True)
+            _apply(self._state, self._order, rec)
+            self._finished_since_compact += 1
+            if self._finished_since_compact >= self.compact_every:
+                self._compact_locked()
+
+    def checkpoint(self):
+        """Flush + fsync whatever has been appended (SIGTERM handoff)."""
+        with self._lock:
+            self._sync(force=True)
+
+    @property
+    def depth(self) -> int:
+        """Unfinished (admitted, not finished) requests on record."""
+        with self._lock:
+            return len(self._state)
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._sync(force=True)
+                self._fh.close()
+                self._fh = None
+
+    # ---------------------------------------------------------- compaction
+
+    def _compact_locked(self):
+        """Rewrite the segment with only the unfinished state — tmp, fsync,
+        atomic replace (same torn-write-safe commit as checkpoint/engine)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        os.makedirs(self.dir, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for uid in self._order:
+                st = self._state.get(uid)
+                if st is None:
+                    continue
+                f.write(_encode(st["admit"]))
+                if st["tokens"] or st["burns"]:
+                    rec = {"op": "progress", "uid": uid,
+                           "tokens": st["tokens"],
+                           "n_out": len(st["tokens"]),
+                           "burns": st["burns"]}
+                    if st["lps"]:
+                        rec["lps"] = st["lps"]
+                    f.write(_encode(rec))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._order = [u for u in self._order if u in self._state]
+        self._finished_since_compact = 0
+
+    def compact(self):
+        with self._lock:
+            self._compact_locked()
+
+    # ------------------------------------------------------------ recovery
+
+    def recover(self) -> List[JournalEntry]:
+        """Scan the segment, rebuild the mirror, compact (healing any torn
+        tail), and return the unfinished requests in admit order."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            buf = b""
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    buf = f.read()
+            state, order, bad = _scan(buf)
+            self.quarantined_records = bad
+            if bad:
+                logger.warning(
+                    "[journal] quarantined %d unreadable record(s) in %s; "
+                    "remaining requests replay from their last consistent "
+                    "high-water mark", bad, self.path)
+            self._state, self._order = state, order
+            self._compact_locked()
+            entries = []
+            for uid in order:
+                st = state.get(uid)
+                if st is None:
+                    continue
+                adm = st["admit"]
+                entries.append(JournalEntry(
+                    uid=uid, prompt=list(adm.get("prompt", [])),
+                    params=dict(adm.get("params", {})),
+                    tokens=list(st["tokens"]), logprobs=list(st["lps"]),
+                    key_burns=int(st["burns"]),
+                    deadline_wall=adm.get("dl"),
+                    queue_deadline_wall=adm.get("qdl")))
+            return entries
+
+
+class ServingCrash(BaseException):
+    """Injected daemon crash (``serve.crash`` mode="drop").
+
+    Derives from BaseException on purpose: it must sail past the per-tick
+    ``retry_with_backoff(exceptions=(Exception,))`` boundary AND the bisect
+    quarantine, killing the scheduler loop exactly like a real abort — the
+    journal is preserved and the next boot replays it."""

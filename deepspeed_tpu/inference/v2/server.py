@@ -42,10 +42,24 @@ reproducible fault down to the one poisoning request (error-finishing
 only it — the loop survives); a watchdog flips ``/health`` to
 ``degraded`` when ticks stall. All deterministic-testable through the
 ``serve.*`` sites of ``utils/fault_injection.py``.
+
+Durability (``durable_serving`` config block + ``inference/v2/journal.py``):
+with the write-ahead request journal enabled, every admitted request is
+persisted (prompt, sampling params, seed, deadline) and its emitted-token
+high-water mark + PRNG key-burn count follow per tick. A daemon crash (or
+SIGTERM ``handoff()``) therefore loses nothing: the next ``start()`` scans
+the journal, re-admits unfinished requests with their original uids and
+remaining deadlines, force-feeds the already-emitted tokens as prefix, and
+fast-forwards each key chain by its burn count — resumed greedy AND sampled
+streams continue byte-identically to an uninterrupted run. Clients
+re-attach by request id: ``GET /requests/<uid>`` blocks for the result,
+``GET /requests/<uid>/stream?from_token=N`` resumes a token stream at the
+client's own high-water mark (offset-addressed, so nothing double-emits).
 """
 
 import itertools
 import json
+import os
 import queue
 import threading
 import time
@@ -58,7 +72,8 @@ import numpy as np
 from ...utils.fault_injection import InjectedFault, get_fault_injector
 from ...utils.logging import logger
 from ...utils.retry import RetriesExhausted, retry_with_backoff
-from .config_v2 import ServingResilienceConfig
+from .config_v2 import DurableServingConfig, ServingResilienceConfig
+from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from .scheduling_utils import (DeadlineExceeded, SchedulerOverloaded,
@@ -100,6 +115,15 @@ class _Request:
     cancelled: bool = False
     error: Optional[BaseException] = None
     rng: Optional[np.random.Generator] = None
+    # durability state: counted device-PRNG key burns (one per sampled
+    # per-token dispatch / fused scan step / verified speculative window),
+    # the journal high-water marks, and the replay/skip flags
+    key_burns: int = 0
+    journaled_n: int = 0       # outputs already on journal record
+    journaled_burns: int = 0   # key_burns already on journal record
+    journal_skip: bool = False  # host logits_processor: not serializable
+    replayed: bool = False
+    stream: bool = False       # submitted as a stream() consumer
     # resilience state
     t_deadline: Optional[float] = None        # monotonic; queue + decode
     t_queue_deadline: Optional[float] = None  # monotonic; unadmitted only
@@ -152,6 +176,32 @@ class RequestHandle:
                     raise self._req.error
                 return
             yield tok
+
+    def stream_from(self, from_token: int = 0,
+                    timeout: Optional[float] = None, poll: float = 0.02):
+        """Offset-addressed stream for (re)connecting consumers: yields
+        ``outputs[from_token:]`` — already-generated tokens immediately,
+        then live ones as they land. Unlike ``stream()`` it never touches
+        the delivery queue, so any number of consumers can attach at their
+        own high-water marks (e.g. after an HTTP reconnect or a daemon
+        warm restart) without double-emitting or stealing tokens."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        i = max(0, int(from_token))
+        while True:
+            n = len(self._req.outputs)  # append-only: snapshot is safe
+            while i < n:
+                yield int(self._req.outputs[i])
+                i += 1
+            if self._req.done.is_set():
+                if len(self._req.outputs) > i:
+                    continue  # tokens landed between the scan and done
+                if self._req.error is not None:
+                    raise self._req.error
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"request {self._req.uid} still running")
+            self._req.done.wait(poll)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until generation finishes; returns all generated tokens."""
@@ -208,7 +258,8 @@ class ServingScheduler:
 
     def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05,
                  token_budget: Optional[int] = None,
-                 fused_decode_window: Optional[int] = None):
+                 fused_decode_window: Optional[int] = None,
+                 journal: Optional[RequestJournal] = None):
         self._engine = engine
         self._idle_wait = idle_wait
         if fused_decode_window is None:
@@ -266,8 +317,32 @@ class ServingScheduler:
                        "tick_errors": 0, "quarantined": [],
                        "watchdog_trips": 0, "slow_consumer_cancels": 0,
                        "spec_drafted": 0, "spec_accepted": 0}
-        # last-256 completed requests for the metrics aggregates
+        # durability: the write-ahead request journal (explicit instance
+        # wins; else built from the durable_serving config block), plus the
+        # uid registry the reconnect surface resolves against
+        dcfg = getattr(engine._config, "durable_serving", None)
+        self._durable: DurableServingConfig = (
+            dcfg if dcfg is not None else DurableServingConfig())
+        if journal is not None:
+            self._journal: Optional[RequestJournal] = journal
+        elif self._durable.enabled:
+            self._journal = RequestJournal(
+                self._durable.journal_dir,
+                fsync_policy=self._durable.fsync_policy,
+                compact_every=self._durable.compact_every)
+        else:
+            self._journal = None
+        # crash/handoff sets this so the drain's error-finishes do NOT
+        # retire journal entries — the next boot must replay them
+        self._preserve_journal = False
+        self._requests = {}  # uid -> _Request, live + recently finished
         from collections import deque
+        self._done_order: "deque" = deque()
+        self._replayed = 0
+        self._restart_count = int(
+            os.environ.get("DS_SERVE_RESTART_COUNT", "0") or 0)
+        self._boot_wall = time.time()
+        # last-256 completed requests for the metrics aggregates
         self._completed: "deque" = deque(maxlen=256)
         sm = engine._config.state_manager
         self._max_batch_tokens = sm.max_ragged_batch_size
@@ -339,6 +414,10 @@ class ServingScheduler:
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         req.wake = self._wake
+        req.stream = bool(stream)
+        # a host logits_processor is an arbitrary callable — it cannot be
+        # journaled, so such requests are (documented) non-durable
+        req.journal_skip = logits_processor is not None
         res = self._res
         if res.enabled:
             if deadline_s is None:
@@ -368,6 +447,11 @@ class ServingScheduler:
                     f"queue full ({self._queued_n} requests, "
                     f"{self._queued_tokens} prompt tokens queued)",
                     retry_after_s=res.retry_after_s)
+            # journal BEFORE the request becomes visible to the loop: the
+            # loop could otherwise finish it and write a finish record the
+            # recovery scan would see before (and thus ignore) the admit
+            self._journal_admit(req)
+            self._requests[req.uid] = req
             self._inbox.append(req)
             self._active += 1
             req.queued = True
@@ -375,6 +459,42 @@ class ServingScheduler:
             self._queued_tokens += len(prompt)
         self._wake.set()
         return RequestHandle(req)
+
+    def _journal_admit(self, req: _Request) -> None:
+        if self._journal is None or req.journal_skip:
+            return
+        now_w, now_m = time.time(), time.monotonic()
+        params = {
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature, "top_k": req.top_k,
+            "top_p": req.top_p, "eos_token_id": req.eos_token_id,
+            "seed": req.seed, "stop": req.stop,
+            "min_new_tokens": req.min_new_tokens,
+            "repetition_penalty": req.repetition_penalty,
+            "speculative": req.speculative,
+            "num_draft_tokens": req.num_draft_tokens,
+            "draft_ngram": req.draft_ngram,
+            "return_logprobs": req.return_logprobs,
+            "stream": req.stream}
+        try:
+            self._journal.record_admit(
+                req.uid, req.prompt, params,
+                deadline_wall=(now_w + (req.t_deadline - now_m)
+                               if req.t_deadline is not None else None),
+                queue_deadline_wall=(
+                    now_w + (req.t_queue_deadline - now_m)
+                    if req.t_queue_deadline is not None else None))
+        except OSError as e:  # journaling is best-effort; serving goes on
+            logger.warning(f"[journal] admit record failed for request "
+                           f"{req.uid}: {e}")
+
+    def lookup(self, uid: int) -> Optional[RequestHandle]:
+        """Re-attach to an in-flight or recently finished request by id —
+        the reconnect surface. Works across a warm restart because journal
+        replay keeps original uids."""
+        with self._lock:
+            req = self._requests.get(int(uid))
+        return RequestHandle(req) if req is not None else None
 
     @property
     def stats(self) -> dict:
@@ -405,6 +525,12 @@ class ServingScheduler:
                "spec_accepted": spec_accepted,
                "spec_accept_rate": (round(spec_accepted / spec_drafted, 4)
                                     if spec_drafted else None),
+               "journal_depth": (self._journal.depth
+                                 if self._journal is not None else 0),
+               "replayed_requests": self._replayed,
+               "restart_count": self._restart_count,
+               "last_restart_age_s": (round(time.time() - self._boot_wall, 3)
+                                      if self._restart_count else None),
                "completed": len(done)}
         done = [d for d in done if d[3] > 0]
         if done:
@@ -450,6 +576,9 @@ class ServingScheduler:
         self._stopping = False
         self._draining = False
         self._degraded = False
+        self._preserve_journal = False
+        if self._journal is not None and self._durable.replay_on_start:
+            self._replay_journal()
         self._last_progress = time.monotonic()
         self._thread = threading.Thread(target=self._run, name="ds-serve",
                                         daemon=True)
@@ -487,6 +616,147 @@ class ServingScheduler:
             self._watchdog.join(1.5)
             self._watchdog = None
 
+    def handoff(self, timeout: float = 30.0) -> None:
+        """SIGTERM path: stop the loop WITHOUT retiring journal entries,
+        then fsync the journal — the next daemon generation (pointed at
+        the same journal dir) replays every in-flight request and its
+        resumed stream continues bit-identically. Pending local handles
+        error-finish exactly like ``stop()``; remote clients re-attach by
+        uid against the new boot."""
+        self._preserve_journal = True
+        with self._lock:
+            self._draining = True  # submit() refuses from here on
+        self._stopping = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(1.5)
+            self._watchdog = None
+        if self._journal is not None:
+            # every emitted token was journaled at its tick's end, so the
+            # checkpoint only needs to make the tail durable
+            try:
+                self._journal.checkpoint()
+            except OSError as e:
+                logger.warning(f"[journal] handoff checkpoint failed: {e}")
+
+    def _replay_journal(self) -> None:
+        """Warm restart: re-admit every unfinished journaled request with
+        its original uid and remaining wall-clock deadline. Emitted tokens
+        become prefix feed (the eviction-replay machinery re-prefills them
+        chunkwise and samples the next token when the feed completes), the
+        host RNG re-burns its consumed entropy, and ``_restore_sampler``
+        fast-forwards the device key chain at admission — so resumed
+        greedy AND sampled streams are byte-identical to an uninterrupted
+        run. Requests whose journaled output already satisfies a finish
+        condition (crash after the last token, before the finish record)
+        complete immediately instead of re-entering the queue."""
+        try:
+            entries = self._journal.recover()
+        except OSError as e:
+            logger.warning(f"[journal] recovery failed: {e}")
+            return
+        if not entries:
+            return
+        now_w, now_m = time.time(), time.monotonic()
+        max_uid = 0
+        finish_now = []
+        with self._lock:
+            for e in entries:
+                p = e.params
+                max_uid = max(max_uid, e.uid)
+                req = _Request(
+                    uid=e.uid, prompt=[int(t) for t in e.prompt],
+                    max_new_tokens=int(p.get("max_new_tokens", 32)),
+                    temperature=float(p.get("temperature", 0.0)),
+                    top_k=int(p.get("top_k", 0)),
+                    top_p=float(p.get("top_p", 1.0)),
+                    eos_token_id=p.get("eos_token_id"),
+                    seed=int(p.get("seed", 0)),
+                    stop=[[int(t) for t in s] for s in p.get("stop") or []],
+                    min_new_tokens=int(p.get("min_new_tokens", 0)),
+                    repetition_penalty=float(
+                        p.get("repetition_penalty", 1.0)),
+                    speculative=p.get("speculative"),
+                    num_draft_tokens=int(p.get("num_draft_tokens", 4)),
+                    draft_ngram=int(p.get("draft_ngram", 2)),
+                    return_logprobs=bool(p.get("return_logprobs")))
+                req.outputs = [int(t) for t in e.tokens]
+                req.logprobs = list(e.logprobs)
+                req.key_burns = int(e.key_burns)
+                req.journaled_n = len(req.outputs)
+                req.journaled_burns = req.key_burns
+                req.replayed = True
+                req.stream = bool(p.get("stream"))
+                req.wake = self._wake
+                req.t_submit = now_m
+                if req.outputs:
+                    req.t_first = now_m
+                req.rng = np.random.default_rng(req.seed)
+                self._burn_host_rng(req)
+                if (req.stream and self._res.enabled
+                        and self._res.max_stream_backlog > 0):
+                    req.stream_q = queue.Queue(
+                        maxsize=int(self._res.max_stream_backlog))
+                if e.deadline_wall is not None:
+                    req.t_deadline = now_m + (e.deadline_wall - now_w)
+                if e.queue_deadline_wall is not None:
+                    req.t_queue_deadline = (now_m
+                                            + (e.queue_deadline_wall - now_w))
+                self._requests[req.uid] = req
+                self._active += 1
+                if self._finished_already(req):
+                    finish_now.append(req)
+                else:
+                    req.queued = True
+                    self._queued_n += 1
+                    self._queued_tokens += len(req.prompt)
+                    self._waiting.append(req)
+                self._replayed += 1
+        # original uids survive the restart; fresh submissions go above them
+        nxt = next(self._uid_iter)
+        self._uid_iter = itertools.count(max(nxt, max_uid + 1))
+        for req in finish_now:  # _finish takes the lock itself
+            self._finish(req, flush=False)
+        logger.warning(f"[journal] replayed {len(entries)} unfinished "
+                       f"request(s) ({len(finish_now)} already complete)")
+
+    def _finished_already(self, req: _Request) -> bool:
+        if not req.outputs:
+            return False
+        if len(req.outputs) >= req.max_new_tokens:
+            return True
+        # emission never continues past eos, so membership == cut
+        if req.eos_token_id is not None and req.eos_token_id in req.outputs:
+            return True
+        return bool(req.stop
+                    and self._engine.hit_stop(req.outputs, req.stop))
+
+    def _burn_host_rng(self, req: _Request) -> None:
+        """Re-consume the host numpy sampler's entropy for a replayed
+        request: exactly one vocab-sized gumbel per emitted token iff the
+        request sampled on host (positive temperature and top_p, not
+        device-owned) — the replayed generator then continues the same
+        draw sequence an uninterrupted run would have used."""
+        if req.temperature <= 0 or req.top_p <= 0 or not req.outputs:
+            return
+        if req.speculative is not None or self._device_eligible(req):
+            return  # chain lives on device; _restore_sampler handles it
+        vocab = int(self._engine._model.config.vocab_size)
+        for _ in req.outputs:
+            req.rng.gumbel(size=vocab)
+
+    def _restore_sampler(self, req: _Request) -> None:
+        """Re-seed the device key chain at its recorded position for a
+        request entering the live set WITH history (journal replay or
+        eviction replay): ``flush()`` dropped the key, and reseeding from
+        scratch would fork the sampled stream mid-request."""
+        if req.key_burns > 0 and req.outputs:
+            self._engine.fast_forward_sampler(req.uid, req.seed,
+                                              req.key_burns)
+
     def _run(self) -> None:
         crash: Optional[BaseException] = None
         try:
@@ -498,6 +768,9 @@ class ServingScheduler:
                     self._wake.clear()
         except BaseException as e:  # noqa: BLE001 — loop death must not
             crash = e               # silently hang every blocked caller
+            # a crash is exactly what the journal exists for: keep every
+            # entry so the next boot replays them (clean stop() retires)
+            self._preserve_journal = True
         finally:
             self._stopping = True
             # drain UNDER the lock: submit() rejects once _stopping is
@@ -529,6 +802,15 @@ class ServingScheduler:
                 time.sleep(float(args.get("seconds", 0.5)))
             if inj.fire("serve.tick_error") is not None:
                 raise InjectedFault("injected serving tick error")
+            args = inj.fire("serve.crash")
+            if args is not None:
+                if str(args.get("mode", "drop")) == "exit":
+                    # a real daemon death: the supervisor's relaunch path
+                    os._exit(int(args.get("exit_code", 23)))
+                # kill just the scheduler loop (BaseException sails past
+                # the tick retry AND the quarantine bisect) — in-process
+                # tests then replay the journal over the same engine
+                raise ServingCrash("injected daemon crash")
         with self._lock:
             if self._inbox:
                 self._waiting.extend(self._inbox)
@@ -542,7 +824,31 @@ class ServingScheduler:
 
         admitted = self._admit()
         advanced = self._advance_tick()
+        if self._journal is not None:
+            self._journal_progress()
         return bool(admitted or advanced)
+
+    def _journal_progress(self) -> None:
+        """Append each live request's new tokens + key-burn count since the
+        last record — the high-water marks a warm restart resumes from."""
+        for req in self._live:
+            if req.journal_skip:
+                continue
+            n = len(req.outputs)
+            if n == req.journaled_n and req.key_burns == req.journaled_burns:
+                continue
+            lps = (req.logprobs[req.journaled_n:n]
+                   if req.return_logprobs else None)
+            try:
+                self._journal.record_progress(
+                    req.uid, req.outputs[req.journaled_n:n], n,
+                    req.key_burns, logprobs=lps)
+            except OSError as e:
+                logger.warning(f"[journal] progress record failed for "
+                               f"request {req.uid}: {e}")
+                continue
+            req.journaled_n = n
+            req.journaled_burns = req.key_burns
 
     def _sweep_cancelled(self) -> None:
         for req in [r for r in self._live if r.cancelled]:
@@ -710,6 +1016,7 @@ class ServingScheduler:
             free -= need
             self._waiting.remove(req)
             req.fed = 0
+            self._restore_sampler(req)
             self._live.append(req)
             self._queue_drop(req)
             admitted.append(req)
@@ -723,6 +1030,7 @@ class ServingScheduler:
             if feed_need <= self._engine._state_manager.free_blocks:
                 self._waiting.pop(0)
                 req.fed = 0
+                self._restore_sampler(req)
                 self._live.append(req)
                 self._queue_drop(req)
                 admitted.append(req)
@@ -922,6 +1230,8 @@ class ServingScheduler:
                     [r.uid for r in fused],
                     [r.feed_slice(1)[0] for r in fused], K,
                     specs=[self._spec_for(r) for r in fused])
+                for r in fused:  # the sampled scan splits once per step
+                    r.key_burns += K
         except SchedulingError:
             return []
         for i, (req, row) in enumerate(zip(fused, toks)):
@@ -987,6 +1297,9 @@ class ServingScheduler:
                         else [self._spec_for(r) for r in fused])
             except SchedulingError:
                 continue  # KV pressure: the per-token tick owns eviction
+            if not all_greedy:  # one split per verified window, K windows
+                for req in fused:
+                    req.key_burns += K
             for req, row, dr, ac in zip(fused, toks_lists, drafted,
                                         accepted):
                 req.fed += len(row)
@@ -1060,6 +1373,7 @@ class ServingScheduler:
                     new_toks, m = self._engine.accept_drafts_sampled(
                         req.uid, d, row, self._spec_for(req),
                         req.num_draft_tokens)
+                    req.key_burns += 1  # one split per verified window
                 else:
                     new_toks, m = self._engine.accept_drafts(req.uid, d, row)
                 req.fed += 1 + m
@@ -1080,6 +1394,7 @@ class ServingScheduler:
                         new_toks, _ = self._engine.accept_drafts_sampled(
                             req.uid, [], last, self._spec_for(req),
                             req.num_draft_tokens)
+                        req.key_burns += 1  # draft-free window still burns
                         self._emit_many(req, new_toks)
                     elif self._device_eligible(req):
                         device_wave.append((req, last))
@@ -1124,6 +1439,7 @@ class ServingScheduler:
             [r.uid for r, _ in wave], [row for _, row in wave],
             [self._spec_for(r) for r, _ in wave])
         for (req, _), tok, lp in zip(wave, toks, lps):
+            req.key_burns += 1  # sample_rows splits each row's key once
             if req.return_logprobs:
                 req.logprobs.append(float(lp))
             if not req.outputs:
@@ -1186,6 +1502,15 @@ class ServingScheduler:
     def _finish(self, req: _Request, flush: bool = True) -> None:
         if flush:
             self._engine.flush(req.uid)
+        if (self._journal is not None and not req.journal_skip
+                and not self._preserve_journal):
+            # crash/handoff keeps entries alive for the next boot's replay;
+            # every normal finish (done/cancel/error/expiry) retires them
+            try:
+                self._journal.record_finish(req.uid)
+            except OSError as e:
+                logger.warning(f"[journal] finish record failed for "
+                               f"request {req.uid}: {e}")
         req.t_done = time.monotonic()
         with self._lock:  # stats()/drain read under the same lock
             self._active -= 1
@@ -1197,6 +1522,14 @@ class ServingScheduler:
                 self._completed.append(
                     (req.t_submit, req.t_first, req.t_done,
                      len(req.outputs)))
+            # keep the last 256 finished requests reconnectable by uid,
+            # then let them go so the registry stays bounded
+            self._done_order.append(req.uid)
+            while len(self._done_order) > 256:
+                old = self._done_order.popleft()
+                r = self._requests.get(old)
+                if r is not None and r.done.is_set():
+                    self._requests.pop(old, None)
         req.done.set()
         while True:
             try:
@@ -1263,8 +1596,71 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     status = "ok"
                 self._json(200 if status == "ok" else 503,
                            {"status": status, **stats})
+            elif self.path.startswith("/requests/"):
+                self._do_request_get()
             else:
                 self._json(404, {"error": "not found"})
+
+        def _do_request_get(self):
+            """Reconnect surface: ``GET /requests/<uid>`` blocks for the
+            full result (a non-streaming wait re-attach);
+            ``GET /requests/<uid>/stream?from_token=N`` resumes a chunked
+            token stream at the client's own high-water mark. Both work
+            across a daemon warm restart (replay keeps original uids)."""
+            from urllib.parse import parse_qs, urlparse
+            parsed = urlparse(self.path)
+            parts = [p for p in parsed.path.split("/") if p]
+            try:
+                uid = int(parts[1])
+            except (IndexError, ValueError):
+                self._json(400, {"error": "bad request id"})
+                return
+            handle = scheduler.lookup(uid)
+            if handle is None:
+                self._json(404, {"error": f"unknown request {uid}"})
+                return
+            if len(parts) > 2 and parts[2] == "stream":
+                try:
+                    from_token = int(
+                        parse_qs(parsed.query).get("from_token", ["0"])[0])
+                except ValueError:
+                    self._json(400, {"error": "bad from_token"})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-DS-Request-Id", str(uid))
+                self.end_headers()
+                try:
+                    for tok in handle.stream_from(
+                            from_token,
+                            timeout=scheduler.wait_timeout(handle)):
+                        line = json.dumps({"token": tok}).encode() + b"\n"
+                        self.wfile.write(hex(len(line))[2:].encode()
+                                         + b"\r\n" + line + b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # reconnectors never cancel the request
+                except Exception:  # noqa: BLE001 — timeout/req error: the
+                    try:           # streamed tokens stand, end chunking
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
+                return
+            try:
+                tokens = handle.result(
+                    timeout=scheduler.wait_timeout(handle))
+            except DeadlineExceeded as e:
+                self._json(504, {"error": str(e)})
+                return
+            except TimeoutError:
+                self._json(504, {"error": f"request {uid} did not "
+                                          "complete in time"})
+                return
+            except Exception as e:  # noqa: BLE001 — surfaced to client
+                self._json(500, {"error": str(e)})
+                return
+            self._json(200, {"uid": uid, "tokens": tokens})
 
         def do_POST(self):
             if self.path not in ("/generate", "/v1/completions",
@@ -1349,6 +1745,9 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")
+                # the reconnect key: a dropped client re-attaches at
+                # GET /requests/<uid>/stream?from_token=<tokens seen>
+                self.send_header("X-DS-Request-Id", str(handle.uid))
                 self.end_headers()
                 try:
                     for tok in handle.stream(
@@ -1399,12 +1798,13 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 else:
                     choice["text"] = text if text is not None else ""
                 self._json(200, {
+                    "id": f"ds-{handle.uid}",
                     "object": ("chat.completion" if chat
                                else "text_completion"),
                     "choices": [choice],
                     "usage": {"completion_tokens": len(tokens)}})
                 return
-            out = {"tokens": tokens}
+            out = {"uid": handle.uid, "tokens": tokens}
             if body.get("speculative"):
                 out["spec"] = handle.stats  # drafted/accepted/accept_rate
             if body.get("logprobs"):
@@ -1416,6 +1816,30 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
     return ThreadingHTTPServer((host, port), Handler)
 
 
+def install_sigterm_handoff(sched: ServingScheduler, httpd) -> bool:
+    """SIGTERM → journal checkpoint + clean handoff: the handler stops the
+    scheduler WITHOUT retiring journal entries (``handoff()``) and shuts
+    the HTTP server down, so a supervisor relaunch replays every in-flight
+    request. Signal handlers only install from the main thread; returns
+    whether the handler is in place."""
+    import signal
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _on_term(signum, frame):
+        logger.warning("[serving] SIGTERM: journal handoff + shutdown")
+        # shutdown() blocks until serve_forever exits — which runs on THIS
+        # thread when blocking — so it must be called from another one
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+        sched.handoff()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # non-main interpreter contexts
+        return False
+    return True
+
+
 def serve(engine: InferenceEngineV2, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, block: bool = True,
           fused_decode_window: Optional[int] = None):
@@ -1423,6 +1847,7 @@ def serve(engine: InferenceEngineV2, host: str = "127.0.0.1", port: int = 8000,
     sched = ServingScheduler(
         engine, fused_decode_window=fused_decode_window).start()
     httpd = create_http_server(sched, host, port, tokenizer)
+    install_sigterm_handoff(sched, httpd)
     if not block:
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         return sched, httpd

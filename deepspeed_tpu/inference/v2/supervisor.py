@@ -1,0 +1,194 @@
+"""Serving supervisor: relaunch a crashed serving daemon under a budget.
+
+The durable-serving story (``journal.py`` + warm-restart replay in
+``server.py``) makes a daemon crash *recoverable*; this module makes it
+*recovered* — a host-side supervisor in the ``elasticity/agent.py``
+DSElasticAgent shape wraps the daemon process, and when the daemon exits
+nonzero it relaunches it with exponential backoff until a restart budget
+is exhausted. The relaunched daemon finds the write-ahead journal on
+boot, re-admits every unfinished request, and continues each stream
+byte-identically; clients re-attach over HTTP with
+``GET /requests/<uid>/stream?from_token=N``.
+
+What the supervisor exports to each child generation:
+
+* ``DS_SERVE_RESTART_COUNT`` — how many relaunches preceded this one;
+  surfaces in ``/health`` / ``stats()`` as ``restart_count``.
+* the caller's env otherwise verbatim, so ``DS_TPU_JOURNAL_DIR`` (and
+  everything else) flows through — successive generations share one
+  journal directory by construction.
+
+Readiness is gated on the daemon's own ``/health`` endpoint: after each
+launch the supervisor polls ``health_url`` until HTTP 200 (a 503 means
+the server is up but degraded — still "arrived", the watchdog owns it
+from there). A child that dies before becoming ready consumes a restart
+from the same budget as a mid-flight crash.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+from ...utils.logging import logger
+
+
+def _wait_ready(health_url: str, timeout_s: float,
+                proc: Optional[subprocess.Popen] = None,
+                poll_s: float = 0.25) -> bool:
+    """Poll ``health_url`` until any HTTP response arrives (200 ready, 503
+    degraded — both mean the server is up) or ``timeout_s`` elapses.
+    Connection refused / reset means the socket isn't listening yet — keep
+    polling. Returns False early if ``proc`` exits while we wait."""
+    deadline = time.monotonic() + float(timeout_s)
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(health_url, timeout=2.0):
+                return True
+        except urllib.error.HTTPError:
+            return True  # 503 et al: the server answered — it's alive
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass
+        time.sleep(poll_s)
+    return False
+
+
+class ServingSupervisor:
+    """Supervise one serving daemon process with budgeted warm restarts.
+
+    ``run()`` blocks until the daemon exits cleanly (returns 0), the
+    restart budget is exhausted (returns the last exit code), or the
+    supervisor itself is interrupted (child is torn down SIGTERM → grace
+    → SIGKILL)."""
+
+    def __init__(self, cmd: Sequence[str],
+                 max_restarts: int = 3,
+                 monitor_interval: float = 0.5,
+                 restart_backoff: float = 0.5,
+                 max_backoff: float = 30.0,
+                 health_url: Optional[str] = None,
+                 ready_timeout_s: float = 120.0,
+                 grace_s: float = 30.0,
+                 env: Optional[dict] = None):
+        self.cmd = list(cmd)
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = float(monitor_interval)
+        self.restart_backoff = float(restart_backoff)
+        self.max_backoff = float(max_backoff)
+        self.health_url = health_url
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.grace_s = float(grace_s)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.restarts = 0
+        self.history: List[dict] = []
+
+    # ------------------------------------------------------------------
+
+    def _launch(self) -> subprocess.Popen:
+        env = dict(self.base_env)
+        env["DS_SERVE_RESTART_COUNT"] = str(self.restarts)
+        self.history.append({"restart": self.restarts, "t": time.time()})
+        logger.info(f"ServingSupervisor: launching daemon "
+                    f"(restart {self.restarts}/{self.max_restarts})")
+        return subprocess.Popen(self.cmd, env=env)
+
+    def _terminate(self, proc: subprocess.Popen) -> None:
+        """SIGTERM (the daemon's handoff path: drain + journal checkpoint),
+        wait out the grace period, then SIGKILL."""
+        if proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=self.grace_s)
+        except subprocess.TimeoutExpired:
+            logger.warning("ServingSupervisor: daemon ignored SIGTERM "
+                           f"for {self.grace_s}s — killing")
+            proc.kill()
+            proc.wait()
+
+    def _await_ready(self, proc: subprocess.Popen) -> None:
+        if self.health_url is None:
+            return
+        if _wait_ready(self.health_url, self.ready_timeout_s, proc=proc):
+            logger.info(f"ServingSupervisor: daemon ready "
+                        f"({self.health_url})")
+        elif proc.poll() is None:
+            # still running but unreachable — let the poll loop decide;
+            # a wedged-at-boot daemon will be caught by its own watchdog
+            # or by the operator, not silently killed here
+            logger.warning(
+                f"ServingSupervisor: daemon not ready after "
+                f"{self.ready_timeout_s}s ({self.health_url})")
+
+    def run(self) -> int:
+        proc = self._launch()
+        self._await_ready(proc)
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is None:
+                    time.sleep(self.monitor_interval)
+                    continue
+                if rc == 0:
+                    logger.info("ServingSupervisor: clean exit")
+                    return 0
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    logger.error(
+                        f"ServingSupervisor: restart budget exhausted "
+                        f"({self.max_restarts}); last rc={rc}")
+                    return rc
+                backoff = min(self.max_backoff,
+                              self.restart_backoff * (2 ** (self.restarts - 1)))
+                logger.warning(
+                    f"ServingSupervisor: daemon died rc={rc} — warm restart "
+                    f"{self.restarts}/{self.max_restarts} in {backoff:.2f}s")
+                if backoff > 0:
+                    time.sleep(backoff)
+                proc = self._launch()
+                self._await_ready(proc)
+        finally:
+            if proc.poll() is None:
+                self._terminate(proc)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Serving daemon supervisor (warm restart + journal replay)")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--monitor-interval", type=float, default=0.5)
+    ap.add_argument("--restart-backoff", type=float, default=0.5)
+    ap.add_argument("--health-url", default=None,
+                    help="e.g. http://127.0.0.1:8100/health — gate readiness "
+                         "on the daemon's own health endpoint")
+    ap.add_argument("--ready-timeout", type=float, default=120.0)
+    ap.add_argument("--grace", type=float, default=30.0,
+                    help="seconds between SIGTERM and SIGKILL on teardown")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="serving command (after --)")
+    args = ap.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":  # only the LEADING separator; the child may
+        cmd = cmd[1:]           # legitimately use "--" in its own argv
+    if not cmd:
+        ap.error("no serving command given")
+    sup = ServingSupervisor(
+        cmd,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        restart_backoff=args.restart_backoff,
+        health_url=args.health_url,
+        ready_timeout_s=args.ready_timeout,
+        grace_s=args.grace)
+    sys.exit(sup.run())
+
+
+if __name__ == "__main__":
+    main()

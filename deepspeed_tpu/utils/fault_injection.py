@@ -32,6 +32,15 @@ Registered sites (the code that hosts them decides the fault's meaning):
   the forward, which quarantine must isolate from the wave.
 - ``serve.slow_consumer``     — a streamed token delivery behaves as if
   the consumer stopped draining: the bounded stream queue must cancel.
+- ``serve.crash``             — the serving daemon dies mid-tick. With
+  ``args["mode"] == "exit"`` the process hard-exits (``os._exit``) so the
+  supervisor's relaunch path is exercised; the default "drop" mode kills
+  just the scheduler loop (a BaseException that skips tick retry AND
+  quarantine) so in-process tests replay the journal over the same engine.
+- ``journal.torn_write``      — a journal append writes only half its
+  frame: a crash mid-write the recovery scan must resync past.
+- ``journal.corrupt_record``  — a journal append lands with a flipped
+  payload byte: silent bit-rot the CRC must quarantine per-record.
 
 Env syntax: ``DS_FAULT_INJECT="site[@nth][*times][;site2...]"`` e.g.
 ``DS_FAULT_INJECT="checkpoint.torn_write@2;train.nan_grads@5*3"``.
@@ -54,6 +63,9 @@ KNOWN_SITES = (
     "serve.tick_hang",
     "serve.request_poison",
     "serve.slow_consumer",
+    "serve.crash",
+    "journal.torn_write",
+    "journal.corrupt_record",
 )
 
 

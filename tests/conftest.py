@@ -81,6 +81,15 @@ def _hermetic_attn_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("DS_TPU_ATTN_CACHE_DIR", str(tmp_path / "attn_cache"))
 
 
+@pytest.fixture(autouse=True)
+def _hermetic_journal_dir(tmp_path, monkeypatch):
+    """Every test resolves the serving request journal to its own tmp dir:
+    a durable-serving test must never replay requests journaled by a
+    previous test (or by a developer's live daemon), and no test may leave
+    journal segments in the user's ~/.cache."""
+    monkeypatch.setenv("DS_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
+
+
 @pytest.fixture
 def devices():
     return jax.devices()

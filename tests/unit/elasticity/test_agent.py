@@ -144,3 +144,45 @@ def test_resolve_world_steps_down():
     assert 1 <= w <= 7
     from deepspeed_tpu.elasticity import compute_elastic_config
     compute_elastic_config(ELASTIC_CFG, world_size=w)  # must not raise
+
+
+def test_default_world_fn_refresh_invalidates_stale_probe(monkeypatch):
+    """The cached device probe is NOT authoritative across a relaunch: a
+    refresh re-probes, so a membership change that crashed the child is
+    observed instead of shadowed by the launch-time cached value."""
+    from deepspeed_tpu.elasticity import agent as agent_mod
+
+    probes = iter([8, 4, 2])
+    monkeypatch.setattr(agent_mod, "_probe_world", lambda: next(probes))
+    monkeypatch.setattr(agent_mod, "_probed_world", None)
+    monkeypatch.delenv("DS_ELASTIC_WORLD_SIZE", raising=False)
+
+    assert agent_mod._default_world_fn() == 8
+    assert agent_mod._default_world_fn() == 8       # steady-state: cached
+    assert agent_mod._default_world_fn(refresh=True) == 4  # relaunch path
+    assert agent_mod._default_world_fn() == 4       # new value now cached
+    # env override always wins, probe untouched
+    monkeypatch.setenv("DS_ELASTIC_WORLD_SIZE", "16")
+    assert agent_mod._default_world_fn(refresh=True) == 16
+
+
+def test_caller_world_fn_is_never_shadowed_by_probe_cache(monkeypatch):
+    """A caller-supplied world_fn is authoritative: _world() must invoke
+    it directly — even with refresh — and never consult the module's
+    cached probe."""
+    from deepspeed_tpu.elasticity import agent as agent_mod
+
+    monkeypatch.setattr(agent_mod, "_probed_world", 8)  # stale cache
+    monkeypatch.setattr(agent_mod, "_probe_world",
+                        lambda: (_ for _ in ()).throw(AssertionError(
+                            "caller world_fn path must not probe")))
+    calls = []
+
+    def world_fn():
+        calls.append(1)
+        return 4
+
+    agent = DSElasticAgent(["true"], ELASTIC_CFG, world_fn=world_fn)
+    assert agent._world() == 4
+    assert agent._world(refresh=True) == 4
+    assert len(calls) == 2

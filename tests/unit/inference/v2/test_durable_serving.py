@@ -1,0 +1,548 @@
+"""Durable serving: write-ahead request journal + warm-restart replay.
+
+The acceptance scenario end-to-end: kill the serving loop mid-decode
+(``serve.crash``), boot a fresh identically-built scheduler over the same
+journal directory, and every unfinished stream — greedy, sampled, fused
+K-step, speculative — continues BYTE-IDENTICALLY to an uninterrupted run.
+Journal damage (``journal.torn_write`` / ``journal.corrupt_record``)
+degrades to per-record quarantine: the remaining requests still replay and
+nothing double-emits. The autouse ``_hermetic_journal_dir`` fixture
+(conftest) gives every test its own journal directory.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import SampleSpec, build_llama_engine
+from deepspeed_tpu.inference.v2.journal import (JournalEntry, RequestJournal,
+                                                ServingCrash, journal_dir)
+from deepspeed_tpu.inference.v2.server import (ServingScheduler,
+                                               create_http_server)
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+pytestmark = pytest.mark.faults
+
+BS = 16
+
+
+def _engine(num_blocks=96, durable=True, **durable_kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    eng_cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=num_blocks,
+        durable_serving={"enabled": durable, **durable_kw})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=eng_cfg)
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _wait_stopped(sched, timeout=120):
+    t0 = time.monotonic()
+    while not sched.stats["stopped"]:
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("scheduler loop never died")
+        time.sleep(0.02)
+
+
+def _crash_then_replay(submits, crash_nth=8, window=1, pre_crash_min=1):
+    """Run ``submits`` on a durable scheduler, crash the loop on its
+    ``crash_nth``-th tick, boot a fresh identically-built scheduler over
+    the same journal dir, and return (pre_crash_outputs, resumed_outputs,
+    new_sched_stats). ``submits`` is a list of submit-kwarg dicts."""
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": crash_nth}]})
+    s1 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=window).start()
+    hs = [s1.submit(**kw) for kw in submits]
+    _wait_stopped(s1)
+    pre = [list(h._req.outputs) for h in hs]
+    assert any(len(p) >= pre_crash_min for p in pre), \
+        "crash fired before anything decoded — scenario is vacuous"
+    assert not all(len(p) >= kw["max_new_tokens"]
+                   for p, kw in zip(pre, submits)), \
+        "crash fired after everything finished — scenario is vacuous"
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=window).start()
+    try:
+        outs = []
+        for uid in range(1, len(submits) + 1):
+            h = s2.lookup(uid)
+            outs.append(None if h is None else h.result(timeout=300))
+        stats = s2.stats
+    finally:
+        s2.stop()
+    return pre, outs, stats
+
+
+def _reference(submits, window=1):
+    eng = _engine(durable=False)
+    sched = ServingScheduler(eng, idle_wait=0.005,
+                             fused_decode_window=window).start()
+    try:
+        hs = [sched.submit(**kw) for kw in submits]
+        return [h.result(timeout=300) for h in hs]
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# journal unit behavior (no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_depth():
+    j = RequestJournal()
+    j.record_admit(1, [1, 2, 3], {"max_new_tokens": 8, "seed": 7})
+    j.record_progress(1, [5, 6], 2, 2)
+    j.record_admit(2, [9], {"max_new_tokens": 4})
+    assert j.depth == 2
+    j.record_finish(1)
+    assert j.depth == 1
+    j.close()
+
+    j2 = RequestJournal()
+    ents = j2.recover()
+    assert [(e.uid, e.prompt, e.tokens, e.key_burns) for e in ents] == \
+        [(2, [9], [], 0)]
+    assert j2.quarantined_records == 0
+    j2.close()
+
+
+def test_journal_progress_accumulates_tokens_and_burns():
+    j = RequestJournal()
+    j.record_admit(7, [1], {"max_new_tokens": 8, "temperature": 1.0},
+                   deadline_wall=123.5)
+    j.record_progress(7, [10, 11], 2, 2, logprobs=[-0.5, -0.25])
+    j.record_progress(7, [12], 3, 3, logprobs=[-1.0])
+    j.close()
+    (e, ) = RequestJournal().recover()
+    assert e.tokens == [10, 11, 12] and e.key_burns == 3
+    assert e.logprobs == [-0.5, -0.25, -1.0]
+    assert e.deadline_wall == 123.5
+
+
+def test_compaction_drops_finished_and_preserves_live_state():
+    j = RequestJournal(compact_every=2)
+    for uid in (1, 2, 3):
+        j.record_admit(uid, [uid], {"max_new_tokens": 4})
+    j.record_progress(3, [30, 31], 2, 2)
+    j.record_finish(1)
+    j.record_finish(2)  # second finish → compaction triggers
+    j.close()
+    import os
+    size = os.path.getsize(j.path)
+    ents = RequestJournal().recover()
+    assert [(e.uid, e.tokens, e.key_burns) for e in ents] == [(3, [30, 31], 2)]
+    # the compacted segment holds 1 admit + 1 merged progress, nothing else
+    assert size < 200
+
+
+def test_torn_write_resyncs_past_the_torn_frame():
+    """A half-written record (crash mid-append) must not take down the
+    records BEHIND it: the scan resyncs on the next frame magic."""
+    j = RequestJournal()
+    j.record_admit(1, [1, 2], {"max_new_tokens": 4})
+    get_fault_injector().configure({"faults": [{
+        "site": "journal.torn_write", "nth": 1}]})
+    j.record_progress(1, [5], 1, 1)      # torn: only half the frame lands
+    get_fault_injector().reset()
+    j.record_admit(2, [3], {"max_new_tokens": 4})  # appended after the tear
+    j.close()
+
+    j2 = RequestJournal()
+    ents = j2.recover()
+    assert j2.quarantined_records >= 1
+    by_uid = {e.uid: e for e in ents}
+    assert set(by_uid) == {1, 2}
+    # the torn progress record is gone; uid 1 replays from its admit state
+    assert by_uid[1].tokens == [] and by_uid[1].key_burns == 0
+
+
+def test_corrupt_record_quarantines_exactly_that_record():
+    """Bit-rot inside one record (CRC fails, frame boundary intact): that
+    record alone is quarantined; earlier AND later records survive, and the
+    victim request freezes at its last consistent high-water mark."""
+    j = RequestJournal()
+    j.record_admit(1, [1, 2], {"max_new_tokens": 6})
+    j.record_progress(1, [5], 1, 1)      # consistent prefix
+    get_fault_injector().configure({"faults": [{
+        "site": "journal.corrupt_record", "nth": 1}]})
+    j.record_progress(1, [6], 2, 2)      # corrupted in place
+    get_fault_injector().reset()
+    j.record_progress(1, [7], 3, 3)      # chain gap: must freeze, not apply
+    j.record_admit(2, [3], {"max_new_tokens": 4})
+    j.close()
+
+    j2 = RequestJournal()
+    ents = j2.recover()
+    assert j2.quarantined_records == 1
+    by_uid = {e.uid: e for e in ents}
+    assert set(by_uid) == {1, 2}
+    # high-water mark frozen at the last CONSISTENT prefix: [5], burns=1 —
+    # the post-gap record (n_out=3) must NOT apply (it would double-emit 7
+    # at the wrong offset on replay)
+    assert by_uid[1].tokens == [5] and by_uid[1].key_burns == 1
+
+
+def test_journal_dir_resolution(monkeypatch, tmp_path):
+    monkeypatch.setenv("DS_TPU_JOURNAL_DIR", str(tmp_path / "explicit"))
+    assert journal_dir() == str(tmp_path / "explicit")
+    monkeypatch.delenv("DS_TPU_JOURNAL_DIR")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    assert journal_dir() == str(tmp_path / "xdg" / "deepspeed_tpu" / "journal")
+    # never a repo-relative path
+    assert journal_dir().startswith(str(tmp_path))
+
+
+def test_serving_crash_skips_normal_exception_boundaries():
+    """ServingCrash must sail past `except Exception` — that is what lets
+    it kill the loop through the tick retry AND the bisect quarantine."""
+    assert not issubclass(ServingCrash, Exception)
+    assert issubclass(ServingCrash, BaseException)
+
+
+# ---------------------------------------------------------------------------
+# key-chain fast-forward
+# ---------------------------------------------------------------------------
+
+
+def test_fast_forward_matches_incremental_burns():
+    """fast_forward_sampler(n) lands on the same key as n live sampled
+    dispatches — the invariant that makes resumed sampled streams
+    bit-identical."""
+    eng = _engine()
+    vocab = eng._model.config.vocab_size
+    rng = np.random.default_rng(3)
+    spec = SampleSpec(temperature=0.9, top_k=0, top_p=1.0, seed=11)
+    eng.seed_sampler(1, seed=11)
+    for _ in range(5):
+        row = rng.standard_normal(vocab).astype(np.float32)
+        eng.sample_rows([1], np.asarray([row]), [spec])
+    live = np.asarray(eng._sample_keys[1])
+
+    eng.fast_forward_sampler(2, 11, 5)
+    assert np.array_equal(np.asarray(eng._sample_keys[2]), live)
+    # and burns=0 is exactly PRNGKey(seed)
+    eng.fast_forward_sampler(3, 11, 0)
+    assert np.array_equal(np.asarray(eng._sample_keys[3]),
+                          np.asarray(jax.random.PRNGKey(11), np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# crash → warm-restart replay, bit-identical streams
+# ---------------------------------------------------------------------------
+
+
+def _assert_bit_identical(ref, pre, outs):
+    for i, (r, p, o) in enumerate(zip(ref, pre, outs)):
+        assert o is not None, f"req {i + 1} lost across the crash"
+        assert o[:len(p)] == p, \
+            f"req {i + 1}: replay rewrote pre-crash tokens {p} -> {o}"
+        assert o == r, f"req {i + 1}: not bit-identical: {r} != {o}"
+
+
+def test_crash_replay_bit_identical_per_token():
+    """The acceptance scenario on the per-token path: greedy + two sampled
+    requests (top-k and top-p), SIGKILL-equivalent crash mid-decode, warm
+    restart replays and every concatenated stream equals the uninterrupted
+    run."""
+    ps = _prompts(3, seed=0)
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=10, temperature=0.8, top_k=20,
+             seed=7),
+        dict(prompt=ps[1], max_new_tokens=10),
+        dict(prompt=ps[2], max_new_tokens=10, temperature=1.1, top_p=0.9,
+             seed=42),
+    ]
+    ref = _reference(submits)
+    pre, outs, stats = _crash_then_replay(submits, crash_nth=8)
+    _assert_bit_identical(ref, pre, outs)
+    assert stats["replayed_requests"] == 3
+
+
+@pytest.mark.slow  # heavier engine-rebuild variant; core coverage stays in tier-1
+def test_crash_replay_bit_identical_fused_window():
+    """Same scenario through the fused K-step scan (sampling inside the
+    lax.scan burns K keys per dispatch — the burn accounting must agree)."""
+    ps = _prompts(2, seed=21)
+    # each window-4 tick emits 4 tokens per request, so the budget must
+    # outlast the crash tick or the scenario degenerates to "all finished"
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=23, temperature=0.7, top_k=16,
+             seed=3),
+        dict(prompt=ps[1], max_new_tokens=23, temperature=1.0, top_p=0.85,
+             seed=9),
+    ]
+    ref = _reference(submits, window=4)
+    pre, outs, _ = _crash_then_replay(submits, crash_nth=4, window=4)
+    _assert_bit_identical(ref, pre, outs)
+
+
+@pytest.mark.slow  # heavier engine-rebuild variant; core coverage stays in tier-1
+def test_crash_replay_bit_identical_speculative():
+    """Speculative sampled request: window verification burns one key per
+    window; the replay must fast-forward by windows, not tokens."""
+    ps = _prompts(2, lo=12, seed=33)
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=12, temperature=0.8, top_k=24,
+             seed=5, speculative="prompt_lookup", num_draft_tokens=3,
+             draft_ngram=2),
+        dict(prompt=ps[1], max_new_tokens=12, speculative="prompt_lookup",
+             num_draft_tokens=3, draft_ngram=2),
+    ]
+    ref = _reference(submits)
+    pre, outs, _ = _crash_then_replay(submits, crash_nth=7)
+    _assert_bit_identical(ref, pre, outs)
+
+
+@pytest.mark.slow  # heavier engine-rebuild variant; core coverage stays in tier-1
+def test_crash_with_corrupt_record_still_replays_the_rest():
+    """Journal damage + crash: the corrupted record quarantines, its
+    request replays from the frozen mark (regenerating the lost suffix
+    deterministically), the undamaged request is untouched — and neither
+    stream double-emits."""
+    ps = _prompts(2, seed=50)
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=10, temperature=0.9, top_k=12,
+             seed=13),
+        dict(prompt=ps[1], max_new_tokens=10),
+    ]
+    ref = _reference(submits)
+    get_fault_injector().configure({"faults": [
+        {"site": "serve.crash", "nth": 8},
+        {"site": "journal.corrupt_record", "nth": 4},
+    ]})
+    s1 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    hs = [s1.submit(**kw) for kw in submits]
+    _wait_stopped(s1)
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    try:
+        outs = [s2.lookup(uid).result(timeout=300) for uid in (1, 2)]
+    finally:
+        s2.stop()
+    for r, o in zip(ref, outs):
+        assert o == r  # full stream intact — no loss, no double emission
+    del hs
+
+
+@pytest.mark.slow  # heavier engine-rebuild variant; core coverage stays in tier-1
+def test_handoff_preserves_journal_for_next_boot():
+    """SIGTERM path: handoff() drains WITHOUT retiring journal entries; the
+    next scheduler generation replays the in-flight request and finishes it
+    bit-identically."""
+    ps = _prompts(1, lo=20, seed=61)
+    submits = [dict(prompt=ps[0], max_new_tokens=24, temperature=0.8,
+                    top_k=10, seed=2)]
+    ref = _reference(submits)
+
+    s1 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    h1 = s1.submit(**submits[0])
+    while not h1._req.outputs:  # let at least one token land
+        time.sleep(0.005)
+    s1.handoff()
+    pre = list(h1._req.outputs)
+    assert 0 < len(pre) < 24
+
+    s2 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    try:
+        out = s2.lookup(1).result(timeout=300)
+        assert s2.stats["replayed_requests"] == 1
+    finally:
+        s2.stop()
+    assert out[:len(pre)] == pre and out == ref[0]
+
+
+def test_replayed_finished_request_finishes_without_decode():
+    """A request whose journal already holds a complete stream (crash
+    between its last token and its finish record) must finish immediately
+    on replay — not decode further, not double-emit."""
+    j = RequestJournal()
+    j.record_admit(1, [5, 6, 7], {"max_new_tokens": 3})
+    j.record_progress(1, [101, 102, 103], 3, 0)
+    j.close()
+
+    sched = ServingScheduler(_engine(), idle_wait=0.005).start()
+    try:
+        out = sched.lookup(1).result(timeout=60)
+        assert out == [101, 102, 103]
+    finally:
+        sched.stop()
+
+
+def test_replay_does_not_reuse_replayed_uids():
+    """Fresh submissions after a replay must mint uids ABOVE every
+    journaled uid, or a new request would collide with a replayed one in
+    the registry/journal."""
+    j = RequestJournal()
+    j.record_admit(41, [5, 6], {"max_new_tokens": 2})
+    j.close()
+    sched = ServingScheduler(_engine(), idle_wait=0.005).start()
+    try:
+        h = sched.submit([1, 2, 3], max_new_tokens=2)
+        assert h.uid > 41
+        sched.lookup(41).result(timeout=120)
+        h.result(timeout=120)
+    finally:
+        sched.stop()
+
+
+def test_disabled_config_journals_nothing(tmp_path, monkeypatch):
+    monkeypatch.setenv("DS_TPU_JOURNAL_DIR", str(tmp_path / "off"))
+    sched = ServingScheduler(_engine(durable=False), idle_wait=0.005).start()
+    try:
+        sched.submit(_prompts(1)[0], max_new_tokens=3).result(timeout=120)
+        assert sched.stats["journal_depth"] == 0
+    finally:
+        sched.stop()
+    assert not (tmp_path / "off").exists()
+
+
+# ---------------------------------------------------------------------------
+# client continuity: reconnect by uid + offset
+# ---------------------------------------------------------------------------
+
+
+def _http(sched):
+    httpd = create_http_server(sched, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+def test_http_reconnect_stream_from_offset():
+    """POST /generate returns the uid; a reconnecting client re-attaches
+    with GET /requests/<uid>/stream?from_token=N and receives exactly the
+    suffix — no token lost, none double-emitted."""
+    sched = ServingScheduler(_engine(), idle_wait=0.005).start()
+    httpd, port = _http(sched)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt": _prompts(1, seed=70)[0], "max_new_tokens": 8,
+             "temperature": 0.9, "top_k": 15, "seed": 4}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        uid, full = body["uid"], body["tokens"]
+        assert len(full) == 8
+        conn.close()
+
+        # re-attach mid-stream (request already finished — the offset
+        # contract is identical either way)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("GET", f"/requests/{uid}/stream?from_token=3")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("X-DS-Request-Id") == str(uid)
+        got = [json.loads(line)["token"]
+               for line in resp.read().decode().splitlines() if line.strip()]
+        conn.close()
+        assert got == full[3:]
+
+        # blocking re-attach returns the whole thing
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("GET", f"/requests/{uid}")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["tokens"] == full
+        conn.close()
+
+        # unknown uid → 404
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("GET", "/requests/999999")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        httpd.shutdown()
+        sched.stop()
+
+
+@pytest.mark.slow  # heavier engine-rebuild variant; core coverage stays in tier-1
+def test_stream_from_handle_survives_replay():
+    """The in-process analog of a client reconnect across a daemon
+    restart: stream_from(from_token=k) on the REPLAYED handle yields
+    exactly the suffix of the reference stream."""
+    ps = _prompts(1, seed=80)
+    submits = [dict(prompt=ps[0], max_new_tokens=10, temperature=0.8,
+                    top_k=20, seed=7)]
+    ref = _reference(submits)[0]
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": 6}]})
+    s1 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    h1 = s1.submit(**submits[0])
+    _wait_stopped(s1)
+    k = len(h1._req.outputs)
+    assert 0 < k < 10
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_engine(), idle_wait=0.005,
+                          fused_decode_window=1).start()
+    try:
+        got = list(s2.lookup(1).stream_from(from_token=k, timeout=300))
+    finally:
+        s2.stop()
+    assert got == ref[k:]
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_stats_surface_durability_fields(monkeypatch):
+    monkeypatch.setenv("DS_SERVE_RESTART_COUNT", "2")
+    j = RequestJournal()
+    j.record_admit(1, [4, 5], {"max_new_tokens": 2})
+    j.close()
+    sched = ServingScheduler(_engine(), idle_wait=0.005).start()
+    try:
+        st = sched.stats
+        assert st["replayed_requests"] == 1
+        assert st["restart_count"] == 2
+        assert st["last_restart_age_s"] >= 0
+        assert st["journal_depth"] >= 0
+        sched.lookup(1).result(timeout=120)
+    finally:
+        sched.stop()
+
+
+def test_health_endpoint_carries_durability_fields():
+    sched = ServingScheduler(_engine(), idle_wait=0.005).start()
+    httpd, port = _http(sched)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        for k in ("journal_depth", "replayed_requests", "restart_count"):
+            assert k in body, k
+    finally:
+        httpd.shutdown()
+        sched.stop()

@@ -1,0 +1,81 @@
+"""utils/retry.py edge cases.
+
+The helper sits under every resilience-layer IO path (checkpoint commits,
+rendezvous, serving tick retry, journal writes), so its boundary behavior
+is contract: a zero/negative budget still attempts once, the backoff is
+capped at ``max_delay``, and exceptions outside the filter propagate
+untouched (no RetriesExhausted wrapping, no consumed attempts).
+"""
+
+import pytest
+
+from deepspeed_tpu.utils.retry import RetriesExhausted, retry_with_backoff
+
+
+def test_zero_retry_budget_still_attempts_once():
+    """retries<=0 clamps to one attempt: fn runs exactly once, and its
+    failure surfaces as RetriesExhausted chained to the real error."""
+    calls = []
+    for budget in (0, -3):
+        calls.clear()
+
+        def fn():
+            calls.append(1)
+            raise OSError("disk on fire")
+
+        with pytest.raises(RetriesExhausted) as ei:
+            retry_with_backoff(fn, retries=budget, sleep=lambda s: None)
+        assert len(calls) == 1
+        assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_success_needs_no_sleep():
+    slept = []
+    assert retry_with_backoff(lambda: 42, retries=5,
+                              sleep=slept.append) == 42
+    assert slept == []
+
+
+def test_backoff_doubles_then_hits_ceiling():
+    """Delays follow base * 2**attempt, clamped at max_delay — and the
+    LAST failure sleeps nothing (there is no attempt after it to wait
+    for)."""
+    slept = []
+
+    def fn():
+        raise OSError("flaky")
+
+    with pytest.raises(RetriesExhausted):
+        retry_with_backoff(fn, retries=6, base_delay=0.1, max_delay=0.5,
+                           sleep=slept.append)
+    # 6 attempts -> 5 sleeps: 0.1, 0.2, 0.4, then capped at 0.5 twice
+    assert slept == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+
+def test_non_matching_exception_passes_through():
+    """An exception outside the filter is not retried and not wrapped —
+    callers distinguish 'transient infra' from 'real bug' by the filter."""
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError, match="logic bug"):
+        retry_with_backoff(fn, retries=5, exceptions=(OSError, ),
+                           sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_recovers_midway():
+    """A transient failure inside the budget is invisible to the caller."""
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_with_backoff(fn, retries=5, sleep=lambda s: None) == "ok"
+    assert state["n"] == 3
