@@ -170,6 +170,10 @@ run bench_serving_overload 1200 env DS_BENCH_OVERLOAD=1 DS_BENCH_FAST=1 python b
 # journal — rebuild/replay time, time-to-first-resumed-token, and the
 # bit_identical flag (the durability layer's correctness + cost evidence)
 run bench_serving_restart 1200 env DS_BENCH_RESTART=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_RESTART.json
+# 15i. continuous fused serving under open-loop Poisson arrivals: fused
+# occupancy, aggregate tok/s, TTFT p50/p99 at three offered loads with
+# the overlap OFF vs ON — the wave-stays-hot-under-live-traffic evidence
+run bench_serving_arrivals 1200 env DS_BENCH_ARRIVALS=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_ARRIVALS.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -96,6 +96,18 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"speculative draft source {'.' * 24} {NO} ({e})")
     try:
+        # continuous fused serving: whether the scheduler overlaps prefill
+        # + admission with the in-flight fused K-step decode wave, or
+        # falls back to the legacy exclusive modes (per-token decode
+        # whenever any prefill/arrival work exists)
+        from .inference.v2.config_v2 import ContinuousFusionConfig
+        ccfg = ContinuousFusionConfig()
+        mode = ("overlapped (prefill rides the in-flight wave)"
+                if ccfg.enabled else "exclusive (legacy gate)")
+        lines.append(f"continuous fused serving {'.' * 24} {mode}")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"continuous fused serving {'.' * 24} {NO} ({e})")
+    try:
         # durable serving: where the write-ahead request journal would land
         # (env/XDG resolution) and whether that directory is writable — the
         # first thing to check when warm restart isn't replaying anything

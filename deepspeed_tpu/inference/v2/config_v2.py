@@ -209,6 +209,55 @@ class DurableServingConfig(ConfigModel):
     False boots with a clean slate but keeps journaling new requests."""
 
 
+class ContinuousFusionConfig(ConfigModel):
+    """Continuous fused serving: keep the K-step fused decode wave hot
+    under live traffic. The scheduler dispatches the fused decode program
+    (JAX dispatch is async), then feeds prefill chunks and admits newly
+    feasible requests WHILE the wave runs on device, harvesting the fused
+    fetch only after the overlap work is enqueued — prefill and the K-step
+    amortization stop being mutually exclusive modes. KV safety needs no
+    extra partition machinery: the wave allocates every one of its K steps'
+    blocks before dispatch (allocation IS the reservation), so an overlap
+    put can only draw from what the wave left, and the eviction path is
+    fenced from flushing in-flight wave members."""
+
+    enabled: bool = True
+    """Master gate. False restores the exclusive-mode scheduler exactly:
+    the fused wave only runs when no prefill/admission work exists, so
+    sustained arrivals degrade every decode to per-token dispatches."""
+
+    prefill_budget_frac: float = 0.5
+    """Fraction of the SplitFuse token budget spendable on prefill chunks
+    inside the overlap window (while the fused program runs on device).
+    The remainder tick after harvest can still feed prefills from its
+    spare budget, so this bounds overlap-window work, not total prefill
+    throughput per tick."""
+
+    queue_depth_per_halving: int = 8
+    """Adaptive K, queue-pressure axis: the fused window is halved once
+    per this many waiting + inbox requests, shrinking toward per-token
+    mode as backlog builds so a K-step wave never delays admission of a
+    deep queue by more than a bounded amount. 0 disables the shrink."""
+
+    deadline_slack_frac: float = 0.5
+    """Adaptive K, deadline axis: K is capped so the wave's estimated
+    duration (EWMA of measured per-step time) fits within this fraction
+    of the slack to the nearest live/waiting deadline. Ignored until a
+    first wave has been measured."""
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not 0.0 <= self.prefill_budget_frac <= 1.0:
+            raise ValueError("prefill_budget_frac must be in [0, 1], got "
+                             f"{self.prefill_budget_frac}")
+        if self.queue_depth_per_halving < 0:
+            raise ValueError("queue_depth_per_halving must be >= 0")
+        if not 0.0 < self.deadline_slack_frac <= 1.0:
+            raise ValueError("deadline_slack_frac must be in (0, 1], got "
+                             f"{self.deadline_slack_frac}")
+        return self
+
+
 class QuantizationConfig(ConfigModel):
     quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
 
@@ -227,6 +276,8 @@ class RaggedInferenceEngineConfig(ConfigModel):
         default_factory=ServingResilienceConfig)
     durable_serving: DurableServingConfig = Field(
         default_factory=DurableServingConfig)
+    continuous_fusion: ContinuousFusionConfig = Field(
+        default_factory=ContinuousFusionConfig)
 
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).

@@ -49,6 +49,39 @@ class SampleSpec:
     min_new: int = 0
 
 
+@dataclass
+class _InFlightWave:
+    """A dispatched-but-unfetched fused decode program: the device is
+    running (or queued to run) the K-step scan while the scheduler's
+    overlap window feeds prefill chunks; ``out``/``lps``/``new_keys`` are
+    lazy jax arrays until :meth:`InferenceEngineV2.fused_decode_harvest`
+    blocks on them. Sequences' host bookkeeping was already advanced at
+    dispatch (plain waves grow deterministically by ``n_steps``)."""
+    uids: list
+    seqs: list
+    tokens: "np.ndarray"   # [S] input tokens (padded row order)
+    out: object            # lazy [n_steps, S] device tokens
+    lps: object            # lazy [n_steps, S] logprobs (sampled waves)
+    new_keys: object       # lazy [S, 2] advanced PRNG keys (sampled waves)
+    n_steps: int
+    sampled: bool
+
+
+@dataclass
+class _InFlightSpecWave:
+    """Speculative sibling of :class:`_InFlightWave`. Host bookkeeping is
+    wholly deferred to harvest — how far each sequence advanced is itself
+    a device result (the accepted counts)."""
+    uids: list
+    seqs: list
+    tokens: "np.ndarray"
+    out: object            # lazy [n_steps, S, 1+d] emitted tokens
+    n_emit: object         # lazy [n_steps, S] per-window emit counts
+    dlen: object           # lazy [n_steps, S] per-window draft lengths
+    new_keys: object       # lazy [S, 2] advanced keys (None when greedy)
+    n_steps: int
+
+
 _FF_KEY = None
 
 
@@ -791,6 +824,23 @@ class InferenceEngineV2:
         ``(tokens [n_seqs, n_steps], logprobs [n_seqs, n_steps])``, with
         each sequence's PRNG key advanced by exactly ``n_steps`` splits
         (the same count the per-token path would burn)."""
+        return self.fused_decode_harvest(
+            self.fused_decode_begin(batch_uids, last_tokens, n_steps,
+                                    specs=specs))
+
+    def fused_decode_begin(self, batch_uids, last_tokens, n_steps: int,
+                           specs=None):
+        """DISPATCH half of :meth:`fused_decode_steps` — the continuous
+        fusion scheduler's entry point. Feasibility-checks and allocates
+        every one of the wave's ``n_steps`` KV blocks (allocation IS the
+        KV partition: an overlap-window prefill put can only draw from
+        what the wave left), enqueues the fused program WITHOUT blocking
+        on the fetch, advances the sequences' host bookkeeping
+        (``pre_forward``/``post_forward`` — so allocator projections made
+        during the overlap window already see the wave's growth), and
+        returns an in-flight handle for :meth:`fused_decode_harvest`.
+        Host work needing device values (sampler-key stores, prefix-cache
+        pending appends) is deferred to harvest."""
         batch_uids = list(batch_uids)
         _fire_request_poison(batch_uids)
         seqs = []
@@ -828,10 +878,10 @@ class InferenceEngineV2:
             seq_lens[i] = seq.seen_tokens
             liv[i] = 1
             block_table[i] = seq.block_table(B)
-        lps = None
+        lps = new_keys = None
         if specs is None:
             out = self._model.fused_decode(tokens, seq_lens, liv, block_table,
-                                           n_steps)  # [K, S]
+                                           n_steps, fetch=False)  # [K, S]
         else:
             V = int(self._model.config.vocab_size)
             use_pen, use_eos, want_lp = self._spec_statics(specs)
@@ -848,23 +898,42 @@ class InferenceEngineV2:
                               top_ps=top_ps, penalties=pens, eos_ids=eos,
                               n_out=n_out, min_new=min_new, seen_mask=mask,
                               want_logprobs=want_lp, use_penalty=use_pen,
-                              use_eos_mask=use_eos))
-            for i, u in enumerate(batch_uids):
-                self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
-            lps = lps[:, :len(seqs)].T  # [n_seqs, K]
-        out = out[:, :len(seqs)].T  # [n_seqs, K]
-
-        pc = self._state_manager.prefix_cache
-        for i, seq in enumerate(seqs):
+                              use_eos_mask=use_eos),
+                fetch=False)
+        for seq in seqs:
             seq.pre_forward(n_steps)
             seq.post_forward()
-            if pc is not None:
+        return _InFlightWave(uids=batch_uids, seqs=seqs, tokens=tokens,
+                             out=out, lps=lps, new_keys=new_keys,
+                             n_steps=n_steps, sampled=specs is not None)
+
+    def fused_decode_harvest(self, wave: "_InFlightWave"):
+        """FETCH half of :meth:`fused_decode_steps`: block on the wave's
+        device arrays, store advanced sampler keys, stage prefix-cache
+        pending appends, and return the per-token contract — int32
+        ``[n_seqs, n_steps]`` tokens (plus ``[n_seqs, n_steps]`` logprobs
+        for a sampled wave)."""
+        n, n_steps = len(wave.seqs), wave.n_steps
+        lps = None
+        if wave.sampled:
+            out, lps, new_keys = jax.device_get(
+                (wave.out, wave.lps, wave.new_keys))
+            for i, u in enumerate(wave.uids):
+                self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
+            lps = np.asarray(lps)[:, :n].T  # [n_seqs, K]
+        else:
+            out = jax.device_get(wave.out)
+        out = np.asarray(out)[:, :n].T  # [n_seqs, K]
+
+        pc = self._state_manager.prefix_cache
+        if pc is not None:
+            for i, seq in enumerate(wave.seqs):
                 # fed tokens this dispatch = the input token plus every
                 # generated token except the last (it is fed by the NEXT
                 # dispatch) — mirrors one put() append per step
                 self._append_pending(
-                    seq, np.concatenate([[tokens[i]], out[i, :-1]]))
-        if specs is not None:
+                    seq, np.concatenate([[wave.tokens[i]], out[i, :-1]]))
+        if wave.sampled:
             return out, lps
         return out
 
@@ -898,6 +967,25 @@ class InferenceEngineV2:
         lists (variable length — between ``n_steps`` and
         ``n_steps * (1 + d)``), and per-uid totals of drafted / accepted
         tokens across the K windows (the accept-rate observability feed)."""
+        return self.fused_spec_decode_harvest(
+            self.fused_spec_decode_begin(
+                batch_uids, histories, n_steps,
+                num_draft_tokens=num_draft_tokens, draft_ngram=draft_ngram,
+                specs=specs))
+
+    def fused_spec_decode_begin(self, batch_uids, histories, n_steps: int, *,
+                                num_draft_tokens: int, draft_ngram: int,
+                                specs=None):
+        """DISPATCH half of :meth:`fused_spec_decode_steps`. Worst-case
+        KV for all ``n_steps * (1 + d)`` tokens is allocated before the
+        dispatch (the KV partition invariant, like
+        :meth:`fused_decode_begin`), but — unlike the plain wave — the
+        sequences' ``pre_forward`` advance depends on the device's
+        accepted counts, so ALL host bookkeeping is deferred to
+        :meth:`fused_spec_decode_harvest`; during the overlap window the
+        wave members' ``seen_tokens`` are stale-low, which only makes
+        admission projections conservative (their worst-case blocks are
+        already taken)."""
         batch_uids = list(batch_uids)
         _fire_request_poison(batch_uids)
         d = max(1, int(num_draft_tokens))
@@ -968,10 +1056,25 @@ class InferenceEngineV2:
                             top_ps=top_ps)
         out, n_emit, dlen, new_keys = self._model.fused_spec_decode(
             tokens, seq_lens, liv, block_table, hist, hist_len, ngrams,
-            max_d, n_steps, d, max_ngram, sampling=sampling)
-        if new_keys is not None:
-            for i, u in enumerate(batch_uids):
+            max_d, n_steps, d, max_ngram, sampling=sampling, fetch=False)
+        return _InFlightSpecWave(uids=batch_uids, seqs=seqs, tokens=tokens,
+                                 out=out, n_emit=n_emit, dlen=dlen,
+                                 new_keys=new_keys, n_steps=n_steps)
+
+    def fused_spec_decode_harvest(self, wave: "_InFlightSpecWave"):
+        """FETCH half of :meth:`fused_spec_decode_steps`: block on the
+        wave, store advanced keys, run the deferred per-sequence
+        bookkeeping against the device's accepted counts, and return
+        ``(tokens, drafted, accepted)``."""
+        n_steps, tokens, seqs = wave.n_steps, wave.tokens, wave.seqs
+        if wave.new_keys is not None:
+            out, n_emit, dlen, new_keys = jax.device_get(
+                (wave.out, wave.n_emit, wave.dlen, wave.new_keys))
+            for i, u in enumerate(wave.uids):
                 self._sample_keys[u] = np.asarray(new_keys[i], np.uint32)
+        else:
+            out, n_emit, dlen = jax.device_get(
+                (wave.out, wave.n_emit, wave.dlen))
 
         pc = self._state_manager.prefix_cache
         toks_lists, drafted, accepted = [], [], []

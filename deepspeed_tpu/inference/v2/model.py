@@ -378,7 +378,7 @@ class RaggedLlamaModel:
         return logits
 
     def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int,
-                     sampling: Optional[dict] = None):
+                     sampling: Optional[dict] = None, fetch: bool = True):
         """``n_steps`` decode steps in ONE XLA program (lax.scan over the
         single-token ragged forward). The TPU-native answer to the
         reference v1 engine's CUDA-graph decode capture
@@ -404,7 +404,15 @@ class RaggedLlamaModel:
         ``use_penalty``/``use_eos_mask``), each scan step runs logit
         controls → ops/sampling.sample_core → feed-back, and the call
         returns ``(toks [n_steps, S], logprobs [n_steps, S], new_keys
-        [S, 2])`` in one host transfer."""
+        [S, 2])`` in one host transfer.
+
+        ``fetch=False`` returns the same tuple as LAZY device arrays: the
+        program is dispatched (JAX dispatch is async) but the host does
+        not block on the result — the continuous-fusion scheduler feeds
+        prefill chunks while the wave runs, then fetches. The KV cache
+        ref is already rebound to the program's (lazy) output, so any
+        forward dispatched afterwards serializes behind the wave through
+        the donated-cache data dependency."""
         kv = self._state_manager.kv_cache
         total_slots = kv.num_blocks * kv.block_size
         S, B = tokens.shape[0], block_table.shape[1]
@@ -446,19 +454,24 @@ class RaggedLlamaModel:
         if sampling is None:
             out, new_cache = fn(*args)
             kv.update(new_cache)
+            if not fetch:
+                return out
             return np.asarray(out)
         sargs = {k: (jnp.asarray(v) if v is not None else None)
                  for k, v in sampling.items()
                  if k not in ("want_logprobs", "use_penalty", "use_eos_mask")}
         out, lps, new_keys, new_cache = fn(*args, **sargs)
         kv.update(new_cache)
+        if not fetch:
+            return out, lps, new_keys
         out, lps, new_keys = jax.device_get((out, lps, new_keys))
         return np.asarray(out), np.asarray(lps), np.asarray(new_keys)
 
     def fused_spec_decode(self, tokens, seq_lens, live, block_table, hist,
                           hist_len, ngrams, max_drafts, n_steps: int,
                           draft_width: int, max_ngram: int,
-                          sampling: Optional[dict] = None):
+                          sampling: Optional[dict] = None,
+                          fetch: bool = True):
         """``n_steps`` speculative draft/verify windows in ONE XLA program
         — the speculative sibling of ``fused_decode``. Each scan iteration
         drafts up to ``draft_width`` tokens per row from a carried
@@ -484,7 +497,10 @@ class RaggedLlamaModel:
         n_emit [n_steps, S] int32, dlen [n_steps, S] int32, new_keys)``
         where window w of row i emitted ``out[w, i, :n_emit[w, i]]``
         tokens after drafting ``dlen[w, i]`` (accepted = n_emit - 1), and
-        ``new_keys`` is None for the greedy program."""
+        ``new_keys`` is None for the greedy program. ``fetch=False``
+        returns the same tuple as LAZY device arrays (see
+        :meth:`fused_decode`) so the scheduler can overlap host work with
+        the in-flight windows."""
         kv = self._state_manager.kv_cache
         total_slots = kv.num_blocks * kv.block_size
         S, B = tokens.shape[0], block_table.shape[1]
@@ -523,11 +539,15 @@ class RaggedLlamaModel:
         if sampling is None:
             out, n_emit, dlen, new_cache = fn(*args)
             kv.update(new_cache)
+            if not fetch:
+                return out, n_emit, dlen, None
             out, n_emit, dlen = jax.device_get((out, n_emit, dlen))
             return np.asarray(out), np.asarray(n_emit), np.asarray(dlen), None
         sargs = {k: jnp.asarray(v) for k, v in sampling.items()}
         out, n_emit, dlen, new_keys, new_cache = fn(*args, **sargs)
         kv.update(new_cache)
+        if not fetch:
+            return out, n_emit, dlen, new_keys
         out, n_emit, dlen, new_keys = jax.device_get(
             (out, n_emit, dlen, new_keys))
         return (np.asarray(out), np.asarray(n_emit), np.asarray(dlen),

@@ -72,7 +72,8 @@ import numpy as np
 from ...utils.fault_injection import InjectedFault, get_fault_injector
 from ...utils.logging import logger
 from ...utils.retry import RetriesExhausted, retry_with_backoff
-from .config_v2 import DurableServingConfig, ServingResilienceConfig
+from .config_v2 import (ContinuousFusionConfig, DurableServingConfig,
+                        ServingResilienceConfig)
 from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
@@ -287,6 +288,21 @@ class ServingScheduler:
         # parity oracle) for everything.
         self._fused_spec = bool(scfg and scfg.fused_speculative_decode)
         self._spec_max_ngram = int(scfg.spec_max_ngram) if scfg else 8
+        # continuous fusion: dispatch the fused wave (async), feed prefill
+        # chunks + admit arrivals WHILE it runs on device, harvest after —
+        # the K-step amortization survives sustained traffic instead of
+        # being an idle-system-only mode. Gate-off restores the exclusive
+        # modes exactly.
+        ccfg = getattr(engine._config, "continuous_fusion", None)
+        self._cf: ContinuousFusionConfig = (
+            ccfg if ccfg is not None else ContinuousFusionConfig())
+        # uids of wave members whose fused program is in flight: the
+        # eviction and retirement paths must not flush them (the device is
+        # still writing their KV); empty outside the overlap window
+        self._in_flight: frozenset = frozenset()
+        # EWMA of measured seconds per fused decode step — the adaptive-K
+        # deadline bound's cost model (0 until the first wave completes)
+        self._step_ewma = 0.0
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._inbox: List[_Request] = []
@@ -316,7 +332,14 @@ class ServingScheduler:
         self._trace = {"shed": 0, "expired_queue": 0, "expired_live": 0,
                        "tick_errors": 0, "quarantined": [],
                        "watchdog_trips": 0, "slow_consumer_cancels": 0,
-                       "spec_drafted": 0, "spec_accepted": 0}
+                       "spec_drafted": 0, "spec_accepted": 0,
+                       # continuous-fusion observability: decode tokens
+                       # from fused dispatches vs all decode tokens (the
+                       # occupancy ratio), dispatch/window-size tallies,
+                       # and prefill tokens fed inside overlap windows
+                       "fused_tokens": 0, "decode_tokens": 0,
+                       "fused_dispatches": 0, "fused_k_sum": 0,
+                       "prefill_overlap_tokens": 0}
         # durability: the write-ahead request journal (explicit instance
         # wins; else built from the durable_serving config block), plus the
         # uid registry the reconnect surface resolves against
@@ -508,6 +531,11 @@ class ServingScheduler:
             watchdog_trips = tr["watchdog_trips"]
             spec_drafted = tr["spec_drafted"]
             spec_accepted = tr["spec_accepted"]
+            fused_tokens = tr["fused_tokens"]
+            decode_tokens = tr["decode_tokens"]
+            fused_dispatches = tr["fused_dispatches"]
+            fused_k_sum = tr["fused_k_sum"]
+            prefill_overlap = tr["prefill_overlap_tokens"]
         out = {"waiting": len(self._waiting) + inbox,
                "live": len(self._live),
                "free_blocks": self._engine.free_blocks,
@@ -525,6 +553,15 @@ class ServingScheduler:
                "spec_accepted": spec_accepted,
                "spec_accept_rate": (round(spec_accepted / spec_drafted, 4)
                                     if spec_drafted else None),
+               # continuous fusion: how much of the decode stream the
+               # K-step wave owns (≈0 means every token pays a per-token
+               # host round-trip), the realized mean window, and prefill
+               # tokens fed while a wave was in flight
+               "fused_occupancy": (round(fused_tokens / decode_tokens, 4)
+                                   if decode_tokens else None),
+               "mean_fused_K": (round(fused_k_sum / fused_dispatches, 2)
+                                if fused_dispatches else None),
+               "prefill_overlap_tokens": prefill_overlap,
                "journal_depth": (self._journal.depth
                                  if self._journal is not None else 0),
                "replayed_requests": self._replayed,
@@ -928,8 +965,9 @@ class ServingScheduler:
         while len(suspects) > 1:
             test = suspects[:len(suspects) // 2]
             rest = suspects[len(suspects) // 2:]
-            parked = [r for r in self._live if r not in test]
-            self._live = [r for r in self._live if r in test]
+            test_ids = {id(r) for r in test}
+            parked = [r for r in self._live if id(r) not in test_ids]
+            self._live = [r for r in self._live if id(r) in test_ids]
             try:
                 self._advance_tick()
                 nxt = rest  # test half ticked clean: culprit is elsewhere
@@ -940,7 +978,8 @@ class ServingScheduler:
             # a probe tick may have retired suspects (eos/eviction): keep
             # only the ones still live — an empty set means the fault
             # dissolved and the next regular tick proceeds normally
-            suspects = [r for r in nxt if r in self._live]
+            live_ids = {id(r) for r in self._live}
+            suspects = [r for r in nxt if id(r) in live_ids]
             if not suspects:
                 return
         culprit = suspects[0]
@@ -1061,62 +1100,233 @@ class ServingScheduler:
                 self._queued_tokens += len(req.prompt)
 
     def _advance_tick(self) -> bool:
-        """ONE ragged forward of ≤ token_budget tokens (Dynamic SplitFuse):
-        decoding sequences (pending == 1) are guaranteed their token first,
-        prefilling sequences chunk into the remaining budget. A sequence
-        samples only on the tick its feed completes."""
+        """ONE scheduling pass of ≤ token_budget fed tokens (Dynamic
+        SplitFuse): decoding sequences (pending == 1) are guaranteed their
+        token first, prefilling sequences chunk into the remaining budget.
+        A sequence samples only on the tick its feed completes.
+
+        With continuous fusion (the default), the fusable decodes run
+        their K-step wave EVERY tick — dispatched async, with prefill
+        chunks and admission overlapped while the program runs on device
+        (_continuous_tick). With the gate off, the wave only runs in the
+        legacy exclusive mode: a quiet system with no prefill, no inbox,
+        and no ADMISSIBLE waiting request (a request that cannot admit
+        until KV frees gets no say — it cannot run either way, so it must
+        not pin every decode to per-token dispatches)."""
         if not self._live:
             return False
         budget = self._token_budget
         decodes = [r for r in self._live if r.pending == 1]
         prefills = [r for r in self._live if r.pending > 1]
-        if (self._fused_window > 1 and decodes and not prefills
-                and not self._waiting and not self._inbox):
-            # steady state: fuse EVERY feasible decode (K steps, one
-            # dispatch) — plain-greedy requests and (when on-device
-            # sampling is enabled) sampled/controlled ones together; the
-            # partition is by feasibility, not greediness. Requests the
-            # device cannot own — speculative drafting and host
-            # logits_processor callbacks — keep their per-token tick below
-            # (each request's sampling depends only on its own context, so
-            # outputs are unchanged by who shares the dispatch). A
-            # just-admitted 1-token-prompt request has pending==1 but NO
-            # engine sequence yet — it must take the per-token path, which
-            # owns prefill (fused_decode_steps requires prefilled history).
-            sm = self._engine._state_manager
+        if self._fused_window > 1 and decodes:
+            if self._cf.enabled:
+                done = self._continuous_tick(decodes, prefills, budget)
+                if done is not None:
+                    return done
+                # no wave could form (nothing fusable / adaptive K < 2 /
+                # KV refused): the per-token tick below owns this pass
+            elif (not prefills and not self._inbox
+                    and not self._has_admissible_waiting()):
+                # legacy exclusive mode: fuse EVERY feasible decode (K
+                # steps, one dispatch) — plain-greedy requests and (when
+                # on-device sampling is enabled) sampled/controlled ones
+                # together; the partition is by feasibility, not
+                # greediness. Requests the device cannot own — speculative
+                # drafting and host logits_processor callbacks — keep
+                # their per-token tick below (each request's sampling
+                # depends only on its own context, so outputs are
+                # unchanged by who shares the dispatch). A just-admitted
+                # 1-token-prompt request has pending==1 but NO engine
+                # sequence yet — it must take the per-token path, which
+                # owns prefill (fused_decode_steps requires prefilled
+                # history).
+                eligible = [r for r in decodes if self._fusable(r)]
+                fused = self._fused_tick(eligible) if eligible else []
+                # speculative rows run their OWN fused wave (the
+                # draft/verify scan feeds 1+d tokens per window — a
+                # different program from the 1-token fused decode),
+                # grouped so one dispatch still serves everything with
+                # the same feed geometry
+                live_ids = {id(r) for r in self._live}
+                spec_rows = [r for r in decodes
+                             if id(r) in live_ids and self._prefilled(r)
+                             and self._spec_fusable(r)]
+                fused += self._fused_spec_tick(spec_rows) if spec_rows \
+                    else []
+                if fused:
+                    # exclude exactly the requests the fused dispatch
+                    # advanced; near-budget greedy stragglers the
+                    # partition left out stay in ``decodes`` and take
+                    # this same tick's per-token path — one constrained
+                    # request no longer demotes the whole wave
+                    fused_ids = {id(r) for r in fused}
+                    live_ids = {id(r) for r in self._live}
+                    decodes = [r for r in decodes
+                               if id(r) not in fused_ids
+                               and id(r) in live_ids]
+                    if not decodes:
+                        return True
+                    # fall through: per-token tick for the remainder
+        return self._per_token_tick(decodes, prefills, budget)
 
-            def _prefilled(r):
-                seq = sm.get_sequence(r.uid)
-                return seq is not None and seq.seen_tokens > 0
+    def _prefilled(self, r: _Request) -> bool:
+        seq = self._engine._state_manager.get_sequence(r.uid)
+        return seq is not None and seq.seen_tokens > 0
 
-            def _fusable(r):
-                if r.speculative is not None or not _prefilled(r):
-                    return False
-                if self._plain_greedy(r):
-                    return True
-                return self._fused_sampled and self._device_eligible(r)
+    def _fusable(self, r: _Request) -> bool:
+        if r.speculative is not None or not self._prefilled(r):
+            return False
+        if self._plain_greedy(r):
+            return True
+        return self._fused_sampled and self._device_eligible(r)
 
-            eligible = [r for r in decodes if _fusable(r)]
-            fused = self._fused_tick(eligible) if eligible else []
-            # speculative rows run their OWN fused wave (the draft/verify
-            # scan feeds 1+d tokens per window — a different program from
-            # the 1-token fused decode), grouped so one dispatch still
-            # serves everything with the same feed geometry
-            spec_rows = [r for r in decodes
-                         if r in self._live and _prefilled(r)
-                         and self._spec_fusable(r)]
-            fused += self._fused_spec_tick(spec_rows) if spec_rows else []
-            if fused:
-                # exclude exactly the requests the fused dispatch advanced;
-                # near-budget greedy stragglers the partition left out stay
-                # in ``decodes`` and take this same tick's per-token path —
-                # one constrained request no longer demotes the whole wave
-                fused_ids = {id(r) for r in fused}
-                decodes = [r for r in decodes
-                           if id(r) not in fused_ids and r in self._live]
-                if not decodes:
-                    return True
-                # fall through: per-token tick for the sampled remainder
+    def _has_admissible_waiting(self) -> bool:
+        """True only if some waiting request could actually join the live
+        set right now (seq-count + full-reservation feasible). _admit ran
+        earlier this tick, so leftovers are normally infeasible — this
+        re-check exists because admission stops at the first infeasible
+        head-of-line request, which may shadow a smaller feasible one.
+        An infeasible-until-KV-frees request returns False: it cannot run
+        whether or not the wave fuses, so it must not demote the fused
+        path to per-token mode (the `_waiting`-pins-the-wave bug)."""
+        if not self._waiting:
+            return False
+        if len(self._live) >= self._max_seqs:
+            return False
+        free = self._engine.free_blocks - self._live_reserve()
+        for req in self._waiting:
+            need = self._future_blocks(
+                PlaceholderSequenceDescriptor(),
+                len(req.feed) + max(0, req.max_new_tokens - len(req.outputs)))
+            if need <= free:
+                return True
+        return False
+
+    def _adaptive_window(self) -> int:
+        """Continuous-fusion window: the configured K, shrunk toward 1 as
+        queue depth grows (halved per ``queue_depth_per_halving`` queued
+        requests) and capped so the wave's estimated duration fits inside
+        ``deadline_slack_frac`` of the slack to the nearest deadline —
+        overlap never costs more than a bounded TTFT/deadline delay."""
+        cap = self._fused_window
+        cf = self._cf
+        if cf.queue_depth_per_halving > 0:
+            with self._lock:
+                depth = len(self._inbox)
+            depth += len(self._waiting)
+            cap >>= min(depth // cf.queue_depth_per_halving, cap.bit_length())
+        if self._step_ewma > 0.0 and self._res.enabled:
+            now = time.monotonic()
+            slack = None
+            for r in self._live + self._waiting:
+                if r.t_deadline is not None:
+                    s = r.t_deadline - now
+                    slack = s if slack is None else min(slack, s)
+            if slack is not None:
+                if slack <= 0:
+                    return 1  # past due: expiry owns it next tick
+                cap = min(cap, int(slack * cf.deadline_slack_frac
+                                   / self._step_ewma))
+        return max(cap, 1)
+
+    def _continuous_tick(self, decodes, prefills, budget) -> Optional[bool]:
+        """The overlapped tick: dispatch the fused K-step wave(s) async,
+        spend the overlap window on host-side work (inbox drain, admission
+        of newly feasible requests, prefill chunks up to the prefill
+        budget) while the program runs on device, THEN harvest the fused
+        fetch, and finish with a per-token pass for whatever the wave
+        could not own. Returns None when no wave formed — the caller's
+        per-token tick owns the pass (including eviction)."""
+        eligible = [r for r in decodes if self._fusable(r)]
+        spec_rows = [r for r in decodes if self._prefilled(r)
+                     and self._spec_fusable(r)]
+        if not eligible and not spec_rows:
+            return None
+        cap = self._adaptive_window()
+        if cap < 2:
+            return None
+        t0 = time.monotonic()
+        wave = self._fused_begin(eligible, cap) if eligible else None
+        swaves = self._fused_spec_begin(spec_rows, cap) if spec_rows else []
+        if wave is None and not swaves:
+            return None
+        protected = set()
+        if wave is not None:
+            protected.update(r.uid for r in wave[0])
+        for sw in swaves:
+            protected.update(r.uid for r in sw[0])
+        self._in_flight = frozenset(protected)
+        n_steps = 0
+        try:
+            fed = self._overlap_fill(budget)
+            if fed:
+                self._trace["prefill_overlap_tokens"] += fed
+        finally:
+            # harvest EVEN IF the overlap work raised (a put fault rides
+            # the tick retry boundary): an unharvested wave would leave
+            # seq bookkeeping advanced with its tokens lost
+            advanced = []
+            if wave is not None:
+                advanced += self._fused_harvest(wave)
+                n_steps = max(n_steps, wave[2])
+            for sw in swaves:
+                advanced += self._fused_spec_harvest(sw)
+                n_steps = max(n_steps, sw[1])
+            self._in_flight = frozenset()
+        if n_steps:
+            per_step = (time.monotonic() - t0) / n_steps
+            self._step_ewma = (per_step if self._step_ewma == 0.0
+                               else 0.7 * self._step_ewma + 0.3 * per_step)
+        self._retire_finished()
+        # remainder pass: per-token tick for live decodes the wave didn't
+        # advance (spec-ineligible rows, unprefilled admits, near-budget
+        # stragglers) and any prefill still pending after the overlap —
+        # rebuilt from the live set so overlap-window admissions ride this
+        # same tick
+        adv_ids = {id(r) for r in advanced}
+        rem_decodes = [r for r in self._live
+                       if r.pending == 1 and id(r) not in adv_ids]
+        rem_prefills = [r for r in self._live if r.pending > 1]
+        if rem_decodes or rem_prefills:
+            self._per_token_tick(rem_decodes, rem_prefills, budget)
+        return True
+
+    def _overlap_fill(self, budget) -> int:
+        """Host-side work done WHILE the fused wave runs on device: drain
+        the inbox, admit newly feasible arrivals, and feed prefill chunks
+        up to ``prefill_budget_frac`` of the token budget. The wave's KV
+        is untouchable by construction — all its blocks were allocated at
+        dispatch — and _tick_put's eviction fence keeps wave members out
+        of the victim choice. Returns the prefill tokens fed."""
+        with self._lock:
+            if self._inbox:
+                self._waiting.extend(self._inbox)
+                self._inbox = []
+        if self._waiting:
+            self._admit()
+        p_budget = int(budget * self._cf.prefill_budget_frac)
+        if p_budget <= 0:
+            return 0
+        p_reqs, p_chunks, spent = [], [], 0
+        for req in self._live:
+            if spent >= p_budget:
+                break
+            if req.uid in self._in_flight or req.pending <= 1:
+                continue
+            take = min(req.pending, p_budget - spent)
+            p_reqs.append(req)
+            p_chunks.append(req.feed_slice(take))
+            spent += take
+        if not p_reqs:
+            return 0
+        if self._tick_put(p_reqs, p_chunks, {}) is None:
+            return 0  # eviction fence refused / eviction ended the fill
+        return spent
+
+    def _per_token_tick(self, decodes, prefills, budget) -> bool:
+        """The per-token SplitFuse pass: one ragged forward covering every
+        decode's reserved token, host-path drafts, and prefill chunks in
+        the spare budget."""
         # decode SLA: every decoding sequence's 1 token is RESERVED before
         # drafts or prefill chunks may spend anything (generate() reserves
         # identically: draft_budget = max_batch - len(live))
@@ -1210,35 +1420,60 @@ class ServingScheduler:
         first generations, so ``fed += K`` restores the pending==1 decode
         invariant; requests whose emit was cut short (eos/stop/max) retire
         this tick, exactly the conditions _emit_many cut on."""
+        wave = self._fused_begin(decodes, self._fused_window)
+        if wave is None:
+            return []
+        fused = self._fused_harvest(wave)
+        self._retire_finished()
+        return fused
+
+    def _fused_begin(self, decodes, cap: int):
+        """Partition + async dispatch of the plain/sampled fused wave.
+        Returns ``(fused_reqs, engine_handle, K, all_greedy)``, or None
+        when no subset reaches a 2-step window or KV pressure refuses the
+        wave (the caller's per-token tick owns eviction)."""
         fusable_uids, K, _solo = self._engine.fused_partition(
             [r.uid for r in decodes],
-            [r.max_new_tokens - len(r.outputs) for r in decodes],
-            self._fused_window)
+            [r.max_new_tokens - len(r.outputs) for r in decodes], cap)
         if K < 2:
-            return []
+            return None
         fusable_set = set(fusable_uids)
         fused = [r for r in decodes if r.uid in fusable_set]
         all_greedy = all(self._plain_greedy(r) for r in fused)
-        lps = None
         try:
             if all_greedy:
-                toks = self._engine.fused_decode_steps(
+                h = self._engine.fused_decode_begin(
                     [r.uid for r in fused],
                     [r.feed_slice(1)[0] for r in fused], K)
             else:
-                toks, lps = self._engine.fused_decode_steps(
+                h = self._engine.fused_decode_begin(
                     [r.uid for r in fused],
                     [r.feed_slice(1)[0] for r in fused], K,
                     specs=[self._spec_for(r) for r in fused])
-                for r in fused:  # the sampled scan splits once per step
-                    r.key_burns += K
         except SchedulingError:
-            return []
+            return None
+        return (fused, h, K, all_greedy)
+
+    def _fused_harvest(self, wave) -> list:
+        """Fetch + emit a dispatched fused wave (retirement is the
+        caller's pass — wave members must not flush mid-overlap)."""
+        fused, h, K, all_greedy = wave
+        lps = None
+        if all_greedy:
+            toks = self._engine.fused_decode_harvest(h)
+        else:
+            toks, lps = self._engine.fused_decode_harvest(h)
+            for r in fused:  # the sampled scan splits once per step
+                r.key_burns += K
+        self._trace["fused_dispatches"] += 1
+        self._trace["fused_k_sum"] += K
         for i, (req, row) in enumerate(zip(fused, toks)):
             req.fed += K
-            self._emit_many(req, [int(t) for t in row],
-                            lps=[float(l) for l in lps[i]]
-                            if lps is not None else None)
+            emitted = self._emit_many(req, [int(t) for t in row],
+                                      lps=[float(l) for l in lps[i]]
+                                      if lps is not None else None)
+            self._trace["fused_tokens"] += emitted
+            self._trace["decode_tokens"] += emitted
             if not self._engine.decode_finished(
                     req.uid, req.outputs, req.max_new_tokens,
                     req.eos_token_id, req.stop):
@@ -1248,7 +1483,6 @@ class ServingScheduler:
                 seq = self._engine._state_manager.get_sequence(req.uid)
                 self._engine._register_pending(seq)
                 self._engine._model.maybe_free_kv(seq)
-        self._retire_finished()
         return fused
 
     def _spec_fusable(self, r: _Request) -> bool:
@@ -1273,52 +1507,72 @@ class ServingScheduler:
         emits between K and K*(1+d) tokens per row; ``fed`` advances by
         the emitted count so the pending==1 decode invariant holds, and
         the accept counters feed the per-request + /health observability."""
+        advanced = []
+        for sw in self._fused_spec_begin(decodes, self._fused_window):
+            advanced.extend(self._fused_spec_harvest(sw))
+        self._retire_finished()
+        return advanced
+
+    def _fused_spec_begin(self, decodes, cap: int) -> list:
+        """Partition + async dispatch of the speculative wave(s), one per
+        (draft width, ngram) group. Returns a list of
+        ``(fused_reqs, K, engine_handle, all_greedy)`` handles — possibly
+        empty under KV pressure (the per-token tick owns eviction)."""
         groups = {}
         for r in decodes:
             groups.setdefault((r.num_draft_tokens, r.draft_ngram),
                               []).append(r)
-        advanced = []
+        waves = []
         for (d, ng), rows in groups.items():
             fusable_uids, K, _solo = self._engine.fused_spec_partition(
                 [r.uid for r in rows],
                 [r.max_new_tokens - len(r.outputs) for r in rows],
-                d, self._fused_window)
+                d, cap)
             if K < 2:
                 continue
             fusable_set = set(fusable_uids)
             fused = [r for r in rows if r.uid in fusable_set]
             all_greedy = all(r.temperature == 0.0 for r in fused)
             try:
-                toks_lists, drafted, accepted = \
-                    self._engine.fused_spec_decode_steps(
-                        [r.uid for r in fused], [r.feed for r in fused], K,
-                        num_draft_tokens=d, draft_ngram=ng,
-                        specs=None if all_greedy
-                        else [self._spec_for(r) for r in fused])
+                h = self._engine.fused_spec_decode_begin(
+                    [r.uid for r in fused], [r.feed for r in fused], K,
+                    num_draft_tokens=d, draft_ngram=ng,
+                    specs=None if all_greedy
+                    else [self._spec_for(r) for r in fused])
             except SchedulingError:
                 continue  # KV pressure: the per-token tick owns eviction
-            if not all_greedy:  # one split per verified window, K windows
-                for req in fused:
-                    req.key_burns += K
-            for req, row, dr, ac in zip(fused, toks_lists, drafted,
-                                        accepted):
-                req.fed += len(row)
-                req.drafted += dr
-                req.accepted += ac
-                self._trace["spec_drafted"] += dr
-                self._trace["spec_accepted"] += ac
-                self._emit_many(req, row)
-                if not self._engine.decode_finished(
-                        req.uid, req.outputs, req.max_new_tokens,
-                        req.eos_token_id, req.stop):
-                    # deferred bookkeeping exactly like _fused_tick:
-                    # retiring rows flush in _retire_finished instead
-                    seq = self._engine._state_manager.get_sequence(req.uid)
-                    self._engine._register_pending(seq)
-                    self._engine._model.maybe_free_kv(seq)
-            advanced.extend(fused)
-        self._retire_finished()
-        return advanced
+            waves.append((fused, K, h, all_greedy))
+        return waves
+
+    def _fused_spec_harvest(self, swave) -> list:
+        """Fetch + emit one dispatched speculative wave."""
+        fused, K, h, all_greedy = swave
+        toks_lists, drafted, accepted = \
+            self._engine.fused_spec_decode_harvest(h)
+        if not all_greedy:  # one split per verified window, K windows
+            for req in fused:
+                req.key_burns += K
+        self._trace["fused_dispatches"] += 1
+        self._trace["fused_k_sum"] += K
+        for req, row, dr, ac in zip(fused, toks_lists, drafted,
+                                    accepted):
+            req.fed += len(row)
+            req.drafted += dr
+            req.accepted += ac
+            self._trace["spec_drafted"] += dr
+            self._trace["spec_accepted"] += ac
+            emitted = self._emit_many(req, row)
+            self._trace["fused_tokens"] += emitted
+            self._trace["decode_tokens"] += emitted
+            if not self._engine.decode_finished(
+                    req.uid, req.outputs, req.max_new_tokens,
+                    req.eos_token_id, req.stop):
+                # deferred bookkeeping exactly like _fused_tick:
+                # retiring rows flush in _retire_finished instead
+                seq = self._engine._state_manager.get_sequence(req.uid)
+                self._engine._register_pending(seq)
+                self._engine._model.maybe_free_kv(seq)
+        return fused
 
     def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:
         """One ragged put + row processing. Returns None if KV exhaustion
@@ -1350,7 +1604,17 @@ class ServingScheduler:
                 # finish it truncated (generate()'s lone-sequence
                 # semantics) instead of requeueing it into a guaranteed
                 # admission error discarding the tokens already streamed.
-                victim = self._live.pop()
+                # EVICTION FENCE: a member of an in-flight fused wave is
+                # untouchable — the device program is still writing its KV
+                # pages — so the victim is the newest NON-wave sequence;
+                # with only wave members live the fill simply yields (the
+                # post-harvest pass owns eviction with the fence down).
+                vi = next((i for i in range(len(self._live) - 1, -1, -1)
+                           if self._live[i].uid not in self._in_flight),
+                          None)
+                if vi is None:
+                    return None
+                victim = self._live.pop(vi)
                 self._engine.flush(victim.uid)
                 victim.fed = 0
                 if self._live:
@@ -1381,7 +1645,8 @@ class ServingScheduler:
                 req.accepted += m
                 self._trace["spec_drafted"] += len(d)
                 self._trace["spec_accepted"] += m
-                self._emit_many(req, new_toks)
+                self._trace["decode_tokens"] += self._emit_many(req,
+                                                                new_toks)
             else:
                 req.fed += len(chunk)
                 if req.pending == 0:  # feed complete: row is the next token
@@ -1395,7 +1660,8 @@ class ServingScheduler:
                             req.uid, [], last, self._spec_for(req),
                             req.num_draft_tokens)
                         req.key_burns += 1  # draft-free window still burns
-                        self._emit_many(req, new_toks)
+                        self._trace["decode_tokens"] += self._emit_many(
+                            req, new_toks)
                     elif self._device_eligible(req):
                         device_wave.append((req, last))
                     else:
@@ -1445,6 +1711,7 @@ class ServingScheduler:
             if not req.outputs:
                 req.t_first = time.monotonic()
             req.outputs.append(int(tok))
+            self._trace["decode_tokens"] += 1
             self._stream_put(req, int(tok))
 
     def _emit(self, req: _Request, logits_row) -> None:
@@ -1465,13 +1732,16 @@ class ServingScheduler:
         if not req.outputs:
             req.t_first = time.monotonic()
         req.outputs.append(int(tok))
+        self._trace["decode_tokens"] += 1
         self._stream_put(req, int(tok))
 
-    def _emit_many(self, req: _Request, toks, lps=None) -> None:
+    def _emit_many(self, req: _Request, toks, lps=None) -> int:
         """Stream a verified draft run or fused window, applying the
         eos/stop/max cuts so tokens past a cut never surface (generate()'s
         truncation rules; the overshot KV needs no rollback — the request
-        retires and flushes)."""
+        retires and flushes). Returns the token count that actually
+        surfaced (the occupancy counters' feed)."""
+        emitted = 0
         for i, t in enumerate(toks):
             if len(req.outputs) >= req.max_new_tokens:
                 break
@@ -1481,14 +1751,18 @@ class ServingScheduler:
                 req.logprobs.append(float(lps[i]) if lps is not None
                                     else None)
             req.outputs.append(int(t))
+            emitted += 1
             self._stream_put(req, int(t))
             if req.eos_token_id is not None and int(t) == req.eos_token_id:
                 break
             if req.stop and self._engine.hit_stop(req.outputs, req.stop):
                 break
+        return emitted
 
     def _retire_finished(self) -> None:
         for req in list(self._live):
+            if req.uid in self._in_flight:
+                continue  # fused wave in flight: judge/flush after harvest
             if not req.outputs or req.pending > 1:
                 continue  # still (re)prefilling — nothing sampled to judge
             if self._engine._state_manager.get_sequence(req.uid) is None:

@@ -1,0 +1,233 @@
+"""Continuous fused serving: the K-step decode wave stays hot under load.
+
+The overlapped tick must (a) keep amortizing dispatches — ~N/K fused
+dispatches for N wave tokens — while an arrival stream prefills
+concurrently, (b) produce BIT-IDENTICAL token/logprob streams with the
+overlap on vs off (greedy, fixed-seed sampled, speculative), and (c)
+compose with the durable-serving journal: a crash with prefill progress
+records interleaved between fused waves replays byte-identically.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine
+from deepspeed_tpu.inference.v2.server import ServingScheduler
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+BS = 16
+WINDOW = 4
+
+
+def _engine(num_blocks=128, overlap=True, durable=False):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    eng_cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=num_blocks,
+        continuous_fusion={"enabled": overlap},
+        durable_serving={"enabled": durable})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=eng_cfg)
+
+
+# ONE engine (weights + per-engine compile cache) is shared module-wide:
+# the builds dominate this file's wall clock and tier-1 timeout headroom
+# is ~1 engine build wide. Every request a test makes is flushed by the
+# time it finishes, and the overlap arm is chosen per-SCHEDULER — the
+# scheduler snapshots continuous_fusion at construction, so flipping the
+# engine config's gate between schedulers is exactly the enabled=False
+# rollback a deployment would do.
+@pytest.fixture(scope="module")
+def eng():
+    return _engine()
+
+
+def _sched(eng, overlap, **kw):
+    eng._config.continuous_fusion.enabled = overlap
+    return ServingScheduler(eng, fused_decode_window=WINDOW, **kw)
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+# a request mix covering every stream type the wave can carry: plain
+# greedy, fixed-seed device-sampled (with logprobs), and speculative
+def _mixed_submits(seed=0, new=10):
+    ps = _prompts(6, lo=6, hi=2 * BS + 5, seed=seed)
+    return [
+        dict(prompt=ps[0], max_new_tokens=new),
+        dict(prompt=ps[1], max_new_tokens=new),
+        dict(prompt=ps[2], max_new_tokens=new, temperature=0.8, top_k=20,
+             seed=11, return_logprobs=True),
+        dict(prompt=ps[3], max_new_tokens=new, temperature=0.7, top_p=0.9,
+             seed=12, return_logprobs=True),
+        dict(prompt=ps[4], max_new_tokens=new, speculative="prompt_lookup",
+             num_draft_tokens=4, draft_ngram=2),
+        dict(prompt=ps[5], max_new_tokens=new, speculative="prompt_lookup",
+             num_draft_tokens=3, draft_ngram=2),
+    ]
+
+
+def _collect(h):
+    if h._req.return_logprobs:
+        toks, lps = h.result_with_logprobs()
+        return toks, [round(float(x), 5) for x in lps]
+    return h.result(), None
+
+
+def test_wave_stays_hot_under_arrival_stream(eng):
+    """Trace-counted: with continuous fusion on, a cohort of decoding
+    requests keeps taking K-step fused dispatches (~N/K dispatches for its
+    N tokens) WHILE later arrivals are admitted and prefilled inside the
+    overlap window — arrivals no longer demote the wave to per-token
+    mode."""
+    sched = _sched(eng, overlap=True)
+    first = [sched.submit(p, max_new_tokens=16) for p in _prompts(4, seed=1)]
+    # prefill + first token for the initial cohort
+    while not all(len(h._req.outputs) >= 1 for h in first):
+        sched.step()
+    # arrival stream: new requests land while the cohort still has most
+    # of its decoding ahead — each step here runs a wave with a non-empty
+    # inbox/prefill set
+    arrivals = []
+    for p in _prompts(4, lo=BS, hi=2 * BS + 4, seed=2):
+        arrivals.append(sched.submit(p, max_new_tokens=8))
+        sched.step()
+    for _ in range(4000):
+        if all(h.finished for h in first + arrivals):
+            break
+        sched.step()
+    assert all(len(h.result()) == 16 for h in first)
+    assert all(len(h.result()) == 8 for h in arrivals)
+
+    tr = sched._trace
+    assert tr["fused_dispatches"] > 0
+    mean_k = tr["fused_k_sum"] / tr["fused_dispatches"]
+    assert mean_k >= 2, f"adaptive K collapsed: {mean_k}"
+    # dispatch amortization: the wave's tokens took ~N/(K*batch)
+    # dispatches, far fewer than one per token
+    assert tr["fused_dispatches"] * 2 <= tr["fused_tokens"]
+    # prefill genuinely rode the overlap window (not the remainder pass)
+    assert tr["prefill_overlap_tokens"] > 0
+    # most decode tokens came out of fused waves despite sustained arrivals
+    st = sched.stats
+    assert st["fused_occupancy"] >= 0.5
+    assert st["mean_fused_K"] == round(mean_k, 2)
+    assert st["prefill_overlap_tokens"] == tr["prefill_overlap_tokens"]
+
+
+def test_bit_identical_streams_overlap_on_vs_off(eng):
+    """Greedy, fixed-seed sampled (tokens AND logprobs), and speculative
+    streams are bit-identical with continuous fusion on vs off — the
+    overlap changes WHEN work is scheduled, never what any request
+    emits."""
+    submits = _mixed_submits(seed=7)
+
+    ref_sched = _sched(eng, overlap=False)
+    ref_h = [ref_sched.submit(**kw) for kw in submits]
+    while not all(h.finished for h in ref_h):
+        ref_sched.step()
+    ref = [_collect(h) for h in ref_h]
+
+    sched = _sched(eng, overlap=True)
+    free0 = sched._engine._state_manager.free_blocks
+    # staggered submission: the first pair decodes in waves while the
+    # rest arrive and prefill inside the overlap window
+    handles = []
+    for kw in submits:
+        handles.append(sched.submit(**kw))
+        sched.step()
+        sched.step()
+    while not all(h.finished for h in handles):
+        sched.step()
+    outs = [_collect(h) for h in handles]
+
+    assert outs == ref
+    # every wave's KV came back: partitioning the headroom between the
+    # in-flight wave and the prefill budget leaked nothing
+    assert sched._engine._state_manager.free_blocks == free0
+
+
+def test_gate_off_restores_exclusive_mode(eng):
+    """continuous_fusion.enabled=False: with any prefill/arrival pending
+    the tick never overlaps (no prefill_overlap_tokens), matching the
+    legacy exclusive scheduler exactly."""
+    sched = _sched(eng, overlap=False)
+    hs = []
+    for p in _prompts(4, seed=9):
+        hs.append(sched.submit(p, max_new_tokens=8))
+        sched.step()
+    while not all(h.finished for h in hs):
+        sched.step()
+    assert all(len(h.result()) == 8 for h in hs)
+    assert sched._trace["prefill_overlap_tokens"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_crash_replay_bit_identical_with_overlap(eng):
+    """Durable serving under continuous fusion: crash mid-wave with
+    prefill progress records interleaved in the journal (staggered
+    arrivals), replay on a fresh scheduler, and every stream continues
+    byte-identically to an uninterrupted run."""
+    # long enough streams that the 4th tick (each continuous tick is a
+    # K=4 wave) lands mid-decode, not after everything finished
+    submits = _mixed_submits(seed=13, new=24)
+    # reference: uninterrupted, no journal, same seed/weights
+    ref_sched = _sched(eng, overlap=True, idle_wait=0.005).start()
+    try:
+        ref_h = [ref_sched.submit(**kw) for kw in submits]
+        ref = [_collect(h) for h in ref_h]
+    finally:
+        ref_sched.stop()
+
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": 4}]})
+    s1 = ServingScheduler(_engine(durable=True), idle_wait=0.005,
+                          fused_decode_window=WINDOW).start()
+    hs = []
+    for kw in submits:  # staggered: prefill puts interleave with waves
+        hs.append(s1.submit(**kw))
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    while not s1.stats["stopped"]:
+        if time.monotonic() - t0 > 120:
+            raise TimeoutError("crash never fired")
+        time.sleep(0.02)
+    pre = [list(h._req.outputs) for h in hs]
+    assert any(pre), "crash fired before anything decoded — vacuous"
+    assert not all(len(p) >= kw["max_new_tokens"]
+                   for p, kw in zip(pre, submits)), \
+        "crash fired after everything finished — vacuous"
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_engine(durable=True), idle_wait=0.005,
+                          fused_decode_window=WINDOW).start()
+    try:
+        outs = []
+        for uid in range(1, len(submits) + 1):
+            h = s2.lookup(uid)
+            assert h is not None, f"uid {uid} lost across the crash"
+            outs.append(_collect(h))
+    finally:
+        s2.stop()
+
+    for (rt, rl), p, (ot, ol) in zip(ref, pre, outs):
+        assert ot[:len(p)] == p, "replay rewrote pre-crash tokens"
+        assert ot == rt
+        if rl is not None:
+            # tokens are bit-identical; logprobs recomputed after the
+            # restart may ride a different dispatch path (fused wave vs
+            # per-token) whose float op order differs in the last ulp —
+            # same tolerance as test_daemon_logprobs_match_generate
+            assert np.allclose(ol, rl, atol=1e-4)
