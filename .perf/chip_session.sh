@@ -174,6 +174,25 @@ run bench_serving_restart 1200 env DS_BENCH_RESTART=1 DS_BENCH_FAST=1 python ben
 # occupancy, aggregate tok/s, TTFT p50/p99 at three offered loads with
 # the overlap OFF vs ON — the wave-stays-hot-under-live-traffic evidence
 run bench_serving_arrivals 1200 env DS_BENCH_ARRIVALS=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_ARRIVALS.json
+# 15i-check. observability acceptance on the arrivals rung: /metrics must
+# scrape cleanly over real HTTP under load (Prometheus-parseable, TTFT +
+# inter-token histograms non-empty) and the recording paths must cost
+# <2% aggregate tok/s vs force-disabled (the observability_ab row)
+run bench_serving_arrivals_metrics 60 python - <<'PYEOF'
+import json, sys
+doc = json.load(open("BENCH_SERVING_ARRIVALS.json"))
+ab = [r for r in doc["results"] if r.get("observability_ab")]
+assert ab, "no observability_ab row in BENCH_SERVING_ARRIVALS.json"
+r = ab[-1]
+assert r["metrics_scrape_ok"] is True, f"/metrics scrape failed: {r}"
+assert r["observability_overhead_pct"] < 2.0, \
+    f"observability overhead {r['observability_overhead_pct']}% >= 2%"
+print("observability: scrape ok, overhead "
+      f"{r['observability_overhead_pct']}% "
+      f"(on {r['tok_s_observability_on']} vs off "
+      f"{r['tok_s_observability_off']} tok/s), "
+      f"ttft hist p50/p99 {r['ttft_hist_p50_s']}/{r['ttft_hist_p99_s']}s")
+PYEOF
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

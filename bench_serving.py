@@ -688,6 +688,43 @@ def _measure_restart(cfg, kv_block, backend, n_requests, ctx, new_tokens):
             os.environ["DS_TPU_JOURNAL_DIR"] = old_jdir
 
 
+def _scrape_metrics_ok(sched) -> bool:
+    """Serve one in-process ``GET /metrics`` over real HTTP and verify the
+    body is Prometheus-parseable (every non-comment line is
+    ``name{labels} value``) with non-empty TTFT and inter-token histograms."""
+    import re
+    import threading
+    import urllib.request
+    from deepspeed_tpu.inference.v2.server import create_http_server
+    httpd = create_http_server(sched, port=0)  # OS-assigned free port
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            if resp.status != 200:
+                return False
+            body = resp.read().decode("utf-8")
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?\s+'
+            r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+        counts = {}
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            if not sample.match(line):
+                return False
+            name, _, val = line.partition(" ")
+            counts[name.split("{")[0]] = val
+        return (float(counts.get("ds_ttft_seconds_count", 0)) > 0
+                and float(counts.get("ds_inter_token_seconds_count", 0)) > 0)
+    except Exception:
+        return False
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
 def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
                       window, token_budget):
     """Open-loop Poisson-arrival rung: requests arrive on a fixed
@@ -737,11 +774,21 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
                    fused_windows=(window, ), decode_context=ctx)
         return eng
 
-    def _run(eng, gaps):
-        """Submit on the arrival schedule (open loop), wait for drain."""
+    def _run(eng, gaps, observability=True, scrape=False):
+        """Submit on the arrival schedule (open loop), wait for drain.
+
+        ``observability=False`` force-disables the metrics/trace recording
+        paths (the A/B arm for the <2% overhead criterion). ``scrape=True``
+        additionally serves one in-process ``GET /metrics`` over HTTP and
+        reports whether it parsed as Prometheus text with non-empty TTFT
+        and inter-token histograms (``metrics_scrape_ok``)."""
         sched = ServingScheduler(eng, idle_wait=0.001,
                                  token_budget=token_budget,
-                                 fused_decode_window=window).start()
+                                 fused_decode_window=window,
+                                 instruments=None if observability else False
+                                 ).start()
+        obs = sched.observability
+        before = (obs.registry.snapshot() if obs is not None else None)
         handles = []
         t0 = time.perf_counter()
         for i, p in enumerate(prompts):
@@ -756,19 +803,37 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
         stats = sched.stats
         ttfts = sorted(h._req.t_first - h._req.t_submit
                        for h in handles if h._req.t_first)
-        sched.stop()
         total = sum(len(h._req.outputs) for h in handles)
 
         def pct(q):
             return (round(ttfts[min(len(ttfts) - 1,
                                     int(q * len(ttfts)))], 4)
                     if ttfts else None)
-        return {"wall_s": round(dt, 2),
-                "aggregate_tok_s": round(total / dt, 2),
-                "ttft_p50_s": pct(0.50), "ttft_p99_s": pct(0.99),
-                "fused_occupancy": stats["fused_occupancy"],
-                "mean_fused_K": stats["mean_fused_K"],
-                "prefill_overlap_tokens": stats["prefill_overlap_tokens"]}
+        out = {"wall_s": round(dt, 2),
+               "aggregate_tok_s": round(total / dt, 2),
+               "ttft_p50_s": pct(0.50), "ttft_p99_s": pct(0.99),
+               "fused_occupancy": stats["fused_occupancy"],
+               "mean_fused_K": stats["mean_fused_K"],
+               "prefill_overlap_tokens": stats["prefill_overlap_tokens"]}
+        if obs is not None:
+            # registry-delta percentiles for THIS run (the registry is
+            # process-global; the snapshot delta isolates the interval)
+            from deepspeed_tpu.observability import (histogram_delta,
+                                                     quantiles_from_counts)
+            after = obs.registry.snapshot()
+            for name, key in (("ds_ttft_seconds", "ttft_hist"),
+                              ("ds_inter_token_seconds", "inter_token_hist")):
+                d = histogram_delta(before.get(name), after[name])
+                qs = quantiles_from_counts(d["edges"], d["counts"],
+                                           (0.5, 0.99))
+                out[f"{key}_p50_s"] = (round(qs[0], 4)
+                                       if qs[0] is not None else None)
+                out[f"{key}_p99_s"] = (round(qs[1], 4)
+                                       if qs[1] is not None else None)
+        if scrape:
+            out["metrics_scrape_ok"] = _scrape_metrics_ok(sched)
+        sched.stop()
+        return out
 
     engines = {False: _build(False), True: _build(True)}
     # one closed-loop pass per arm burns the lazily-compiled ragged
@@ -809,6 +874,30 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
                           key=lambda r: r["wall_s"])
             row.update(reps[1])
             rows.append(row)
+    # observability overhead A/B: the same load-2.0 arrival schedule on
+    # the overlap arm with the recording paths force-disabled vs enabled
+    # (acceptance: <2% tok/s regression), plus one real HTTP /metrics
+    # scrape on the enabled arm and registry-delta percentiles so the
+    # bench JSON carries histogram-derived numbers, not recomputed means
+    gaps = gaps_unit / (2.0 * cap_req_s)
+    off = sorted((_run(engines[True], gaps, observability=False)
+                  for _ in range(3)), key=lambda r: r["wall_s"])[1]
+    on = sorted((_run(engines[True], gaps, scrape=True)
+                 for _ in range(3)), key=lambda r: r["wall_s"])[1]
+    rows.append({
+        "backend": backend, "context": ctx, "arrivals": True,
+        "observability_ab": True, "fused_window": window,
+        "requests": n_requests, "new_tokens_per_req": new_tokens,
+        "offered_load": 2.0,
+        "tok_s_observability_off": off["aggregate_tok_s"],
+        "tok_s_observability_on": on["aggregate_tok_s"],
+        "observability_overhead_pct": round(
+            100.0 * (1.0 - on["aggregate_tok_s"]
+                     / off["aggregate_tok_s"]), 2),
+        "metrics_scrape_ok": on.get("metrics_scrape_ok"),
+        "ttft_hist_p50_s": on.get("ttft_hist_p50_s"),
+        "ttft_hist_p99_s": on.get("ttft_hist_p99_s"),
+        "inter_token_hist_p99_s": on.get("inter_token_hist_p99_s")})
     return rows
 
 

@@ -294,6 +294,11 @@ class MonitorConfig(ConfigModel):
     comet: CometConfig = {}
     wandb: WandbConfig = {}
     csv_monitor: CSVConfig = {}
+    registry_events: bool = False
+    """Also publish the process observability registry (counters/gauges/
+    histogram percentiles from ``deepspeed_tpu.observability``) into the
+    monitor fan-out at each flush — one event schema across training steps
+    and serving metrics."""
 
 
 # -------------------- AIO / NVMe --------------------

@@ -120,6 +120,24 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"serving journal dir {'.' * 29} {NO} ({e})")
     try:
+        # observability: registry/tracer defaults and where an on-demand
+        # jax.profiler capture would land (and whether that dir is writable)
+        from .inference.v2.config_v2 import ObservabilityConfig
+        from .observability import profile_dir
+        ocfg = ObservabilityConfig()
+        pd = profile_dir(ocfg.profile_dir)
+        writable = os.access(pd if os.path.isdir(pd)
+                             else os.path.dirname(pd) or ".", os.W_OK)
+        state = ("enabled" if ocfg.enabled else "disabled")
+        lines.append(
+            f"serving observability {'.' * 27} {state} "
+            f"(trace rings {ocfg.trace_requests} req x "
+            f"{ocfg.trace_spans_per_request} spans, {ocfg.trace_waves} waves)")
+        lines.append(f"profiler capture dir {'.' * 28} "
+                     f"{pd} [{'writable' if writable else 'NOT writable'}]")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"serving observability {'.' * 27} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

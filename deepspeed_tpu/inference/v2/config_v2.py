@@ -258,6 +258,49 @@ class ContinuousFusionConfig(ConfigModel):
         return self
 
 
+class ObservabilityConfig(ConfigModel):
+    """Serving observability: the metrics registry, per-request span
+    tracer, and on-demand profiler capture (``deepspeed_tpu/observability``).
+    Recording is host-side and allocation-light (pre-resolved handles, one
+    bisect + bucket bump per sample), so the default is ON; every ring is
+    bounded so a long-lived daemon cannot grow."""
+
+    enabled: bool = True
+    """Master gate. False skips every recording site (the scheduler holds
+    no instruments object) and the HTTP observability endpoints answer
+    404 — exactly the pre-observability daemon."""
+
+    trace_requests: int = 512
+    """Max request timelines held live (oldest evicted first)."""
+
+    trace_spans_per_request: int = 512
+    """Max spans retained per request timeline (a ring: a pathological
+    million-token request keeps its most recent spans)."""
+
+    trace_waves: int = 2048
+    """Global ring of daemon-level spans (fused waves, restarts) backing
+    the bulk ``GET /debug/trace`` Chrome export."""
+
+    profile_dir: Optional[str] = None
+    """Directory for ``POST /debug/profile`` captures. None resolves
+    ``$DS_TPU_PROFILE_DIR`` → ``$XDG_CACHE_HOME/deepspeed_tpu/profiles``
+    (the journal_dir resolution pattern)."""
+
+    profile_max_seconds: float = 60.0
+    """Hard cap on one profiler capture's duration; requests asking for
+    longer are clamped, and an auto-stop timer enforces it."""
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.trace_requests < 1 or self.trace_spans_per_request < 1:
+            raise ValueError("trace ring sizes must be >= 1")
+        if self.trace_waves < 1:
+            raise ValueError("trace_waves must be >= 1")
+        if self.profile_max_seconds <= 0:
+            raise ValueError("profile_max_seconds must be > 0")
+        return self
+
+
 class QuantizationConfig(ConfigModel):
     quantization_mode: Optional[str] = None  # e.g. 'wf6af16' in reference
 
@@ -278,6 +321,8 @@ class RaggedInferenceEngineConfig(ConfigModel):
         default_factory=DurableServingConfig)
     continuous_fusion: ContinuousFusionConfig = Field(
         default_factory=ContinuousFusionConfig)
+    observability: ObservabilityConfig = Field(
+        default_factory=ObservabilityConfig)
 
     # TPU-specific: number of KV blocks to allocate (overrides memory_config
     # sizing when set — tests and CPU runs need deterministic small caches).

@@ -10,6 +10,7 @@ bucket (see ragged_wrapper) and the KV cache is donated functional state.
 
 import os
 import pickle
+import time
 from dataclasses import dataclass
 from typing import Iterable, List, Optional, Tuple
 
@@ -17,6 +18,7 @@ import numpy as np
 import jax
 
 from ...models.llama import LlamaConfig, init_llama
+from ...observability import get_registry
 from ...utils.fault_injection import InjectedFault, get_fault_injector
 from .config_v2 import RaggedInferenceEngineConfig
 from .model import RaggedLlamaModel
@@ -24,6 +26,24 @@ from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import RaggedBatchWrapper
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from .scheduling_utils import SchedulingError, SchedulingResult
+
+# Host-boundary timings (process registry, resolved once at import): the
+# engine never timestamps device-side work — ``dispatch`` is the async
+# enqueue half of a fused wave, ``harvest`` the blocking device_get, and
+# ``put`` one whole ragged forward including its fetch.
+_obs = get_registry()
+_put_seconds = _obs.histogram(
+    "ds_engine_put_seconds", "One ragged forward (put), dispatch + fetch")
+_dispatch_seconds = _obs.histogram(
+    "ds_engine_dispatch_seconds",
+    "Async enqueue of a fused wave (begin half, no fetch)")
+_harvest_seconds = _obs.histogram(
+    "ds_engine_harvest_seconds",
+    "Blocking fetch of a dispatched fused wave (device_get)")
+_dispatches_total = _obs.counter(
+    "ds_engine_dispatches_total", "Fused wave dispatches (plain + spec)")
+_harvests_total = _obs.counter(
+    "ds_engine_harvests_total", "Fused wave harvests (plain + spec)")
 
 
 @dataclass
@@ -216,7 +236,9 @@ class InferenceEngineV2:
         batch = self._batch.finalize(
             total_slots=self._state_manager.kv_cache.num_blocks *
             self._state_manager.kv_cache.block_size)
+        t0 = time.monotonic()
         logits = self._model.forward(batch, window_logits=window_logits)
+        _put_seconds.record(time.monotonic() - t0)
 
         for uid in batch_uids:
             seq = self._state_manager.get_sequence(uid)
@@ -841,6 +863,7 @@ class InferenceEngineV2:
         returns an in-flight handle for :meth:`fused_decode_harvest`.
         Host work needing device values (sampler-key stores, prefix-cache
         pending appends) is deferred to harvest."""
+        t0 = time.monotonic()
         batch_uids = list(batch_uids)
         _fire_request_poison(batch_uids)
         seqs = []
@@ -903,6 +926,8 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.pre_forward(n_steps)
             seq.post_forward()
+        _dispatch_seconds.record(time.monotonic() - t0)
+        _dispatches_total.inc()
         return _InFlightWave(uids=batch_uids, seqs=seqs, tokens=tokens,
                              out=out, lps=lps, new_keys=new_keys,
                              n_steps=n_steps, sampled=specs is not None)
@@ -913,6 +938,7 @@ class InferenceEngineV2:
         pending appends, and return the per-token contract — int32
         ``[n_seqs, n_steps]`` tokens (plus ``[n_seqs, n_steps]`` logprobs
         for a sampled wave)."""
+        t0 = time.monotonic()
         n, n_steps = len(wave.seqs), wave.n_steps
         lps = None
         if wave.sampled:
@@ -933,6 +959,8 @@ class InferenceEngineV2:
                 # dispatch) — mirrors one put() append per step
                 self._append_pending(
                     seq, np.concatenate([[wave.tokens[i]], out[i, :-1]]))
+        _harvest_seconds.record(time.monotonic() - t0)
+        _harvests_total.inc()
         if wave.sampled:
             return out, lps
         return out
@@ -986,6 +1014,7 @@ class InferenceEngineV2:
         wave members' ``seen_tokens`` are stale-low, which only makes
         admission projections conservative (their worst-case blocks are
         already taken)."""
+        t0 = time.monotonic()
         batch_uids = list(batch_uids)
         _fire_request_poison(batch_uids)
         d = max(1, int(num_draft_tokens))
@@ -1057,6 +1086,8 @@ class InferenceEngineV2:
         out, n_emit, dlen, new_keys = self._model.fused_spec_decode(
             tokens, seq_lens, liv, block_table, hist, hist_len, ngrams,
             max_d, n_steps, d, max_ngram, sampling=sampling, fetch=False)
+        _dispatch_seconds.record(time.monotonic() - t0)
+        _dispatches_total.inc()
         return _InFlightSpecWave(uids=batch_uids, seqs=seqs, tokens=tokens,
                                  out=out, n_emit=n_emit, dlen=dlen,
                                  new_keys=new_keys, n_steps=n_steps)
@@ -1066,6 +1097,7 @@ class InferenceEngineV2:
         wave, store advanced keys, run the deferred per-sequence
         bookkeeping against the device's accepted counts, and return
         ``(tokens, drafted, accepted)``."""
+        t0 = time.monotonic()
         n_steps, tokens, seqs = wave.n_steps, wave.tokens, wave.seqs
         if wave.new_keys is not None:
             out, n_emit, dlen, new_keys = jax.device_get(
@@ -1094,6 +1126,8 @@ class InferenceEngineV2:
             toks_lists.append(emitted)
             drafted.append(int(dlen[:, i].sum()))
             accepted.append(len(emitted) - n_steps)
+        _harvest_seconds.record(time.monotonic() - t0)
+        _harvests_total.inc()
         return toks_lists, drafted, accepted
 
     @staticmethod

@@ -44,12 +44,28 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ...observability import get_registry
 from ...utils.fault_injection import get_fault_injector
 from ...utils.logging import logger
+
+# Durability-cost observability (process registry, resolved at import):
+# where a request's time goes when the WAL is on — append (encode+write),
+# fsync (the durability boundary), and boot-time replay scans.
+_obs = get_registry()
+_append_seconds = _obs.histogram(
+    "ds_journal_append_seconds", "One journal record append (write+flush)")
+_fsync_seconds = _obs.histogram(
+    "ds_journal_fsync_seconds", "One journal fsync (durability boundary)")
+_replay_seconds = _obs.histogram(
+    "ds_journal_replay_seconds", "One recover() scan+compact at boot")
+_appends_total = _obs.counter(
+    "ds_journal_appends_total", "Journal records appended")
+_fsyncs_total = _obs.counter("ds_journal_fsyncs_total", "Journal fsyncs")
 
 MAGIC = b"DSJ1"
 _HEADER = struct.Struct("<II")  # payload_len, crc32
@@ -210,9 +226,13 @@ class RequestJournal:
             return
         fh.flush()
         if force or self.fsync_policy == "always":
+            t0 = time.monotonic()
             os.fsync(fh.fileno())
+            _fsync_seconds.record(time.monotonic() - t0)
+            _fsyncs_total.inc()
 
     def _append(self, rec: dict, sync: bool):
+        t_app = time.monotonic()
         frame = _encode(rec)
         fh = self._open()
         inj = get_fault_injector()
@@ -230,6 +250,8 @@ class RequestJournal:
                 frame = bytes(mut)
         fh.write(frame)
         self._sync(sync and self.fsync_policy != "never")
+        _append_seconds.record(time.monotonic() - t_app)
+        _appends_total.inc()
 
     # ------------------------------------------------------------- records
 
@@ -321,6 +343,7 @@ class RequestJournal:
         """Scan the segment, rebuild the mirror, compact (healing any torn
         tail), and return the unfinished requests in admit order."""
         with self._lock:
+            t_rec = time.monotonic()
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
@@ -350,6 +373,7 @@ class RequestJournal:
                     key_burns=int(st["burns"]),
                     deadline_wall=adm.get("dl"),
                     queue_deadline_wall=adm.get("qdl")))
+            _replay_seconds.record(time.monotonic() - t_rec)
             return entries
 
 

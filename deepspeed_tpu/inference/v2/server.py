@@ -65,15 +65,16 @@ import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import List, Optional, Union
 
 import numpy as np
 
+from ...observability import ProfilerBusy, ServingInstruments
 from ...utils.fault_injection import InjectedFault, get_fault_injector
 from ...utils.logging import logger
 from ...utils.retry import RetriesExhausted, retry_with_backoff
 from .config_v2 import (ContinuousFusionConfig, DurableServingConfig,
-                        ServingResilienceConfig)
+                        ObservabilityConfig, ServingResilienceConfig)
 from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
@@ -133,6 +134,7 @@ class _Request:
     # metrics timeline (time.monotonic)
     t_submit: float = 0.0
     t_first: float = 0.0
+    t_last: float = 0.0   # last emitted token (inter-token gap anchor)
     t_done: float = 0.0
 
     @property
@@ -260,7 +262,8 @@ class ServingScheduler:
     def __init__(self, engine: InferenceEngineV2, idle_wait: float = 0.05,
                  token_budget: Optional[int] = None,
                  fused_decode_window: Optional[int] = None,
-                 journal: Optional[RequestJournal] = None):
+                 journal: Optional[RequestJournal] = None,
+                 instruments: "Union[ServingInstruments, bool, None]" = None):
         self._engine = engine
         self._idle_wait = idle_wait
         if fused_decode_window is None:
@@ -365,8 +368,31 @@ class ServingScheduler:
         self._restart_count = int(
             os.environ.get("DS_SERVE_RESTART_COUNT", "0") or 0)
         self._boot_wall = time.time()
-        # last-256 completed requests for the metrics aggregates
+        # last-256 completed requests for the metrics aggregates:
+        # (t_submit, t_first, t_done, n_tokens, replayed)
         self._completed: "deque" = deque(maxlen=256)
+        # observability: pre-resolved metric handles + per-request span
+        # tracer + profiler guard, or None with the block disabled (every
+        # recording site is one `if self._obs is not None` away from the
+        # pre-observability scheduler). An explicit ``instruments``
+        # (private registry) wins — test isolation; ``instruments=False``
+        # force-disables regardless of config (the bench's A/B arm).
+        obscfg = getattr(engine._config, "observability", None)
+        self._ocfg: ObservabilityConfig = (
+            obscfg if obscfg is not None else ObservabilityConfig())
+        if instruments is False:
+            self._obs: Optional[ServingInstruments] = None
+        elif instruments is not None:
+            self._obs = instruments
+        elif self._ocfg.enabled:
+            self._obs = ServingInstruments(
+                trace_requests=self._ocfg.trace_requests,
+                trace_spans_per_request=self._ocfg.trace_spans_per_request,
+                trace_waves=self._ocfg.trace_waves,
+                profile_dir=self._ocfg.profile_dir,
+                profile_max_seconds=self._ocfg.profile_max_seconds)
+        else:
+            self._obs = None
         sm = engine._config.state_manager
         self._max_batch_tokens = sm.max_ragged_batch_size
         self._token_budget = min(token_budget or self._max_batch_tokens,
@@ -466,6 +492,8 @@ class ServingScheduler:
                         and (self._queued_tokens + len(prompt)
                              > res.max_queued_tokens))):
                 self._trace["shed"] += 1
+                if self._obs is not None:
+                    self._obs.shed.inc()
                 raise SchedulerOverloaded(
                     f"queue full ({self._queued_n} requests, "
                     f"{self._queued_tokens} prompt tokens queued)",
@@ -480,6 +508,8 @@ class ServingScheduler:
             req.queued = True
             self._queued_n += 1
             self._queued_tokens += len(prompt)
+        if self._obs is not None:
+            self._obs.request_submitted(req.uid, req.t_submit)
         self._wake.set()
         return RequestHandle(req)
 
@@ -523,7 +553,7 @@ class ServingScheduler:
     def stats(self) -> dict:
         with self._lock:
             inbox = len(self._inbox)
-            done = list(self._completed)  # (t_submit, t_first, t_done, n)
+            done = list(self._completed)  # (t_sub, t_first, t_done, n, rp)
             queued_tokens = self._queued_tokens
             tr = self._trace
             shed, quarantined = tr["shed"], len(tr["quarantined"])
@@ -570,15 +600,29 @@ class ServingScheduler:
                                       if self._restart_count else None),
                "completed": len(done)}
         done = [d for d in done if d[3] > 0]
-        if done:
+        # replayed requests' TTFT spans the crash + restart (measured from
+        # the ORIGINAL admit) — real for that client, but a restart would
+        # skew the scheduler-latency aggregate, so the mean excludes them
+        fresh = [d for d in done if not d[4]]
+        if fresh:
             # MII-style serving metrics over the recent completions:
             # time-to-first-token and per-request decode rate
             out["ttft_mean_s"] = round(
-                sum(t1 - t0 for t0, t1, _, _ in done) / len(done), 4)
+                sum(t1 - t0 for t0, t1, _, _, _ in fresh) / len(fresh), 4)
+        if done:
             rates = [(n - 1) / max(t2 - t1, 1e-9)
-                     for _, t1, t2, n in done if n > 1]
+                     for _, t1, t2, n, _ in done if n > 1]
             if rates:
                 out["decode_tok_s_mean"] = round(sum(rates) / len(rates), 2)
+        if self._obs is not None:
+            # histogram-derived percentiles (whole-process, not last-256)
+            ps = self._obs.ttft.percentiles((0.5, 0.95, 0.99))
+            for q, v in zip(("p50", "p95", "p99"), ps):
+                if v is not None:
+                    out[f"ttft_{q}_s"] = round(v, 4)
+            it99 = self._obs.inter_token.quantile(0.99)
+            if it99 is not None:
+                out["inter_token_p99_s"] = round(it99, 4)
         return out
 
     @property
@@ -590,6 +634,18 @@ class ServingScheduler:
         with self._lock:
             return {k: (list(v) if isinstance(v, list) else v)
                     for k, v in self._trace.items()}
+
+    @property
+    def observability(self) -> Optional[ServingInstruments]:
+        """The instruments bundle (registry/tracer/profiler) the HTTP
+        observability endpoints render, or None with the block disabled."""
+        return self._obs
+
+    def trace_timeline(self, uid: int) -> Optional[dict]:
+        """Per-request span timeline (``GET /requests/<uid>/trace``)."""
+        if self._obs is None:
+            return None
+        return self._obs.tracer.timeline(str(int(uid)))
 
     def wait_timeout(self, handle: RequestHandle) -> Optional[float]:
         """Bound for a blocking wait on one request (the HTTP threads'
@@ -752,6 +808,9 @@ class ServingScheduler:
                     self._queued_tokens += len(req.prompt)
                     self._waiting.append(req)
                 self._replayed += 1
+                if self._obs is not None:
+                    self._obs.request_replayed(req.uid, req.t_submit,
+                                               len(req.outputs))
         # original uids survive the restart; fresh submissions go above them
         nxt = next(self._uid_iter)
         self._uid_iter = itertools.count(max(nxt, max_uid + 1))
@@ -798,8 +857,19 @@ class ServingScheduler:
         crash: Optional[BaseException] = None
         try:
             while not self._stopping:
+                t_tick = time.monotonic()
                 progressed = self._safe_step()
                 self._last_progress = time.monotonic()
+                if self._obs is not None and progressed:
+                    # idle polls stay out: the histogram measures work
+                    # ticks, not the idle_wait cadence
+                    self._obs.tick.record(self._last_progress - t_tick)
+                if self._obs is not None:
+                    tr = self._trace
+                    self._obs.refresh(
+                        self._queued_n, len(self._live),
+                        self._engine.free_blocks,
+                        tr["fused_tokens"], tr["decode_tokens"])
                 if not progressed:
                     self._wake.wait(self._idle_wait)
                     self._wake.clear()
@@ -876,6 +946,7 @@ class ServingScheduler:
                 continue
             lps = (req.logprobs[req.journaled_n:n]
                    if req.return_logprobs else None)
+            t0 = time.monotonic()
             try:
                 self._journal.record_progress(
                     req.uid, req.outputs[req.journaled_n:n], n,
@@ -884,6 +955,10 @@ class ServingScheduler:
                 logger.warning(f"[journal] progress record failed for "
                                f"request {req.uid}: {e}")
                 continue
+            if self._obs is not None:
+                self._obs.tracer.span(
+                    str(req.uid), "journal_append", t0, time.monotonic(),
+                    {"tokens": n - req.journaled_n})
             req.journaled_n = n
             req.journaled_burns = req.key_burns
 
@@ -987,6 +1062,10 @@ class ServingScheduler:
             self._live.remove(culprit)
         culprit.error = exc
         self._trace["quarantined"].append(culprit.uid)
+        if self._obs is not None:
+            self._obs.quarantined.inc()
+            self._obs.tracer.event(str(culprit.uid), "quarantine",
+                                   args={"error": repr(exc)})
         logger.warning(f"[serving] quarantined request {culprit.uid} after "
                        f"reproducible tick fault: {exc!r}")
         self._finish(culprit)  # flush=True: its KV reservation is released
@@ -1006,6 +1085,8 @@ class ServingScheduler:
                     self._degraded = True
                     with self._lock:
                         self._trace["watchdog_trips"] += 1
+                    if self._obs is not None:
+                        self._obs.watchdog_trips.inc()
                     logger.warning(f"[serving-watchdog] no scheduler "
                                    f"progress for {age:.2f}s with work in "
                                    "flight; /health degraded")
@@ -1080,6 +1161,10 @@ class ServingScheduler:
                     SchedulingResult.KVCacheLimitExceeded)
                 self._waiting.remove(req)
                 self._finish(req, flush=False)
+        if self._obs is not None and admitted:
+            now = time.monotonic()
+            for r in admitted:
+                self._obs.request_admitted(r.uid, r.t_submit, now)
         return admitted
 
     def _queue_drop(self, req: _Request) -> None:
@@ -1243,6 +1328,8 @@ class ServingScheduler:
         if not eligible and not spec_rows:
             return None
         cap = self._adaptive_window()
+        if self._obs is not None:
+            self._obs.adaptive_k.set(cap)
         if cap < 2:
             return None
         t0 = time.monotonic()
@@ -1261,6 +1348,8 @@ class ServingScheduler:
             fed = self._overlap_fill(budget)
             if fed:
                 self._trace["prefill_overlap_tokens"] += fed
+                if self._obs is not None:
+                    self._obs.prefill_overlap.inc(fed)
         finally:
             # harvest EVEN IF the overlap work raised (a put fault rides
             # the tick retry boundary): an unharvested wave would leave
@@ -1319,8 +1408,12 @@ class ServingScheduler:
             spent += take
         if not p_reqs:
             return 0
+        t0 = time.monotonic()
         if self._tick_put(p_reqs, p_chunks, {}) is None:
             return 0  # eviction fence refused / eviction ended the fill
+        if self._obs is not None:
+            self._obs.prefill_span([r.uid for r in p_reqs], t0,
+                                   time.monotonic(), spent, overlap=True)
         return spent
 
     def _per_token_tick(self, decodes, prefills, budget) -> bool:
@@ -1362,6 +1455,7 @@ class ServingScheduler:
             spare -= take
         if not d_reqs and not p_reqs:
             return False
+        t_put = time.monotonic()
         if drafted and p_reqs:
             # a prefill chunk inside a window-logits put would materialize
             # [S, chunk, vocab] logits — issue the windowed decode put and
@@ -1374,6 +1468,10 @@ class ServingScheduler:
             self._tick_put(d_reqs, d_chunks, drafted)
         else:
             self._tick_put(d_reqs + p_reqs, d_chunks + p_chunks, {})
+        if self._obs is not None and p_reqs:
+            self._obs.prefill_span(
+                [r.uid for r in p_reqs], t_put, time.monotonic(),
+                sum(len(c) for c in p_chunks))
         self._retire_finished()
         return True
 
@@ -1429,9 +1527,9 @@ class ServingScheduler:
 
     def _fused_begin(self, decodes, cap: int):
         """Partition + async dispatch of the plain/sampled fused wave.
-        Returns ``(fused_reqs, engine_handle, K, all_greedy)``, or None
-        when no subset reaches a 2-step window or KV pressure refuses the
-        wave (the caller's per-token tick owns eviction)."""
+        Returns ``(fused_reqs, engine_handle, K, all_greedy, t_dispatch)``,
+        or None when no subset reaches a 2-step window or KV pressure
+        refuses the wave (the caller's per-token tick owns eviction)."""
         fusable_uids, K, _solo = self._engine.fused_partition(
             [r.uid for r in decodes],
             [r.max_new_tokens - len(r.outputs) for r in decodes], cap)
@@ -1452,12 +1550,12 @@ class ServingScheduler:
                     specs=[self._spec_for(r) for r in fused])
         except SchedulingError:
             return None
-        return (fused, h, K, all_greedy)
+        return (fused, h, K, all_greedy, time.monotonic())
 
     def _fused_harvest(self, wave) -> list:
         """Fetch + emit a dispatched fused wave (retirement is the
         caller's pass — wave members must not flush mid-overlap)."""
-        fused, h, K, all_greedy = wave
+        fused, h, K, all_greedy, t0 = wave
         lps = None
         if all_greedy:
             toks = self._engine.fused_decode_harvest(h)
@@ -1467,6 +1565,7 @@ class ServingScheduler:
                 r.key_burns += K
         self._trace["fused_dispatches"] += 1
         self._trace["fused_k_sum"] += K
+        wave_tokens = 0
         for i, (req, row) in enumerate(zip(fused, toks)):
             req.fed += K
             emitted = self._emit_many(req, [int(t) for t in row],
@@ -1474,6 +1573,7 @@ class ServingScheduler:
                                       if lps is not None else None)
             self._trace["fused_tokens"] += emitted
             self._trace["decode_tokens"] += emitted
+            wave_tokens += emitted
             if not self._engine.decode_finished(
                     req.uid, req.outputs, req.max_new_tokens,
                     req.eos_token_id, req.stop):
@@ -1483,6 +1583,12 @@ class ServingScheduler:
                 seq = self._engine._state_manager.get_sequence(req.uid)
                 self._engine._register_pending(seq)
                 self._engine._model.maybe_free_kv(seq)
+        if self._obs is not None:
+            self._obs.fused_dispatches.inc()
+            self._obs.fused_tokens.inc(wave_tokens)
+            self._obs.wave_span([r.uid for r in fused], t0,
+                                time.monotonic(), K, len(fused),
+                                "greedy" if all_greedy else "sampled")
         return fused
 
     def _spec_fusable(self, r: _Request) -> bool:
@@ -1516,8 +1622,9 @@ class ServingScheduler:
     def _fused_spec_begin(self, decodes, cap: int) -> list:
         """Partition + async dispatch of the speculative wave(s), one per
         (draft width, ngram) group. Returns a list of
-        ``(fused_reqs, K, engine_handle, all_greedy)`` handles — possibly
-        empty under KV pressure (the per-token tick owns eviction)."""
+        ``(fused_reqs, K, engine_handle, all_greedy, t_dispatch)``
+        handles — possibly empty under KV pressure (the per-token tick
+        owns eviction)."""
         groups = {}
         for r in decodes:
             groups.setdefault((r.num_draft_tokens, r.draft_ngram),
@@ -1541,12 +1648,12 @@ class ServingScheduler:
                     else [self._spec_for(r) for r in fused])
             except SchedulingError:
                 continue  # KV pressure: the per-token tick owns eviction
-            waves.append((fused, K, h, all_greedy))
+            waves.append((fused, K, h, all_greedy, time.monotonic()))
         return waves
 
     def _fused_spec_harvest(self, swave) -> list:
         """Fetch + emit one dispatched speculative wave."""
-        fused, K, h, all_greedy = swave
+        fused, K, h, all_greedy, t0 = swave
         toks_lists, drafted, accepted = \
             self._engine.fused_spec_decode_harvest(h)
         if not all_greedy:  # one split per verified window, K windows
@@ -1554,6 +1661,7 @@ class ServingScheduler:
                 req.key_burns += K
         self._trace["fused_dispatches"] += 1
         self._trace["fused_k_sum"] += K
+        wave_tokens = wave_dr = wave_ac = 0
         for req, row, dr, ac in zip(fused, toks_lists, drafted,
                                     accepted):
             req.fed += len(row)
@@ -1561,9 +1669,12 @@ class ServingScheduler:
             req.accepted += ac
             self._trace["spec_drafted"] += dr
             self._trace["spec_accepted"] += ac
+            wave_dr += dr
+            wave_ac += ac
             emitted = self._emit_many(req, row)
             self._trace["fused_tokens"] += emitted
             self._trace["decode_tokens"] += emitted
+            wave_tokens += emitted
             if not self._engine.decode_finished(
                     req.uid, req.outputs, req.max_new_tokens,
                     req.eos_token_id, req.stop):
@@ -1572,6 +1683,14 @@ class ServingScheduler:
                 seq = self._engine._state_manager.get_sequence(req.uid)
                 self._engine._register_pending(seq)
                 self._engine._model.maybe_free_kv(seq)
+        if self._obs is not None:
+            self._obs.fused_dispatches.inc()
+            self._obs.fused_tokens.inc(wave_tokens)
+            self._obs.spec_drafted.inc(wave_dr)
+            self._obs.spec_accepted.inc(wave_ac)
+            self._obs.wave_span([r.uid for r in fused], t0,
+                                time.monotonic(), K, len(fused), "spec",
+                                drafted=wave_dr, accepted=wave_ac)
         return fused
 
     def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:
@@ -1697,6 +1816,24 @@ class ServingScheduler:
                            f"consumer stopped draining "
                            f"({req.stream_q.maxsize} tokens undelivered)")
 
+    def _mark_emit(self, req: _Request) -> None:
+        """Timestamp bookkeeping for one about-to-append token: ``t_first``
+        on the first (feeding the TTFT histogram unless the request is a
+        journal replay, whose submit anchor predates the restart), the
+        inter-token gap histogram on every later one."""
+        now = time.monotonic()
+        obs = self._obs
+        if not req.outputs:
+            req.t_first = now
+            if obs is not None:
+                obs.first_token(req.t_submit, now, req.replayed)
+        elif obs is not None and req.t_last > 0.0:
+            obs.token_gap(now - req.t_last)
+        req.t_last = now
+        if obs is not None:
+            obs.tokens.inc()
+            obs.decode_tokens.inc()
+
     def _emit_device(self, wave) -> None:
         """ONE batched on-device sampling dispatch for every device-eligible
         row of a per-token tick (engine.sample_rows) — the N sampled
@@ -1708,8 +1845,7 @@ class ServingScheduler:
             req.key_burns += 1  # sample_rows splits each row's key once
             if req.return_logprobs:
                 req.logprobs.append(float(lp))
-            if not req.outputs:
-                req.t_first = time.monotonic()
+            self._mark_emit(req)
             req.outputs.append(int(tok))
             self._trace["decode_tokens"] += 1
             self._stream_put(req, int(tok))
@@ -1729,8 +1865,7 @@ class ServingScheduler:
             want_lp=req.return_logprobs)
         if req.return_logprobs:
             req.logprobs.append(lp)
-        if not req.outputs:
-            req.t_first = time.monotonic()
+        self._mark_emit(req)
         req.outputs.append(int(tok))
         self._trace["decode_tokens"] += 1
         self._stream_put(req, int(tok))
@@ -1745,8 +1880,7 @@ class ServingScheduler:
         for i, t in enumerate(toks):
             if len(req.outputs) >= req.max_new_tokens:
                 break
-            if not req.outputs:
-                req.t_first = time.monotonic()
+            self._mark_emit(req)
             if req.return_logprobs:
                 req.logprobs.append(float(lps[i]) if lps is not None
                                     else None)
@@ -1795,7 +1929,19 @@ class ServingScheduler:
             if req.error is None and not req.cancelled:
                 self._completed.append(
                     (req.t_submit, req.t_first, req.t_done,
-                     len(req.outputs)))
+                     len(req.outputs), req.replayed))
+        if self._obs is not None:
+            if req.error is None and not req.cancelled:
+                outcome = "ok"
+            elif req.cancelled:
+                outcome = "cancelled"
+            elif isinstance(req.error, DeadlineExceeded):
+                outcome = "expired"
+            else:
+                outcome = "error"
+            self._obs.request_finished(req.uid, req.t_submit, req.t_done,
+                                       outcome, len(req.outputs),
+                                       req.replayed)
             # keep the last 256 finished requests reconnectable by uid,
             # then let them go so the registry stays bounded
             self._done_order.append(req.uid)
@@ -1833,6 +1979,13 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
       seed / stream. ``stream: true`` answers chunked, one JSON line per
       token; otherwise one JSON object with the full output.
     GET /health: scheduler stats.
+    Observability (404 with the ``observability`` config block disabled):
+      GET /metrics — Prometheus text exposition of the process registry;
+      GET /requests/<uid>/trace — the request's span timeline as JSON;
+      GET /debug/trace?last=N — recent waves + live timelines as Chrome
+      ``trace_event`` JSON (Perfetto-loadable);
+      POST /debug/profile — start a bounded jax.profiler capture
+      (409 while one runs); POST /debug/profile/stop — end it early.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -1870,6 +2023,31 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     status = "ok"
                 self._json(200 if status == "ok" else 503,
                            {"status": status, **stats})
+            elif self.path == "/metrics":
+                obs = scheduler.observability
+                if obs is None:
+                    self._json(404, {"error": "observability disabled"})
+                    return
+                body = obs.registry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/trace"):
+                obs = scheduler.observability
+                if obs is None:
+                    self._json(404, {"error": "observability disabled"})
+                    return
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    last = int(q.get("last", ["0"])[0]) or None
+                except ValueError:
+                    self._json(400, {"error": "bad last"})
+                    return
+                self._json(200, obs.tracer.chrome_trace(last))
             elif self.path.startswith("/requests/"):
                 self._do_request_get()
             else:
@@ -1888,6 +2066,15 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 uid = int(parts[1])
             except (IndexError, ValueError):
                 self._json(400, {"error": "bad request id"})
+                return
+            if len(parts) > 2 and parts[2] == "trace":
+                # post-hoc reconstruction: the span timeline survives the
+                # request itself (bounded ring), so no live handle needed
+                tl = scheduler.trace_timeline(uid)
+                if tl is None:
+                    self._json(404, {"error": f"no trace for request {uid}"})
+                    return
+                self._json(200, tl)
                 return
             handle = scheduler.lookup(uid)
             if handle is None:
@@ -1936,7 +2123,45 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 return
             self._json(200, {"uid": uid, "tokens": tokens})
 
+        def _do_profile(self):
+            """``POST /debug/profile`` starts a bounded ``jax.profiler``
+            capture (body: optional ``{"seconds": N, "dir": ...}``); a
+            second start while one runs answers 409. ``/stop`` ends a
+            capture early (the auto-stop timer otherwise does)."""
+            obs = scheduler.observability
+            if obs is None:
+                self._json(404, {"error": "observability disabled"})
+                return
+            if self.path.endswith("/stop"):
+                info = obs.profiler.stop()
+                if info is None:
+                    self._json(200, {"status": "idle"})
+                else:
+                    self._json(200, {"status": "stopped", **info})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                seconds = body.get("seconds")
+                seconds = float(seconds) if seconds is not None else None
+                directory = body.get("dir")
+            except (ValueError, TypeError):
+                self._json(400, {"error": "bad profile request body"})
+                return
+            try:
+                info = obs.profiler.start(seconds, directory)
+            except ProfilerBusy as e:
+                self._json(409, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — profiler backends vary
+                self._json(500, {"error": f"profiler start failed: {e}"})
+                return
+            self._json(200, {"status": "started", **info})
+
         def do_POST(self):
+            if self.path in ("/debug/profile", "/debug/profile/stop"):
+                self._do_profile()
+                return
             if self.path not in ("/generate", "/v1/completions",
                                  "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})

@@ -34,7 +34,19 @@ import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
 
+from ...observability import get_registry, get_tracer
 from ...utils.logging import logger
+
+# Restart accounting (process registry, resolved at import). The restart
+# histogram measures death-detected → child-ready (or ready-timeout) — the
+# real unavailability window a client sees across a warm restart.
+_obs = get_registry()
+_restarts_total = _obs.counter(
+    "ds_supervisor_restarts_total", "Daemon warm restarts (crash relaunches)")
+_restart_seconds = _obs.histogram(
+    "ds_supervisor_restart_seconds",
+    "Warm restart wall time: crash detected to child ready",
+    lo=1e-3, hi=1e4, buckets_per_decade=10)
 
 
 def _wait_ready(health_url: str, timeout_s: float,
@@ -138,7 +150,9 @@ class ServingSupervisor:
                 if rc == 0:
                     logger.info("ServingSupervisor: clean exit")
                     return 0
+                t_down = time.monotonic()
                 self.restarts += 1
+                _restarts_total.inc()
                 if self.restarts > self.max_restarts:
                     logger.error(
                         f"ServingSupervisor: restart budget exhausted "
@@ -153,6 +167,12 @@ class ServingSupervisor:
                     time.sleep(backoff)
                 proc = self._launch()
                 self._await_ready(proc)
+                t_up = time.monotonic()
+                _restart_seconds.record(t_up - t_down)
+                get_tracer().global_span(
+                    "supervisor_restart", t_down, t_up,
+                    args={"rc": rc, "restart": self.restarts,
+                          "backoff_s": round(backoff, 3)})
         finally:
             if proc.poll() is None:
                 self._terminate(proc)

@@ -71,18 +71,37 @@ class csvMonitor(Monitor):
         self.job_name = config.job_name
         self.log_dir = os.path.join(self.output_path, self.job_name)
         os.makedirs(self.log_dir, exist_ok=True)
-        self.filenames = {}
+        self.filenames = {}  # metric name -> (path, open handle)
+
+    def _writer(self, name: str):
+        cached = self.filenames.get(name)
+        if cached is not None and not cached[1].closed:
+            return cached[1]
+        safe = name.replace("/", "_")
+        # the dir may have been removed after __init__ (log rotation, tests)
+        os.makedirs(self.log_dir, exist_ok=True)
+        fn = os.path.join(self.log_dir, f"{safe}.csv")
+        new = not os.path.exists(fn) or os.path.getsize(fn) == 0
+        fh = open(fn, "a", newline="")
+        if new:
+            _csv.writer(fh).writerow(["step", safe])
+        self.filenames[name] = (fn, fh)
+        return fh
 
     def write_events(self, event_list):
+        touched = set()
         for name, value, step in event_list:
-            safe = name.replace("/", "_")
-            fn = os.path.join(self.log_dir, f"{safe}.csv")
-            new = not os.path.exists(fn)
-            with open(fn, "a", newline="") as f:
-                w = _csv.writer(f)
-                if new:
-                    w.writerow(["step", safe])
-                w.writerow([step, value])
+            fh = self._writer(name)
+            _csv.writer(fh).writerow([step, value])
+            touched.add(name)
+        for name in touched:  # one flush per batch, not per event
+            self.filenames[name][1].flush()
+
+    def close(self):
+        for _, fh in self.filenames.values():
+            if not fh.closed:
+                fh.close()
+        self.filenames = {}
 
 
 class CometMonitor(Monitor):
@@ -135,6 +154,17 @@ class MonitorMaster(Monitor):
     def write_events(self, event_list):
         for m in self.monitors:
             m.write_events(event_list)
+
+    def write_registry(self, step, registry=None, prefix=""):
+        """Bridge the observability metrics registry into the fan-out:
+        counters/gauges as scalars, histograms as _count/_mean/_pNN —
+        one ``(name, value, step)`` schema shared with training events."""
+        if not self.enabled:
+            return
+        if registry is None:
+            from ..observability import get_registry
+            registry = get_registry()
+        self.write_events(registry.to_events(step, prefix=prefix))
 
     def write_events_async(self, event_list):
         """Queue events WITHOUT forcing a device→host sync (async-pipeline

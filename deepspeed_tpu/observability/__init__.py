@@ -1,0 +1,35 @@
+"""Unified serving/training observability.
+
+Three pillars, all host-side and allocation-light (nothing here ever
+touches the device — timestamps are ``time.monotonic()`` around already
+existing host boundaries, honoring the async-dispatch design):
+
+- :mod:`metrics` — a process-wide registry of counters, gauges, and
+  log-bucketed histograms (fixed-size numpy bucket arrays; p50/p90/p99
+  derivable at read time). Rendered as Prometheus text by the serving
+  daemon's ``GET /metrics`` and bridgeable into the ``monitor/`` fan-out
+  (one ``(name, value, step)`` event schema shared with training).
+- :mod:`tracing` — per-request span timelines (submit → queue → admit →
+  prefill chunks → fused K-waves → journal → finish) in a bounded ring,
+  exportable per-uid as JSON and in bulk as Chrome ``trace_event`` JSON
+  (loadable in Perfetto / chrome://tracing).
+- :mod:`profiler` — guarded on-demand ``jax.profiler`` captures (one at
+  a time, duration-bounded) behind ``POST /debug/profile``.
+
+Gated by the ``observability`` config block (:class:`ObservabilityConfig`
+in ``inference/v2/config_v2.py``): on by default with bounded ring sizes.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry, histogram_delta, quantiles_from_counts)
+from .tracing import RequestTracer, get_tracer
+from .profiler import ProfilerBusy, ProfilerCapture, profile_dir
+from .instruments import ServingInstruments
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "histogram_delta", "quantiles_from_counts",
+    "RequestTracer", "get_tracer",
+    "ProfilerBusy", "ProfilerCapture", "profile_dir",
+    "ServingInstruments",
+]

@@ -1,0 +1,114 @@
+"""Guarded on-demand ``jax.profiler`` captures.
+
+``POST /debug/profile`` maps here: start a trace capture into a
+directory, bounded in duration, with at most one capture in flight per
+process (a second request gets :class:`ProfilerBusy` → HTTP 409).
+The capture auto-stops after ``seconds`` via a daemon timer so an
+operator who fires a capture and walks away cannot leave the profiler
+running forever.
+
+``start_fn``/``stop_fn`` are injectable so unit tests (and CPU-only
+environments without a working profiler backend) never import-commit to
+``jax.profiler``.
+"""
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (one at a time per process)."""
+
+
+def profile_dir(explicit: Optional[str] = None) -> str:
+    """Resolve the capture directory: explicit > ``$DS_TPU_PROFILE_DIR`` >
+    ``$XDG_CACHE_HOME/deepspeed_tpu/profiles`` (mirrors journal_dir())."""
+    if explicit:
+        return explicit
+    env = os.environ.get("DS_TPU_PROFILE_DIR")
+    if env:
+        return env
+    cache = os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(cache, "deepspeed_tpu", "profiles")
+
+
+def _jax_start(directory: str) -> None:
+    import jax
+    jax.profiler.start_trace(directory)
+
+
+def _jax_stop() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+class ProfilerCapture:
+    """One-at-a-time, duration-bounded profiler capture controller."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_seconds: float = 60.0,
+                 start_fn: Callable[[str], None] = _jax_start,
+                 stop_fn: Callable[[], None] = _jax_stop):
+        self._dir = profile_dir(directory)
+        self._max_seconds = float(max_seconds)
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._lock = threading.Lock()
+        self._active: Optional[dict] = None
+        self._timer: Optional[threading.Timer] = None
+        self._captures = 0
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    @property
+    def active(self) -> Optional[dict]:
+        """Info dict for the in-flight capture, or None."""
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+    @property
+    def captures(self) -> int:
+        return self._captures
+
+    def start(self, seconds: Optional[float] = None,
+              directory: Optional[str] = None) -> dict:
+        """Begin a capture; auto-stops after ``seconds`` (clamped to the
+        configured maximum). Raises :class:`ProfilerBusy` if one is
+        already running."""
+        dur = self._max_seconds if seconds is None else float(seconds)
+        dur = max(0.01, min(dur, self._max_seconds))
+        target = directory or self._dir
+        with self._lock:
+            if self._active is not None:
+                raise ProfilerBusy(
+                    f"capture already running in {self._active['dir']}")
+            os.makedirs(target, exist_ok=True)
+            self._start_fn(target)
+            self._captures += 1
+            self._active = {"dir": target, "seconds": dur,
+                            "t_start": time.monotonic()}
+            self._timer = threading.Timer(dur, self.stop)
+            self._timer.daemon = True
+            self._timer.start()
+            return dict(self._active)
+
+    def stop(self) -> Optional[dict]:
+        """Stop the in-flight capture (no-op when idle — the auto-stop
+        timer and an explicit stop may race benignly)."""
+        with self._lock:
+            if self._active is None:
+                return None
+            info, self._active = self._active, None
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+        try:
+            self._stop_fn()
+        finally:
+            info["dur_s"] = time.monotonic() - info["t_start"]
+        return info

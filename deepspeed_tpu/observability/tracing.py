@@ -1,0 +1,192 @@
+"""Per-request span timelines in bounded ring buffers.
+
+A *span* is one closed interval of a request's life on the host clock:
+queue wait, a prefill chunk, one fused K-wave, a journal append, the
+finish. Spans carry a small ``args`` dict (wave K, wave size, spec
+accept counts, chunk tokens...) and are recorded with plain
+``time.monotonic()`` timestamps — recording never touches the device.
+
+Storage is bounded three ways so a long-lived daemon cannot grow:
+
+- at most ``max_requests`` live timelines (oldest evicted first),
+- at most ``max_spans_per_request`` spans per timeline (a deque ring —
+  a pathological million-token request keeps its most recent spans),
+- a global ``max_waves`` ring of wave/global spans for the bulk
+  ``GET /debug/trace`` Chrome export.
+
+Export formats:
+
+- :meth:`RequestTracer.timeline` — the per-uid JSON served by
+  ``GET /requests/<uid>/trace``: ordered spans with ``t0``/``t1``
+  relative to submit, plus the raw monotonic anchors.
+- :meth:`RequestTracer.chrome_trace` — Chrome ``trace_event`` JSON
+  (``ph: "X"`` complete events, microsecond timestamps) loadable in
+  Perfetto / chrome://tracing, one ``tid`` lane per request.
+"""
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+
+class _Timeline:
+    __slots__ = ("uid", "t_submit", "spans", "events", "done")
+
+    def __init__(self, uid: str, t_submit: float, max_spans: int):
+        self.uid = uid
+        self.t_submit = t_submit
+        self.spans = deque(maxlen=max_spans)
+        self.events = deque(maxlen=max_spans)
+        self.done = False
+
+
+class RequestTracer:
+    """Bounded recorder of request lifecycles and global daemon spans."""
+
+    def __init__(self, max_requests: int = 512,
+                 max_spans_per_request: int = 512,
+                 max_waves: int = 2048):
+        self._lock = threading.Lock()
+        self._max_requests = int(max_requests)
+        self._max_spans = int(max_spans_per_request)
+        self._timelines: "OrderedDict[str, _Timeline]" = OrderedDict()
+        self._waves = deque(maxlen=int(max_waves))
+
+    # ---- recording (hot path: one lock, one deque append) ----
+
+    def begin(self, uid: str, t_submit: Optional[float] = None) -> None:
+        """Open a timeline at submit time. Idempotent per uid (a replayed
+        request re-begins and keeps its original timeline)."""
+        t = time.monotonic() if t_submit is None else t_submit
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is not None:
+                self._timelines.move_to_end(uid)
+                return
+            tl = _Timeline(uid, t, self._max_spans)
+            self._timelines[uid] = tl
+            while len(self._timelines) > self._max_requests:
+                self._timelines.popitem(last=False)
+
+    def span(self, uid: str, name: str, t0: float, t1: float,
+             args: Optional[dict] = None) -> None:
+        """Record a closed [t0, t1] interval for a request."""
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is None:
+                return
+            tl.spans.append((name, t0, t1, args))
+
+    def event(self, uid: str, name: str, t: Optional[float] = None,
+              args: Optional[dict] = None) -> None:
+        """Record an instant (shed, expiry, quarantine, resume...)."""
+        t = time.monotonic() if t is None else t
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is None:
+                return
+            tl.events.append((name, t, args))
+
+    def finish(self, uid: str, name: str = "finish",
+               t: Optional[float] = None,
+               args: Optional[dict] = None) -> None:
+        t = time.monotonic() if t is None else t
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is None:
+                return
+            tl.events.append((name, t, args))
+            tl.done = True
+
+    def global_span(self, name: str, t0: float, t1: float,
+                    args: Optional[dict] = None,
+                    uids: Optional[List[str]] = None) -> None:
+        """Record a daemon-level interval (a fused wave, a restart) into
+        the global ring, optionally mirrored onto member timelines."""
+        with self._lock:
+            self._waves.append((name, t0, t1, args))
+            if uids:
+                for uid in uids:
+                    tl = self._timelines.get(uid)
+                    if tl is not None:
+                        tl.spans.append((name, t0, t1, args))
+
+    # ---- export (cold path) ----
+
+    def has(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._timelines
+
+    def timeline(self, uid: str) -> Optional[dict]:
+        """Per-request JSON timeline: spans sorted by start, times both
+        absolute (monotonic) and relative to submit."""
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is None:
+                return None
+            spans = list(tl.spans)
+            events = list(tl.events)
+            t_submit, done = tl.t_submit, tl.done
+        spans.sort(key=lambda s: s[1])
+        out_spans = []
+        for name, t0, t1, args in spans:
+            d = {"name": name, "t0": t0 - t_submit, "t1": t1 - t_submit,
+                 "dur_s": t1 - t0, "t0_monotonic": t0, "t1_monotonic": t1}
+            if args:
+                d["args"] = dict(args)
+            out_spans.append(d)
+        out_events = []
+        for name, t, args in sorted(events, key=lambda e: e[1]):
+            d = {"name": name, "t": t - t_submit, "t_monotonic": t}
+            if args:
+                d["args"] = dict(args)
+            out_events.append(d)
+        return {"uid": uid, "t_submit_monotonic": t_submit, "done": done,
+                "spans": out_spans, "events": out_events}
+
+    def chrome_trace(self, last: Optional[int] = None) -> dict:
+        """Chrome ``trace_event`` JSON of recent global spans plus every
+        live timeline, one ``tid`` lane per request (pid 1 = daemon)."""
+        with self._lock:
+            waves = list(self._waves)
+            tls = [(tl.uid, tl.t_submit, list(tl.spans), list(tl.events))
+                   for tl in self._timelines.values()]
+        if last is not None and last >= 0:
+            waves = waves[-last:]
+        events = []
+        for name, t0, t1, args in waves:
+            ev = {"name": name, "ph": "X", "pid": 1, "tid": 0,
+                  "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6}
+            if args:
+                ev["args"] = dict(args)
+            events.append(ev)
+        for tid, (uid, t_submit, spans, instants) in enumerate(tls, start=1):
+            events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": tid, "args": {"name": f"req {uid}"}})
+            for name, t0, t1, args in spans:
+                ev = {"name": name, "ph": "X", "pid": 1, "tid": tid,
+                      "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6}
+                if args:
+                    ev["args"] = dict(args)
+                events.append(ev)
+            for name, t, args in instants:
+                ev = {"name": name, "ph": "i", "pid": 1, "tid": tid,
+                      "ts": t * 1e6, "s": "t"}
+                if args:
+                    ev["args"] = dict(args)
+                events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._timelines.clear()
+            self._waves.clear()
+
+
+_TRACER = RequestTracer()
+
+
+def get_tracer() -> RequestTracer:
+    """The process-wide tracer (serving injects its own sized instance)."""
+    return _TRACER

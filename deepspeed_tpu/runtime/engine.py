@@ -1311,6 +1311,7 @@ class DeepSpeedTpuEngine:
                 if self.monitor is not None and self.losses is not None:
                     self.monitor.write_events([("Train/Samples/train_loss", float(self.losses),
                                                 self.global_samples)])
+                self._publish_registry_events()
                 if self._config.steps_per_print and self.global_steps % self._config.steps_per_print == 0:
                     log_dist(
                         f"step={self.global_steps}, skipped={self.skipped_steps}, "
@@ -1404,6 +1405,18 @@ class DeepSpeedTpuEngine:
     def _advance_schedule(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
+        from ..observability import get_registry
+        get_registry().counter(
+            "ds_train_steps_total", "Effective (non-skipped) optimizer steps"
+        ).inc()
+
+    def _publish_registry_events(self):
+        """Monitor bridge (``monitor.registry_events``): fan the process
+        observability registry out alongside the training events, stamped
+        with the current global step."""
+        if (self.monitor is not None
+                and self._config.monitor_config.registry_events):
+            self.monitor.write_registry(self.global_steps)
 
     # ------------------------------------------------------------------
     # async step pipeline (windowed host sync)
@@ -1484,6 +1497,7 @@ class DeepSpeedTpuEngine:
                 op="reduce_scatter")
         if self.monitor is not None:
             self.monitor.flush_events(fetch=host_fetch)
+            self._publish_registry_events()
         if getattr(self, "_sentry", None) is not None:
             # async-mode sentry feed: the window's values were just fetched
             # in the batched transfer above — zero additional syncs

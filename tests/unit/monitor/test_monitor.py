@@ -100,3 +100,65 @@ def test_wandb_monitor_degrades_without_login(monkeypatch):
     mon = WandbMonitor(WandbConfig(enabled=True))
     assert not mon.enabled
     mon.write_events([("loss", 1.0, 0)])  # inert
+
+
+def test_csv_monitor_recreates_deleted_log_dir(tmp_path):
+    """write_events must mkdir the log dir if it vanished after __init__
+    (log rotation, tmpdir cleanup) instead of crashing the train loop."""
+    import shutil
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "rot"})
+    mon = csvMonitor(cfg.csv_monitor)
+    shutil.rmtree(tmp_path / "rot")
+    mon.write_events([("loss", 3.0, 0)])
+    with open(tmp_path / "rot" / "loss.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows == [["step", "loss"], ["0", "3.0"]]
+
+
+def test_csv_monitor_flushes_and_reuses_handles(tmp_path):
+    """Rows are on disk after every write_events batch (no close needed)
+    and the per-metric file handle persists across batches."""
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "fl"})
+    mon = csvMonitor(cfg.csv_monitor)
+    mon.write_events([("a", 1.0, 0), ("a", 2.0, 1), ("b", 9.0, 0)])
+    fh_a = mon.filenames["a"][1]
+    # visible immediately, while the handle is still open
+    with open(tmp_path / "fl" / "a.csv") as f:
+        assert len(list(csv.reader(f))) == 3  # header + 2 rows
+    mon.write_events([("a", 3.0, 2)])
+    assert mon.filenames["a"][1] is fh_a  # cached, not reopened
+    with open(tmp_path / "fl" / "a.csv") as f:
+        assert list(csv.reader(f))[-1] == ["2", "3.0"]
+    mon.close()
+    assert fh_a.closed and mon.filenames == {}
+    mon.write_events([("a", 4.0, 3)])  # reopens and appends, no rewrite
+    with open(tmp_path / "fl" / "a.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "a"] and rows[-1] == ["3", "4.0"]
+    assert len(rows) == 5  # ONE header: append did not re-write it
+
+
+def test_master_bridges_metrics_registry(tmp_path):
+    """write_registry publishes the observability registry through the
+    fan-out: counters/gauges as scalars, histograms as derived series."""
+    from deepspeed_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("ds_x_total").inc(4)
+    h = reg.histogram("ds_lat_seconds")
+    for v in (0.1, 0.2):
+        h.record(v)
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "reg"})
+    master = MonitorMaster(cfg)
+    master.write_registry(step=7, registry=reg, prefix="serve/")
+    with open(tmp_path / "reg" / "serve_ds_x_total.csv") as f:
+        assert list(csv.reader(f))[-1] == ["7", "4.0"]
+    assert os.path.exists(tmp_path / "reg" / "serve_ds_lat_seconds_p99.csv")
+    # disabled master: write_registry is inert (no default-registry pull)
+    off = MonitorMaster(MonitorConfig())
+    off.write_registry(step=1)  # must not raise nor write

@@ -1,0 +1,301 @@
+"""End-to-end serving observability over a real (tiny) engine: histogram
+percentiles validated against raw per-request timestamps, full post-hoc
+trace reconstruction of an HTTP request, the /metrics exposition, and
+the disabled-config degradation.
+
+One module-scoped engine is shared by every test here (builds dominate
+wall clock; tier-1 headroom is narrow) and each scheduler gets a PRIVATE
+registry/tracer via ``instruments=`` so the process-global namespace —
+which other test modules' engine instrumentation feeds — never leaks in.
+"""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2 import (RaggedInferenceEngineConfig,
+                                        ServingScheduler, build_llama_engine)
+from deepspeed_tpu.inference.v2.server import create_http_server
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.observability import MetricsRegistry, ServingInstruments
+
+BS = 16
+WINDOW = 4
+
+
+@pytest.fixture(scope="module")
+def eng():
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS,
+                              engine_config=RaggedInferenceEngineConfig())
+
+
+def _private_instruments():
+    return ServingInstruments(registry=MetricsRegistry())
+
+
+def _sched(eng, **kw):
+    kw.setdefault("instruments", _private_instruments())
+    return ServingScheduler(eng, idle_wait=0.002,
+                            fused_decode_window=WINDOW, **kw)
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 200, size=n).tolist()
+
+
+# ------------------------------------------------- percentiles vs truth
+
+
+def test_histogram_percentiles_match_raw_timestamps(eng):
+    """The /metrics histograms must agree with ground truth: TTFT and
+    e2e quantiles derived from the bucket counts land within one bucket
+    ratio (10**(1/10)) of numpy quantiles over the raw per-request
+    monotonic timestamps the scheduler itself recorded."""
+    sched = _sched(eng).start()
+    obs = sched.observability
+    try:
+        rng = np.random.default_rng(0)
+        handles = [sched.submit(_prompt(rng, 8 + i), max_new_tokens=6)
+                   for i in range(8)]
+        for h in handles:
+            h.result(120)
+        raw_ttft = [h._req.t_first - h._req.t_submit for h in handles]
+        raw_e2e = [h._req.t_done - h._req.t_submit for h in handles]
+        assert obs.ttft.count == len(handles)
+        assert obs.e2e.count == len(handles)
+        ratio = 10 ** (1 / 10) * 1.0001
+        for hist, raw in ((obs.ttft, raw_ttft), (obs.e2e, raw_e2e)):
+            for q in (0.5, 0.99):
+                est, true = hist.quantile(q), float(np.quantile(raw, q))
+                assert true / ratio <= est <= true * ratio, (
+                    hist.name, q, est, true)
+        # inter-token gaps: one per emitted token beyond the first
+        assert obs.inter_token.count == sum(
+            len(h._req.outputs) - 1 for h in handles)
+        # /health carries the same histogram-derived percentiles
+        stats = sched.stats
+        assert stats["ttft_p50_s"] == pytest.approx(
+            obs.ttft.quantile(0.5), rel=1e-3, abs=1e-4)
+        assert stats["ttft_p99_s"] is not None
+        assert stats["inter_token_p99_s"] == pytest.approx(
+            obs.inter_token.quantile(0.99), rel=1e-3, abs=1e-4)
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------- HTTP trace reconstruction
+
+
+def test_http_request_fully_reconstructable_post_hoc(eng):
+    """Acceptance: an HTTP-submitted request is reconstructable after the
+    fact from GET /requests/<uid>/trace — queue wait, prefill, every
+    fused wave (with its K), and finish, with monotonic non-overlapping
+    host timestamps."""
+    sched = _sched(eng).start()
+    httpd = create_http_server(sched, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        body = json.dumps({"prompt": list(range(3, 3 + 2 * BS)),
+                           "max_new_tokens": 10}).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        uid = out["uid"]
+        assert len(out["tokens"]) == 10
+
+        with urllib.request.urlopen(f"{base}/requests/{uid}/trace",
+                                    timeout=10) as r:
+            tl = json.loads(r.read())
+        assert tl["done"] is True
+        names = [s["name"] for s in tl["spans"]]
+        assert names[0] == "queue"
+        assert "prefill" in names
+        waves = [s for s in tl["spans"]
+                 if s["name"].startswith("fused_wave[")]
+        # 10 greedy tokens through a K=4 window: at least two full waves
+        assert len(waves) >= 2
+        for w in waves:
+            assert w["args"]["K"] >= 1
+            assert w["args"]["size"] >= 1
+        # prefill yields token 1 and the final token can fall off the
+        # fused path (needs >= 2 tokens of room), so the waves carry at
+        # least new_tokens - 2 of the 10
+        assert sum(w["args"]["K"] for w in waves) >= 8
+        # timestamps: monotonic, non-overlapping, inside [submit, finish]
+        seq = [s for s in tl["spans"] if not s["name"].startswith("journal")]
+        finish = [e for e in tl["events"] if e["name"] == "finish"]
+        assert len(finish) == 1
+        assert seq[0]["t0"] >= 0.0  # nothing precedes submit
+        for s in seq:
+            assert s["t1"] >= s["t0"]
+        for a, b in zip(seq, seq[1:]):
+            assert b["t0"] >= a["t1"] - 1e-9, (a, b)
+        assert finish[0]["t"] >= seq[-1]["t1"] - 1e-9
+
+        # the same request also appears in the Chrome bulk export
+        with urllib.request.urlopen(f"{base}/debug/trace?last=100",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        lanes = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["args"]["name"] == f"req {uid}"]
+        assert lanes
+
+        # ... and /metrics scrapes Prometheus-parseable with non-empty
+        # TTFT / inter-token histograms from the same traffic
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode("utf-8")
+        samples = _parse_prometheus(text)
+        assert samples["ds_ttft_seconds_count"] >= 1
+        assert samples["ds_inter_token_seconds_count"] >= 5
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        sched.stop()
+
+
+_PROM_LINE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?\s+\S+$')
+
+
+def _parse_prometheus(text):
+    """Line-validating parse: {sample name (labels stripped): value}."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), line
+        name, _, val = line.partition(" ")
+        samples[name.split("{")[0]] = float(val)
+    return samples
+
+
+@pytest.mark.slow
+def test_metrics_endpoint_exact_counts(eng):
+    """GET /metrics carries exact lifecycle counts for a known traffic
+    pattern (the fast path's parseability is asserted in the
+    reconstruction test above)."""
+    sched = _sched(eng).start()
+    httpd = create_http_server(sched, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        rng = np.random.default_rng(3)
+        for h in [sched.submit(_prompt(rng, 6), max_new_tokens=5)
+                  for _ in range(2)]:
+            h.result(120)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode("utf-8")
+        samples = _parse_prometheus(text)
+        assert samples["ds_ttft_seconds_count"] == 2
+        assert samples["ds_inter_token_seconds_count"] == 8
+        assert samples["ds_requests_finished_total"] == 2
+        assert samples["ds_tokens_emitted_total"] == 10
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        sched.stop()
+
+
+def test_profile_endpoint_guarded(eng):
+    """POST /debug/profile: starts a bounded capture, answers 409 while
+    one runs, stop ends it. Profiler fns are stubbed — no real capture."""
+    sched = _sched(eng).start()
+    prof = sched.observability.profiler
+    prof._start_fn = lambda d: None
+    prof._stop_fn = lambda: None
+    httpd = create_http_server(sched, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, out = post("/debug/profile", {"seconds": 30})
+        assert code == 200 and out["status"] == "started"
+        assert out["seconds"] == 30.0
+        code, _ = post("/debug/profile", {"seconds": 1})
+        assert code == 409
+        code, out = post("/debug/profile/stop", {})
+        assert code == 200 and out["status"] == "stopped"
+        code, out = post("/debug/profile/stop", {})
+        assert code == 200 and out["status"] == "idle"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        sched.stop()
+
+
+def test_observability_disabled_degrades_to_404(eng):
+    """instruments=False (or ``observability: {enabled: false}``) removes
+    the endpoints: /metrics, traces, and profile answer 404; /health and
+    /generate keep working without histogram keys."""
+    sched = _sched(eng, instruments=False).start()
+    assert sched.observability is None
+    httpd = create_http_server(sched, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        h = sched.submit(list(range(5)), max_new_tokens=3)
+        h.result(120)
+        assert "ttft_p50_s" not in sched.stats
+        for path in ("/metrics", "/debug/trace",
+                     f"/requests/{h._req.uid}/trace"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}{path}", timeout=10)
+            assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        sched.stop()
+
+
+# ----------------------------------------------- instrument-level units
+
+
+def test_replayed_requests_stay_out_of_ttft_and_e2e():
+    obs = _private_instruments()
+    obs.request_submitted(1, 0.0)
+    obs.first_token(0.0, 1.5, replayed=True)
+    obs.request_finished(1, 0.0, 2.0, "ok", 5, replayed=True)
+    assert obs.ttft.count == 0 and obs.e2e.count == 0
+    assert obs.finished.value == 1
+    obs.first_token(0.0, 0.5, replayed=False)
+    obs.request_finished(1, 0.0, 1.0, "ok", 5, replayed=False)
+    assert obs.ttft.count == 1 and obs.e2e.count == 1
+
+
+def test_outcome_counter_routing():
+    obs = _private_instruments()
+    for uid, outcome in enumerate(("ok", "cancelled", "expired", "error")):
+        obs.request_submitted(uid, 0.0)
+        obs.request_finished(uid, 0.0, 1.0, outcome, 0, replayed=False)
+    assert obs.finished.value == 1
+    assert obs.cancelled.value == 1
+    assert obs.expired.value == 1
+    assert obs.errored.value == 1  # expired is NOT double-counted as error
+    tl = obs.tracer.timeline("2")
+    assert tl["events"][-1]["args"]["outcome"] == "expired"

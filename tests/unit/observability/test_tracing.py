@@ -1,0 +1,178 @@
+"""Request tracer: ring wraparound bounds, timeline export, Chrome
+trace_event schema, and the profiler guard."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.observability import (ProfilerBusy, ProfilerCapture,
+                                         RequestTracer, get_tracer,
+                                         profile_dir)
+
+
+# ---------------------------------------------------------------- rings
+
+
+def test_timeline_ring_evicts_oldest_request():
+    tr = RequestTracer(max_requests=3)
+    for i in range(5):
+        tr.begin(str(i), t_submit=float(i))
+    assert not tr.has("0") and not tr.has("1")
+    assert all(tr.has(str(i)) for i in (2, 3, 4))
+
+
+def test_begin_is_idempotent_and_keeps_spans():
+    tr = RequestTracer()
+    tr.begin("7", t_submit=1.0)
+    tr.span("7", "queue", 1.0, 2.0)
+    tr.begin("7", t_submit=99.0)  # replay re-begin: same timeline
+    tl = tr.timeline("7")
+    assert tl["t_submit_monotonic"] == 1.0
+    assert [s["name"] for s in tl["spans"]] == ["queue"]
+
+
+def test_span_ring_wraparound_keeps_most_recent():
+    tr = RequestTracer(max_spans_per_request=4)
+    tr.begin("1", t_submit=0.0)
+    for i in range(10):
+        tr.span("1", f"s{i}", float(i), float(i) + 0.5)
+    names = [s["name"] for s in tr.timeline("1")["spans"]]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_wave_ring_bound_and_last_filter():
+    tr = RequestTracer(max_waves=8)
+    for i in range(20):
+        tr.global_span("wave", float(i), float(i) + 0.1, args={"K": i})
+    waves = [e for e in tr.chrome_trace()["traceEvents"]
+             if e.get("ph") == "X"]
+    assert len(waves) == 8
+    assert waves[0]["args"]["K"] == 12  # oldest retained
+    assert len([e for e in tr.chrome_trace(last=3)["traceEvents"]
+                if e.get("ph") == "X"]) == 3
+
+
+def test_span_on_unknown_uid_is_a_noop():
+    tr = RequestTracer()
+    tr.span("ghost", "x", 0.0, 1.0)
+    tr.event("ghost", "x")
+    tr.finish("ghost")
+    assert tr.timeline("ghost") is None
+
+
+# ------------------------------------------------------------- timeline
+
+
+def test_timeline_relative_times_sorted_and_done():
+    tr = RequestTracer()
+    tr.begin("5", t_submit=10.0)
+    tr.span("5", "late", 12.0, 13.0, args={"K": 4})
+    tr.span("5", "early", 10.0, 11.5)
+    tr.event("5", "note", t=11.0)
+    tr.finish("5", t=13.0)
+    tl = tr.timeline("5")
+    assert tl["done"] is True
+    assert [s["name"] for s in tl["spans"]] == ["early", "late"]
+    s = tl["spans"][1]
+    assert s["t0"] == pytest.approx(2.0) and s["t1"] == pytest.approx(3.0)
+    assert s["dur_s"] == pytest.approx(1.0)
+    assert s["t0_monotonic"] == 12.0
+    assert s["args"] == {"K": 4}
+    assert [e["name"] for e in tl["events"]] == ["note", "finish"]
+
+
+def test_global_span_mirrors_onto_member_timelines():
+    tr = RequestTracer()
+    tr.begin("a", t_submit=0.0)
+    tr.begin("b", t_submit=0.0)
+    tr.global_span("fused_wave[greedy]", 1.0, 2.0,
+                   args={"K": 8, "size": 2}, uids=["a", "b", "ghost"])
+    for uid in ("a", "b"):
+        spans = tr.timeline(uid)["spans"]
+        assert [s["name"] for s in spans] == ["fused_wave[greedy]"]
+        assert spans[0]["args"]["K"] == 8
+
+
+# --------------------------------------------------------- chrome trace
+
+
+def test_chrome_trace_schema():
+    """Every event must satisfy the trace_event contract Perfetto
+    requires: ph/pid/tid always, X events carry numeric ts+dur (µs),
+    M events name the lane, i events carry a scope; JSON-serializable."""
+    tr = RequestTracer()
+    tr.begin("9", t_submit=100.0)
+    tr.span("9", "prefill", 100.0, 100.5, args={"tokens": 64})
+    tr.event("9", "finish", t=101.0)
+    tr.global_span("wave", 100.1, 100.2, args={"K": 4}, uids=["9"])
+    doc = tr.chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    json.dumps(doc)  # serializable end-to-end
+    assert {e["ph"] for e in evs} == {"X", "M", "i"}
+    for e in evs:
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], float) and e["dur"] >= 0
+        elif e["ph"] == "M":
+            assert e["name"] == "thread_name" and "name" in e["args"]
+        elif e["ph"] == "i":
+            assert e["s"] in ("t", "p", "g")
+    # the daemon lane is tid 0; request lanes start at 1
+    assert any(e["tid"] == 0 and e["ph"] == "X" for e in evs)
+    lanes = {e["tid"] for e in evs if e["ph"] == "M"}
+    assert lanes == {1}
+
+
+def test_reset_and_global_singleton():
+    tr = RequestTracer()
+    tr.begin("1")
+    tr.global_span("w", 0.0, 1.0)
+    tr.reset()
+    assert not tr.has("1")
+    assert tr.chrome_trace()["traceEvents"] == []
+    assert get_tracer() is get_tracer()
+
+
+# ------------------------------------------------------------- profiler
+
+
+def test_profiler_capture_guard(tmp_path):
+    calls = []
+    cap = ProfilerCapture(directory=str(tmp_path), max_seconds=30.0,
+                          start_fn=lambda d: calls.append(("start", d)),
+                          stop_fn=lambda: calls.append(("stop", )))
+    info = cap.start(seconds=600.0)  # clamped to max_seconds
+    assert info["seconds"] == 30.0
+    assert cap.active
+    with pytest.raises(ProfilerBusy):
+        cap.start(seconds=1.0)
+    ended = cap.stop()
+    assert ended["dur_s"] >= 0
+    assert not cap.active
+    assert cap.stop() is None  # idempotent: timer/explicit race is benign
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert cap.captures == 1
+
+
+def test_profiler_timer_autostops(tmp_path):
+    import time
+    calls = []
+    cap = ProfilerCapture(directory=str(tmp_path),
+                          start_fn=lambda d: calls.append("start"),
+                          stop_fn=lambda: calls.append("stop"))
+    cap.start(seconds=0.05)
+    deadline = time.monotonic() + 5.0
+    while cap.active and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert calls == ["start", "stop"]
+
+
+def test_profile_dir_resolution(tmp_path, monkeypatch):
+    assert profile_dir("/x/y") == "/x/y"
+    monkeypatch.setenv("DS_TPU_PROFILE_DIR", str(tmp_path / "env"))
+    assert profile_dir(None) == str(tmp_path / "env")
+    monkeypatch.delenv("DS_TPU_PROFILE_DIR")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    assert profile_dir(None) == str(tmp_path / "xdg" / "deepspeed_tpu"
+                                    / "profiles")
