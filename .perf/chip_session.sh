@@ -193,6 +193,11 @@ print("observability: scrape ok, overhead "
       f"{r['tok_s_observability_off']} tok/s), "
       f"ttft hist p50/p99 {r['ttft_hist_p50_s']}/{r['ttft_hist_p99_s']}s")
 PYEOF
+# 15j. quantized TP serving: tp=2 in a forced-host-device child, A/B over
+# {fp, int8} collective wire x {bf16, int8-WoQ} weights — tok/s, per-step
+# wire bytes, max |dlogit| vs fp wire; the >=3x wire-byte reduction is a
+# hard assert inside the rung on the fp32-activation arm
+run bench_serving_tp 1500 env DS_BENCH_TP=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_TP.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

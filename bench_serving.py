@@ -115,6 +115,14 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                                          new_tokens=4 * decode_steps,
                                          window=FUSED_K if on_tpu else 4,
                                          token_budget=256 if on_tpu else 96))
+    # DS_BENCH_TP=1: quantized tensor-parallel serving — tp=2 in a CHILD
+    # process over forced host devices (the parent's jax is already
+    # committed to its own device set), A/B over {fp, int8} collective
+    # wire x {bf16, int8-WoQ} weights: tok/s, per-step wire bytes, and
+    # max |dlogit| vs the fp-wire reference; the >=3x wire-byte reduction
+    # is asserted in the child on the fp32-activation arm
+    if env_flag("DS_BENCH_TP"):
+        results.extend(_measure_tp())
     # DS_BENCH_MOE=1: Mixtral-style expert-parallel decode through the v2
     # engine (ops/grouped_matmul in the ragged forward) — tok/s +
     # decode_step_ms like the dense rungs, so MoE serving regressions are
@@ -946,6 +954,92 @@ def _measure_prefix_caching(cfg, ctx, kv_block, backend):
     return rows
 
 
+def _measure_tp():
+    """Parent half of the DS_BENCH_TP rung: run the tp=2 A/B grid in a
+    subprocess whose env forces 8 virtual host devices (this process's jax
+    backend is already initialized and cannot re-shape its device set), and
+    collect the child's JSON rows from its last stdout line."""
+    import subprocess
+    import sys
+    from deepspeed_tpu.utils.hostdev import force_host_devices_env
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = force_host_devices_env(8, extra={"PYTHONPATH": repo,
+                                           "DS_BENCH_TP_CHILD": "1"})
+    out = subprocess.run([sys.executable,
+                          os.path.join(repo, "bench_serving.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=1200)
+    if out.returncode != 0:
+        return [{"rung": "tp", "error": (out.stderr or out.stdout)[-800:]}]
+    return json.loads(out.stdout.splitlines()[-1])
+
+
+def _measure_tp_child():
+    """Child half of DS_BENCH_TP (runs at the forced 8-device count): serve
+    a tiny model at tp=2 through the v2 engine for every {weights} x {wire}
+    arm. Weights arms: bf16 dense, and int8-WoQ at fp32 activations — the
+    fp32 arm is where the blockwise-int8 wire's >=3x byte reduction is a
+    hard assert (at bf16 activations the bound is ~1.94x by arithmetic:
+    1 code byte + scale overhead vs 2 activation bytes)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import LlamaConfig
+
+    cfg = LlamaConfig.tiny(max_position_embeddings=2048)
+    # batch 16: a decode step then feeds 16*hidden = 1024 wire elements —
+    # a whole multiple of tp*wire_block, so the per-step byte accounting
+    # reflects the steady state instead of one block's tail padding
+    prompts = [[(i * 7 + j) % (cfg.vocab_size - 1) + 1 for j in range(48)]
+               for i in range(16)]
+    probe = [p[:8] for p in prompts[:2]]
+    new_tokens = 32
+    rows, refs = [], {}
+    arms = (("bf16", None, jnp.bfloat16), ("int8-woq", "int8", jnp.float32))
+    for weights, quantize, dtype in arms:
+        for wire in ("fp", "int8"):
+            reset_mesh_context()
+            ec = RaggedInferenceEngineConfig(
+                tensor_parallel={"tp_size": 2, "tp_wire_dtype": wire})
+            kw = {"quantize": quantize} if quantize else {}
+            eng = build_llama_engine(cfg, seed=3, dtype=dtype,
+                                     engine_config=ec, **kw)
+            logits = np.asarray(eng.put([0, 1], [list(p) for p in probe]),
+                                np.float32)[:2]
+            for u in (0, 1):
+                eng.flush(u)
+            refs.setdefault(weights, logits)
+            dmax = float(np.max(np.abs(logits - refs[weights])))
+
+            eng.generate(prompts, max_new_tokens=4, fused_decode_window=4)
+            t0 = time.perf_counter()
+            out = eng.generate(prompts, max_new_tokens=new_tokens,
+                               fused_decode_window=4)
+            dt = time.perf_counter() - t0
+            n_tok = sum(len(o) for o in out)
+            # one decode step feeds len(prompts) tokens through the wire
+            cost = eng.model().tp_wire_cost(len(prompts))
+            ratio = (cost["fp_equiv"] / cost["moved"]
+                     if cost["moved"] else 1.0)
+            rows.append({"rung": "tp", "tp": 2, "weights": weights,
+                         "wire": wire,
+                         "act_dtype": jnp.dtype(dtype).name,
+                         "decode_tok_s": round(n_tok / dt, 2),
+                         "wire_bytes_per_step": int(cost["moved"]),
+                         "wire_bytes_fp_equiv": int(cost["fp_equiv"]),
+                         "wire_ratio": round(ratio, 2),
+                         "max_abs_dlogit_vs_fp_wire": round(dmax, 5)})
+            if weights == "int8-woq" and wire == "int8":
+                # the acceptance bound: fp32-activation arm saves >=3x
+                assert ratio >= 3.0, \
+                    f"int8 wire ratio {ratio:.2f} < 3.0 on fp32 arm"
+            if weights == "bf16" and wire == "int8":
+                rows[-1]["note"] = ("bf16 activations bound the wire "
+                                    "ratio near 2x by arithmetic")
+    return rows
+
+
 def _vs_baseline(results):
     """NUMERIC paged-vs-dense ratio scored against the FastGen 2.3x bar, so
     a serving regression is machine-checkable round-over-round instead of a
@@ -972,6 +1066,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_SERVING.json")
     args = ap.parse_args()
+    from bench import env_flag
+    if env_flag("DS_BENCH_TP_CHILD"):
+        # forced-host-device child of the DS_BENCH_TP rung: emit rows as
+        # the last stdout line and skip the normal sweep entirely
+        print(json.dumps(_measure_tp_child()))
+        return 0
     import jax
     platform = jax.devices()[0].platform
     platform = "tpu" if platform in ("tpu", "axon") else platform

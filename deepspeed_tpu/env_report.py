@@ -108,6 +108,21 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"continuous fused serving {'.' * 24} {NO} ({e})")
     try:
+        # quantized TP serving: the resolved collective wire dtype (with its
+        # precedence source — explicit config > DS_TPU_TP_WIRE env >
+        # default) and whether WoQ×TP sharded kernels are available
+        from .parallel.tp import resolve_tp_wire
+        wire, source = resolve_tp_wire()
+        base = wire["attn_out"]
+        note = "" if wire["lm_head"] == base else " (lm_head fp)"
+        lines.append(f"tp collective wire dtype {'.' * 24} "
+                     f"{base}{note} [source: {source}]")
+        from .inference.v2.model import check_woq_tp_support  # noqa: F401
+        lines.append(f"woq x tp sharded kernels {'.' * 24} "
+                     f"available (int8/int4/fp6 shard-major)")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"tp collective wire dtype {'.' * 24} {NO} ({e})")
+    try:
         # durable serving: where the write-ahead request journal would land
         # (env/XDG resolution) and whether that directory is writable — the
         # first thing to check when warm restart isn't replaying anything

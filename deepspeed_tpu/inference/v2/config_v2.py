@@ -307,6 +307,37 @@ class QuantizationConfig(ConfigModel):
 
 class TensorParallelConfig(ConfigModel):
     tp_size: int = 1
+    # Wire dtype for the per-layer TP output collectives: None defers to
+    # the DS_TPU_TP_WIRE env then the "fp" default (parallel/tp.py
+    # resolve_tp_wire precedence ladder); "fp" keeps the bit-identical
+    # implicit-GSPMD psum, "int8" runs the explicit blockwise-int8
+    # reduce-scatter → all-gather two-step from comm/bucketing.py.
+    tp_wire_dtype: Optional[str] = None
+    # quantization block for the int8 wire (elements per fp32 scale+zero)
+    tp_wire_block: int = 256
+    # per-layer-class wire overrides, e.g. {"lm_head": "fp"} — classes are
+    # parallel/tp.TP_WIRE_CLASSES ("attn_out", "mlp_out", "lm_head")
+    tp_wire_overrides: dict = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _check(self):
+        from ...parallel.tp import TP_WIRE_CLASSES, TP_WIRE_DTYPES
+        if self.tp_wire_dtype is not None and \
+                self.tp_wire_dtype not in TP_WIRE_DTYPES:
+            raise ValueError(f"tp_wire_dtype must be one of {TP_WIRE_DTYPES} "
+                             f"(or None to defer to env), got "
+                             f"{self.tp_wire_dtype!r}")
+        if self.tp_wire_block < 2:
+            raise ValueError("tp_wire_block must be >= 2, got "
+                             f"{self.tp_wire_block}")
+        for cls, val in self.tp_wire_overrides.items():
+            if cls not in TP_WIRE_CLASSES:
+                raise ValueError(f"unknown tp_wire_overrides class {cls!r}; "
+                                 f"expected one of {TP_WIRE_CLASSES}")
+            if val not in TP_WIRE_DTYPES:
+                raise ValueError(f"tp_wire_overrides[{cls!r}] must be one of "
+                                 f"{TP_WIRE_DTYPES}, got {val!r}")
+        return self
 
 
 class RaggedInferenceEngineConfig(ConfigModel):

@@ -1728,9 +1728,13 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
         quantize = mapped
     if params is None:
         _, params = init_llama(config, seed=seed)
+    tp_cfg = engine_config.tensor_parallel
     model = RaggedLlamaModel(config, params, dtype=dtype or jnp.bfloat16,
                              kv_block_size=kv_block_size, quantize=quantize,
                              attn_backend=attn_backend,
                              kv_cache_dtype=kv_cache_dtype,
-                             tp_size=engine_config.tensor_parallel.tp_size)
+                             tp_size=tp_cfg.tp_size,
+                             tp_wire_dtype=tp_cfg.tp_wire_dtype,
+                             tp_wire_overrides=tp_cfg.tp_wire_overrides,
+                             tp_wire_block=tp_cfg.tp_wire_block)
     return InferenceEngineV2(model, engine_config)

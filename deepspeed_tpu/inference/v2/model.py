@@ -41,6 +41,7 @@ except ImportError:  # pragma: no cover — older jax
 
 from .config_v2 import KVCacheConfig
 from ...models.llama import LlamaConfig, precompute_rope
+from ...observability import get_registry
 from ...ops.normalization import rms_norm
 from ...ops.paged_attention import paged_attention
 from ...ops.grouped_matmul import moe_grouped_mlp
@@ -48,12 +49,108 @@ from .ragged.ragged_wrapper import RaggedBatch
 from .ragged.sequence_descriptor import BaseSequenceDescriptor
 from ...ops.registry import on_tpu
 
+_obs = get_registry()
+_tp_wire_moved = _obs.counter(
+    "ds_tp_wire_bytes_moved_total",
+    "Receive-side interconnect bytes moved by the per-layer TP output "
+    "collectives (reduce-scatter + all-gather two-step, or the "
+    "plain-precision psum equivalent when the wire is fp)")
+_tp_wire_saved = _obs.counter(
+    "ds_tp_wire_bytes_saved_total",
+    "Interconnect bytes saved by the blockwise-int8 TP wire vs moving the "
+    "same activations at their compute dtype")
+
 
 def _kernel(d):
     """Weight accessor: dequantizes WoQ kernels in-graph (XLA fuses the
     dequant into the consuming matmul; HBM holds int8)."""
     k = d["kernel"]
     return k.dequantized() if hasattr(k, "dequantized") else k
+
+
+def check_woq_tp_support(config: LlamaConfig, quantize, tp_size: int,
+                         group_size: int = 512) -> dict:
+    """Capability check for weight-quantization × tensor-parallel combos.
+
+    Replaces the former blanket mutual exclusion: packed kernels + their
+    per-block scales now shard shard-major along the AutoTP dims, so only
+    genuinely unsupported combos are refused — packing granularities the
+    quantizer cannot honor, or a combo where NO kernel is shardable (which
+    would silently serve a fully-replicated "TP" engine, the failure mode
+    the old ValueError guarded against). Kernels that are individually
+    non-divisible simply replicate, matching the fp heuristics.
+
+    Returns ``{kernel class: shardable}`` (empty when the combo is trivially
+    fine, i.e. no quantization or tp_size == 1); raises ``ValueError`` with
+    an actionable message naming the combo otherwise.
+    """
+    if quantize is None or tp_size <= 1:
+        return {}
+    combo = f"quantize={quantize!r} x tp={tp_size}"
+    if quantize == "int4" and group_size % 2:
+        raise ValueError(
+            f"unsupported combo {combo}: int4 nibble-packing needs an even "
+            f"quantization group_size, got {group_size}")
+    if quantize == "fp6" and group_size % 4:
+        raise ValueError(
+            f"unsupported combo {combo}: fp6 e3m2 packs 4 codes per 3 bytes "
+            f"and needs group_size % 4 == 0, got {group_size}")
+    hd, nq, nkv = (config.head_dim_, config.num_attention_heads,
+                   config.num_key_value_heads)
+    shardable = {
+        "q_proj/o_proj": (nq * hd) % tp_size == 0,
+        "k_proj/v_proj": (nkv * hd) % tp_size == 0,
+        "mlp": (config.num_local_experts == 0
+                and config.intermediate_size % tp_size == 0),
+    }
+    if not any(shardable.values()):
+        raise ValueError(
+            f"unsupported combo {combo}: no quantized kernel is shardable "
+            f"(attn q/o dim {nq * hd}, k/v dim {nkv * hd}, mlp intermediate "
+            f"{config.intermediate_size}"
+            + (" [MoE experts replicate under TP]"
+               if config.num_local_experts else "")
+            + f" — none divisible by tp={tp_size}), so every chip would hold "
+            f"the full quantized model: a silently-replicated 'TP' engine. "
+            f"Pick a tp_size dividing the head or MLP dims, or serve "
+            f"unquantized.")
+    return shardable
+
+
+def _tp_wire_matmul(x, w, mesh, block: int):
+    """Row-parallel output projection with an EXPLICIT quantized-wire
+    reduction: local partial matmul → fp32 → blockwise-int8
+    reduce-scatter → blockwise-int8 all-gather (comm/bucketing.py wire
+    kernels), replacing the plain-precision psum GSPMD would insert. The
+    all-gather dequant is deterministic, so every worker reconstructs the
+    identical full output — activations stay replicated downstream exactly
+    like the implicit path. Quantization residual is dropped (serving has
+    no cross-step error-feedback channel).
+
+    ``x`` [T, K] activations (K = the sharded contraction dim), ``w``
+    [K, M] row-sharded kernel. Caller guarantees ``K % tp == 0``.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ...comm.bucketing import all_gather_bucket, reduce_scatter_bucket
+    T, K = x.shape
+    M = w.shape[-1]
+    n = T * M
+    tp = mesh.shape["model"]
+    pad = (-n) % (tp * block)
+
+    def _local(x_l, w_l):
+        part = (x_l @ w_l).astype(jnp.float32).reshape(-1)
+        if pad:
+            part = jnp.concatenate([part, jnp.zeros((pad, ), jnp.float32)])
+        shard, _ = reduce_scatter_bucket(part, ("model", ), tier="int8",
+                                         block_size=block)
+        full = all_gather_bucket(shard, ("model", ), tier="int8",
+                                 block_size=block)
+        return full[:n].reshape(T, M)
+
+    out = _smap(_local, mesh, (P(None, "model"), P("model", None)),
+                P(None, None), {"model"})(x, w)
+    return out.astype(x.dtype)
 
 
 def _rope_tok(x, cos, sin, positions, rotary_dim=None, interleaved=False):
@@ -98,21 +195,25 @@ def _norm_tok(x, p, cfg):
     return rms_norm(x, w, cfg.rms_norm_eps)
 
 
-def _mlp_tok(x, lp, cfg):
-    """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc."""
+def _mlp_tok(x, lp, cfg, row_out=None):
+    """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc.
+    ``row_out(y, kernel, cls)`` routes the row-parallel down-projection —
+    the TP wire hook; None = the plain matmul."""
+    mm = row_out or (lambda y, k, cls: y @ k)
     mlp = lp["mlp"]
     if cfg.mlp_type in ("swiglu", "geglu_tanh"):
         pre = x @ _kernel(mlp["gate_proj"])
         gate = (jax.nn.silu(pre) if cfg.mlp_type == "swiglu"
                 else jax.nn.gelu(pre, approximate=True))
-        return (gate * (x @ _kernel(mlp["up_proj"]))) @ _kernel(mlp["down_proj"])
+        return mm(gate * (x @ _kernel(mlp["up_proj"])),
+                  _kernel(mlp["down_proj"]), "mlp_out")
     act = {"gelu_fc": lambda y: jax.nn.gelu(y, approximate=False),
            "gelu_tanh_fc": lambda y: jax.nn.gelu(y, approximate=True),
            "relu_fc": jax.nn.relu}[cfg.mlp_type]
     h = x @ _kernel(mlp["fc1"])
     if "bias" in mlp["fc1"]:
         h = h + mlp["fc1"]["bias"]
-    out = act(h) @ _kernel(mlp["fc2"])
+    out = mm(act(h), _kernel(mlp["fc2"]), "mlp_out")
     if "bias" in mlp["fc2"]:
         out = out + mlp["fc2"]["bias"]
     return out
@@ -123,7 +224,10 @@ class RaggedLlamaModel:
 
     def __init__(self, config: LlamaConfig, params, dtype=jnp.bfloat16, kv_block_size: int = 64,
                  attn_backend: str = "auto", quantize=None, tp_size: int = 1,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 tp_wire_dtype: Optional[str] = None,
+                 tp_wire_overrides: Optional[dict] = None,
+                 tp_wire_block: int = 256):
         self.config = config
         self.dtype = dtype
         self.kv_block_size = kv_block_size
@@ -140,12 +244,21 @@ class RaggedLlamaModel:
         self._kv_cache_dtype = kv_cache_dtype
         self.tp_size = int(tp_size or 1)
         self._kv_pad = 0  # KV-head padding for nondivisible GQA under TP
-        if self.tp_size > 1 and quantize is not None:
-            # packed WoQ kernels have collapsed shapes the TP heuristics
-            # cannot row/col-shard — refuse loudly rather than serve a
-            # silently-replicated "TP" engine
-            raise ValueError("tensor_parallel serving does not compose with "
-                             "weight quantization yet; pick one")
+        if quantize is not None:
+            from ...linear.config import QuantizationConfig as _QC
+            check_woq_tp_support(config, quantize, self.tp_size,
+                                 _QC().group_size)
+        # TP collective wire: explicit tp_wire_dtype > DS_TPU_TP_WIRE env >
+        # default "fp" (the bit-identical GSPMD path). Resolved per layer
+        # class; an all-fp map leaves the traced program literally untouched.
+        from ...parallel.tp import resolve_tp_wire
+        self._tp_wire, self._tp_wire_source = resolve_tp_wire(
+            tp_wire_dtype, tp_wire_overrides)
+        self._wire_block = int(tp_wire_block or 256)
+        self._wire_static = (tuple(sorted(self._tp_wire.items()))
+                             if self.tp_size > 1 and any(
+                                 v == "int8" for v in self._tp_wire.values())
+                             else None)
         # "paged" = Pallas blocked-flash decode kernel (TPU; interpret-mode on
         # CPU), "dense" = XLA gather of the full history window, "auto" =
         # paged on TPU, dense elsewhere (interpret mode is a numerics tool,
@@ -231,28 +344,56 @@ class RaggedLlamaModel:
             # WoQ (reference inference/v2 mixed_gemm + linear/quantization):
             # per-layer matmul weights stored packed (int8 / fp6-e3m2 /
             # int4) + scales, dequantized in-graph. Router gates / norms /
-            # embeddings / lm_head stay fp.
+            # embeddings / lm_head stay fp. Under TP the packed values AND
+            # per-block scales are laid out SHARD-MAJOR along the same
+            # model-axis dim the AutoTP heuristics pick for the fp kernel
+            # (parallel/tp.woq_shard_dim), each shard quantized
+            # independently so no block crosses a shard boundary — a chip
+            # holds 1/tp of the quantized bytes and dequantizes its own
+            # segment locally in-graph. Kernels the heuristics would not
+            # shard (MoE experts, non-divisible dims) stay flat+replicated.
             from ...linear.config import QuantizationConfig
             from ...linear.quantization import QuantizedParameter
             qcfg = QuantizationConfig(
                 q_bits={"int8": 8, "fp6": 6, "int4": 4}[quantize])
+            tp = self.tp_size
+            if tp > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from ...parallel.tp import woq_shard_dim
+                sh_shard = NamedSharding(self._mesh_ctx.mesh, P("model"))
+                sh_repl = NamedSharding(self._mesh_ctx.mesh, P())
+
+            def _quantize_one(w, path):
+                sd = woq_shard_dim(path, w.shape, tp) if tp > 1 else None
+                qp = QuantizedParameter.quantize(
+                    w, qcfg, shard_dim=sd,
+                    shards=(tp if sd is not None else 1))
+                if tp > 1:
+                    sh = sh_shard if sd is not None else sh_repl
+                    qp = QuantizedParameter(
+                        jax.device_put(qp.values, sh),
+                        jax.device_put(qp.scales, sh),
+                        qp.shape, qp.block_size, qp.dtype, qp.q_bits,
+                        qp.shard_dim, qp.shards)
+                return qp
+
             model_p = self.params["model"]
             for lname, lp in model_p.items():
                 if not lname.startswith("layers_"):
                     continue
-                def _maybe_q(node):
+                def _maybe_q(node, prefix):
                     for key, sub in list(node.items()):
                         if key in ("gate", "shared_expert_gate"):
                             continue
                         if isinstance(sub, dict):
                             if "kernel" in sub and getattr(sub["kernel"], "ndim", 0) >= 2:
-                                sub["kernel"] = QuantizedParameter.quantize(
-                                    sub["kernel"], qcfg)
+                                sub["kernel"] = _quantize_one(
+                                    sub["kernel"], f"{prefix}/{key}/kernel")
                             else:
-                                _maybe_q(sub)
+                                _maybe_q(sub, f"{prefix}/{key}")
                         elif key in ("w1", "w2", "w3") and getattr(sub, "ndim", 0) >= 2:
-                            node[key] = QuantizedParameter.quantize(sub, qcfg)
-                _maybe_q(lp)
+                            node[key] = _quantize_one(sub, f"{prefix}/{key}")
+                _maybe_q(lp, lname)
         # unembed in fp32 (reference keeps logits fp32; lm_head lives under
         # "model" in the training tree)
         if "lm_head" in params.get("model", {}):
@@ -346,6 +487,60 @@ class RaggedLlamaModel:
     def prepare_batch(self, batch) -> None:
         pass
 
+    # ---- TP wire accounting (host-side static arithmetic) ----
+
+    def tp_wire_cost(self, n_tokens: int) -> dict:
+        """Receive-side interconnect bytes for ONE forward feeding
+        ``n_tokens`` tokens through the per-layer TP output collectives.
+        Pure host arithmetic mirroring the traced program (the in-graph
+        collective can't count itself): per wired row-parallel matmul of
+        ``n = n_tokens * hidden`` output elements over a tp-worker ring,
+        both collectives of the two-step move ``2*(tp-1)/tp`` of the wire
+        array remotely — int8 wire = codes (1 B/elem on the padded length)
+        + fp32 scale and zero per ``wire_block``; the fp equivalent moves
+        the partial sums at the activation dtype. Returns
+        ``{"moved", "fp_equiv", "saved"}`` in bytes.
+        """
+        if self.tp_size <= 1:
+            return {"moved": 0, "fp_equiv": 0, "saved": 0}
+        cfg, tp, block = self.config, self.tp_size, self._wire_block
+        itemsize = jnp.dtype(self.dtype).itemsize
+        factor = 2.0 * (tp - 1) / tp
+        classes = []
+        if (cfg.num_attention_heads * cfg.head_dim_) % tp == 0:
+            classes.append("attn_out")
+        if cfg.num_local_experts == 0 and cfg.intermediate_size % tp == 0:
+            classes.append("mlp_out")
+        moved = fp_equiv = 0.0
+        for cls in classes:
+            n = n_tokens * cfg.hidden_size
+            fp_n = factor * n * itemsize
+            if self._tp_wire.get(cls) == "int8":
+                n_tot = n + ((-n) % (tp * block))
+                m = factor * (n_tot + 8 * (n_tot // block))
+            else:
+                m = fp_n
+            moved += m * cfg.num_hidden_layers
+            fp_equiv += fp_n * cfg.num_hidden_layers
+        return {"moved": int(moved), "fp_equiv": int(fp_equiv),
+                "saved": int(max(0.0, fp_equiv - moved))}
+
+    def _bump_wire_counters(self, n_tokens: int) -> None:
+        if self.tp_size <= 1:
+            return
+        cost = self.tp_wire_cost(n_tokens)
+        if cost["moved"]:
+            _tp_wire_moved.inc(cost["moved"])
+        if cost["saved"]:
+            _tp_wire_saved.inc(cost["saved"])
+        from ...comm.comms_logging import get_comms_logger
+        cl = get_comms_logger()
+        if cl.enabled and cost["moved"]:
+            tier = ("int8" if any(v == "int8"
+                                  for v in self._tp_wire.values()) else "fp")
+            cl.append("all_reduce", f"tp_wire[{tier}]", 0.0, cost["moved"],
+                      n_participants=self.tp_size)
+
     # ---- forward ----
 
     def forward(self, batch: RaggedBatch, window_logits: bool = False) -> jax.Array:
@@ -368,6 +563,8 @@ class RaggedLlamaModel:
                                  attn_backend=self.attn_backend,
                                  tp_size=self.tp_size,
                                  kv_pad=self._kv_pad,
+                                 tp_wire=self._wire_static,
+                                 wire_block=self._wire_block,
                                  window_logits=window_logits,
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
@@ -375,6 +572,7 @@ class RaggedLlamaModel:
             self._fwd_cache[key] = fn
         logits, new_cache = fn(self.params, kv.cache, batch)
         kv.update(new_cache)
+        self._bump_wire_counters(batch.tokens.shape[0])
         return logits
 
     def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int,
@@ -440,6 +638,8 @@ class RaggedLlamaModel:
                                  attn_backend=self.attn_backend,
                                  tp_size=self.tp_size,
                                  kv_pad=self._kv_pad,
+                                 tp_wire=self._wire_static,
+                                 wire_block=self._wire_block,
                                  total_slots=total_slots,
                                  n_steps=n_steps,
                                  sample=sampling is not None,
@@ -454,6 +654,7 @@ class RaggedLlamaModel:
         if sampling is None:
             out, new_cache = fn(*args)
             kv.update(new_cache)
+            self._bump_wire_counters(S * n_steps)
             if not fetch:
                 return out
             return np.asarray(out)
@@ -462,6 +663,7 @@ class RaggedLlamaModel:
                  if k not in ("want_logprobs", "use_penalty", "use_eos_mask")}
         out, lps, new_keys, new_cache = fn(*args, **sargs)
         kv.update(new_cache)
+        self._bump_wire_counters(S * n_steps)
         if not fetch:
             return out, lps, new_keys
         out, lps, new_keys = jax.device_get((out, lps, new_keys))
@@ -522,6 +724,8 @@ class RaggedLlamaModel:
                                  attn_backend=self.attn_backend,
                                  tp_size=self.tp_size,
                                  kv_pad=self._kv_pad,
+                                 tp_wire=self._wire_static,
+                                 wire_block=self._wire_block,
                                  total_slots=total_slots,
                                  n_steps=n_steps,
                                  d=draft_width,
@@ -539,6 +743,7 @@ class RaggedLlamaModel:
         if sampling is None:
             out, n_emit, dlen, new_cache = fn(*args)
             kv.update(new_cache)
+            self._bump_wire_counters(S * (1 + draft_width) * n_steps)
             if not fetch:
                 return out, n_emit, dlen, None
             out, n_emit, dlen = jax.device_get((out, n_emit, dlen))
@@ -546,6 +751,7 @@ class RaggedLlamaModel:
         sargs = {k: jnp.asarray(v) for k, v in sampling.items()}
         out, n_emit, dlen, new_keys, new_cache = fn(*args, **sargs)
         kv.update(new_cache)
+        self._bump_wire_counters(S * (1 + draft_width) * n_steps)
         if not fetch:
             return out, n_emit, dlen, new_keys
         out, n_emit, dlen, new_keys = jax.device_get(
@@ -557,6 +763,7 @@ class RaggedLlamaModel:
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
                     tp_size: int = 1, kv_pad: int = 0, mesh=None,
+                    tp_wire=None, wire_block: int = 256,
                     window_logits: bool = False):
     """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
     cfg = config
@@ -609,6 +816,20 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
     # token → (seq, rel) scatter-back indices
     rel = batch.token_pos - batch.seq_seen[batch.token_seq]  # [T]
+
+    # TP wire routing for the row-parallel output projections: a class gated
+    # to "int8" rides the explicit quantized two-step (lives inside whatever
+    # scan calls this forward); "fp" (or no TP) keeps the plain matmul whose
+    # psum GSPMD inserts — byte-identical to the pre-wire program. The
+    # lm_head class is accounted but currently a no-op: the unembed is
+    # replicated, so no TP reduce exists there to quantize.
+    wire = dict(tp_wire) if tp_wire else {}
+
+    def _row_out(y, kern, cls):
+        if (wire.get(cls) == "int8" and tp_size > 1 and mesh is not None
+                and y.shape[-1] % tp_size == 0):
+            return _tp_wire_matmul(y, kern, mesh, wire_block)
+        return y @ kern
 
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
@@ -775,14 +996,15 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         # back to token-major and project out
         ctx_tok = ctx[batch.token_seq, jnp.clip(rel, 0, N - 1)]  # [T, H*D]
-        attn_out = ctx_tok @ _kernel(lp["self_attn"]["o_proj"])
+        attn_out = _row_out(ctx_tok, _kernel(lp["self_attn"]["o_proj"]),
+                            "attn_out")
         if "bias" in lp["self_attn"]["o_proj"]:
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
         def _ffn(h_in):
             """Dense MLP or Mixtral-style MoE block (matches models/llama.py)."""
             if cfg.num_local_experts == 0:
-                return _mlp_tok(h_in, lp, cfg)
+                return _mlp_tok(h_in, lp, cfg, _row_out)
             moe = lp["block_sparse_moe"]
             logits = h_in.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
             probs = jax.nn.softmax(logits, axis=-1)
@@ -850,7 +1072,8 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
                        penalties=None, eos_ids=None, n_out=None, min_new=None,
                        seen_mask=None, *,
                        config, block_size, attn_backend, tp_size, kv_pad,
-                       total_slots, n_steps, mesh, sample=False,
+                       total_slots, n_steps, mesh, tp_wire=None,
+                       wire_block=256, sample=False,
                        want_logprobs=False, use_penalty=False,
                        use_eos_mask=False):
     """K single-token ragged steps under one lax.scan: each iteration builds
@@ -889,7 +1112,7 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
         logits, cache = _ragged_forward(
             params, cache, batch, config=config, block_size=block_size,
             attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
-            mesh=mesh)
+            mesh=mesh, tp_wire=tp_wire, wire_block=wire_block)
         if not sample:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             lps = jnp.zeros(S, jnp.float32)
@@ -929,7 +1152,7 @@ def _fused_spec_decode_loop(params, cache, tokens, seq_lens, live, block_table,
                             keys=None, temps=None, top_ks=None, top_ps=None, *,
                             config, block_size, attn_backend, tp_size, kv_pad,
                             total_slots, n_steps, d, max_ngram, mesh,
-                            sample=False):
+                            tp_wire=None, wire_block=256, sample=False):
     """K speculative windows under one lax.scan — the speculative sibling
     of ``_fused_decode_loop``. Each iteration: draft from the carried
     history ring, build the multi-token RaggedBatch **in-trace** (1+d
@@ -973,7 +1196,8 @@ def _fused_spec_decode_loop(params, cache, tokens, seq_lens, live, block_table,
         logits, cache = _ragged_forward(
             params, cache, batch, config=config, block_size=block_size,
             attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
-            mesh=mesh, window_logits=True)               # [S, 1+d, V]
+            mesh=mesh, tp_wire=tp_wire, wire_block=wire_block,
+            window_logits=True)                          # [S, 1+d, V]
         if sample:
             out, n_emit, keys = dsamp.spec_verify_window(
                 logits, drafts, dlen, keys, temps, top_ks, top_ps, d=d)

@@ -27,31 +27,89 @@ _FMTS = {
 
 
 class QuantizedParameter:
+    """Packed (values, scales) container, optionally *shard-major*.
+
+    ``shards == 1`` is the legacy flat layout: the whole tensor is flattened
+    row-major and block-quantized as one stream. With ``shards == S`` and a
+    ``shard_dim``, the tensor is first permuted so ``shard_dim`` leads, then
+    split into S equal contiguous chunks along it, and each chunk is
+    quantized *independently* (per-chunk tail padding, so no block ever
+    crosses a shard boundary). values/scales stay flat 1-D with S
+    equal-length segments — shardable as ``P("model")`` on dim 0, and each
+    TP worker dequantizes its own segment locally with no neighbor data.
+    """
 
     def __init__(self, values, scales, shape: Tuple[int, ...], block_size: int,
-                 dtype=jnp.bfloat16, q_bits: int = 8):
+                 dtype=jnp.bfloat16, q_bits: int = 8,
+                 shard_dim: "int | None" = None, shards: int = 1):
         self.values = values
         self.scales = scales
         self.shape = tuple(shape)
         self.block_size = block_size
         self.dtype = dtype
         self.q_bits = q_bits
+        self.shard_dim = shard_dim
+        self.shards = int(shards)
 
     @staticmethod
-    def quantize(w, config: QuantizationConfig = None) -> "QuantizedParameter":
+    def quantize(w, config: QuantizationConfig = None,
+                 shard_dim: "int | None" = None,
+                 shards: int = 1) -> "QuantizedParameter":
         config = config or QuantizationConfig()
         if config.q_bits not in _FMTS:
             raise ValueError(f"q_bits must be one of {sorted(_FMTS)} "
                              f"(int8 / fp6-e3m2 / int4), got {config.q_bits}")
         quant, _ = _FMTS[config.q_bits]
-        values, scales = quant(w, block_size=config.group_size)
-        return QuantizedParameter(values, scales, w.shape, config.group_size,
-                                  dtype=w.dtype, q_bits=config.q_bits)
+        if shards <= 1 or shard_dim is None:
+            values, scales = quant(w, block_size=config.group_size)
+            return QuantizedParameter(values, scales, w.shape, config.group_size,
+                                      dtype=w.dtype, q_bits=config.q_bits)
+        shard_dim = shard_dim % w.ndim
+        if w.shape[shard_dim] % shards != 0:
+            raise ValueError(
+                f"shard_dim {shard_dim} of shape {w.shape} not divisible by "
+                f"{shards} shards")
+        perm = jnp.moveaxis(w, shard_dim, 0)
+        rows = perm.shape[0] // shards
+        vs, ss = [], []
+        for i in range(shards):
+            v, s = quant(perm[i * rows:(i + 1) * rows],
+                         block_size=config.group_size)
+            vs.append(v)
+            ss.append(s)
+        return QuantizedParameter(jnp.concatenate(vs), jnp.concatenate(ss),
+                                  w.shape, config.group_size, dtype=w.dtype,
+                                  q_bits=config.q_bits, shard_dim=shard_dim,
+                                  shards=shards)
 
     def dequantized(self):
         _, dequant = _FMTS[self.q_bits]
-        return dequant(self.values, self.scales, self.shape,
-                       self.block_size).astype(self.dtype)
+        if self.shards <= 1 or self.shard_dim is None:
+            return dequant(self.values, self.scales, self.shape,
+                           self.block_size).astype(self.dtype)
+        # Shard-major decode, vectorized over ALL shards at once. Every
+        # per-shard segment is padded to whole blocks, so the concatenated
+        # stream is itself a valid flat blockwise stream: decode it globally
+        # (elementwise over dim-0-sharded blocks), then strip each shard's
+        # tail pad with a slice on the NON-sharded dim. Never slice or
+        # concatenate along the sharded dim itself — a per-chunk
+        # slice+concat loop here made XLA's SPMD partitioner mispair
+        # values with neighboring blocks' scales inside large jitted
+        # graphs (wrong dequant by exactly a scale ratio).
+        perm_shape = (self.shape[self.shard_dim], ) + tuple(
+            d for i, d in enumerate(self.shape) if i != self.shard_dim)
+        chunk_rows = perm_shape[0] // self.shards
+        chunk_elems = chunk_rows
+        for d in perm_shape[1:]:
+            chunk_elems *= d
+        total_blocks = self.scales.shape[0]
+        elems_padded = total_blocks * self.block_size
+        flat = dequant(self.values, self.scales, (elems_padded, ),
+                       self.block_size)
+        x = flat.reshape(self.shards, elems_padded // self.shards)
+        x = x[:, :chunk_elems]
+        perm = x.reshape((self.shards * chunk_rows, ) + perm_shape[1:])
+        return jnp.moveaxis(perm, 0, self.shard_dim).astype(self.dtype)
 
     @property
     def nbytes(self) -> int:
@@ -63,6 +121,7 @@ class QuantizedParameter:
 jax.tree_util.register_pytree_node(
     QuantizedParameter,
     lambda qp: ((qp.values, qp.scales),
-                (qp.shape, qp.block_size, qp.dtype, qp.q_bits)),
+                (qp.shape, qp.block_size, qp.dtype, qp.q_bits,
+                 qp.shard_dim, qp.shards)),
     lambda aux, kids: QuantizedParameter(kids[0], kids[1], aux[0], aux[1],
-                                         aux[2], aux[3]))
+                                         aux[2], aux[3], aux[4], aux[5]))

@@ -13,6 +13,7 @@ Two sources of rules:
    parallel for q/k/v/gate/up/in-projections, row-parallel for o/down/out.
 """
 
+import os
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -20,6 +21,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm.mesh import MeshContext
+from ..linear.quantization import QuantizedParameter
 from ..utils.logging import logger
 
 # logical axis name -> mesh axis (None = replicate); the t5x-style rule table
@@ -56,6 +58,18 @@ def heuristic_spec(path: str, shape: Sequence[int], mp_size: int) -> P:
     return P()
 
 
+def woq_shard_dim(path: str, shape: Sequence[int], mp_size: int) -> Optional[int]:
+    """Which dim of a kernel the AutoTP heuristics would shard over 'model'
+    (None = replicated). The weight quantizer uses this to lay packed
+    values/scales out shard-major so the quantized bytes split the same way
+    the fp weights would."""
+    spec = heuristic_spec(path, shape, mp_size)
+    for i, ax in enumerate(spec):
+        if ax == "model":
+            return i
+    return None
+
+
 def path_str(path) -> str:
     """Public: jax key-path -> 'a/b/c' (shared by AutoTP + weight quantizer)."""
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
@@ -63,7 +77,15 @@ def path_str(path) -> str:
 
 def tp_shardings(params: Any, ctx: MeshContext, logical_axes: Any = None,
                  rules=None) -> Any:
-    """NamedSharding pytree for TP over the 'model' axis."""
+    """NamedSharding pytree for TP over the 'model' axis.
+
+    QuantizedParameter leaves are handled as a unit: a shard-major qparam
+    (shards > 1) gets ``P("model")`` on both its flat values and scales —
+    each worker holds exactly its own contiguous segment — while a flat
+    qparam replicates. The returned tree mirrors the params treedef (the
+    sharding "leaf" for a qparam is a qparam of NamedShardings) so it feeds
+    ``jax.device_put`` directly.
+    """
     mp = ctx.mp_size
 
     if logical_axes is not None:
@@ -73,12 +95,66 @@ def tp_shardings(params: Any, ctx: MeshContext, logical_axes: Any = None,
             is_leaf=lambda x: x is None or isinstance(x, tuple))
 
     def _one(path, leaf):
+        if isinstance(leaf, QuantizedParameter):
+            spec = P("model") if (leaf.shards > 1
+                                  and leaf.shard_dim is not None) else P()
+            ns = NamedSharding(ctx.mesh, spec)
+            return QuantizedParameter(ns, ns, leaf.shape, leaf.block_size,
+                                      leaf.dtype, leaf.q_bits, leaf.shard_dim,
+                                      leaf.shards)
         return NamedSharding(ctx.mesh, heuristic_spec(path_str(path), leaf.shape, mp))
 
-    return jax.tree_util.tree_map_with_path(_one, params)
+    return jax.tree_util.tree_map_with_path(
+        _one, params, is_leaf=lambda x: isinstance(x, QuantizedParameter))
 
 
 def shard_params_for_tp(params: Any, ctx: MeshContext, logical_axes: Any = None) -> Any:
     """Place params with TP shardings (inference path entry point)."""
     shardings = tp_shardings(params, ctx, logical_axes)
     return jax.device_put(params, shardings)
+
+
+# ------------------------------------------------------------- TP wire dtype
+#
+# Gate ladder for the quantized TP collectives (mirrors the PR 4 kernel
+# dispatch precedence): explicit config > DS_TPU_TP_WIRE env > default "fp".
+# The wire is resolved per layer class so the final lm_head reduce can stay
+# full-precision while attention/MLP outputs ride blockwise-int8.
+
+TP_WIRE_CLASSES = ("attn_out", "mlp_out", "lm_head")
+TP_WIRE_DTYPES = ("fp", "int8")
+
+
+def resolve_tp_wire(config_value: Optional[str] = None,
+                    overrides: Optional[Dict[str, str]] = None,
+                    env: Optional[Dict[str, str]] = None
+                    ) -> Tuple[Dict[str, str], str]:
+    """Resolve the TP collective wire dtype per layer class.
+
+    Returns ``(wire_map, source)`` where wire_map maps each of
+    :data:`TP_WIRE_CLASSES` to ``"fp"`` or ``"int8"`` and source is one of
+    ``config`` / ``env`` / ``default``. ``lm_head`` defaults to ``"fp"``
+    even under a base of ``"int8"`` (logit-forming reduce keeps full
+    precision) — an explicit per-class override can flip it.
+    """
+    env = os.environ if env is None else env
+    if config_value:
+        base, source = config_value, "config"
+    elif env.get("DS_TPU_TP_WIRE"):
+        base, source = env["DS_TPU_TP_WIRE"], "env"
+    else:
+        base, source = "fp", "default"
+    if base not in TP_WIRE_DTYPES:
+        raise ValueError(f"tp wire dtype must be one of {TP_WIRE_DTYPES}, "
+                         f"got {base!r} (source: {source})")
+    wire = {c: base for c in TP_WIRE_CLASSES}
+    wire["lm_head"] = "fp"
+    for cls, val in (overrides or {}).items():
+        if cls not in TP_WIRE_CLASSES:
+            raise ValueError(f"unknown tp wire class {cls!r}; "
+                             f"expected one of {TP_WIRE_CLASSES}")
+        if val not in TP_WIRE_DTYPES:
+            raise ValueError(f"tp wire override {cls}={val!r} invalid; "
+                             f"expected one of {TP_WIRE_DTYPES}")
+        wire[cls] = val
+    return wire, source

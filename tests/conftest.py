@@ -95,6 +95,21 @@ def devices():
     return jax.devices()
 
 
+@pytest.fixture
+def force_host_devices():
+    """Env factory for SUBPROCESS tests that need their own forced
+    virtual-device count: returns ``build(n, extra=...) -> env dict`` (the
+    same scrub/pin recipe the conftest re-exec applies, shared via
+    utils/hostdev so mesh tests, TP benches and serving e2e tests stop
+    hand-rolling the four env edits)."""
+    from deepspeed_tpu.utils.hostdev import force_host_devices_env
+
+    def _build(n: int, extra=None):
+        return force_host_devices_env(n, extra=extra)
+
+    return _build
+
+
 def pytest_runtest_setup(item):
     ws_marks = list(item.iter_markers(name="world_size"))
     if ws_marks:

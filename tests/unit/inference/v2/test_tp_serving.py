@@ -166,12 +166,34 @@ def test_tp_paged_gqa_nondivisible_pads():
     assert len(out) == 2 and all(len(o) == 3 for o in out)
 
 
-def test_tp_rejects_quantize_combo():
-    with pytest.raises(ValueError, match="does not compose"):
-        from deepspeed_tpu.inference.v2.model import RaggedLlamaModel
-        cfg = LlamaConfig.tiny()
-        _, params = init_llama(cfg, seed=0)
-        RaggedLlamaModel(cfg, params, quantize="int8", tp_size=2)
+def test_woq_tp_capability_check():
+    """WoQ×TP is no longer a blanket mutual exclusion: the capability check
+    accepts shardable combos and rejects only genuinely unsupported ones,
+    naming the combo in the message."""
+    from deepspeed_tpu.inference.v2.model import check_woq_tp_support
+    cfg = LlamaConfig.tiny()
+
+    # trivially fine: no quantization, or no TP
+    assert check_woq_tp_support(cfg, None, 2) == {}
+    assert check_woq_tp_support(cfg, "int8", 1) == {}
+
+    # the lifted case: int8 x tp=2 on tiny (all classes shardable)
+    ok = check_woq_tp_support(cfg, "int8", 2)
+    assert ok == {"q_proj/o_proj": True, "k_proj/v_proj": True, "mlp": True}
+
+    # packing granularity the quantizer cannot honor
+    with pytest.raises(ValueError, match=r"quantize='int4' x tp=2.*even"):
+        check_woq_tp_support(cfg, "int4", 2, group_size=511)
+    with pytest.raises(ValueError, match=r"quantize='fp6' x tp=2.*4"):
+        check_woq_tp_support(cfg, "fp6", 2, group_size=510)
+
+    # nothing shardable -> every chip would hold the full quantized model
+    odd = LlamaConfig.tiny(hidden_size=63, num_attention_heads=7,
+                           num_key_value_heads=7, intermediate_size=127,
+                           head_dim=9)
+    with pytest.raises(ValueError, match=r"quantize='int8' x tp=2.*no "
+                                         r"quantized kernel is shardable"):
+        check_woq_tp_support(odd, "int8", 2)
 
 
 @pytest.mark.world_size(8)

@@ -84,19 +84,15 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_zero3_train_and_resume(tmp_path):
+def test_two_process_zero3_train_and_resume(tmp_path, force_host_devices):
     script = tmp_path / "child.py"
     script.write_text(CHILD)
     ckpt = tmp_path / "ckpt"  # shared fs, like a pod's NFS/GCS mount
-    exports = {
-        "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO,
-        # 4 local devices per process -> 8 global, mesh data=2 x fsdp=4
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-    }
+    # 4 local devices per process -> 8 global, mesh data=2 x fsdp=4
+    env = force_host_devices(4, extra={"PYTHONPATH": REPO})
+    exports = {k: env[k] for k in ("JAX_PLATFORMS", "PYTHONPATH", "XLA_FLAGS")}
     cmds = build_commands(["localhost", "localhost"], "127.0.0.1", _free_port(),
                           str(script), [str(ckpt)], exports)
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     procs = [subprocess.Popen(c, env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True) for c in cmds]
     outs = []
@@ -146,17 +142,16 @@ CHILD_TAG = textwrap.dedent("""
 """)
 
 
-def test_checkpoint_tag_validation_across_processes(tmp_path):
+def test_checkpoint_tag_validation_across_processes(tmp_path, force_host_devices):
     """Reference engine.py:3092 _checkpoint_tag_validation: a diverged tag
     fails BEFORE anyone writes (FAIL mode); an agreed tag saves fine."""
     script = tmp_path / "child_tag.py"
     script.write_text(CHILD_TAG)
     unit_dir = os.path.join(REPO, "tests", "unit")
-    exports = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
-               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    env = force_host_devices(1, extra={"PYTHONPATH": REPO})
+    exports = {k: env[k] for k in ("JAX_PLATFORMS", "PYTHONPATH", "XLA_FLAGS")}
     cmds = build_commands(["localhost", "localhost"], "127.0.0.1", _free_port(),
                           str(script), [str(tmp_path / "ck"), unit_dir], exports)
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     procs = [subprocess.Popen(c, env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True) for c in cmds]
     outs = [p.communicate(timeout=420)[0] for p in procs]
