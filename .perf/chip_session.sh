@@ -202,5 +202,34 @@ run bench_serving_tp 1500 env DS_BENCH_TP=1 DS_BENCH_FAST=1 python bench_serving
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)
 run bench_multistep 1500 env DS_BENCH_MULTISTEP=8 DS_BENCH_FAST=1 python bench.py
+# 16. training-observability A/B: same engine/program with the compile
+# watch + goodput ledger + MFU/memory gauges ON vs force-disabled. The
+# recording paths ride every optimizer step, so this is the proof they
+# stay out of the step's way on real silicon (CPU A/B gave 1.25%).
+run bench_obs_ab 1500 env DS_BENCH_OBS_AB=1 python bench.py
+# 16-check. hard gate on the A/B row: overhead must stay under 2%
+run bench_obs_ab_check 60 python - <<'PYEOF'
+import glob, json, sys
+rows = []
+for p in sorted(glob.glob("/root/repo/.perf/bench_obs_ab_r5_*.out")):
+    for ln in open(p):
+        try:
+            r = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and r.get("observability_ab"):
+            rows.append(r)
+assert rows, "no observability_ab row in any bench_obs_ab output"
+r = rows[-1]
+assert r["value"] < 2.0, \
+    f"training observability overhead {r['value']}% >= 2%"
+print("training observability: overhead "
+      f"{r['value']}% (off {r['tok_s_observability_off']} vs on "
+      f"{r['tok_s_observability_on']} tok/s)")
+PYEOF
+# 17. bench regression gate: every rung above appended its headline number
+# to BENCH_HISTORY.jsonl — diff latest vs previous per rung and fail the
+# session on a >10% drop, so a silent perf regression can't ride a window
+run benchdiff 120 python bin/ds_benchdiff
 echo "CHIP SESSION $SFX done $(date -u +%FT%TZ)" >> $LOG
 touch $P/SUITE_DONE

@@ -365,6 +365,43 @@ def _journal_chip_result(out):
     _journal_append(_journal_path(), out)
 
 
+def _history_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_HISTORY.jsonl")
+
+
+def _history_rung(unit: str = "") -> str:
+    """Stable rung tag for the regression history: the env flags that pick
+    the ladder (each selects a different model/footprint, so their numbers
+    must never be diffed against each other), with a ``-cpu`` suffix for
+    diagnostic-fallback runs (host-CPU numbers are liveness evidence, not
+    comparable to chip numbers)."""
+    rung = "train"
+    for flag, tag in (("DS_BENCH_LONGSEQ", "longseq"),
+                      ("DS_BENCH_LARGE", "large"),
+                      ("DS_BENCH_SCAN", "scan"),
+                      ("DS_BENCH_FAST", "fast")):
+        if env_flag(flag):
+            rung += f"-{tag}"
+    if int(os.environ.get("DS_BENCH_MULTISTEP", "0") or 0) > 1:
+        rung += "-multistep"
+    if "DIAGNOSTIC" in unit:
+        rung += "-cpu"
+    return rung
+
+
+def _append_history(rec, rung=None):
+    """One line per completed bench run in ``BENCH_HISTORY.jsonl`` — the
+    regression ledger ``bin/ds_benchdiff`` diffs. ``_journal_append`` stamps
+    git revision and UTC date; records are compared latest-vs-previous
+    within a rung, higher ``value`` better."""
+    _journal_append(_history_path(),
+                    {"rung": rung or _history_rung(rec.get("unit", "")),
+                     **{k: rec[k] for k in
+                        ("metric", "value", "unit", "vs_baseline",
+                         "paged_vs_dense") if k in rec}})
+
+
 _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 
 
@@ -729,7 +766,90 @@ def breakdown(batch=8, seq=1024, iters=10):
     print(json.dumps(report), flush=True)
 
 
+def _measure_obs_ab():
+    """``DS_BENCH_OBS_AB=1``: training-observability overhead A/B — the
+    same fused-step loop on two engines, one with the ``observability``
+    config block force-disabled, one with the default-on instrumentation
+    (compile watch + goodput ledger + step histogram). Timed reps ALTERNATE
+    between the arms so clock/thermal drift lands on both equally.
+    Acceptance (chip_session rung): the enabled arm costs <2% tok/s."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # fp32 model dtype: the bf16 default would route fp32 masters
+        # through the use-site cast barrier, which has no grad rule on host
+        cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                          intermediate_size=704, num_hidden_layers=4,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=512, remat=True,
+                          dtype=jnp.float32)
+        batch, seq, iters, reps = 2, 256, 4, 4
+    else:
+        cfg = bench_config("dots_saveable", scan_layers=True)
+        batch, seq, iters, reps = 8, 1024, 8, 3
+
+    rng = np.random.default_rng(0)
+    pool = [jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, seq)),
+                        dtype=jnp.int32) for _ in range(4)]
+    engines = {}
+    for obs_on in (False, True):
+        model, params = init_llama(cfg)
+        ecfg = bench_engine_config(batch)
+        if platform == "cpu":
+            # the chip config's bf16+use-site-cast combo can't differentiate
+            # on host CPU (optimization_barrier grad, chip-only path) — the
+            # diagnostic arm measures instrumentation overhead, not dtype
+            ecfg.pop("bf16", None)
+            ecfg.pop("param_cast", None)
+        ecfg["observability"] = {"enabled": obs_on}
+        engines[obs_on], _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ecfg)
+
+    def rep(eng):
+        t0 = time.time()
+        for i in range(iters):
+            eng.fused_train_step(pool[i % len(pool)],
+                                 labels=pool[i % len(pool)])
+        jax.block_until_ready(eng.params)
+        float(jax.tree_util.tree_leaves(eng.params)[0].ravel()[0])
+        return time.time() - t0
+
+    for eng in engines.values():  # compile + warmup, outside the clock
+        rep(eng)
+    wall = {False: 0.0, True: 0.0}
+    for _ in range(reps):
+        for obs_on in (False, True):
+            wall[obs_on] += rep(engines[obs_on])
+    tokens = reps * iters * batch * seq
+    tok_off, tok_on = tokens / wall[False], tokens / wall[True]
+    overhead = round(100.0 * (1.0 - tok_on / tok_off), 2)
+    _journal_append(_history_path(), {
+        "rung": "train-obs-ab" + ("-cpu" if platform == "cpu" else ""),
+        "metric": "train_tokens_per_sec_observability_on",
+        "value": round(tok_on, 1), "unit": "tokens/s",
+        "vs_baseline": 0.0, "observability_overhead_pct": overhead})
+    return {"metric": "train_observability_overhead_pct",
+            "value": overhead,
+            "unit": (f"pct tok/s lost with training observability on "
+                     f"(off {tok_off:.0f} vs on {tok_on:.0f} tok/s"
+                     f"{', DIAGNOSTIC cpu fallback' if platform == 'cpu' else ''})"),
+            "vs_baseline": 0.0,
+            "tok_s_observability_off": round(tok_off, 1),
+            "tok_s_observability_on": round(tok_on, 1),
+            "observability_ab": True}
+
+
 def measure():
+    if env_flag("DS_BENCH_OBS_AB"):
+        # overhead A/B replaces the ladder for this run — its number is a
+        # regression gate, not a throughput headline
+        print(json.dumps(_measure_obs_ab()), flush=True)
+        return
     # ANYTIME ladder: a footprint that RELIABLY lands runs FIRST so a short
     # relay window still records a real number, then the ambitious configs
     # try to beat it. Every improvement prints a fresh JSON line; the
@@ -829,6 +949,7 @@ def measure():
                 last_err = msg
                 continue
             if best is not None:
+                _append_history(best)
                 return  # keep the number already printed; don't die improving it
             raise
         finally:
@@ -847,11 +968,13 @@ def measure():
             best = out
             print(json.dumps(out), flush=True)
         if "DIAGNOSTIC" in out["unit"]:
+            _append_history(best)
             return  # CPU fallback sizing ignores the ladder; once is enough
     if best is None:
         raise RuntimeError("all bench footprints OOMed: "
                            + (last_err or "every rung skipped by triage "
                               "verdicts")[-500:])
+    _append_history(best)
 
 
 def supervise():

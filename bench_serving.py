@@ -1106,6 +1106,15 @@ def main():
         os.remove(args.out + ".partial")
     except OSError:
         pass
+    # regression ledger: one line per completed sweep, diffed latest-vs-
+    # previous within the rung by bin/ds_benchdiff (higher value better)
+    from bench import _history_path, _journal_append
+    _journal_append(_history_path(), {
+        "rung": f"serving-{platform}",
+        "metric": "paged_vs_dense_decode_ratio",
+        "value": doc.get("paged_vs_dense", 0.0),
+        "unit": "paged/dense best decode tok_s ratio",
+        "vs_baseline": doc.get("vs_baseline", 0.0)})
     print(json.dumps(doc))
     return 0
 

@@ -38,6 +38,17 @@ QUARANTINE_SUFFIX = ".quarantined"
 MANIFEST_VERSION = 1
 
 
+def _ckpt_hist(kind: str):
+    """Registry histograms for checkpoint IO wall time (save includes the
+    orbax write + host-state flush on the sync path, only the dispatch on
+    the async path — commit() carries the wait there)."""
+    from ..observability import get_registry
+    return get_registry().histogram(
+        f"ds_checkpoint_{kind}_seconds",
+        f"Wall seconds per checkpoint {kind}", lo=1e-4, hi=1e4,
+        buckets_per_decade=5)
+
+
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint failed manifest verification (torn or corrupt)."""
 
@@ -321,6 +332,8 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         logger.info(f"[OrbaxCheckpointEngine] Checkpoint {tag} is about to be saved!")
 
     def save(self, state_dict: Dict[str, Any], path: str, host_state: Optional[Dict] = None):
+        import time
+        t0 = time.perf_counter()
         path = os.path.abspath(path)
         self._ckptr.save(path, state_dict, force=True)
         self._pending_path = path
@@ -328,9 +341,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             # orbax materializes the dir atomically (tmp → rename) when the
             # async write completes; host state must wait for commit()
             self._pending_host_state = (path, host_state)
+            _ckpt_hist("save").record(time.perf_counter() - t0)
             return path
         self._ckptr.wait_until_finished()
         self._write_host_state(path, host_state)
+        _ckpt_hist("save").record(time.perf_counter() - t0)
         return path
 
     def _write_host_state(self, path, host_state):
@@ -348,6 +363,8 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         ``verify=True`` checks the integrity manifest first (legacy dirs
         without one pass) and raises :class:`CheckpointCorruptionError`
         instead of letting orbax deserialize torn data."""
+        import time
+        t0 = time.perf_counter()
         path = os.path.abspath(path)
         if verify:
             ok, reason = verify_checkpoint(path, require_manifest=False)
@@ -367,6 +384,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         elif os.path.exists(legacy):
             with open(legacy) as f:
                 host_state = json.load(f)
+        _ckpt_hist("load").record(time.perf_counter() - t0)
         return restored, host_state
 
     def commit(self, tag) -> bool:

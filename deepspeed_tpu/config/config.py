@@ -28,6 +28,7 @@ from .feature_configs import (
     MonitorConfig,
     ResilienceConfig,
     TensorParallelConfig,
+    TrainObservabilityConfig,
     ZeroConfig,
 )
 from ..utils.logging import logger
@@ -178,7 +179,10 @@ class DeepSpeedTpuConfig:
             wandb=pd.get("wandb", {}),
             csv_monitor=pd.get("csv_monitor", {}),
             comet=pd.get("comet", {}),
+            registry_events=bool(pd.get("registry_events", False)),
         )
+        self.observability_config = TrainObservabilityConfig(
+            **pd.get("observability", {}))
         self.aio_config = AioConfig(**pd.get("aio", {}))
         self.checkpoint_config = CheckpointConfig(**pd.get("checkpoint", {}))
         self.compile_config = CompileConfig(**pd.get("compile", {}))

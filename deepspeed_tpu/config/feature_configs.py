@@ -363,6 +363,42 @@ class CompileConfig(ConfigModel):
     cache_min_compile_secs: Optional[float] = Field(None, ge=0)
 
 
+# -------------------- training observability --------------------
+
+
+class TrainObservabilityConfig(ConfigModel):
+    """TPU extension (``"observability"`` config block): training-side
+    compile/goodput/MFU telemetry (``observability/xla.py`` +
+    ``observability/goodput.py``), the training sibling of serving's
+    ``ObservabilityConfig``.
+
+    - ``enabled``: master gate. Off ⇒ the engine records nothing beyond
+      the pre-existing ``ds_train_steps_total`` counter (the bench A/B
+      arm).
+    - ``goodput``: wall-clock goodput ledger
+      (``ds_goodput_seconds_total{category=...}`` + fraction gauge).
+    - ``compile_watch``: wrap every jitted step program so compile vs
+      cache-hit vs retrace is counted per compile key
+      (``ds_compile_seconds{key=...}`` etc.), and install the process-wide
+      ``backend_compile_duration`` listener.
+    - ``mfu``: publish ``ds_train_mfu`` from cost-analysis FLOPs at each
+      registry publish (lazy AOT cost analysis — never on the step path).
+    - ``memory``: refresh device-memory gauges (live/peak/limit bytes) at
+      the publish cadence; silently absent on backends without
+      ``memory_stats`` (CPU).
+    - ``textfile``: path of an atomically-replaced Prometheus textfile
+      written at each registry publish (training has no HTTP server; this
+      is what ``ds_top --file`` and node-exporter textfile collectors
+      read). ``DS_TPU_METRICS_TEXTFILE`` env is the fallback when unset.
+    """
+    enabled: bool = True
+    goodput: bool = True
+    compile_watch: bool = True
+    mfu: bool = True
+    memory: bool = True
+    textfile: Optional[str] = None
+
+
 class AsyncPipelineConfig(ConfigModel):
     """TPU extension: fully asynchronous train-step pipeline — keep the
     device's dispatch queue full by never blocking the host on a per-step

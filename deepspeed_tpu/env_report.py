@@ -153,6 +153,33 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"serving observability {'.' * 27} {NO} ({e})")
     try:
+        # training observability: which recorders ride the training loop
+        # (compile watch / goodput ledger / MFU / memory gauges) and where
+        # the Prometheus textfile would land — config > env > disabled
+        from .config.feature_configs import TrainObservabilityConfig
+        tcfg = TrainObservabilityConfig()
+        if tcfg.enabled:
+            parts = [n for n, on in (("goodput", tcfg.goodput),
+                                     ("compile-watch", tcfg.compile_watch),
+                                     ("mfu", tcfg.mfu),
+                                     ("memory", tcfg.memory)) if on]
+            state = "enabled (" + ", ".join(parts) + ")"
+        else:
+            state = "disabled"
+        lines.append(f"training observability {'.' * 26} {state}")
+        tf = tcfg.textfile or os.environ.get("DS_TPU_METRICS_TEXTFILE")
+        if tf:
+            d = os.path.dirname(os.path.abspath(tf)) or "."
+            writable = os.access(d if os.path.isdir(d) else ".", os.W_OK)
+            lines.append(f"metrics textfile {'.' * 32} "
+                         f"{tf} [{'writable' if writable else 'NOT writable'}]")
+        else:
+            lines.append(f"metrics textfile {'.' * 32} "
+                         f"disabled (set observability.textfile or "
+                         f"DS_TPU_METRICS_TEXTFILE)")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"training observability {'.' * 26} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

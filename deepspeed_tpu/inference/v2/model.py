@@ -18,6 +18,7 @@ logits_gather). TPU design:
 """
 
 import functools
+import re
 from functools import partial
 from typing import Optional, Tuple
 
@@ -59,6 +60,25 @@ _tp_wire_saved = _obs.counter(
     "ds_tp_wire_bytes_saved_total",
     "Interconnect bytes saved by the blockwise-int8 TP wire vs moving the "
     "same activations at their compute dtype")
+
+_SERVE_COMPILE_WATCH = None
+
+
+def _serving_compile_watch():
+    """Process-wide :class:`~...observability.xla.CompileWatch` for the
+    serving compile cache: every bucketed forward / fused-decode /
+    fused-spec program shares one watch so ``ds_compiles_total{key}`` /
+    ``ds_compile_cache_hits_total{key}`` count across engines."""
+    global _SERVE_COMPILE_WATCH
+    if _SERVE_COMPILE_WATCH is None:
+        from ...observability.xla import CompileWatch
+        _SERVE_COMPILE_WATCH = CompileWatch(registry=_obs)
+    return _SERVE_COMPILE_WATCH
+
+
+def _compile_key_str(key) -> str:
+    """Flatten a ``_fwd_cache`` key tuple into a Prometheus-safe label."""
+    return re.sub(r"[^0-9A-Za-z_.,:=\[\]()+-]", "", "serve:" + repr(key))
 
 
 def _kernel(d):
@@ -412,6 +432,7 @@ class RaggedLlamaModel:
                 fp32_put, params["model"]["lm_head"])
         self._state_manager = None
         self._fwd_cache = {}  # bucket key -> compiled fn
+        self._last_dispatch_fn = None  # WatchedJit behind the latest dispatch
 
     # ---- state-manager plumbing (reference inference_model_base) ----
 
@@ -569,7 +590,9 @@ class RaggedLlamaModel:
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
+            fn = _serving_compile_watch().wrap(fn, _compile_key_str(key))
             self._fwd_cache[key] = fn
+        self._last_dispatch_fn = fn
         logits, new_cache = fn(self.params, kv.cache, batch)
         kv.update(new_cache)
         self._bump_wire_counters(batch.tokens.shape[0])
@@ -647,7 +670,9 @@ class RaggedLlamaModel:
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
+            fn = _serving_compile_watch().wrap(fn, _compile_key_str(key))
             self._fwd_cache[key] = fn
+        self._last_dispatch_fn = fn
         args = (self.params, kv.cache, jnp.asarray(tokens),
                 jnp.asarray(seq_lens), jnp.asarray(live),
                 jnp.asarray(block_table))
@@ -734,7 +759,9 @@ class RaggedLlamaModel:
                                  mesh=(self._mesh_ctx.mesh
                                        if self._mesh_ctx is not None else None)),
                          donate_argnums=(1, ), **kw)
+            fn = _serving_compile_watch().wrap(fn, _compile_key_str(key))
             self._fwd_cache[key] = fn
+        self._last_dispatch_fn = fn
         args = (self.params, kv.cache, jnp.asarray(tokens),
                 jnp.asarray(seq_lens), jnp.asarray(live),
                 jnp.asarray(block_table), jnp.asarray(hist),
@@ -758,6 +785,19 @@ class RaggedLlamaModel:
             (out, n_emit, dlen, new_keys))
         return (np.asarray(out), np.asarray(n_emit), np.asarray(dlen),
                 np.asarray(new_keys))
+
+    def last_wave_flops(self) -> float:
+        """XLA cost-analysis FLOPs of the most recently dispatched program
+        (the wave just harvested) — the numerator of the serving wave-MFU
+        gauge. 0.0 when nothing dispatched yet or the backend exposes no
+        cost analysis (the gauge then simply stays unset)."""
+        w = self._last_dispatch_fn
+        if w is None or not hasattr(w, "program_flops"):
+            return 0.0
+        try:
+            return float(w.program_flops() or 0.0)
+        except Exception:  # pragma: no cover — telemetry must not break serving
+            return 0.0
 
 
 def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,

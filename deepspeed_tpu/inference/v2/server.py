@@ -1588,7 +1588,8 @@ class ServingScheduler:
             self._obs.fused_tokens.inc(wave_tokens)
             self._obs.wave_span([r.uid for r in fused], t0,
                                 time.monotonic(), K, len(fused),
-                                "greedy" if all_greedy else "sampled")
+                                "greedy" if all_greedy else "sampled",
+                                flops=self._engine._model.last_wave_flops())
         return fused
 
     def _spec_fusable(self, r: _Request) -> bool:
@@ -1690,7 +1691,8 @@ class ServingScheduler:
             self._obs.spec_accepted.inc(wave_ac)
             self._obs.wave_span([r.uid for r in fused], t0,
                                 time.monotonic(), K, len(fused), "spec",
-                                drafted=wave_dr, accepted=wave_ac)
+                                drafted=wave_dr, accepted=wave_ac,
+                                flops=self._engine._model.last_wave_flops())
         return fused
 
     def _tick_put(self, reqs, chunks, drafted) -> Optional[bool]:

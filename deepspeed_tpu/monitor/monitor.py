@@ -155,16 +155,27 @@ class MonitorMaster(Monitor):
         for m in self.monitors:
             m.write_events(event_list)
 
-    def write_registry(self, step, registry=None, prefix=""):
+    def write_registry(self, step, registry=None, prefix="",
+                       window_len=None):
         """Bridge the observability metrics registry into the fan-out:
         counters/gauges as scalars, histograms as _count/_mean/_pNN —
-        one ``(name, value, step)`` schema shared with training events."""
+        one ``(name, value, step)`` schema shared with training events.
+
+        Async windows pass the WINDOW-START step as ``step`` plus the
+        window length (optimizer steps the publish covers), emitted as an
+        explicit ``registry_window_steps`` event so a consumer can
+        reconstruct the interval [step, step + window_len) instead of
+        mis-attributing the whole window to its last step."""
         if not self.enabled:
             return
         if registry is None:
             from ..observability import get_registry
             registry = get_registry()
-        self.write_events(registry.to_events(step, prefix=prefix))
+        events = registry.to_events(step, prefix=prefix)
+        if window_len is not None:
+            events.append((f"{prefix}registry_window_steps",
+                           float(window_len), step))
+        self.write_events(events)
 
     def write_events_async(self, event_list):
         """Queue events WITHOUT forcing a device→host sync (async-pipeline

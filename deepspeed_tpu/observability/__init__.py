@@ -15,9 +15,21 @@ existing host boundaries, honoring the async-dispatch design):
   (loadable in Perfetto / chrome://tracing).
 - :mod:`profiler` — guarded on-demand ``jax.profiler`` captures (one at
   a time, duration-bounded) behind ``POST /debug/profile``.
+- :mod:`xla` — compile observability: per-compile-key compile/retrace/hit
+  telemetry (:class:`CompileWatch` wrapping every jit entry point),
+  cost-analysis FLOPs feeding the ``ds_train_mfu`` /
+  ``ds_serving_wave_mfu`` gauges, and device-memory gauges.
+- :mod:`goodput` — a wall-clock ledger attributing every training second
+  to {useful step, compile, host-sync stall, checkpoint save/load,
+  anomaly rollback, restart}, exported as
+  ``ds_goodput_seconds_total{category=...}``.
 
-Gated by the ``observability`` config block (:class:`ObservabilityConfig`
-in ``inference/v2/config_v2.py``): on by default with bounded ring sizes.
+Serving is gated by the ``observability`` config block
+(:class:`ObservabilityConfig` in ``inference/v2/config_v2.py``); training
+by :class:`TrainObservabilityConfig` (``config/feature_configs.py``).
+Training runs have no HTTP server — they export through
+``MetricsRegistry.write_textfile`` (atomic Prometheus textfile consumed
+by ``ds_top --file``) and the ``monitor.write_registry`` bridge.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -25,6 +37,10 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
 from .tracing import RequestTracer, get_tracer
 from .profiler import ProfilerBusy, ProfilerCapture, profile_dir
 from .instruments import ServingInstruments
+from .xla import (CompileWatch, TrainInstruments, WatchedJit,
+                  cost_analysis_flops, install_backend_compile_listener,
+                  refresh_memory_gauges)
+from .goodput import CATEGORIES as GOODPUT_CATEGORIES, GoodputLedger
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -32,4 +48,7 @@ __all__ = [
     "RequestTracer", "get_tracer",
     "ProfilerBusy", "ProfilerCapture", "profile_dir",
     "ServingInstruments",
+    "CompileWatch", "TrainInstruments", "WatchedJit", "cost_analysis_flops",
+    "install_backend_compile_listener", "refresh_memory_gauges",
+    "GOODPUT_CATEGORIES", "GoodputLedger",
 ]

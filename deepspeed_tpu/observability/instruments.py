@@ -105,6 +105,12 @@ class ServingInstruments:
         self.fused_occupancy = g(
             "ds_fused_occupancy",
             "Fraction of decode tokens produced by fused dispatches")
+        self.wave_mfu = g(
+            "ds_serving_wave_mfu",
+            "Model FLOPs utilization of the last fused decode wave "
+            "(cost-analysis FLOPs / wall / peak_bf16_flops)")
+        from .xla import peak_device_flops
+        self.peak_flops = peak_device_flops()
 
     # ---- recording helpers (each: a few attribute ops + one deque/lock) ----
 
@@ -136,8 +142,10 @@ class ServingInstruments:
 
     def wave_span(self, uids: Iterable, t0: float, t1: float, K: int,
                   size: int, kind: str, drafted: int = 0,
-                  accepted: int = 0) -> None:
+                  accepted: int = 0, flops: float = 0.0) -> None:
         self.wave.record(t1 - t0)
+        if flops > 0 and t1 > t0:
+            self.wave_mfu.set(min(1.0, flops / ((t1 - t0) * self.peak_flops)))
         args = {"K": K, "size": size, "kind": kind}
         if drafted:
             args["drafted"], args["accepted"] = drafted, accepted

@@ -24,6 +24,7 @@ one coherent snapshot. Tests and benches needing isolation construct
 their own :class:`MetricsRegistry` (or diff ``snapshot()`` deltas).
 """
 
+import os
 import threading
 from bisect import bisect_left
 from math import ceil, log10, sqrt
@@ -233,22 +234,28 @@ class Histogram:
 class MetricsRegistry:
     """Named metric store. ``counter``/``gauge``/``histogram`` return the
     existing instance on re-request (handles are meant to be resolved once
-    and kept), raising if the name is already bound to another type."""
+    and kept), raising if the name is already bound to another type.
+
+    A metric with ``labels`` is one SERIES of a metric family: the store
+    key is ``name{labels}``, so ``counter("x", labels={"k": "a"})`` and
+    ``counter("x", labels={"k": "b"})`` coexist and render under one
+    ``# TYPE x`` header (compile keys, goodput categories)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
     def _get_or_make(self, cls, name, kwargs):
+        key = name + _label_str(kwargs.get("labels"))
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is not None:
                 if not isinstance(m, cls):
-                    raise TypeError(f"metric {name!r} already registered as "
+                    raise TypeError(f"metric {key!r} already registered as "
                                     f"{type(m).__name__}, not {cls.__name__}")
                 return m
             m = cls(name, **kwargs)
-            self._metrics[name] = m
+            self._metrics[key] = m
             return m
 
     def counter(self, name: str, help: str = "",
@@ -268,8 +275,14 @@ class MetricsRegistry:
             dict(help=help, lo=lo, hi=hi,
                  buckets_per_decade=buckets_per_decade, labels=labels))
 
-    def get(self, name: str):
-        return self._metrics.get(name)
+    def get(self, name: str, labels: Optional[dict] = None):
+        return self._metrics.get(name + _label_str(labels))
+
+    def series(self, name: str) -> List[object]:
+        """Every registered series of a metric family, labeled or not."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        return [m for _, m in items if m.name == name]
 
     def names(self) -> List[str]:
         with self._lock:
@@ -296,13 +309,40 @@ class MetricsRegistry:
                     m._value = 0.0
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4 (one scrape body)."""
+        """Prometheus text exposition format 0.0.4 (one scrape body).
+        Labeled series of the same family (sort-adjacent, since the store
+        key is ``name{labels}``) share one ``# HELP``/``# TYPE`` header."""
         with self._lock:
             items = sorted(self._metrics.items())
         lines: List[str] = []
+        seen_families = set()
         for _, m in items:
-            lines.extend(m.render())
+            rendered = m.render()
+            if m.name in seen_families:
+                rendered = [ln for ln in rendered if not ln.startswith("#")]
+            else:
+                seen_families.add(m.name)
+            lines.extend(rendered)
         return "\n".join(lines) + "\n" if lines else ""
+
+    def write_textfile(self, path: str) -> str:
+        """Prometheus *textfile* export for processes with no HTTP server
+        (training runs): render the full registry and atomically replace
+        ``path`` (write to ``path + ".tmp"`` then ``os.replace``), so a
+        node-exporter-style collector or ``ds_top --file`` never observes
+        a torn body. Recreates the parent directory if it was deleted."""
+        body = self.render_prometheus()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        # unique tmp per writer: a shared ".tmp" would let one writer's
+        # replace publish another's half-written body
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(body)
+            f.flush()
+        os.replace(tmp, path)
+        return path
 
     def to_events(self, step: int, prefix: str = "",
                   percentiles: Sequence[float] = (0.5, 0.9, 0.99)):

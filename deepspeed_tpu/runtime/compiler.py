@@ -65,8 +65,20 @@ def configure_compile_cache(compile_config) -> Callable[[], None]:
     earlier does not count as user-chosen: an explicit config may replace
     it.) The env var is also SET here so spawned child processes inherit the
     cache. Returns an undo() restoring prior state (no-op when nothing was
-    applied)."""
+    applied).
+
+    Also installs the process-wide XLA backend-compile listener
+    (``ds_xla_backend_compile_seconds``): the compile-cache entry point is
+    the one place every engine passes through before its first compile, so
+    compiles that bypass the per-key ``CompileWatch`` wrappers (model init,
+    eager ops, persistent-cache deserialization misses) are still visible.
+    Idempotent; never blocks cache configuration."""
     global _SELF_APPLIED_PATH
+    try:
+        from ..observability.xla import install_backend_compile_listener
+        install_backend_compile_listener()
+    except Exception:  # pragma: no cover — telemetry must not break startup
+        pass
     path = getattr(compile_config, "cache_dir", None)
     explicit = bool(path)
     if not path:

@@ -4,10 +4,27 @@ or jax arrays; collation stacks to numpy (host) and the engine shards to
 device via the batch sharding plan."""
 
 import math
+import time
 from collections import deque
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 import numpy as np
+
+_DATA_WAIT_HIST = None
+
+
+def _data_wait_hist():
+    """Lazy module-level handle: host seconds spent blocked on the inner
+    data iterator (the prefetch buffer's refill wait — nonzero means the
+    input pipeline, not the device, is the bottleneck)."""
+    global _DATA_WAIT_HIST
+    if _DATA_WAIT_HIST is None:
+        from ..observability import get_registry
+        _DATA_WAIT_HIST = get_registry().histogram(
+            "ds_data_wait_seconds",
+            "Host wall seconds blocked on the training data iterator per "
+            "prefetch refill", lo=1e-6, hi=1e3, buckets_per_decade=10)
+    return _DATA_WAIT_HIST
 
 
 class RepeatingLoader:
@@ -67,10 +84,12 @@ class DevicePrefetchIterator:
 
     def _fill(self):
         while len(self._buf) < self.depth:
+            t0 = time.perf_counter()
             try:
                 batch = next(self._iter)
             except StopIteration:
                 return
+            _data_wait_hist().record(time.perf_counter() - t0)
             self._buf.append(self._put(batch))
 
     def __iter__(self):

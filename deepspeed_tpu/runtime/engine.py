@@ -25,6 +25,7 @@ ZeRO stages are *sharding plans* (see ``zero_sharding.py``), not subclasses.
 
 import os
 import time
+from contextlib import nullcontext
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
@@ -379,6 +380,25 @@ class DeepSpeedTpuEngine:
         self._async_window = (_AsyncStepWindow(apc.sync_interval)
                               if apc.enabled else None)
 
+        # ---- training/compiler observability (observability/xla.py +
+        # observability/goodput.py): created before the compiled fns so the
+        # compile watch can wrap them; the goodput ledger's clock starts
+        # here, so construction/auto-resume lands in "restart" ----
+        oc = self._config.observability_config
+        self._train_obs = None
+        self._obs_textfile = None
+        if oc.enabled:
+            from ..observability.goodput import GoodputLedger
+            from ..observability.xla import (TrainInstruments,
+                                             install_backend_compile_listener)
+            ledger = GoodputLedger() if oc.goodput else None
+            self._train_obs = TrainInstruments(ledger=ledger)
+            if oc.compile_watch:
+                install_backend_compile_listener()
+            self._obs_textfile = (oc.textfile
+                                  or os.environ.get("DS_TPU_METRICS_TEXTFILE")
+                                  or None)
+
         # ---- state init ----
         if model_parameters is None and _HAS_FLAX and isinstance(model, nn.Module):
             raise ValueError("model_parameters (the flax params pytree) is required")
@@ -386,6 +406,7 @@ class DeepSpeedTpuEngine:
 
         # ---- compiled steps ----
         self._build_compiled_fns()
+        self._watch_compiled_fns()
 
         # ---- compile() / is_compiled surface (reference engine.py:3665) ----
         from .compiler import attach_compile_api
@@ -469,6 +490,14 @@ class DeepSpeedTpuEngine:
         # ---- resilience: preemption autosave, anomaly sentry, auto-resume
         # (after the dataloader so auto-resume can restore sampler state) ----
         self._init_resilience()
+
+        if self._train_obs is not None:
+            # everything up to here — construction, compile-cache setup,
+            # auto-resume — is "restart" time; anchor the step clock so the
+            # first step's sample measures the step, not engine init
+            if self._train_obs.ledger is not None:
+                self._train_obs.ledger.mark("restart")
+            self._train_obs.start_clock()
 
         log_dist(
             f"DeepSpeedTpuEngine ready: zero_stage={zc.stage} dtype={self.compute_dtype.__name__} "
@@ -903,6 +932,34 @@ class DeepSpeedTpuEngine:
                     "device optimizer); gradients exchange via the default "
                     "GSPMD reduce")
 
+    def _watch_compiled_fns(self):
+        """Compile observability: wrap every jitted step program in a
+        ``WatchedJit`` so compile vs cache-hit vs retrace is counted per
+        compile key and the MFU publisher can cost-analyze each dispatched
+        program. Runs after every ``_build_compiled_fns`` (idempotent on
+        already-wrapped programs); transparent to the flops profiler and
+        the grad-comm path (``WatchedJit`` forwards attribute access)."""
+        obs = getattr(self, "_train_obs", None)
+        if obs is None or not self._config.observability_config.compile_watch:
+            return
+        w = obs.watch_program
+        self._fwd_bwd = w(self._fwd_bwd, "train_fwd_bwd")
+        self._fwd_only = w(self._fwd_only, "eval_fwd")
+        self._apply_step = w(self._apply_step, "optimizer_apply")
+        if getattr(self, "_offload_prep", None) is not None:
+            self._offload_prep = w(self._offload_prep, "offload_prep")
+        if getattr(self, "_train_step_fused", None) is not None:
+            self._train_step_fused = w(self._train_step_fused,
+                                       "train_step_fused")
+        if getattr(self, "_train_steps_fused", None) is not None:
+            self._train_steps_fused = w(self._train_steps_fused,
+                                        "train_steps_fused")
+        if getattr(self, "_train_batch_fused", None) is not None:
+            self._train_batch_fused = w(self._train_batch_fused,
+                                        "train_batch_fused")
+        if getattr(self, "_wire_step", None) is not None:
+            self._wire_step = w(self._wire_step, "onebit_wire_step")
+
     # ------------------------------------------------------------------
     # train API (reference engine.py:1838/:1977/:2176)
     # ------------------------------------------------------------------
@@ -1108,7 +1165,11 @@ class DeepSpeedTpuEngine:
             logger.warning("[resilience] no valid checkpoint to roll back to")
             return False
         try:
-            path, _ = self.load_checkpoint(self._resilience_save_dir, tag=tag)
+            # goodput: the whole excursion (incl. the inner load_checkpoint,
+            # whose nested span folds into this one) is "anomaly_rollback"
+            with self._obs_span("anomaly_rollback"):
+                path, _ = self.load_checkpoint(self._resilience_save_dir,
+                                               tag=tag)
         except CheckpointCorruptionError as e:
             logger.error(f"[resilience] rollback target is corrupt: {e}")
             return False
@@ -1291,6 +1352,7 @@ class DeepSpeedTpuEngine:
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             self.tput_timer.stop(global_step=True)
+            self._obs_step_mark(1)
             if (self._async_window is not None
                     and self._host_optimizer is None):
                 # windowed host sync: overflow stays a device scalar; every
@@ -1410,13 +1472,46 @@ class DeepSpeedTpuEngine:
             "ds_train_steps_total", "Effective (non-skipped) optimizer steps"
         ).inc()
 
-    def _publish_registry_events(self):
-        """Monitor bridge (``monitor.registry_events``): fan the process
-        observability registry out alongside the training events, stamped
-        with the current global step."""
+    def _obs_step_mark(self, steps=1):
+        """Per-optimizer-step observability boundary: record the step-wall
+        histogram sample(s) and attribute the interval to goodput
+        "useful_step". Host-only (one perf_counter + histogram bump) —
+        never syncs the device."""
+        obs = self._train_obs
+        if obs is not None:
+            obs.step_mark(steps)
+
+    def _obs_span(self, category):
+        """Goodput span for an excursion (checkpoint save/load, rollback,
+        host-sync stall); nullcontext when observability is off."""
+        obs = getattr(self, "_train_obs", None)
+        if obs is not None and obs.ledger is not None:
+            return obs.ledger.span(category)
+        return nullcontext()
+
+    def _publish_registry_events(self, window_start=None, window_len=None):
+        """Registry publish cadence: refresh derived observability views
+        (MFU, memory, goodput fraction), fan the registry into the monitor
+        bridge (``monitor.registry_events``), and rewrite the Prometheus
+        textfile. Async windows pass ``window_start``/``window_len`` so the
+        events are stamped at the step the window STARTED on plus an
+        explicit length event — stamping the drain-time ``global_steps``
+        attributed a whole window's metrics to its last step."""
+        if self._train_obs is not None:
+            self._train_obs.publish()
         if (self.monitor is not None
                 and self._config.monitor_config.registry_events):
-            self.monitor.write_registry(self.global_steps)
+            step = self.global_steps if window_start is None else window_start
+            self.monitor.write_registry(step, window_len=window_len)
+        if self._obs_textfile:
+            from ..observability import get_registry
+            try:
+                get_registry().write_textfile(self._obs_textfile)
+            except OSError as e:
+                logger.warning(
+                    f"observability textfile export to "
+                    f"{self._obs_textfile} failed: {e}; disabling")
+                self._obs_textfile = None
 
     # ------------------------------------------------------------------
     # async step pipeline (windowed host sync)
@@ -1470,7 +1565,9 @@ class DeepSpeedTpuEngine:
         if w is None or not w.entries:
             return
         entries, duration, comm_steps = w.take()
-        fetched = host_fetch([(loss, ovf) for (_, loss, ovf) in entries])
+        with self._obs_span("host_sync_stall"):
+            # the ONE deliberate device→host block of the window
+            fetched = host_fetch([(loss, ovf) for (_, loss, ovf) in entries])
         total_steps, n_overflow, last_loss = 0, 0, None
         for (steps, _, _), (loss_h, ovf_h) in zip(entries, fetched):
             total_steps += steps
@@ -1497,7 +1594,9 @@ class DeepSpeedTpuEngine:
                 op="reduce_scatter")
         if self.monitor is not None:
             self.monitor.flush_events(fetch=host_fetch)
-            self._publish_registry_events()
+        self._publish_registry_events(
+            window_start=self.global_steps - total_steps,
+            window_len=total_steps)
         if getattr(self, "_sentry", None) is not None:
             # async-mode sentry feed: the window's values were just fetched
             # in the batched transfer above — zero additional syncs
@@ -1583,6 +1682,7 @@ class DeepSpeedTpuEngine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
+        self._obs_step_mark(1)
         if self._async_window is not None:
             # windowed sync: the loss stays a device scalar; comm traffic is
             # banked at the drain against the whole window's wall clock
@@ -1600,6 +1700,7 @@ class DeepSpeedTpuEngine:
         if self.monitor is not None:
             self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                         self.global_samples)])
+        self._publish_registry_events()
         self._flops_profile_post()
         loss_val = float(loss)  # blocks on the dispatch
         if self._grad_comm_layout is not None:
@@ -1643,6 +1744,7 @@ class DeepSpeedTpuEngine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.tput_timer.stop(global_step=True)
+        self._obs_step_mark(1)
         if self._async_window is not None:
             # zero host syncs this step: loss/overflow stay device scalars
             # until the window drains (ONE batched fetch per sync_interval)
@@ -1655,6 +1757,7 @@ class DeepSpeedTpuEngine:
             if self.monitor is not None:
                 self.monitor.write_events([("Train/Samples/train_loss", float(loss),
                                             self.global_samples)])
+            self._publish_registry_events()
         self._flops_profile_post()
         self._resilience_step_boundary(loss=loss, overflow=overflow)
         return loss
@@ -1717,6 +1820,7 @@ class DeepSpeedTpuEngine:
         # one dispatch = K real optimizer steps: the throughput timer and
         # the monitor both see K events, not one
         self.tput_timer.stop(global_step=True, steps=K)
+        self._obs_step_mark(K)
         if self._async_window is not None:
             # push the whole K-step dispatch as ONE vector entry: the loss
             # vector and per-step overflow mask drain together at the window
@@ -1732,6 +1836,8 @@ class DeepSpeedTpuEngine:
                     [("Train/Samples/train_loss", float(l),
                       base + i * self.train_batch_size())
                      for i, l in enumerate(np.asarray(losses))])
+            self._publish_registry_events(
+                window_start=self.global_steps - K, window_len=K)
         self._flops_profile_post()
         self._resilience_step_boundary(losses_vec=losses, overflows_vec=overflows)
         return losses
@@ -1770,6 +1876,7 @@ class DeepSpeedTpuEngine:
         self._config.gradient_accumulation_steps = new_gas
         if gas_changed:  # gas is the only value baked into the closures
             self._build_compiled_fns()
+            self._watch_compiled_fns()
 
     def set_train_micro_batch_size(self, micro_batch_size):
         """Adjust the micro batch, keeping gradient-accumulation steps
@@ -1915,6 +2022,13 @@ class DeepSpeedTpuEngine:
         # settle the async window first: deferred skipped-step / scheduler
         # accounting must land in the host state the checkpoint captures
         self._drain_async_window()
+        with self._obs_span("checkpoint_save"):
+            return self._save_checkpoint(save_dir, tag=tag,
+                                         client_state=client_state,
+                                         save_latest=save_latest)
+
+    def _save_checkpoint(self, save_dir, tag=None, client_state=None,
+                         save_latest=True):
         tag = tag or f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         self.checkpoint_engine.create(tag)
@@ -1992,6 +2106,17 @@ class DeepSpeedTpuEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
+        # goodput: a load inside a rollback nests under "anomaly_rollback"
+        with self._obs_span("checkpoint_load"):
+            return self._load_checkpoint(
+                load_dir, tag=tag,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_optimizer_states=load_optimizer_states,
+                load_module_only=load_module_only)
+
+    def _load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                         load_lr_scheduler_states=True,
+                         load_module_only=False):
         if tag is None:
             # `latest` is authoritative while it names a sealed, verified
             # checkpoint. After a crash it may be missing, stale, or name a

@@ -65,12 +65,25 @@ def record_window_traffic(layout, dp_world: int, tier: str, block_size: int,
     ``calc_bw_log`` aggregates the same totals the per-step path reported."""
     if steps <= 0:
         return None
-    from ..comm.bucketing import record_bucket_traffic
+    from ..comm.bucketing import bucket_wire_bytes, record_bucket_traffic
     per_step = duration / steps
     stats = None
     for _ in range(steps):
         stats = record_bucket_traffic(layout, dp_world, tier, block_size,
                                       duration=per_step, op=op)
+    # observability registry mirror (independent of the CommsLogger gate):
+    # wire volume and dispatch count for comm-vs-compute attribution
+    from ..observability import get_registry
+    reg = get_registry()
+    wire = bucket_wire_bytes(layout, dp_world, tier, block_size)["wire_bytes"]
+    reg.counter(
+        "ds_train_comm_bytes_total",
+        "Bucketed gradient-collective wire bytes (post-quantization)"
+    ).inc(float(wire) * steps)
+    reg.counter(
+        "ds_train_comm_dispatches_total",
+        "Bucketed gradient-collective step dispatches banked"
+    ).inc(steps)
     return stats
 
 

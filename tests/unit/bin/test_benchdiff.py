@@ -1,0 +1,82 @@
+"""ds_benchdiff: per-rung latest-vs-previous comparison over
+BENCH_HISTORY.jsonl — regression gate semantics, diagnostic-record
+filtering, torn-tail tolerance."""
+
+import json
+import os
+import subprocess
+import sys
+
+BIN = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                   "bin", "ds_benchdiff")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, BIN, *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _write(path, recs, tail=""):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write(tail)
+
+
+def test_regression_fails_gate_and_names_rung(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    _write(hist, [
+        {"rung": "train-fast", "value": 1000.0, "rev": "a"},
+        {"rung": "serving-tpu", "value": 1.2, "rev": "a"},
+        {"rung": "train-fast", "value": 990.0, "rev": "b"},
+        {"rung": "serving-tpu", "value": 0.8, "rev": "b"},  # -33%
+    ])
+    r = _run("--history", str(hist))
+    assert r.returncode == 1
+    assert "serving-tpu" in r.stderr and "REGRESSED" in r.stdout
+    # train-fast's -1% is inside the default 10% threshold
+    assert "train-fast" in r.stdout and r.stdout.count("REGRESSED") == 1
+
+
+def test_threshold_and_rung_filter(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    _write(hist, [
+        {"rung": "train-fast", "value": 100.0, "rev": "a"},
+        {"rung": "train-fast", "value": 80.0, "rev": "b"},  # -20%
+    ])
+    assert _run("--history", str(hist)).returncode == 1
+    assert _run("--history", str(hist), "--threshold", "0.25").returncode == 0
+    # filtering to a different rung leaves nothing to compare → pass
+    assert _run("--history", str(hist), "--rung", "other").returncode == 0
+
+
+def test_diagnostic_and_torn_records_skipped(tmp_path):
+    """BENCH FAILED rows (value 0) and a torn trailing line must not
+    poison the comparison — only real measurements count."""
+    hist = tmp_path / "h.jsonl"
+    _write(hist, [
+        {"rung": "train-fast", "value": 1000.0, "rev": "a"},
+        {"rung": "train-fast", "value": 0.0, "rev": "b"},   # BENCH FAILED
+        {"rung": "train-fast", "value": 995.0, "rev": "c"},
+    ], tail='{"rung": "train-fast", "val')  # killed writer mid-append
+    r = _run("--history", str(hist), "--json")
+    assert r.returncode == 0
+    doc = json.loads(r.stdout)
+    row = doc["rungs"][0]
+    assert (row["previous"], row["latest"]) == (1000.0, 995.0)
+    assert doc["regressed"] == []
+
+
+def test_single_record_is_baseline_not_failure(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    _write(hist, [{"rung": "serving-tpu", "value": 1.3, "rev": "a"}])
+    r = _run("--history", str(hist))
+    assert r.returncode == 0 and "baseline" in r.stdout
+
+
+def test_missing_history_is_soft(tmp_path):
+    """A fresh checkout has no history yet — the gate must not fail the
+    chip session over it."""
+    r = _run("--history", str(tmp_path / "nope.jsonl"))
+    assert r.returncode == 0
+    assert "no comparable records" in r.stdout

@@ -162,3 +162,27 @@ def test_master_bridges_metrics_registry(tmp_path):
     # disabled master: write_registry is inert (no default-registry pull)
     off = MonitorMaster(MonitorConfig())
     off.write_registry(step=1)  # must not raise nor write
+
+
+def test_write_registry_stamps_window_start_and_length(tmp_path):
+    """Async-window publishes must land at the WINDOW-START step with an
+    explicit registry_window_steps event — not at the drain step, which
+    would mis-attribute a whole window's metrics to its last step."""
+    from deepspeed_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("ds_train_steps_total").inc(16)
+    cfg = MonitorConfig(csv_monitor={"enabled": True,
+                                     "output_path": str(tmp_path),
+                                     "job_name": "win"})
+    master = MonitorMaster(cfg)
+    # a 4-step window [12, 16) draining at step 16
+    master.write_registry(step=12, registry=reg, window_len=4)
+    with open(tmp_path / "win" / "ds_train_steps_total.csv") as f:
+        assert list(csv.reader(f))[-1] == ["12", "16.0"]
+    with open(tmp_path / "win" / "registry_window_steps.csv") as f:
+        assert list(csv.reader(f))[-1] == ["12", "4.0"]
+    # sync mode: no window_len → no window event series
+    master.write_registry(step=13, registry=reg)
+    rows = list(csv.reader(open(tmp_path / "win"
+                                / "registry_window_steps.csv")))
+    assert len(rows) == 2  # header + the single windowed publish

@@ -1,0 +1,109 @@
+"""Goodput ledger: exhaustive wall-clock attribution under a fake clock
+(categories sum EXACTLY to the wall at every attribution point), compile
+carving, span banking, nesting, and the labeled Prometheus rendering."""
+
+import pytest
+
+from deepspeed_tpu.observability import (GOODPUT_CATEGORIES, GoodputLedger,
+                                         MetricsRegistry)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ledger():
+    clk = FakeClock()
+    return GoodputLedger(registry=MetricsRegistry(), clock=clk), clk
+
+
+def test_marks_partition_the_wall_exactly():
+    led, clk = _ledger()
+    clk.advance(2.0)
+    led.mark("restart")
+    for _ in range(5):
+        clk.advance(0.3)
+        led.mark("useful_step")
+    assert led.totals()["restart"] == pytest.approx(2.0)
+    assert led.totals()["useful_step"] == pytest.approx(1.5)
+    # the invariant the acceptance test scales up: sum == wall, exactly,
+    # because every second since construction was attributed by a mark
+    assert led.attributed_seconds() == pytest.approx(led.wall_seconds())
+    assert set(led.totals()) == set(GOODPUT_CATEGORIES)
+
+
+def test_span_banks_foreign_time_no_double_count():
+    led, clk = _ledger()
+    clk.advance(1.0)
+    with led.span("checkpoint_save"):
+        clk.advance(4.0)
+    clk.advance(1.0)
+    led.mark("useful_step")
+    t = led.totals()
+    assert t["checkpoint_save"] == pytest.approx(4.0)
+    # the mark interval was 6s but 4 were already attributed by the span
+    assert t["useful_step"] == pytest.approx(2.0)
+    assert led.attributed_seconds() == pytest.approx(led.wall_seconds())
+
+
+def test_nested_span_folds_into_outermost():
+    led, clk = _ledger()
+    with led.span("anomaly_rollback"):
+        clk.advance(1.0)
+        with led.span("checkpoint_load"):  # rollback internally loads
+            clk.advance(2.0)
+        clk.advance(0.5)
+    t = led.totals()
+    assert t["anomaly_rollback"] == pytest.approx(3.5)
+    assert t["checkpoint_load"] == 0.0
+
+
+def test_compile_carved_out_of_next_mark():
+    led, clk = _ledger()
+    clk.advance(10.0)
+    led.note_compile(7.5)  # compile watch saw a 7.5s compiling call
+    led.mark("useful_step")
+    t = led.totals()
+    assert t["compile"] == pytest.approx(7.5)
+    assert t["useful_step"] == pytest.approx(2.5)
+    # carve is clamped to the interval: a pending pool larger than the
+    # residual can't attribute seconds that never elapsed
+    led.note_compile(100.0)
+    clk.advance(1.0)
+    led.mark("useful_step")
+    assert led.totals()["compile"] == pytest.approx(8.5)
+    assert led.attributed_seconds() == pytest.approx(led.wall_seconds())
+
+
+def test_fraction_and_publish():
+    led, clk = _ledger()
+    clk.advance(3.0)
+    led.mark("useful_step")
+    clk.advance(1.0)
+    led.mark("compile")
+    assert led.goodput_fraction() == pytest.approx(0.75)
+    assert led.publish() == pytest.approx(0.75)
+    assert led.fraction.value == pytest.approx(0.75)
+
+
+def test_labeled_render_one_family_header():
+    """All seven category series render under ONE HELP/TYPE header pair,
+    each sample carrying its category label."""
+    reg = MetricsRegistry()
+    led = GoodputLedger(registry=reg, clock=FakeClock())
+    led.add("useful_step", 1.0)
+    led.add("compile", 2.0)
+    text = reg.render_prometheus()
+    assert text.count("# TYPE ds_goodput_seconds_total counter") == 1
+    assert 'ds_goodput_seconds_total{category="useful_step"} 1' in text
+    assert 'ds_goodput_seconds_total{category="compile"} 2' in text
+    # eager series: every category is present even at zero
+    for c in GOODPUT_CATEGORIES:
+        assert f'category="{c}"' in text

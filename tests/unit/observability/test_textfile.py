@@ -1,0 +1,81 @@
+"""Prometheus textfile exporter: atomic tmp+replace semantics, golden
+body identity with render_prometheus, parent-dir resilience."""
+
+import os
+import threading
+
+from deepspeed_tpu.observability import MetricsRegistry
+
+
+def _populated():
+    reg = MetricsRegistry()
+    reg.counter("ds_x_total", "things").inc(3)
+    reg.gauge("ds_g", "level").set(0.5)
+    h = reg.histogram("ds_lat_seconds", "latency")
+    for v in (0.01, 0.02, 0.04):
+        h.record(v)
+    reg.counter("ds_goodput_seconds_total", "per category",
+                labels={"category": "useful_step"}).inc(1.25)
+    return reg
+
+
+def test_textfile_body_is_render_prometheus(tmp_path):
+    reg = _populated()
+    path = tmp_path / "ds.prom"
+    out = reg.write_textfile(str(path))
+    assert out == str(path)
+    assert path.read_text() == reg.render_prometheus()
+    # no tmp residue after the replace
+    assert not os.path.exists(str(path) + ".tmp")
+
+
+def test_textfile_atomic_replace_same_inode_swap(tmp_path):
+    """A rewrite must never truncate-in-place: the new body lands under a
+    different inode and os.replace swaps it in whole."""
+    reg = _populated()
+    path = tmp_path / "ds.prom"
+    reg.write_textfile(str(path))
+    ino_before = os.stat(path).st_ino
+    reg.counter("ds_x_total").inc()
+    reg.write_textfile(str(path))
+    assert os.stat(path).st_ino != ino_before
+    assert "ds_x_total 4" in path.read_text()
+
+
+def test_textfile_recreates_deleted_parent(tmp_path):
+    """The node-exporter textfile dir being wiped mid-run (tmpwatch, a
+    redeploy) must not kill the exporter — the next write recreates it."""
+    reg = _populated()
+    d = tmp_path / "collector" / "sub"
+    path = d / "ds.prom"
+    reg.write_textfile(str(path))
+    import shutil
+    shutil.rmtree(tmp_path / "collector")
+    reg.write_textfile(str(path))
+    assert path.exists()
+
+
+def test_textfile_concurrent_writers_never_torn(tmp_path):
+    """Two threads rewriting the same path: every observed body must be a
+    complete render (ends with the trailing newline, parses whole)."""
+    reg = _populated()
+    path = tmp_path / "ds.prom"
+    reg.write_textfile(str(path))
+    errs = []
+
+    def writer():
+        for _ in range(30):
+            try:
+                reg.write_textfile(str(path))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for _ in range(50):
+        body = path.read_text()
+        assert body.endswith("\n") and "# TYPE" in body
+    for t in threads:
+        t.join()
+    assert not errs
