@@ -227,7 +227,13 @@ print("training observability: overhead "
       f"{r['value']}% (off {r['tok_s_observability_off']} vs on "
       f"{r['tok_s_observability_on']} tok/s)")
 PYEOF
-# 17. bench regression gate: every rung above appended its headline number
+# 17. scheduled ZeRO-3 A/B: stage 3 (compiler-scheduled param store,
+# traced gather prefetch in the scan) vs stage 2 on the same bucketed
+# wire. Gate: step time within 10% of stage 2 at ~1/dp the param bytes.
+# A 1-chip session re-execs under 2 forced host devices (diagnostic dp=2
+# — CPU gave 0.99x with the 5-bucket prefetch pipeline).
+run bench_zero3_ab 1800 env DS_BENCH_ZERO3=1 python bench.py
+# 18. bench regression gate: every rung above appended its headline number
 # to BENCH_HISTORY.jsonl — diff latest vs previous per rung and fail the
 # session on a >10% drop, so a silent perf regression can't ride a window
 run benchdiff 120 python bin/ds_benchdiff

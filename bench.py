@@ -844,11 +844,139 @@ def _measure_obs_ab():
             "observability_ab": True}
 
 
+def _measure_zero3_ab():
+    """``DS_BENCH_ZERO3=1``: scheduled ZeRO-3 vs ZeRO-2 A/B — the same
+    bucketed-gradient-comm training loop on two engines, stage 2 (replicated
+    params, scattered grads) vs stage 3 (the compiler-scheduled param store:
+    1/dp bucket shards, traced gather prefetch inside the microbatch scan).
+    Records step-time ratio, per-chip param bytes, and the schedule's gather
+    wire bytes. Needs dp>=2: on a single-device session the measurement
+    re-execs itself under 2 forced host CPU devices (diagnostic sizing, the
+    same topology the dp=2 acceptance test uses)."""
+    import jax
+
+    if jax.device_count() < 2:
+        from deepspeed_tpu.utils.hostdev import force_host_devices_env
+        env = force_host_devices_env(2, extra={"DS_BENCH_ZERO3": "1"})
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, capture_output=True, text=True, timeout=1700)
+        lines = [ln for ln in out.stdout.splitlines()
+                 if ln.lstrip().startswith("{")]
+        if out.returncode != 0 or not lines:
+            raise RuntimeError("zero3 A/B dp=2 subprocess failed: "
+                               + (out.stderr or out.stdout)[-800:])
+        rec = json.loads(lines[-1])
+        rec["forced_host_dp2"] = True
+        return rec
+
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import reset_mesh_context
+    from deepspeed_tpu.models import LlamaConfig, init_llama
+
+    platform = jax.devices()[0].platform
+    w = jax.device_count()  # pure-DP over every device
+    # fp32 model dtype: the scheduled program's fp32 gather wire is the
+    # bitwise-parity arm; small llama sizing keeps the CPU diagnostic snappy
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
+                      num_hidden_layers=4, num_attention_heads=8,
+                      num_key_value_heads=8, max_position_embeddings=512,
+                      remat=True, dtype=jnp.float32)
+    rows, seq, gas = 2 * w, 128, 2
+    iters, reps = 2, 3
+
+    def mk(zero_cfg):
+        reset_mesh_context()
+        model, params = init_llama(cfg)
+        ecfg = {"train_batch_size": rows * gas,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                # 4MB buckets: ~5 buckets over the 17MB model, so the
+                # stage-3 arm runs a real multi-epoch prefetch pipeline
+                # (25MB default = one bucket = one degenerate gather)
+                "gradient_comm": {"enabled": True, "overlap_comm": True,
+                                  "bucket_size_mb": 4.0},
+                "zero_optimization": zero_cfg,
+                "steps_per_print": 0}
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=ecfg)
+        return eng
+
+    engines = {
+        2: mk({"stage": 2}),
+        3: mk({"stage": 3, "stage3_param_persistence_threshold": 0}),
+    }
+    assert engines[3]._zero3_store is not None, \
+        "stage-3 engine fell back — the A/B would measure nothing"
+
+    rng = np.random.default_rng(0)
+    pool = [(jnp.asarray(rng.integers(0, cfg.vocab_size, size=(rows, seq)),
+                         jnp.int32), ) * 2 for _ in range(gas)]
+
+    def rep(eng):
+        t0 = time.time()
+        for _ in range(iters):
+            loss = eng.train_batch(iter(pool))
+        jax.block_until_ready(eng.params)
+        float(loss)
+        return time.time() - t0
+
+    for eng in engines.values():  # compile + warmup, outside the clock
+        rep(eng)
+    wall = {2: 0.0, 3: 0.0}
+    for _ in range(reps):  # timed reps alternate so drift lands on both arms
+        for stage in (2, 3):
+            wall[stage] += rep(engines[stage])
+    step2 = wall[2] / (reps * iters)
+    step3 = wall[3] / (reps * iters)
+    ratio = step2 / step3  # >1: scheduled stage 3 is faster
+
+    def per_chip(tree):
+        return sum(l.addressable_shards[0].data.nbytes
+                   for l in jax.tree_util.tree_leaves(tree))
+
+    p2, p3 = per_chip(engines[2].params), per_chip(engines[3].params)
+    sched = engines[3]._zero3_schedule
+    wire = sched.gather_wire_bytes * gas  # per optimizer step, per chip
+    rung = "zero3-ab" + ("-cpu" if platform == "cpu" else "")
+    _journal_append(_history_path(), {
+        "rung": rung, "metric": "zero3_vs_zero2_step_time_ratio",
+        "value": round(ratio, 4),
+        "unit": "x (zero2_step/zero3_step, higher = faster zero3)",
+        "vs_baseline": 0.0, "dp_world": w,
+        "zero2_step_ms": round(step2 * 1e3, 1),
+        "zero3_step_ms": round(step3 * 1e3, 1),
+        "per_chip_param_bytes_zero2": p2, "per_chip_param_bytes_zero3": p3,
+        "zero3_gather_wire_bytes_per_step": wire,
+        "zero3_gather_epochs": len(sched.epochs),
+        "zero3_prefetched_epochs": sched.prefetch_count})
+    return {"metric": "zero3_vs_zero2_step_time_ratio",
+            "value": round(ratio, 4),
+            "unit": (f"x zero2/zero3 step time at dp={w} (z2 "
+                     f"{step2 * 1e3:.0f}ms vs z3 {step3 * 1e3:.0f}ms; "
+                     f"params/chip {p2} -> {p3} B; gather "
+                     f"{wire} B/step/chip"
+                     f"{', DIAGNOSTIC cpu' if platform == 'cpu' else ''})"),
+            "vs_baseline": 0.0,
+            "per_chip_param_bytes_zero2": p2,
+            "per_chip_param_bytes_zero3": p3,
+            "zero3_gather_wire_bytes_per_step": wire,
+            "zero3_ab": True}
+
+
 def measure():
     if env_flag("DS_BENCH_OBS_AB"):
         # overhead A/B replaces the ladder for this run — its number is a
         # regression gate, not a throughput headline
         print(json.dumps(_measure_obs_ab()), flush=True)
+        return
+    if env_flag("DS_BENCH_ZERO3"):
+        # scheduled-ZeRO-3 A/B replaces the ladder likewise: the ratio is a
+        # parity gate (step time within 10% of stage 2 at ~1/dp the param
+        # bytes), not a throughput headline
+        print(json.dumps(_measure_zero3_ab()), flush=True)
         return
     # ANYTIME ladder: a footprint that RELIABLY lands runs FIRST so a short
     # relay window still records a real number, then the ambitious configs

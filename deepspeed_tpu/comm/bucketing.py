@@ -34,6 +34,7 @@ can fold it into the next step's gradients.
 """
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -344,6 +345,37 @@ def all_gather_bucket(shard, axis_names, tier: str = "fp32",
     all_packed = lax.all_gather(packed, axis_names)
     all_scales = lax.all_gather(scale, axis_names)
     return (unpack_signs(all_packed, n) * all_scales[:, None]).reshape(-1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def param_gather_bucket(shard, axis_names, fwd_tier: str = "fp32",
+                        bwd_tier: str = "fp32",
+                        block_size: int = DEFAULT_BLOCK_SIZE):
+    """Differentiable bucket all-gather for ZeRO-3 parameter epochs.
+
+    Forward: ``all_gather_bucket(shard, fwd_tier)`` — int8 when
+    ``zero_quantized_weights`` (qwZ wire on the flat bucket). Backward: the
+    cotangent of the full bucket reduce-scatters back to shard shape through
+    ``bwd_tier`` (int8 = qgZ). For fp32/fp32 this is EXACTLY the transpose
+    pair XLA uses for a tiled all-gather (psum_scatter), so the scheduled
+    stage-3 gradient exchange is bitwise the stage-2 bucket reduce-scatter;
+    the custom_vjp exists so the quantized tiers — whose forward rounding is
+    not differentiable — ride the same straight-through estimator as
+    ``zeropp.quantized_gather_param``, but on flat buckets."""
+    return all_gather_bucket(shard, axis_names, fwd_tier, block_size)
+
+
+def _pgb_fwd(shard, axis_names, fwd_tier, bwd_tier, block_size):
+    return all_gather_bucket(shard, axis_names, fwd_tier, block_size), None
+
+
+def _pgb_bwd(axis_names, fwd_tier, bwd_tier, block_size, _, g):
+    shard, _residual = reduce_scatter_bucket(g, axis_names, bwd_tier,
+                                             block_size)
+    return (shard, )
+
+
+param_gather_bucket.defvjp(_pgb_fwd, _pgb_bwd)
 
 
 # ---------------------------------------------------------------------------

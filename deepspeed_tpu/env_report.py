@@ -180,6 +180,18 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"training observability {'.' * 26} {NO} ({e})")
     try:
+        # ZeRO defaults: configured stage and the wire dtype a scheduled
+        # stage-3 param gather would move (int8 iff zero_quantized_weights)
+        from .config.feature_configs import ZeroConfig
+        zc = ZeroConfig()
+        lines.append(f"zero stage (default) {'.' * 28} {zc.stage}")
+        wire = "int8" if zc.zero_quantized_weights else "fp32"
+        lines.append(f"zero3 gather wire dtype {'.' * 25} {wire} "
+                     f"(persistence threshold "
+                     f"{int(zc.param_persistence_threshold)} elems)")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"zero defaults {'.' * 35} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

@@ -48,6 +48,7 @@ CATEGORIES = (
     "checkpoint_load",
     "anomaly_rollback",  # sentry-triggered restore-to-last-good
     "restart",          # engine construction, auto-resume, warm restart
+    "param_gather_stall",  # ZeRO-3 whole-model gather (full_params/export)
 )
 
 _HELP = ("Wall-clock seconds attributed to each training-time category "

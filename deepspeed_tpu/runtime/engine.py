@@ -513,10 +513,23 @@ class DeepSpeedTpuEngine:
         bf16_optimizer.py:34 keeps fp32 master weights), sharded per plan."""
         ctx = self.mesh_ctx
         params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype=jnp.float32), params)
-        self.param_shardings = self.zero_plan.param_shardings(params)
-        self.params = jax.device_put(params, self.param_shardings)
+        # Compiler-scheduled ZeRO-3 (runtime/zero3_schedule.py): when the
+        # bucketed wire is on and the mesh qualifies, the fp32 masters live
+        # as 1/dp-sharded flat buckets (+ replicated persistent leaves)
+        # instead of a leaf tree — the optimizer state below is then built
+        # OVER the store, so moments shard identically (params+opt ~dp×
+        # smaller per chip). Grads are store-shaped too.
+        from .zero3_schedule import init_param_store, zero3_store_supported
+        self._zero3_store = None
+        self._zero3_schedule = None
+        if zero3_store_supported(self):
+            init_param_store(self, params)  # sets params/param_shardings/_zero3_store
+        else:
+            self.param_shardings = self.zero_plan.param_shardings(params)
+            self.params = jax.device_put(params, self.param_shardings)
 
-        self.grad_shardings = self.zero_plan.grad_shardings(params)
+        self.grad_shardings = (self.param_shardings if self._zero3_store is not None
+                               else self.zero_plan.grad_shardings(params))
         acc_dtype = self.grad_accum_dtype
         zeros_fn = jax.jit(
             lambda p: jax.tree_util.tree_map(
@@ -560,7 +573,12 @@ class DeepSpeedTpuEngine:
                      f"({cum/total:.2f}) on host, rest on device", ranks=[0])
         else:
             opt_state_shape = jax.eval_shape(self.base_tx.init, self.params)
-            self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
+            if self._zero3_store is not None:
+                from .zero3_schedule import store_opt_state_shardings
+                self.opt_state_shardings = store_opt_state_shardings(
+                    opt_state_shape, self.param_shardings, self.mesh_ctx)
+            else:
+                self.opt_state_shardings = self.zero_plan.opt_state_shardings(opt_state_shape)
             self.opt_state = jax.jit(self.base_tx.init,
                                      out_shardings=self.opt_state_shardings)(self.params)
 
@@ -627,11 +645,23 @@ class DeepSpeedTpuEngine:
         scaler_cfg = self.scaler_cfg
         self._grad_comm_layout = None  # set when the bucketed program engages
 
+        # Scheduled ZeRO-3 store: every program below sees the bucket store
+        # where it used to see the param tree; materialize_params is the
+        # slice-back (under jit, GSPMD turns the sharded-bucket reads into
+        # per-bucket all-gathers — the resilience fallback; the scheduled
+        # train-batch program places those gathers explicitly instead)
+        zmeta = getattr(self, "_zero3_store", None)
+        if zmeta is not None:
+            from .zero3_schedule import materialize_params as _materialize
+
         # ZeRO++ qwZ/qgZ: explicit int8-wire param gather (fwd) and gradient
-        # reduce-scatter (bwd) instead of XLA's implicit bf16 resharding
+        # reduce-scatter (bwd) instead of XLA's implicit bf16 resharding.
+        # Under the bucket store the same int8 wire rides the scheduled
+        # bucket gathers (param_gather_bucket) — no per-leaf wrap needed.
         zc = self._config.zero_config
         qwz_gather = None
-        if zc.zero_quantized_weights and self.zero_plan.stage >= 3 and self.zero_plan.zero_axes:
+        if zc.zero_quantized_weights and self.zero_plan.stage >= 3 \
+                and self.zero_plan.zero_axes and zmeta is None:
             from .zeropp import make_qwz_param_gather
             qwz_gather = make_qwz_param_gather(self.mesh_ctx, self.param_shardings,
                                                qgz=zc.zero_quantized_gradients,
@@ -663,6 +693,8 @@ class DeepSpeedTpuEngine:
                          and qwz_gather is None)
 
         def loss_of(params, args, kwargs, static_kv, scale):
+            if zmeta is not None:
+                params = _materialize(params, zmeta)
             if qwz_gather is not None:
                 params = qwz_gather(params)
             if not cast_in_model:
@@ -682,7 +714,7 @@ class DeepSpeedTpuEngine:
             accumulate. With param_cast="model" the masters go in as-is and
             grads are fp32."""
             if (compute_dtype != jnp.float32 and qwz_gather is None
-                    and not cast_in_model):
+                    and zmeta is None and not cast_in_model):
                 cparams = jax.tree_util.tree_map(
                     lambda x: x.astype(compute_dtype), params)
                 return jax.value_and_grad(loss_from_cparams, has_aux=True)(
@@ -706,6 +738,8 @@ class DeepSpeedTpuEngine:
         )
 
         def fwd_only(params, args, kwargs, static_kv):
+            if zmeta is not None:
+                params = _materialize(params, zmeta)
             if not cast_in_model:
                 params = jax.tree_util.tree_map(
                     lambda x: x.astype(compute_dtype), params)
@@ -872,9 +906,13 @@ class DeepSpeedTpuEngine:
                     self._wire_step = build_wire_step(self, opname)
                     self._wire_freeze_step = int(op.get("freeze_step", 100000))
                 else:
-                    logger.warning("1-bit wire program unavailable (needs gas=1, "
-                                   "ZeRO stage 0, bf16/fp32, pure-DP mesh, no "
-                                   "clipping); falling back to fp32 reduce")
+                    logger.warning("1-bit wire program unavailable (its "
+                                   "stateful optimizer-side compression needs "
+                                   "gas=1, unpartitioned gradients [ZeRO stage "
+                                   "0], bf16/fp32, a pure-DP mesh, and no "
+                                   "gradient clipping); falling back to fp32 "
+                                   "reduce — consider gradient_comm's onebit "
+                                   "tier, which composes with ZeRO stages 1-3")
 
         # gas>1 fused batch: lax.scan over stacked microbatches + optimizer
         # apply, all in ONE XLA program (one dispatch per optimizer step
@@ -928,8 +966,11 @@ class DeepSpeedTpuEngine:
             else:
                 logger.warning(
                     "gradient_comm requested but unsupported here (needs a "
-                    "pure data-parallel mesh, ZeRO stage <= 2, bf16/fp32, "
-                    "device optimizer); gradients exchange via the default "
+                    "pure data-parallel mesh, ZeRO stage <= 3, bf16/fp32, "
+                    "device optimizer; the stage-3 scheduled store further "
+                    "excludes optimizer offload, composed tensor-parallel "
+                    "training, and meshes whose ZeRO axes don't span the "
+                    "full dp world); gradients exchange via the default "
                     "GSPMD reduce")
 
     def _watch_compiled_fns(self):
@@ -954,7 +995,11 @@ class DeepSpeedTpuEngine:
         if getattr(self, "_train_steps_fused", None) is not None:
             self._train_steps_fused = w(self._train_steps_fused,
                                         "train_steps_fused")
-        if getattr(self, "_train_batch_fused", None) is not None:
+        if getattr(self, "_train_batch_fused", None) is not None \
+                and not getattr(self._train_batch_fused, "_zero3_scheduled",
+                                False):
+            # the scheduled ZeRO-3 entry is a lazy python wrapper; its inner
+            # jit is watched at build time under "zero3_scheduled_step"
             self._train_batch_fused = w(self._train_batch_fused,
                                         "train_batch_fused")
         if getattr(self, "_wire_step", None) is not None:
@@ -1592,6 +1637,7 @@ class DeepSpeedTpuEngine:
                 self._grad_comm_layout, self.dp_world_size, str(tier),
                 gcc.quantization_block_size, duration, comm_steps,
                 op="reduce_scatter")
+            self._bank_zero3_gathers(comm_steps)
         if self.monitor is not None:
             self.monitor.flush_events(fetch=host_fetch)
         self._publish_registry_events(
@@ -1714,8 +1760,30 @@ class DeepSpeedTpuEngine:
                 self._grad_comm_layout, self.dp_world_size,
                 str(tier), gcc.quantization_block_size,
                 duration=time.perf_counter() - step_t0, op="reduce_scatter")
+            self._bank_zero3_gathers(1)
         self._resilience_step_boundary(loss=loss, overflow=overflow)
         return loss_val
+
+    def _bank_zero3_gathers(self, steps: int):
+        """Registry accounting for the scheduled ZeRO-3 param gathers:
+        wire bytes actually moved by the bucket all-gathers (post-
+        quantization, receive side per chip) and the prefetch-epoch count —
+        the schedule is static per compiled program, so ``steps`` optimizer
+        steps move exactly ``steps * gas`` microbatch traversals of it."""
+        sched = getattr(self, "_zero3_schedule", None)
+        if sched is None or steps <= 0:
+            return
+        from ..observability import get_registry
+        reg = get_registry()
+        n = steps * self.gradient_accumulation_steps()
+        reg.counter(
+            "ds_zero3_gather_bytes_total",
+            "Scheduled ZeRO-3 param all-gather wire bytes (post-quantization)"
+        ).inc(float(sched.gather_wire_bytes) * n)
+        reg.counter(
+            "ds_zero3_prefetch_hits_total",
+            "ZeRO-3 gather epochs issued ahead of first use (T3 overlap)"
+        ).inc(float(sched.prefetch_count) * n)
 
     def fused_train_step(self, *args, **kwargs):
         """One-program fwd+bwd+step (gas=1 only). Same semantics as
@@ -1962,6 +2030,11 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
 
     def _state_dict(self):
+        # Under the scheduled ZeRO-3 store, "params"/"grad_acc"/"opt_state"
+        # are the store pytrees: orbax writes each sharded bucket from its
+        # owning chips — a per-shard save with NO full gather (the reference
+        # stage-3 default; consolidation stays the explicit
+        # stage3_gather_16bit_weights_on_model_save / save_16bit_model path).
         sd = {
             "params": self.params,
             "grad_acc": self.grad_acc,
@@ -1970,6 +2043,19 @@ class DeepSpeedTpuEngine:
         if self.opt_state is not None:
             sd["opt_state"] = self.opt_state
         return sd
+
+    def full_params(self):
+        """Full leaf-tree fp32 master params. Under the scheduled ZeRO-3
+        store this is the one deliberate whole-model gather (store buckets
+        sliced back into leaves; GSPMD gathers each bucket) — used by the
+        explicit consolidation paths, and accounted to the
+        ``param_gather_stall`` goodput category."""
+        if getattr(self, "_zero3_store", None) is None:
+            return self.params
+        from .zero3_schedule import materialize_params
+        meta = self._zero3_store
+        with self._obs_span("param_gather_stall"):
+            return jax.jit(lambda s: materialize_params(s, meta))(self.params)
 
     def _checkpoint_tag_validation(self, tag) -> None:
         """All processes must agree on the tag before anyone writes
@@ -2015,6 +2101,17 @@ class DeepSpeedTpuEngine:
             if self.training_dataloader is not None else None
         if sampler is not None and hasattr(sampler, "state_dict"):
             sd["data_sampler"] = sampler.state_dict()
+        if getattr(self, "_zero3_store", None) is not None:
+            # enough to rebuild the exact bucket layout at load time (the
+            # planner is deterministic given these + the leaf structs), so a
+            # stage-2 engine can reshard a stage-3 checkpoint and vice versa
+            m = self._zero3_store
+            sd["zero3_store"] = {
+                "bucket_size_mb": float(m.bucket_size_mb),
+                "pad_multiple": int(m.pad_multiple),
+                "persistent_idx": [int(i) for i in m.p_idx],
+                "n_leaves": int(m.n_leaves),
+            }
         return sd
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
@@ -2071,7 +2168,8 @@ class DeepSpeedTpuEngine:
         # uint16 with a dtype sidecar key (fp16 stores natively)
         bf16 = self.compute_dtype == jnp.bfloat16
         sd = {}
-        for k, v in _flatten(jax.tree_util.tree_map(np.asarray, self.params)).items():
+        for k, v in _flatten(jax.tree_util.tree_map(np.asarray,
+                                                    self.full_params())).items():
             if bf16:
                 import ml_dtypes
                 sd[k] = np.asarray(v).astype(ml_dtypes.bfloat16).view(np.uint16)
@@ -2089,6 +2187,13 @@ class DeepSpeedTpuEngine:
         config flag): fp32 fragments are re-laid-out onto the live mesh's
         shardings regardless of what topology wrote them."""
         from ..checkpoint.universal import load_universal_into
+        if getattr(self, "_zero3_store", None) is not None:
+            raise NotImplementedError(
+                "universal-checkpoint load into the scheduled ZeRO-3 param "
+                "store is not supported yet — regular checkpoints reshard "
+                "automatically on load_checkpoint (stage 2<->3); to consume "
+                "a universal checkpoint, load it at zero stage <= 2 and "
+                "save a regular checkpoint")
         params_host = jax.tree_util.tree_map(lambda x: np.zeros(x.shape, jnp.float32),
                                              jax.eval_shape(lambda p: p, self.params))
         params, opt_state, meta = load_universal_into(universal_dir, params_host,
@@ -2140,11 +2245,18 @@ class DeepSpeedTpuEngine:
                 return None, {}
         path = os.path.join(load_dir, str(tag))
 
-        # abstract target: restore straight into the live shardings
-        target = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if hasattr(x, "sharding") else x, self._state_dict())
-        restored, host_state = self.checkpoint_engine.load(path, target=target)
+        saved_store = self._peek_zero3_store_meta(path)
+        if (saved_store is not None) != (getattr(self, "_zero3_store", None)
+                                         is not None):
+            # the checkpoint's arrays are in the OTHER param format
+            # (bucketed ZeRO-3 store vs leaf tree): reshard on load
+            restored, host_state = self._reshard_load(path, saved_store)
+        else:
+            # abstract target: restore straight into the live shardings
+            target = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+                if hasattr(x, "sharding") else x, self._state_dict())
+            restored, host_state = self.checkpoint_engine.load(path, target=target)
         self.params = restored["params"]
         if load_optimizer_states and not load_module_only:
             if "opt_state" in restored:
@@ -2178,3 +2290,118 @@ class DeepSpeedTpuEngine:
                 sampler.load_state_dict(host_state["data_sampler"])
         self._last_good_tag = str(tag)
         return path, client_state
+
+    def _peek_zero3_store_meta(self, path):
+        """Read the checkpoint's host-state sidecar (tiny pickle, no array
+        data) to learn whether its arrays were saved in ZeRO-3 store form;
+        returns the saved store descriptor or None."""
+        import pickle
+        from ..checkpoint.engine import OrbaxCheckpointEngine
+        f = os.path.join(path, OrbaxCheckpointEngine.HOST_STATE_FILE)
+        if not os.path.exists(f):
+            return None
+        try:
+            with open(f, "rb") as fh:
+                hs = pickle.load(fh)
+        except Exception as e:  # legacy/foreign sidecar: same-format load
+            logger.warning(f"could not peek host state at {f}: {e}")
+            return None
+        return (hs or {}).get("zero3_store")
+
+    def _reshard_load(self, path, saved_store):
+        """Stage 2<->3 reshard-on-load: restore into an abstract target
+        shaped like the SAVE-time format, then convert on device into the
+        live format. Both directions are exact (pure slice/concat of fp32
+        masters and moments), so a 2->3->2 round trip is bitwise."""
+        from .zero3_schedule import (build_store_meta, map_store_subtrees,
+                                     materialize_params, store_from_tree)
+        repl = self.mesh_ctx.replicated()
+
+        def _repl_struct(t):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=repl), t)
+
+        acc_dtype = self.grad_accum_dtype
+        scale_target = _repl_struct(tuple(self.scale_state))
+        if saved_store is not None:
+            # checkpoint holds the bucketed store; live engine wants a tree
+            fp32_tree = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                self.params)
+            meta = build_store_meta(fp32_tree, saved_store["persistent_idx"],
+                                    saved_store["bucket_size_mb"],
+                                    saved_store["pad_multiple"])
+            if meta.n_leaves != int(saved_store.get("n_leaves",
+                                                    meta.n_leaves)):
+                raise ValueError(
+                    f"checkpoint ZeRO-3 store covers "
+                    f"{saved_store['n_leaves']} param leaves but the live "
+                    f"model has {meta.n_leaves}")
+
+            def _store_struct(dtype):
+                return {"buckets": [jax.ShapeDtypeStruct((b.padded_size, ),
+                                                         dtype, sharding=repl)
+                                    for b in meta.layout.buckets],
+                        "persistent": [jax.ShapeDtypeStruct(
+                            meta.leaf_structs[i].shape, dtype, sharding=repl)
+                            for i in meta.p_idx]}
+
+            target = {"params": _store_struct(jnp.float32),
+                      "grad_acc": _store_struct(acc_dtype),
+                      "scale_state": scale_target}
+            if self.opt_state is not None:
+                target["opt_state"] = _repl_struct(jax.eval_shape(
+                    self.base_tx.init, _store_struct(jnp.float32)))
+            restored, host_state = self.checkpoint_engine.load(path,
+                                                               target=target)
+            out = {"params": jax.jit(
+                       lambda s: materialize_params(s, meta),
+                       out_shardings=self.param_shardings)(restored["params"]),
+                   "grad_acc": jax.jit(
+                       lambda s: materialize_params(s, meta),
+                       out_shardings=self.grad_shardings)(restored["grad_acc"]),
+                   "scale_state": restored["scale_state"]}
+            if "opt_state" in restored:
+                store_def = jax.tree_util.tree_structure(
+                    _store_struct(jnp.float32))
+                out["opt_state"] = jax.jit(
+                    lambda o: map_store_subtrees(
+                        o, store_def, lambda s: materialize_params(s, meta)),
+                    out_shardings=self.opt_state_shardings)(
+                        restored["opt_state"])
+            log_dist(f"resharded ZeRO-3 store checkpoint {path} into the "
+                     f"live leaf-tree layout (stage 3 -> "
+                     f"{self.zero_plan.stage})", ranks=[0])
+            return out, host_state
+        # checkpoint holds a leaf tree; live engine runs the ZeRO-3 store
+        meta = self._zero3_store
+        leaves_f32 = [jax.ShapeDtypeStruct(s.shape, jnp.float32,
+                                           sharding=repl)
+                      for s in meta.leaf_structs]
+        fp32_tree = jax.tree_util.tree_unflatten(meta.treedef, leaves_f32)
+        acc_tree = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, acc_dtype, sharding=repl),
+            fp32_tree)
+        target = {"params": fp32_tree, "grad_acc": acc_tree,
+                  "scale_state": scale_target}
+        if self.opt_state is not None:
+            target["opt_state"] = _repl_struct(jax.eval_shape(
+                self.base_tx.init, fp32_tree))
+        restored, host_state = self.checkpoint_engine.load(path,
+                                                           target=target)
+        out = {"params": jax.jit(
+                   lambda t: store_from_tree(t, meta),
+                   out_shardings=self.param_shardings)(restored["params"]),
+               "grad_acc": jax.jit(
+                   lambda t: store_from_tree(t, meta),
+                   out_shardings=self.grad_shardings)(restored["grad_acc"]),
+               "scale_state": restored["scale_state"]}
+        if "opt_state" in restored:
+            out["opt_state"] = jax.jit(
+                lambda o: map_store_subtrees(
+                    o, meta.treedef, lambda t: store_from_tree(t, meta)),
+                out_shardings=self.opt_state_shardings)(restored["opt_state"])
+        log_dist(f"resharded leaf-tree checkpoint {path} into the live "
+                 f"ZeRO-3 bucket store", ranks=[0])
+        return out, host_state

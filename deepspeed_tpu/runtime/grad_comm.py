@@ -37,7 +37,12 @@ resets at each boundary — documented in docs/comm_compression.md).
 
 Constraints (checked by ``grad_comm_supported``): pure-DP mesh (model/seq/
 expert/pipe axes trivial), no fp16 loss scaling (the overflow check wants
-the exact fp32 reduce), ZeRO stage <= 2, device optimizer (no host offload).
+the exact fp32 reduce), ZeRO stage <= 3, device optimizer (no host offload).
+Stage 3 dispatches to the compiler-scheduled program in
+``runtime/zero3_schedule.py`` — params live as 1/dp bucket shards and each
+bucket's all-gather is woven into the scan one epoch ahead of use; its
+gradients exit through the same ``reduce_scatter_bucket`` wire (the gather's
+transpose), so the stage-2 numerics carry over bitwise on the fp32 tier.
 """
 
 from typing import List
@@ -91,6 +96,13 @@ def grad_comm_supported(engine) -> bool:
     cfg = engine._config
     ctx = engine.mesh_ctx
     dp = sum(ctx.axis_size(a) > 1 for a in ("data", "fsdp"))
+    if cfg.zero_config.stage >= 3:
+        # stage 3 runs the scheduled param-store program, which needs the
+        # store to have been installed at init (its own support predicate:
+        # additionally no offload, no composed TP, ZeRO axes == dp world)
+        from .zero3_schedule import zero3_store_supported
+        return (zero3_store_supported(engine)
+                and getattr(engine, "_zero3_store", None) is not None)
     return (cfg.zero_config.stage <= 2
             and not cfg.fp16_enabled
             and dp >= 1  # something to reduce over
@@ -112,7 +124,12 @@ def build_grad_comm_step(engine, apply_step):
     if not grad_comm_supported(engine):
         raise ValueError(
             "the bucketed gradient-comm program needs a pure data-parallel "
-            "mesh, ZeRO stage <= 2, bf16/fp32, and a device optimizer")
+            "mesh, ZeRO stage <= 3, bf16/fp32, and a device optimizer "
+            "(stage 3 additionally: no offload, no composed tensor-parallel, "
+            "ZeRO axes spanning the full dp world)")
+    if engine.zero_plan.stage >= 3:
+        from .zero3_schedule import build_zero3_step
+        return build_zero3_step(engine, apply_step)
     cfg = engine._config
     gc = cfg.gradient_comm_config
     ctx = engine.mesh_ctx

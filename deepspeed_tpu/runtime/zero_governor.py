@@ -54,6 +54,31 @@ def chunk_size_for(n_layers: int, per_layer_elems: int,
     return best
 
 
+def gather_bucket_mb(bucket_size_mb: float,
+                     max_live_parameters: Optional[int] = None,
+                     prefetch_bucket_size: Optional[int] = None,
+                     itemsize: int = 4) -> float:
+    """Effective bucket budget (MB) for the scheduled ZeRO-3 param store.
+
+    The schedule keeps at most two bucket epochs in flight (current + one
+    prefetched), so a bucket may not exceed half ``max_live_parameters``;
+    the reference's ``stage3_prefetch_bucket_size`` caps one in-flight
+    gather directly. Both are element counts — converted at ``itemsize``
+    (fp32 masters). The defaults (1e9 / 5e7 elements) are far above the
+    25MB comm bucket, so out of the box this is a no-op.
+    """
+    cap: Optional[int] = None
+    if max_live_parameters and max_live_parameters > 0:
+        cap = int(max_live_parameters) // 2
+    if prefetch_bucket_size and prefetch_bucket_size > 0:
+        cap = min(cap, int(prefetch_bucket_size)) if cap is not None \
+            else int(prefetch_bucket_size)
+    if cap is None:
+        return bucket_size_mb
+    cap_mb = max(cap * itemsize / 2**20, 1 / 2**20)
+    return min(bucket_size_mb, cap_mb)
+
+
 def governed_layer_scan(layer_apply: Callable,
                         stacked_params,
                         x,

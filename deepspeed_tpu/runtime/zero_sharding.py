@@ -278,6 +278,17 @@ class ZeroShardingPlan:
                 out.append(NamedSharding(self.ctx.mesh, P()))
         return out
 
+    def param_store_shardings(self, layout, n_persistent: int):
+        """Shardings for the ZeRO-3 bucketed parameter STORE
+        (``runtime/zero3_schedule.py``): the fp32 masters live as flat
+        1-D buckets sharded over the ZeRO axes — 1/dp of every parameter
+        per chip, the stage-3 residency the reference keeps in
+        ``param.ds_tensor`` — while persistent (small) leaves replicate.
+        """
+        repl = NamedSharding(self.ctx.mesh, P())
+        return {"buckets": list(self.bucket_shardings(layout)),
+                "persistent": [repl] * n_persistent}
+
     def batch_sharding(self, batch, stacked: bool = False):
         """Batch is sharded over the full data-parallel world on dim 0
         (``stacked=True``: dim 0 is a microbatch axis; shard dim 1)."""
