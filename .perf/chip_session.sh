@@ -198,6 +198,13 @@ PYEOF
 # wire bytes, max |dlogit| vs fp wire; the >=3x wire-byte reduction is a
 # hard assert inside the rung on the fp32-activation arm
 run bench_serving_tp 1500 env DS_BENCH_TP=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_TP.json
+# 15k. disaggregated prefill/decode serving: a 4-forced-host-device child
+# (2 prefill + 2 decode) runs the same mixed short-chat/long-document
+# open-loop arrival schedule with disagg ON vs the continuous-fusion
+# baseline — decode inter-token p99 is the headline, aggregate tok/s +
+# TTFT p50 the no-regression guardrails; the A/B summary is journaled to
+# BENCH_HISTORY.jsonl and gated round-over-round by bin/ds_benchdiff
+run bench_serving_disagg 1500 env DS_BENCH_DISAGG=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_DISAGG.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -115,6 +115,15 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
                                          new_tokens=4 * decode_steps,
                                          window=FUSED_K if on_tpu else 4,
                                          token_budget=256 if on_tpu else 96))
+    # DS_BENCH_DISAGG=1: disaggregated prefill/decode serving — a CHILD
+    # process over 4 forced host devices (2 prefill + 2 decode) runs the
+    # SAME mixed short-chat/long-document open-loop arrival schedule with
+    # disagg ON vs the continuous-fusion baseline: decode inter-token p99
+    # is the headline (long prefills leave the decode group's dispatch
+    # path), aggregate tok/s + TTFT p50 are the no-regression guardrails;
+    # the A/B lands in BENCH_HISTORY.jsonl for bin/ds_benchdiff
+    if env_flag("DS_BENCH_DISAGG"):
+        results.extend(_measure_disagg())
     # DS_BENCH_TP=1: quantized tensor-parallel serving — tp=2 in a CHILD
     # process over forced host devices (the parent's jax is already
     # committed to its own device set), A/B over {fp, int8} collective
@@ -753,25 +762,36 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
                                             build_llama_engine,
                                             RaggedInferenceEngineConfig)
     rng = np.random.default_rng(53)
-    prompts = [rng.integers(0, cfg.vocab_size, size=ctx).tolist()
-               for _ in range(n_requests)]
+    # mixed-length open-loop workload: a short-chat arm and a long-document
+    # arm (~30% long). Long prefills arriving while short chats decode is
+    # the regime both continuous fusion and disaggregation target; a
+    # single-length sweep never exercises it.
+    short_ctx = max(kv_block, ctx // 4)
+    lens = [ctx if rng.random() < 0.3 else short_ctx
+            for _ in range(n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, size=L).tolist()
+               for L in lens]
 
     # KV sized so the scheduler's full-reservation admission caps live
-    # concurrency at 8: a standing queue forms under supercritical
-    # arrivals and every finisher triggers an admission+prefill — the
-    # production churn where the legacy gate keeps demoting the wave.
-    # The cap also bounds the wave's batch bucket at 8, so warmup only
-    # needs (and the cache only needs to hold) 8 full-context scratch
-    # sequences (warmup puts skip can_schedule, so an undersized cache
-    # would surface as a block-table IndexError, not a SchedulingError).
+    # LONG-document concurrency at 8: a standing queue forms under
+    # supercritical arrivals and every finisher triggers an
+    # admission+prefill — the production churn where the legacy gate keeps
+    # demoting the wave. Short-chat requests reserve fewer blocks, so live
+    # concurrency can exceed the long cap — warm the wave buckets up to
+    # the short-arm cap too (warmup puts skip can_schedule, so an
+    # undersized cache would surface as a block-table IndexError, not a
+    # SchedulingError).
     cap = 8
     blocks_per_req = (ctx + new_tokens + kv_block - 1) // kv_block
-    bss = [b for b in (1, 2, 4, 8) if b <= cap]
+    num_blocks = cap * blocks_per_req + 2
+    blocks_short = (short_ctx + new_tokens + kv_block - 1) // kv_block
+    cap_hi = max(cap, num_blocks // blocks_short)
+    bss = [b for b in (1, 2, 4, 8, 16, 32) if b <= cap_hi] or [1]
 
     def _build(overlap):
         eng = build_llama_engine(
             cfg, engine_config=RaggedInferenceEngineConfig(
-                num_kv_blocks=cap * blocks_per_req + 2,
+                num_kv_blocks=num_blocks,
                 continuous_fusion={"enabled": overlap},
                 # open loop must stay open: never shed the offered excess
                 serving_resilience={"max_queued": 0}),
@@ -817,9 +837,14 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
             return (round(ttfts[min(len(ttfts) - 1,
                                     int(q * len(ttfts)))], 4)
                     if ttfts else None)
+        plens = [len(p) for p in prompts]
+        h_counts, h_edges = np.histogram(plens,
+                                         bins=min(8, len(set(plens)) + 1))
         out = {"wall_s": round(dt, 2),
                "aggregate_tok_s": round(total / dt, 2),
                "ttft_p50_s": pct(0.50), "ttft_p99_s": pct(0.99),
+               "prompt_len_hist": {"edges": [int(e) for e in h_edges],
+                                   "counts": [int(c) for c in h_counts]},
                "fused_occupancy": stats["fused_occupancy"],
                "mean_fused_K": stats["mean_fused_K"],
                "prefill_overlap_tokens": stats["prefill_overlap_tokens"]}
@@ -869,6 +894,7 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
         gaps = gaps_unit / rate
         for overlap in (False, True):
             row = {"backend": backend, "context": ctx, "arrivals": True,
+                   "mixed_lengths": True, "short_context": short_ctx,
                    "fused_window": window, "requests": n_requests,
                    "new_tokens_per_req": new_tokens,
                    "offered_load": load,
@@ -1040,6 +1066,189 @@ def _measure_tp_child():
     return rows
 
 
+def _measure_disagg():
+    """Parent half of the DS_BENCH_DISAGG rung: run the disagg-vs-
+    continuous-fusion A/B in a subprocess whose env forces 4 virtual host
+    devices (2 prefill + 2 decode; this process's jax backend is already
+    initialized and cannot re-shape its device set), collect the child's
+    JSON rows from its last stdout line, and journal the A/B summary to
+    BENCH_HISTORY.jsonl so bin/ds_benchdiff gates it round-over-round."""
+    import subprocess
+    import sys
+    from deepspeed_tpu.utils.hostdev import force_host_devices_env
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = force_host_devices_env(4, extra={"PYTHONPATH": repo,
+                                           "DS_BENCH_DISAGG_CHILD": "1"})
+    out = subprocess.run([sys.executable,
+                          os.path.join(repo, "bench_serving.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=1200)
+    if out.returncode != 0:
+        return [{"rung": "disagg", "error": (out.stderr or out.stdout)[-800:]}]
+    rows = json.loads(out.stdout.splitlines()[-1])
+    summary = [r for r in rows if r.get("summary")]
+    if summary:
+        s = summary[-1]
+        from bench import _history_path, _journal_append
+        _journal_append(_history_path(), {
+            "rung": "serving-disagg",
+            "metric": "inter_token_p99_base_over_disagg",
+            # baseline p99 / disagg p99 — > 1.0 means the decode group's
+            # inter-token tail beat the continuous-fusion baseline; higher
+            # is better, so a regression here trips ds_benchdiff
+            "value": s.get("inter_token_p99_ratio", 0.0),
+            "unit": "baseline inter-token p99 / disagg p99",
+            "tok_s_ratio": s.get("tok_s_ratio"),
+            "ttft_p50_ratio": s.get("ttft_p50_ratio")})
+    return rows
+
+
+def _measure_disagg_child():
+    """Child half of DS_BENCH_DISAGG (runs at the forced 4-device count):
+    the SAME mixed short-chat/long-document open-loop arrival schedule
+    against (a) the continuous-fusion baseline and (b) the disaggregated
+    prefill/decode split with the overlapped KV-page handoff. The headline
+    is the decode inter-token p99 (registry-delta over the run): routing
+    long prefills to their own group keeps them out of the decode group's
+    dispatch path, so the decode tail should tighten while aggregate tok/s
+    and TTFT p50 hold."""
+    import time
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (ServingScheduler,
+                                            build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.disagg import build_disagg_llama
+    from deepspeed_tpu.models import LlamaConfig
+    from deepspeed_tpu.observability import (histogram_delta,
+                                             quantiles_from_counts)
+
+    cfg = LlamaConfig.tiny(max_position_embeddings=2048)
+    rng = np.random.default_rng(29)
+    n_requests = 12
+    kv_block = 64
+    short_ctx, long_ctx = 64, 384
+    # ~40% long documents: enough long prefills in flight to pressure the
+    # decode path, enough short chats decoding to feel that pressure
+    lens = [long_ctx if rng.random() < 0.4 else short_ctx
+            for _ in range(n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, size=L).tolist()
+               for L in lens]
+    new_tokens = 32
+    window = 4
+    # budget sized so a long-document prompt prefills across SEVERAL ticks
+    # — the regime where in-group prefill chunks contend with the decode
+    # wave and a separate prefill group pays off
+    token_budget = 96
+    blocks_long = (long_ctx + new_tokens + kv_block - 1) // kv_block
+    num_blocks = 8 * blocks_long + 4
+
+    def _build(disagg_on):
+        ec = RaggedInferenceEngineConfig(
+            num_kv_blocks=num_blocks,
+            serving_resilience={"max_queued": 0})
+        if disagg_on:
+            ec.disaggregation.enabled = True
+            return build_disagg_llama(cfg, engine_config=ec, seed=5,
+                                      kv_block_size=kv_block)
+        return build_llama_engine(cfg, engine_config=ec, seed=5,
+                                  kv_block_size=kv_block), None
+
+    def _run(eng, ds, gaps):
+        sched = ServingScheduler(eng, idle_wait=0.001,
+                                 token_budget=token_budget,
+                                 fused_decode_window=window,
+                                 disagg=ds).start()
+        obs = sched.observability
+        before = (obs.registry.snapshot() if obs is not None else None)
+        handles = []
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            if gaps is not None:
+                target = t0 + float(np.sum(gaps[:i + 1]))
+                while (d := target - time.perf_counter()) > 0:
+                    time.sleep(min(d, 0.002))
+            handles.append(sched.submit(p, max_new_tokens=new_tokens))
+        for h in handles:
+            h.result(600)
+        dt = time.perf_counter() - t0
+        ttfts = sorted(h._req.t_first - h._req.t_submit
+                       for h in handles if h._req.t_first)
+        total = sum(len(h._req.outputs) for h in handles)
+        out = {"wall_s": round(dt, 2),
+               "aggregate_tok_s": round(total / dt, 2),
+               "ttft_p50_s": (round(ttfts[len(ttfts) // 2], 4)
+                              if ttfts else None)}
+        if obs is not None:
+            after = obs.registry.snapshot()
+            d = histogram_delta(before.get("ds_inter_token_seconds"),
+                                after["ds_inter_token_seconds"])
+            qs = quantiles_from_counts(d["edges"], d["counts"], (0.99, ))
+            out["inter_token_p99_s"] = (round(qs[0], 5)
+                                        if qs[0] is not None else None)
+        dstats = sched.stats.get("disagg")
+        if dstats is not None:
+            out["handoffs"] = dstats["handoffs_total"]
+            out["degraded"] = dstats["degraded_total"]
+        sched.stop()
+        return out
+
+    plens = [len(p) for p in prompts]
+    h_counts, h_edges = np.histogram(plens, bins=4)
+    len_hist = {"edges": [int(e) for e in h_edges],
+                "counts": [int(c) for c in h_counts]}
+    # one normalized arrival pattern; BOTH arms see the identical schedule,
+    # calibrated ONCE from the baseline arm's clean closed-loop capacity at
+    # 2x (supercritical: a queue forms and long prefills genuinely contend
+    # with decode). Per-arm calibration would hand the slower arm an easier
+    # schedule and the A/B would compare different workloads.
+    gaps_unit = rng.exponential(1.0, size=n_requests)
+    engines = {on: _build(on) for on in (False, True)}
+    cal = {}
+    for on in (False, True):
+        eng, ds = engines[on]
+        _run(eng, ds, gaps=None)            # compile-polluted warm pass
+        cal[on] = _run(eng, ds, gaps=None)  # clean closed-loop capacity
+    rate = 2.0 * cal[False]["aggregate_tok_s"] / new_tokens
+    gaps = gaps_unit / rate
+    rows, arm = [], {}
+    for disagg_on in (False, True):
+        eng, ds = engines[disagg_on]
+        # the open-loop interleaving hits ragged buckets the closed-loop
+        # warm passes never compiled — burn them off the clock first
+        _run(eng, ds, gaps)
+        # median-of-3 by wall clock: seconds-scale cells, one straggler
+        # must not own the arm
+        reps = sorted((_run(eng, ds, gaps) for _ in range(3)),
+                      key=lambda r: r["wall_s"])
+        arm[disagg_on] = reps[1]
+        rows.append({"rung": "disagg", "disagg": disagg_on,
+                     "requests": n_requests,
+                     "short_context": short_ctx, "long_context": long_ctx,
+                     "new_tokens_per_req": new_tokens,
+                     "token_budget": token_budget,
+                     "prompt_len_hist": len_hist, **reps[1]})
+    base, dis = arm[False], arm[True]
+    summary = {"rung": "disagg", "summary": True,
+               "inter_token_p99_base_s": base.get("inter_token_p99_s"),
+               "inter_token_p99_disagg_s": dis.get("inter_token_p99_s"),
+               "tok_s_base": base["aggregate_tok_s"],
+               "tok_s_disagg": dis["aggregate_tok_s"],
+               "ttft_p50_base_s": base["ttft_p50_s"],
+               "ttft_p50_disagg_s": dis["ttft_p50_s"]}
+    if base.get("inter_token_p99_s") and dis.get("inter_token_p99_s"):
+        r = base["inter_token_p99_s"] / dis["inter_token_p99_s"]
+        summary["inter_token_p99_ratio"] = round(r, 3)
+        summary["inter_token_p99_improved"] = r > 1.0
+    if base["aggregate_tok_s"]:
+        summary["tok_s_ratio"] = round(
+            dis["aggregate_tok_s"] / base["aggregate_tok_s"], 3)
+    if base["ttft_p50_s"] and dis["ttft_p50_s"]:
+        summary["ttft_p50_ratio"] = round(
+            base["ttft_p50_s"] / dis["ttft_p50_s"], 3)
+    rows.append(summary)
+    return rows
+
+
 def _vs_baseline(results):
     """NUMERIC paged-vs-dense ratio scored against the FastGen 2.3x bar, so
     a serving regression is machine-checkable round-over-round instead of a
@@ -1071,6 +1280,11 @@ def main():
         # forced-host-device child of the DS_BENCH_TP rung: emit rows as
         # the last stdout line and skip the normal sweep entirely
         print(json.dumps(_measure_tp_child()))
+        return 0
+    if env_flag("DS_BENCH_DISAGG_CHILD"):
+        # forced-host-device child of the DS_BENCH_DISAGG rung (4 devices:
+        # 2 prefill + 2 decode)
+        print(json.dumps(_measure_disagg_child()))
         return 0
     import jax
     platform = jax.devices()[0].platform

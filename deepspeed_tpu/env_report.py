@@ -192,6 +192,27 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"zero defaults {'.' * 35} {NO} ({e})")
     try:
+        # disaggregated serving: what the default-config planner would
+        # carve THIS host's devices into — the group topology a
+        # ``--disagg`` daemon would serve with, or the fallback reason
+        from .inference.v2.config_v2 import DisaggregationConfig
+        from .inference.v2.disagg import plan_groups
+        dcfg = DisaggregationConfig(enabled=True)
+        plan = plan_groups(dcfg)
+        if plan is not None:
+            lines.append(
+                f"disagg group topology {'.' * 27} prefill "
+                f"{[d.id for d in plan.prefill_devices]} "
+                f"(tp={plan.prefill_tp}) | decode "
+                f"{[d.id for d in plan.decode_devices]}")
+        else:
+            lines.append(
+                f"disagg group topology {'.' * 27} single group "
+                f"({len(jax.local_devices())} device(s) — continuous-"
+                f"fusion fallback)")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"disagg group topology {'.' * 27} {NO} ({e})")
+    try:
         devs = jax.devices()
         lines.append(f"platform {'.' * 40} {devs[0].platform}")
         lines.append(f"device count {'.' * 36} {len(devs)}")

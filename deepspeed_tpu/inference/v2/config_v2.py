@@ -258,6 +258,77 @@ class ContinuousFusionConfig(ConfigModel):
         return self
 
 
+class DisaggregationConfig(ConfigModel):
+    """Disaggregated prefill/decode serving: carve the local device set
+    into a PREFILL group and a DECODE group, so long-prompt prefill chunks
+    run on their own chips concurrently with the decode group's fused
+    K-step wave — the continuous-fusion overlap extended from time into
+    space. Completed prefix KV pages migrate to the decode group's paged
+    pool through a double-buffered async ``device_put`` handoff queue
+    (``inference/v2/disagg.py``); token streams stay bit-identical to the
+    single-group path because routing only changes WHERE the same compiled
+    programs run, never the per-sequence PRNG key chains or the sampled
+    values they produce."""
+
+    enabled: bool = False
+    """Master gate. When the local device set cannot yield two non-empty
+    groups (single-device hosts, ``prefill_fraction`` rounding to zero)
+    the planner falls back to plain time-overlap continuous fusion rather
+    than failing — unless explicit device lists were given, which must be
+    honorable."""
+
+    prefill_fraction: float = 0.5
+    """Fraction of local devices carved into the prefill group (rounded,
+    clamped to leave at least one decode device). Ignored when explicit
+    ``prefill_devices``/``decode_devices`` lists are set."""
+
+    prefill_devices: Optional[Tuple[int, ...]] = None
+    """Explicit prefill-group device ids (``jax.local_devices()`` ids).
+    Must be disjoint from ``decode_devices``; both lists are validated
+    against the live device set at plan time."""
+
+    decode_devices: Optional[Tuple[int, ...]] = None
+    """Explicit decode-group device ids. When only one of the two lists is
+    given, the other group takes the remaining local devices."""
+
+    prefill_tp_size: int = 1
+    """Tensor-parallel degree inside the prefill group (PR 12 sharding on
+    a private per-group mesh). Must divide the prefill group size."""
+
+    prefill_kv_blocks: Optional[int] = None
+    """KV pool size of the prefill group's engine. None inherits the
+    decode engine's ``num_kv_blocks`` sizing. The prefill pool only holds
+    prompts in flight toward handoff, so it can run much smaller."""
+
+    max_inflight_transfers: int = 2
+    """Handoff queue depth: transfer batches in flight at once. 2 =
+    double-buffered (transfer of chunk N overlaps prefill of chunk N+1);
+    submitting past the cap drains the oldest batch first."""
+
+    stall_timeout_s: float = 5.0
+    """Watchdog: a handoff transfer not ready after this long counts as
+    wedged — the request degrades to in-group (decode-side) prefill and
+    the disagg router latches degraded, so admission never stalls behind
+    a dead interconnect."""
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not 0.0 <= self.prefill_fraction < 1.0:
+            raise ValueError("prefill_fraction must be in [0, 1), got "
+                             f"{self.prefill_fraction}")
+        if self.prefill_tp_size < 1:
+            raise ValueError("prefill_tp_size must be >= 1")
+        if self.max_inflight_transfers < 1:
+            raise ValueError("max_inflight_transfers must be >= 1")
+        if self.stall_timeout_s <= 0:
+            raise ValueError("stall_timeout_s must be > 0")
+        if (self.prefill_devices is not None and self.decode_devices is not None
+                and set(self.prefill_devices) & set(self.decode_devices)):
+            raise ValueError("prefill_devices and decode_devices overlap: "
+                             f"{set(self.prefill_devices) & set(self.decode_devices)}")
+        return self
+
+
 class ObservabilityConfig(ConfigModel):
     """Serving observability: the metrics registry, per-request span
     tracer, and on-demand profiler capture (``deepspeed_tpu/observability``).
@@ -352,6 +423,8 @@ class RaggedInferenceEngineConfig(ConfigModel):
         default_factory=DurableServingConfig)
     continuous_fusion: ContinuousFusionConfig = Field(
         default_factory=ContinuousFusionConfig)
+    disaggregation: DisaggregationConfig = Field(
+        default_factory=DisaggregationConfig)
     observability: ObservabilityConfig = Field(
         default_factory=ObservabilityConfig)
 

@@ -1,0 +1,517 @@
+"""Disaggregated prefill/decode serving: split device groups with an
+overlapped KV-page handoff.
+
+The continuous-fusion scheduler (PR 10) overlaps prefill and decode in
+TIME on one device group — but a long prompt still steals token budget
+and device cycles from the fused K-step wave, inflating decode
+inter-token p99. This module extends the overlap into SPACE:
+
+* the local device set is carved into a PREFILL group and a DECODE group
+  (``disaggregation`` config block: ``prefill_fraction`` or explicit
+  device lists; per-group TP reuses the PR 12 sharding on a *private*
+  mesh, so both groups' engines coexist in one process);
+* the server scheduler routes ``pending > 1`` requests to the prefill
+  group, which runs chunked prefill concurrently with the decode group's
+  fused wave;
+* completed prefix KV pages migrate through :class:`HandoffQueue` — a
+  double-buffered async ``jax.device_put`` mover. Each transfer batch is
+  LAYER-BATCHED by construction: the paged pool is one
+  ``[2L, slots, KV*D]`` array with a block's slots contiguous, so one
+  slice per block carries every layer's K and V at once. The transfer of
+  chunk N overlaps prefill of chunk N+1 (submission is async; at the
+  in-flight cap the *submitter* blocks, never the decode group), and
+  pages land in the decode pool via a jitted donated
+  ``dynamic_update_slice`` at block granularity — the landed blocks then
+  enter the decode engine's descriptor/prefix-cache accounting exactly
+  like locally computed prefill (``InferenceEngineV2.adopt_handoff``).
+
+Invariants:
+
+* **Bit-identical streams.** Routing changes WHERE the same compiled
+  programs run, never the per-sequence PRNG key chains (tracked as
+  ``key_burns`` on the request, engine-independent) or the values they
+  produce — greedy, sampled and fused-speculative streams match the
+  single-group path token for token, including across journal replay.
+* **Never blocks the decode dispatch.** Landing only happens for
+  transfer batches that are already ready on the wire (``is_ready``);
+  backpressure past ``max_inflight_transfers`` blocks the prefill-side
+  submitter instead.
+* **Graceful fallback.** One-device hosts, ``prefill_fraction`` rounding
+  to zero, or sliding-window models plan to ``None`` — the scheduler
+  then runs plain time-overlap continuous fusion. A wedged transfer
+  (watchdog: ``stall_timeout_s``, fault site ``disagg.transfer_stall``)
+  degrades the request to in-group prefill and latches the router
+  degraded, so admission never stalls behind a dead interconnect.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...observability import get_registry
+from ...utils.fault_injection import get_fault_injector
+from ...utils.logging import logger
+from .config_v2 import DisaggregationConfig, RaggedInferenceEngineConfig
+from .engine_v2 import InferenceEngineV2
+from .scheduling_utils import SchedulingError
+
+# module-level handles (same idiom as engine_v2): both /metrics and the
+# bench registry-delta percentiles read these
+_obs = get_registry()
+_transfer_bytes = _obs.histogram(
+    "ds_disagg_transfer_bytes", "KV bytes per handoff transfer batch",
+    lo=1.0, hi=1e12, buckets_per_decade=5)
+_transfer_seconds = _obs.histogram(
+    "ds_disagg_transfer_seconds",
+    "Handoff transfer batch submit→land latency")
+_handoffs_total = _obs.counter(
+    "ds_disagg_handoffs_total", "Requests handed off prefill→decode")
+_degraded_total = _obs.counter(
+    "ds_disagg_degraded_total",
+    "Requests degraded to in-group prefill (wedged or full handoff)")
+_decode_stalls = _obs.counter(
+    "ds_disagg_decode_stalls_total",
+    "Tick-requests where decode waited on an unlanded handoff")
+_queue_depth = _obs.gauge(
+    "ds_disagg_queue_depth", "Handoff transfer batches in flight")
+_prefill_occupancy = _obs.gauge(
+    "ds_disagg_prefill_occupancy",
+    "Live requests currently prefilling on the prefill group")
+_decode_occupancy = _obs.gauge(
+    "ds_disagg_decode_occupancy",
+    "Live requests currently decoding on the decode group")
+
+
+@dataclass
+class GroupPlan:
+    """The carve: which local devices prefill, which decode."""
+    prefill_devices: Tuple
+    decode_devices: Tuple
+    prefill_tp: int = 1
+
+    def describe(self) -> dict:
+        return {
+            "prefill_devices": [d.id for d in self.prefill_devices],
+            "decode_devices": [d.id for d in self.decode_devices],
+            "prefill_tp": self.prefill_tp,
+        }
+
+
+def plan_groups(cfg: DisaggregationConfig,
+                devices=None) -> Optional[GroupPlan]:
+    """Carve the local device set per config. Returns None when only one
+    group fits (graceful fallback to continuous fusion) — unless explicit
+    device lists were given, which raise if unhonorable."""
+    if not cfg.enabled:
+        return None
+    devices = list(jax.local_devices()) if devices is None else list(devices)
+    by_id = {d.id: d for d in devices}
+
+    if cfg.prefill_devices is not None or cfg.decode_devices is not None:
+        def _pick(ids, what):
+            missing = [i for i in ids if i not in by_id]
+            if missing:
+                raise ValueError(
+                    f"disaggregation.{what} names device ids {missing} "
+                    f"not in the local set {sorted(by_id)}")
+            return tuple(by_id[i] for i in ids)
+        if cfg.prefill_devices is not None:
+            prefill = _pick(cfg.prefill_devices, "prefill_devices")
+            decode = (tuple(d for d in devices if d not in prefill)
+                      if cfg.decode_devices is None
+                      else _pick(cfg.decode_devices, "decode_devices"))
+        else:
+            decode = _pick(cfg.decode_devices, "decode_devices")
+            prefill = tuple(d for d in devices if d not in decode)
+        if not prefill or not decode:
+            raise ValueError(
+                f"disaggregation device lists leave an empty group "
+                f"(prefill={len(prefill)}, decode={len(decode)}) on "
+                f"{len(devices)} local devices")
+    else:
+        n = len(devices)
+        k = int(round(cfg.prefill_fraction * n))
+        k = min(k, n - 1)
+        if n < 2 or k < 1:
+            logger.info(
+                f"disaggregation: prefill_fraction={cfg.prefill_fraction} "
+                f"yields no prefill group on {n} device(s) — falling back "
+                f"to time-overlap continuous fusion")
+            return None
+        # decode keeps the front of the list (including the process
+        # default device, so the decode engine's default placement IS its
+        # group); prefill takes the tail
+        prefill, decode = tuple(devices[n - k:]), tuple(devices[:n - k])
+
+    if len(prefill) % cfg.prefill_tp_size != 0:
+        raise ValueError(
+            f"disaggregation.prefill_tp_size={cfg.prefill_tp_size} does "
+            f"not divide the {len(prefill)}-device prefill group")
+    return GroupPlan(prefill, decode, cfg.prefill_tp_size)
+
+
+@dataclass
+class _Batch:
+    """One in-flight transfer: a few blocks' worth of KV slices, already
+    submitted to the wire via async device_put."""
+    uid: int
+    arrays: object          # ONE pytree, blocks concatenated on the slot dim
+    dst_blocks: List[int]
+    nbytes: int
+    t_submit: float
+    wedged: bool = False
+
+
+@dataclass
+class _Handoff:
+    """Per-request handoff progress."""
+    uid: int
+    submitted: int = 0      # source blocks submitted to the wire so far
+    dst_blocks: List[int] = field(default_factory=list)
+    landed: int = 0         # blocks landed in the decode pool
+    inflight: int = 0       # transfer batches not yet landed
+    final: bool = False     # prompt fully fed; no more chunks coming
+    seen_tokens: int = 0    # history length at final submit
+    tokens: Optional[np.ndarray] = None  # that history (prefix registration)
+    wedged: bool = False
+    t_oldest: float = 0.0   # submit time of the oldest unlanded batch
+
+
+class HandoffQueue:
+    """Double-buffered, layer-batched async block mover between two
+    engines' paged KV pools."""
+
+    def __init__(self, src_engine: InferenceEngineV2,
+                 dst_engine: InferenceEngineV2,
+                 cfg: DisaggregationConfig):
+        self._src = src_engine
+        self._dst = dst_engine
+        self._cfg = cfg
+        self._bs = src_engine._state_manager.block_size
+        assert self._bs == dst_engine._state_manager.block_size
+        dst_model = dst_engine.model()
+        self._dst_device = (dst_model.devices[0] if dst_model.devices
+                            else jax.local_devices()[0])
+        self._handoffs: Dict[int, _Handoff] = {}
+        self._inflight: List[_Batch] = []
+        # one compiled landing program per batch SIZE (not per block): a
+        # donated in-place fori_loop of dynamic_update_slice along the slot
+        # dim, pytree-shaped so the int8 (data, scales) cache lands both
+        # leaves in one dispatch. Distinct sizes are bounded by
+        # token_budget // block_size + 2, so the compile set stays tiny.
+        self._land_fn = jax.jit(self._land_tree, donate_argnums=(0, ),
+                                static_argnums=(3, ))
+
+    @staticmethod
+    def _land_tree(cache, upd, starts, bs):
+        def body(i, c):
+            return jax.tree_util.tree_map(
+                lambda cc, uu: jax.lax.dynamic_update_slice_in_dim(
+                    cc,
+                    jax.lax.dynamic_slice_in_dim(uu, i * bs, bs, axis=1),
+                    starts[i], axis=1),
+                c, upd)
+        return jax.lax.fori_loop(0, starts.shape[0], body, cache)
+
+    # -- submission (prefill side) --------------------------------------
+
+    def _block_nbytes(self) -> int:
+        cache = self._src._state_manager.kv_cache.cache
+        return sum(int(np.prod(a.shape[::2])) * self._bs * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(cache))
+
+    def submit(self, uid: int, src_seq, final: bool,
+               tokens: Optional[np.ndarray] = None) -> None:
+        """Move every newly COMPLETED source block of ``uid`` onto the
+        wire (final=True also ships the partial tail block and freezes the
+        handoff). Raises SchedulingError when the decode pool cannot
+        allocate the destination blocks — the caller degrades the request
+        to in-group prefill."""
+        h = self._handoffs.setdefault(uid, _Handoff(uid))
+        if h.wedged:
+            return
+        seen = src_seq.seen_tokens
+        n_done = ((seen + self._bs - 1) // self._bs if final
+                  else seen // self._bs)
+        src_blocks = src_seq.kv_blocks
+        new = src_blocks[h.submitted:n_done]
+        if final:
+            h.final = True
+            h.seen_tokens = int(seen)
+            h.tokens = np.asarray(tokens, np.int32).reshape(-1)[:seen]
+        if not new:
+            return
+        # reservation first: decode-pool blocks allocate at submit so the
+        # scheduler's free_blocks/eviction arithmetic covers in-flight
+        # handoffs exactly like live prefill
+        dst = [int(b) for b in
+               self._dst._state_manager.allocate_blocks(len(new))]
+        h.dst_blocks.extend(dst)
+        h.submitted = n_done
+
+        src_cache = self._src._state_manager.kv_cache.cache
+        # ONE gather per cache leaf pulls every new block's slots into a
+        # contiguous [.., n*bs, ..] staging array, then ONE async
+        # device_put for the whole chunk: the copy rides the wire while
+        # the prefill engine runs the next chunk's forward
+        idx = jnp.asarray(np.concatenate(
+            [np.arange(b * self._bs, (b + 1) * self._bs) for b in new]),
+            jnp.int32)
+        gathered = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, idx, axis=1), src_cache)
+        arrays = jax.device_put(gathered, self._dst_device)
+        batch = _Batch(uid, arrays, dst, len(new) * self._block_nbytes(),
+                       time.monotonic())
+        if get_fault_injector().fire("disagg.transfer_stall",
+                                     uid=uid) is not None:
+            batch.wedged = True
+            h.wedged = True
+        h.inflight += 1
+        if h.inflight == 1 or not h.t_oldest:
+            h.t_oldest = batch.t_submit
+        self._inflight.append(batch)
+        _transfer_bytes.record(batch.nbytes)
+        _queue_depth.set(len(self._inflight))
+        # double-buffer backpressure: past the cap, the SUBMITTER waits
+        # for the oldest healthy batch and lands it — prefill stalls,
+        # decode never does
+        while (len([b for b in self._inflight if not b.wedged])
+               > max(1, self._cfg.max_inflight_transfers)):
+            oldest = next(b for b in self._inflight if not b.wedged)
+            for leaf in jax.tree_util.tree_leaves(oldest.arrays):
+                leaf.block_until_ready()
+            self._land(oldest)
+
+    # -- landing (decode side) ------------------------------------------
+
+    def _land(self, batch: _Batch) -> None:
+        dst_kv = self._dst._state_manager.kv_cache
+        starts = jnp.asarray([b * self._bs for b in batch.dst_blocks],
+                             jnp.int32)
+        dst_kv.cache = self._land_fn(dst_kv.cache, batch.arrays, starts,
+                                     self._bs)
+        self._inflight.remove(batch)
+        h = self._handoffs.get(batch.uid)
+        if h is not None:
+            h.landed += len(batch.dst_blocks)
+            h.inflight -= 1
+            h.t_oldest = min((b.t_submit for b in self._inflight
+                              if b.uid == batch.uid), default=0.0)
+        _transfer_seconds.record(time.monotonic() - batch.t_submit)
+        _queue_depth.set(len(self._inflight))
+
+    def pump(self) -> List[int]:
+        """Land every transfer batch that is ready on the wire; returns
+        uids whose handoff is COMPLETE (final + fully landed) and ready
+        for decode-side takeover. Never blocks: un-ready batches stay in
+        flight, wedged ones are left to the watchdog."""
+        for batch in list(self._inflight):
+            if batch.wedged:
+                continue
+            if all(leaf.is_ready()
+                   for leaf in jax.tree_util.tree_leaves(batch.arrays)):
+                self._land(batch)
+        return [uid for uid, h in self._handoffs.items()
+                if h.final and not h.wedged and h.inflight == 0
+                and h.landed == len(h.dst_blocks)]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def get(self, uid: int) -> Optional[_Handoff]:
+        return self._handoffs.get(uid)
+
+    def active_uids(self):
+        return list(self._handoffs)
+
+    def stalled_uids(self, now: float, timeout_s: float) -> List[int]:
+        """Wedged transfers plus anything older than the watchdog
+        timeout."""
+        out = []
+        for uid, h in self._handoffs.items():
+            if h.wedged:
+                out.append(uid)
+            elif h.inflight and h.t_oldest and now - h.t_oldest > timeout_s:
+                out.append(uid)
+        return out
+
+    def finish(self, uid: int) -> _Handoff:
+        """Takeover complete: forget the handoff (blocks now belong to the
+        decode-side descriptor)."""
+        h = self._handoffs.pop(uid)
+        self._drop_batches(uid)
+        return h
+
+    def abort(self, uid: int) -> None:
+        """Request left the handoff path (degrade, eviction, finish,
+        quarantine): drop queued transfers and return the allocated
+        decode-pool blocks."""
+        h = self._handoffs.pop(uid, None)
+        if h is None:
+            return
+        self._drop_batches(uid)
+        if h.dst_blocks:
+            self._dst._state_manager.release_blocks(h.dst_blocks)
+
+    def _drop_batches(self, uid: int) -> None:
+        self._inflight = [b for b in self._inflight if b.uid != uid]
+        _queue_depth.set(len(self._inflight))
+
+    @property
+    def depth(self) -> int:
+        return len(self._inflight)
+
+
+class DisaggServing:
+    """The scheduler-facing façade: prefill engine + group plan + handoff
+    queue + degrade watchdog. Owned by ``ServingScheduler``; every method
+    is called from the scheduler thread only."""
+
+    def __init__(self, prefill_engine: InferenceEngineV2,
+                 decode_engine: InferenceEngineV2,
+                 plan: GroupPlan, cfg: DisaggregationConfig):
+        self.prefill_engine = prefill_engine
+        self.decode_engine = decode_engine
+        self.plan = plan
+        self.cfg = cfg
+        self.queue = HandoffQueue(prefill_engine, decode_engine, cfg)
+        self.degraded = False
+        self._decode_stalled_uids = set()
+
+    # -- routing ---------------------------------------------------------
+
+    def route_to_prefill(self, feed_len: int) -> bool:
+        """Should a prefilling request feed on the prefill group? No when
+        degraded, or when the prefill pool cannot hold the remaining feed
+        (in-group prefill is always a correct fallback)."""
+        if self.degraded:
+            return False
+        bs = self.prefill_engine._state_manager.block_size
+        need = (feed_len + bs - 1) // bs
+        return need <= self.prefill_engine.free_blocks
+
+    # -- per-tick driving ------------------------------------------------
+
+    def advance(self, uid: int, final: bool,
+                tokens: Optional[np.ndarray] = None) -> bool:
+        """After a prefill chunk lands on the prefill engine: ship newly
+        completed blocks. False = the decode pool refused the destination
+        blocks — caller degrades the request to in-group prefill."""
+        seq = self.prefill_engine._state_manager.get_sequence(uid)
+        if seq is None:
+            return True
+        try:
+            self.queue.submit(uid, seq, final, tokens)
+        except SchedulingError:
+            _degraded_total.inc()
+            return False
+        return True
+
+    def pump(self, now: Optional[float] = None) -> Tuple[List[int], List[int]]:
+        """Land ready transfers. Returns (takeover_ready_uids,
+        degraded_uids); degraded uids have already been aborted here and
+        latch the router degraded."""
+        ready = self.queue.pump()
+        now = time.monotonic() if now is None else now
+        stalled = self.queue.stalled_uids(now, self.cfg.stall_timeout_s)
+        for uid in stalled:
+            logger.warning(
+                f"disagg: handoff for uid={uid} wedged past "
+                f"{self.cfg.stall_timeout_s}s — degrading to in-group "
+                f"prefill; router latched degraded")
+            self.abort(uid)
+            _degraded_total.inc()
+            self.degraded = True
+        return [u for u in ready if u not in stalled], stalled
+
+    def takeover(self, uid: int) -> None:
+        """Handoff fully landed: the decode engine adopts the sequence
+        (descriptor + prefix-cache registration over the landed blocks)
+        and the prefill-side KV frees."""
+        h = self.queue.finish(uid)
+        self.decode_engine.adopt_handoff(uid, h.tokens, h.dst_blocks,
+                                         h.seen_tokens)
+        self.prefill_engine.flush(uid)
+        _handoffs_total.inc()
+
+    def abort(self, uid: int) -> None:
+        self.queue.abort(uid)
+        try:
+            self.prefill_engine.flush(uid)
+        except Exception:  # noqa: BLE001 — uid may be unknown to this side
+            pass
+
+    def in_handoff(self, uid: int) -> bool:
+        h = self.queue.get(uid)
+        return h is not None and h.final
+
+    def note_decode_stall(self, uid: int) -> None:
+        _decode_stalls.inc()
+        self._decode_stalled_uids.add(uid)
+
+    def refresh_occupancy(self, n_prefilling: int, n_decoding: int) -> None:
+        _prefill_occupancy.set(n_prefilling)
+        _decode_occupancy.set(n_decoding)
+
+    # -- reporting -------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            **self.plan.describe(),
+            "degraded": self.degraded,
+            "handoff_queue_depth": self.queue.depth,
+            "handoffs_total": int(_handoffs_total.value),
+            "degraded_total": int(_degraded_total.value),
+            "decode_stalls_total": int(_decode_stalls.value),
+            "prefill_free_blocks": self.prefill_engine.free_blocks,
+            "decode_free_blocks": self.decode_engine.free_blocks,
+        }
+
+
+def build_disagg_llama(config=None, params=None,
+                       engine_config: Optional[RaggedInferenceEngineConfig] = None,
+                       seed: int = 0, **model_kwargs
+                       ) -> Tuple[InferenceEngineV2, Optional[DisaggServing]]:
+    """Build the serving engine(s) for the ``disaggregation`` config:
+    returns ``(decode_engine, disagg)`` where ``disagg`` is None whenever
+    the planner falls back to a single group — the decode engine is then
+    byte-identical to a plain ``build_llama_engine`` build."""
+    from ...models.llama import LlamaConfig, init_llama
+    from .engine_v2 import build_llama_engine
+
+    engine_config = engine_config or RaggedInferenceEngineConfig()
+    cfg = engine_config.disaggregation
+    plan = plan_groups(cfg)
+    if plan is not None and params is None:
+        # both engines must see the SAME weights; materialize once
+        config = config or LlamaConfig.tiny()
+        _, params = init_llama(config, seed=seed)
+    if plan is not None and getattr(config, "sliding_window", None):
+        logger.warning(
+            "disaggregation disabled: sliding-window models release "
+            "trailing KV blocks mid-sequence, which the block-granular "
+            "handoff does not carry")
+        plan = None
+    decode_engine = build_llama_engine(
+        config, params=params, engine_config=engine_config, seed=seed,
+        devices=list(plan.decode_devices) if plan is not None else None,
+        **model_kwargs)
+    if plan is None:
+        return decode_engine, None
+
+    p_cfg = engine_config.model_copy(deep=True)
+    p_cfg.tensor_parallel.tp_size = cfg.prefill_tp_size
+    if cfg.prefill_kv_blocks is not None:
+        p_cfg.num_kv_blocks = cfg.prefill_kv_blocks
+    prefill_engine = build_llama_engine(
+        decode_engine.model().config, params=params, engine_config=p_cfg,
+        seed=seed, devices=list(plan.prefill_devices), **model_kwargs)
+    logger.info(
+        f"disaggregated serving: prefill group "
+        f"{[d.id for d in plan.prefill_devices]} (tp={plan.prefill_tp}), "
+        f"decode group {[d.id for d in plan.decode_devices]}")
+    return decode_engine, DisaggServing(prefill_engine, decode_engine,
+                                        plan, cfg)

@@ -44,6 +44,15 @@ _dispatches_total = _obs.counter(
     "ds_engine_dispatches_total", "Fused wave dispatches (plain + spec)")
 _harvests_total = _obs.counter(
     "ds_engine_harvests_total", "Fused wave harvests (plain + spec)")
+# prefix-cache effectiveness, previously visible only as host-side
+# descriptor attrs: one hit per new sequence that adopted a cached
+# prefix, plus the block count it skipped recomputing
+_prefix_hits = _obs.counter(
+    "ds_prefix_cache_hits_total",
+    "New sequences that adopted a cached full-block prefix")
+_prefix_adopted_blocks = _obs.counter(
+    "ds_prefix_adopted_blocks_total",
+    "KV blocks adopted from the prefix cache (prefill skipped)")
 
 
 @dataclass
@@ -212,6 +221,8 @@ class InferenceEngineV2:
                 # At least one token must stay fed (logits come from it).
                 matched, chain_key = pc.match_with_key(tokens[:tokens.size - 1])
                 if matched:
+                    _prefix_hits.inc()
+                    _prefix_adopted_blocks.inc(len(matched))
                     host_seq_desc = self._state_manager.get_or_create_sequence(uid)
                     host_seq_desc.extend_kv_cache(matched)
                     host_seq_desc.adopted_blocks = set(matched)
@@ -1671,6 +1682,27 @@ class InferenceEngineV2:
             return [outputs[u] for u in uids], [logprobs[u] for u in uids]
         return [outputs[u] for u in uids]
 
+    def adopt_handoff(self, uid: int, tokens, blocks, seen_tokens: int) -> None:
+        """Take over a sequence whose prefix KV was computed on ANOTHER
+        engine (disaggregated prefill) and landed into ``blocks`` of THIS
+        engine's paged pool: create the descriptor with its history marked
+        seen, and register the landed full blocks with the prefix cache so
+        adoption/eviction accounting treats them exactly like locally
+        computed prefill. ``blocks`` must already be allocated from this
+        engine's state manager; ``tokens`` is the seen history (prompt +
+        force-fed replay outputs) backing those blocks."""
+        sm = self._state_manager
+        if sm.get_sequence(uid) is not None:
+            raise ValueError(f"uid {uid} already tracked; cannot adopt handoff")
+        seq = sm.get_or_create_sequence(uid)
+        seq.extend_kv_cache(np.asarray(blocks, np.int64))
+        seq.pre_forward(int(seen_tokens))
+        seq.post_forward()
+        if sm.prefix_cache is not None:
+            tokens = np.asarray(tokens, np.int32).reshape(-1)[:int(seen_tokens)]
+            self._append_pending(seq, tokens)
+            self._register_pending(seq)
+
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
         self._sample_keys.pop(uid, None)
@@ -1685,16 +1717,19 @@ class InferenceEngineV2:
             pickle.dump({"treedef": treedef, "config": self._model.config}, f)
 
 
-def load_engine(save_path: str, **engine_kwargs) -> "InferenceEngineV2":
+def load_engine(save_path: str, builder=None, **engine_kwargs):
     """Rebuild a serving engine from an ``InferenceEngineV2.serialize`` dir
-    (params.npz + metadata.pkl). ``engine_kwargs`` forward to
-    :func:`build_llama_engine` (engine_config, kv_cache_dtype, ...)."""
+    (params.npz + metadata.pkl). ``engine_kwargs`` forward to the builder
+    (engine_config, kv_cache_dtype, ...) — :func:`build_llama_engine` by
+    default; pass ``disagg.build_disagg_llama`` to stand up the
+    disaggregated prefill/decode pair from the same snapshot."""
     with open(os.path.join(save_path, "metadata.pkl"), "rb") as f:
         meta = pickle.load(f)
     with np.load(os.path.join(save_path, "params.npz")) as z:
         flat = [z[str(i)] for i in range(len(z.files))]
     params = jax.tree_util.tree_unflatten(meta["treedef"], flat)
-    return build_llama_engine(meta["config"], params=params, **engine_kwargs)
+    builder = builder if builder is not None else build_llama_engine
+    return builder(meta["config"], params=params, **engine_kwargs)
 
 
 def build_llama_engine(config: Optional[LlamaConfig] = None,
@@ -1705,7 +1740,8 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                        kv_block_size: int = 64,
                        quantize=None,
                        kv_cache_dtype=None,
-                       attn_backend: str = "auto") -> InferenceEngineV2:
+                       attn_backend: str = "auto",
+                       devices=None) -> InferenceEngineV2:
     """Factory (reference ``engine_factory.py build_hf_engine``): build a
     ragged engine from a Llama config + trained params (random if None)."""
     import jax.numpy as jnp
@@ -1736,5 +1772,6 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                              tp_size=tp_cfg.tp_size,
                              tp_wire_dtype=tp_cfg.tp_wire_dtype,
                              tp_wire_overrides=tp_cfg.tp_wire_overrides,
-                             tp_wire_block=tp_cfg.tp_wire_block)
+                             tp_wire_block=tp_cfg.tp_wire_block,
+                             devices=devices)
     return InferenceEngineV2(model, engine_config)

@@ -247,8 +247,13 @@ class RaggedLlamaModel:
                  kv_cache_dtype: Optional[str] = None,
                  tp_wire_dtype: Optional[str] = None,
                  tp_wire_overrides: Optional[dict] = None,
-                 tp_wire_block: int = 256):
+                 tp_wire_block: int = 256,
+                 devices=None):
         self.config = config
+        # explicit device subset (disaggregated serving: each group's
+        # engine pins params + KV to its own devices). None = process
+        # default placement, byte-identical to the pre-disagg behavior.
+        self.devices = tuple(devices) if devices is not None else None
         self.dtype = dtype
         self.kv_block_size = kv_block_size
         if quantize not in (None, "int8", "fp6", "int4"):
@@ -295,7 +300,18 @@ class RaggedLlamaModel:
             # the per-layer psum on the row-parallel projections
             from ...comm.mesh import (MeshContext, get_mesh_context,
                                       mesh_is_initialized, set_mesh_context)
-            if mesh_is_initialized():
+            if self.devices is not None:
+                # disaggregated group: a PRIVATE mesh over exactly these
+                # devices — never registered globally, so the prefill and
+                # decode groups' TP engines coexist in one process
+                if len(self.devices) % self.tp_size != 0:
+                    raise ValueError(
+                        f"tp_size={self.tp_size} does not divide the "
+                        f"{len(self.devices)}-device group")
+                ctx = MeshContext.create(
+                    axis_sizes={"model": self.tp_size, "data": -1},
+                    devices=list(self.devices))
+            elif mesh_is_initialized():
                 ctx = get_mesh_context()
                 if ctx.axis_size("model") != self.tp_size:
                     raise ValueError(
@@ -357,6 +373,21 @@ class RaggedLlamaModel:
             spec = (P(None, None, "model")
                     if n_kv % self.tp_size == 0 else P())
             self._cache_sharding = NamedSharding(self._mesh_ctx.mesh, spec)
+        elif self.devices is not None:
+            # single-device group (disagg without TP): COMMIT params to the
+            # group's lead device so every jitted forward — and the KV
+            # cache it donates — executes there instead of on the process
+            # default device
+            from jax.sharding import SingleDeviceSharding
+            dev = self.devices[0]
+
+            def _place1(x):
+                if isinstance(x, jax.Array):
+                    return jax.device_put(x, dev).astype(dtype)
+                return jax.device_put(np.asarray(x).astype(dtype), dev)
+
+            self.params = jax.tree_util.tree_map(_place1, params)
+            self._cache_sharding = SingleDeviceSharding(dev)
         else:
             self.params = jax.tree_util.tree_map(
                 lambda x: jnp.asarray(x, dtype=dtype), params)
@@ -426,6 +457,11 @@ class RaggedLlamaModel:
                 fp32_put = lambda x: jax.device_put(
                     np.asarray(x, np.float32) if not isinstance(x, jax.Array)
                     else x, repl).astype(jnp.float32)
+            elif self.devices is not None:
+                dev0 = self.devices[0]
+                fp32_put = lambda x: jax.device_put(
+                    np.asarray(x, np.float32) if not isinstance(x, jax.Array)
+                    else x, dev0).astype(jnp.float32)
             else:
                 fp32_put = lambda x: jnp.asarray(x, jnp.float32)
             self.params["model"]["lm_head"] = jax.tree_util.tree_map(

@@ -56,12 +56,16 @@ class BlockedKVCache:
                 # scales [2L, slots, KV] shard on the head dim like the data
                 # (a replicated data spec — the dense nondivisible-GQA
                 # fallback — replicates the scales too, and P(None,)*3
-                # degrades to replicated for it)
+                # degrades to replicated for it). A non-named sharding
+                # (disagg single-device group pinning) applies as-is.
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                spec = tuple(config.cache_sharding.spec)
-                head_axis = spec[2] if len(spec) > 2 else None
-                ssharding = NamedSharding(config.cache_sharding.mesh,
-                                          P(None, None, head_axis))
+                if isinstance(config.cache_sharding, NamedSharding):
+                    spec = tuple(config.cache_sharding.spec)
+                    head_axis = spec[2] if len(spec) > 2 else None
+                    ssharding = NamedSharding(config.cache_sharding.mesh,
+                                              P(None, None, head_axis))
+                else:
+                    ssharding = config.cache_sharding
                 self.cache = (
                     jax.jit(lambda: jnp.zeros(self.shape, jnp.int8),
                             out_shardings=config.cache_sharding)(),

@@ -75,6 +75,7 @@ from ...utils.logging import logger
 from ...utils.retry import RetriesExhausted, retry_with_backoff
 from .config_v2 import (ContinuousFusionConfig, DurableServingConfig,
                         ObservabilityConfig, ServingResilienceConfig)
+from .disagg import DisaggServing
 from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
@@ -263,9 +264,18 @@ class ServingScheduler:
                  token_budget: Optional[int] = None,
                  fused_decode_window: Optional[int] = None,
                  journal: Optional[RequestJournal] = None,
-                 instruments: "Union[ServingInstruments, bool, None]" = None):
+                 instruments: "Union[ServingInstruments, bool, None]" = None,
+                 disagg: Optional[DisaggServing] = None):
         self._engine = engine
         self._idle_wait = idle_wait
+        # disaggregated prefill/decode (disagg.py): ``engine`` is the
+        # DECODE group's; pending>1 requests route to the prefill group
+        # and their KV pages migrate back through the handoff queue.
+        # None (the default / single-group fallback) leaves every code
+        # path byte-identical to the time-overlap scheduler.
+        self._disagg = disagg
+        self._on_prefill: set = set()  # uids resident on the prefill group
+        self._disagg_fed_tick = False  # one prefill-group put per tick
         if fused_decode_window is None:
             from ...ops.registry import on_tpu
             fused_decode_window = 16 if on_tpu() else 1
@@ -592,6 +602,10 @@ class ServingScheduler:
                "mean_fused_K": (round(fused_k_sum / fused_dispatches, 2)
                                 if fused_dispatches else None),
                "prefill_overlap_tokens": prefill_overlap,
+               # disaggregated prefill/decode: group topology, handoff
+               # queue depth, degrade/stall tallies (None = single group)
+               "disagg": (self._disagg.stats()
+                          if self._disagg is not None else None),
                "journal_depth": (self._journal.depth
                                  if self._journal is not None else 0),
                "replayed_requests": self._replayed,
@@ -1198,11 +1212,23 @@ class ServingScheduler:
         and no ADMISSIBLE waiting request (a request that cannot admit
         until KV frees gets no say — it cannot run either way, so it must
         not pin every decode to per-token dispatches)."""
+        if self._disagg is not None:
+            self._disagg_fed_tick = False
+            self._disagg_pump()
         if not self._live:
             return False
         budget = self._token_budget
-        decodes = [r for r in self._live if r.pending == 1]
-        prefills = [r for r in self._live if r.pending > 1]
+        decodes, prefills = [], []
+        for r in self._live:
+            if r.uid in self._on_prefill:
+                # resident on the prefill group: pending>1 feeds there
+                # (_disagg_fill); pending==1 means the final prompt chunk
+                # sampled but its KV is still mid-handoff — the decode
+                # wave cannot own it yet
+                if r.pending <= 1:
+                    self._disagg.note_decode_stall(r.uid)
+                continue
+            (decodes if r.pending == 1 else prefills).append(r)
         if self._fused_window > 1 and decodes:
             if self._cf.enabled:
                 done = self._continuous_tick(decodes, prefills, budget)
@@ -1252,7 +1278,10 @@ class ServingScheduler:
                     if not decodes:
                         return True
                     # fall through: per-token tick for the remainder
-        return self._per_token_tick(decodes, prefills, budget)
+        advanced = self._per_token_tick(decodes, prefills, budget)
+        # in-flight handoffs ARE progress: keep ticking (pumping) at full
+        # cadence instead of sleeping idle_wait on top of the transfer
+        return advanced or bool(self._on_prefill)
 
     def _prefilled(self, r: _Request) -> bool:
         seq = self._engine._state_manager.get_sequence(r.uid)
@@ -1374,8 +1403,10 @@ class ServingScheduler:
         # same tick
         adv_ids = {id(r) for r in advanced}
         rem_decodes = [r for r in self._live
-                       if r.pending == 1 and id(r) not in adv_ids]
-        rem_prefills = [r for r in self._live if r.pending > 1]
+                       if r.pending == 1 and id(r) not in adv_ids
+                       and r.uid not in self._on_prefill]
+        rem_prefills = [r for r in self._live if r.pending > 1
+                        and r.uid not in self._on_prefill]
         if rem_decodes or rem_prefills:
             self._per_token_tick(rem_decodes, rem_prefills, budget)
         return True
@@ -1393,33 +1424,199 @@ class ServingScheduler:
                 self._inbox = []
         if self._waiting:
             self._admit()
+        overlap_fed = 0
+        if self._disagg is not None:
+            # the prefill GROUP's put runs here so the host-side wait on
+            # its logits overlaps the decode group's in-flight wave — the
+            # space analog of the time overlap below
+            overlap_fed += self._disagg_fill(budget)
         p_budget = int(budget * self._cf.prefill_budget_frac)
         if p_budget <= 0:
-            return 0
+            return overlap_fed
         p_reqs, p_chunks, spent = [], [], 0
         for req in self._live:
             if spent >= p_budget:
                 break
-            if req.uid in self._in_flight or req.pending <= 1:
+            if (req.uid in self._in_flight or req.pending <= 1
+                    or req.uid in self._on_prefill):
                 continue
             take = min(req.pending, p_budget - spent)
             p_reqs.append(req)
             p_chunks.append(req.feed_slice(take))
             spent += take
         if not p_reqs:
-            return 0
+            return overlap_fed
         t0 = time.monotonic()
         if self._tick_put(p_reqs, p_chunks, {}) is None:
-            return 0  # eviction fence refused / eviction ended the fill
+            # eviction fence refused / eviction ended the fill
+            return overlap_fed
         if self._obs is not None:
             self._obs.prefill_span([r.uid for r in p_reqs], t0,
                                    time.monotonic(), spent, overlap=True)
+        return overlap_fed + spent
+
+    # ---- disaggregated prefill/decode (disagg.py) ----
+
+    def _disagg_fill(self, budget) -> int:
+        """Route-and-feed pass for the PREFILL group: newly admitted
+        pending>1 requests with no decode-side history route here (unless
+        the router is degraded or the prefill pool cannot hold them), then
+        every resident gets a prompt chunk within the token budget — one
+        ragged put on the prefill engine per tick. Returns tokens fed."""
+        if self._disagg_fed_tick:
+            return 0
+        self._disagg_fed_tick = True
+        ds = self._disagg
+        for r in self._live:
+            if (r.uid not in self._on_prefill and r.pending > 1
+                    and self._engine._state_manager.get_sequence(r.uid)
+                    is None
+                    and ds.route_to_prefill(r.pending)):
+                self._on_prefill.add(r.uid)
+                if r.key_burns > 0 and r.outputs:
+                    # replayed history: the final chunk SAMPLES on the
+                    # prefill engine, so its key chain must stand at the
+                    # recorded position too (the decode-side twin of
+                    # _restore_sampler)
+                    ds.prefill_engine.fast_forward_sampler(
+                        r.uid, r.seed, r.key_burns)
+        if not self._on_prefill:
+            return 0
+        reqs, chunks, spent = [], [], 0
+        for r in self._live:
+            if r.uid not in self._on_prefill or r.pending <= 1:
+                continue
+            if spent >= budget:
+                break
+            take = min(r.pending, budget - spent)
+            reqs.append(r)
+            chunks.append(r.feed_slice(take))
+            spent += take
+        if not reqs:
+            return 0
+        t0 = time.monotonic()
+        if not self._disagg_put(reqs, chunks):
+            return 0
+        if self._obs is not None:
+            self._obs.prefill_span([r.uid for r in reqs], t0,
+                                   time.monotonic(), spent, overlap=True)
         return spent
+
+    def _disagg_put(self, reqs, chunks) -> bool:
+        """One ragged put on the prefill engine + handoff submission. The
+        sampling mirror of _tick_put's draft-free branch pointed at the
+        prefill group: a final prompt chunk's logits row comes from the
+        same compiled program over the same weights as an in-group
+        prefill's, and the device key chain stands at the same position —
+        so the first token is bit-identical to the single-group path."""
+        ds = self._disagg
+        pe = ds.prefill_engine
+        try:
+            logits = np.asarray(pe.put([r.uid for r in reqs], chunks))
+        except SchedulingError:
+            # prefill pool exhausted mid-batch: nothing advanced (fed is
+            # untouched) — this batch re-prefills in-group
+            for r in list(reqs):
+                self._degrade_to_decode(r)
+            return False
+        device_wave, finals = [], {}
+        for req, chunk, row in zip(reqs, chunks, logits):
+            req.fed += len(chunk)
+            if req.pending == 0:  # feed complete: row is the next token
+                # capture the handed-off history BEFORE emission grows it
+                finals[id(req)] = np.asarray(req.feed, np.int32)
+                if req.speculative is not None and req.temperature != 0.0:
+                    new_toks, _ = pe.accept_drafts_sampled(
+                        req.uid, [], row, self._spec_for(req),
+                        req.num_draft_tokens)
+                    req.key_burns += 1  # draft-free window still burns
+                    self._trace["decode_tokens"] += self._emit_many(
+                        req, new_toks)
+                elif self._device_eligible(req):
+                    device_wave.append((req, row))
+                else:
+                    self._emit(req, row)
+        if device_wave:
+            self._emit_device(device_wave, engine=pe)
+        for req in reqs:
+            hist = finals.get(id(req))
+            if not ds.advance(req.uid, final=hist is not None,
+                              tokens=hist):
+                # decode pool refused the destination blocks
+                self._degrade_to_decode(req)
+        return True
+
+    def _disagg_pump(self) -> None:
+        """Land every handoff transfer that is ready on the wire, complete
+        takeovers (the request joins the decode group: descriptor adopted
+        over the landed blocks, prefix blocks registered, device key chain
+        fast-forwarded), and degrade wedged handoffs to in-group prefill
+        so admission never stalls behind a dead interconnect."""
+        ds = self._disagg
+        ready, degraded = ds.pump()
+        for uid in ready:
+            req = self._requests.get(uid)
+            if (req is None or uid not in self._on_prefill
+                    or req.done.is_set()):
+                ds.abort(uid)
+                self._on_prefill.discard(uid)
+                continue
+            if self._finished_already(req):
+                # eos/stop/max on the very first token: no decode steps
+                # will run — retire without adopting (the _finish hook
+                # aborts the handoff and frees both pools)
+                if req in self._live:
+                    self._live.remove(req)
+                self._finish(req, flush=False)
+                continue
+            ds.takeover(uid)
+            self._on_prefill.discard(uid)
+            self._restore_sampler(req)  # decode-side chain continues
+        for uid in degraded:
+            req = self._requests.get(uid)
+            if req is not None and uid in self._on_prefill:
+                self._degrade_to_decode(req, aborted=True)
+            else:
+                self._on_prefill.discard(uid)
+        ds.refresh_occupancy(
+            len(self._on_prefill),
+            sum(1 for r in self._live if r.uid not in self._on_prefill))
+
+    def _degrade_to_decode(self, req: _Request, aborted: bool = False
+                           ) -> None:
+        """Move a prefill-group resident back in-group, eviction-style:
+        drop its prefill seq + handoff state and re-feed its WHOLE history
+        on the decode group (the replay machinery — already-emitted tokens
+        never re-emit, and _restore_sampler lands the key chain at its
+        recorded position, so the stream continues bit-identically)."""
+        self._on_prefill.discard(req.uid)
+        if not aborted:
+            self._disagg.abort(req.uid)
+        if self._finished_already(req):
+            # sampled its last token on the prefill group already; nothing
+            # left to re-prefill for
+            if req in self._live:
+                self._live.remove(req)
+            self._finish(req, flush=False)
+            return
+        req.fed = 0
+        self._restore_sampler(req)
 
     def _per_token_tick(self, decodes, prefills, budget) -> bool:
         """The per-token SplitFuse pass: one ragged forward covering every
         decode's reserved token, host-path drafts, and prefill chunks in
         the spare budget."""
+        if self._disagg is not None:
+            # no overlap window fed the prefill group this tick (wave-less
+            # pass, or the quarantine bisect re-entered): feed it here —
+            # routing newly admitted pending>1 requests in the process —
+            # then keep its residents out of the in-group lists
+            self._disagg_fill(budget)
+            if self._on_prefill:
+                decodes = [r for r in decodes
+                           if r.uid not in self._on_prefill]
+                prefills = [r for r in prefills
+                            if r.uid not in self._on_prefill]
         # decode SLA: every decoding sequence's 1 token is RESERVED before
         # drafts or prefill chunks may spend anything (generate() reserves
         # identically: draft_budget = max_batch - len(live))
@@ -1730,8 +1927,12 @@ class ServingScheduler:
                 # pages — so the victim is the newest NON-wave sequence;
                 # with only wave members live the fill simply yields (the
                 # post-harvest pass owns eviction with the fence down).
+                # prefill-group residents are fenced like wave members:
+                # their decode-pool blocks are mid-handoff (they free via
+                # degrade/abort, never via this eviction path)
                 vi = next((i for i in range(len(self._live) - 1, -1, -1)
-                           if self._live[i].uid not in self._in_flight),
+                           if self._live[i].uid not in self._in_flight
+                           and self._live[i].uid not in self._on_prefill),
                           None)
                 if vi is None:
                     return None
@@ -1836,11 +2037,15 @@ class ServingScheduler:
             obs.tokens.inc()
             obs.decode_tokens.inc()
 
-    def _emit_device(self, wave) -> None:
+    def _emit_device(self, wave, engine: Optional[InferenceEngineV2] = None
+                     ) -> None:
         """ONE batched on-device sampling dispatch for every device-eligible
         row of a per-token tick (engine.sample_rows) — the N sampled
-        decodes of a tick cost one host round-trip, not N."""
-        toks, lps = self._engine.sample_rows(
+        decodes of a tick cost one host round-trip, not N. ``engine``
+        points the dispatch at the prefill group's engine for first
+        tokens sampled there (same program, same key chain → same bits)."""
+        eng = engine if engine is not None else self._engine
+        toks, lps = eng.sample_rows(
             [r.uid for r, _ in wave], [row for _, row in wave],
             [self._spec_for(r) for r, _ in wave])
         for (req, _), tok, lp in zip(wave, toks, lps):
@@ -1899,6 +2104,10 @@ class ServingScheduler:
         for req in list(self._live):
             if req.uid in self._in_flight:
                 continue  # fused wave in flight: judge/flush after harvest
+            if req.uid in self._on_prefill:
+                # prefill-group resident: no decode-side descriptor yet —
+                # an eos-on-first-token finish lands at takeover instead
+                continue
             if not req.outputs or req.pending > 1:
                 continue  # still (re)prefilling — nothing sampled to judge
             if self._engine._state_manager.get_sequence(req.uid) is None:
@@ -1910,6 +2119,12 @@ class ServingScheduler:
                 self._finish(req)
 
     def _finish(self, req: _Request, flush: bool = True) -> None:
+        if self._disagg is not None and req.uid in self._on_prefill:
+            # prefill-group resident: its engine state is the prefill
+            # seq + handoff (no decode-side descriptor to flush)
+            self._on_prefill.discard(req.uid)
+            self._disagg.abort(req.uid)
+            flush = False
         if flush:
             self._engine.flush(req.uid)
         if (self._journal is not None and not req.journal_skip
@@ -2343,10 +2558,12 @@ def install_sigterm_handoff(sched: ServingScheduler, httpd) -> bool:
 
 def serve(engine: InferenceEngineV2, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, block: bool = True,
-          fused_decode_window: Optional[int] = None):
+          fused_decode_window: Optional[int] = None,
+          disagg: Optional[DisaggServing] = None):
     """One-call deployment: start the scheduler + HTTP server (mii.serve)."""
     sched = ServingScheduler(
-        engine, fused_decode_window=fused_decode_window).start()
+        engine, fused_decode_window=fused_decode_window,
+        disagg=disagg).start()
     httpd = create_http_server(sched, host, port, tokenizer)
     install_sigterm_handoff(sched, httpd)
     if not block:

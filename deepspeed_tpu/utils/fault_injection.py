@@ -41,6 +41,9 @@ Registered sites (the code that hosts them decides the fault's meaning):
   frame: a crash mid-write the recovery scan must resync past.
 - ``journal.corrupt_record``  — a journal append lands with a flipped
   payload byte: silent bit-rot the CRC must quarantine per-record.
+- ``disagg.transfer_stall``   — a prefill→decode KV handoff transfer
+  batch wedges (never becomes ready): the disagg watchdog must degrade
+  the request to in-group prefill instead of stalling admission.
 
 Env syntax: ``DS_FAULT_INJECT="site[@nth][*times][;site2...]"`` e.g.
 ``DS_FAULT_INJECT="checkpoint.torn_write@2;train.nan_grads@5*3"``.
@@ -66,6 +69,7 @@ KNOWN_SITES = (
     "serve.crash",
     "journal.torn_write",
     "journal.corrupt_record",
+    "disagg.transfer_stall",
 )
 
 
