@@ -205,6 +205,14 @@ run bench_serving_tp 1500 env DS_BENCH_TP=1 DS_BENCH_FAST=1 python bench_serving
 # TTFT p50 the no-regression guardrails; the A/B summary is journaled to
 # BENCH_HISTORY.jsonl and gated round-over-round by bin/ds_benchdiff
 run bench_serving_disagg 1500 env DS_BENCH_DISAGG=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_DISAGG.json
+# 15l. replica-fleet resilience: 2 real ds_serve replicas behind the
+# router, open-loop streaming arrivals, SIGKILL one mid-stream —
+# availability %, journal-migration p50/p99, tokens_lost (greedy decode
+# is deterministic, so the bar is availability 100 / lost 0). Replicas
+# run on CPU by design: the rung measures the control plane (probe, WAL
+# drain, re-admit, re-attach), and two replicas must not fight for the
+# chip the parent already holds.
+run bench_serving_fleet 1200 env DS_BENCH_FLEET=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FLEET.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

@@ -132,6 +132,13 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
     # is asserted in the child on the fp32-activation arm
     if env_flag("DS_BENCH_TP"):
         results.extend(_measure_tp())
+    # DS_BENCH_FLEET=1: replica-fleet resilience — 2 real ds_serve replica
+    # processes behind the router, open-loop arrivals of streaming
+    # requests, SIGKILL one replica mid-stream: availability %, migration
+    # latency p50/p99, and tokens_lost (greedy decode is deterministic, so
+    # every resumed stream is checked byte-for-byte — the bar is 0)
+    if env_flag("DS_BENCH_FLEET"):
+        results.extend(_measure_fleet())
     # DS_BENCH_MOE=1: Mixtral-style expert-parallel decode through the v2
     # engine (ops/grouped_matmul in the ragged forward) — tok/s +
     # decode_step_ms like the dense rungs, so MoE serving regressions are
@@ -1247,6 +1254,160 @@ def _measure_disagg_child():
             base["ttft_p50_s"] / dis["ttft_p50_s"], 3)
     rows.append(summary)
     return rows
+
+
+def _measure_fleet():
+    """DS_BENCH_FLEET rung: two real ds_serve replicas supervised by the
+    in-process ReplicaFleet behind the router surface; streaming requests
+    arrive open-loop on a seeded exponential schedule; one replica is
+    SIGKILLed while it owns a long stream. Reports availability (share of
+    offered requests whose stream completed without an in-band error),
+    journal-migration latency p50/p99, and tokens_lost — greedy decode is
+    deterministic, so each delivered stream is compared byte-for-byte
+    against a post-hoc reference from the surviving pool and any shortfall
+    or divergence counts as lost. The bar is availability 100 / lost 0.
+
+    Replicas always run on CPU (JAX_PLATFORMS=cpu): the rung measures the
+    control plane — probe, kill, WAL drain, re-admit, re-attach — and two
+    replica processes must not fight the parent for the chip."""
+    import http.client
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    from deepspeed_tpu.inference.v2.router import (ReplicaFleet,
+                                                   create_router_server)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    jroot = tempfile.mkdtemp(prefix="ds_bench_fleet_")
+    cmd = [sys.executable, os.path.join(repo, "bin", "ds_serve"),
+           "--durable", "--port", "{port}", "--kv-blocks", "96"]
+    rng = np.random.default_rng(61)
+    n_requests = 8
+    long_tokens, short_tokens = 192, 48
+    prompts = [rng.integers(1, 31999, size=32).tolist()
+               for _ in range(n_requests)]
+    bodies = [{"prompt": p, "stream": True,
+               "max_new_tokens": long_tokens if i == 0 else short_tokens}
+              for i, p in enumerate(prompts)]
+    gaps = rng.exponential(0.25, size=n_requests)
+
+    fleet = ReplicaFleet(cmd, replicas=2, journal_root=jroot,
+                         probe_interval=0.2, probe_timeout=3.0,
+                         grace_s=5.0, ready_timeout_s=600.0,
+                         retry_after_s=2.0, autoscale=False,
+                         max_replicas=4, jitter_seed=0, env=env)
+    results = [None] * n_requests
+    first_streaming = threading.Event()
+    try:
+        fleet.start()
+        assert fleet.wait_ready(), "fleet never became healthy"
+        srv = create_router_server(fleet, port=0, reattach_timeout_s=120.0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+        def client(i):
+            rec = {"uid": None, "tokens": [], "error": None}
+            results[i] = rec
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=300)
+                conn.request("POST", "/generate", json.dumps(bodies[i]),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                hdr = resp.getheader("X-DS-Request-Id")
+                rec["uid"] = int(hdr) if hdr else None
+                buf = b""
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    *lines, buf = buf.split(b"\n")
+                    for ln in lines:
+                        if not ln.strip():
+                            continue
+                        msg = json.loads(ln)
+                        if "error" in msg:
+                            rec["error"] = msg["error"]
+                        elif "token" in msg:
+                            rec["tokens"].append(msg["token"])
+                            if i == 0 and len(rec["tokens"]) >= 5:
+                                first_streaming.set()
+                conn.close()
+            except Exception as exc:  # a dropped client IS the metric
+                rec["error"] = repr(exc)
+
+        t0 = time.perf_counter()
+        threads = []
+        for i in range(n_requests):
+            target = t0 + float(np.sum(gaps[:i + 1]))
+            while (d := target - time.perf_counter()) > 0:
+                time.sleep(min(d, 0.01))
+            t = threading.Thread(target=client, args=(i, ))
+            t.start()
+            threads.append(t)
+            if i == 0:
+                # the long stream must be mid-flight before anything else
+                # arrives — the kill lands while its owner also holds
+                # freshly balanced admissions
+                assert first_streaming.wait(300), "no stream before kill"
+                victim = fleet.owner_of(results[0]["uid"])
+                victim.proc.send_signal(signal.SIGKILL)
+                t_kill = time.perf_counter()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+
+        # post-hoc references from the surviving pool: greedy decode is
+        # deterministic across replicas (same demo seed), so the full
+        # uninterrupted token list is recoverable after the fact
+        refs = []
+        for body in bodies:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=300)
+            conn.request("POST", "/generate",
+                         json.dumps({**body, "stream": False}),
+                         {"Content-Type": "application/json"})
+            refs.append(json.loads(conn.getresponse().read())["tokens"])
+            conn.close()
+        completed = sum(1 for r in results
+                        if r and r["error"] is None and r["tokens"])
+        tokens_lost = sum(
+            max(0, len(ref) - len(r["tokens"])) for r, ref in
+            zip(results, refs) if r)
+        diverged = sum(1 for r, ref in zip(results, refs)
+                       if r and r["tokens"] != ref[:len(r["tokens"])])
+        lat = sorted(m["seconds"] for m in fleet.migrations)
+
+        def pct(q):
+            return (round(lat[min(len(lat) - 1, int(q * len(lat)))], 4)
+                    if lat else None)
+        row = {"rung": "fleet", "replicas": 2, "requests": n_requests,
+               "availability_pct": round(100.0 * completed / n_requests, 2),
+               "completed": completed,
+               "tokens_lost": int(tokens_lost),
+               "streams_diverged": int(diverged),
+               "migrations": len(fleet.migrations),
+               "migration_p50_s": pct(0.50),
+               "migration_p99_s": pct(0.99),
+               "kill_to_done_s": round(wall - (t_kill - t0), 2),
+               "wall_s": round(wall, 2)}
+        srv.shutdown()
+    finally:
+        fleet.stop()
+    from bench import _history_path, _journal_append
+    _journal_append(_history_path(), {
+        "rung": "serving-fleet",
+        "metric": "availability_pct",
+        "value": row["availability_pct"],
+        "unit": "% offered requests completed across a replica SIGKILL",
+        "tokens_lost": row["tokens_lost"],
+        "migration_p99_s": row["migration_p99_s"]})
+    return [row]
 
 
 def _vs_baseline(results):

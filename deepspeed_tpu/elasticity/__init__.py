@@ -2,4 +2,4 @@ from .config import (ElasticityConfig, ElasticityError, ElasticityConfigError,
                      ElasticityIncompatibleWorldSize)
 from .elasticity import (compute_elastic_config, elasticity_enabled,
                          get_compatible_chip_counts)
-from .agent import DSElasticAgent
+from .agent import DSElasticAgent, probe_available_world

@@ -74,6 +74,14 @@ def _default_world_fn(refresh: bool = False) -> int:
     return _probed_world
 
 
+def probe_available_world(refresh: bool = False) -> int:
+    """Public face of the cached world probe for non-training supervisors
+    (the serving fleet router sizes its replica pool ceiling from this):
+    ``DS_ELASTIC_WORLD_SIZE`` if set, else one subprocess device-count
+    probe — never a jax import in the calling process."""
+    return _default_world_fn(refresh=refresh)
+
+
 class DSElasticAgent:
     """Supervise one SPMD training process with elastic restarts."""
 

@@ -188,6 +188,54 @@ def _scan(buf: bytes) -> Tuple[Dict[int, dict], List[int], int]:
     return state, order, bad
 
 
+def _entries_from_state(state: Dict[int, dict],
+                        order: List[int]) -> List[JournalEntry]:
+    """Unfinished requests in admit order from a scanned per-uid state."""
+    entries = []
+    for uid in order:
+        st = state.get(uid)
+        if st is None:
+            continue
+        adm = st["admit"]
+        entries.append(JournalEntry(
+            uid=uid, prompt=list(adm.get("prompt", [])),
+            params=dict(adm.get("params", {})),
+            tokens=list(st["tokens"]), logprobs=list(st["lps"]),
+            key_burns=int(st["burns"]),
+            deadline_wall=adm.get("dl"),
+            queue_deadline_wall=adm.get("qdl")))
+    return entries
+
+
+def _state_frames(state: Dict[int, dict], order: List[int]) -> bytes:
+    """Serialize the unfinished per-uid state back into the portable
+    CRC-framed wire format (one admit + at most one folded progress record
+    per request) — the same shape ``_compact_locked`` writes to disk, and
+    the payload ``GET /journal/export`` ships between replicas."""
+    out = []
+    for uid in order:
+        st = state.get(uid)
+        if st is None:
+            continue
+        out.append(_encode(st["admit"]))
+        if st["tokens"] or st["burns"]:
+            rec = {"op": "progress", "uid": uid, "tokens": st["tokens"],
+                   "n_out": len(st["tokens"]), "burns": st["burns"]}
+            if st["lps"]:
+                rec["lps"] = st["lps"]
+            out.append(_encode(rec))
+    return b"".join(out)
+
+
+def entries_from_frames(buf: bytes) -> Tuple[List[JournalEntry], int]:
+    """Decode a portable frame stream (a ``/journal/export`` body, or a
+    dead replica's raw WAL segment) into unfinished entries. Damaged
+    records quarantine individually exactly like boot-time recovery.
+    Returns ``(entries, quarantined_count)``."""
+    state, order, bad = _scan(buf)
+    return _entries_from_state(state, order), bad
+
+
 class RequestJournal:
     """Append-only WAL over one segment file, with in-memory mirror.
 
@@ -314,19 +362,7 @@ class RequestJournal:
         os.makedirs(self.dir, exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
-            for uid in self._order:
-                st = self._state.get(uid)
-                if st is None:
-                    continue
-                f.write(_encode(st["admit"]))
-                if st["tokens"] or st["burns"]:
-                    rec = {"op": "progress", "uid": uid,
-                           "tokens": st["tokens"],
-                           "n_out": len(st["tokens"]),
-                           "burns": st["burns"]}
-                    if st["lps"]:
-                        rec["lps"] = st["lps"]
-                    f.write(_encode(rec))
+            f.write(_state_frames(self._state, self._order))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -336,6 +372,16 @@ class RequestJournal:
     def compact(self):
         with self._lock:
             self._compact_locked()
+
+    def export_frames(self) -> Tuple[bytes, int]:
+        """Snapshot the unfinished state as a portable frame stream (the
+        ``GET /journal/export`` body): byte-compatible with the on-disk
+        segment, so the importer reuses the recovery scanner verbatim.
+        Returns ``(frames, depth)``."""
+        with self._lock:
+            self._sync(force=True)
+            return (_state_frames(self._state, self._order),
+                    len(self._state))
 
     # ------------------------------------------------------------ recovery
 
@@ -360,19 +406,7 @@ class RequestJournal:
                     "high-water mark", bad, self.path)
             self._state, self._order = state, order
             self._compact_locked()
-            entries = []
-            for uid in order:
-                st = state.get(uid)
-                if st is None:
-                    continue
-                adm = st["admit"]
-                entries.append(JournalEntry(
-                    uid=uid, prompt=list(adm.get("prompt", [])),
-                    params=dict(adm.get("params", {})),
-                    tokens=list(st["tokens"]), logprobs=list(st["lps"]),
-                    key_burns=int(st["burns"]),
-                    deadline_wall=adm.get("dl"),
-                    queue_deadline_wall=adm.get("qdl")))
+            entries = _entries_from_state(state, order)
             _replay_seconds.record(time.monotonic() - t_rec)
             return entries
 

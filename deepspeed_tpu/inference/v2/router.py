@@ -1,0 +1,1040 @@
+"""Replica fleet router: health-gated balancing, WAL live migration,
+queue-depth autoscaling.
+
+One ``ds_serve`` daemon is a total outage waiting to happen; the reference
+DeepSpeed survives node churn with its elasticity subsystem. This module is
+the serving-side analog: a front-tier daemon that supervises N replicas
+(:class:`ReplicaFleet`, the pool generalization of
+``supervisor.ServingSupervisor``) and fronts them with one HTTP surface
+(:func:`create_router_server`).
+
+Why this is *correct* and not merely available: every replica runs the
+write-ahead request journal (PR 8), whose frame stream is portable — any
+unfinished entry replays byte-identically on any identically-built peer.
+So replica death is not request death:
+
+* **crash** (SIGKILL, OOM) — the dead replica's WAL segment is read
+  straight off disk (the on-disk bytes ARE the export format) and POSTed
+  to a healthy peer's ``/journal/import``; the peer re-admits every
+  unfinished request mid-run and regenerates each stream's suffix
+  deterministically.
+* **scale-down / sustained degraded** — the live replica's
+  ``GET /journal/export`` drains it first (readiness flips to
+  ``migrating``), then the same import path adopts the entries.
+
+Clients never see the topology: submits balance onto the least-loaded
+healthy replica (queue depth + live count from the probe loop's ``/health``
+snapshots), refused/timed-out submits retry against a peer with
+full-jittered backoff (``utils/retry``), and a stream severed mid-decode
+re-attaches to the request's new owner at the client's own token
+high-water mark (``GET /requests/<uid>/stream?from_token=N``) — zero gap,
+zero duplicates. uid collisions across replicas cannot happen by
+construction: each replica *generation* mints uids in its own stride
+(``DS_SERVE_UID_BASE`` = generation x stride) and imports never bump the
+peer's iterator.
+
+An autoscaler loop grows the pool when mean queue depth or
+``fused_occupancy`` run hot for ``hysteresis`` consecutive evaluations and
+shrinks it (live migration first) when cold, with a cooldown between
+actions so the two thresholds cannot flap. The pool ceiling defaults to
+the available world size probed by ``elasticity.probe_available_world``.
+
+Every failure leg is deterministically testable via fault sites:
+``router.replica_crash`` (probe-time SIGKILL), ``router.probe_timeout``
+(probe behaves timed out → quarantine after a streak → healthy probe
+re-admits), ``router.migrate_stall`` (an export/import leg wedges → the
+stall budget trips), ``router.split_brain_uid`` (import-side uid
+collision → the entry is refused and surfaced here). When no healthy peer
+exists to adopt a drained journal, the router degrades gracefully:
+affected uids are error-finished with a ``Retry-After`` hint instead of
+hanging the fleet.
+"""
+
+import json
+import os
+import random
+import shlex
+import signal
+import socket
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.client import HTTPConnection, HTTPException
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+from ...observability import get_registry
+from ...utils.fault_injection import get_fault_injector
+from ...utils.logging import logger
+from ...utils.retry import backoff_delay
+from .journal import SEGMENT_NAME, entries_from_frames
+
+# Fleet accounting (process registry, resolved at import).
+_obs = get_registry()
+_submits = _obs.counter("ds_router_submits_total",
+                        "Requests admitted through the router")
+_retries = _obs.counter("ds_router_retries_total",
+                        "Submits retried against a peer replica")
+_probe_failures = _obs.counter("ds_router_probe_failures_total",
+                               "Replica health probes that failed/timed out")
+_quarantines = _obs.counter("ds_router_quarantines_total",
+                            "Replicas quarantined after a probe-failure streak")
+_unavailable = _obs.counter("ds_router_unavailable_total",
+                            "Requests refused: no healthy replica")
+_reattaches = _obs.counter("ds_router_stream_reattaches_total",
+                           "Severed client streams re-attached to a new owner")
+_pool_size = _obs.gauge("ds_router_pool_size", "Live replica count")
+_migration_seconds = _obs.histogram(
+    "ds_router_migration_seconds",
+    "Journal drain -> peer import wall time", lo=1e-3, hi=1e3,
+    buckets_per_decade=10)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class MigrationFailed(RuntimeError):
+    """No healthy peer adopted the drained journal (or a leg stalled)."""
+
+
+class _Replica:
+    """One supervised serving process + the router's view of its health."""
+
+    def __init__(self, generation: int, port: int, uid_base: int,
+                 journal_dir: str):
+        self.generation = generation
+        self.port = int(port)
+        self.uid_base = int(uid_base)
+        self.journal_dir = journal_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "starting"   # starting|ok|degraded|quarantined|
+        #                           migrating|dead|stopped
+        self.fail_streak = 0
+        self.stats: dict = {}
+        self.t_launched = 0.0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def routable(self) -> bool:
+        """May new submits land here? Degraded stays routable (the
+        watchdog owns recovery); quarantined/migrating/dead do not."""
+        return self.state in ("ok", "degraded")
+
+    def score(self) -> float:
+        """Load score for balanced admission: queue depth + in-flight."""
+        st = self.stats or {}
+        return float(st.get("waiting") or 0) + float(st.get("live") or 0)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def describe(self) -> dict:
+        return {"generation": self.generation, "port": self.port,
+                "state": self.state, "uid_base": self.uid_base,
+                "fail_streak": self.fail_streak,
+                "score": self.score(),
+                "pid": self.proc.pid if self.proc else None,
+                "waiting": (self.stats or {}).get("waiting"),
+                "live": (self.stats or {}).get("live"),
+                "fused_occupancy": (self.stats or {}).get("fused_occupancy")}
+
+
+class ReplicaFleet:
+    """Supervise a pool of serving replicas with health-gated membership.
+
+    ``replica_cmd`` is the daemon argv with ``{port}`` placeholders; each
+    launched generation gets a fresh journal directory and a disjoint uid
+    stride via env (``DS_TPU_JOURNAL_DIR``, ``DS_SERVE_UID_BASE``), so two
+    generations can never double-replay one journal or mint one uid twice.
+    """
+
+    def __init__(self, replica_cmd: Sequence[str],
+                 replicas: int = 2,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 journal_root: Optional[str] = None,
+                 uid_stride: int = 1_000_000,
+                 probe_interval: float = 1.0,
+                 probe_timeout: float = 2.0,
+                 quarantine_after: int = 3,
+                 ready_timeout_s: float = 120.0,
+                 grace_s: float = 15.0,
+                 migrate_stall_s: float = 30.0,
+                 retry_after_s: float = 5.0,
+                 autoscale: bool = True,
+                 queue_high: float = 8.0,
+                 queue_low: float = 1.0,
+                 occupancy_high: float = 0.95,
+                 queue_eval_interval: float = 2.0,
+                 hysteresis: int = 3,
+                 cooldown_s: float = 10.0,
+                 env: Optional[dict] = None,
+                 jitter_seed: Optional[int] = None):
+        if max_replicas is None:
+            from ...elasticity import probe_available_world
+            max_replicas = max(int(replicas), probe_available_world())
+        self.replica_cmd = list(replica_cmd)
+        self.target = int(replicas)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.journal_root = journal_root or os.path.join(
+            os.path.expanduser(os.environ.get("DS_TPU_JOURNAL_DIR")
+                               or "~/.cache/deepspeed_tpu/journal"), "fleet")
+        self.uid_stride = int(uid_stride)
+        self.probe_interval = float(probe_interval)
+        self.probe_timeout = float(probe_timeout)
+        self.quarantine_after = int(quarantine_after)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.grace_s = float(grace_s)
+        self.migrate_stall_s = float(migrate_stall_s)
+        self.retry_after_s = float(retry_after_s)
+        self.autoscale = bool(autoscale)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.occupancy_high = float(occupancy_high)
+        self.queue_eval_interval = float(queue_eval_interval)
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown_s = float(cooldown_s)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.rng = random.Random(jitter_seed)
+        self._lock = threading.RLock()
+        self._pool: List[_Replica] = []
+        self._generation = 0
+        # uid -> replica currently owning the request (submit + migration
+        # keep this current; the reattach surface routes through it)
+        self._owners: Dict[int, _Replica] = {}
+        # uid -> wall deadline after which a client may retry: requests
+        # whose journal could not be adopted anywhere (graceful degradation)
+        self._lost: Dict[int, float] = {}
+        self._hot_streak = 0
+        self._cold_streak = 0
+        self._t_scaled = 0.0
+        self._t_eval = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.migrations: List[dict] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ReplicaFleet":
+        os.makedirs(self.journal_root, exist_ok=True)
+        for _ in range(self.target):
+            self._launch_replica()
+        self._thread = threading.Thread(target=self._control_loop,
+                                        name="ds-router-control", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.probe_interval * 4 + 5.0)
+            self._thread = None
+        with self._lock:
+            pool = list(self._pool)
+        for r in pool:
+            self._terminate(r)
+        with self._lock:
+            self._pool.clear()
+            _pool_size.set(0)
+
+    def _launch_replica(self) -> _Replica:
+        with self._lock:
+            self._generation += 1
+            g = self._generation
+            r = _Replica(
+                generation=g, port=_free_port(),
+                uid_base=g * self.uid_stride,
+                journal_dir=os.path.join(self.journal_root, f"gen{g:04d}"))
+            cmd = [a.replace("{port}", str(r.port)) for a in self.replica_cmd]
+            env = dict(self.base_env)
+            env["DS_SERVE_UID_BASE"] = str(r.uid_base)
+            env["DS_TPU_JOURNAL_DIR"] = r.journal_dir
+            logger.info(f"ReplicaFleet: launching replica gen{g} "
+                        f"port={r.port} uid_base={r.uid_base}")
+            r.proc = subprocess.Popen(cmd, env=env)
+            r.t_launched = time.monotonic()
+            self._pool.append(r)
+            _pool_size.set(len(self._pool))
+            return r
+
+    def _terminate(self, r: _Replica) -> None:
+        if r.proc is None or r.proc.poll() is not None:
+            return
+        r.proc.send_signal(signal.SIGTERM)
+        try:
+            r.proc.wait(timeout=self.grace_s)
+        except subprocess.TimeoutExpired:
+            logger.warning(f"ReplicaFleet: gen{r.generation} ignored SIGTERM "
+                           f"for {self.grace_s}s — killing")
+            r.proc.kill()
+            r.proc.wait()
+        r.state = "stopped"
+
+    # ------------------------------------------------------------ probing
+
+    def _probe(self, r: _Replica) -> None:
+        """One health probe; owns the ok/degraded/quarantined transitions
+        and fires crash handling when the process is gone."""
+        inj = get_fault_injector()
+        if inj.fire("router.replica_crash") is not None and r.alive():
+            logger.warning(f"[fault-injection] SIGKILL replica "
+                           f"gen{r.generation}")
+            r.proc.kill()
+            r.proc.wait()
+        if not r.alive():
+            if r.state not in ("dead", "stopped"):
+                r.state = "dead"
+                self._on_replica_dead(r)
+            return
+        timed_out = inj.fire("router.probe_timeout") is not None
+        payload = None
+        if not timed_out:
+            try:
+                req = urllib.request.Request(r.base_url + "/health")
+                with urllib.request.urlopen(
+                        req, timeout=self.probe_timeout) as resp:
+                    payload = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                # 503 carries the full stats payload (draining/degraded/
+                # migrating) — the server answered; it is not timed out
+                try:
+                    payload = json.loads(e.read())
+                except (ValueError, OSError):
+                    payload = {"status": "degraded"}
+            except (urllib.error.URLError, OSError, TimeoutError, ValueError):
+                timed_out = True
+        if timed_out:
+            if r.state == "starting":
+                return  # still booting: refused connects are not a signal
+            r.fail_streak += 1
+            _probe_failures.inc()
+            if (r.fail_streak >= self.quarantine_after
+                    and r.state != "quarantined"):
+                logger.warning(
+                    f"ReplicaFleet: gen{r.generation} quarantined after "
+                    f"{r.fail_streak} probe failures")
+                r.state = "quarantined"
+                _quarantines.inc()
+            return
+        r.fail_streak = 0
+        r.stats = payload
+        status = payload.get("status", "ok")
+        if status == "ok":
+            if r.state in ("starting", "degraded", "quarantined"):
+                if r.state == "quarantined":
+                    logger.info(f"ReplicaFleet: gen{r.generation} healthy "
+                                f"again — re-admitted")
+                r.state = "ok"
+        elif status == "degraded":
+            r.state = "degraded"
+        elif status == "migrating":
+            r.state = "migrating"
+        # draining/stopped answer 503 and keep their last state; the
+        # process-exit path owns the dead transition
+
+    def _control_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            with self._lock:
+                pool = list(self._pool)
+            for r in pool:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._probe(r)
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    logger.warning(f"ReplicaFleet: probe gen{r.generation} "
+                                   f"raised: {e}")
+            try:
+                self._reap()
+                if self.autoscale:
+                    self._autoscale_tick()
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"ReplicaFleet: control tick raised: {e}")
+
+    def _reap(self) -> None:
+        """Drop dead/stopped replicas from the pool and backfill up to the
+        current target so a crash never silently shrinks capacity."""
+        with self._lock:
+            self._pool = [r for r in self._pool
+                          if r.state not in ("dead", "stopped")]
+            _pool_size.set(len(self._pool))
+            deficit = self.target - len(self._pool)
+        for _ in range(max(0, deficit)):
+            self._launch_replica()
+
+    # ------------------------------------------------------------ selection
+
+    def healthy(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self._pool if r.routable]
+
+    def pick(self, exclude: Sequence[_Replica] = ()) -> Optional[_Replica]:
+        """Least-loaded routable replica (health-gated balanced admission);
+        ties break by uid_base for determinism."""
+        cands = [r for r in self.healthy() if r not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.score(), r.uid_base))
+
+    def owner_of(self, uid: int) -> Optional[_Replica]:
+        with self._lock:
+            return self._owners.get(uid)
+
+    def note_owner(self, uid: int, r: _Replica) -> None:
+        with self._lock:
+            self._owners[uid] = r
+
+    def lost_retry_after(self, uid: int) -> Optional[float]:
+        """Seconds a client should wait before retrying a request whose
+        journal migration failed; None if the uid is not marked lost."""
+        with self._lock:
+            dl = self._lost.get(uid)
+        if dl is None:
+            return None
+        return max(1.0, dl - time.monotonic())
+
+    # ------------------------------------------------------------ migration
+
+    def _drain_frames(self, r: _Replica) -> bytes:
+        """The replica's unfinished journal as portable CRC frames: over
+        HTTP while it lives (``/journal/export`` drains it first), straight
+        off its WAL segment when it is already dead — the on-disk bytes ARE
+        the wire format, so a SIGKILL'd replica exports posthumously."""
+        if get_fault_injector().fire("router.migrate_stall") is not None:
+            time.sleep(self.migrate_stall_s)
+            raise MigrationFailed(
+                f"journal drain from gen{r.generation} stalled past "
+                f"{self.migrate_stall_s}s")
+        if r.alive():
+            req = urllib.request.Request(r.base_url + "/journal/export")
+            with urllib.request.urlopen(
+                    req, timeout=self.migrate_stall_s) as resp:
+                return resp.read()
+        path = os.path.join(r.journal_dir, SEGMENT_NAME)
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return b""
+
+    def _import_into(self, target: _Replica, frames: bytes) -> dict:
+        req = urllib.request.Request(
+            target.base_url + "/journal/import", data=frames,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(
+                req, timeout=self.migrate_stall_s) as resp:
+            return json.loads(resp.read())
+
+    def migrate_from(self, source: _Replica) -> dict:
+        """Drain ``source``'s journal and hand every unfinished request to
+        a healthy peer. Peers are tried least-loaded-first with full-jitter
+        backoff between attempts; with no adopter, the affected uids are
+        error-finished with a Retry-After hint (graceful degradation — the
+        fleet keeps serving fresh traffic) and :class:`MigrationFailed`
+        raises."""
+        t0 = time.monotonic()
+        if source.alive():
+            # a dead source stays "dead" — overwriting it would make the
+            # probe loop re-detect the death and migrate the WAL twice
+            source.state = "migrating"
+        try:
+            frames = self._drain_frames(source)
+        except (MigrationFailed, urllib.error.URLError, OSError,
+                TimeoutError) as e:
+            # nothing drained -> nothing to mark lost here; whatever the
+            # WAL held stays on disk for a later manual replay
+            logger.warning(f"ReplicaFleet: drain from gen{source.generation} "
+                           f"failed: {e}")
+            raise MigrationFailed(str(e)) from e
+        entries, bad = entries_from_frames(frames)
+        uids = [e.uid for e in entries]
+        if not uids:
+            logger.info(f"ReplicaFleet: gen{source.generation} had no "
+                        f"unfinished requests — nothing to migrate")
+            return {"migrated": 0, "refused_uids": [], "uids": []}
+        last_err: Optional[Exception] = None
+        for attempt in range(3):
+            target = self.pick(exclude=(source, ))
+            if target is None:
+                break
+            try:
+                res = self._import_into(target, frames)
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError) as e:
+                last_err = e
+                logger.warning(
+                    f"ReplicaFleet: import into gen{target.generation} "
+                    f"failed ({e}); retrying elsewhere")
+                time.sleep(backoff_delay(attempt, base_delay=0.1,
+                                         max_delay=2.0, jitter="full",
+                                         rng=self.rng))
+                continue
+            refused = set(res.get("refused_uids") or [])
+            with self._lock:
+                for uid in uids:
+                    if uid in refused:
+                        if self._owners.get(uid) is target:
+                            # the target ALREADY owns it (an earlier leg
+                            # of this migration landed) — not a conflict
+                            continue
+                        # split brain: the peer owns a uid it was never
+                        # handed — surface it instead of double-serving
+                        self._lost[uid] = (time.monotonic()
+                                           + self.retry_after_s)
+                        self._owners.pop(uid, None)
+                    else:
+                        self._owners[uid] = target
+            dt = time.monotonic() - t0
+            _migration_seconds.record(dt)
+            rec = {"source_gen": source.generation,
+                   "target_gen": target.generation,
+                   "mode": "live" if source.alive() else "crash",
+                   "migrated": len(uids) - len(refused),
+                   "refused_uids": sorted(refused),
+                   "quarantined_records": bad,
+                   "seconds": round(dt, 4)}
+            self.migrations.append(rec)
+            logger.info(f"ReplicaFleet: migrated {rec['migrated']} "
+                        f"request(s) gen{source.generation} -> "
+                        f"gen{target.generation} in {dt:.2f}s")
+            return {**rec, "uids": uids}
+        # no adopter: error-finish with a retry hint instead of hanging
+        with self._lock:
+            dl = time.monotonic() + self.retry_after_s
+            for uid in uids:
+                self._lost[uid] = dl
+                self._owners.pop(uid, None)
+        _unavailable.inc()
+        logger.error(f"ReplicaFleet: no healthy peer adopted "
+                     f"gen{source.generation}'s journal — {len(uids)} "
+                     f"request(s) error-finished with Retry-After")
+        raise MigrationFailed(
+            f"no healthy peer for {len(uids)} request(s)") from last_err
+
+    def _on_replica_dead(self, r: _Replica) -> None:
+        logger.warning(f"ReplicaFleet: replica gen{r.generation} died "
+                       f"(rc={r.proc.returncode if r.proc else None})")
+        try:
+            self.migrate_from(r)
+        except MigrationFailed:
+            pass
+        # _reap() backfills the pool on the next control tick
+
+    # ------------------------------------------------------------ scaling
+
+    def scale_up(self) -> Optional[_Replica]:
+        with self._lock:
+            if len(self._pool) >= self.max_replicas:
+                return None
+            self.target = min(self.max_replicas, self.target + 1)
+        logger.info(f"ReplicaFleet: scale up -> target {self.target}")
+        return self._launch_replica()
+
+    def scale_down(self) -> bool:
+        """Shrink by one: the least-loaded replica live-migrates its
+        journal to a peer, then terminates (SIGTERM)."""
+        with self._lock:
+            if len(self._pool) <= self.min_replicas:
+                return False
+            victim = min((r for r in self._pool if r.routable),
+                         key=lambda r: (r.score(), -r.generation),
+                         default=None)
+            if victim is None:
+                return False
+            self.target = max(self.min_replicas, self.target - 1)
+        logger.info(f"ReplicaFleet: scale down gen{victim.generation} "
+                    f"-> target {self.target}")
+        try:
+            self.migrate_from(victim)
+        except MigrationFailed:
+            pass  # uids already error-finished with Retry-After
+        self._terminate(victim)
+        return True
+
+    def _autoscale_tick(self) -> None:
+        now = time.monotonic()
+        if now - self._t_eval < self.queue_eval_interval:
+            return
+        self._t_eval = now
+        healthy = self.healthy()
+        if not healthy:
+            return
+        depth = sum(r.score() for r in healthy) / len(healthy)
+        occs = [float((r.stats or {}).get("fused_occupancy") or 0.0)
+                for r in healthy]
+        occ = max(occs) if occs else 0.0
+        hot = depth >= self.queue_high or occ >= self.occupancy_high
+        cold = depth <= self.queue_low
+        self._hot_streak = self._hot_streak + 1 if hot else 0
+        self._cold_streak = self._cold_streak + 1 if cold else 0
+        if now - self._t_scaled < self.cooldown_s:
+            return
+        # hysteresis: one threshold crossing is noise; `hysteresis`
+        # consecutive evaluations is a trend — and hot wins over cold
+        if self._hot_streak >= self.hysteresis:
+            if self.scale_up() is not None:
+                self._t_scaled = now
+            self._hot_streak = self._cold_streak = 0
+        elif self._cold_streak >= self.hysteresis:
+            if self.scale_down():
+                self._t_scaled = now
+            self._hot_streak = self._cold_streak = 0
+
+    # ------------------------------------------------------------ status
+
+    def status(self) -> dict:
+        with self._lock:
+            pool = [r.describe() for r in self._pool]
+            lost = len(self._lost)
+        healthy = sum(1 for p in pool if p["state"] in ("ok", "degraded"))
+        return {"replicas": pool, "pool_size": len(pool),
+                "healthy": healthy, "target": self.target,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "lost_uids": lost,
+                "migrations": len(self.migrations)}
+
+    def wait_ready(self, timeout_s: Optional[float] = None,
+                   n: Optional[int] = None) -> bool:
+        """Block until ``n`` (default: target) replicas probe healthy."""
+        need = self.target if n is None else int(n)
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.ready_timeout_s)
+        while time.monotonic() < deadline:
+            if len(self.healthy()) >= need:
+                return True
+            time.sleep(0.05)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def create_router_server(fleet: ReplicaFleet, host: str = "127.0.0.1",
+                         port: int = 8080,
+                         submit_retries: int = 3,
+                         reattach_timeout_s: float = 60.0):
+    """One client-facing surface over the fleet.
+
+    POST /generate | /v1/completions | /v1/chat/completions — balanced
+      onto the least-loaded healthy replica; refused/timed-out submits
+      retry a peer with full-jitter backoff. Streaming responses proxy
+      chunk-for-chunk; a replica dying mid-stream is invisible — the
+      router waits for the journal migration to land and re-attaches to
+      the new owner at the exact token count already forwarded.
+    GET /requests/<uid>[/stream?from_token=N] — proxied to the uid's
+      current owner (migration keeps the mapping fresh).
+    GET /health — fleet status: 200 with >=1 routable replica, else 503
+      with Retry-After. GET /metrics — ds_router_* + process registry.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet by default
+            pass
+
+        def _json(self, code: int, obj, headers=()) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _no_replica(self):
+            _unavailable.inc()
+            self._json(503, {"error": "no healthy replica"},
+                       headers=(("Retry-After",
+                                 str(max(1, round(fleet.retry_after_s)))), ))
+
+        # -------------------------------------------------- GET surface
+
+        def do_GET(self):
+            if self.path == "/health":
+                st = fleet.status()
+                ok = st["healthy"] > 0
+                status = "ok" if ok else "unavailable"
+                hdrs = () if ok else (
+                    ("Retry-After", str(max(1, round(fleet.retry_after_s)))),)
+                self._json(200 if ok else 503,
+                           {"status": status, **st}, headers=hdrs)
+            elif self.path == "/metrics":
+                body = _obs.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/requests/"):
+                self._proxy_request_get()
+            else:
+                self._json(404, {"error": "not found"})
+
+        def _uid_from_path(self) -> Optional[int]:
+            parts = [p for p in self.path.split("?")[0].split("/") if p]
+            try:
+                return int(parts[1])
+            except (IndexError, ValueError):
+                return None
+
+        def _proxy_request_get(self):
+            uid = self._uid_from_path()
+            if uid is None:
+                self._json(400, {"error": "bad request id"})
+                return
+            ra = fleet.lost_retry_after(uid)
+            if ra is not None:
+                self._json(503, {"error": f"request {uid} was lost in "
+                                          f"migration; retry"},
+                           headers=(("Retry-After", str(max(1, round(ra)))),))
+                return
+            owner = fleet.owner_of(uid)
+            if owner is None or not owner.routable:
+                # unknown to the router (e.g. router restarted): ask around
+                owner = next((r for r in fleet.healthy()
+                              if self._uid_known(r, uid)), None)
+                if owner is None:
+                    self._json(404, {"error": f"unknown request {uid}"})
+                    return
+                fleet.note_owner(uid, owner)
+            self._proxy_stream(owner, "GET", self.path, None, uid=uid)
+
+        @staticmethod
+        def _uid_known(r: _Replica, uid: int) -> bool:
+            try:
+                req = urllib.request.Request(f"{r.base_url}/requests/{uid}")
+                with urllib.request.urlopen(req, timeout=2.0):
+                    return True
+            except urllib.error.HTTPError as e:
+                return e.code != 404
+            except (urllib.error.URLError, OSError, TimeoutError):
+                return False
+
+        # -------------------------------------------------- POST surface
+
+        def do_POST(self):
+            if self.path not in ("/generate", "/v1/completions",
+                                 "/v1/chat/completions"):
+                self._json(404, {"error": "not found"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            tried: List[_Replica] = []
+            for attempt in range(max(1, submit_retries)):
+                r = fleet.pick(exclude=tried)
+                if r is None:
+                    break
+                if attempt:
+                    _retries.inc()
+                    time.sleep(backoff_delay(attempt - 1, base_delay=0.05,
+                                             max_delay=1.0, jitter="full",
+                                             rng=fleet.rng))
+                tried.append(r)
+                if self._forward_submit(r, body):
+                    return
+            self._no_replica()
+
+        def _forward_submit(self, r: _Replica, body: bytes) -> bool:
+            """One submit attempt against one replica. Returns True when a
+            response was relayed to the client (success OR a definitive
+            per-request error); False means "try a peer"."""
+            conn = HTTPConnection("127.0.0.1", r.port,
+                                  timeout=fleet.migrate_stall_s)
+            try:
+                conn.request("POST", self.path, body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except (OSError, TimeoutError):
+                conn.close()
+                return False  # refused/timed out pre-admission: idempotent
+            if resp.status in (429, 503):
+                # overloaded/draining — definitive from this replica, but a
+                # peer may have room
+                resp.read()
+                conn.close()
+                return False
+            if resp.getheader("Transfer-Encoding", "").lower() == "chunked":
+                uid_hdr = resp.getheader("X-DS-Request-Id")
+                uid = int(uid_hdr) if uid_hdr else None
+                if uid is not None:
+                    fleet.note_owner(uid, r)
+                _submits.inc()
+                self._relay_stream(resp, conn, uid)
+                return True
+            payload = resp.read()
+            uid_hdr = resp.getheader("X-DS-Request-Id")
+            if uid_hdr:
+                fleet.note_owner(int(uid_hdr), r)
+            if resp.status == 200:
+                _submits.inc()
+            self.send_response(resp.status)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type",
+                                            "application/json"))
+            self.send_header("Content-Length", str(len(payload)))
+            if uid_hdr:
+                self.send_header("X-DS-Request-Id", uid_hdr)
+            self.end_headers()
+            self.wfile.write(payload)
+            conn.close()
+            return True
+
+        # -------------------------------------------------- streaming
+
+        def _begin_chunked(self, uid: Optional[int]) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Transfer-Encoding", "chunked")
+            if uid is not None:
+                self.send_header("X-DS-Request-Id", str(uid))
+            self.end_headers()
+
+        def _send_chunk(self, line: bytes) -> None:
+            self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
+                             + line + b"\r\n")
+
+        def _end_chunks(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+
+        def _pump_chunks(self, resp) -> "tuple":
+            """Parse the upstream's chunked framing raw off the response
+            socket, forwarding each non-empty line to the client. Returns
+            ``(lines_forwarded, clean)`` — ``clean`` only when the proper
+            0-length terminator arrived. http.client's own readers can't
+            make this distinction (peek swallows IncompleteRead and a torn
+            EOF looks identical to a clean close), and the difference is
+            exactly what separates "stream done" from "replica died"."""
+            fp, n = resp.fp, 0
+            buf = b""
+            try:
+                while True:
+                    size_line = fp.readline(65536)
+                    if not size_line:
+                        return n, False  # EOF before terminator: severed
+                    try:
+                        size = int(size_line.strip().split(b";")[0], 16)
+                    except ValueError:
+                        return n, False
+                    if size == 0:
+                        fp.readline(65536)  # trailing CRLF
+                        return n, True
+                    data = fp.read(size + 2)
+                    if data is None or len(data) < size:
+                        return n, False
+                    buf += data[:size]
+                    *lines, buf = buf.split(b"\n")
+                    for line in lines:
+                        if line.strip():
+                            self._send_chunk(line.strip() + b"\n")
+                            n += 1
+            except (OSError, TimeoutError, HTTPException):
+                return n, False
+
+        def _relay_stream(self, resp, conn, uid: Optional[int],
+                          already_sent: int = 0,
+                          started: bool = False) -> None:
+            """Proxy a chunked token stream; on a severed upstream (the
+            replica died mid-decode) re-attach to the uid's new owner at
+            the forwarded-token high-water mark and keep going — the
+            client sees one uninterrupted stream."""
+            sent = already_sent
+            if not started:
+                self._begin_chunked(uid)
+            while True:
+                n, clean = self._pump_chunks(resp)
+                sent += n
+                conn.close()
+                if clean:
+                    self._end_chunks()
+                    return
+                logger.warning(f"ds_router: upstream stream severed "
+                               f"(uid={uid})")
+                # mid-stream death: wait for the migration to land
+                if uid is None:
+                    self._end_chunks()
+                    return
+                resp, conn = self._reattach(uid, sent)
+                if resp is None:
+                    ra = fleet.lost_retry_after(uid) or fleet.retry_after_s
+                    self._send_chunk(json.dumps(
+                        {"error": f"request {uid} lost in migration",
+                         "retry_after_s": round(ra, 1)}).encode() + b"\n")
+                    self._end_chunks()
+                    return
+                # loop: relay the resumed stream (byte-identical suffix)
+
+        def _reattach(self, uid: int, sent: int):
+            """Re-open the uid's stream on its (possibly migrating) owner.
+            Retried until ``reattach_timeout_s``: a dying replica can look
+            alive for a few ms after SIGKILL (poll() races the reaper), so
+            the first attempt may land on the corpse and get a connection
+            reset, and a freshly imported uid may not be visible for one
+            beat.  Returns ``(resp, conn)`` or ``(None, None)``."""
+            deadline = time.monotonic() + reattach_timeout_s
+            attempt = 0
+            while time.monotonic() < deadline:
+                nxt = self._await_new_owner(uid, deadline)
+                if nxt is None:
+                    return None, None
+                _reattaches.inc()
+                conn = HTTPConnection("127.0.0.1", nxt.port,
+                                      timeout=reattach_timeout_s)
+                try:
+                    conn.request("GET", f"/requests/{uid}/stream"
+                                        f"?from_token={sent}")
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        resp.read()
+                        raise OSError(f"reattach got {resp.status}")
+                    return resp, conn
+                except (OSError, TimeoutError, HTTPException) as exc:
+                    conn.close()
+                    logger.warning(f"ds_router: reattach for uid={uid} to "
+                                   f"gen{nxt.generation} failed "
+                                   f"(attempt {attempt}): {exc!r}")
+                    attempt += 1
+                    time.sleep(backoff_delay(attempt, 0.05, 1.0,
+                                             jitter="full", rng=fleet.rng))
+            return None, None
+
+        def _await_new_owner(self, uid: int,
+                             deadline: float) -> Optional[_Replica]:
+            while time.monotonic() < deadline:
+                if fleet.lost_retry_after(uid) is not None:
+                    return None
+                owner = fleet.owner_of(uid)
+                if owner is not None and owner.routable and owner.alive():
+                    return owner
+                time.sleep(0.05)
+            return None
+
+        def _proxy_stream(self, r: _Replica, method: str, path: str,
+                          body: Optional[bytes], uid: Optional[int]) -> None:
+            conn = HTTPConnection("127.0.0.1", r.port,
+                                  timeout=reattach_timeout_s)
+            try:
+                conn.request(method, path, body=body)
+                resp = conn.getresponse()
+            except (OSError, TimeoutError):
+                conn.close()
+                self._no_replica()
+                return
+            if resp.getheader("Transfer-Encoding", "").lower() == "chunked":
+                # count tokens the CLIENT already holds (from_token=N in
+                # the proxied path) so a mid-proxy reattach resumes at the
+                # true client high-water mark, not at zero
+                sent = 0
+                if "from_token=" in path:
+                    try:
+                        sent = int(path.rsplit("from_token=", 1)[1]
+                                   .split("&")[0])
+                    except ValueError:
+                        sent = 0
+                self._relay_stream(resp, conn, uid, already_sent=sent)
+                return
+            payload = resp.read()
+            self.send_response(resp.status)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type",
+                                            "application/json"))
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            conn.close()
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    return server
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Replica fleet router: health-gated balancing, WAL "
+                    "live migration, queue-depth autoscaling")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="pool ceiling (default: available world size via "
+                         "the elasticity probe)")
+    ap.add_argument("--journal-root", default=None)
+    ap.add_argument("--probe-interval", type=float, default=1.0)
+    ap.add_argument("--probe-timeout", type=float, default=2.0)
+    ap.add_argument("--quarantine-after", type=int, default=3)
+    ap.add_argument("--migrate-stall", type=float, default=30.0)
+    ap.add_argument("--no-autoscale", action="store_true")
+    ap.add_argument("--queue-high", type=float, default=8.0)
+    ap.add_argument("--queue-low", type=float, default=1.0)
+    ap.add_argument("--occupancy-high", type=float, default=0.95)
+    ap.add_argument("--hysteresis", type=int, default=3)
+    ap.add_argument("--cooldown", type=float, default=10.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="replica command after --, with {port} placeholder"
+                         " (e.g. -- ds_serve --port {port})")
+    args = ap.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no replica command given (after --)")
+    if not any("{port}" in a for a in cmd):
+        ap.error("replica command needs a {port} placeholder")
+    fleet = ReplicaFleet(
+        cmd, replicas=args.replicas, min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas, journal_root=args.journal_root,
+        probe_interval=args.probe_interval, probe_timeout=args.probe_timeout,
+        quarantine_after=args.quarantine_after,
+        migrate_stall_s=args.migrate_stall,
+        autoscale=not args.no_autoscale, queue_high=args.queue_high,
+        queue_low=args.queue_low, occupancy_high=args.occupancy_high,
+        hysteresis=args.hysteresis, cooldown_s=args.cooldown).start()
+    server = create_router_server(fleet, host=args.host, port=args.port)
+    logger.info(f"ds_router: fleet of {args.replicas} "
+                f"({shlex.join(cmd)}) on http://{args.host}:{args.port}")
+
+    # SIGTERM must not strand the replicas: python's default handler
+    # skips the finally below, leaving N orphaned daemons holding ports
+    # and journal dirs.  Route it through KeyboardInterrupt so shutdown
+    # tears the whole fleet down.
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _on_term)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        fleet.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -265,7 +265,8 @@ class ServingScheduler:
                  fused_decode_window: Optional[int] = None,
                  journal: Optional[RequestJournal] = None,
                  instruments: "Union[ServingInstruments, bool, None]" = None,
-                 disagg: Optional[DisaggServing] = None):
+                 disagg: Optional[DisaggServing] = None,
+                 uid_base: Optional[int] = None):
         self._engine = engine
         self._idle_wait = idle_wait
         # disaggregated prefill/decode (disagg.py): ``engine`` is the
@@ -321,7 +322,13 @@ class ServingScheduler:
         self._inbox: List[_Request] = []
         self._waiting: List[_Request] = []
         self._live: List[_Request] = []
-        self._uid_iter = itertools.count(1)
+        # fleet uid namespacing: the router exports DS_SERVE_UID_BASE so
+        # every replica generation mints uids from a disjoint stride —
+        # migrated requests keep their original uids on any peer without
+        # ever colliding with the peer's own mints
+        self._uid_base = uid_base if uid_base is not None else int(
+            os.environ.get("DS_SERVE_UID_BASE", "0") or 0)
+        self._uid_iter = itertools.count(self._uid_base + 1)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self._draining = False
@@ -337,6 +344,13 @@ class ServingScheduler:
         self._queued_n = 0
         self._queued_tokens = 0
         self._degraded = False
+        # live-migration state: export_journal() flips _migrating so
+        # /health answers "migrating" (distinct from a plain drain — the
+        # router and ds_top can tell a handoff-in-progress from a
+        # shutdown) and records how many entries left in the export
+        self._migrating = False
+        self._journal_export_depth = 0
+        self._imported = 0
         self._last_progress = time.monotonic()
         self._watchdog: Optional[threading.Thread] = None
         # resilience event counters (mutations: scheduler thread, except
@@ -377,6 +391,11 @@ class ServingScheduler:
         self._replayed = 0
         self._restart_count = int(
             os.environ.get("DS_SERVE_RESTART_COUNT", "0") or 0)
+        # supervisor-exported budget headroom (how many more crashes the
+        # relaunch loop will absorb) — surfaced through stats//health so
+        # the router can prefer peers with budget left
+        _budget = os.environ.get("DS_SERVE_RESTART_BUDGET_REMAINING", "")
+        self._restart_budget_remaining = int(_budget) if _budget else None
         self._boot_wall = time.time()
         # last-256 completed requests for the metrics aggregates:
         # (t_submit, t_first, t_done, n_tokens, replayed)
@@ -609,7 +628,13 @@ class ServingScheduler:
                "journal_depth": (self._journal.depth
                                  if self._journal is not None else 0),
                "replayed_requests": self._replayed,
+               # live-migration readiness: a handoff in progress (journal
+               # export running / exported) is NOT a plain drain
+               "migrating": self._migrating,
+               "journal_export_depth": self._journal_export_depth,
+               "imported_requests": self._imported,
                "restart_count": self._restart_count,
+               "restart_budget_remaining": self._restart_budget_remaining,
                "last_restart_age_s": (round(time.time() - self._boot_wall, 3)
                                       if self._restart_count else None),
                "completed": len(done)}
@@ -767,51 +792,89 @@ class ServingScheduler:
             return
         if not entries:
             return
+        admitted, finished, _ = self._admit_replayed_entries(entries,
+                                                             live=False)
+        logger.warning(f"[journal] replayed {len(admitted) + len(finished)} "
+                       f"unfinished request(s) ({len(finished)} already "
+                       f"complete)")
+
+    def _req_from_entry(self, e, now_w: float, now_m: float) -> _Request:
+        """Rebuild a scheduler request from a journal entry: original uid,
+        emitted tokens as prefix feed, key burns for the sampler
+        fast-forward, wall deadlines converted back to monotonic."""
+        p = e.params
+        req = _Request(
+            uid=e.uid, prompt=[int(t) for t in e.prompt],
+            max_new_tokens=int(p.get("max_new_tokens", 32)),
+            temperature=float(p.get("temperature", 0.0)),
+            top_k=int(p.get("top_k", 0)),
+            top_p=float(p.get("top_p", 1.0)),
+            eos_token_id=p.get("eos_token_id"),
+            seed=int(p.get("seed", 0)),
+            stop=[[int(t) for t in s] for s in p.get("stop") or []],
+            min_new_tokens=int(p.get("min_new_tokens", 0)),
+            repetition_penalty=float(p.get("repetition_penalty", 1.0)),
+            speculative=p.get("speculative"),
+            num_draft_tokens=int(p.get("num_draft_tokens", 4)),
+            draft_ngram=int(p.get("draft_ngram", 2)),
+            return_logprobs=bool(p.get("return_logprobs")))
+        req.outputs = [int(t) for t in e.tokens]
+        req.logprobs = list(e.logprobs)
+        req.key_burns = int(e.key_burns)
+        req.journaled_n = len(req.outputs)
+        req.journaled_burns = req.key_burns
+        req.replayed = True
+        req.stream = bool(p.get("stream"))
+        req.wake = self._wake
+        req.t_submit = now_m
+        if req.outputs:
+            req.t_first = now_m
+        req.rng = np.random.default_rng(req.seed)
+        self._burn_host_rng(req)
+        if (req.stream and self._res.enabled
+                and self._res.max_stream_backlog > 0):
+            req.stream_q = queue.Queue(
+                maxsize=int(self._res.max_stream_backlog))
+        if e.deadline_wall is not None:
+            req.t_deadline = now_m + (e.deadline_wall - now_w)
+        if e.queue_deadline_wall is not None:
+            req.t_queue_deadline = now_m + (e.queue_deadline_wall - now_w)
+        return req
+
+    def _admit_replayed_entries(self, entries, live: bool):
+        """Re-admit journal entries into the scheduler. ``live=False`` is
+        the boot-time replay (the loop has not started; entries land in
+        ``_waiting`` and the uid iterator bumps past them). ``live=True``
+        is a cross-replica import on a RUNNING scheduler: entries land in
+        the inbox (the loop's own transfer point), are re-journaled into
+        THIS replica's WAL so a later crash here still preserves them, and
+        uids already owned by this scheduler are refused (split brain —
+        two replicas must never serve one stream). Returns
+        ``(admitted_uids, finished_uids, refused_uids)``."""
         now_w, now_m = time.time(), time.monotonic()
         max_uid = 0
-        finish_now = []
+        finish_now: List[_Request] = []
+        admitted: List[int] = []
+        refused: List[int] = []
+        split_brain = (get_fault_injector().fire("router.split_brain_uid")
+                       if live else None)
         with self._lock:
             for e in entries:
-                p = e.params
+                if live:
+                    if self._stopping or self._draining:
+                        refused.append(e.uid)
+                        continue
+                    collide = (split_brain is not None
+                               and int(split_brain.get("uid", e.uid))
+                               == e.uid)
+                    if e.uid in self._requests or collide:
+                        logger.warning(
+                            f"[journal] import refused uid {e.uid}: already "
+                            f"owned by this replica (split brain)")
+                        refused.append(e.uid)
+                        continue
                 max_uid = max(max_uid, e.uid)
-                req = _Request(
-                    uid=e.uid, prompt=[int(t) for t in e.prompt],
-                    max_new_tokens=int(p.get("max_new_tokens", 32)),
-                    temperature=float(p.get("temperature", 0.0)),
-                    top_k=int(p.get("top_k", 0)),
-                    top_p=float(p.get("top_p", 1.0)),
-                    eos_token_id=p.get("eos_token_id"),
-                    seed=int(p.get("seed", 0)),
-                    stop=[[int(t) for t in s] for s in p.get("stop") or []],
-                    min_new_tokens=int(p.get("min_new_tokens", 0)),
-                    repetition_penalty=float(
-                        p.get("repetition_penalty", 1.0)),
-                    speculative=p.get("speculative"),
-                    num_draft_tokens=int(p.get("num_draft_tokens", 4)),
-                    draft_ngram=int(p.get("draft_ngram", 2)),
-                    return_logprobs=bool(p.get("return_logprobs")))
-                req.outputs = [int(t) for t in e.tokens]
-                req.logprobs = list(e.logprobs)
-                req.key_burns = int(e.key_burns)
-                req.journaled_n = len(req.outputs)
-                req.journaled_burns = req.key_burns
-                req.replayed = True
-                req.stream = bool(p.get("stream"))
-                req.wake = self._wake
-                req.t_submit = now_m
-                if req.outputs:
-                    req.t_first = now_m
-                req.rng = np.random.default_rng(req.seed)
-                self._burn_host_rng(req)
-                if (req.stream and self._res.enabled
-                        and self._res.max_stream_backlog > 0):
-                    req.stream_q = queue.Queue(
-                        maxsize=int(self._res.max_stream_backlog))
-                if e.deadline_wall is not None:
-                    req.t_deadline = now_m + (e.deadline_wall - now_w)
-                if e.queue_deadline_wall is not None:
-                    req.t_queue_deadline = (now_m
-                                            + (e.queue_deadline_wall - now_w))
+                req = self._req_from_entry(e, now_w, now_m)
                 self._requests[req.uid] = req
                 self._active += 1
                 if self._finished_already(req):
@@ -820,18 +883,89 @@ class ServingScheduler:
                     req.queued = True
                     self._queued_n += 1
                     self._queued_tokens += len(req.prompt)
-                    self._waiting.append(req)
+                    if live:
+                        self._inbox.append(req)
+                    else:
+                        self._waiting.append(req)
+                    admitted.append(req.uid)
                 self._replayed += 1
+                if live:
+                    self._imported += 1
                 if self._obs is not None:
                     self._obs.request_replayed(req.uid, req.t_submit,
                                                len(req.outputs))
-        # original uids survive the restart; fresh submissions go above them
-        nxt = next(self._uid_iter)
-        self._uid_iter = itertools.count(max(nxt, max_uid + 1))
+            if live and self._journal is not None:
+                # the importer's own WAL must cover adopted requests from
+                # this instant: admit + folded progress, inside the lock so
+                # no finish can precede its admit (same ordering as submit)
+                for e in entries:
+                    if e.uid in refused:
+                        continue
+                    try:
+                        self._journal.record_admit(
+                            e.uid, e.prompt, e.params,
+                            deadline_wall=e.deadline_wall,
+                            queue_deadline_wall=e.queue_deadline_wall)
+                        if e.tokens or e.key_burns:
+                            self._journal.record_progress(
+                                e.uid, e.tokens, len(e.tokens), e.key_burns,
+                                logprobs=e.logprobs or None)
+                    except OSError as err:
+                        logger.warning(f"[journal] import record failed "
+                                       f"for request {e.uid}: {err}")
+        if not live:
+            # original uids survive the restart; fresh mints go above them.
+            # Imports do NOT bump: a migrated uid lives in its source
+            # replica's stride (DS_SERVE_UID_BASE namespacing) and must
+            # not drag this replica's iterator into a foreign namespace.
+            nxt = next(self._uid_iter)
+            self._uid_iter = itertools.count(max(nxt, max_uid + 1))
         for req in finish_now:  # _finish takes the lock itself
             self._finish(req, flush=False)
-        logger.warning(f"[journal] replayed {len(entries)} unfinished "
-                       f"request(s) ({len(finish_now)} already complete)")
+        if live and (admitted or finish_now):
+            self._wake.set()
+        return admitted, [r.uid for r in finish_now], refused
+
+    # ---- cross-replica live migration (router surface) ----
+
+    def export_journal(self, drain: bool = True) -> bytes:
+        """Drain this replica's unfinished journal entries as a portable
+        CRC-frame stream (``GET /journal/export``): flips readiness to
+        ``migrating``, stops the scheduler WITHOUT retiring journal
+        entries (the ``handoff()`` path), and snapshots the unfinished
+        state. A peer POSTs the bytes to ``/journal/import`` and replays
+        every stream mid-flight, byte-identically."""
+        if self._journal is None:
+            raise RuntimeError("journal export needs durable serving "
+                               "(durable_serving.enabled)")
+        self._migrating = True
+        with self._lock:
+            self._journal_export_depth = self._journal.depth
+        if drain and self._thread is not None:
+            self.handoff()
+        frames, depth = self._journal.export_frames()
+        with self._lock:
+            self._journal_export_depth = depth
+        return frames
+
+    def import_journal_frames(self, buf: bytes) -> dict:
+        """Adopt a peer's exported journal frames mid-run
+        (``POST /journal/import``): scan with the recovery scanner
+        (damaged records quarantine individually), re-admit the unfinished
+        requests with their ORIGINAL uids, and continue each stream
+        byte-identically — emitted tokens replay as prefix feed and the
+        PRNG chains fast-forward by their recorded burn counts."""
+        from .journal import entries_from_frames
+        entries, bad = entries_from_frames(buf)
+        admitted, finished, refused = self._admit_replayed_entries(
+            entries, live=True)
+        if admitted or finished:
+            logger.warning(
+                f"[journal] imported {len(admitted) + len(finished)} "
+                f"migrated request(s) ({len(finished)} already complete, "
+                f"{len(refused)} refused, {bad} quarantined)")
+        return {"imported": len(admitted), "finished": len(finished),
+                "refused_uids": refused, "quarantined_records": bad}
 
     def _finished_already(self, req: _Request) -> bool:
         if not req.outputs:
@@ -2230,7 +2364,12 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 # answer 503 so load balancers stop routing here, while
                 # the payload still carries the full stats for operators
                 stats = scheduler.stats
-                if stats["stopped"]:
+                if stats["migrating"]:
+                    # checked before "stopped": an export stops the loop,
+                    # but the router must see a handoff in progress (with
+                    # journal_export_depth), not a plain shutdown
+                    status = "migrating"
+                elif stats["stopped"]:
                     status = "stopped"
                 elif stats["draining"]:
                     status = "draining"
@@ -2265,6 +2404,22 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     self._json(400, {"error": "bad last"})
                     return
                 self._json(200, obs.tracer.chrome_trace(last))
+            elif self.path == "/journal/export":
+                # migration drain: hand every unfinished journal entry to
+                # the caller (the fleet router) as the WAL's own portable
+                # CRC-frame stream; this replica stops serving first
+                try:
+                    frames = scheduler.export_journal()
+                except RuntimeError as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(frames)))
+                self.send_header("X-DS-Journal-Depth",
+                                 str(scheduler.stats["journal_export_depth"]))
+                self.end_headers()
+                self.wfile.write(frames)
             elif self.path.startswith("/requests/"):
                 self._do_request_get()
             else:
@@ -2379,6 +2534,19 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
             if self.path in ("/debug/profile", "/debug/profile/stop"):
                 self._do_profile()
                 return
+            if self.path == "/journal/import":
+                # migration adopt: the body is a peer's exported frame
+                # stream; unfinished requests re-admit here mid-run with
+                # their original uids and byte-identical continuations
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    result = scheduler.import_journal_frames(
+                        self.rfile.read(n))
+                except RuntimeError as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                self._json(200, {"status": "imported", **result})
+                return
             if self.path not in ("/generate", "/v1/completions",
                                  "/v1/chat/completions"):
                 self._json(404, {"error": "not found"})
@@ -2456,6 +2624,12 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 return
             except (ValueError, SchedulingError) as e:
                 self._json(400, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                # stopped / draining / migrating: this replica no longer
+                # admits — tell the client (or the router) to go elsewhere
+                self._json(503, {"error": str(e)},
+                           headers=(("Retry-After", "1"), ))
                 return
             if body.get("stream"):
                 self.send_response(200)

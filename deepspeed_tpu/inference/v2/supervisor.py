@@ -14,9 +14,19 @@ What the supervisor exports to each child generation:
 
 * ``DS_SERVE_RESTART_COUNT`` — how many relaunches preceded this one;
   surfaces in ``/health`` / ``stats()`` as ``restart_count``.
+* ``DS_SERVE_RESTART_BUDGET_REMAINING`` — restarts left in the budget;
+  surfaces in ``/health`` as ``restart_budget_remaining`` so the fleet
+  router can prefer replicas with headroom.
 * the caller's env otherwise verbatim, so ``DS_TPU_JOURNAL_DIR`` (and
   everything else) flows through — successive generations share one
   journal directory by construction.
+
+The restart budget *heals*: after ``budget_reset_after_s`` of healthy
+child uptime the restart counter returns to zero. Without this, a
+long-lived daemon spends its lifetime budget on unrelated crashes days
+apart and the Nth transient fault becomes terminal. Relaunch backoff is
+full-jittered (``utils/retry.backoff_delay``) so a rack of supervisors
+recovering from one power event doesn't relaunch in lockstep.
 
 Readiness is gated on the daemon's own ``/health`` endpoint: after each
 launch the supervisor polls ``health_url`` until HTTP 200 (a 503 means
@@ -26,6 +36,7 @@ from the same budget as a mid-flight crash.
 """
 
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -36,6 +47,7 @@ from typing import List, Optional, Sequence
 
 from ...observability import get_registry, get_tracer
 from ...utils.logging import logger
+from ...utils.retry import backoff_delay
 
 # Restart accounting (process registry, resolved at import). The restart
 # histogram measures death-detected → child-ready (or ready-timeout) — the
@@ -87,7 +99,10 @@ class ServingSupervisor:
                  health_url: Optional[str] = None,
                  ready_timeout_s: float = 120.0,
                  grace_s: float = 30.0,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 budget_reset_after_s: float = 600.0,
+                 backoff_jitter: str = "full",
+                 jitter_seed: Optional[int] = None):
         self.cmd = list(cmd)
         self.max_restarts = int(max_restarts)
         self.monitor_interval = float(monitor_interval)
@@ -97,14 +112,23 @@ class ServingSupervisor:
         self.ready_timeout_s = float(ready_timeout_s)
         self.grace_s = float(grace_s)
         self.base_env = dict(env if env is not None else os.environ)
+        self.budget_reset_after_s = float(budget_reset_after_s)
+        self.backoff_jitter = backoff_jitter
+        self._rng = (random.Random(jitter_seed)
+                     if jitter_seed is not None else None)
         self.restarts = 0
         self.history: List[dict] = []
+
+    @property
+    def budget_remaining(self) -> int:
+        return max(0, self.max_restarts - self.restarts)
 
     # ------------------------------------------------------------------
 
     def _launch(self) -> subprocess.Popen:
         env = dict(self.base_env)
         env["DS_SERVE_RESTART_COUNT"] = str(self.restarts)
+        env["DS_SERVE_RESTART_BUDGET_REMAINING"] = str(self.budget_remaining)
         self.history.append({"restart": self.restarts, "t": time.time()})
         logger.info(f"ServingSupervisor: launching daemon "
                     f"(restart {self.restarts}/{self.max_restarts})")
@@ -140,6 +164,7 @@ class ServingSupervisor:
 
     def run(self) -> int:
         proc = self._launch()
+        t_launched = time.monotonic()
         self._await_ready(proc)
         try:
             while True:
@@ -151,6 +176,16 @@ class ServingSupervisor:
                     logger.info("ServingSupervisor: clean exit")
                     return 0
                 t_down = time.monotonic()
+                uptime = t_down - t_launched
+                if (self.restarts > 0 and self.budget_reset_after_s > 0
+                        and uptime >= self.budget_reset_after_s):
+                    # a healthy-uptime window proves the last restart
+                    # worked — forget old crashes so the budget measures
+                    # crash *loops*, not lifetime totals
+                    logger.info(
+                        f"ServingSupervisor: {uptime:.0f}s healthy uptime "
+                        f"— restart budget reset ({self.restarts} forgiven)")
+                    self.restarts = 0
                 self.restarts += 1
                 _restarts_total.inc()
                 if self.restarts > self.max_restarts:
@@ -158,14 +193,18 @@ class ServingSupervisor:
                         f"ServingSupervisor: restart budget exhausted "
                         f"({self.max_restarts}); last rc={rc}")
                     return rc
-                backoff = min(self.max_backoff,
-                              self.restart_backoff * (2 ** (self.restarts - 1)))
+                backoff = backoff_delay(self.restarts - 1,
+                                        base_delay=self.restart_backoff,
+                                        max_delay=self.max_backoff,
+                                        jitter=self.backoff_jitter,
+                                        rng=self._rng)
                 logger.warning(
                     f"ServingSupervisor: daemon died rc={rc} — warm restart "
                     f"{self.restarts}/{self.max_restarts} in {backoff:.2f}s")
                 if backoff > 0:
                     time.sleep(backoff)
                 proc = self._launch()
+                t_launched = time.monotonic()
                 self._await_ready(proc)
                 t_up = time.monotonic()
                 _restart_seconds.record(t_up - t_down)
@@ -191,6 +230,12 @@ def main(argv=None):
     ap.add_argument("--ready-timeout", type=float, default=120.0)
     ap.add_argument("--grace", type=float, default=30.0,
                     help="seconds between SIGTERM and SIGKILL on teardown")
+    ap.add_argument("--budget-reset-after", type=float, default=600.0,
+                    help="healthy-uptime seconds after which the restart "
+                         "budget resets (0 disables)")
+    ap.add_argument("--backoff-jitter", choices=("none", "full"),
+                    default="full",
+                    help="relaunch backoff jitter policy")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="serving command (after --)")
     args = ap.parse_args(argv)
@@ -206,7 +251,9 @@ def main(argv=None):
         restart_backoff=args.restart_backoff,
         health_url=args.health_url,
         ready_timeout_s=args.ready_timeout,
-        grace_s=args.grace)
+        grace_s=args.grace,
+        budget_reset_after_s=args.budget_reset_after,
+        backoff_jitter=args.backoff_jitter)
     sys.exit(sup.run())
 
 

@@ -44,6 +44,20 @@ Registered sites (the code that hosts them decides the fault's meaning):
 - ``disagg.transfer_stall``   — a prefill→decode KV handoff transfer
   batch wedges (never becomes ready): the disagg watchdog must degrade
   the request to in-group prefill instead of stalling admission.
+- ``router.replica_crash``    — the fleet router SIGKILLs one of its own
+  replicas at probe time: a daemon death the crash-migration path must
+  absorb (journal drained from disk, peer replays mid-stream).
+- ``router.probe_timeout``    — one replica health probe behaves as timed
+  out: consecutive timeouts must quarantine the replica and a later
+  healthy probe must re-admit it.
+- ``router.migrate_stall``    — a journal export/import leg of a live
+  migration wedges past the stall budget: the router must fall back to
+  error-finishing the affected requests with Retry-After instead of
+  hanging the fleet.
+- ``router.split_brain_uid``  — a journal import collides with a uid the
+  target replica already owns (two replicas claiming one request): the
+  import must refuse exactly that entry and the router must surface the
+  conflict instead of double-serving the stream.
 
 Env syntax: ``DS_FAULT_INJECT="site[@nth][*times][;site2...]"`` e.g.
 ``DS_FAULT_INJECT="checkpoint.torn_write@2;train.nan_grads@5*3"``.
@@ -70,6 +84,10 @@ KNOWN_SITES = (
     "journal.torn_write",
     "journal.corrupt_record",
     "disagg.transfer_stall",
+    "router.replica_crash",
+    "router.probe_timeout",
+    "router.migrate_stall",
+    "router.split_brain_uid",
 )
 
 

@@ -1,0 +1,263 @@
+"""Live WAL migration between schedulers: export_journal on the source,
+import_journal_frames on a RUNNING peer, byte-identical continuation.
+
+This is the in-process half of the replica-fleet story (test_router.py
+drives the same path through real subprocesses + the HTTP surface): the
+source drains its journal as portable CRC frames mid-decode, the peer
+re-admits the entries into its live inbox — original uids, token prefixes,
+PRNG fast-forward — and every migrated stream finishes exactly as an
+uninterrupted run would have. Disjoint uid namespaces (``uid_base``
+strides) keep generations collision-free; a colliding uid is refused
+(split brain), as is any uid named by the ``router.split_brain_uid``
+fault site. The autouse ``_hermetic_journal_dir`` fixture (conftest)
+gives every test its own journal directory.
+"""
+
+import http.client
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine
+from deepspeed_tpu.inference.v2.server import (ServingScheduler,
+                                               create_http_server)
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+pytestmark = pytest.mark.faults
+
+BS = 16
+
+
+def _engine(num_blocks=96, durable=True, **durable_kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=5)
+    eng_cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=num_blocks,
+        durable_serving={"enabled": durable, **durable_kw})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=eng_cfg)
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _wait_tokens(handles, k, timeout=120):
+    """Block until every handle has decoded at least ``k`` tokens — the
+    export must land MID-decode or the scenario is vacuous."""
+    t0 = time.monotonic()
+    while not all(len(h._req.outputs) >= k for h in handles):
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("requests never reached the export point")
+        time.sleep(0.01)
+
+
+def _reference(submits, window=1):
+    sched = ServingScheduler(_engine(durable=False), idle_wait=0.005,
+                             fused_decode_window=window).start()
+    try:
+        hs = [sched.submit(**kw) for kw in submits]
+        return [h.result(timeout=300) for h in hs]
+    finally:
+        sched.stop()
+
+
+def _migrate(submits, tmp_path, window=1, mid_tokens=3):
+    """Submit on scheduler A (uid stride 1M), export its journal while the
+    streams are mid-decode, import into a RUNNING scheduler B (stride 2M),
+    and return (pre_export_outputs, migrated_outputs, import_result,
+    a_stats, b_stats)."""
+    a = ServingScheduler(_engine(), idle_wait=0.005, uid_base=1_000_000,
+                         fused_decode_window=window).start()
+    hs = [a.submit(**kw) for kw in submits]
+    _wait_tokens(hs, mid_tokens)
+    buf = a.export_journal()
+    pre = [list(h._req.outputs) for h in hs]
+    assert not all(len(p) >= kw["max_new_tokens"]
+                   for p, kw in zip(pre, submits)), \
+        "everything finished before the export — scenario is vacuous"
+    a_stats = a.stats
+    b = ServingScheduler(_engine(journal_dir=str(tmp_path / "peer")),
+                         idle_wait=0.005, uid_base=2_000_000,
+                         fused_decode_window=window).start()
+    try:
+        res = b.import_journal_frames(buf)
+        outs = [b.lookup(h.uid).result(timeout=300) for h in hs]
+        b_stats = b.stats
+    finally:
+        b.stop()
+    return pre, outs, res, a_stats, b_stats
+
+
+def test_live_migration_greedy_byte_exact(tmp_path):
+    """Greedy streams drain to a running peer and finish byte-identically:
+    the delivered prefix survives verbatim and the continuation matches an
+    uninterrupted run token-for-token."""
+    ps = _prompts(3, seed=0)
+    submits = [dict(prompt=p, max_new_tokens=12) for p in ps]
+    ref = _reference(submits)
+    pre, outs, res, a_stats, b_stats = _migrate(submits, tmp_path)
+    assert outs == ref
+    assert all(o[:len(p)] == p for o, p in zip(outs, pre))
+    assert res["imported"] == 3 and not res["refused_uids"]
+    assert res["quarantined_records"] == 0
+    assert a_stats["migrating"] and a_stats["journal_export_depth"] == 3
+    assert b_stats["imported_requests"] == 3
+
+
+def test_live_migration_sampled_byte_exact(tmp_path):
+    """Seeded sampled decode survives migration bit-exactly: the peer
+    fast-forwards each request's PRNG by the journaled key_burns, so the
+    continuation draws the same samples the source would have."""
+    ps = _prompts(2, seed=21)
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=14, temperature=0.7, top_k=16,
+             seed=3),
+        dict(prompt=ps[1], max_new_tokens=14, temperature=1.0, top_p=0.85,
+             seed=9),
+    ]
+    ref = _reference(submits)
+    pre, outs, _, _, _ = _migrate(submits, tmp_path)
+    assert outs == ref
+    assert all(o[:len(p)] == p for o, p in zip(outs, pre))
+
+
+def test_live_migration_speculative_byte_exact(tmp_path):
+    """Prompt-lookup speculative decode migrates byte-exactly: accepted
+    draft runs are journaled as plain progress, so the peer resumes from
+    the same token stream regardless of where a draft window was cut."""
+    ps = _prompts(2, lo=12, seed=33)
+    submits = [
+        dict(prompt=ps[0], max_new_tokens=12, temperature=0.8, top_k=24,
+             seed=5, speculative="prompt_lookup", num_draft_tokens=3,
+             draft_ngram=2),
+        dict(prompt=ps[1], max_new_tokens=12, speculative="prompt_lookup",
+             num_draft_tokens=3, draft_ngram=2),
+    ]
+    ref = _reference(submits)
+    pre, outs, _, _, _ = _migrate(submits, tmp_path)
+    assert outs == ref
+    assert all(o[:len(p)] == p for o, p in zip(outs, pre))
+
+
+def test_import_refuses_colliding_uid(tmp_path):
+    """Split brain: a peer that already owns a uid must refuse the import
+    of that uid — double-serving one request id would emit two streams
+    under one name. The peer's own request is untouched."""
+    a = ServingScheduler(_engine(), idle_wait=0.005).start()
+    ha = a.submit(_prompts(1, seed=4)[0], max_new_tokens=16)
+    _wait_tokens([ha], 2)
+    buf = a.export_journal()
+    # same uid namespace (uid_base=0 on both): b's first submit takes uid 1
+    b = ServingScheduler(_engine(journal_dir=str(tmp_path / "peer")),
+                         idle_wait=0.005).start()
+    try:
+        hb = b.submit(_prompts(1, seed=5)[0], max_new_tokens=6)
+        assert hb.uid == ha.uid == 1
+        res = b.import_journal_frames(buf)
+        assert res["imported"] == 0
+        assert res["refused_uids"] == [1]
+        assert hb.result(timeout=300)  # b's own request still finishes
+        assert b.stats["imported_requests"] == 0
+    finally:
+        b.stop()
+
+
+def test_split_brain_fault_site_refuses_named_uid(tmp_path):
+    """``router.split_brain_uid`` forces the refusal arm without a real
+    collision: the named uid bounces, the rest import normally."""
+    ps = _prompts(2, seed=7)
+    submits = [dict(prompt=p, max_new_tokens=12) for p in ps]
+    a = ServingScheduler(_engine(), idle_wait=0.005,
+                         uid_base=1_000_000).start()
+    hs = [a.submit(**kw) for kw in submits]
+    _wait_tokens(hs, 2)
+    buf = a.export_journal()
+    get_fault_injector().configure({"faults": [{
+        "site": "router.split_brain_uid", "nth": 1, "times": 99,
+        "args": {"uid": 1_000_001}}]})
+    b = ServingScheduler(_engine(journal_dir=str(tmp_path / "peer")),
+                         idle_wait=0.005, uid_base=2_000_000).start()
+    try:
+        res = b.import_journal_frames(buf)
+        assert res["refused_uids"] == [1_000_001]
+        assert res["imported"] == 1
+        assert b.lookup(1_000_002).result(timeout=300)
+        assert any(f.startswith("router.split_brain_uid")
+                   for f in get_fault_injector().fired)
+    finally:
+        get_fault_injector().reset()
+        b.stop()
+
+
+def test_http_export_import_and_migrating_health(tmp_path):
+    """The HTTP surface of the migration path: ``GET /journal/export``
+    streams the WAL frames (depth in ``X-DS-Journal-Depth``), the source's
+    /health flips to 503 ``migrating`` (distinct from draining) and stops
+    admitting, and ``POST /journal/import`` re-admits on the peer — whose
+    stream then finishes byte-identically through plain request polling."""
+    submits = [dict(prompt=_prompts(1, seed=11)[0], max_new_tokens=12)]
+    ref = _reference(submits)
+
+    a = ServingScheduler(_engine(), idle_wait=0.005,
+                         uid_base=1_000_000).start()
+    httpd_a = create_http_server(a, port=0)
+    port_a = httpd_a.server_address[1]
+    import threading
+    threading.Thread(target=httpd_a.serve_forever, daemon=True).start()
+    hs = [a.submit(**kw) for kw in submits]
+    _wait_tokens(hs, 2)
+
+    conn = http.client.HTTPConnection("127.0.0.1", port_a, timeout=60)
+    conn.request("GET", "/journal/export")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "application/octet-stream"
+    assert int(resp.getheader("X-DS-Journal-Depth")) == 1
+    frames = resp.read()
+    conn.close()
+
+    # exporting flips the source to migrating: 503 on /health, no admits
+    conn = http.client.HTTPConnection("127.0.0.1", port_a, timeout=60)
+    conn.request("GET", "/health")
+    resp = conn.getresponse()
+    health = json.loads(resp.read())
+    assert resp.status == 503
+    assert health["status"] == "migrating"
+    assert health["journal_export_depth"] == 1
+    conn.close()
+    conn = http.client.HTTPConnection("127.0.0.1", port_a, timeout=60)
+    conn.request("POST", "/generate",
+                 json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 2}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 503
+    conn.close()
+    httpd_a.shutdown()
+
+    b = ServingScheduler(_engine(journal_dir=str(tmp_path / "peer")),
+                         idle_wait=0.005, uid_base=2_000_000).start()
+    httpd_b = create_http_server(b, port=0)
+    port_b = httpd_b.server_address[1]
+    threading.Thread(target=httpd_b.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port_b, timeout=60)
+        conn.request("POST", "/journal/import", frames,
+                     {"Content-Type": "application/octet-stream"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200
+        assert out["status"] == "imported" and out["imported"] == 1
+        conn.close()
+        assert b.lookup(hs[0].uid).result(timeout=300) == ref[0]
+    finally:
+        httpd_b.shutdown()
+        b.stop()

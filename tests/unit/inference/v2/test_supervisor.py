@@ -119,6 +119,70 @@ def test_teardown_sends_sigterm_then_kills(tmp_path):
     assert 0.1 < time.monotonic() - t0 < 10
 
 
+def test_budget_resets_after_healthy_uptime(tmp_path):
+    """A long-lived daemon must not spend its lifetime budget on unrelated
+    crashes far apart: after budget_reset_after_s of healthy uptime the
+    restart counter forgets old crashes. Here every generation outlives
+    the reset window, so 5 sequential crashes survive a budget of 2 —
+    without the reset the run would die at the 3rd launch."""
+    child = tmp_path / "child.py"
+    log = tmp_path / "gens.log"
+    # each generation: log (restart_count, budget_remaining), stay up past
+    # the reset window, then crash — until 5 generations have run
+    child.write_text(textwrap.dedent("""
+        import os, sys, time
+        path = sys.argv[1]
+        with open(path, "a") as f:
+            f.write(os.environ["DS_SERVE_RESTART_COUNT"] + " "
+                    + os.environ["DS_SERVE_RESTART_BUDGET_REMAINING"] + "\\n")
+        n = len(open(path).read().splitlines())
+        time.sleep(0.25)
+        sys.exit(0 if n >= 5 else 7)
+    """))
+    sup = ServingSupervisor(
+        [sys.executable, str(child), str(log)],
+        max_restarts=2, monitor_interval=0.02, restart_backoff=0.01,
+        budget_reset_after_s=0.1, backoff_jitter="none",
+        env={**os.environ, "PYTHONPATH": ""})
+    assert sup.run() == 0
+    lines = [tuple(map(int, ln.split())) for ln in
+             log.read_text().splitlines()]
+    assert len(lines) == 5
+    # every relaunch happened with a reset budget: restart_count 1, one
+    # restart left of the 2 — never the exhaustion staircase
+    assert lines[0] == (0, 2)
+    assert all(ln == (1, 1) for ln in lines[1:])
+
+
+def test_budget_still_exhausts_on_crash_loop(tmp_path):
+    """The reset must NOT forgive a tight crash loop: generations dying
+    inside the healthy-uptime window consume the budget as before."""
+    rc, gens, sup = _run(tmp_path, fail_until=99, max_restarts=2)
+    assert rc == 7
+    assert gens == [0, 1, 2]
+    assert sup.restarts == 3
+    assert sup.budget_remaining == 0
+
+
+def test_relaunch_backoff_full_jitter_is_seeded(tmp_path):
+    """With jitter_seed set, two identically-configured supervisors pick
+    the identical (bounded) jittered relaunch delays."""
+    import random
+
+    from deepspeed_tpu.utils.retry import backoff_delay
+
+    a = ServingSupervisor(["true"], restart_backoff=0.2, max_backoff=1.0,
+                          jitter_seed=3)
+    b = ServingSupervisor(["true"], restart_backoff=0.2, max_backoff=1.0,
+                          jitter_seed=3)
+    da = [backoff_delay(i, 0.2, 1.0, jitter=a.backoff_jitter, rng=a._rng)
+          for i in range(5)]
+    db = [backoff_delay(i, 0.2, 1.0, jitter=b.backoff_jitter, rng=b._rng)
+          for i in range(5)]
+    assert da == db
+    assert all(0.0 <= d <= min(1.0, 0.2 * 2 ** i) for i, d in enumerate(da))
+
+
 # ---------------------------------------------------------------------------
 # full-stack acceptance: SIGKILL a real daemon process mid-decode
 # ---------------------------------------------------------------------------
