@@ -213,6 +213,14 @@ run bench_serving_disagg 1500 env DS_BENCH_DISAGG=1 DS_BENCH_FAST=1 python bench
 # drain, re-admit, re-attach), and two replicas must not fight for the
 # chip the parent already holds.
 run bench_serving_fleet 1200 env DS_BENCH_FLEET=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FLEET.json
+# 15m. radix prefix cache + multi-tenant scheduling: two tenants (3:1
+# weights), each with a shared system-prompt template, submit
+# template+tail requests through the scheduler with the radix cache OFF
+# vs ON — TTFT p50 ratio is the headline (cached adoption + COW tail
+# fork skip the template's prefill), with the Prometheus saved-token
+# counter cross-checked EXACTLY against the radix tree's own ledger;
+# journaled to BENCH_HISTORY.jsonl and gated by bin/ds_benchdiff
+run bench_serving_prefix 1500 env DS_BENCH_PREFIX=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_PREFIX.json
 # 15. multi-step dispatch: K optimizer steps per program. If tok/s rises
 # vs bench_fast, the single-step number was relay-dispatch-bound and the
 # TRUE chip MFU is the K-step figure (compiles the same scanned body)

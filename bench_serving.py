@@ -943,47 +943,140 @@ def _measure_arrivals(cfg, kv_block, backend, n_requests, ctx, new_tokens,
 
 
 def _measure_prefix_caching(cfg, ctx, kv_block, backend):
-    """Prefill a shared prefix once, then time N requests reusing it vs a
-    cold engine computing it every time."""
-    import jax
+    """Shared-system-prompt serving A/B: two tenants (weights 3:1), each
+    with its own system-prompt template, submit requests whose prompts are
+    ``template + unique tail`` against the running ServingScheduler — radix
+    cache off vs on. The cached arm's first request per template pays the
+    full prefill and seeds the tree; every later one adopts the shared
+    blocks (COW-forking the partial tail block), so its TTFT is the tail's
+    prefill, not the template's. The headline is the TTFT p50 ratio
+    (uncached / cached — higher is better), journaled to
+    BENCH_HISTORY.jsonl for bin/ds_benchdiff; the row also cross-checks
+    the Prometheus saved-token counter against the radix tree's own
+    accounting (they must agree EXACTLY — the counter is fed from the same
+    adoption events)."""
+    import threading
     import numpy as np
-    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+    from deepspeed_tpu.inference.v2 import (ServingScheduler,
+                                            build_llama_engine,
                                             RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import TenantConfig
+    from deepspeed_tpu.inference.v2 import engine_v2 as _ev2
     rng = np.random.default_rng(7)
-    shared = rng.integers(0, cfg.vocab_size, size=ctx).tolist()
-    tails = [rng.integers(0, cfg.vocab_size, size=16).tolist()
-             for _ in range(4)]
+    tenants = [("chat", 3.0), ("batch", 1.0)]
+    templates = {name: rng.integers(0, cfg.vocab_size, size=ctx).tolist()
+                 for name, _ in tenants}
+    per_template = 4
+    tail_len = 16
+    jobs = []  # (tenant, prompt) arrival mix: tenants interleaved
+    for i in range(per_template):
+        for name, _ in tenants:
+            tail = rng.integers(0, cfg.vocab_size, size=tail_len).tolist()
+            jobs.append((name, templates[name] + tail))
     rows = []
+    ttft_p50 = {}
     for cached in (False, True):
         eng = build_llama_engine(
             cfg, engine_config=RaggedInferenceEngineConfig(
                 enable_prefix_caching=cached,
-                num_kv_blocks=8 * ((ctx + 256) // kv_block + 2)),
+                tenants={name: TenantConfig(weight=w)
+                         for name, w in tenants},
+                num_kv_blocks=2 * len(jobs) * ((ctx + 256) // kv_block + 2)),
             kv_block_size=kv_block)
         eng.model().attn_backend = backend
-        # warm compiles + (cached mode) populate the prefix cache; a second
-        # warm request compiles the short-suffix bucket the cached path
-        # actually runs (timing must not include either compile)
-        out = eng.put([999], [shared + tails[0]])
-        jax.block_until_ready(out)
-        eng.flush(999)
-        out = eng.put([998], [shared + tails[0]])
-        jax.block_until_ready(out)
-        eng.flush(998)
-        t0 = time.perf_counter()
-        for i, tail in enumerate(tails):
-            out = eng.put([i], [shared + tail])
-        jax.block_until_ready(out)
-        float(np.asarray(out).ravel()[0])
-        dt = (time.perf_counter() - t0) / len(tails)
-        rows.append({"backend": backend, "context": ctx,
-                     "prefix_cached": cached,
-                     "request_prefill_ms": round(1e3 * dt, 2)})
-        for i in range(len(tails)):
-            eng.flush(i)
-    if rows[1]["request_prefill_ms"] > 0:
-        rows[1]["speedup_vs_cold"] = round(
-            rows[0]["request_prefill_ms"] / rows[1]["request_prefill_ms"], 2)
+        # warm compiles outside the timing: the full-prompt prefill bucket,
+        # the short-suffix bucket the cached path actually runs, and the
+        # ramping decode batch sizes. The warm prompt reuses template[0] so
+        # the cached arm's COW-fork program compiles here too; the cache is
+        # then reset so the measured phase starts cold for BOTH arms.
+        warm = templates[tenants[0][0]]
+        # the second warm prompt shares ONE tail token past the template so
+        # the COW-fork program (fork point p=1) compiles here, not timed
+        eng.generate([warm + [1] * tail_len,
+                      warm + [1] + [2] * (tail_len - 1)],
+                     max_new_tokens=2)
+        bss = [b for b in (1, 2, 4, 8) if b <= len(jobs)]
+        eng.warmup(prefill_lens=(), batch_sizes=bss,
+                   decode_context=ctx + tail_len + 8)
+        if cached:
+            eng._state_manager.reset_prefix_cache()
+
+        def run_pass():
+            sched = ServingScheduler(eng, idle_wait=0.001).start()
+            ttfts = [None] * len(jobs)
+
+            def client(i, name, prompt):
+                t0 = time.perf_counter()
+                h = sched.submit(prompt, max_new_tokens=8, tenant=name,
+                                 stream=True)
+                for _ in h.stream(timeout=600):
+                    ttfts[i] = time.perf_counter() - t0
+                    break
+                h.result(600)
+
+            threads = [threading.Thread(target=client, args=(i, name, p))
+                       for i, (name, p) in enumerate(jobs)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(600)
+            return ttfts, time.perf_counter() - t0, sched
+
+        # discarded burn-off pass: both arms pay every prefill-chunk /
+        # decode-batch compile the workload can reach (adoption changes
+        # the fed-chunk shapes, so the cached arm has extra programs), and
+        # the cached arm enters the timed pass in STEADY STATE — every
+        # template hot, which is the scenario the headline claims
+        _, _, s0 = run_pass()
+        s0.stop()
+        # stats + Prometheus counters are cumulative; diff BOTH over the
+        # timed phase so the exact-accounting check compares the same
+        # event window
+        pre = eng.prefix_cache_report() if cached else {}
+        saved0 = _ev2._prefix_saved_tokens.value
+        ttfts, wall, sched = run_pass()
+        report = eng.prefix_cache_report()
+        stats = sched.stats
+        sched.stop()
+        got = sorted(t for t in ttfts if t is not None)
+        p50 = got[len(got) // 2] if got else None
+        ttft_p50[cached] = p50
+        row = {"backend": backend, "context": ctx, "prefix_cached": cached,
+               "tenants": len(tenants), "templates": len(templates),
+               "requests": len(jobs), "wall_s": round(wall, 2),
+               "ttft_p50_s": round(p50, 4) if p50 is not None else None}
+        if cached:
+            saved = (report.get("saved_prefill_tokens", 0)
+                     - pre.get("saved_prefill_tokens", 0))
+            counter_saved = int(_ev2._prefix_saved_tokens.value - saved0)
+            row.update({
+                "saved_prefill_tokens": saved,
+                "cow_forks": (report.get("cow_forks", 0)
+                              - pre.get("cow_forks", 0)),
+                "hit_rate": report.get("hit_rate"),
+                "p50_match_depth": report.get("p50_match_depth"),
+                # exact-accounting invariant: the Prometheus counter and
+                # the radix tree's own ledger count the same events
+                "saved_tokens_counter_matches":
+                    counter_saved == saved,
+                "tenant_stats": stats.get("tenants")})
+        rows.append(row)
+    if ttft_p50.get(True) and ttft_p50.get(False):
+        ratio = round(ttft_p50[False] / ttft_p50[True], 3)
+        rows[-1]["ttft_p50_speedup_vs_cold"] = ratio
+        from bench import _history_path, _journal_append
+        _journal_append(_history_path(), {
+            "rung": "serving-prefix",
+            "metric": "ttft_p50_uncached_over_cached",
+            # uncached p50 / cached p50 — higher is better; a regression
+            # in radix adoption or COW forking trips ds_benchdiff
+            "value": ratio,
+            "unit": "uncached ttft p50 / cached ttft p50",
+            "saved_prefill_tokens": rows[-1].get("saved_prefill_tokens"),
+            "cow_forks": rows[-1].get("cow_forks"),
+            "accounting_exact": rows[-1].get(
+                "saved_tokens_counter_matches")})
     return rows
 
 

@@ -123,6 +123,24 @@ def debug_report():
     except Exception as e:  # pragma: no cover
         lines.append(f"tp collective wire dtype {'.' * 24} {NO} ({e})")
     try:
+        # radix prefix cache: whether the default engine config would run
+        # with cross-request KV reuse (COW forking), and why not when
+        # disabled — the sliding-window gate lives in the engine, so here
+        # we report the config default + the model-dependent caveat
+        from .inference.v2.config_v2 import RaggedInferenceEngineConfig
+        ecfg = RaggedInferenceEngineConfig()
+        if ecfg.enable_prefix_caching:
+            state = ("enabled (radix + COW fork; disabled at runtime "
+                     "for sliding-window models)")
+        else:
+            state = "disabled (state_manager.enable_prefix_caching)"
+        lines.append(f"prefix cache {'.' * 36} {state}")
+        nt = len(ecfg.tenants)
+        lines.append(f"multi-tenant scheduling {'.' * 25} "
+                     f"{f'{nt} tenant(s) configured' if nt else 'single lane (no tenants block)'}")
+    except Exception as e:  # pragma: no cover
+        lines.append(f"prefix cache {'.' * 36} {NO} ({e})")
+    try:
         # durable serving: where the write-ahead request journal would land
         # (env/XDG resolution) and whether that directory is writable — the
         # first thing to check when warm restart isn't replaying anything

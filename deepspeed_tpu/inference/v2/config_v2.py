@@ -6,9 +6,10 @@ KVCacheConfig). Same knobs, pydantic-validated, TPU notes where semantics
 shift (static shapes → bucketing).
 """
 
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from pydantic import Field, model_validator
+from pydantic_core import PydanticCustomError
 
 from ...config.config_utils import ConfigModel
 
@@ -56,8 +57,14 @@ class DSStateManagerConfig(ConfigModel):
             raise ValueError("max_ragged_sequence_count cannot exceed max_ragged_batch_size")
         if self.offload:
             # reference manager_configs.py:171: "Currently unsupported" —
-            # reject loudly rather than accept-and-ignore
-            raise ValueError("KV-cache offload is not supported")
+            # reject loudly rather than accept-and-ignore. The custom error
+            # type is the machine-readable reason slug: pydantic wraps any
+            # ValueError raised here into a ValidationError, and the slug
+            # (scheduling_utils.error_reason) is what survives the wrap for
+            # the HTTP layer's structured 400 body.
+            raise PydanticCustomError(
+                "kv_offload_unsupported",
+                "KV-cache offload is not supported")
         if self.memory_config_mode == "reserve":
             if not 0.0 < self.memory_config_size <= 1.0:
                 raise ValueError(
@@ -411,6 +418,39 @@ class TensorParallelConfig(ConfigModel):
         return self
 
 
+class TenantConfig(ConfigModel):
+    """One tenant's scheduling contract (beyond the reference — the
+    multi-tenant scenario layer). Tenants are soft-isolated: admission and
+    the prefill budget are divided by WEIGHTED FAIR SHARE (a tenant at
+    weight 3 gets 3× the delivered tokens of a weight-1 tenant under
+    contention), idle share redistributes work-conservingly, and the
+    per-tenant caps shed a noisy tenant before it can starve the wave."""
+
+    weight: float = 1.0
+    """Fair-share weight (> 0): delivered-token ratio under contention."""
+
+    priority: int = 0
+    """Strict admission tier: higher-priority tenants admit first; weights
+    arbitrate WITHIN a tier."""
+
+    max_live_tokens: int = 0
+    """Cap on this tenant's concurrently live tokens (prompt + generated
+    budget of admitted requests); 0 = uncapped. A capped tenant's unused
+    share flows to others (work-conserving)."""
+
+    max_queued: int = 0
+    """Per-tenant admission queue cap (sheds with 429 like the global
+    ``serving_resilience.max_queued``); 0 = only the global cap applies."""
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {self.weight}")
+        if self.max_live_tokens < 0 or self.max_queued < 0:
+            raise ValueError("tenant caps must be >= 0 (0 = uncapped)")
+        return self
+
+
 class RaggedInferenceEngineConfig(ConfigModel):
     """Reference config_v2.py:RaggedInferenceEngineConfig."""
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
@@ -437,3 +477,9 @@ class RaggedInferenceEngineConfig(ConfigModel):
     # Disabled for sliding-window models (their trailing-window release
     # would free shared blocks).
     enable_prefix_caching: bool = False
+
+    # Multi-tenant weighted-fair scheduling: per-tenant contracts keyed by
+    # the ``tenant`` id requests carry. Unknown tenants get the "default"
+    # entry if present, else TenantConfig() (weight 1, no caps) — an empty
+    # dict keeps the scheduler exactly single-tenant.
+    tenants: Dict[str, TenantConfig] = Field(default_factory=dict)

@@ -53,6 +53,13 @@ _prefix_hits = _obs.counter(
 _prefix_adopted_blocks = _obs.counter(
     "ds_prefix_adopted_blocks_total",
     "KV blocks adopted from the prefix cache (prefill skipped)")
+_prefix_saved_tokens = _obs.counter(
+    "ds_prefix_saved_prefill_tokens_total",
+    "Prompt tokens kept out of prefill by prefix adoption + COW forks "
+    "(mirrors PrefixKVCache.stats['saved_tokens'] exactly)")
+_prefix_cow_forks = _obs.counter(
+    "ds_prefix_cow_forks_total",
+    "Mid-block prompt divergences resolved by a copy-on-write block fork")
 
 
 @dataclass
@@ -154,12 +161,14 @@ class InferenceEngineV2:
         self._batch = RaggedBatchWrapper(engine_config.state_manager,
                                          block_size=kv_config.block_size)
         prefix_caching = engine_config.enable_prefix_caching
+        self._prefix_disable_reason = None if prefix_caching else "not_enabled"
         if prefix_caching and getattr(model.config, "sliding_window", None):
             from ...utils.logging import logger
             logger.warning("prefix caching disabled: sliding-window models "
                            "release trailing KV blocks mid-sequence, which "
                            "would free shared prefix blocks")
             prefix_caching = False
+            self._prefix_disable_reason = "sliding_window_model"
         self._state_manager = DSStateManager(engine_config.state_manager, kv_config,
                                              num_blocks=engine_config.num_kv_blocks,
                                              enable_prefix_caching=prefix_caching)
@@ -183,6 +192,19 @@ class InferenceEngineV2:
 
     def model(self) -> RaggedLlamaModel:
         return self._model
+
+    def prefix_cache_report(self) -> dict:
+        """State + effectiveness of the radix prefix cache for /health,
+        env_report and the bench cross-check: ``state`` is enabled/disabled
+        with a machine-readable ``reason`` when disabled (e.g. a
+        sliding-window model makes shared blocks unsafe to retain)."""
+        pc = self._state_manager.prefix_cache
+        if pc is None:
+            return {"state": "disabled",
+                    "reason": self._prefix_disable_reason or "not_enabled"}
+        rep = pc.report()
+        rep["state"] = "enabled"
+        return rep
 
     # ---- serving (reference :107 put) ----
 
@@ -215,20 +237,50 @@ class InferenceEngineV2:
         for i, (uid, tokens) in enumerate(zip(batch_uids, batch_tokens)):
             host_seq_desc = self._state_manager.get_sequence(uid)
             if (pc is not None and adopt_prefix and host_seq_desc is None
-                    and tokens.size > self._state_manager.block_size):
+                    and tokens.size > 1):
                 # NEW sequence: adopt the longest cached full-block prefix —
                 # its KV already exists, so only the suffix is fed/computed.
                 # At least one token must stay fed (logits come from it).
-                matched, chain_key = pc.match_with_key(tokens[:tokens.size - 1])
-                if matched:
+                matched, chain_key, fork = pc.match_fork(tokens[:tokens.size - 1])
+                dst = None
+                if fork is not None:
+                    # mid-block divergence: COW-copy the fork source so the
+                    # shared page stays read-only and this sequence writes
+                    # its tail into a PRIVATE block. The transient pin taken
+                    # by match_fork keeps the source alive even while it is
+                    # an eviction candidate; dropped once the copy is in the
+                    # device stream (later reuse of the source block orders
+                    # after the copy program).
+                    _src_key, src_block, fork_p = fork
+                    try:
+                        dst = self._state_manager.allocate_blocks(1)
+                    except SchedulingError:
+                        pc.release([src_block])  # abort fork: pool exhausted
+                        fork = None
+                    else:
+                        self._model.cow_copy_block(src_block, int(dst[0]))
+                        pc.commit_fork(fork_p)
+                        pc.release([src_block])
+                if matched or fork is not None:
                     _prefix_hits.inc()
                     _prefix_adopted_blocks.inc(len(matched))
                     host_seq_desc = self._state_manager.get_or_create_sequence(uid)
-                    host_seq_desc.extend_kv_cache(matched)
+                    if matched:
+                        host_seq_desc.extend_kv_cache(matched)
                     host_seq_desc.adopted_blocks = set(matched)
                     host_seq_desc.chain_key = chain_key
                     host_seq_desc.chain_blocks = len(matched)
                     skip = len(matched) * self._state_manager.block_size
+                    if fork is not None:
+                        _prefix_cow_forks.inc()
+                        host_seq_desc.extend_kv_cache(dst)  # private COW block
+                        # the forked run must reach the cache when this block
+                        # completes: stage it ahead of the fed suffix so
+                        # _register_pending sees the block's true contents
+                        host_seq_desc.pending_tokens = np.asarray(
+                            tokens[skip:skip + fork_p], np.int32)
+                        skip += fork_p
+                    _prefix_saved_tokens.inc(skip)
                     host_seq_desc.pre_forward(skip)
                     host_seq_desc.post_forward()  # history = cached prefix
                     tokens = tokens[skip:]
@@ -490,6 +542,7 @@ class InferenceEngineV2:
                          window_logits=True, defer_register={uid})
                 seq = self._state_manager.get_sequence(uid)
                 seq.rollback(draft_tokens)
+            self._scrub_pending(uid)
             self.flush(uid)
         for bs in batch_sizes:
             uids = list(range(base + 1, base + 1 + bs))
@@ -526,8 +579,18 @@ class InferenceEngineV2:
                     draft_ngram=spec_draft_ngram,
                     specs=[SampleSpec(temperature=1.0) for _ in uids])
             for u in uids:
+                self._scrub_pending(u)
                 self.flush(u)
         return len(self._model._fwd_cache)
+
+    def _scrub_pending(self, uid) -> None:
+        """Drop a scratch sequence's staged registration tail: warmup
+        sequences feed zeros, and letting flush register that tail would
+        seed the radix cache with entries real zero-prefixed traffic could
+        adopt (warmup must stay invisible to the cache)."""
+        seq = self._state_manager.get_sequence(uid)
+        if seq is not None:
+            seq.pending_tokens = np.zeros(0, np.int32)
 
     # ---- convenience decode loop (the MII surface over FastGen) ----
 

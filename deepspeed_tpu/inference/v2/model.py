@@ -634,6 +634,37 @@ class RaggedLlamaModel:
         self._bump_wire_counters(batch.tokens.shape[0])
         return logits
 
+    def cow_copy_block(self, src_block: int, dst_block: int) -> None:
+        """Copy one KV block's slots ``src_block`` -> ``dst_block`` inside
+        the paged pool: the prefix cache's copy-on-write fork. One jitted
+        dynamic gather/scatter along the flat slot axis (the PR-15
+        handoff-landing idiom), cache donated so the pool is updated in
+        place; block indices are traced operands so every fork reuses the
+        same compiled program. Copying the WHOLE block is safe even when
+        only the first ``p`` slots are shared: causal attention means those
+        slots are bit-identical to what the forking sequence would compute,
+        and the stale tail slots are overwritten by the fork's own prefill
+        before ``seen_tokens`` ever lets a read touch them."""
+        kv = self._state_manager.kv_cache
+        fn = self._fwd_cache.get("cow_copy")
+        if fn is None:
+            def _cow(cache, src, dst, *, block_size):
+                def _one(arr):
+                    blk = jax.lax.dynamic_slice_in_dim(
+                        arr, src * block_size, block_size, axis=1)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        arr, blk, dst * block_size, axis=1)
+                return jax.tree_util.tree_map(_one, cache)
+
+            kw = ({"out_shardings": jax.tree_util.tree_map(
+                       lambda a: a.sharding, kv.cache)}
+                  if self._mesh_ctx is not None else {})
+            fn = jax.jit(partial(_cow, block_size=self.kv_block_size),
+                         donate_argnums=(0, ), **kw)
+            fn = _serving_compile_watch().wrap(fn, "cow_copy_block")
+            self._fwd_cache["cow_copy"] = fn
+        kv.update(fn(kv.cache, jnp.int32(src_block), jnp.int32(dst_block)))
+
     def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int,
                      sampling: Optional[dict] = None, fetch: bool = True):
         """``n_steps`` decode steps in ONE XLA program (lax.scan over the

@@ -1,10 +1,23 @@
-"""Automatic prefix caching: content-addressed KV block reuse.
+"""Radix prefix cache: content-addressed KV block reuse with COW forking.
 
-Beyond the reference's FastGen (vLLM-class feature): FULL prompt blocks are
-keyed by the exact chain of their token contents; a later prompt sharing a
-block-aligned prefix ADOPTS the cached blocks read-only — prefill compute
-and KV writes are skipped for the matched region, and the engine feeds only
-the uncached suffix.
+Beyond the reference's FastGen (vLLM/SGLang-class feature): prompt blocks are
+keyed by the exact chain of their token contents, forming a radix tree whose
+edges are token RUNS, not just whole-block hashes. A later prompt sharing a
+block-aligned prefix ADOPTS the cached blocks read-only — prefill compute and
+KV writes are skipped for the matched region. A prompt that diverges
+MID-block no longer loses the partial match: ``match_fork`` returns the
+child entry sharing the longest token-run prefix so the engine can
+copy-on-write its block (one jitted gather/scatter on device) and keep only
+the diverging tail to prefill.
+
+Entry kinds:
+
+* FULL entries (``len(tokens) == block_size``) — the classic chain nodes;
+  they are what ``match``/``match_with_key`` walk and what ``len()`` counts.
+* PARTIAL entries (``0 < len(tokens) < block_size``, via ``register_tail``) —
+  leaf-only fork sources capturing a flushed sequence's sub-block tail (the
+  common "system prompt shorter than a block boundary" case). They never
+  gain children and are never adopted whole; they exist to be forked.
 
 Ownership model (host-side, no device traffic — block ids only):
 
@@ -14,32 +27,42 @@ Ownership model (host-side, no device traffic — block ids only):
   (they are NOT returned to the allocator); unregistered blocks free
   normally.
 * adopters take a reference (``refs``); flushing an adopter drops it.
+  ``match_fork`` also takes a TRANSIENT reference on the fork-source entry
+  so eviction cannot free it between the match and the device copy; the
+  engine drops it via ``release([src_block])`` once the copy is dispatched.
 * under allocator pressure the state manager evicts LRU leaf entries
   (``refs == 0`` and no cached children) back to the allocator — a parent
   is never evicted before its children, so every cached chain stays
   matchable root-first.
 
 Safety: adopted blocks are never written (new tokens start at the
-block-aligned ``seen_tokens`` boundary, i.e. a fresh block), and prefix
-caching is disabled for sliding-window models whose mid-sequence
-trailing-window release would free shared blocks.
+``seen_tokens`` boundary inside a PRIVATE block — after a fork that block is
+the COW copy, never the shared source), and prefix caching is disabled for
+sliding-window models whose mid-sequence trailing-window release would free
+shared blocks. COW whole-block copies are safe because attention is causal:
+the first ``p`` slots of the source block are bit-identical to what the
+forking sequence would have computed, and slots past ``p`` are overwritten
+by the fork's own prefill before any read can see them.
 """
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 
 class _Entry:
-    __slots__ = ("block", "refs", "children", "last_use", "parent", "owned")
+    __slots__ = ("block", "refs", "children", "last_use", "parent", "owned",
+                 "tokens")
 
-    def __init__(self, block: int, parent):
+    def __init__(self, block: int, parent, tokens: np.ndarray):
         self.block = int(block)
         self.refs = 0          # live sequences currently adopting this block
         self.children = 0      # cached entries chained after this one
         self.last_use = 0
         self.parent = parent   # parent key or None
         self.owned = False     # True once the computing sequence flushed
+        self.tokens = tokens   # this block's token run (len <= block_size)
 
 
 class PrefixKVCache:
@@ -48,7 +71,16 @@ class PrefixKVCache:
         self.block_size = int(block_size)
         self._entries: Dict[tuple, _Entry] = {}
         self._by_block: Dict[int, tuple] = {}
+        # radix child index: parent key (None = root) -> child keys; lets
+        # match_fork scan divergence candidates without hashing every entry
+        self._kids: Dict[Optional[tuple], Set[tuple]] = {}
         self._clock = 0
+        # single source of truth for the saved-prefill accounting: the
+        # serving layer mirrors these into Prometheus counters, and the
+        # bench cross-checks that mirror against this dict exactly
+        self.stats = {"hits": 0, "misses": 0, "saved_tokens": 0,
+                      "cow_forks": 0}
+        self._depth_samples: deque = deque(maxlen=512)
 
     # ---- keys ----
 
@@ -87,8 +119,65 @@ class PrefixKVCache:
             e.last_use = self._clock
         return [e.block for e in matched], last_key
 
+    def match_fork(self, tokens: np.ndarray
+                   ) -> Tuple[List[int], Optional[tuple],
+                              Optional[Tuple[tuple, int, int]]]:
+        """Radix lookup: the full-block walk of ``match_with_key`` PLUS a
+        fork candidate at the divergence point.
+
+        Returns ``(full_block_ids, last_key, fork)`` where ``fork`` is
+        ``None`` or ``(child_key, block_id, p)``: a child of ``last_key``
+        whose token run shares ``p >= 1`` leading tokens with the remainder
+        of ``tokens``. Matched full entries are adopted (refs bumped) as in
+        ``match``; the fork source additionally takes one TRANSIENT ref the
+        caller must drop with ``release([block_id])`` after the COW copy —
+        that pin is what keeps the source alive while it is simultaneously
+        an eviction candidate.
+
+        Stats (hits/misses/saved_tokens/depth) are counted HERE and only
+        here: this is the engine's adoption entry point, so direct test
+        calls to ``match``/``match_with_key`` don't pollute the counters.
+        """
+        tokens = np.asarray(tokens, np.int32)
+        matched, last_key = self.match_with_key(tokens)
+        remaining = tokens[len(matched) * self.block_size:]
+        fork = None
+        if len(remaining) > 0:
+            best = None  # (p, child_key, entry)
+            for ck in self._kids.get(last_key, ()):
+                e = self._entries.get(ck)
+                if e is None or e.tokens is None:
+                    continue
+                m = min(len(e.tokens), len(remaining))
+                if m == 0:
+                    continue
+                eq = e.tokens[:m] == remaining[:m]
+                p = m if eq.all() else int(np.argmin(eq))
+                if p >= 1 and (best is None or p > best[0]):
+                    best = (p, ck, e)
+            if best is not None:
+                p, ck, e = best
+                e.refs += 1                     # transient fork pin
+                e.last_use = self._clock
+                fork = (ck, e.block, p)
+        saved = len(matched) * self.block_size
+        if matched or fork is not None:
+            self.stats["hits"] += 1
+            self.stats["saved_tokens"] += saved
+            self._depth_samples.append(saved + (fork[2] if fork else 0))
+        else:
+            self.stats["misses"] += 1
+        return matched, last_key, fork
+
+    def commit_fork(self, p: int) -> None:
+        """The engine landed a COW copy covering ``p`` forked tokens: fold
+        them into the saved-prefill accounting (kept out of ``match_fork``
+        so an aborted fork — allocator full — never over-counts)."""
+        self.stats["cow_forks"] += 1
+        self.stats["saved_tokens"] += int(p)
+
     def release(self, block_ids: Sequence[int]) -> None:
-        """An adopter flushed: drop its references."""
+        """An adopter flushed (or a fork pin is dropped): drop references."""
         for b in block_ids:
             key = self._by_block.get(int(b))
             if key is not None:
@@ -119,21 +208,47 @@ class PrefixKVCache:
         key = parent_key
         for i, b in zip(range(len(tokens) // bs), block_ids):
             parent = key
-            key = (parent, tokens[i * bs:(i + 1) * bs].tobytes())
+            run = tokens[i * bs:(i + 1) * bs]
+            key = (parent, run.tobytes())
             b = int(b)
             e = self._entries.get(key)
             if e is not None:
                 continue  # chain already cached by another sequence
             if b in self._by_block:
                 continue  # block already backs another entry (shouldn't happen)
-            e = _Entry(b, parent)
+            e = _Entry(b, parent, run.copy())
             e.last_use = self._clock
             self._entries[key] = e
             self._by_block[b] = key
+            self._kids.setdefault(parent, set()).add(key)
             if parent is not None and parent in self._entries:
                 self._entries[parent].children += 1
             registered.append(b)
         return key, registered
+
+    def register_tail(self, parent_key: Optional[tuple], tokens: np.ndarray,
+                      block_id: int) -> bool:
+        """Register a PARTIAL leaf entry: a flushed sequence's sub-block
+        tail (``0 < len(tokens) < block_size`` tokens already written into
+        ``block_id`` at slots ``[0, len)``). Partial entries never appear
+        in the full-block walk and never gain children — they exist purely
+        as fork sources for ``match_fork``. Returns True if inserted."""
+        tokens = np.asarray(tokens, np.int32)
+        if not 0 < len(tokens) < self.block_size:
+            return False
+        block_id = int(block_id)
+        key = (parent_key, tokens.tobytes())
+        if key in self._entries or block_id in self._by_block:
+            return False
+        self._clock += 1
+        e = _Entry(block_id, parent_key, tokens.copy())
+        e.last_use = self._clock
+        self._entries[key] = e
+        self._by_block[block_id] = key
+        self._kids.setdefault(parent_key, set()).add(key)
+        if parent_key is not None and parent_key in self._entries:
+            self._entries[parent_key].children += 1
+        return True
 
     def owns(self, block_id: int) -> bool:
         return int(block_id) in self._by_block
@@ -159,17 +274,14 @@ class PrefixKVCache:
         unreferenced (leaf-first eviction cannot pass a pinned or live
         child — counting those would let the scheduler admit work the
         allocator can never satisfy)."""
-        kids: Dict[Optional[tuple], List[tuple]] = {}
-        for key, e in self._entries.items():
-            kids.setdefault(e.parent, []).append(key)
         memo: Dict[tuple, bool] = {}
 
         def evictable(key) -> bool:
             if key in memo:
                 return memo[key]
-            e = self._entries[key]
-            ok = (e.owned and e.refs <= 0
-                  and all(evictable(k) for k in kids.get(key, ())))
+            e = self._entries.get(key)
+            ok = (e is not None and e.owned and e.refs <= 0
+                  and all(evictable(k) for k in self._kids.get(key, ())))
             memo[key] = ok
             return ok
 
@@ -190,10 +302,18 @@ class PrefixKVCache:
                     break
                 e = self._entries.pop(key)
                 self._by_block.pop(e.block, None)
+                self._forget_kid(e.parent, key)
                 if e.parent is not None and e.parent in self._entries:
                     self._entries[e.parent].children -= 1
                 freed.append(e.block)
         return freed
+
+    def _forget_kid(self, parent, key) -> None:
+        kids = self._kids.get(parent)
+        if kids is not None:
+            kids.discard(key)
+            if not kids:
+                self._kids.pop(parent, None)
 
     def clear(self) -> List[int]:
         """Drop every entry (weights changed — cached KV content is stale).
@@ -203,7 +323,33 @@ class PrefixKVCache:
         owned = [e.block for e in self._entries.values() if e.owned]
         self._entries.clear()
         self._by_block.clear()
+        self._kids.clear()
         return owned
 
+    # ---- reporting ----
+
+    def report(self) -> Dict[str, object]:
+        """Counters + structure snapshot for /health, env_report and the
+        bench cross-check. ``saved_prefill_tokens`` is the exact number of
+        prompt tokens adoption + COW forks kept out of prefill."""
+        s = dict(self.stats)
+        lookups = s["hits"] + s["misses"]
+        samples = sorted(self._depth_samples)
+        return {
+            "hits": s["hits"],
+            "misses": s["misses"],
+            "hit_rate": (s["hits"] / lookups) if lookups else 0.0,
+            "saved_prefill_tokens": s["saved_tokens"],
+            "cow_forks": s["cow_forks"],
+            "p50_match_depth": int(samples[len(samples) // 2]) if samples else 0,
+            "entries": len(self._entries),
+            "full_entries": len(self),
+            "blocks": len(self._by_block),
+        }
+
     def __len__(self):
-        return len(self._entries)
+        # full-block chain entries only: the unit every accounting contract
+        # (and the engine's chain_blocks bookkeeping) is written in; partial
+        # fork-source tails are auxiliary and counted via report()["entries"]
+        bs = self.block_size
+        return sum(1 for e in self._entries.values() if len(e.tokens) == bs)

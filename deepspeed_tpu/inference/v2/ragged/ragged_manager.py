@@ -95,11 +95,31 @@ class DSStateManager:
         if self.prefix_cache is not None:
             adopted = set(getattr(seq, "adopted_blocks", ()))
             self.prefix_cache.release([b for b in blocks if b in adopted])
+            self._register_tail(seq, blocks, adopted)
             kept = set(self.prefix_cache.take_ownership(
                 [b for b in blocks if b not in adopted]))
             blocks = [b for b in blocks if b not in adopted and b not in kept]
         if blocks:
             self._allocator.free(blocks)
+
+    def _register_tail(self, seq, blocks, adopted) -> None:
+        """At flush, hand the sequence's sub-block TAIL to the radix cache
+        as a partial (fork-source) entry: the common "system prompt shorter
+        than a block" case would otherwise evaporate on every flush. Runs
+        before take_ownership so the tail block transfers with the rest.
+        The seen_tokens consistency check skips sequences whose staged
+        tail no longer reflects block contents (mid-rollback flushes)."""
+        pend = getattr(seq, "pending_tokens", None)
+        start = int(getattr(seq, "chain_blocks", 0))
+        bs = self.block_size
+        if (pend is None or not 0 < len(pend) < bs or start >= len(blocks)
+                or seq.seen_tokens != start * bs + len(pend)):
+            return
+        tail_block = blocks[start]
+        if tail_block in adopted:
+            return
+        self.prefix_cache.register_tail(
+            getattr(seq, "chain_key", None), pend, tail_block)
 
     # ---- KV accounting ----
 

@@ -130,10 +130,18 @@ class _Replica:
         watchdog owns recovery); quarantined/migrating/dead do not."""
         return self.state in ("ok", "degraded")
 
-    def score(self) -> float:
-        """Load score for balanced admission: queue depth + in-flight."""
+    def score(self, tenant: Optional[str] = None) -> float:
+        """Load score for balanced admission: queue depth + in-flight.
+        With a ``tenant``, that tenant's own backlog on this replica
+        (from the probed per-tenant stats) weighs in too, so one tenant's
+        burst spreads across replicas instead of piling behind itself
+        while the others stay globally balanced."""
         st = self.stats or {}
-        return float(st.get("waiting") or 0) + float(st.get("live") or 0)
+        base = float(st.get("waiting") or 0) + float(st.get("live") or 0)
+        if tenant:
+            t = ((st.get("tenants") or {}).get(tenant)) or {}
+            base += float(t.get("queued") or 0) + float(t.get("live") or 0)
+        return base
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -379,13 +387,15 @@ class ReplicaFleet:
         with self._lock:
             return [r for r in self._pool if r.routable]
 
-    def pick(self, exclude: Sequence[_Replica] = ()) -> Optional[_Replica]:
+    def pick(self, exclude: Sequence[_Replica] = (),
+             tenant: Optional[str] = None) -> Optional[_Replica]:
         """Least-loaded routable replica (health-gated balanced admission);
-        ties break by uid_base for determinism."""
+        ties break by uid_base for determinism. ``tenant`` biases the
+        score by that tenant's per-replica backlog."""
         cands = [r for r in self.healthy() if r not in exclude]
         if not cands:
             return None
-        return min(cands, key=lambda r: (r.score(), r.uid_base))
+        return min(cands, key=lambda r: (r.score(tenant), r.uid_base))
 
     def owner_of(self, uid: int) -> Optional[_Replica]:
         with self._lock:
@@ -736,9 +746,13 @@ def create_router_server(fleet: ReplicaFleet, host: str = "127.0.0.1",
                 return
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
+            try:  # tenant-aware balancing: bias by the tenant's backlog
+                tenant = json.loads(body or b"{}").get("tenant")
+            except (ValueError, AttributeError):
+                tenant = None
             tried: List[_Replica] = []
             for attempt in range(max(1, submit_retries)):
-                r = fleet.pick(exclude=tried)
+                r = fleet.pick(exclude=tried, tenant=tenant)
                 if r is None:
                     break
                 if attempt:

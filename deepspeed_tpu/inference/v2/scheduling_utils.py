@@ -33,3 +33,38 @@ class SchedulerOverloaded(RuntimeError):
     def __init__(self, msg: str, retry_after_s: float = 1.0):
         self.retry_after_s = float(retry_after_s)
         super().__init__(msg)
+
+
+class UnsupportedFeature(ValueError):
+    """A request or config names a feature this build rejects (KV offload,
+    unsupported chat-completion knobs, ...). Subclasses ValueError so
+    existing ``except ValueError`` rejection paths keep working, but
+    carries a machine-readable ``reason`` slug the HTTP front end surfaces
+    in the 400 body — clients branch on the slug, not on message text."""
+
+    def __init__(self, msg: str, reason: str):
+        self.reason = str(reason)
+        super().__init__(msg)
+
+
+def error_reason(exc: BaseException):
+    """Best-effort machine-readable reason slug for a rejection: the
+    ``reason`` attribute of :class:`UnsupportedFeature`, or the custom
+    error type a pydantic ValidationError carries (config validators use
+    ``PydanticCustomError`` slugs — pydantic wraps any ValueError raised
+    inside a validator, so the slug is how the type survives the wrap).
+    Returns None when the error has no structured reason."""
+    r = getattr(exc, "reason", None)
+    if isinstance(r, str) and r:
+        return r
+    errors = getattr(exc, "errors", None)  # pydantic ValidationError
+    if callable(errors):
+        try:
+            for e in errors():
+                t = e.get("type")
+                if isinstance(t, str) and t not in (
+                        "value_error", "assertion_error"):
+                    return t
+        except Exception:  # noqa: BLE001 — reporting is best-effort
+            return None
+    return None

@@ -65,7 +65,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -74,13 +74,15 @@ from ...utils.fault_injection import InjectedFault, get_fault_injector
 from ...utils.logging import logger
 from ...utils.retry import RetriesExhausted, retry_with_backoff
 from .config_v2 import (ContinuousFusionConfig, DurableServingConfig,
-                        ObservabilityConfig, ServingResilienceConfig)
+                        ObservabilityConfig, ServingResilienceConfig,
+                        TenantConfig)
 from .disagg import DisaggServing
 from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
 from .ragged.sequence_descriptor import PlaceholderSequenceDescriptor
 from .scheduling_utils import (DeadlineExceeded, SchedulerOverloaded,
-                               SchedulingError, SchedulingResult)
+                               SchedulingError, SchedulingResult,
+                               UnsupportedFeature, error_reason)
 
 _END = object()  # stream sentinel
 
@@ -103,6 +105,9 @@ class _Request:
     num_draft_tokens: int = 4
     draft_ngram: int = 2
     return_logprobs: bool = False
+    # multi-tenant scheduling: which tenant contract (config ``tenants``
+    # block) this request admits/sheds/budgets under
+    tenant: str = "default"
     logprobs: list = field(default_factory=list)
     # speculative accept-rate accounting (drafted tokens offered / accepted)
     drafted: int = 0
@@ -343,6 +348,16 @@ class ServingScheduler:
         # scheduler thread's queues
         self._queued_n = 0
         self._queued_tokens = 0
+        # multi-tenant weighted-fair scheduling: per-tenant contracts from
+        # the config ``tenants`` block (unknown tenants fall back to the
+        # "default" entry, else weight-1/no-caps), plus the per-tenant
+        # accounting admission and shedding run on. _tenant_queued mutates
+        # under _lock with the global queue counters; _tenant_delivered is
+        # scheduler-thread-only (stats snapshots it under _lock).
+        self._tenants = dict(getattr(engine._config, "tenants", None) or {})
+        self._tenant_fallback = self._tenants.get("default") or TenantConfig()
+        self._tenant_queued: dict = {}
+        self._tenant_delivered: dict = {}
         self._degraded = False
         # live-migration state: export_journal() flips _migrating so
         # /health answers "migrating" (distinct from a plain drain — the
@@ -446,13 +461,17 @@ class ServingScheduler:
                return_logprobs: bool = False,
                deadline_s: Optional[float] = None,
                queue_ttl_s: Optional[float] = None,
-               stream: bool = False) -> RequestHandle:
+               stream: bool = False,
+               tenant: Optional[str] = None) -> RequestHandle:
         """``deadline_s``: end-to-end budget (queue + decode) after which
         the request finishes with :class:`DeadlineExceeded`; ``queue_ttl_s``
         bounds only the unadmitted wait. Both default from the
         ``serving_resilience`` config. ``stream=True`` marks the caller as
         a ``stream()`` consumer: its token queue is bounded by
-        ``max_stream_backlog`` and stops the request if never drained."""
+        ``max_stream_backlog`` and stops the request if never drained.
+        ``tenant`` selects the scheduling contract from the config
+        ``tenants`` block (weighted-fair admission + budgets, per-tenant
+        shed); unnamed requests run as "default"."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -460,22 +479,27 @@ class ServingScheduler:
             raise SchedulingError(SchedulingResult.SequenceTokenLimitExceeded)
         if speculative is not None:
             if speculative != "prompt_lookup":
-                raise ValueError(f"unknown speculative mode {speculative!r}")
+                raise UnsupportedFeature(
+                    f"unknown speculative mode {speculative!r}",
+                    reason="unknown_speculative_mode")
             if (min_new_tokens or repetition_penalty != 1.0
                     or logits_processor is not None or return_logprobs):
-                # ValueError → the HTTP handler's 400 (not a dead request).
-                # temperature/top_k/top_p are FINE now: the window verify
-                # rejection-samples against the draft point masses on the
-                # per-sequence key chains. The leftovers here mutate the
-                # distribution per emitted token (penalty/min_new) or need
-                # host callbacks/per-token logprobs a multi-token accept
-                # cannot honor.
-                raise ValueError("speculative decoding does not compose "
-                                 "with min_new_tokens/repetition_penalty/"
-                                 "logits_processor/logprobs")
+                # UnsupportedFeature (a ValueError) → the HTTP handler's
+                # structured 400 (not a dead request). temperature/top_k/
+                # top_p are FINE now: the window verify rejection-samples
+                # against the draft point masses on the per-sequence key
+                # chains. The leftovers here mutate the distribution per
+                # emitted token (penalty/min_new) or need host callbacks/
+                # per-token logprobs a multi-token accept cannot honor.
+                raise UnsupportedFeature(
+                    "speculative decoding does not compose with "
+                    "min_new_tokens/repetition_penalty/logits_processor/"
+                    "logprobs", reason="speculative_compose_unsupported")
             if temperature != 0.0 and not self._device_sampling:
-                raise ValueError("speculative sampling requires "
-                                 "sampling.device_sampling")
+                raise UnsupportedFeature(
+                    "speculative sampling requires "
+                    "sampling.device_sampling",
+                    reason="speculative_requires_device_sampling")
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
@@ -488,7 +512,8 @@ class ServingScheduler:
                        speculative=speculative,
                        num_draft_tokens=int(num_draft_tokens),
                        draft_ngram=int(draft_ngram),
-                       return_logprobs=bool(return_logprobs))
+                       return_logprobs=bool(return_logprobs),
+                       tenant=str(tenant) if tenant else "default")
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         req.wake = self._wake
@@ -527,6 +552,18 @@ class ServingScheduler:
                     f"queue full ({self._queued_n} requests, "
                     f"{self._queued_tokens} prompt tokens queued)",
                     retry_after_s=res.retry_after_s)
+            tcfg = self._tenant_cfg(req.tenant)
+            if (tcfg.max_queued and self._tenant_queued.get(
+                    req.tenant, 0) >= tcfg.max_queued):
+                # per-tenant shed: one tenant's backlog must not consume
+                # the global queue budget the other tenants share
+                self._trace["shed"] += 1
+                if self._obs is not None:
+                    self._obs.shed.inc()
+                raise SchedulerOverloaded(
+                    f"tenant {req.tenant!r} queue full "
+                    f"({self._tenant_queued.get(req.tenant, 0)} queued)",
+                    retry_after_s=res.retry_after_s if res.enabled else 1.0)
             # journal BEFORE the request becomes visible to the loop: the
             # loop could otherwise finish it and write a finish record the
             # recovery scan would see before (and thus ignore) the admit
@@ -535,6 +572,7 @@ class ServingScheduler:
             self._inbox.append(req)
             self._active += 1
             req.queued = True
+            self._tq_inc(req)
             self._queued_n += 1
             self._queued_tokens += len(prompt)
         if self._obs is not None:
@@ -557,7 +595,7 @@ class ServingScheduler:
             "num_draft_tokens": req.num_draft_tokens,
             "draft_ngram": req.draft_ngram,
             "return_logprobs": req.return_logprobs,
-            "stream": req.stream}
+            "stream": req.stream, "tenant": req.tenant}
         try:
             self._journal.record_admit(
                 req.uid, req.prompt, params,
@@ -569,6 +607,30 @@ class ServingScheduler:
         except OSError as e:  # journaling is best-effort; serving goes on
             logger.warning(f"[journal] admit record failed for request "
                            f"{req.uid}: {e}")
+
+    # ---- multi-tenant bookkeeping -------------------------------------
+
+    def _tenant_cfg(self, name: str) -> TenantConfig:
+        """Scheduling contract for a tenant: its ``tenants`` config entry,
+        else the "default" entry, else a neutral weight-1 contract — unknown
+        tenants are never rejected, they just share the default lane."""
+        return self._tenants.get(name) or self._tenant_fallback
+
+    def _tq_inc(self, req: _Request) -> None:
+        """Caller holds ``_lock``. Mirrors every ``req.queued = True``."""
+        self._tenant_queued[req.tenant] = \
+            self._tenant_queued.get(req.tenant, 0) + 1
+        if self._obs is not None:
+            self._obs.tenant_queue_depth(
+                req.tenant, self._tenant_queued[req.tenant])
+
+    def _tq_dec(self, req: _Request) -> None:
+        """Caller holds ``_lock``. Mirrors every ``req.queued = False``."""
+        n = self._tenant_queued.get(req.tenant, 0) - 1
+        self._tenant_queued[req.tenant] = max(0, n)
+        if self._obs is not None:
+            self._obs.tenant_queue_depth(
+                req.tenant, self._tenant_queued[req.tenant])
 
     def lookup(self, uid: int) -> Optional[RequestHandle]:
         """Re-attach to an in-flight or recently finished request by id —
@@ -595,6 +657,8 @@ class ServingScheduler:
             fused_dispatches = tr["fused_dispatches"]
             fused_k_sum = tr["fused_k_sum"]
             prefill_overlap = tr["prefill_overlap_tokens"]
+            tq = dict(self._tenant_queued)
+            td = dict(self._tenant_delivered)
         out = {"waiting": len(self._waiting) + inbox,
                "live": len(self._live),
                "free_blocks": self._engine.free_blocks,
@@ -638,6 +702,25 @@ class ServingScheduler:
                "last_restart_age_s": (round(time.time() - self._boot_wall, 3)
                                       if self._restart_count else None),
                "completed": len(done)}
+        # per-tenant scheduling view: queue depth, live load, delivered
+        # tokens — the router's tenant-aware balancer and ds_top read this
+        live_by = {}
+        live_tok = {}
+        for r in list(self._live):
+            live_by[r.tenant] = live_by.get(r.tenant, 0) + 1
+            live_tok[r.tenant] = (live_tok.get(r.tenant, 0)
+                                  + len(r.prompt) + r.max_new_tokens)
+        tenants = {}
+        for name in set(tq) | set(td) | set(live_by) | set(self._tenants):
+            cfg = self._tenant_cfg(name)
+            tenants[name] = {
+                "queued": tq.get(name, 0),
+                "live": live_by.get(name, 0),
+                "live_tokens": live_tok.get(name, 0),
+                "delivered_tokens": td.get(name, 0),
+                "weight": cfg.weight, "priority": cfg.priority}
+        out["tenants"] = tenants
+        out["prefix_cache"] = self._engine.prefix_cache_report()
         done = [d for d in done if d[3] > 0]
         # replayed requests' TTFT spans the crash + restart (measured from
         # the ORIGINAL admit) — real for that client, but a restart would
@@ -817,7 +900,8 @@ class ServingScheduler:
             speculative=p.get("speculative"),
             num_draft_tokens=int(p.get("num_draft_tokens", 4)),
             draft_ngram=int(p.get("draft_ngram", 2)),
-            return_logprobs=bool(p.get("return_logprobs")))
+            return_logprobs=bool(p.get("return_logprobs")),
+            tenant=str(p.get("tenant") or "default"))
         req.outputs = [int(t) for t in e.tokens]
         req.logprobs = list(e.logprobs)
         req.key_burns = int(e.key_burns)
@@ -881,6 +965,7 @@ class ServingScheduler:
                     finish_now.append(req)
                 else:
                     req.queued = True
+                    self._tq_inc(req)
                     self._queued_n += 1
                     self._queued_tokens += len(req.prompt)
                     if live:
@@ -1270,17 +1355,52 @@ class ServingScheduler:
         """Move waiting requests into the live set (no forward happens
         here — _advance_tick feeds them chunkwise). A request admits when
         blocks for its ENTIRE feed + decode budget fit after the projected
-        growth of everything already live."""
+        growth of everything already live.
+
+        Admission order is weighted-fair across tenants: each pick takes
+        the FIFO head of the tenant with the smallest weighted live-token
+        deficit (higher ``priority`` strictly first; tenants at their
+        ``max_live_tokens`` cap are skipped, so their share redistributes
+        — work-conserving). The loop still breaks the moment the chosen
+        head cannot fit, never queue-jumping within or across tenants, so
+        a single-tenant system degenerates exactly to plain FIFO."""
         free = self._engine.free_blocks - self._live_reserve()
         admitted: List[_Request] = []
-        for req in list(self._waiting):
+        live_tok: Dict[str, int] = {}
+        for r in self._live:
+            live_tok[r.tenant] = (live_tok.get(r.tenant, 0)
+                                  + len(r.prompt) + r.max_new_tokens)
+        queues: Dict[str, List[_Request]] = {}
+        for r in self._waiting:
+            queues.setdefault(r.tenant, []).append(r)
+        while True:
             if len(self._live) >= self._max_seqs:
                 break
+            best = None
+            for name, q in queues.items():
+                if not q:
+                    continue
+                cfg = self._tenant_cfg(name)
+                if (cfg.max_live_tokens
+                        and live_tok.get(name, 0) >= cfg.max_live_tokens):
+                    continue
+                key = (-cfg.priority,
+                       live_tok.get(name, 0) / cfg.weight, name)
+                if best is None or key < best[0]:
+                    best = (key, name, q)
+            if best is None:
+                break
+            _, name, q = best
+            req = q[0]
             need = self._future_blocks(
                 PlaceholderSequenceDescriptor(),
                 len(req.feed) + max(0, req.max_new_tokens - len(req.outputs)))
             if need > free:
+                # the chosen head is the most-deficient admissible tenant's
+                # oldest request — admitting anything else over it would be
+                # queue-jumping, so stop the whole pass here
                 break
+            q.pop(0)
             free -= need
             self._waiting.remove(req)
             req.fed = 0
@@ -1288,6 +1408,8 @@ class ServingScheduler:
             self._live.append(req)
             self._queue_drop(req)
             admitted.append(req)
+            live_tok[name] = (live_tok.get(name, 0)
+                              + len(req.prompt) + req.max_new_tokens)
         if not admitted and not self._live and self._waiting:
             # nothing can reserve full headroom: admit ONE on feed
             # feasibility alone rather than deadlocking (eviction truncates
@@ -1315,12 +1437,86 @@ class ServingScheduler:
                 self._obs.request_admitted(r.uid, r.t_submit, now)
         return admitted
 
+    @staticmethod
+    def _water_fill(demands: Dict[str, Tuple[float, int]],
+                    budget: int) -> Dict[str, int]:
+        """Weighted max-min (water-filling) split of ``budget`` tokens over
+        ``{tenant: (weight, demand)}``: each round hands every unsatisfied
+        tenant its weighted share of the remaining budget (at least 1, so
+        the loop always terminates), tenants that fill their demand drop
+        out and their leftover redistributes — work-conserving."""
+        grant = {name: 0 for name in demands}
+        pending = {name: d for name, (_, d) in demands.items() if d > 0}
+        while budget > 0 and pending:
+            wsum = sum(demands[n][0] for n in pending)
+            round_budget = budget
+            for name in list(pending):
+                w = demands[name][0]
+                share = max(1, int(round_budget * w / wsum))
+                take = min(share, pending[name], budget)
+                grant[name] += take
+                pending[name] -= take
+                budget -= take
+                if pending[name] <= 0:
+                    del pending[name]
+                if budget <= 0:
+                    break
+        return grant
+
+    def _fair_takes(self, reqs, budget: int):
+        """Split a prefill token budget across ``reqs`` (each wanting
+        ``req.pending``) by tenant weight, FIFO within a tenant. With one
+        tenant this is exactly the old greedy head-of-line loop. Returns
+        ``[(req, take), ...]`` preserving the input (arrival) order."""
+        tenants = {r.tenant for r in reqs}
+        takes = []
+        if len(tenants) <= 1:
+            spent = 0
+            for req in reqs:
+                if spent >= budget:
+                    break
+                take = min(req.pending, budget - spent)
+                takes.append((req, take))
+                spent += take
+            return takes
+        demands = {}
+        for r in reqs:
+            w, d = demands.get(r.tenant, (self._tenant_cfg(r.tenant).weight,
+                                          0))
+            demands[r.tenant] = (w, d + r.pending)
+        grant = self._water_fill(demands, budget)
+        for req in reqs:
+            left = grant.get(req.tenant, 0)
+            if left <= 0:
+                continue
+            take = min(req.pending, left)
+            grant[req.tenant] = left - take
+            takes.append((req, take))
+        return takes
+
+    def _fair_decode_order(self, decodes):
+        """WFQ order for an oversubscribed decode set: virtual finish time
+        ``(i+1)/weight`` over each tenant's FIFO index ``i``, priority
+        classes strictly first, uid as the deterministic tiebreak. Called
+        only when decodes exceed the tick budget — the common case skips
+        the sort entirely."""
+        idx: Dict[str, int] = {}
+
+        def key(r):
+            cfg = self._tenant_cfg(r.tenant)
+            i = idx.get(r.tenant, 0)
+            idx[r.tenant] = i + 1
+            return (-cfg.priority, (i + 1) / cfg.weight, r.uid)
+
+        return sorted(decodes, key=key)
+
     def _queue_drop(self, req: _Request) -> None:
         """Request left the unadmitted set (admitted; finishes drop inside
         _finish's own lock section)."""
         with self._lock:
             if req.queued:
                 req.queued = False
+                self._tq_dec(req)
                 self._queued_n -= 1
                 self._queued_tokens -= len(req.prompt)
 
@@ -1329,6 +1525,7 @@ class ServingScheduler:
         with self._lock:
             if not req.queued:
                 req.queued = True
+                self._tq_inc(req)
                 self._queued_n += 1
                 self._queued_tokens += len(req.prompt)
 
@@ -1567,14 +1764,11 @@ class ServingScheduler:
         p_budget = int(budget * self._cf.prefill_budget_frac)
         if p_budget <= 0:
             return overlap_fed
+        cands = [req for req in self._live
+                 if not (req.uid in self._in_flight or req.pending <= 1
+                         or req.uid in self._on_prefill)]
         p_reqs, p_chunks, spent = [], [], 0
-        for req in self._live:
-            if spent >= p_budget:
-                break
-            if (req.uid in self._in_flight or req.pending <= 1
-                    or req.uid in self._on_prefill):
-                continue
-            take = min(req.pending, p_budget - spent)
+        for req, take in self._fair_takes(cands, p_budget):
             p_reqs.append(req)
             p_chunks.append(req.feed_slice(take))
             spent += take
@@ -1754,6 +1948,10 @@ class ServingScheduler:
         # decode SLA: every decoding sequence's 1 token is RESERVED before
         # drafts or prefill chunks may spend anything (generate() reserves
         # identically: draft_budget = max_batch - len(live))
+        if len(decodes) > budget:
+            # only an oversubscribed tick rations decode slots — and then
+            # by weighted-fair queueing order, not arrival order
+            decodes = self._fair_decode_order(decodes)
         reserve = min(len(decodes), budget)
         spare = budget - reserve
         d_reqs, d_chunks, drafted = [], [], {}
@@ -1777,10 +1975,7 @@ class ServingScheduler:
             d_reqs.append(req)
             d_chunks.append(chunk)
         p_reqs, p_chunks = [], []
-        for req in prefills:
-            if spare <= 0:
-                break
-            take = min(req.pending, spare)
+        for req, take in self._fair_takes(prefills, max(0, spare)):
             p_reqs.append(req)
             p_chunks.append(req.feed_slice(take))
             spare -= take
@@ -2163,13 +2358,17 @@ class ServingScheduler:
         if not req.outputs:
             req.t_first = now
             if obs is not None:
-                obs.first_token(req.t_submit, now, req.replayed)
+                obs.first_token(req.t_submit, now, req.replayed,
+                                tenant=req.tenant)
         elif obs is not None and req.t_last > 0.0:
             obs.token_gap(now - req.t_last)
         req.t_last = now
+        self._tenant_delivered[req.tenant] = \
+            self._tenant_delivered.get(req.tenant, 0) + 1
         if obs is not None:
             obs.tokens.inc()
             obs.decode_tokens.inc()
+            obs.tenant_token(req.tenant)
 
     def _emit_device(self, wave, engine: Optional[InferenceEngineV2] = None
                      ) -> None:
@@ -2275,6 +2474,7 @@ class ServingScheduler:
             self._active -= 1
             if req.queued:  # finished straight out of the waiting queue
                 req.queued = False
+                self._tq_dec(req)
                 self._queued_n -= 1
                 self._queued_tokens -= len(req.prompt)
             if req.error is None and not req.cancelled:
@@ -2292,7 +2492,7 @@ class ServingScheduler:
                 outcome = "error"
             self._obs.request_finished(req.uid, req.t_submit, req.t_done,
                                        outcome, len(req.outputs),
-                                       req.replayed)
+                                       req.replayed, tenant=req.tenant)
             # keep the last 256 finished requests reconnectable by uid,
             # then let them go so the registry stays bounded
             self._done_order.append(req.uid)
@@ -2564,16 +2764,18 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                         body.setdefault("text", body.pop("prompt"))
                 if chat:
                     if body.get("stream"):
-                        raise ValueError("streaming chat completions are "
-                                         "not supported; use /generate "
-                                         "with stream for token streaming")
+                        raise UnsupportedFeature(
+                            "streaming chat completions are not supported; "
+                            "use /generate with stream for token streaming",
+                            reason="streaming_chat_unsupported")
                     msgs = body.get("messages")
                     if not msgs:
                         raise ValueError("chat completions need 'messages'")
                     if tokenizer is None or not hasattr(
                             tokenizer, "apply_chat_template"):
-                        raise ValueError("chat completions need a tokenizer "
-                                         "with a chat template")
+                        raise UnsupportedFeature(
+                            "chat completions need a tokenizer with a chat "
+                            "template", reason="chat_template_unavailable")
                     try:
                         body["prompt"] = tokenizer.apply_chat_template(
                             msgs, add_generation_prompt=True)
@@ -2615,7 +2817,8 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     return_logprobs=bool(body.get("logprobs")),
                     deadline_s=body.get("deadline_s"),
                     queue_ttl_s=body.get("queue_ttl_s"),
-                    stream=bool(body.get("stream")))
+                    stream=bool(body.get("stream")),
+                    tenant=body.get("tenant"))
             except SchedulerOverloaded as e:
                 self._json(429, {"error": str(e),
                                  "retry_after_s": e.retry_after_s},
@@ -2623,7 +2826,11 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                                      str(max(1, round(e.retry_after_s)))), ))
                 return
             except (ValueError, SchedulingError) as e:
-                self._json(400, {"error": str(e)})
+                err = {"error": str(e)}
+                reason = error_reason(e)
+                if reason:  # machine-readable slug: clients branch on it
+                    err["reason"] = reason
+                self._json(400, err)
                 return
             except RuntimeError as e:
                 # stopped / draining / migrating: this replica no longer

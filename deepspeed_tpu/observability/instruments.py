@@ -111,6 +111,40 @@ class ServingInstruments:
             "(cost-analysis FLOPs / wall / peak_bf16_flops)")
         from .xla import peak_device_flops
         self.peak_flops = peak_device_flops()
+        # per-tenant handle bundles, created lazily on first sight of a
+        # tenant name — the hot path still touches plain attributes after
+        # one dict hit, and an untenanted deployment allocates nothing
+        self._tenants: dict = {}
+
+    def _tenant(self, name: str):
+        """Labeled series for one tenant, sharing the family names of the
+        unlabeled aggregates (``ds_tokens_emitted_total{tenant="a"}`` sits
+        next to plain ``ds_tokens_emitted_total``)."""
+        t = self._tenants.get(name)
+        if t is None:
+            lbl = {"tenant": name}
+            reg = self.registry
+            from types import SimpleNamespace
+            t = SimpleNamespace(
+                tokens=reg.counter(
+                    "ds_tokens_emitted_total",
+                    "Tokens surfaced to consumers", labels=lbl),
+                finished=reg.counter(
+                    "ds_requests_finished_total",
+                    "Requests finished successfully", labels=lbl),
+                ttft=reg.histogram(
+                    "ds_ttft_seconds",
+                    "Submit to first emitted token (replays excluded)",
+                    labels=lbl, **_HIST),
+                e2e=reg.histogram(
+                    "ds_request_e2e_seconds",
+                    "Submit to finish for successful requests",
+                    labels=lbl, **_HIST),
+                queue_depth=reg.gauge(
+                    "ds_tenant_queue_depth",
+                    "Unadmitted requests of one tenant", labels=lbl))
+            self._tenants[name] = t
+        return t
 
     # ---- recording helpers (each: a few attribute ops + one deque/lock) ----
 
@@ -131,14 +165,22 @@ class ServingInstruments:
         self.tracer.span(str(uid), "queue", t_submit, t)
 
     def first_token(self, req_t_submit: float, t: float,
-                    replayed: bool) -> None:
+                    replayed: bool, tenant: Optional[str] = None) -> None:
         # a replayed request's TTFT spans the crash+restart — real for the
         # client but not a scheduler-latency signal, so it stays out
         if not replayed:
             self.ttft.record(t - req_t_submit)
+            if tenant is not None:
+                self._tenant(tenant).ttft.record(t - req_t_submit)
 
     def token_gap(self, dt: float) -> None:
         self.inter_token.record(dt)
+
+    def tenant_token(self, tenant: str) -> None:
+        self._tenant(tenant).tokens.inc()
+
+    def tenant_queue_depth(self, tenant: str, depth: int) -> None:
+        self._tenant(tenant).queue_depth.set(depth)
 
     def wave_span(self, uids: Iterable, t0: float, t1: float, K: int,
                   size: int, kind: str, drafted: int = 0,
@@ -162,11 +204,15 @@ class ServingInstruments:
 
     def request_finished(self, uid, t_submit: float, t_done: float,
                          outcome: str, n_tokens: int,
-                         replayed: bool) -> None:
+                         replayed: bool, tenant: Optional[str] = None) -> None:
         if outcome == "ok":
             self.finished.inc()
+            if tenant is not None:
+                self._tenant(tenant).finished.inc()
             if not replayed:
                 self.e2e.record(t_done - t_submit)
+                if tenant is not None:
+                    self._tenant(tenant).e2e.record(t_done - t_submit)
         elif outcome == "cancelled":
             self.cancelled.inc()
         elif outcome == "expired":

@@ -107,9 +107,11 @@ def test_fused_with_prefix_caching():
     out2 = eng.generate([prompt], max_new_tokens=12, fused_decode_window=4)
     assert out2 == out1
     # live sequences all flushed: the allocator holds only the cached prefix
-    # blocks, and the scheduling view (which counts them as reclaimable)
-    # shows full conservation
-    assert eng._state_manager._allocator.free_blocks == free0 - len(pc)
+    # blocks (full-block chain entries plus the sub-block fork-source tail),
+    # and the scheduling view (which counts them as reclaimable) shows full
+    # conservation
+    assert (eng._state_manager._allocator.free_blocks
+            == free0 - pc.report()["blocks"])
     assert eng._state_manager.free_blocks == free0
 
 

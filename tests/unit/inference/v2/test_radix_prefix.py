@@ -1,0 +1,390 @@
+"""Radix prefix cache: partial-block fork sources + copy-on-write forking.
+
+The tree stores full-block runs as walkable edges and sub-block tails as
+leaf-only PARTIAL entries; a new request sharing only part of a cached
+block forks it — the shared block stays refcounted read-only while the
+diverging request COW-copies the block and overwrites the divergent slots
+through its own prefill. The contract under test: fork-point detection,
+the transient fork pin (the source must survive eviction pressure until
+the copy is dispatched), commit-only accounting, and streams that stay
+bit-identical cache-on vs cache-off — greedy, sampled, speculative, and
+across a durable crash-replay / cross-replica migration while a request
+holds adopted radix blocks.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import PrefixKVCache
+from deepspeed_tpu.inference.v2.server import ServingScheduler
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+BS = 16
+
+
+# ---------------------------------------------------------------------------
+# radix tree unit semantics (no engine)
+# ---------------------------------------------------------------------------
+
+
+class TestRadixUnit:
+
+    def test_match_fork_walks_fulls_then_forks_tail(self):
+        pc = PrefixKVCache(4)
+        toks = np.arange(11, dtype=np.int32)       # 2 full blocks + 3 tail
+        pc.register(toks[:8], [1, 2])
+        _, last_key = pc.match_with_key(toks[:8])
+        pc.release([1, 2])
+        assert pc.register_tail(last_key, toks[8:], 3)
+        pc.take_ownership([1, 2, 3])
+
+        full, key, fork = pc.match_fork(toks)
+        assert full == [1, 2] and key == last_key
+        assert fork is not None
+        _, src_block, p = fork
+        assert (src_block, p) == (3, 3)            # whole 3-token tail shared
+        pc.release(full)
+        pc.release([src_block])                    # drop the fork pin
+
+    def test_fork_point_is_first_divergent_token(self):
+        pc = PrefixKVCache(4)
+        tail = np.array([7, 8, 9], np.int32)
+        assert pc.register_tail(None, tail, 5)
+        pc.take_ownership([5])
+        # diverges at index 1: only the 1-token prefix of the tail shares
+        full, _, fork = pc.match_fork(np.array([7, 99, 9, 1], np.int32))
+        assert full == [] and fork is not None
+        assert fork[1:] == (5, 1)
+        pc.release([5])
+        # no shared prefix at all -> no fork
+        _, _, fork = pc.match_fork(np.array([99, 8], np.int32))
+        assert fork is None
+
+    def test_register_tail_guards(self):
+        pc = PrefixKVCache(4)
+        assert not pc.register_tail(None, np.zeros(0, np.int32), 1)
+        assert not pc.register_tail(None, np.arange(4, dtype=np.int32), 1)
+        tail = np.array([3, 4], np.int32)
+        assert pc.register_tail(None, tail, 7)
+        # identical tail re-registration dedupes on the key
+        assert not pc.register_tail(None, tail, 8)
+        # partial entries are not walkable full blocks
+        assert len(pc) == 0
+        assert pc.match(np.array([3, 4, 5, 6], np.int32)) == []
+
+    def test_fork_pin_protects_source_from_eviction(self):
+        """The transient ref taken by match_fork must keep the source block
+        out of the eviction victim set until the COW copy is dispatched —
+        this is exactly the fork-while-parent-is-eviction-candidate race."""
+        pc = PrefixKVCache(4)
+        tail = np.array([1, 2, 3], np.int32)
+        pc.register_tail(None, tail, 9)
+        pc.take_ownership([9])
+        assert pc.reclaimable_blocks == 1          # eviction candidate
+
+        _, _, fork = pc.match_fork(np.array([1, 2, 3, 4], np.int32))
+        assert fork is not None and fork[1] == 9
+        assert pc.evict(1) == []                   # pinned: not a victim
+        assert pc.reclaimable_blocks == 0
+        pc.release([9])                            # copy dispatched
+        assert pc.evict(1) == [9]
+
+    def test_commit_only_accounting(self):
+        """saved_tokens/cow_forks move only on commit_fork — an aborted
+        fork (allocator full) must not inflate the savings ledger."""
+        pc = PrefixKVCache(4)
+        toks = np.arange(10, dtype=np.int32)
+        pc.register(toks[:8], [1, 2])
+        _, key = pc.match_with_key(toks[:8])
+        pc.release([1, 2])
+        pc.register_tail(key, toks[8:], 3)
+        pc.take_ownership([1, 2, 3])
+
+        full, _, fork = pc.match_fork(toks)
+        assert pc.stats["saved_tokens"] == 8       # full blocks count now
+        assert pc.stats["cow_forks"] == 0
+        pc.release([fork[1]])                      # abort: no commit
+        assert pc.stats["saved_tokens"] == 8
+        pc.release(full)
+
+        full, _, fork = pc.match_fork(toks)
+        pc.commit_fork(fork[2])
+        assert pc.stats["saved_tokens"] == 8 + 8 + 2
+        assert pc.stats["cow_forks"] == 1
+        pc.release(full + [fork[1]])
+
+    def test_report_shape(self):
+        pc = PrefixKVCache(4)
+        pc.register(np.arange(8, dtype=np.int32), [1, 2])
+        r = pc.report()
+        for k in ("hits", "misses", "hit_rate", "saved_prefill_tokens",
+                  "cow_forks", "p50_match_depth", "entries", "full_entries",
+                  "blocks"):
+            assert k in r
+        assert r["full_entries"] == 2 and r["blocks"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine-level COW forking
+# ---------------------------------------------------------------------------
+
+
+def _engine(prefix=True, num_blocks=64, seed=11, **eng_kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=seed)
+    ec = RaggedInferenceEngineConfig(enable_prefix_caching=prefix,
+                                     num_kv_blocks=num_blocks, **eng_kw)
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              engine_config=ec, kv_block_size=BS)
+
+
+def test_cow_fork_bit_identical_logits_and_exact_accounting():
+    """B forking A's partial tail block must produce the cold engine's
+    logits, and the engine's saved-token skip must equal the radix
+    ledger's delta EXACTLY (full blocks * BS + fork point)."""
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 200, size=2 * BS + 6).tolist()
+    cold = _engine(prefix=False)
+    ref = np.asarray(cold.put([0], [prompt]), np.float32)[0]
+
+    eng = _engine(prefix=True)
+    pc = eng._state_manager.prefix_cache
+    eng.put([1], [prompt])
+    eng.flush(1)                        # 2 full blocks + 6-token tail cached
+
+    s0 = dict(pc.stats)
+    b = np.asarray(eng.put([2], [prompt]), np.float32)[0]
+    np.testing.assert_allclose(b, ref, rtol=2e-5, atol=2e-5)
+    seq = eng._state_manager.get_sequence(2)
+    assert len(seq.adopted_blocks) == 2            # COW dst is OWNED
+    assert seq.seen_tokens == len(prompt)
+    assert pc.stats["cow_forks"] - s0["cow_forks"] == 1
+    # exact accounting: 2 full blocks + 5 forked tokens (last prompt token
+    # is the sampling feed, never part of the matched prefix)
+    assert pc.stats["saved_tokens"] - s0["saved_tokens"] == 2 * BS + 5
+
+    # decode over the forked history matches the cold engine
+    tok = int(b.argmax())
+    d1 = np.asarray(eng.put([2], [[tok]]), np.float32)[0]
+    d0 = np.asarray(cold.put([0], [[tok]]), np.float32)[0]
+    np.testing.assert_allclose(d1, d0, rtol=2e-5, atol=2e-5)
+
+
+def test_cow_fork_when_parent_is_eviction_candidate():
+    """Allocator pressure at fork time: the COW destination allocation
+    must evict, and the fork SOURCE chain is an eviction candidate (older
+    LRU stamp than the other cached chain) — the adoption refs + transient
+    fork pin must steer eviction to the other chain so the copy reads live
+    data. Logits must still match a cold engine."""
+    rng = np.random.default_rng(4)
+    base = rng.integers(0, 200, size=BS + 6).tolist()
+    other = rng.integers(0, 200, size=2 * BS).tolist()
+    eng = _engine(prefix=True, num_blocks=12)
+    sm = eng._state_manager
+    pc = sm.prefix_cache
+    eng.put([1], [base])
+    eng.flush(1)                         # fork source: 1 full + 6-tail
+    eng.put([2], [other])
+    eng.flush(2)                         # younger chain: 2 full blocks
+    # burn every remaining free block so the fork's dst must evict
+    filler = rng.integers(0, 200, size=8 * BS).tolist()
+    eng.put([5], [filler], do_checks=False)
+    assert sm._allocator.free_blocks == 0
+    assert pc.reclaimable_blocks == 4    # BOTH chains are candidates
+
+    cold = _engine(prefix=False)
+    ref = np.asarray(cold.put([0], [base]), np.float32)[0]
+    got = np.asarray(eng.put([3], [base]), np.float32)[0]
+    assert pc.stats["cow_forks"] == 1    # fork committed, not aborted
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    # eviction is demand-driven (the dst needed one block) and took the
+    # OTHER chain's leaf — the pinned source chain survived untouched
+    left = pc.match(np.asarray(other, np.int32))
+    assert len(left) < 2
+    pc.release(left)
+    # conservation: every block is live, cache-owned, or free
+    live = set()
+    for seq in sm.tracked_sequences.values():
+        live.update(seq.kv_blocks)
+    cached_only = {b for b in pc._by_block if b not in live}
+    assert sm._allocator.free_blocks + len(cached_only) + len(live) == 12
+
+
+def test_sub_block_prompt_tail_forks():
+    """Prompts shorter than one block still share through the radix tree:
+    the first request's tail registers as a partial root child, the second
+    forks it instead of recomputing."""
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 200, size=9).tolist()
+    cold = _engine(prefix=False)
+    ref = np.asarray(cold.put([0], [prompt]), np.float32)[0]
+
+    eng = _engine(prefix=True)
+    pc = eng._state_manager.prefix_cache
+    eng.put([1], [prompt])
+    eng.flush(1)
+    assert len(pc) == 0                  # no full blocks — tail only
+    got = np.asarray(eng.put([2], [prompt]), np.float32)[0]
+    assert pc.stats["cow_forks"] == 1
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# bit-identical streams, cache on vs off
+# ---------------------------------------------------------------------------
+
+
+def _run_streams(prefix, submits, window=1):
+    # sequential on purpose: radix entries register when a sequence
+    # flushes, so request N+1 can only adopt if N already finished
+    sched = ServingScheduler(_engine(prefix=prefix, num_blocks=96),
+                             idle_wait=0.005,
+                             fused_decode_window=window).start()
+    try:
+        outs = [sched.submit(**kw).result(timeout=300) for kw in submits]
+        report = sched.stats["prefix_cache"]
+        return outs, report
+    finally:
+        sched.stop()
+
+
+@pytest.mark.parametrize("mode", ["greedy", "sampled", "speculative"])
+def test_streams_bit_identical_cache_on_off(mode):
+    """The same shared-prefix workload through the scheduler with the radix
+    cache off vs on: every stream must be BIT-identical, and the cached arm
+    must actually have adopted/forked (not trivially matched nothing)."""
+    rng = np.random.default_rng(8)
+    shared = rng.integers(0, 200, size=2 * BS + 5).tolist()
+    kw = {"max_new_tokens": 8}
+    if mode == "sampled":
+        kw.update(temperature=0.8, top_k=20, seed=13)
+    elif mode == "speculative":
+        kw.update(speculative="prompt_lookup", num_draft_tokens=3)
+    submits = [dict(prompt=shared + rng.integers(0, 200, size=n).tolist(),
+                    **kw) for n in (4, 9, 4)]
+    # identical tails for request 0 and 2 -> an exact-prefix adoption too
+    submits[2]["prompt"] = list(submits[0]["prompt"])
+
+    off, _ = _run_streams(False, submits)
+    on, report = _run_streams(True, submits)
+    assert on == off
+    assert report["state"] == "enabled"
+    assert report["hits"] >= 1 and report["saved_prefill_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# durability: adopted radix blocks across crash-replay and migration
+# ---------------------------------------------------------------------------
+
+
+def _durable_engine(**durable_kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    _, params = init_llama(cfg, seed=11)
+    ec = RaggedInferenceEngineConfig(
+        num_kv_blocks=96, enable_prefix_caching=True,
+        durable_serving={"enabled": True, **durable_kw})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=ec)
+
+
+def _wait_stopped(sched, timeout=120):
+    t0 = time.monotonic()
+    while not sched.stats["stopped"]:
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("scheduler loop never died")
+        time.sleep(0.02)
+
+
+@pytest.mark.faults
+def test_crash_replay_with_adopted_radix_blocks():
+    """Crash mid-decode while a request holds adopted (and COW-forked)
+    radix blocks. The replayed stream re-prefills from the journal on a
+    fresh engine — whose radix cache starts empty — and must continue
+    byte-identically to an uninterrupted cache-off run."""
+    rng = np.random.default_rng(21)
+    shared = rng.integers(0, 200, size=2 * BS + 5).tolist()
+    prompts = [shared + rng.integers(0, 200, size=n).tolist()
+               for n in (3, 7)]
+    submits = [dict(prompt=p, max_new_tokens=10) for p in prompts]
+
+    ref_sched = ServingScheduler(_engine(prefix=False, num_blocks=96),
+                                 idle_wait=0.005).start()
+    try:
+        ref = [ref_sched.submit(**kw).result(timeout=300) for kw in submits]
+    finally:
+        ref_sched.stop()
+
+    s1 = ServingScheduler(_durable_engine(), idle_wait=0.005).start()
+    # A retires cleanly and seeds the radix cache ...
+    assert s1.submit(**submits[0]).result(timeout=300) == ref[0]
+    # ... then B adopts A's shared prefix and the loop dies mid-decode
+    hb = s1.submit(**submits[1])
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": 5}]})
+    _wait_stopped(s1)
+    pre = list(hb._req.outputs)
+    assert 0 < len(pre) < submits[1]["max_new_tokens"], \
+        "crash did not land mid-decode — scenario is vacuous"
+    assert s1.stats["prefix_cache"]["cow_forks"] >= 1, \
+        "B never forked an adopted block before the crash"
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_durable_engine(), idle_wait=0.005).start()
+    try:
+        out_b = s2.lookup(2).result(timeout=300)
+    finally:
+        s2.stop()
+    assert out_b == ref[1]
+
+
+@pytest.mark.faults
+def test_migration_with_adopted_radix_blocks(tmp_path):
+    """Export a replica's journal mid-run while its requests hold adopted
+    radix blocks; a peer imports and finishes every stream byte-identically
+    (the adopted KV never travels — the peer re-prefills from tokens)."""
+    rng = np.random.default_rng(22)
+    shared = rng.integers(0, 200, size=2 * BS + 4).tolist()
+    warm = dict(prompt=shared + rng.integers(0, 200, size=5).tolist(),
+                max_new_tokens=4)
+    submits = [dict(prompt=shared + rng.integers(0, 200, size=n).tolist(),
+                    max_new_tokens=24) for n in (3, 6)]
+
+    ref_sched = ServingScheduler(_engine(prefix=False, num_blocks=96),
+                                 idle_wait=0.005).start()
+    try:
+        ref = [ref_sched.submit(**kw).result(timeout=300) for kw in submits]
+    finally:
+        ref_sched.stop()
+
+    s1 = ServingScheduler(_durable_engine(), idle_wait=0.005,
+                          uid_base=1_000_000).start()
+    s1.submit(**warm).result(timeout=300)   # seeds the radix cache
+    hs = [s1.submit(**kw) for kw in submits]
+    t0 = time.monotonic()
+    while not all(len(h._req.outputs) >= 2 for h in hs):
+        assert time.monotonic() - t0 < 120, "never reached the export point"
+        time.sleep(0.01)
+    # both live streams hold adopted radix blocks at the export point
+    assert s1.stats["prefix_cache"]["hits"] >= 1
+    frames = s1.export_journal()        # drains + stops without retiring
+    s1.stop()
+
+    s2 = ServingScheduler(
+        _durable_engine(journal_dir=str(tmp_path / "peer")),
+        idle_wait=0.005, uid_base=2_000_000).start()
+    try:
+        result = s2.import_journal_frames(frames)
+        assert not result.get("refused")
+        outs = [s2.lookup(h.uid).result(timeout=300) for h in hs]
+    finally:
+        s2.stop()
+    assert outs == ref
