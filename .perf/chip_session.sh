@@ -213,6 +213,14 @@ run bench_serving_disagg 1500 env DS_BENCH_DISAGG=1 DS_BENCH_FAST=1 python bench
 # drain, re-admit, re-attach), and two replicas must not fight for the
 # chip the parent already holds.
 run bench_serving_fleet 1200 env DS_BENCH_FLEET=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_FLEET.json
+# 15m-lora. multi-LoRA fused decode: 8 live adapters + base rows mixed
+# into ONE fused-K wave vs the all-base baseline — mixed/base tok/s
+# ratio is the headline, with two hard in-rung asserts: one device
+# dispatch per K window on the mixed arm (slot bank is a traced
+# operand, not a compile key) and ZERO recompiles when a 9th adapter
+# hot-loads after warmup; journaled to BENCH_HISTORY.jsonl and gated
+# by bin/ds_benchdiff
+run bench_serving_lora 1500 env DS_BENCH_LORA=1 DS_BENCH_FAST=1 python bench_serving.py --out BENCH_SERVING_LORA.json
 # 15m. radix prefix cache + multi-tenant scheduling: two tenants (3:1
 # weights), each with a shared system-prompt template, submit
 # template+tail requests through the scheduler with the radix cache OFF

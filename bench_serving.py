@@ -147,6 +147,15 @@ def measure(platform: str, results=None, checkpoint=lambda: None):
         results.extend(_measure_moe(cfg, contexts[0] if on_tpu else 256,
                                     kv_block, backends[0], decode_steps,
                                     batch_sizes[0]))
+    # DS_BENCH_LORA=1: multi-LoRA serving A/B — a base-only decode wave vs
+    # the SAME wave with 8 distinct adapters mixed into it, through the
+    # same fused programs: tok/s ratio (the batched-adapter overhead),
+    # counted dispatches per K window (must stay 1 — mixed waves never
+    # split), and a mid-run hot adapter load asserted to compile NOTHING
+    if env_flag("DS_BENCH_LORA"):
+        results.extend(_measure_lora(cfg, contexts[0] // 4 if on_tpu else 64,
+                                     kv_block, backends[0], decode_steps,
+                                     nseq=8))
     # DS_BENCH_SAMPLED=1: on-device sampled decode — per-token vs fused-K
     # dispatch for a fully non-greedy batch (the subset the fused path
     # newly covers; the delta is the dispatch amortization win)
@@ -1078,6 +1087,122 @@ def _measure_prefix_caching(cfg, ctx, kv_block, backend):
             "accounting_exact": rows[-1].get(
                 "saved_tokens_counter_matches")})
     return rows
+
+
+def _measure_lora(cfg, ctx, kv_block, backend, decode_steps, nseq):
+    """Multi-LoRA fused-wave A/B. Both arms decode the SAME nseq-sequence
+    wave with the same fused-K programs; the B arm pins a different LoRA
+    adapter to every row (8 distinct adapters — the sort-by-slot grouped
+    delta's worst mix). Headline: mixed tok/s / base tok/s (the cost of
+    batched adapters; 1.0 = free), journaled for bin/ds_benchdiff.
+    Guardrails measured, not assumed: dispatches per K window == 1 on the
+    mixed arm (engine dispatch counter), and a mid-run ``load`` +
+    re-pin compiles ZERO new programs (compile-watch delta)."""
+    import tempfile
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (build_llama_engine,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import AdaptersConfig
+    from deepspeed_tpu.inference.v2.adapters import save_adapter
+    from deepspeed_tpu.inference.v2 import engine_v2 as _ev2
+    from deepspeed_tpu.inference.v2.model import _serving_compile_watch
+    from deepspeed_tpu.linear.config import LoRAConfig
+
+    n_adapters, r, K = 8, 4, min(FUSED_K, decode_steps)
+    n_windows = max(2, decode_steps // K)
+    rng = np.random.default_rng(11)
+    eng = build_llama_engine(
+        cfg, engine_config=RaggedInferenceEngineConfig(
+            num_kv_blocks=2 * nseq * (
+                (ctx + decode_steps + K * n_windows) // kv_block + 2),
+            adapters=AdaptersConfig(enabled=True,
+                                    max_live_adapters=n_adapters,
+                                    slot_rank_pad=2 * r)),
+        kv_block_size=kv_block)
+    eng.model().attn_backend = backend
+    L, H, hd = (cfg.num_hidden_layers, cfg.hidden_size, cfg.head_dim_)
+    root = tempfile.mkdtemp(prefix="ds_bench_lora_")
+    scale = 1.0 / np.sqrt(H)
+    for i in range(n_adapters + 1):  # +1: the mid-run hot-load probe
+        save_adapter(
+            os.path.join(root, f"a{i}"),
+            LoRAConfig(lora_r=r, lora_alpha=16.0,
+                       targets=("q_proj", "v_proj")),
+            {t: (rng.standard_normal((L, H, r)) * scale,
+                 rng.standard_normal((L, r, d)) * scale)
+             for t, d in (("q_proj",
+                           cfg.num_attention_heads * hd),
+                          ("v_proj",
+                           cfg.num_key_value_heads * hd))})
+    for i in range(n_adapters):
+        eng.adapters.load(os.path.join(root, f"a{i}"))
+    prompts = [rng.integers(0, cfg.vocab_size, size=ctx).tolist()
+               for _ in range(nseq)]
+
+    def run_arm(uids, mixed):
+        if mixed:
+            for j, uid in enumerate(uids):
+                eng.set_request_adapter(uid, f"a{j % n_adapters}")
+        logits = eng.put(uids, [np.asarray(p, np.int32) for p in prompts])
+        last = [int(t) for t in np.argmax(np.asarray(logits)[:len(uids)],
+                                          axis=-1)]
+        out = eng.fused_decode_steps(uids, last, K)  # warm, untimed
+        last = [int(t) for t in np.asarray(out)[:, -1]]
+        d0 = _ev2._dispatches_total.value
+        t0 = time.perf_counter()
+        for _ in range(n_windows):
+            out = eng.fused_decode_steps(uids, last, K)
+            last = [int(t) for t in np.asarray(out)[:, -1]]
+        wall = time.perf_counter() - t0
+        dispatches = _ev2._dispatches_total.value - d0
+        toks = len(uids) * K * n_windows
+        for uid in uids:
+            eng.flush(uid)
+        return toks / wall, wall, dispatches / n_windows
+
+    base_tok_s, base_wall, base_dpw = run_arm(list(range(100, 100 + nseq)),
+                                              mixed=False)
+    mixed_tok_s, mixed_wall, mixed_dpw = run_arm(
+        list(range(200, 200 + nseq)), mixed=True)
+
+    # hot-load probe: every fused/prefill/writer program is warm — loading
+    # a NEW adapter and decoding one more wave must compile nothing
+    watch = _serving_compile_watch()
+    compiles0 = sum(watch.counts(k)["compiles"] for k in watch._per_key)
+    eng.adapters.load(os.path.join(root, f"a{n_adapters}"))
+    uids = list(range(300, 300 + nseq))
+    for j, uid in enumerate(uids):
+        eng.set_request_adapter(uid, f"a{n_adapters}" if j == 0
+                                else f"a{j % n_adapters}")
+    logits = eng.put(uids, [np.asarray(p, np.int32) for p in prompts])
+    last = [int(t) for t in np.argmax(np.asarray(logits)[:nseq], axis=-1)]
+    eng.fused_decode_steps(uids, last, K)
+    for uid in uids:
+        eng.flush(uid)
+    hot_compiles = sum(watch.counts(k)["compiles"]
+                       for k in watch._per_key) - compiles0
+
+    ratio = round(mixed_tok_s / base_tok_s, 3) if base_tok_s else None
+    row = {"backend": backend, "context": ctx, "batch": nseq,
+           "adapters": n_adapters, "lora_r": r, "fused_K": K,
+           "windows": n_windows,
+           "base_tok_s": round(base_tok_s, 1),
+           "mixed_tok_s": round(mixed_tok_s, 1),
+           "mixed_over_base_tok_s": ratio,
+           "dispatches_per_window_base": base_dpw,
+           "dispatches_per_window_mixed": mixed_dpw,
+           "hot_load_compiles": hot_compiles}
+    from bench import _history_path, _journal_append
+    _journal_append(_history_path(), {
+        "rung": "serving-lora",
+        "metric": "mixed_over_base_tok_s",
+        # 8-adapter mixed wave tok/s / base-only tok/s — closer to 1.0 is
+        # better; a regression means the grouped delta stopped being cheap
+        "value": ratio,
+        "unit": "mixed-adapter tok/s / base tok/s",
+        "dispatches_per_window": mixed_dpw,
+        "hot_load_compiles": hot_compiles})
+    return [row]
 
 
 def _measure_tp():

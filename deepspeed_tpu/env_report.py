@@ -138,6 +138,20 @@ def debug_report():
         nt = len(ecfg.tenants)
         lines.append(f"multi-tenant scheduling {'.' * 25} "
                      f"{f'{nt} tenant(s) configured' if nt else 'single lane (no tenants block)'}")
+        # multi-LoRA serving: whether the default config builds an adapter
+        # registry, the boot-scan dir (DS_ADAPTERS_DIR override), and the
+        # bank geometry hot loads must fit inside
+        ad = ecfg.adapters
+        ad_dir = os.environ.get("DS_ADAPTERS_DIR") or ad.registry_dir
+        if ad.enabled:
+            state = (f"enabled ({ad.max_live_adapters} slots, "
+                     f"rank pad {ad.slot_rank_pad}, "
+                     f"targets {','.join(ad.targets)})")
+        else:
+            state = "disabled (adapters.enabled)"
+        lines.append(f"multi-LoRA adapters {'.' * 29} {state}")
+        lines.append(f"adapter registry dir {'.' * 28} "
+                     f"{ad_dir if ad_dir else 'unset (load via POST /adapters/load)'}")
     except Exception as e:  # pragma: no cover
         lines.append(f"prefix cache {'.' * 36} {NO} ({e})")
     try:

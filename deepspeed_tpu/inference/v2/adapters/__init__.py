@@ -1,0 +1,3 @@
+from .registry import (AdapterRegistry, AdapterSlotsExhausted, save_adapter)
+
+__all__ = ["AdapterRegistry", "AdapterSlotsExhausted", "save_adapter"]

@@ -1,0 +1,463 @@
+"""Multi-LoRA adapter registry for the v2 serving engine.
+
+Beyond the reference (which carries LoRA plumbing for TRAINING in
+``linear/optimized_linear.py`` and ``module_inject``): this is the serving
+side — N adapters ride ONE compiled program instead of N recompiles or N
+replicas.
+
+Design:
+
+- Adapters are loaded from checkpoint dirs (``adapter_config.json`` +
+  ``weights.npz``) and validated through the SAME ``linear.config.LoRAConfig``
+  dataclass the training path uses — one spec, one ``alpha / sqrt(r)``
+  scaling rule.
+- Device residency is a fixed pool of SLOTS: stacked factor banks
+  ``A [n_slots, L, in, r_pad]`` / ``B [n_slots, L, r_pad, out]`` per target
+  kernel plus a ``scale [n_slots]`` vector. Slot 0 is the identity adapter
+  (zero factors, zero scale): base-only rows compute an exactly-zero delta,
+  so their streams stay bit-identical to the adapter-free engine.
+- The bank is a TRACED operand of every fused program: its shapes are fixed
+  by ``max_live_adapters``/``slot_rank_pad`` at construction, so loading,
+  evicting, or hot-swapping adapters only changes VALUES — one jitted
+  donated ``bank.at[slot].set(...)`` per factor, no recompile, no restart.
+- Residency is LRU over UNPINNED slots: every in-flight request pins its
+  adapter's slot (``pin``/``unpin`` keyed by uid), so a live stream's
+  factors can never be evicted mid-decode.
+- Ids are VERSIONED (``name@version``): reloading a name bumps the version,
+  and the serving journal records the resolved versioned id, so durable
+  replay and WAL fleet migration re-resolve the exact factors the original
+  stream decoded with (or fail loudly — never a silent base fallback).
+"""
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ....linear.config import LoRAConfig
+from ....observability import get_registry as _get_obs
+
+_obs = _get_obs()
+_loads_total = _obs.counter(
+    "ds_adapter_loads_total",
+    "Adapters loaded into the registry (boot scan + POST /adapters/load)")
+_evictions_total = _obs.counter(
+    "ds_adapter_evictions_total",
+    "LRU evictions of device-resident adapter slots")
+_live_gauge = _obs.gauge(
+    "ds_adapter_live",
+    "Adapters currently device-resident (occupied slots, identity excluded)")
+
+
+class AdapterSlotsExhausted(RuntimeError):
+    """Every device slot is pinned by an in-flight request — the load/pin
+    must wait for streams to finish (HTTP maps this to 429 + Retry-After,
+    like scheduler overload)."""
+
+
+def _target_dims(model_config, target: str) -> Tuple[int, int]:
+    """(in_dim, out_dim) of one projection kernel under the model config."""
+    cfg = model_config
+    hd = cfg.head_dim_
+    H = cfg.hidden_size
+    dims = {
+        "q_proj": (H, cfg.num_attention_heads * hd),
+        "k_proj": (H, cfg.num_key_value_heads * hd),
+        "v_proj": (H, cfg.num_key_value_heads * hd),
+        "o_proj": (cfg.num_attention_heads * hd, H),
+        "gate_proj": (H, cfg.intermediate_size),
+        "up_proj": (H, cfg.intermediate_size),
+        "down_proj": (cfg.intermediate_size, H),
+    }
+    return dims[target]
+
+
+def save_adapter(path: str, spec: LoRAConfig, factors: Dict[str, tuple],
+                 name: Optional[str] = None,
+                 version: Optional[int] = None) -> str:
+    """Write one adapter checkpoint dir (the registry's load format):
+    ``adapter_config.json`` (the LoRAConfig fields) + ``weights.npz`` with
+    ``{target}.lora_a`` ``[L, in, r]`` / ``{target}.lora_b`` ``[L, r, out]``
+    stacked over layers. Returns ``path``. The writer for tests, benches,
+    and training-side export."""
+    os.makedirs(path, exist_ok=True)
+    spec.validate()
+    cfg = {"lora_r": int(spec.lora_r), "lora_alpha": float(spec.lora_alpha),
+           "lora_dtype": spec.lora_dtype, "targets": list(spec.targets)}
+    if name is not None:
+        cfg["name"] = name
+    if version is not None:
+        cfg["version"] = int(version)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(cfg, f, indent=1)
+    arrs = {}
+    for t, (a, b) in factors.items():
+        arrs[f"{t}.lora_a"] = np.asarray(a)
+        arrs[f"{t}.lora_b"] = np.asarray(b)
+    np.savez(os.path.join(path, "weights.npz"), **arrs)
+    return path
+
+
+class _Record:
+    """One loaded adapter version: host-resident padded factors + spec."""
+
+    __slots__ = ("adapter_id", "name", "version", "spec", "factors", "scale")
+
+    def __init__(self, adapter_id, name, version, spec, factors, scale):
+        self.adapter_id = adapter_id
+        self.name = name
+        self.version = version
+        self.spec = spec
+        self.factors = factors  # target -> (a [L, in, r_pad], b [L, r_pad, out])
+        self.scale = scale
+
+
+class AdapterRegistry:
+    """Load/validate/pin/unpin LoRA adapters and keep an LRU of
+    device-resident slots backing the fused programs' stacked factor bank.
+
+    Thread-safe: the scheduler's submit path (pin), finish path (unpin),
+    and the admin endpoints (load/unload) run on different threads.
+    """
+
+    def __init__(self, config, model):
+        self._config = config
+        self._model = model
+        mcfg = model.config
+        self._L = int(mcfg.num_hidden_layers)
+        self._r_pad = int(config.slot_rank_pad)
+        self._n_slots = int(config.max_live_adapters) + 1  # + identity slot 0
+        self._targets = tuple(config.targets)
+        moe_mlp = ({"gate_proj", "up_proj", "down_proj"} & set(self._targets)
+                   if getattr(mcfg, "num_local_experts", 0) else set())
+        if moe_mlp:
+            # the LoRA hooks only ride the DENSE MLP path; silently serving
+            # a config that never applies its MLP deltas would be a wrong
+            # answer, not a degraded one
+            raise ValueError(
+                f"adapters.targets {sorted(moe_mlp)} are MLP projections but "
+                "the model is MoE (num_local_experts > 0) — expert MLPs have "
+                "no LoRA hook; restrict targets to attention projections")
+        self._lock = threading.RLock()
+        self._records: Dict[str, _Record] = {}   # adapter_id -> record
+        self._latest: Dict[str, str] = {}        # name -> latest adapter_id
+        self._versions: Dict[str, int] = {}      # name -> last version number
+        self._slot_of: Dict[str, int] = {}       # adapter_id -> live slot
+        self._id_at: Dict[int, str] = {}         # slot -> adapter_id
+        self._pins: Dict[int, int] = {}          # slot -> pin count
+        self._uid_slot: Dict[int, int] = {}      # uid -> pinned slot
+        self._uid_id: Dict[int, str] = {}        # uid -> adapter_id
+        self._clock = 0                          # LRU timestamps
+        self._last_used: Dict[int, int] = {}     # slot -> clock
+        self._loads = 0
+        self._evictions = 0
+
+        import jax
+        import jax.numpy as jnp
+        dtype = model.dtype
+        mesh_ctx = getattr(model, "_mesh_ctx", None)
+        factors = {}
+        self._writers = {}
+
+        def _writer(sharding=None):
+            kw = {"out_shardings": sharding} if sharding is not None else {}
+            return jax.jit(lambda leaf, val, slot: leaf.at[slot].set(val),
+                           donate_argnums=(0,), **kw)
+
+        for t in self._targets:
+            di, do = _target_dims(mcfg, t)
+            a = jnp.zeros((self._n_slots, self._L, di, self._r_pad), dtype)
+            b = jnp.zeros((self._n_slots, self._L, self._r_pad, do), dtype)
+            sh_a = sh_b = None
+            if mesh_ctx is not None:
+                # TP: factor shards follow the base kernel's AutoTP dims
+                # (parallel/tp.lora_factor_specs) so the grouped delta's
+                # activations line up with the sharded base matmul
+                from jax.sharding import NamedSharding
+                from ....parallel.tp import lora_factor_specs
+                spec_a, spec_b = lora_factor_specs(
+                    t, a.shape, b.shape, model.tp_size)
+                sh_a = NamedSharding(mesh_ctx.mesh, spec_a)
+                sh_b = NamedSharding(mesh_ctx.mesh, spec_b)
+                a = jax.device_put(a, sh_a)
+                b = jax.device_put(b, sh_b)
+            factors[t] = (a, b)
+            self._writers[(t, "a")] = _writer(sh_a)
+            self._writers[(t, "b")] = _writer(sh_b)
+        scale = jnp.zeros((self._n_slots,), jnp.float32)
+        sh_s = None
+        if mesh_ctx is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh_s = NamedSharding(mesh_ctx.mesh, P())
+            scale = jax.device_put(scale, sh_s)
+        self._writers["scale"] = _writer(sh_s)
+        self.bank = {"factors": factors, "scale": scale}
+        # warm the slot-write programs against the identity slot (writing
+        # zeros to slot 0 is a no-op by value), so the first live
+        # POST /adapters/load compiles nothing
+        self._device_write(0, {}, 0.0)
+        if config.registry_dir:
+            self.scan_dir(config.registry_dir)
+
+    # ---- loading / unloading ----
+
+    @property
+    def n_slots(self) -> int:
+        return self._n_slots
+
+    @property
+    def rank_pad(self) -> int:
+        return self._r_pad
+
+    @property
+    def targets(self) -> Tuple[str, ...]:
+        return self._targets
+
+    def scan_dir(self, root: str) -> list:
+        """Boot scan: each subdirectory with an ``adapter_config.json`` is
+        one adapter (name defaults to the subdirectory name). Bad entries
+        log and skip — one broken checkpoint must not kill the daemon."""
+        from ....utils.logging import logger
+        loaded = []
+        if not os.path.isdir(root):
+            return loaded
+        for entry in sorted(os.listdir(root)):
+            d = os.path.join(root, entry)
+            if not os.path.isfile(os.path.join(d, "adapter_config.json")):
+                continue
+            try:
+                loaded.append(self.load(d, name=entry))
+            except Exception as e:  # noqa: BLE001 — boot must survive
+                logger.warning(f"adapter scan: skipping {d}: {e}")
+        return loaded
+
+    def load(self, path: str, name: Optional[str] = None) -> str:
+        """Load + validate one adapter checkpoint dir; returns the
+        VERSIONED adapter id (``name@version``). Validation failures raise
+        ValueError with an actionable message (the HTTP layer maps them to
+        structured 400s). Loading an explicit (name, version) pair that is
+        already registered is idempotent."""
+        cfg_path = os.path.join(path, "adapter_config.json")
+        if not os.path.isfile(cfg_path):
+            raise ValueError(f"no adapter_config.json under {path!r}")
+        with open(cfg_path) as f:
+            raw = json.load(f)
+        name = name or raw.get("name") or os.path.basename(
+            os.path.normpath(path))
+        spec = LoRAConfig(
+            lora_r=int(raw.get("lora_r", 0) or 0),
+            lora_alpha=float(raw.get("lora_alpha", 0.0)),
+            lora_dtype=raw.get("lora_dtype", "bfloat16"),
+            targets=tuple(raw.get("targets") or ()))
+        if spec.lora_r > self._r_pad:
+            raise ValueError(
+                f"adapter {name!r}: lora_r={spec.lora_r} exceeds the bank's "
+                f"slot_rank_pad={self._r_pad} — raise adapters.slot_rank_pad")
+        extra = set(spec.targets) - set(self._targets)
+        if extra:
+            raise ValueError(
+                f"adapter {name!r} targets {sorted(extra)} outside the "
+                f"configured bank targets {list(self._targets)} — serving it "
+                f"would silently drop trained factors")
+        wpath = os.path.join(path, "weights.npz")
+        if not os.path.isfile(wpath):
+            raise ValueError(f"no weights.npz under {path!r}")
+        factors = {}
+        with np.load(wpath) as z:
+            for t in spec.targets:
+                ka, kb = f"{t}.lora_a", f"{t}.lora_b"
+                if ka not in z.files or kb not in z.files:
+                    raise ValueError(
+                        f"adapter {name!r}: weights.npz missing {ka}/{kb}")
+                a, b = np.asarray(z[ka]), np.asarray(z[kb])
+                di, do = _target_dims(self._model.config, t)
+                r = spec.lora_r
+                if a.shape != (self._L, di, r) or b.shape != (self._L, r, do):
+                    raise ValueError(
+                        f"adapter {name!r} target {t}: factor shapes "
+                        f"{a.shape}/{b.shape} do not match model dims "
+                        f"[{self._L}, {di}, {r}] / [{self._L}, {r}, {do}]")
+                pa = np.zeros((self._L, di, self._r_pad), np.float32)
+                pb = np.zeros((self._L, self._r_pad, do), np.float32)
+                pa[:, :, :r] = a  # zero rank padding is mathematically inert
+                pb[:, :r, :] = b
+                factors[t] = (pa, pb)
+        with self._lock:
+            want = raw.get("version")
+            if want is not None:
+                aid = f"{name}@{int(want)}"
+                if aid in self._records:
+                    return aid  # idempotent re-load of a pinned version
+                version = int(want)
+                self._versions[name] = max(self._versions.get(name, 0),
+                                           version)
+            else:
+                version = self._versions.get(name, 0) + 1
+                self._versions[name] = version
+                aid = f"{name}@{version}"
+            self._records[aid] = _Record(aid, name, version, spec, factors,
+                                         spec.scaling)
+            self._latest[name] = aid
+            self._loads += 1
+        _loads_total.inc()
+        return aid
+
+    def unload(self, name_or_id: str) -> str:
+        """Drop one adapter version from the registry (and its device slot,
+        when resident). Refuses while in-flight requests pin it — a live
+        stream's factors never vanish out from under it."""
+        with self._lock:
+            aid = self.resolve(name_or_id)
+            slot = self._slot_of.get(aid)
+            if slot is not None and self._pins.get(slot, 0) > 0:
+                raise ValueError(
+                    f"adapter {aid!r} is pinned by "
+                    f"{self._pins[slot]} in-flight request(s)")
+            if slot is not None:
+                self._release_slot(slot)
+            rec = self._records.pop(aid)
+            if self._latest.get(rec.name) == aid:
+                prev = [r for r in self._records.values()
+                        if r.name == rec.name]
+                if prev:
+                    self._latest[rec.name] = max(
+                        prev, key=lambda r: r.version).adapter_id
+                else:
+                    del self._latest[rec.name]
+            return aid
+
+    def resolve(self, name_or_id: str) -> str:
+        """Resolve a user-facing name (latest version) or an exact
+        ``name@version`` id to the versioned id. KeyError when unknown —
+        the submit path maps this to a structured 400, never a silent
+        base-weight fallback."""
+        with self._lock:
+            if name_or_id in self._records:
+                return name_or_id
+            aid = self._latest.get(name_or_id)
+            if aid is None:
+                raise KeyError(f"unknown adapter {name_or_id!r}")
+            return aid
+
+    # ---- device residency (slots) ----
+
+    def _release_slot(self, slot: int) -> None:
+        aid = self._id_at.pop(slot, None)
+        if aid is not None:
+            self._slot_of.pop(aid, None)
+        self._pins.pop(slot, None)
+        self._last_used.pop(slot, None)
+        # hygiene: a freed slot's scale drops to 0 so even a stale slot
+        # index (a bug) yields a zero delta, not another tenant's adapter
+        self._device_write(slot, None, 0.0)
+        _live_gauge.set(len(self._id_at))
+
+    def _device_write(self, slot: int, factors, scale: float) -> None:
+        """Write one slot of the stacked bank in place (jitted donated
+        updates — slot index traced, so every hot swap reuses the same
+        compiled programs). ``factors=None`` writes only the scale;
+        ``factors={}`` zero-fills every target (the identity write)."""
+        import jax.numpy as jnp
+        bank = self.bank
+        dtype = self._model.dtype
+        new_factors = dict(bank["factors"])
+        if factors is not None:
+            for t, (a, b) in bank["factors"].items():
+                fa, fb = factors.get(t, (None, None))
+                va = (jnp.asarray(fa, dtype) if fa is not None
+                      else jnp.zeros(a.shape[1:], dtype))
+                vb = (jnp.asarray(fb, dtype) if fb is not None
+                      else jnp.zeros(b.shape[1:], dtype))
+                a = self._writers[(t, "a")](a, va, jnp.int32(slot))
+                b = self._writers[(t, "b")](b, vb, jnp.int32(slot))
+                new_factors[t] = (a, b)
+        new_scale = self._writers["scale"](
+            bank["scale"], jnp.float32(scale), jnp.int32(slot))
+        self.bank = {"factors": new_factors, "scale": new_scale}
+
+    def _acquire_slot(self, aid: str) -> int:
+        """Make ``aid`` device-resident and return its slot (caller holds
+        the lock). Prefers a free slot; else LRU-evicts an unpinned one;
+        raises :class:`AdapterSlotsExhausted` when every slot is pinned."""
+        slot = self._slot_of.get(aid)
+        if slot is not None:
+            return slot
+        free = [s for s in range(1, self._n_slots) if s not in self._id_at]
+        if free:
+            slot = free[0]
+        else:
+            unpinned = [s for s in self._id_at
+                        if self._pins.get(s, 0) == 0]
+            if not unpinned:
+                raise AdapterSlotsExhausted(
+                    f"all {self._n_slots - 1} adapter slots are pinned by "
+                    "in-flight requests")
+            slot = min(unpinned, key=lambda s: self._last_used.get(s, 0))
+            evicted = self._id_at.pop(slot)
+            self._slot_of.pop(evicted, None)
+            self._evictions += 1
+            _evictions_total.inc()
+        rec = self._records[aid]
+        self._device_write(slot, rec.factors, rec.scale)
+        self._slot_of[aid] = slot
+        self._id_at[slot] = aid
+        _live_gauge.set(len(self._id_at))
+        return slot
+
+    def pin(self, uid: int, name_or_id: str) -> int:
+        """Resolve + pin one request's adapter for its lifetime; returns
+        the device slot its rows carry. Raises KeyError (unknown id) or
+        AdapterSlotsExhausted (every slot pinned)."""
+        with self._lock:
+            aid = self.resolve(name_or_id)
+            if uid in self._uid_slot:
+                if self._uid_id.get(uid) == aid:
+                    return self._uid_slot[uid]
+                self._unpin_locked(uid)
+            slot = self._acquire_slot(aid)
+            self._pins[slot] = self._pins.get(slot, 0) + 1
+            self._clock += 1
+            self._last_used[slot] = self._clock
+            self._uid_slot[uid] = slot
+            self._uid_id[uid] = aid
+            return slot
+
+    def _unpin_locked(self, uid: int) -> None:
+        slot = self._uid_slot.pop(uid, None)
+        self._uid_id.pop(uid, None)
+        if slot is not None and slot in self._pins:
+            self._pins[slot] = max(0, self._pins[slot] - 1)
+
+    def unpin(self, uid: int) -> None:
+        """Release a finished request's pin (no-op for unknown uids, so
+        every finish path can call it unconditionally)."""
+        with self._lock:
+            self._unpin_locked(uid)
+
+    def slot_for_uid(self, uid: int) -> int:
+        """The slot a pinned request's rows decode with (0 = identity)."""
+        with self._lock:
+            return self._uid_slot.get(uid, 0)
+
+    def adapter_for_uid(self, uid: int) -> Optional[str]:
+        with self._lock:
+            return self._uid_id.get(uid)
+
+    # ---- reporting ----
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "registered": sorted(self._records),
+                "live": {aid: s for aid, s in sorted(self._slot_of.items())},
+                "pinned": {self._id_at[s]: n for s, n in self._pins.items()
+                           if n > 0 and s in self._id_at},
+                "max_live_adapters": self._n_slots - 1,
+                "slot_rank_pad": self._r_pad,
+                "targets": list(self._targets),
+                "registry_dir": self._config.registry_dir,
+                "loads": self._loads,
+                "evictions": self._evictions,
+            }

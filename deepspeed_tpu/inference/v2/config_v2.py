@@ -418,6 +418,65 @@ class TensorParallelConfig(ConfigModel):
         return self
 
 
+class AdaptersConfig(ConfigModel):
+    """Multi-LoRA adapter serving (beyond the reference — the serving-side
+    use of ``linear/config.LoRAConfig``): hot-swappable adapters batched
+    into ONE fused decode wave. The registry keeps up to
+    ``max_live_adapters`` adapters device-resident as slots of a stacked
+    factor bank; every request row carries a slot index and the fused
+    programs apply ``y += B[slot] @ (A[slot] @ x) * scale`` via the
+    sort-by-slot grouped matmul, so a mixed-adapter wave stays one
+    dispatch per K window and the compile key never depends on WHICH
+    adapters are live — ``POST /adapters/load`` writes factor values into
+    a pre-shaped bank (no recompile, no restart)."""
+
+    enabled: bool = False
+    """Master gate. False builds no registry and leaves every traced
+    program byte-identical to the adapter-free engine."""
+
+    registry_dir: Optional[str] = None
+    """Directory scanned at boot: each subdirectory holding an
+    ``adapter_config.json`` + ``weights.npz`` pair registers as one
+    adapter (name = subdirectory name). ``POST /adapters/load`` can add
+    more at runtime from any path under this root."""
+
+    max_live_adapters: int = 8
+    """Device-resident adapter slots (slot 0 is the always-present
+    identity adapter and does not count). Loading past the cap evicts the
+    least-recently-used UNPINNED slot; slots pinned by in-flight requests
+    never evict."""
+
+    slot_rank_pad: int = 16
+    """Every slot's factors are zero-padded to this rank, so adapters of
+    different true ranks share one bank shape (zero rank columns are
+    mathematically inert). Adapters with ``lora_r`` above this are
+    refused at load."""
+
+    targets: Tuple[str, ...] = ("q_proj", "v_proj")
+    """Projection kernels the bank covers (``linear.config.LORA_TARGETS``
+    subset). An adapter may cover a subset of these; targets it omits get
+    zero factors. Adapters targeting kernels OUTSIDE this set are refused
+    at load — silently dropping a trained factor would serve wrong
+    weights."""
+
+    @model_validator(mode="after")
+    def _check(self):
+        from ...linear.config import LORA_TARGETS
+        if self.max_live_adapters < 1:
+            raise ValueError("max_live_adapters must be >= 1, got "
+                             f"{self.max_live_adapters}")
+        if self.slot_rank_pad < 1:
+            raise ValueError("slot_rank_pad must be >= 1, got "
+                             f"{self.slot_rank_pad}")
+        if not self.targets:
+            raise ValueError("adapters.targets must name at least one kernel")
+        for t in self.targets:
+            if t not in LORA_TARGETS:
+                raise ValueError(f"unknown adapter target {t!r}; expected a "
+                                 f"subset of {LORA_TARGETS}")
+        return self
+
+
 class TenantConfig(ConfigModel):
     """One tenant's scheduling contract (beyond the reference — the
     multi-tenant scenario layer). Tenants are soft-isolated: admission and
@@ -441,6 +500,12 @@ class TenantConfig(ConfigModel):
     max_queued: int = 0
     """Per-tenant admission queue cap (sheds with 429 like the global
     ``serving_resilience.max_queued``); 0 = only the global cap applies."""
+
+    default_adapter: Optional[str] = None
+    """LoRA adapter applied to this tenant's requests that carry no
+    explicit ``adapter`` field (resolved against the adapter registry at
+    submit; unknown names fail the submit with a structured 400, never a
+    silent fallback to base weights). None = base model."""
 
     @model_validator(mode="after")
     def _check(self):
@@ -483,3 +548,7 @@ class RaggedInferenceEngineConfig(ConfigModel):
     # entry if present, else TenantConfig() (weight 1, no caps) — an empty
     # dict keeps the scheduler exactly single-tenant.
     tenants: Dict[str, TenantConfig] = Field(default_factory=dict)
+
+    # Multi-LoRA adapter serving: hot-swappable adapters batched into one
+    # fused decode wave (inference/v2/adapters).
+    adapters: AdaptersConfig = Field(default_factory=AdaptersConfig)

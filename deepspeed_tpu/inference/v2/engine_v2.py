@@ -179,6 +179,38 @@ class InferenceEngineV2:
         # flush). Kept as host uint32[2] rows; each dispatch carries the
         # batch's keys in and the advanced keys out.
         self._sample_keys = {}
+        # Multi-LoRA: the adapter registry attached to the model (None =
+        # adapter-free engine). Slot assignment is per-uid and lives in the
+        # registry's pin table; KV and scheduling accounting never see it.
+        self._adapters = getattr(model, "_adapters", None)
+
+    # ---- multi-LoRA (inference/v2/adapters) ----
+
+    @property
+    def adapters(self):
+        """The attached :class:`AdapterRegistry`, or None."""
+        return self._adapters
+
+    def set_request_adapter(self, uid: int, name_or_id: str) -> int:
+        """Pin ``uid`` to an adapter for its lifetime (resolve + device
+        slot + pin; released by :meth:`flush`). Returns the slot. Raises
+        KeyError (unknown adapter) or AdapterSlotsExhausted."""
+        if self._adapters is None:
+            raise RuntimeError("engine built without an adapter registry "
+                               "(adapters.enabled is off)")
+        return self._adapters.pin(uid, name_or_id)
+
+    def _adapter_slot_rows(self, batch_uids, n_rows: int):
+        """Bucketed per-row slot array for one dispatch (None when the
+        engine is adapter-free — the model then omits the bank operand).
+        Padding rows carry slot 0: the identity adapter's zero factors
+        make them an exact no-op."""
+        if self._adapters is None:
+            return None
+        slots = np.zeros(n_rows, np.int32)
+        for i, u in enumerate(batch_uids):
+            slots[i] = self._adapters.slot_for_uid(u)
+        return slots
 
     # ---- properties (reference engine_v2.py:47-66) ----
 
@@ -300,7 +332,10 @@ class InferenceEngineV2:
             total_slots=self._state_manager.kv_cache.num_blocks *
             self._state_manager.kv_cache.block_size)
         t0 = time.monotonic()
-        logits = self._model.forward(batch, window_logits=window_logits)
+        logits = self._model.forward(
+            batch, window_logits=window_logits,
+            adapter_slots=self._adapter_slot_rows(
+                batch_uids, batch.q_tok_idx.shape[0]))
         _put_seconds.record(time.monotonic() - t0)
 
         for uid in batch_uids:
@@ -975,10 +1010,12 @@ class InferenceEngineV2:
             seq_lens[i] = seq.seen_tokens
             liv[i] = 1
             block_table[i] = seq.block_table(B)
+        aslots = self._adapter_slot_rows(batch_uids, S)
         lps = new_keys = None
         if specs is None:
             out = self._model.fused_decode(tokens, seq_lens, liv, block_table,
-                                           n_steps, fetch=False)  # [K, S]
+                                           n_steps, fetch=False,
+                                           adapter_slots=aslots)  # [K, S]
         else:
             V = int(self._model.config.vocab_size)
             use_pen, use_eos, want_lp = self._spec_statics(specs)
@@ -996,7 +1033,7 @@ class InferenceEngineV2:
                               n_out=n_out, min_new=min_new, seen_mask=mask,
                               want_logprobs=want_lp, use_penalty=use_pen,
                               use_eos_mask=use_eos),
-                fetch=False)
+                fetch=False, adapter_slots=aslots)
         for seq in seqs:
             seq.pre_forward(n_steps)
             seq.post_forward()
@@ -1159,7 +1196,8 @@ class InferenceEngineV2:
                             top_ps=top_ps)
         out, n_emit, dlen, new_keys = self._model.fused_spec_decode(
             tokens, seq_lens, liv, block_table, hist, hist_len, ngrams,
-            max_d, n_steps, d, max_ngram, sampling=sampling, fetch=False)
+            max_d, n_steps, d, max_ngram, sampling=sampling, fetch=False,
+            adapter_slots=self._adapter_slot_rows(batch_uids, S))
         _dispatch_seconds.record(time.monotonic() - t0)
         _dispatches_total.inc()
         return _InFlightSpecWave(uids=batch_uids, seqs=seqs, tokens=tokens,
@@ -1769,6 +1807,8 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         self._state_manager.flush_sequence(uid)
         self._sample_keys.pop(uid, None)
+        if self._adapters is not None:
+            self._adapters.unpin(uid)
 
     def serialize(self, save_path: str) -> None:
         """Flat param snapshot (reference :251 → flat_model_helpers)."""
@@ -1837,4 +1877,11 @@ def build_llama_engine(config: Optional[LlamaConfig] = None,
                              tp_wire_overrides=tp_cfg.tp_wire_overrides,
                              tp_wire_block=tp_cfg.tp_wire_block,
                              devices=devices)
+    if engine_config.adapters.enabled:
+        # attach BEFORE the engine warms up: the bank operand is part of
+        # every traced program's signature, so it must exist before the
+        # first dispatch (hot loads after that are pure value writes)
+        from .adapters import AdapterRegistry
+        model.set_adapter_registry(AdapterRegistry(engine_config.adapters,
+                                                   model))
     return InferenceEngineV2(model, engine_config)

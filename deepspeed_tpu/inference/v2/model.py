@@ -215,18 +215,22 @@ def _norm_tok(x, p, cfg):
     return rms_norm(x, w, cfg.rms_norm_eps)
 
 
-def _mlp_tok(x, lp, cfg, row_out=None):
+def _mlp_tok(x, lp, cfg, row_out=None, lora_add=None, layer=0):
     """Dense MLP variants (token-major): swiglu | gelu_fc | relu_fc.
     ``row_out(y, kernel, cls)`` routes the row-parallel down-projection —
-    the TP wire hook; None = the plain matmul."""
+    the TP wire hook; None = the plain matmul. ``lora_add(y, name, inp,
+    layer)`` is the multi-LoRA delta hook on gate/up/down projections;
+    None = base weights only."""
     mm = row_out or (lambda y, k, cls: y @ k)
+    la = lora_add or (lambda y, name, inp, layer: y)
     mlp = lp["mlp"]
     if cfg.mlp_type in ("swiglu", "geglu_tanh"):
-        pre = x @ _kernel(mlp["gate_proj"])
+        pre = la(x @ _kernel(mlp["gate_proj"]), "gate_proj", x, layer)
         gate = (jax.nn.silu(pre) if cfg.mlp_type == "swiglu"
                 else jax.nn.gelu(pre, approximate=True))
-        return mm(gate * (x @ _kernel(mlp["up_proj"])),
-                  _kernel(mlp["down_proj"]), "mlp_out")
+        inner = gate * la(x @ _kernel(mlp["up_proj"]), "up_proj", x, layer)
+        return la(mm(inner, _kernel(mlp["down_proj"]), "mlp_out"),
+                  "down_proj", inner, layer)
     act = {"gelu_fc": lambda y: jax.nn.gelu(y, approximate=False),
            "gelu_tanh_fc": lambda y: jax.nn.gelu(y, approximate=True),
            "relu_fc": jax.nn.relu}[cfg.mlp_type]
@@ -469,6 +473,33 @@ class RaggedLlamaModel:
         self._state_manager = None
         self._fwd_cache = {}  # bucket key -> compiled fn
         self._last_dispatch_fn = None  # WatchedJit behind the latest dispatch
+        # Multi-LoRA: when an AdapterRegistry is attached, its stacked
+        # factor bank rides every dispatch as a TRACED operand (shapes
+        # fixed at registry construction), so hot adapter loads never
+        # change a compile key
+        self._adapters = None
+
+    def set_adapter_registry(self, registry) -> None:
+        """Attach the multi-LoRA adapter registry. Must happen before the
+        first dispatch: the bank operand is part of every traced program's
+        call signature, and attaching later would recompile the world."""
+        if self._fwd_cache:
+            raise RuntimeError("set_adapter_registry must precede the first "
+                               "dispatch (the compiled programs' signatures "
+                               "are fixed at trace time)")
+        self._adapters = registry
+
+    def _adapter_args(self, n_rows: int, adapter_slots):
+        """(bank, per-seq slots [n_rows]) operand pair, or (None, None)
+        when no registry is attached. ``adapter_slots=None`` with a
+        registry means an all-identity wave (slot 0 everywhere)."""
+        if self._adapters is None:
+            return None, None
+        if adapter_slots is None:
+            slots = jnp.zeros(n_rows, jnp.int32)
+        else:
+            slots = jnp.asarray(adapter_slots, jnp.int32)
+        return self._adapters.bank, slots
 
     # ---- state-manager plumbing (reference inference_model_base) ----
 
@@ -600,10 +631,12 @@ class RaggedLlamaModel:
 
     # ---- forward ----
 
-    def forward(self, batch: RaggedBatch, window_logits: bool = False) -> jax.Array:
+    def forward(self, batch: RaggedBatch, window_logits: bool = False,
+                adapter_slots=None) -> jax.Array:
         """``window_logits``: return [S, N, vocab] logits for every fed
         token (the speculative verifier's one-pass need) instead of the
-        final-token [S, vocab] gather."""
+        final-token [S, vocab] gather. ``adapter_slots``: per-SEQUENCE
+        adapter slot ids [S] (multi-LoRA); None = identity everywhere."""
         kv = self._state_manager.kv_cache
         key = (batch.bucket_key, window_logits)
         fn = self._fwd_cache.get(key)
@@ -629,7 +662,12 @@ class RaggedLlamaModel:
             fn = _serving_compile_watch().wrap(fn, _compile_key_str(key))
             self._fwd_cache[key] = fn
         self._last_dispatch_fn = fn
-        logits, new_cache = fn(self.params, kv.cache, batch)
+        bank, slots = self._adapter_args(batch.q_tok_idx.shape[0],
+                                         adapter_slots)
+        if bank is not None:
+            logits, new_cache = fn(self.params, kv.cache, batch, bank, slots)
+        else:
+            logits, new_cache = fn(self.params, kv.cache, batch)
         kv.update(new_cache)
         self._bump_wire_counters(batch.tokens.shape[0])
         return logits
@@ -666,7 +704,8 @@ class RaggedLlamaModel:
         kv.update(fn(kv.cache, jnp.int32(src_block), jnp.int32(dst_block)))
 
     def fused_decode(self, tokens, seq_lens, live, block_table, n_steps: int,
-                     sampling: Optional[dict] = None, fetch: bool = True):
+                     sampling: Optional[dict] = None, fetch: bool = True,
+                     adapter_slots=None):
         """``n_steps`` decode steps in ONE XLA program (lax.scan over the
         single-token ragged forward). The TPU-native answer to the
         reference v1 engine's CUDA-graph decode capture
@@ -743,8 +782,11 @@ class RaggedLlamaModel:
         args = (self.params, kv.cache, jnp.asarray(tokens),
                 jnp.asarray(seq_lens), jnp.asarray(live),
                 jnp.asarray(block_table))
+        bank, slots = self._adapter_args(S, adapter_slots)
+        akw = ({} if bank is None
+               else {"adapter_bank": bank, "adapter_slots": slots})
         if sampling is None:
-            out, new_cache = fn(*args)
+            out, new_cache = fn(*args, **akw)
             kv.update(new_cache)
             self._bump_wire_counters(S * n_steps)
             if not fetch:
@@ -753,7 +795,7 @@ class RaggedLlamaModel:
         sargs = {k: (jnp.asarray(v) if v is not None else None)
                  for k, v in sampling.items()
                  if k not in ("want_logprobs", "use_penalty", "use_eos_mask")}
-        out, lps, new_keys, new_cache = fn(*args, **sargs)
+        out, lps, new_keys, new_cache = fn(*args, **sargs, **akw)
         kv.update(new_cache)
         self._bump_wire_counters(S * n_steps)
         if not fetch:
@@ -765,7 +807,7 @@ class RaggedLlamaModel:
                           hist_len, ngrams, max_drafts, n_steps: int,
                           draft_width: int, max_ngram: int,
                           sampling: Optional[dict] = None,
-                          fetch: bool = True):
+                          fetch: bool = True, adapter_slots=None):
         """``n_steps`` speculative draft/verify windows in ONE XLA program
         — the speculative sibling of ``fused_decode``. Each scan iteration
         drafts up to ``draft_width`` tokens per row from a carried
@@ -834,8 +876,11 @@ class RaggedLlamaModel:
                 jnp.asarray(block_table), jnp.asarray(hist),
                 jnp.asarray(hist_len), jnp.asarray(ngrams),
                 jnp.asarray(max_drafts))
+        bank, slots = self._adapter_args(S, adapter_slots)
+        akw = ({} if bank is None
+               else {"adapter_bank": bank, "adapter_slots": slots})
         if sampling is None:
-            out, n_emit, dlen, new_cache = fn(*args)
+            out, n_emit, dlen, new_cache = fn(*args, **akw)
             kv.update(new_cache)
             self._bump_wire_counters(S * (1 + draft_width) * n_steps)
             if not fetch:
@@ -843,7 +888,7 @@ class RaggedLlamaModel:
             out, n_emit, dlen = jax.device_get((out, n_emit, dlen))
             return np.asarray(out), np.asarray(n_emit), np.asarray(dlen), None
         sargs = {k: jnp.asarray(v) for k, v in sampling.items()}
-        out, n_emit, dlen, new_keys, new_cache = fn(*args, **sargs)
+        out, n_emit, dlen, new_keys, new_cache = fn(*args, **sargs, **akw)
         kv.update(new_cache)
         self._bump_wire_counters(S * (1 + draft_width) * n_steps)
         if not fetch:
@@ -867,12 +912,21 @@ class RaggedLlamaModel:
             return 0.0
 
 
-def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
+def _ragged_forward(params, cache, batch: RaggedBatch, adapter_bank=None,
+                    adapter_slots=None, *, config: LlamaConfig,
                     block_size: int, attn_backend: str = "dense",
                     tp_size: int = 1, kv_pad: int = 0, mesh=None,
                     tp_wire=None, wire_block: int = 256,
                     window_logits: bool = False):
-    """One ragged step: embed → L×(paged attn + mlp) → final-token logits."""
+    """One ragged step: embed → L×(paged attn + mlp) → final-token logits.
+
+    ``adapter_bank`` (multi-LoRA, traced): ``{"factors": {target: (A
+    [n_slots, L, in, r], B [n_slots, L, r, out])}, "scale": [n_slots]}``
+    plus ``adapter_slots`` [S] per-sequence slot ids. Each targeted
+    projection gains ``y += B[slot] @ (A[slot] @ x) * scale`` via ONE pair
+    of grouped GEMMs over the slot-sorted token wave — the sort is hoisted
+    here and shared by every layer/target. Slot 0 holds zero factors, so
+    identity rows add an exact 0.0 and base streams stay bit-identical."""
     cfg = config
     T = batch.tokens.shape[0]
     S, B = batch.block_table.shape
@@ -938,6 +992,31 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
             return _tp_wire_matmul(y, kern, mesh, wire_block)
         return y @ kern
 
+    # multi-LoRA: hoist the slot sort ONCE per forward (it depends only on
+    # the wave's slot assignment), then each targeted projection pays two
+    # rank-r grouped GEMMs regardless of how many adapters are live
+    lora = None
+    if adapter_bank is not None:
+        from ...ops.grouped_matmul import lora_grouped_delta, lora_sort_slots
+        slots_tok = adapter_slots[batch.token_seq]  # [T] per-token slot
+        n_slots = adapter_bank["scale"].shape[0]
+        l_order, l_gsz = lora_sort_slots(slots_tok, n_slots)
+        l_scale = adapter_bank["scale"][slots_tok][l_order]
+
+        def lora(name, inp, layer):
+            ab = adapter_bank["factors"].get(name)
+            if ab is None:
+                return None
+            a, b = ab
+            return lora_grouped_delta(inp, a[:, layer], b[:, layer],
+                                      l_scale, l_order, l_gsz)
+
+    def _lora_add(y, name, inp, layer):
+        if lora is None:
+            return y
+        d = lora(name, inp, layer)
+        return y if d is None else y + d.astype(y.dtype)
+
     for l in range(cfg.num_hidden_layers):
         lp = p[f"layers_{l}"]
         # post_norm (OLMo2): the raw stream feeds the sublayers, norms land
@@ -946,6 +1025,7 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 
         def proj(name, heads, norm=None):
             y = h @ _kernel(lp["self_attn"][name])
+            y = _lora_add(y, name, h, l)
             if "bias" in lp["self_attn"][name]:  # qwen2/OPT/Phi biases
                 y = y + lp["self_attn"][name]["bias"]
             if cfg.clip_qkv is not None:  # OLMo clamp — BEFORE qk-norm,
@@ -1105,13 +1185,14 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
         ctx_tok = ctx[batch.token_seq, jnp.clip(rel, 0, N - 1)]  # [T, H*D]
         attn_out = _row_out(ctx_tok, _kernel(lp["self_attn"]["o_proj"]),
                             "attn_out")
+        attn_out = _lora_add(attn_out, "o_proj", ctx_tok, l)
         if "bias" in lp["self_attn"]["o_proj"]:
             attn_out = attn_out + lp["self_attn"]["o_proj"]["bias"]
 
         def _ffn(h_in):
             """Dense MLP or Mixtral-style MoE block (matches models/llama.py)."""
             if cfg.num_local_experts == 0:
-                return _mlp_tok(h_in, lp, cfg, _row_out)
+                return _mlp_tok(h_in, lp, cfg, _row_out, _lora_add, l)
             moe = lp["block_sparse_moe"]
             logits = h_in.astype(jnp.float32) @ moe["gate"]["kernel"].astype(jnp.float32)
             probs = jax.nn.softmax(logits, axis=-1)
@@ -1177,7 +1258,8 @@ def _ragged_forward(params, cache, batch: RaggedBatch, *, config: LlamaConfig,
 def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
                        keys=None, temps=None, top_ks=None, top_ps=None,
                        penalties=None, eos_ids=None, n_out=None, min_new=None,
-                       seen_mask=None, *,
+                       seen_mask=None, adapter_bank=None, adapter_slots=None,
+                       *,
                        config, block_size, attn_backend, tp_size, kv_pad,
                        total_slots, n_steps, mesh, tp_wire=None,
                        wire_block=256, sample=False,
@@ -1217,7 +1299,8 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
             block_table=block_table, last_token_idx=ar,
             q_tok_idx=ar[:, None])
         logits, cache = _ragged_forward(
-            params, cache, batch, config=config, block_size=block_size,
+            params, cache, batch, adapter_bank, adapter_slots,
+            config=config, block_size=block_size,
             attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
             mesh=mesh, tp_wire=tp_wire, wire_block=wire_block)
         if not sample:
@@ -1256,7 +1339,8 @@ def _fused_decode_loop(params, cache, tokens, seq_lens, live, block_table,
 
 def _fused_spec_decode_loop(params, cache, tokens, seq_lens, live, block_table,
                             hist, hist_len, ngrams, max_drafts,
-                            keys=None, temps=None, top_ks=None, top_ps=None, *,
+                            keys=None, temps=None, top_ks=None, top_ps=None,
+                            adapter_bank=None, adapter_slots=None, *,
                             config, block_size, attn_backend, tp_size, kv_pad,
                             total_slots, n_steps, d, max_ngram, mesh,
                             tp_wire=None, wire_block=256, sample=False):
@@ -1301,7 +1385,8 @@ def _fused_spec_decode_loop(params, cache, tokens, seq_lens, live, block_table,
             block_table=block_table, last_token_idx=ar * Np1,
             q_tok_idx=(ar * Np1)[:, None] + jw[None, :])
         logits, cache = _ragged_forward(
-            params, cache, batch, config=config, block_size=block_size,
+            params, cache, batch, adapter_bank, adapter_slots,
+            config=config, block_size=block_size,
             attn_backend=attn_backend, tp_size=tp_size, kv_pad=kv_pad,
             mesh=mesh, tp_wire=tp_wire, wire_block=wire_block,
             window_logits=True)                          # [S, 1+d, V]

@@ -130,17 +130,29 @@ class _Replica:
         watchdog owns recovery); quarantined/migrating/dead do not."""
         return self.state in ("ok", "degraded")
 
-    def score(self, tenant: Optional[str] = None) -> float:
+    def score(self, tenant: Optional[str] = None,
+              adapter: Optional[str] = None) -> float:
         """Load score for balanced admission: queue depth + in-flight.
         With a ``tenant``, that tenant's own backlog on this replica
         (from the probed per-tenant stats) weighs in too, so one tenant's
         burst spreads across replicas instead of piling behind itself
-        while the others stay globally balanced."""
+        while the others stay globally balanced. With an ``adapter``, a
+        replica already holding that adapter DEVICE-RESIDENT scores a
+        bonus (one point: roughly "worth one queued request") — requests
+        for one adapter gravitate to replicas that won't pay a slot write
+        or an LRU eviction, without ever overriding health or gross load."""
         st = self.stats or {}
         base = float(st.get("waiting") or 0) + float(st.get("live") or 0)
         if tenant:
             t = ((st.get("tenants") or {}).get(tenant)) or {}
             base += float(t.get("queued") or 0) + float(t.get("live") or 0)
+        if adapter:
+            ad = st.get("adapters") or {}
+            live = ad.get("live") or {}
+            name = adapter.split("@", 1)[0]
+            if not any(aid == adapter or aid.split("@", 1)[0] == name
+                       for aid in live):
+                base += 1.0
         return base
 
     def alive(self) -> bool:
@@ -388,14 +400,17 @@ class ReplicaFleet:
             return [r for r in self._pool if r.routable]
 
     def pick(self, exclude: Sequence[_Replica] = (),
-             tenant: Optional[str] = None) -> Optional[_Replica]:
+             tenant: Optional[str] = None,
+             adapter: Optional[str] = None) -> Optional[_Replica]:
         """Least-loaded routable replica (health-gated balanced admission);
         ties break by uid_base for determinism. ``tenant`` biases the
-        score by that tenant's per-replica backlog."""
+        score by that tenant's per-replica backlog; ``adapter`` biases
+        toward replicas already holding the adapter device-resident."""
         cands = [r for r in self.healthy() if r not in exclude]
         if not cands:
             return None
-        return min(cands, key=lambda r: (r.score(tenant), r.uid_base))
+        return min(cands, key=lambda r: (r.score(tenant, adapter),
+                                         r.uid_base))
 
     def owner_of(self, uid: int) -> Optional[_Replica]:
         with self._lock:
@@ -746,13 +761,16 @@ def create_router_server(fleet: ReplicaFleet, host: str = "127.0.0.1",
                 return
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
-            try:  # tenant-aware balancing: bias by the tenant's backlog
-                tenant = json.loads(body or b"{}").get("tenant")
+            try:  # tenant backlog + adapter residency bias the balancer
+                parsed = json.loads(body or b"{}")
+                tenant = parsed.get("tenant")
+                adapter = parsed.get("adapter")
             except (ValueError, AttributeError):
-                tenant = None
+                tenant = adapter = None
             tried: List[_Replica] = []
             for attempt in range(max(1, submit_retries)):
-                r = fleet.pick(exclude=tried, tenant=tenant)
+                r = fleet.pick(exclude=tried, tenant=tenant,
+                               adapter=adapter)
                 if r is None:
                     break
                 if attempt:

@@ -76,6 +76,7 @@ from ...utils.retry import RetriesExhausted, retry_with_backoff
 from .config_v2 import (ContinuousFusionConfig, DurableServingConfig,
                         ObservabilityConfig, ServingResilienceConfig,
                         TenantConfig)
+from .adapters import AdapterSlotsExhausted
 from .disagg import DisaggServing
 from .journal import RequestJournal, ServingCrash
 from .engine_v2 import InferenceEngineV2, SampleSpec
@@ -108,6 +109,12 @@ class _Request:
     # multi-tenant scheduling: which tenant contract (config ``tenants``
     # block) this request admits/sheds/budgets under
     tenant: str = "default"
+    # multi-LoRA: the client-facing adapter name (None = base weights) and
+    # the RESOLVED versioned id (``name@version``) the stream decodes with —
+    # the journal records the resolved id so replay/migration re-pin the
+    # exact factors, never "whatever version is latest over there"
+    adapter: Optional[str] = None
+    adapter_id: Optional[str] = None
     logprobs: list = field(default_factory=list)
     # speculative accept-rate accounting (drafted tokens offered / accepted)
     drafted: int = 0
@@ -462,7 +469,8 @@ class ServingScheduler:
                deadline_s: Optional[float] = None,
                queue_ttl_s: Optional[float] = None,
                stream: bool = False,
-               tenant: Optional[str] = None) -> RequestHandle:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> RequestHandle:
         """``deadline_s``: end-to-end budget (queue + decode) after which
         the request finishes with :class:`DeadlineExceeded`; ``queue_ttl_s``
         bounds only the unadmitted wait. Both default from the
@@ -471,7 +479,10 @@ class ServingScheduler:
         ``max_stream_backlog`` and stops the request if never drained.
         ``tenant`` selects the scheduling contract from the config
         ``tenants`` block (weighted-fair admission + budgets, per-tenant
-        shed); unnamed requests run as "default"."""
+        shed); unnamed requests run as "default". ``adapter`` names a LoRA
+        adapter (or exact ``name@version``) from the engine's adapter
+        registry; defaults to the tenant's ``default_adapter``; unknown
+        ids are a structured error, never a silent base fallback."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -500,6 +511,25 @@ class ServingScheduler:
                     "speculative sampling requires "
                     "sampling.device_sampling",
                     reason="speculative_requires_device_sampling")
+        tenant_name = str(tenant) if tenant else "default"
+        if adapter is None:
+            # per-tenant default: the tenants config block can route a
+            # tenant's unadorned requests onto its own adapter
+            adapter = self._tenant_cfg(tenant_name).default_adapter
+        adapter_id = None
+        if adapter is not None:
+            reg = getattr(self._engine, "adapters", None)
+            if reg is None:
+                raise UnsupportedFeature(
+                    f"adapter {adapter!r} requested but the engine has no "
+                    "adapter registry (adapters.enabled is off)",
+                    reason="adapters_disabled")
+            try:
+                adapter_id = reg.resolve(str(adapter))
+            except KeyError:
+                raise UnsupportedFeature(
+                    f"unknown adapter {adapter!r}",
+                    reason="unknown_adapter") from None
         req = _Request(uid=next(self._uid_iter), prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        temperature=float(temperature), top_k=int(top_k),
@@ -513,7 +543,9 @@ class ServingScheduler:
                        num_draft_tokens=int(num_draft_tokens),
                        draft_ngram=int(draft_ngram),
                        return_logprobs=bool(return_logprobs),
-                       tenant=str(tenant) if tenant else "default")
+                       tenant=tenant_name,
+                       adapter=str(adapter) if adapter else None,
+                       adapter_id=adapter_id)
         req.rng = np.random.default_rng(req.seed)
         req.t_submit = time.monotonic()
         req.wake = self._wake
@@ -564,6 +596,24 @@ class ServingScheduler:
                     f"tenant {req.tenant!r} queue full "
                     f"({self._tenant_queued.get(req.tenant, 0)} queued)",
                     retry_after_s=res.retry_after_s if res.enabled else 1.0)
+            if req.adapter_id is not None:
+                # pin INSIDE the lock, after every shed check: a request
+                # that is rejected above never takes a slot, and one that
+                # is admitted holds its adapter until _finish unpins
+                try:
+                    self._engine.set_request_adapter(req.uid, req.adapter_id)
+                except KeyError:
+                    raise UnsupportedFeature(
+                        f"adapter {req.adapter_id!r} was unloaded",
+                        reason="unknown_adapter") from None
+                except AdapterSlotsExhausted as e:
+                    self._trace["shed"] += 1
+                    if self._obs is not None:
+                        self._obs.shed.inc()
+                    raise SchedulerOverloaded(
+                        str(e), retry_after_s=(res.retry_after_s
+                                               if res.enabled else 1.0)
+                    ) from None
             # journal BEFORE the request becomes visible to the loop: the
             # loop could otherwise finish it and write a finish record the
             # recovery scan would see before (and thus ignore) the admit
@@ -595,7 +645,8 @@ class ServingScheduler:
             "num_draft_tokens": req.num_draft_tokens,
             "draft_ngram": req.draft_ngram,
             "return_logprobs": req.return_logprobs,
-            "stream": req.stream, "tenant": req.tenant}
+            "stream": req.stream, "tenant": req.tenant,
+            "adapter": req.adapter_id}
         try:
             self._journal.record_admit(
                 req.uid, req.prompt, params,
@@ -721,6 +772,10 @@ class ServingScheduler:
                 "weight": cfg.weight, "priority": cfg.priority}
         out["tenants"] = tenants
         out["prefix_cache"] = self._engine.prefix_cache_report()
+        # multi-LoRA view: registered/live/pinned adapters — the router's
+        # adapter-affinity scoring and ds_top read this
+        reg = getattr(self._engine, "adapters", None)
+        out["adapters"] = reg.stats() if reg is not None else None
         done = [d for d in done if d[3] > 0]
         # replayed requests' TTFT spans the crash + restart (measured from
         # the ORIGINAL admit) — real for that client, but a restart would
@@ -762,6 +817,12 @@ class ServingScheduler:
         """The instruments bundle (registry/tracer/profiler) the HTTP
         observability endpoints render, or None with the block disabled."""
         return self._obs
+
+    @property
+    def engine(self) -> InferenceEngineV2:
+        """The served engine (the adapter admin endpoints reach its
+        registry through this)."""
+        return self._engine
 
     def trace_timeline(self, uid: int) -> Optional[dict]:
         """Per-request span timeline (``GET /requests/<uid>/trace``)."""
@@ -881,6 +942,21 @@ class ServingScheduler:
                        f"unfinished request(s) ({len(finished)} already "
                        f"complete)")
 
+    def _repin_adapter(self, req: _Request) -> bool:
+        """Re-pin a replayed request's journaled adapter version; on any
+        failure set a typed error and report False (the caller
+        error-finishes the request instead of continuing it wrong)."""
+        if req.adapter_id is None:
+            return True
+        try:
+            self._engine.set_request_adapter(req.uid, req.adapter_id)
+            return True
+        except (KeyError, RuntimeError) as e:
+            req.error = UnsupportedFeature(
+                f"replay: adapter {req.adapter_id!r} unavailable: {e}",
+                reason="adapter_unavailable")
+            return False
+
     def _req_from_entry(self, e, now_w: float, now_m: float) -> _Request:
         """Rebuild a scheduler request from a journal entry: original uid,
         emitted tokens as prefix feed, key burns for the sampler
@@ -901,7 +977,8 @@ class ServingScheduler:
             num_draft_tokens=int(p.get("num_draft_tokens", 4)),
             draft_ngram=int(p.get("draft_ngram", 2)),
             return_logprobs=bool(p.get("return_logprobs")),
-            tenant=str(p.get("tenant") or "default"))
+            tenant=str(p.get("tenant") or "default"),
+            adapter=p.get("adapter"), adapter_id=p.get("adapter"))
         req.outputs = [int(t) for t in e.tokens]
         req.logprobs = list(e.logprobs)
         req.key_burns = int(e.key_burns)
@@ -962,6 +1039,12 @@ class ServingScheduler:
                 self._requests[req.uid] = req
                 self._active += 1
                 if self._finished_already(req):
+                    finish_now.append(req)
+                elif not self._repin_adapter(req):
+                    # the journaled VERSIONED id must re-resolve exactly —
+                    # a replayed stream continuing on different factors (or
+                    # silently on base weights) would diverge byte-wise, so
+                    # unavailability is a loud error finish
                     finish_now.append(req)
                 else:
                     req.queued = True
@@ -2369,6 +2452,8 @@ class ServingScheduler:
             obs.tokens.inc()
             obs.decode_tokens.inc()
             obs.tenant_token(req.tenant)
+            if req.adapter_id is not None:
+                obs.adapter_token(req.adapter_id)
 
     def _emit_device(self, wave, engine: Optional[InferenceEngineV2] = None
                      ) -> None:
@@ -2460,6 +2545,12 @@ class ServingScheduler:
             flush = False
         if flush:
             self._engine.flush(req.uid)
+        elif req.adapter_id is not None:
+            # flush=False paths (queue expiry, replay error-finish) never
+            # touched the engine, but the submit/replay pin is real
+            reg = getattr(self._engine, "adapters", None)
+            if reg is not None:
+                reg.unpin(req.uid)
         if (self._journal is not None and not req.journal_skip
                 and not self._preserve_journal):
             # crash/handoff keeps entries alive for the next boot's replay;
@@ -2492,7 +2583,8 @@ class ServingScheduler:
                 outcome = "error"
             self._obs.request_finished(req.uid, req.t_submit, req.t_done,
                                        outcome, len(req.outputs),
-                                       req.replayed, tenant=req.tenant)
+                                       req.replayed, tenant=req.tenant,
+                                       adapter=req.adapter_id)
             # keep the last 256 finished requests reconnectable by uid,
             # then let them go so the registry stays bounded
             self._done_order.append(req.uid)
@@ -2730,7 +2822,55 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                 return
             self._json(200, {"status": "started", **info})
 
+        def _do_adapters(self):
+            """``POST /adapters/load`` (``{"path": dir, "name": ...}``) and
+            ``POST /adapters/unload`` (``{"adapter": name_or_id}``) — the
+            hot-swap surface: factors land in (or leave) the running bank
+            via value-only slot writes, so the daemon never restarts and
+            the fused programs never recompile."""
+            reg = getattr(scheduler.engine, "adapters", None)
+            if reg is None:
+                self._json(404, {"error": "adapters disabled "
+                                          "(adapters.enabled is off)",
+                                 "reason": "adapters_disabled"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                self._json(400, {"error": "bad JSON body"})
+                return
+            try:
+                if self.path == "/adapters/load":
+                    path = body.get("path")
+                    if not path:
+                        raise ValueError("missing 'path' (adapter "
+                                         "checkpoint dir)")
+                    aid = reg.load(str(path), name=body.get("name"))
+                    self._json(200, {"status": "loaded", "adapter": aid})
+                else:
+                    target = body.get("adapter") or body.get("name")
+                    if not target:
+                        raise ValueError("missing 'adapter' (name or "
+                                         "name@version)")
+                    aid = reg.unload(str(target))
+                    self._json(200, {"status": "unloaded", "adapter": aid})
+            except KeyError as e:
+                self._json(400, {"error": str(e),
+                                 "reason": "unknown_adapter"})
+            except ValueError as e:
+                err = {"error": str(e)}
+                reason = error_reason(e)
+                err["reason"] = reason or "bad_adapter"
+                self._json(400, err)
+            except OSError as e:
+                self._json(400, {"error": f"adapter load failed: {e}",
+                                 "reason": "adapter_io_error"})
+
         def do_POST(self):
+            if self.path in ("/adapters/load", "/adapters/unload"):
+                self._do_adapters()
+                return
             if self.path in ("/debug/profile", "/debug/profile/stop"):
                 self._do_profile()
                 return
@@ -2818,7 +2958,8 @@ def create_http_server(scheduler: ServingScheduler, host: str = "127.0.0.1",
                     deadline_s=body.get("deadline_s"),
                     queue_ttl_s=body.get("queue_ttl_s"),
                     stream=bool(body.get("stream")),
-                    tenant=body.get("tenant"))
+                    tenant=body.get("tenant"),
+                    adapter=body.get("adapter"))
             except SchedulerOverloaded as e:
                 self._json(429, {"error": str(e),
                                  "retry_after_s": e.retry_after_s},

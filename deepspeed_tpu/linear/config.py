@@ -1,15 +1,61 @@
 """Linear/LoRA configs (reference ``deepspeed/linear/config.py`` — same
 fields)."""
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+LORA_DTYPES = ("bfloat16", "float32", "float16")
+
+# projection kernels a serving-side adapter may target (the subset of the
+# AutoTP-recognized names the ragged forward exposes a LoRA hook on)
+LORA_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                "gate_proj", "up_proj", "down_proj")
 
 
 @dataclass
 class LoRAConfig:
-    """Reference linear/config.py LoRAConfig."""
+    """Reference linear/config.py LoRAConfig — extended to double as the
+    serving-side adapter spec (inference/v2/adapters): training and
+    serving share ONE dataclass and one scaling rule
+    (``alpha / sqrt(r)``, matching ``LoRAOptimizedLinear``)."""
     lora_r: int = 64
     lora_alpha: float = 16.0
     base_weight_sharding: int = 1  # shard the frozen base over 'model' axis
+    lora_dtype: str = "bfloat16"
+    # serving-side: which projection kernels the adapter's factors cover
+    # (training-side LoRAOptimizedLinear wraps one layer and ignores this)
+    targets: Tuple[str, ...] = field(default_factory=lambda: ("q_proj", "v_proj"))
+
+    def __post_init__(self):
+        self.validate()
+
+    def validate(self) -> "LoRAConfig":
+        if int(self.lora_r) < 1:
+            raise ValueError(f"lora_r must be >= 1, got {self.lora_r}")
+        if float(self.lora_alpha) < 0:
+            # alpha == 0 is the explicit "disabled adapter" sentinel
+            # (OptimizedLinear's quantized-only path): scaling 0 zeroes the
+            # LoRA branch exactly
+            raise ValueError(f"lora_alpha must be >= 0, got {self.lora_alpha}")
+        if self.lora_dtype not in LORA_DTYPES:
+            raise ValueError(f"lora_dtype must be one of {LORA_DTYPES}, "
+                             f"got {self.lora_dtype!r}")
+        if int(self.base_weight_sharding) < 1:
+            raise ValueError("base_weight_sharding must be >= 1, got "
+                             f"{self.base_weight_sharding}")
+        self.targets = tuple(self.targets)
+        for t in self.targets:
+            if t not in LORA_TARGETS:
+                raise ValueError(f"unknown LoRA target {t!r}; expected a "
+                                 f"subset of {LORA_TARGETS}")
+        if not self.targets:
+            raise ValueError("LoRA targets must name at least one kernel")
+        return self
+
+    @property
+    def scaling(self) -> float:
+        """The LoRAOptimizedLinear scaling — ONE rule for train + serve."""
+        return float(self.lora_alpha) / (int(self.lora_r) ** 0.5)
 
 
 @dataclass

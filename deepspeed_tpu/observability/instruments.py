@@ -115,6 +115,30 @@ class ServingInstruments:
         # tenant name — the hot path still touches plain attributes after
         # one dict hit, and an untenanted deployment allocates nothing
         self._tenants: dict = {}
+        # per-adapter handle bundles (multi-LoRA serving), same lazy scheme
+        self._adapters: dict = {}
+
+    def _adapter(self, name: str):
+        """Labeled series for one adapter id (``name@version``)."""
+        a = self._adapters.get(name)
+        if a is None:
+            lbl = {"adapter": name}
+            reg = self.registry
+            from types import SimpleNamespace
+            a = SimpleNamespace(
+                tokens=reg.counter(
+                    "ds_adapter_tokens_total",
+                    "Tokens emitted by requests decoding with one adapter",
+                    labels=lbl),
+                finished=reg.counter(
+                    "ds_adapter_requests_finished_total",
+                    "Requests finished successfully per adapter",
+                    labels=lbl))
+            self._adapters[name] = a
+        return a
+
+    def adapter_token(self, adapter: str) -> None:
+        self._adapter(adapter).tokens.inc()
 
     def _tenant(self, name: str):
         """Labeled series for one tenant, sharing the family names of the
@@ -204,11 +228,14 @@ class ServingInstruments:
 
     def request_finished(self, uid, t_submit: float, t_done: float,
                          outcome: str, n_tokens: int,
-                         replayed: bool, tenant: Optional[str] = None) -> None:
+                         replayed: bool, tenant: Optional[str] = None,
+                         adapter: Optional[str] = None) -> None:
         if outcome == "ok":
             self.finished.inc()
             if tenant is not None:
                 self._tenant(tenant).finished.inc()
+            if adapter is not None:
+                self._adapter(adapter).finished.inc()
             if not replayed:
                 self.e2e.record(t_done - t_submit)
                 if tenant is not None:

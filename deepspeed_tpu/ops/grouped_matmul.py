@@ -74,6 +74,60 @@ def moe_grouped_mlp(x, w1, w3, w2, top_idx, top_w, *, activation=jax.nn.silu):
     return out.astype(x.dtype)
 
 
+def lora_sort_slots(slots, n_slots):
+    """Sort per-token adapter slot ids for the grouped LoRA delta — the
+    k=1 specialization of :func:`moe_sort_tokens` (every token has exactly
+    one adapter). Hoist this ONCE per forward and reuse the (order,
+    group_sizes) pair across every layer/target: the sort is a function of
+    the batch's slot assignment only.
+
+    Args:
+      slots: ``[T]`` int32 adapter slot per token (0 = identity).
+      n_slots: static slot-pool size (bank leading dim).
+    Returns:
+      (order ``[T]`` sort permutation, group_sizes ``[n_slots]`` int32).
+    """
+    order = jnp.argsort(slots, stable=True)
+    group_sizes = jnp.bincount(slots, length=n_slots).astype(jnp.int32)
+    return order, group_sizes
+
+
+def lora_grouped_delta(x, a, b, scale_sorted, order, group_sizes):
+    """Batched multi-LoRA delta ``y[t] += B[s_t] @ (A[s_t] @ x[t]) * scale``
+    via the sort-by-slot ragged idiom — ONE pair of grouped GEMMs covers a
+    mixed-adapter token wave, FLOPs ∝ rank regardless of how many adapters
+    are live, and slot 0's zero factors make base-only tokens an exact
+    no-op (delta ≡ 0.0, so streams stay bit-identical to the base model).
+
+    Args:
+      x: ``[T, in]`` tokens (original order).
+      a: ``[n_slots, in, r]`` stacked down-projection factors.
+      b: ``[n_slots, r, out]`` stacked up-projection factors.
+      scale_sorted: ``[T]`` fp32 per-token ``alpha / sqrt(r)`` in SORTED
+        order (``scale[slots][order]`` — the caller gathers once).
+      order, group_sizes: from :func:`lora_sort_slots`.
+    Returns:
+      ``[T, out]`` fp32 delta in original token order.
+    """
+    xs = x[order]
+    h = jax.lax.ragged_dot(xs, a, group_sizes,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+    y = jax.lax.ragged_dot(h, b, group_sizes,
+                           preferred_element_type=jnp.float32)
+    y = y * scale_sorted[:, None]
+    return jnp.zeros((x.shape[0], b.shape[-1]), jnp.float32).at[order].set(y)
+
+
+def lora_dense_delta(x, a, b, slots, scale):
+    """Dense-gather reference for :func:`lora_grouped_delta` — the numerics
+    oracle: per-token factor gather + two plain matmuls, no sort."""
+    af = a[slots].astype(jnp.float32)        # [T, in, r]
+    bf = b[slots].astype(jnp.float32)        # [T, r, out]
+    h = jnp.einsum("ti,tir->tr", x.astype(jnp.float32), af)
+    y = jnp.einsum("tr,tro->to", h, bf)
+    return y * scale[slots][:, None]
+
+
 def moe_dense_mlp(x, w1, w3, w2, top_idx, top_w, *, activation=jax.nn.silu):
     """Dense-over-experts reference (every expert for every token, masked
     combine) — the numerics oracle for tests and the fallback when an

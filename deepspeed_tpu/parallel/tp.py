@@ -58,6 +58,27 @@ def heuristic_spec(path: str, shape: Sequence[int], mp_size: int) -> P:
     return P()
 
 
+def lora_factor_specs(target: str, a_shape: Sequence[int],
+                      b_shape: Sequence[int], mp_size: int) -> Tuple[P, P]:
+    """PartitionSpecs for a stacked LoRA factor bank under TP — the AutoTP
+    heuristics applied to the low-rank pair. A ``[n_slots, L, in, r]``
+    contracts against the kernel's INPUT dim, B ``[n_slots, L, r, out]``
+    produces its OUTPUT dim, so a column-parallel target (q/k/v/gate/up:
+    kernel out-dim sharded) shards B's out-dim and replicates A (the rank-r
+    intermediate stays tiny and replicated), while a row-parallel target
+    (o/down: kernel in-dim sharded) shards A's in-dim alongside the sharded
+    activations and replicates B — GSPMD then reduces the rank-r partials
+    with the same psum it inserts for the base matmul. Non-divisible dims
+    replicate, matching :func:`heuristic_spec`."""
+    if mp_size <= 1:
+        return P(), P()
+    if _COL_PARALLEL.search(target) and b_shape[-1] % mp_size == 0:
+        return P(), P(*([None] * (len(b_shape) - 1) + ["model"]))
+    if _ROW_PARALLEL.search(target) and a_shape[-2] % mp_size == 0:
+        return P(*([None] * (len(a_shape) - 2) + ["model", None])), P()
+    return P(), P()
+
+
 def woq_shard_dim(path: str, shape: Sequence[int], mp_size: int) -> Optional[int]:
     """Which dim of a kernel the AutoTP heuristics would shard over 'model'
     (None = replicated). The weight quantizer uses this to lay packed

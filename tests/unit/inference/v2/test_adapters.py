@@ -1,0 +1,601 @@
+"""Multi-LoRA adapter serving: registry lifecycle, fused-wave batching,
+and the hot-swap / durability / migration resilience scenarios.
+
+The invariants under test, in order: the registry validates checkpoints
+against the bank geometry and versions every load; slot 0 is the
+identity adapter, so an enabled-but-unpinned engine is BIT-IDENTICAL to
+an adapter-free one across greedy / sampled / speculative decode and
+prefix cache on/off; a wave mixing base rows with different adapters is
+ONE device dispatch per fused-K window; a post-warmup hot load compiles
+ZERO new programs (the bank is a traced operand, never a compile key);
+unknown ids are structured HTTP 400s, never a silent base fallback; and
+the journaled VERSIONED id survives crash replay and WAL migration
+byte-exactly — or error-finishes loudly when that version is gone.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import reset_mesh_context
+from deepspeed_tpu.inference.v2 import engine_v2 as _ev2
+from deepspeed_tpu.inference.v2.adapters import (AdapterRegistry,
+                                                 AdapterSlotsExhausted,
+                                                 save_adapter)
+from deepspeed_tpu.inference.v2.config_v2 import (AdaptersConfig,
+                                                  RaggedInferenceEngineConfig,
+                                                  TenantConfig)
+from deepspeed_tpu.inference.v2.engine_v2 import build_llama_engine
+from deepspeed_tpu.inference.v2.scheduling_utils import (UnsupportedFeature,
+                                                         error_reason)
+from deepspeed_tpu.inference.v2.server import (ServingScheduler,
+                                               create_http_server)
+from deepspeed_tpu.linear.config import LoRAConfig
+from deepspeed_tpu.models import LlamaConfig, init_llama
+from deepspeed_tpu.utils.fault_injection import get_fault_injector
+
+BS = 16
+TARGETS = ("q_proj", "v_proj")
+
+
+def _acfg(registry_dir=None, max_live=4, r_pad=8):
+    return AdaptersConfig(enabled=True, registry_dir=registry_dir,
+                          max_live_adapters=max_live, slot_rank_pad=r_pad,
+                          targets=TARGETS)
+
+
+def _engine(adapters=None, durable=False, num_blocks=96, tenants=None,
+            journal_dir=None, **cfg_kw):
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, **cfg_kw)
+    _, params = init_llama(cfg, seed=5)
+    eng_cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=num_blocks,
+        adapters=adapters if adapters is not None else AdaptersConfig(),
+        durable_serving={"enabled": durable, "journal_dir": journal_dir},
+        tenants=tenants or {})
+    return build_llama_engine(cfg, params=params, dtype=jnp.float32,
+                              kv_block_size=BS, engine_config=eng_cfg)
+
+
+def _save(root, name="demo", seed=0, r=4, alpha=16.0, scale=0.5):
+    """Write one adapter checkpoint dir for the tiny llama geometry."""
+    cfg = LlamaConfig.tiny(num_key_value_heads=4)
+    L, H, hd = cfg.num_hidden_layers, cfg.hidden_size, cfg.head_dim_
+    dims = {"q_proj": cfg.num_attention_heads * hd,
+            "v_proj": cfg.num_key_value_heads * hd}
+    rng = np.random.default_rng(seed)
+    factors = {t: (rng.standard_normal((L, H, r)) * scale,
+                   rng.standard_normal((L, r, dims[t])) * scale)
+               for t in TARGETS}
+    path = os.path.join(str(root), name)
+    save_adapter(path, LoRAConfig(lora_r=r, lora_alpha=alpha,
+                                  targets=TARGETS), factors)
+    return path
+
+
+def _prompts(n, lo=3, hi=2 * BS + 5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, size=rng.integers(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+def _drive(eng, uid, prompt, k=8, adapter=None):
+    """One prefill put + one fused K-step wave; returns the token stream."""
+    if adapter is not None:
+        eng.set_request_adapter(uid, adapter)
+    logits = eng.put([uid], [np.asarray(prompt, np.int32)])
+    tok = int(np.argmax(np.asarray(logits)[0]))
+    out = eng.fused_decode_steps([uid], [tok], k)
+    toks = [tok] + [int(t) for t in np.asarray(out)[0]]
+    eng.flush(uid)
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle (load / validate / version / LRU / pin)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_versioning_and_resolve(tmp_path):
+    """Every load returns ``name@version``; a reload bumps the version;
+    bare names resolve to the latest while exact ids stay addressable, and
+    unloading the latest falls back to the survivor."""
+    eng = _engine(adapters=_acfg())
+    reg = eng.adapters
+    path = _save(tmp_path, "demo", seed=0)
+    assert reg.load(path) == "demo@1"
+    assert reg.load(path) == "demo@2"
+    assert reg.resolve("demo") == "demo@2"
+    assert reg.resolve("demo@1") == "demo@1"
+    with pytest.raises(KeyError):
+        reg.resolve("nope")
+    assert reg.unload("demo") == "demo@2"
+    assert reg.resolve("demo") == "demo@1"
+    st = reg.stats()
+    assert st["registered"] == ["demo@1"]
+    assert st["loads"] == 2
+
+
+def test_registry_validates_against_bank_geometry(tmp_path):
+    """Checkpoints that cannot run in the configured bank are refused with
+    actionable ValueErrors: rank beyond the slot pad, targets outside the
+    bank, and missing factor arrays."""
+    eng = _engine(adapters=_acfg(r_pad=8))
+    reg = eng.adapters
+    with pytest.raises(ValueError, match="slot_rank_pad"):
+        reg.load(_save(tmp_path, "fat", r=16))
+    p = _save(tmp_path, "demo")
+    with open(os.path.join(p, "adapter_config.json")) as f:
+        raw = json.load(f)
+    raw["targets"] = ["q_proj", "gate_proj"]
+    bad = tmp_path / "badtarget"
+    bad.mkdir()
+    with open(bad / "adapter_config.json", "w") as f:
+        json.dump(raw, f)
+    import shutil
+    shutil.copy(os.path.join(p, "weights.npz"), bad / "weights.npz")
+    with pytest.raises(ValueError, match="outside the"):
+        reg.load(str(bad))
+    noweights = tmp_path / "noweights"
+    noweights.mkdir()
+    with open(noweights / "adapter_config.json", "w") as f:
+        json.dump({"lora_r": 4, "lora_alpha": 16.0,
+                   "targets": list(TARGETS)}, f)
+    with pytest.raises(ValueError, match="weights.npz"):
+        reg.load(str(noweights))
+    # negative alpha is a spec-level validation error
+    with pytest.raises(ValueError):
+        LoRAConfig(lora_r=4, lora_alpha=-1.0).validate()
+
+
+def test_registry_lru_eviction_pin_exhaustion_unload_refusal(tmp_path):
+    """With 2 device slots: pinned slots cannot be evicted (a third pin is
+    AdapterSlotsExhausted) or unloaded (ValueError); releasing a pin makes
+    its slot the LRU victim for the next resident adapter."""
+    eng = _engine(adapters=_acfg(max_live=2))
+    reg = eng.adapters
+    ids = [reg.load(_save(tmp_path, f"a{i}", seed=i)) for i in range(3)]
+    s0 = reg.pin(1, ids[0])
+    s1 = reg.pin(2, ids[1])
+    assert s0 != s1 and 0 not in (s0, s1)
+    with pytest.raises(AdapterSlotsExhausted):
+        reg.pin(3, ids[2])
+    with pytest.raises(ValueError, match="pinned"):
+        reg.unload(ids[0])
+    reg.unpin(1)
+    assert reg.pin(3, ids[2]) == s0  # LRU-evicted a0's slot
+    st = reg.stats()
+    assert set(st["live"]) == {ids[1], ids[2]}
+    assert st["evictions"] == 1
+    assert reg.slot_for_uid(3) == s0 and reg.slot_for_uid(999) == 0
+    # double-pinning the same uid to a new adapter re-pins, never leaks
+    reg.pin(2, ids[2])
+    assert reg.adapter_for_uid(2) == ids[2]
+
+
+def test_registry_refuses_moe_mlp_targets():
+    """MoE models have no LoRA hook on the expert MLPs — a config naming
+    an MLP projection must refuse at construction, not silently drop the
+    trained deltas. Attention-only targets still build."""
+    reset_mesh_context()
+    cfg = LlamaConfig.tiny(num_key_value_heads=4, num_local_experts=4,
+                           num_experts_per_tok=2)
+    _, params = init_llama(cfg, seed=13)
+    eng = build_llama_engine(
+        cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+        engine_config=RaggedInferenceEngineConfig(num_kv_blocks=96))
+    with pytest.raises(ValueError, match="MoE"):
+        AdapterRegistry(AdaptersConfig(enabled=True, max_live_adapters=4,
+                                       slot_rank_pad=8,
+                                       targets=("q_proj", "up_proj")),
+                        eng._model)
+    reg = AdapterRegistry(_acfg(), eng._model)
+    assert reg.targets == TARGETS
+
+
+def test_boot_scan_skips_broken_checkpoints(tmp_path):
+    """``registry_dir`` boot scan loads every valid subdir and skips (not
+    raises on) a broken one — one bad checkpoint must not kill the boot."""
+    _save(tmp_path, "good_a", seed=1)
+    _save(tmp_path, "good_b", seed=2)
+    broken = tmp_path / "broken"
+    broken.mkdir()
+    (broken / "adapter_config.json").write_text(
+        json.dumps({"lora_r": 99, "lora_alpha": 1.0,
+                    "targets": list(TARGETS)}))
+    eng = _engine(adapters=_acfg(registry_dir=str(tmp_path)))
+    assert eng.adapters.stats()["registered"] == ["good_a@1", "good_b@1"]
+
+
+# ---------------------------------------------------------------------------
+# fused execution: identity parity, mixed waves, hot-load compile economy
+# ---------------------------------------------------------------------------
+
+
+def test_grouped_delta_matches_dense_oracle():
+    """The sort-by-slot ragged grouped matmul equals the per-token dense
+    gather oracle for random slot assignments (including slot 0)."""
+    from deepspeed_tpu.ops.grouped_matmul import (lora_dense_delta,
+                                                  lora_grouped_delta,
+                                                  lora_sort_slots)
+    rng = np.random.default_rng(7)
+    T, din, dout, rp, ns = 13, 16, 24, 8, 5
+    x = jnp.asarray(rng.standard_normal((T, din)), jnp.float32)
+    a = jnp.asarray(rng.standard_normal((ns, din, rp)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((ns, rp, dout)), jnp.float32)
+    sc = jnp.asarray(rng.standard_normal(ns), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, ns, T), jnp.int32)
+    order, gsz = lora_sort_slots(slots, ns)
+    got = lora_grouped_delta(x, a, b, sc[slots][order], order, gsz)
+    want = lora_dense_delta(x, a, b, slots, sc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_identity_slot_bit_exact_all_decode_modes():
+    """An enabled registry with nothing pinned is invisible: greedy fused,
+    seeded sampled, and speculative streams are bit-identical to an
+    adapter-free engine (slot 0 adds exactly +0.0)."""
+    ps = _prompts(3, lo=12, seed=11)
+    modes = [
+        dict(max_new_tokens=10, fused_decode_window=4),
+        dict(max_new_tokens=10, temperature=0.8, top_k=16, seed=3,
+             fused_decode_window=4),
+        dict(max_new_tokens=10, temperature=0.7, top_p=0.9, seed=5,
+             speculative="prompt_lookup", num_draft_tokens=3,
+             draft_ngram=2),
+    ]
+    ref_eng = _engine()
+    refs = [ref_eng.generate(ps, **kw) for kw in modes]
+    got_eng = _engine(adapters=_acfg())
+    for kw, ref in zip(modes, refs):
+        assert got_eng.generate(ps, **kw) == ref, kw
+
+
+def test_identity_slot_bit_exact_with_prefix_cache():
+    """Identity parity holds with the radix prefix cache adopting shared
+    prefixes — cached KV and the adapter bank compose without drift."""
+    shared = list(range(40, 40 + 2 * BS))
+    ps = [shared + [7, 3], shared + [9, 1, 4]]
+    kw = dict(max_new_tokens=8, fused_decode_window=4)
+
+    def run(adapters):
+        reset_mesh_context()
+        cfg = LlamaConfig.tiny(num_key_value_heads=4)
+        _, params = init_llama(cfg, seed=5)
+        eng = build_llama_engine(
+            cfg, params=params, dtype=jnp.float32, kv_block_size=BS,
+            engine_config=RaggedInferenceEngineConfig(
+                num_kv_blocks=96, enable_prefix_caching=True,
+                adapters=adapters))
+        return eng.generate(ps, **kw)
+
+    assert run(_acfg()) == run(AdaptersConfig())
+
+
+def test_mixed_wave_one_dispatch_and_solo_parity(tmp_path):
+    """A wave mixing a base row and an adapter row is ONE device dispatch
+    per fused-K window, and each row's stream equals its solo run — the
+    batching changes cost, never results."""
+    eng = _engine(adapters=_acfg())
+    eng.adapters.load(_save(tmp_path, "demo"))
+    p = _prompts(1, lo=6, seed=2)[0]
+    base = _drive(eng, 101, p)
+    ad = _drive(eng, 102, p, adapter="demo")
+    assert ad != base
+    base_again = _drive(eng, 103, p)
+    assert base_again == base  # pinning never perturbs base rows
+
+    eng.set_request_adapter(202, "demo")
+    logits = eng.put([201, 202], [np.asarray(p, np.int32)] * 2)
+    t1 = int(np.argmax(np.asarray(logits)[0]))
+    t2 = int(np.argmax(np.asarray(logits)[1]))
+    d0 = _ev2._dispatches_total.value
+    out = np.asarray(eng.fused_decode_steps([201, 202], [t1, t2], 8))
+    assert _ev2._dispatches_total.value - d0 == 1
+    assert [t1] + [int(t) for t in out[0]] == base
+    assert [t2] + [int(t) for t in out[1]] == ad
+    eng.flush(201)
+    eng.flush(202)
+    assert eng.adapters.stats()["pinned"] == {}
+
+
+def test_hot_load_zero_recompiles_after_warmup(tmp_path):
+    """Loading + pinning a NEW adapter after warmup compiles nothing: the
+    slot bank is a traced operand with fixed geometry, so which adapters
+    are live never enters a compile key."""
+    from deepspeed_tpu.inference.v2.model import _serving_compile_watch
+    eng = _engine(adapters=_acfg())
+    eng.adapters.load(_save(tmp_path, "warm", seed=1))
+    p = _prompts(1, lo=6, seed=9)[0]
+    _drive(eng, 1, p, adapter="warm")  # warm prefill + fused wave
+    watch = _serving_compile_watch()
+    before = sum(watch.counts(k)["compiles"] for k in watch._per_key)
+    eng.adapters.load(_save(tmp_path, "hot", seed=2))
+    hot = _drive(eng, 2, p, adapter="hot")
+    after = sum(watch.counts(k)["compiles"] for k in watch._per_key)
+    assert after - before == 0
+    assert hot != _drive(eng, 3, p, adapter="warm")
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: structured errors, tenant defaults, hot load/unload
+# ---------------------------------------------------------------------------
+
+
+def _http_fixture(tmp_path, tenants=None):
+    eng = _engine(adapters=_acfg(), tenants=tenants)
+    sched = ServingScheduler(eng, idle_wait=0.005).start()
+    srv = create_http_server(sched, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    def call(method, path, body=None):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        c.request(method, path,
+                  json.dumps(body) if body is not None else None,
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+
+    return sched, srv, call
+
+
+def test_http_unknown_adapter_is_structured_400(tmp_path):
+    """An unknown (or unloaded) ``adapter`` id is a structured 400 with
+    ``reason: unknown_adapter`` — never a silent base-weights fallback —
+    and ``submit()`` raises the same typed error in-process."""
+    sched, srv, call = _http_fixture(tmp_path)
+    try:
+        st, b = call("POST", "/generate",
+                     {"prompt": [1, 5, 9], "adapter": "nope",
+                      "max_new_tokens": 4})
+        assert st == 400 and b["reason"] == "unknown_adapter", (st, b)
+        assert "error" in b
+        with pytest.raises(UnsupportedFeature) as ei:
+            sched.submit([1, 5, 9], max_new_tokens=4, adapter="nope")
+        assert error_reason(ei.value) == "unknown_adapter"
+        # a load from a path holding no checkpoint is a structured 400 too
+        st, b = call("POST", "/adapters/load", {"path": "/nonexistent"})
+        assert st == 400 and "reason" in b, (st, b)
+    finally:
+        srv.shutdown()
+        sched.stop()
+
+
+def test_http_load_generate_unload_and_tenant_default(tmp_path):
+    """The full HTTP lifecycle: hot load returns the versioned id, the
+    adapter stream differs from base, a tenant's ``default_adapter``
+    applies when the body names none, ``/health`` + ``/metrics`` expose
+    the registry, and unload makes the id a 400."""
+    path = _save(tmp_path, "demo")
+    sched, srv, call = _http_fixture(
+        tmp_path, tenants={"acme": TenantConfig(weight=2.0,
+                                                default_adapter="demo")})
+    try:
+        st, b = call("POST", "/adapters/load", {"path": path})
+        assert st == 200 and b["adapter"] == "demo@1", (st, b)
+        prompt = _prompts(1, lo=6, seed=3)[0]
+        _, base = call("POST", "/generate",
+                       {"prompt": prompt, "max_new_tokens": 6})
+        _, ad = call("POST", "/generate",
+                     {"prompt": prompt, "max_new_tokens": 6,
+                      "adapter": "demo"})
+        _, ten = call("POST", "/generate",
+                      {"prompt": prompt, "max_new_tokens": 6,
+                       "tenant": "acme"})
+        assert ad["tokens"] != base["tokens"]
+        assert ten["tokens"] == ad["tokens"]
+        st, h = call("GET", "/health")
+        assert h["adapters"]["registered"] == ["demo@1"]
+        c = http.client.HTTPConnection("127.0.0.1", srv.server_address[1],
+                                       timeout=60)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        assert "ds_adapter_loads_total" in text
+        assert "ds_adapter_live" in text
+        assert 'ds_adapter_tokens_total{adapter="demo@1"}' in text
+        st, b = call("POST", "/adapters/unload", {"adapter": "demo"})
+        assert st == 200 and b["adapter"] == "demo@1"
+        st, b = call("POST", "/generate",
+                     {"prompt": prompt, "adapter": "demo",
+                      "max_new_tokens": 4})
+        assert st == 400 and b["reason"] == "unknown_adapter"
+    finally:
+        srv.shutdown()
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# resilience: hot swap mid-stream, crash replay, WAL migration
+# ---------------------------------------------------------------------------
+
+
+def _wait_tokens(handles, k, timeout=120):
+    t0 = time.monotonic()
+    while not all(len(h._req.outputs) >= k for h in handles):
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("requests never reached the swap point")
+        time.sleep(0.01)
+
+
+def _wait_stopped(sched, timeout=120):
+    t0 = time.monotonic()
+    while not sched.stats["stopped"]:
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError("scheduler loop never died")
+        time.sleep(0.02)
+
+
+@pytest.mark.faults
+def test_hot_swap_mid_stream_pins_its_version(tmp_path):
+    """Reloading a NAME mid-stream must not touch in-flight requests: the
+    running stream finishes byte-identically on its pinned version while
+    new submits resolve to the reload — and unloading the pinned version
+    is refused until the stream retires."""
+    path = _save(tmp_path, "demo", seed=1)
+    acfg = _acfg(registry_dir=str(tmp_path))
+    p = _prompts(1, lo=10, seed=6)[0]
+    ref_sched = ServingScheduler(_engine(adapters=acfg),
+                                 idle_wait=0.005).start()
+    try:
+        ref = ref_sched.submit(p, max_new_tokens=14,
+                               adapter="demo").result(timeout=300)
+    finally:
+        ref_sched.stop()
+
+    sched = ServingScheduler(_engine(adapters=acfg), idle_wait=0.005).start()
+    try:
+        h1 = sched.submit(p, max_new_tokens=14, adapter="demo")
+        _wait_tokens([h1], 3)
+        # hot swap: same name, new factors -> demo@2
+        reg = sched.engine.adapters
+        _save(tmp_path, "demo", seed=99)
+        assert reg.load(os.path.join(str(tmp_path), "demo"),
+                        name="demo") == "demo@2"
+        with pytest.raises(ValueError, match="pinned"):
+            reg.unload("demo@1")
+        h2 = sched.submit(p, max_new_tokens=14, adapter="demo")
+        out1 = h1.result(timeout=300)
+        out2 = h2.result(timeout=300)
+        assert out1 == ref  # v1 stream never saw the swap
+        assert out2 != out1  # new submits run the reloaded factors
+        assert reg.unload("demo@1") == "demo@1"  # unpinned now
+    finally:
+        sched.stop()
+
+
+@pytest.mark.faults
+def test_crash_replay_resolves_journaled_adapter_byte_exact(tmp_path):
+    """Durable warm restart: the journal stores the RESOLVED versioned
+    adapter id, so the rebooted scheduler re-pins exactly that version
+    (boot-scanned fresh -> same ``@1``) and every stream — base and
+    adapter — continues byte-identically to an uninterrupted run."""
+    adir = tmp_path / "adapters"
+    adir.mkdir()
+    _save(adir, "demo", seed=1)
+    acfg = _acfg(registry_dir=str(adir))
+    ps = _prompts(3, seed=8)
+    submits = [dict(prompt=ps[0], max_new_tokens=12, adapter="demo"),
+               dict(prompt=ps[1], max_new_tokens=12),
+               dict(prompt=ps[2], max_new_tokens=12, temperature=0.7,
+                    top_k=16, seed=4, adapter="demo")]
+    ref_sched = ServingScheduler(_engine(adapters=acfg),
+                                 idle_wait=0.005).start()
+    try:
+        ref = [ref_sched.submit(**kw).result(timeout=300) for kw in submits]
+    finally:
+        ref_sched.stop()
+
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": 8}]})
+    s1 = ServingScheduler(_engine(adapters=acfg, durable=True),
+                          idle_wait=0.005).start()
+    hs = [s1.submit(**kw) for kw in submits]
+    _wait_stopped(s1)
+    pre = [list(h._req.outputs) for h in hs]
+    assert any(pre), "crash fired before anything decoded — vacuous"
+    assert not all(len(x) >= 12 for x in pre), "everything finished — vacuous"
+    get_fault_injector().reset()
+
+    s2 = ServingScheduler(_engine(adapters=acfg, durable=True),
+                          idle_wait=0.005).start()
+    try:
+        outs = [s2.lookup(uid).result(timeout=300)
+                for uid in range(1, len(submits) + 1)]
+        reg_stats = s2.engine.adapters.stats()
+    finally:
+        s2.stop()
+    assert outs == ref
+    assert all(o[:len(x)] == x for o, x in zip(outs, pre))
+    assert reg_stats["pinned"] == {}  # replayed pins retired on finish
+
+
+@pytest.mark.faults
+def test_crash_replay_missing_adapter_error_finishes(tmp_path):
+    """When the journaled adapter version no longer exists on the rebooted
+    daemon, the stream error-finishes with a typed ``adapter_unavailable``
+    error — NEVER a silent continuation on base weights. Base streams in
+    the same journal still replay byte-exactly."""
+    adir = tmp_path / "adapters"
+    adir.mkdir()
+    _save(adir, "demo", seed=1)
+    ps = _prompts(2, seed=14)
+    base_submit = dict(prompt=ps[1], max_new_tokens=12)
+    ref_sched = ServingScheduler(
+        _engine(adapters=_acfg(registry_dir=str(adir))),
+        idle_wait=0.005).start()
+    try:
+        ref_base = ref_sched.submit(**base_submit).result(timeout=300)
+    finally:
+        ref_sched.stop()
+
+    get_fault_injector().configure({"faults": [{
+        "site": "serve.crash", "nth": 8}]})
+    s1 = ServingScheduler(
+        _engine(adapters=_acfg(registry_dir=str(adir)), durable=True),
+        idle_wait=0.005).start()
+    hs = [s1.submit(prompt=ps[0], max_new_tokens=12, adapter="demo"),
+          s1.submit(**base_submit)]
+    _wait_stopped(s1)
+    get_fault_injector().reset()
+    assert len(hs[0]._req.outputs) < 12, \
+        "adapter stream finished before the crash — scenario is vacuous"
+
+    # reboot WITHOUT the registry dir: demo@1 is gone
+    s2 = ServingScheduler(_engine(adapters=_acfg(), durable=True),
+                          idle_wait=0.005).start()
+    try:
+        with pytest.raises(UnsupportedFeature) as ei:
+            s2.lookup(1).result(timeout=300)
+        assert error_reason(ei.value) == "adapter_unavailable"
+        assert s2.lookup(2).result(timeout=300) == ref_base
+    finally:
+        s2.stop()
+
+
+@pytest.mark.faults
+def test_wal_migration_resolves_adapter_byte_exact(tmp_path):
+    """Live WAL migration re-pins the journaled versioned id on the peer:
+    an adapter stream exported mid-decode finishes on the peer exactly as
+    an uninterrupted run, delivered prefix preserved verbatim."""
+    adir = tmp_path / "adapters"
+    adir.mkdir()
+    _save(adir, "demo", seed=1)
+    acfg = _acfg(registry_dir=str(adir))
+    ps = _prompts(2, seed=19)
+    submits = [dict(prompt=ps[0], max_new_tokens=12, adapter="demo"),
+               dict(prompt=ps[1], max_new_tokens=12)]
+    ref_sched = ServingScheduler(_engine(adapters=acfg),
+                                 idle_wait=0.005).start()
+    try:
+        ref = [ref_sched.submit(**kw).result(timeout=300) for kw in submits]
+    finally:
+        ref_sched.stop()
+
+    a = ServingScheduler(_engine(adapters=acfg, durable=True),
+                         idle_wait=0.005, uid_base=1_000_000).start()
+    hs = [a.submit(**kw) for kw in submits]
+    _wait_tokens(hs, 3)
+    buf = a.export_journal()
+    pre = [list(h._req.outputs) for h in hs]
+    assert not all(len(x) >= 12 for x in pre), "vacuous"
+    b = ServingScheduler(
+        _engine(adapters=acfg, durable=True,
+                journal_dir=str(tmp_path / "peer")),
+        idle_wait=0.005, uid_base=2_000_000).start()
+    try:
+        res = b.import_journal_frames(buf)
+        outs = [b.lookup(h.uid).result(timeout=300) for h in hs]
+    finally:
+        b.stop()
+    assert res["imported"] == 2 and not res["refused_uids"]
+    assert outs == ref
+    assert all(o[:len(x)] == x for o, x in zip(outs, pre))
